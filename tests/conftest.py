@@ -1,0 +1,28 @@
+"""Test harness config.
+
+Engine/sharding tests run on a virtual 8-device CPU mesh (the standard JAX
+multi-host test pattern; SURVEY.md §4) — env must be set before jax import.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+from xllm_service_tpu.coordination.memory import MemoryStore  # noqa: E402
+
+
+@pytest.fixture()
+def store():
+    """A fresh coordination 'cluster' per test."""
+    st = MemoryStore(expiry_tick_s=0.02)
+    yield st
+    st.close()

@@ -1,0 +1,163 @@
+"""Unit tests for the L1 common layer (types, hashing, predictor, metrics,
+ordered executor)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common.hashing import (
+    DEFAULT_BLOCK_SIZE,
+    hash_block,
+    prefix_block_hashes,
+    prefix_block_hash_hexes,
+)
+from xllm_service_tpu.common.metrics import MetricsRegistry
+from xllm_service_tpu.common.ordered_executor import OrderedExecutor
+from xllm_service_tpu.common.request import RequestOutput, SequenceOutput, Status, StatusCode, Usage, LogProb
+from xllm_service_tpu.common.time_predictor import TimePredictor
+from xllm_service_tpu.common.types import (
+    CacheLocations,
+    InstanceMetaInfo,
+    InstanceType,
+    KvCacheEvent,
+    LoadMetrics,
+    TpuTopology,
+)
+
+
+class TestHashing:
+    def test_chained_and_deterministic(self):
+        toks = list(range(DEFAULT_BLOCK_SIZE * 3 + 5))
+        h1 = prefix_block_hashes(toks)
+        h2 = prefix_block_hashes(toks)
+        assert h1 == h2
+        assert len(h1) == 3  # trailing partial block ignored
+        assert all(len(h) == 16 for h in h1)
+        assert len(set(h1)) == 3
+
+    def test_prefix_property(self):
+        """Shared prefixes share leading block hashes; divergence changes all
+        subsequent hashes (chaining)."""
+        a = list(range(256))
+        b = list(range(256))
+        b[200] = 9999  # diverge in block 2
+        ha, hb = prefix_block_hashes(a), prefix_block_hashes(b)
+        assert ha[0] == hb[0]
+        assert ha[1] != hb[1]
+
+    def test_block_size_variants(self):
+        toks = list(range(64))
+        assert prefix_block_hashes(toks, block_size=16) != prefix_block_hashes(toks, block_size=32)
+        assert len(prefix_block_hashes(toks, block_size=16)) == 4
+        with pytest.raises(ValueError):
+            prefix_block_hashes(toks, block_size=0)
+
+    def test_chain_seed(self):
+        blk = list(range(DEFAULT_BLOCK_SIZE))
+        assert hash_block(b"", blk) != hash_block(b"\x00" * 16, blk)
+        assert prefix_block_hash_hexes(blk)[0] == hash_block(b"", blk).hex()
+
+
+class TestTypes:
+    def test_instance_meta_roundtrip(self):
+        info = InstanceMetaInfo(
+            name="10.0.0.1:9000",
+            rpc_address="10.0.0.1:9001",
+            type=InstanceType.PREFILL,
+            dp_size=2,
+            topology=TpuTopology(slice_id="slice-a", mesh_shape=[2, 4],
+                                 axis_names=["data", "model"],
+                                 host_addrs=["10.0.0.1:9100"]),
+            ttft_profiling_data=[[128, 30.0], [512, 90.0], [2048, 300.0]],
+            incarnation_id="abc123",
+        )
+        back = InstanceMetaInfo.from_json(info.to_json())
+        assert back == info
+        assert back.topology.num_devices() == 8
+
+    def test_kv_event_and_locations(self):
+        ev = KvCacheEvent(stored=["aa" * 16], removed=[], offloaded=[])
+        assert not ev.empty()
+        assert KvCacheEvent.from_dict(ev.to_dict()) == ev
+        loc = CacheLocations(hbm={"i1", "i2"}, dram={"i3"})
+        back = CacheLocations.from_dict(loc.to_dict())
+        assert back == loc
+        back.remove_instance("i1")
+        assert back.hbm == {"i2"}
+
+    def test_load_metrics_roundtrip(self):
+        lm = LoadMetrics(waiting_requests_num=3, hbm_cache_usage_perc=0.5)
+        assert LoadMetrics.from_dict(lm.to_dict()) == lm
+
+    def test_request_output_roundtrip(self):
+        out = RequestOutput(
+            request_id="r1", service_request_id="s1",
+            status=Status(StatusCode.OK),
+            outputs=[SequenceOutput(index=0, text="hi", token_ids=[1, 2],
+                                    finish_reason="stop",
+                                    logprobs=[LogProb(token="hi", token_id=1, logprob=-0.5)])],
+            usage=Usage(10, 2), finished=True)
+        back = RequestOutput.from_dict(out.to_dict())
+        assert back == out
+
+
+class TestTimePredictor:
+    def test_ttft_quadratic_fit(self):
+        tp = TimePredictor()
+        xs = np.array([64, 128, 256, 512, 1024, 2048], dtype=float)
+        ys = 5.0 + 0.05 * xs + 1e-5 * xs * xs
+        assert tp.fit_ttft(np.stack([xs, ys], axis=1).tolist())
+        assert tp.predict_ttft(300) == pytest.approx(5.0 + 0.05 * 300 + 1e-5 * 300 * 300, rel=1e-3)
+
+    def test_tpot_linear_fit(self):
+        tp = TimePredictor()
+        rows = [[b, t, 2.0 + 0.5 * b + 0.001 * t]
+                for b in (1, 4, 16, 64) for t in (100, 1000, 10000)]
+        assert tp.fit_tpot(rows)
+        assert tp.predict_tpot(8, 5000) == pytest.approx(2.0 + 0.5 * 8 + 5.0, rel=1e-3)
+
+    def test_insufficient_data(self):
+        tp = TimePredictor()
+        assert not tp.fit_ttft([[1, 2]])
+        assert tp.predict_ttft(100) == 0.0
+        assert not tp.has_ttft
+
+
+class TestMetrics:
+    def test_prometheus_render(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "total requests")
+        c.inc()
+        c.inc(2)
+        h = reg.histogram("lat_ms", buckets=(10, 100))
+        h.observe(5)
+        h.observe(50)
+        h.observe(500)
+        text = reg.render_prometheus()
+        assert "reqs_total 3.0" in text
+        assert '# TYPE lat_ms histogram' in text
+        assert 'lat_ms_bucket{le="10"} 1' in text
+        assert 'lat_ms_bucket{le="100"} 2' in text
+        assert 'lat_ms_bucket{le="+Inf"} 3' in text
+        assert reg.counter("reqs_total") is c
+        with pytest.raises(TypeError):
+            reg.gauge("reqs_total")
+
+
+class TestOrderedExecutor:
+    def test_per_key_ordering(self):
+        ex = OrderedExecutor(num_lanes=4)
+        results: dict[str, list[int]] = {"a": [], "b": []}
+        for i in range(50):
+            for key in ("a", "b"):
+                ex.submit(key, lambda k=key, i=i: results[k].append(i))
+        ex.drain()
+        assert results["a"] == list(range(50))
+        assert results["b"] == list(range(50))
+        ex.shutdown()
+
+    def test_lane_stability(self):
+        ex = OrderedExecutor(num_lanes=8)
+        assert ex.lane_for("req-1") == ex.lane_for("req-1")
+        ex.shutdown()

@@ -1,0 +1,162 @@
+"""Coordination layer tests: lease expiry, watches, election, TCP server."""
+
+import threading
+import time
+
+import pytest
+
+from xllm_service_tpu.coordination.base import WatchEventType
+from xllm_service_tpu.coordination.client import TcpCoordinationClient
+from xllm_service_tpu.coordination.memory import InMemoryCoordination, MemoryStore
+from xllm_service_tpu.coordination.server import CoordinationServer
+
+
+class _WatchSink:
+    def __init__(self):
+        self.events = []
+        self.cv = threading.Condition()
+
+    def __call__(self, events, prefix):
+        with self.cv:
+            self.events.extend(events)
+            self.cv.notify_all()
+
+    def wait_for(self, pred, timeout=3.0):
+        with self.cv:
+            return self.cv.wait_for(lambda: pred(self.events), timeout)
+
+
+class TestInMemory:
+    def test_basic_kv(self, store):
+        c = InMemoryCoordination(store)
+        assert c.set("a/b", "1")
+        assert c.get("a/b") == "1"
+        c.bulk_set({"a/c": "2", "d": "3"})
+        assert c.get_prefix("a/") == {"a/b": "1", "a/c": "2"}
+        assert c.rm("a/b")
+        assert c.get("a/b") is None
+        assert c.bulk_rm(["a/c", "nope"]) == 1
+        c.close()
+
+    def test_namespace(self, store):
+        c1 = InMemoryCoordination(store, namespace="tenant1")
+        c2 = InMemoryCoordination(store, namespace="tenant2")
+        c1.set("k", "v1")
+        c2.set("k", "v2")
+        assert c1.get("k") == "v1"
+        assert c2.get("k") == "v2"
+        assert store.get("tenant1/k") == "v1"
+        c1.close(); c2.close()
+
+    def test_lease_expiry_without_keepalive(self, store):
+        c = InMemoryCoordination(store)
+        sink = _WatchSink()
+        c.add_watch("inst/", sink)
+        c.set("inst/x", "v", ttl_s=0.1, keepalive=False)
+        assert sink.wait_for(lambda ev: any(
+            e.type == WatchEventType.DELETE and e.key == "inst/x" for e in ev))
+        assert c.get("inst/x") is None
+        c.close()
+
+    def test_keepalive_then_client_death(self, store):
+        owner = InMemoryCoordination(store)
+        observer = InMemoryCoordination(store)
+        sink = _WatchSink()
+        observer.add_watch("svc/", sink)
+        owner.set("svc/me", "alive", ttl_s=0.15)
+        time.sleep(0.5)  # several ttl periods: keepalive must hold it
+        assert observer.get("svc/me") == "alive"
+        owner.close()    # "process death"
+        assert sink.wait_for(lambda ev: any(
+            e.type == WatchEventType.DELETE and e.key == "svc/me" for e in ev))
+        observer.close()
+
+    def test_create_if_absent_election(self, store):
+        a = InMemoryCoordination(store)
+        b = InMemoryCoordination(store)
+        won_a = a.create_if_absent("MASTER", "a", ttl_s=0.15)
+        won_b = b.create_if_absent("MASTER", "b", ttl_s=0.15)
+        assert won_a and not won_b
+        assert b.get("MASTER") == "a"
+        # Master dies -> key lapses -> replica can win.
+        a.close()
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            if b.create_if_absent("MASTER", "b", ttl_s=0.15):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("replica never won election after master death")
+        b.close()
+
+    def test_guarded_rm_prefix(self, store):
+        c = InMemoryCoordination(store)
+        c.set("CACHE/a", "1")
+        c.set("CACHE/b", "2")
+        assert c.rm_prefix("CACHE/", guard_key="MASTER") == 0  # guard absent
+        c.set("MASTER", "me")
+        assert c.rm_prefix("CACHE/", guard_key="MASTER") == 2
+        c.close()
+
+    def test_watch_put_events(self, store):
+        c = InMemoryCoordination(store)
+        sink = _WatchSink()
+        wid = c.add_watch("p/", sink)
+        c.set("p/x", "1")
+        c.set("q/y", "2")  # outside prefix
+        assert sink.wait_for(lambda ev: len(ev) >= 1)
+        assert [e.key for e in sink.events] == ["p/x"]
+        c.remove_watch(wid)
+        c.set("p/z", "3")
+        time.sleep(0.1)
+        assert [e.key for e in sink.events] == ["p/x"]
+        c.close()
+
+
+class TestTcpServer:
+    @pytest.fixture()
+    def server(self):
+        srv = CoordinationServer(host="127.0.0.1", port=0)
+        srv.start_background()
+        yield srv
+        srv.stop()
+
+    def test_kv_and_watch_over_tcp(self, server):
+        c1 = TcpCoordinationClient(f"127.0.0.1:{server.port}")
+        c2 = TcpCoordinationClient(f"127.0.0.1:{server.port}")
+        sink = _WatchSink()
+        c2.add_watch("inst/", sink)
+        assert c1.set("inst/a", "hello")
+        assert c2.get("inst/a") == "hello"
+        assert sink.wait_for(lambda ev: any(e.key == "inst/a" for e in ev))
+        assert c1.get_prefix("inst/") == {"inst/a": "hello"}
+        c1.close(); c2.close()
+
+    def test_lease_over_tcp_client_death(self, server):
+        owner = TcpCoordinationClient(f"127.0.0.1:{server.port}")
+        observer = TcpCoordinationClient(f"127.0.0.1:{server.port}")
+        sink = _WatchSink()
+        observer.add_watch("svc/", sink)
+        owner.set("svc/me", "alive", ttl_s=0.2)
+        time.sleep(0.6)
+        assert observer.get("svc/me") == "alive"  # keepalive held it
+        owner.close()  # refreshes stop -> lease lapses
+        assert sink.wait_for(lambda ev: any(
+            e.type == WatchEventType.DELETE and e.key == "svc/me" for e in ev),
+            timeout=5.0)
+        observer.close()
+
+    def test_auth(self):
+        srv = CoordinationServer(host="127.0.0.1", port=0, auth=("u", "p"))
+        srv.start_background()
+        try:
+            ok = TcpCoordinationClient(f"127.0.0.1:{srv.port}",
+                                       username="u", password="p")
+            assert ok.set("k", "v")
+            ok.close()
+            from xllm_service_tpu.coordination.client import CoordinationError
+            with pytest.raises(CoordinationError):
+                TcpCoordinationClient(f"127.0.0.1:{srv.port}",
+                                      username="u", password="wrong")
+        finally:
+            srv.stop()

@@ -1,0 +1,19 @@
+"""xllm_service_tpu — a TPU-native LLM serving-orchestration framework.
+
+Brand-new implementation of the capability surface of
+jd-opensource/xllm-service (reference surveyed in SURVEY.md), designed
+TPU-first:
+
+- **Orchestration plane**: OpenAI-compatible HTTP frontend, fleet management
+  with lease/incarnation failure detection, PD-disaggregated routing with
+  dynamic role flipping, global prefix-KV-cache-aware + SLO-aware load
+  balancing, master HA — mirrors the behavioral contract of the reference's
+  `xllm_service/` C++ service (see SURVEY.md §2).
+- **Engine plane**: JAX/XLA/Pallas continuous-batching runtime with a paged
+  KV cache in HBM, prefill/decode as separately compiled jit programs over a
+  `jax.sharding.Mesh`, Pallas paged-attention decode kernels, and ICI/DCN
+  KV handoff — replaces the reference's empty `third_party/xllm` engine
+  (reference: SURVEY.md §0, §7).
+"""
+
+__version__ = "0.1.0"

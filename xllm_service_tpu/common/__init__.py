@@ -1,0 +1,4 @@
+"""L1 common infrastructure: domain types, config, hashing, metrics.
+
+Reference parity: `xllm_service/common/` (SURVEY.md §2.9).
+"""

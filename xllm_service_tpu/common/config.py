@@ -1,0 +1,98 @@
+"""Service configuration.
+
+Parity: the reference's three-tier config (SURVEY.md §5.6): gflags
+(`common/global_gflags.cpp:20-149`) copied into a fluent `Options` object
+(`common/options.h:25-92`) plus env vars. Here: one dataclass with every
+reference knob (same defaults), constructible from argparse CLI flags and
+env vars; live-reloadable SLO targets (the reference exposes target_ttft /
+target_tpot via brpc flag reload, `global_gflags.cpp:122-132` — we expose
+them via the admin HTTP endpoint).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ServiceOptions:
+    """All orchestration-plane knobs (reference defaults preserved)."""
+
+    # --- serving endpoints (reference `global_gflags.cpp:25,38`) ---
+    host: str = "0.0.0.0"
+    http_port: int = 8888
+    rpc_port: int = 8889
+    num_http_threads: int = 32
+    num_rpc_threads: int = 32
+    max_concurrency: int = 0          # 0 = unlimited
+    # --- model / tokenization ---
+    tokenizer_path: str = ""
+    model_id: str = ""
+    # --- coordination (reference `etcd_addr/namespace`) ---
+    coordination_addr: str = ""       # "" => in-process memory backend
+    coordination_namespace: str = ""
+    coordination_username: str = field(
+        default_factory=lambda: os.environ.get("ETCD_USERNAME", ""))
+    coordination_password: str = field(
+        default_factory=lambda: os.environ.get("ETCD_PASSWORD", ""))
+    # --- scheduling ---
+    load_balance_policy: str = "RR"   # RR | CAR | SLO_AWARE
+    block_size: int = 128             # prefix-hash block (`global_gflags.cpp:114-116`)
+    max_waiting_requests: int = 1024  # CAR normalization denominator
+    # SLO targets, live-reloadable (`global_gflags.cpp:122-132`).
+    target_ttft_ms: float = 1000.0
+    target_tpot_ms: float = 50.0
+    # --- failure detection (`global_gflags.cpp:95-113`) ---
+    heartbeat_interval_s: float = 3.0
+    lease_ttl_s: float = 3.0
+    health_probe_attempts: int = 2
+    health_probe_timeout_s: float = 1.0
+    heartbeat_silence_to_suspect_s: float = 3.0
+    detect_disconnected_instance_interval_s: float = 15.0
+    reconcile_interval_s: float = 1.0
+    sync_interval_s: float = 3.0      # master upload loop cadence
+    readiness_check_interval_s: float = 3.0
+    # --- output parsing preferences (`global_gflags.cpp:134-142`) ---
+    tool_call_parser: str = "auto"
+    reasoning_parser: str = "auto"
+    # --- tracing / debug ---
+    enable_request_trace: bool = False
+    trace_dir: str = "trace"
+    debug_log: bool = field(
+        default_factory=lambda: os.environ.get("ENABLE_XLLM_DEBUG_LOG", "") not in ("", "0", "false"))
+    # --- request registry ---
+    num_output_threads: int = 16      # per-request output-ordering lanes
+    request_timeout_s: float = 600.0
+
+    def with_overrides(self, **kw) -> "ServiceOptions":
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def add_cli_args(cls, p: argparse.ArgumentParser) -> None:
+        for f in dataclasses.fields(cls):
+            name = "--" + f.name.replace("_", "-")
+            if f.type in ("bool", bool):
+                p.add_argument(name, action="store_true", default=None)
+            else:
+                p.add_argument(name, default=None)
+
+    @classmethod
+    def from_cli_args(cls, args: argparse.Namespace) -> "ServiceOptions":
+        opts = cls()
+        for f in dataclasses.fields(cls):
+            v = getattr(args, f.name, None)
+            if v is None:
+                continue
+            cur = getattr(opts, f.name)
+            if isinstance(cur, bool):
+                setattr(opts, f.name, bool(v))
+            elif isinstance(cur, int):
+                setattr(opts, f.name, int(v))
+            elif isinstance(cur, float):
+                setattr(opts, f.name, float(v))
+            else:
+                setattr(opts, f.name, v)
+        return opts

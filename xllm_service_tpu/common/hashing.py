@@ -1,0 +1,68 @@
+"""Chained block hashing for global prefix-KV-cache identity.
+
+Parity: reference `common/hash_util.{h,cpp}` — 16-byte keys produced by a
+chained 128-bit hash over ``[prev_hash ‖ block_token_ids]`` per fixed-size
+token block (`hash_util.cpp:18-50`, block_size=128 per
+`global_gflags.cpp:114-116`). The reference uses XXH3-128; the exact function
+is an implementation detail — what matters is that every party (engines,
+schedulers, replicas) computes identical keys for identical token prefixes.
+
+We use BLAKE2b-128 keyed with the previous block hash via Python's hashlib
+(C-speed, battle-tested, dependency-free). An optional C extension
+(`csrc/blockhash.c`) implements the same construction for the native
+orchestration components; both produce identical digests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Sequence
+
+import numpy as np
+
+# 128-token blocks, matching the reference default (`global_gflags.cpp:114`).
+DEFAULT_BLOCK_SIZE = 128
+HASH_NBYTES = 16
+_SEED = b"xllm-service-tpu"
+
+
+def hash_block(prev: bytes, token_ids: Sequence[int]) -> bytes:
+    """Hash one token block chained onto ``prev`` (b"" for the first block)."""
+    key = prev if prev else _SEED
+    h = hashlib.blake2b(digest_size=HASH_NBYTES, key=key)
+    h.update(np.asarray(token_ids, dtype=np.int32).tobytes())
+    return h.digest()
+
+
+def prefix_block_hashes(
+    token_ids: Sequence[int], block_size: int = DEFAULT_BLOCK_SIZE
+) -> list[bytes]:
+    """Chained hashes for every *complete* block of ``token_ids``.
+
+    Matches the reference's matching loop (`global_kvcache_mgr.cpp:85-94`):
+    only full blocks participate; the trailing partial block is ignored.
+    """
+    if block_size <= 0:
+        raise ValueError(f"block_size must be positive, got {block_size}")
+    arr = np.asarray(token_ids, dtype=np.int32)
+    n_blocks = len(arr) // block_size
+    out: list[bytes] = []
+    prev = b""
+    for i in range(n_blocks):
+        prev = hash_block(prev, arr[i * block_size : (i + 1) * block_size])
+        out.append(prev)
+    return out
+
+
+def prefix_block_hash_hexes(
+    token_ids: Sequence[int], block_size: int = DEFAULT_BLOCK_SIZE
+) -> list[str]:
+    return [h.hex() for h in prefix_block_hashes(token_ids, block_size)]
+
+
+def to_hex(h: bytes) -> str:
+    return h.hex()
+
+
+def from_hex(s: str) -> bytes:
+    return bytes.fromhex(s)

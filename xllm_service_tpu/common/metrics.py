@@ -1,0 +1,144 @@
+"""Service metrics: counters, gauges, histograms with Prometheus text export.
+
+Parity: reference bvar macros (`common/metrics.h:50-104`) and the three
+defined instruments (`metrics.h:108-111`): `server_request_in_total`,
+`time_to_first_token_latency_milliseconds`,
+`inter_token_latency_milliseconds`. The reference leaves `/metrics` empty
+(`http_service/service.cpp:526-532`); we implement it properly
+(SURVEY.md §5.5 "New framework: same shape, Prometheus-format /metrics done
+properly").
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Iterable
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_)
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._v += v
+
+    def value(self) -> float:
+        return self._v
+
+    def render(self) -> str:
+        return f"{self.name} {self._v}\n"
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_)
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = float(v)
+
+    def value(self) -> float:
+        return self._v
+
+    def render(self) -> str:
+        return f"{self.name} {self._v}\n"
+
+
+_DEFAULT_BUCKETS = (1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000, 30000)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str = "", buckets: Iterable[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_)
+        self.buckets = sorted(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._counts[bisect_right(self.buckets, v)] += 1
+            self._sum += v
+            self._n += 1
+
+    def count(self) -> int:
+        return self._n
+
+    def mean(self) -> float:
+        return self._sum / self._n if self._n else 0.0
+
+    def render(self) -> str:
+        out = []
+        cum = 0
+        with self._lock:
+            for b, c in zip(self.buckets, self._counts):
+                cum += c
+                out.append(f'{self.name}_bucket{{le="{b}"}} {cum}\n')
+            cum += self._counts[-1]
+            out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}\n')
+            out.append(f"{self.name}_sum {self._sum}\n")
+            out.append(f"{self.name}_count {self._n}\n")
+        return "".join(out)
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help_, buckets), Histogram)
+
+    def _get_or_create(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+            return m
+
+    def render_prometheus(self) -> str:
+        parts = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.help:
+                parts.append(f"# HELP {m.name} {m.help}\n")
+            parts.append(f"# TYPE {m.name} {m.kind}\n")
+            parts.append(m.render())
+        return "".join(parts)
+
+
+# Global registry + the reference's instruments (`metrics.h:108-111`).
+REGISTRY = MetricsRegistry()
+SERVER_REQUEST_IN_TOTAL = REGISTRY.counter(
+    "server_request_in_total", "Total requests accepted by the HTTP frontend")
+TTFT_MS = REGISTRY.histogram(
+    "time_to_first_token_latency_milliseconds", "TTFT per request (ms)")
+ITL_MS = REGISTRY.histogram(
+    "inter_token_latency_milliseconds", "Inter-token latency (ms)")

@@ -1,0 +1,294 @@
+"""Core domain types for the orchestration plane.
+
+Behavioral parity with the reference's `xllm_service/common/types.h` (461 LoC;
+see SURVEY.md §2.9): InstanceMetaInfo, InstanceType, InstanceRuntimeState,
+Routing, LoadMetrics, LatencyMetrics, KvCacheEvent, CacheLocations,
+RequestAction/RequestMetrics, OverlapScores, LoadBalanceInfos — re-designed
+for TPU: the reference's RDMA endpoint fields (`device_ips`, `ports`,
+`cluster_ids`, reference `xllm_rpc_service.proto:38-43`) are replaced with an
+explicit :class:`TpuTopology` (slice id, mesh shape, named axes, per-host DCN
+addresses) so the scheduler can place prefill/decode roles topology-aware.
+
+All types JSON-round-trip (``to_json``/``from_json``) because — like the
+reference, which persists them to etcd (`types.h:224-318`) — they are stored
+in the coordination service and mirrored by replica schedulers.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Any
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class InstanceType(str, enum.Enum):
+    """Role of an engine instance in the PD(+E)-disaggregated fleet.
+
+    Reference: `common/types.h:75-83` {DEFAULT, PREFILL, DECODE, MIX}; we add
+    ENCODE for EPD three-stage multimodal disaggregation (the reference only
+    claims the feature, README.md:47 — the mechanism is ours to define).
+    """
+
+    DEFAULT = "DEFAULT"
+    PREFILL = "PREFILL"
+    DECODE = "DECODE"
+    MIX = "MIX"
+    ENCODE = "ENCODE"
+
+    @classmethod
+    def parse(cls, v: "InstanceType | str | None") -> "InstanceType":
+        if v is None:
+            return cls.DEFAULT
+        if isinstance(v, InstanceType):
+            return v
+        return cls(str(v).upper())
+
+
+class InstanceRuntimeState(str, enum.Enum):
+    """Three-state liveness (reference `common/types.h:85-89`).
+
+    ACTIVE -> LEASE_LOST (lease expired but health probe passed; still
+    schedulable) -> SUSPECT (probe failed or heartbeat silence; excluded from
+    scheduling) -> evicted. See SURVEY.md §3.4.
+    """
+
+    ACTIVE = "ACTIVE"
+    LEASE_LOST = "LEASE_LOST"
+    SUSPECT = "SUSPECT"
+
+
+class RequestAction(str, enum.Enum):
+    """SLO-accounting actions (reference `common/types.h:152-158`)."""
+
+    SCHEDULE = "SCHEDULE"
+    FINISH_PREFILL = "FINISH_PREFILL"
+    DECODE_STEP = "DECODE_STEP"
+    FINISH_DECODE = "FINISH_DECODE"
+
+
+@dataclass
+class TpuTopology:
+    """TPU-native placement metadata, replacing the reference's RDMA NIC
+    fields (`xllm_rpc_service.proto:38-43` device_ips/ports/cluster_ids).
+
+    slice_id      — which TPU slice/pod this instance's mesh lives on; KV
+                    handoff between instances on the same slice can ride ICI,
+                    cross-slice handoff rides DCN.
+    mesh_shape    — e.g. [2, 4] for a 2x4 sub-mesh.
+    axis_names    — named mesh axes, e.g. ["data", "model"].
+    host_addrs    — per-host DCN endpoints (host:port) for KV transfer.
+    chip_coords   — optional per-chip coordinates within the slice.
+    """
+
+    slice_id: str = ""
+    mesh_shape: list[int] = field(default_factory=list)
+    axis_names: list[str] = field(default_factory=list)
+    host_addrs: list[str] = field(default_factory=list)
+    chip_coords: list[list[int]] = field(default_factory=list)
+
+    def num_devices(self) -> int:
+        n = 1
+        for d in self.mesh_shape:
+            n *= d
+        return n if self.mesh_shape else 0
+
+
+@dataclass
+class InstanceMetaInfo:
+    """Engine instance registration record.
+
+    Reference: `xllm_rpc_service.proto:31-46` InstanceMetaInfo — name (the
+    instance's HTTP address doubles as its identity), rpc_address, type,
+    dp_size, kv-cache ids, profiling tables, incarnation_id, register_ts_ms.
+    TPU changes: `topology` replaces cluster_ids/device_ips/ports;
+    `max_context_len`/`cp_degree` advertise long-context capability
+    (SURVEY.md §5.7); `kv_page_size`/`kv_dtype`/`num_layers`/`num_kv_heads`/
+    `head_dim` advertise KV layout so PD peers can validate transfer
+    compatibility before linking.
+    """
+
+    name: str = ""                       # identity; typically "host:http_port"
+    rpc_address: str = ""
+    type: InstanceType = InstanceType.DEFAULT
+    dp_size: int = 1
+    topology: TpuTopology = field(default_factory=TpuTopology)
+    # KV layout contract for PD linking (replaces opaque k/v_cache_ids).
+    kv_page_size: int = 128
+    kv_dtype: str = "bfloat16"
+    num_layers: int = 0
+    num_kv_heads: int = 0
+    head_dim: int = 0
+    # Long-context capability (SURVEY.md §5.7).
+    max_context_len: int = 8192
+    cp_degree: int = 1
+    # Offline-profiled latency tables: rows of [prompt_len, ttft_ms] and
+    # [batch_size, total_tokens, tpot_ms] (reference `common/types.h:207-210`),
+    # fitted by TimePredictor at registration.
+    ttft_profiling_data: list[list[float]] = field(default_factory=list)
+    tpot_profiling_data: list[list[float]] = field(default_factory=list)
+    # Lifecycle.
+    incarnation_id: str = ""
+    register_ts_ms: int = 0
+    models: list[str] = field(default_factory=list)
+
+    # ---- json ----
+    def to_json(self) -> str:
+        d = asdict(self)
+        d["type"] = self.type.value
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str | bytes) -> "InstanceMetaInfo":
+        d = json.loads(s)
+        topo = d.pop("topology", None) or {}
+        info = cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__ and k not in ("type", "topology")})
+        info.type = InstanceType.parse(d.get("type"))
+        info.topology = TpuTopology(**{k: v for k, v in topo.items() if k in TpuTopology.__dataclass_fields__})
+        return info
+
+
+@dataclass
+class LoadMetrics:
+    """Per-instance load snapshot carried in heartbeats.
+
+    Reference: `xllm_rpc_service.proto:54-58` {waiting_requests_num,
+    gpu_cache_usage_perc}; renamed gpu→hbm for TPU.
+    """
+
+    waiting_requests_num: int = 0
+    hbm_cache_usage_perc: float = 0.0
+    running_requests_num: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "LoadMetrics":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+
+@dataclass
+class LatencyMetrics:
+    """Recent worst-case latencies from the engine (reference
+    `xllm_rpc_service.proto:59-62`)."""
+
+    recent_max_ttft: float = 0.0
+    recent_max_tbt: float = 0.0
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "LatencyMetrics":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+
+@dataclass
+class KvCacheEvent:
+    """Delta of the instance's prefix-cache content, carried in heartbeats.
+
+    Reference: `xllm_rpc_service.proto:48-53` KvCacheEvent {stored/removed/
+    offload_cache blobs}. Hashes are hex strings of the 16-byte chained block
+    hash (common/hashing.py).
+    """
+
+    stored: list[str] = field(default_factory=list)
+    removed: list[str] = field(default_factory=list)
+    offloaded: list[str] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return not (self.stored or self.removed or self.offloaded)
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "KvCacheEvent":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+
+class CacheTier(str, enum.Enum):
+    """KV block residence tier (reference `common/types.h:320-365`
+    CacheLocations{hbm,dram,ssd}). On TPU: HBM = device memory,
+    DRAM = TPU-VM host memory, SSD = local disk."""
+
+    HBM = "hbm"
+    DRAM = "dram"
+    SSD = "ssd"
+
+
+@dataclass
+class CacheLocations:
+    """Which instances hold a given KV block, per tier."""
+
+    hbm: set[str] = field(default_factory=set)
+    dram: set[str] = field(default_factory=set)
+    ssd: set[str] = field(default_factory=set)
+
+    def empty(self) -> bool:
+        return not (self.hbm or self.dram or self.ssd)
+
+    def remove_instance(self, name: str) -> None:
+        self.hbm.discard(name)
+        self.dram.discard(name)
+        self.ssd.discard(name)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"hbm": sorted(self.hbm), "dram": sorted(self.dram), "ssd": sorted(self.ssd)}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "CacheLocations":
+        return cls(hbm=set(d.get("hbm", ())), dram=set(d.get("dram", ())), ssd=set(d.get("ssd", ())))
+
+
+@dataclass
+class OverlapScores:
+    """Prefix-cache match result per candidate instance
+    (reference `common/types.h:376-403`)."""
+
+    # instance name -> number of matched KV blocks (per tier-weighted score).
+    scores: dict[str, float] = field(default_factory=dict)
+    max_block_num: int = 0
+
+
+@dataclass
+class Routing:
+    """Chosen (prefill, decode[, encode]) instance pair for a request
+    (reference `common/types.h:43-55`)."""
+
+    prefill_name: str = ""
+    decode_name: str = ""
+    encode_name: str = ""
+
+    def valid(self) -> bool:
+        return bool(self.prefill_name)
+
+
+@dataclass
+class RequestMetrics:
+    """Per-request SLO accounting (reference `common/types.h:161-178`)."""
+
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+    schedule_time_ms: int = 0
+    prefill_finish_time_ms: int = 0
+    finish_time_ms: int = 0
+    estimated_ttft_ms: float = 0.0
+
+
+@dataclass
+class InstanceLoadInfo:
+    """Aggregated per-instance info handed to LB policies
+    (reference `common/types.h:405-437` LoadBalanceInfos)."""
+
+    name: str = ""
+    type: InstanceType = InstanceType.DEFAULT
+    load: LoadMetrics = field(default_factory=LoadMetrics)
+    latency: LatencyMetrics = field(default_factory=LatencyMetrics)
+    schedulable: bool = True

@@ -1,0 +1,208 @@
+"""TCP client for the standalone coordination server.
+
+Implements :class:`CoordinationClient` over the newline-JSON protocol of
+:mod:`.server`. A reader thread demultiplexes responses (by request id) from
+watch pushes; a keepalive thread refreshes leased keys at ttl/3 — so if this
+process dies, its leases lapse on the server and watchers see DELETEs
+(etcd-lease parity; reference `etcd_client.cpp:105-120`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import socket
+import threading
+from typing import Optional
+
+from .base import CoordinationClient, KeyEvent, WatchCallback, WatchEventType
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class CoordinationError(RuntimeError):
+    pass
+
+
+class TcpCoordinationClient(CoordinationClient):
+    def __init__(self, addr: str, namespace: str = "",
+                 username: str = "", password: str = "",
+                 timeout_s: float = 10.0):
+        host, _, port = addr.rpartition(":")
+        self._sock = socket.create_connection((host or "127.0.0.1", int(port)),
+                                              timeout=timeout_s)
+        self._sock.settimeout(None)
+        self._rfile = self._sock.makefile("rb")
+        self._wlock = threading.Lock()
+        self._ns = namespace.strip("/")
+        self._ids = itertools.count(1)
+        self._pending: dict[int, tuple[threading.Event, dict]] = {}
+        self._plock = threading.Lock()
+        self._watches: dict[int, tuple[str, WatchCallback]] = {}
+        self._keepalives: dict[str, float] = {}
+        self._ka_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._timeout_s = timeout_s
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="coord-reader", daemon=True)
+        self._reader.start()
+        self._ka_thread = threading.Thread(target=self._keepalive_loop,
+                                           name="coord-ka", daemon=True)
+        self._ka_thread.start()
+        if username:
+            resp = self._call({"op": "auth", "username": username,
+                               "password": password})
+            if not resp.get("ok"):
+                raise CoordinationError("coordination auth failed")
+        # Connectivity check (reference pings with a PUT of XLLM_PING,
+        # `etcd_client.cpp:58-86`).
+        if not self._call({"op": "ping"}).get("ok"):
+            raise CoordinationError("coordination ping failed")
+
+    # ---- plumbing ----------------------------------------------------------
+    def _k(self, key: str) -> str:
+        return f"{self._ns}/{key}" if self._ns else key
+
+    def _strip(self, key: str) -> str:
+        return key[len(self._ns) + 1:] if self._ns else key
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self._rfile:
+                msg = json.loads(line)
+                if msg.get("event") == "watch":
+                    wid = msg["watch_id"]
+                    entry = self._watches.get(wid)
+                    if entry is None:
+                        continue
+                    prefix, cb = entry
+                    events = [KeyEvent(WatchEventType(e["type"]),
+                                       self._strip(e["key"]), e.get("value", ""))
+                              for e in msg.get("events", ())]
+                    try:
+                        cb(events, prefix)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("watch callback failed")
+                    continue
+                rid = msg.get("id")
+                with self._plock:
+                    waiter = self._pending.pop(rid, None)
+                if waiter is not None:
+                    waiter[1].update(msg)
+                    waiter[0].set()
+        except (OSError, ValueError):
+            pass
+        finally:
+            # Fail all pending calls on disconnect.
+            with self._plock:
+                for ev, resp in self._pending.values():
+                    resp["ok"] = False
+                    resp["error"] = "connection closed"
+                    ev.set()
+                self._pending.clear()
+
+    def _call(self, req: dict) -> dict:
+        if self._closed.is_set():
+            return {"ok": False, "error": "client closed"}
+        rid = next(self._ids)
+        req["id"] = rid
+        ev, resp = threading.Event(), {}
+        with self._plock:
+            self._pending[rid] = (ev, resp)
+        data = (json.dumps(req) + "\n").encode()
+        try:
+            with self._wlock:
+                self._sock.sendall(data)
+        except OSError as e:
+            with self._plock:
+                self._pending.pop(rid, None)
+            return {"ok": False, "error": str(e)}
+        if not ev.wait(self._timeout_s):
+            with self._plock:
+                self._pending.pop(rid, None)
+            return {"ok": False, "error": "timeout"}
+        return resp
+
+    def _keepalive_loop(self) -> None:
+        # Fine-grained tick; each key refreshed at ttl/3 cadence (etcd
+        # KeepAlive behavior).
+        last_refresh: dict[str, float] = {}
+        import time as _time
+
+        while not self._closed.wait(0.02):
+            now = _time.monotonic()
+            with self._ka_lock:
+                items = list(self._keepalives.items())
+            for key, ttl in items:
+                if now - last_refresh.get(key, 0.0) >= ttl / 3.0:
+                    last_refresh[key] = now
+                    self._call({"op": "refresh", "key": key, "ttl": ttl})
+
+    # ---- CoordinationClient ------------------------------------------------
+    def set(self, key, value, ttl_s=None, keepalive=True) -> bool:
+        ok = self._call({"op": "put", "key": self._k(key), "value": value,
+                         "ttl": ttl_s}).get("ok", False)
+        if ok and ttl_s and keepalive:
+            with self._ka_lock:
+                self._keepalives[self._k(key)] = ttl_s
+        return ok
+
+    def create_if_absent(self, key, value, ttl_s=None, keepalive=True) -> bool:
+        ok = self._call({"op": "put", "key": self._k(key), "value": value,
+                         "ttl": ttl_s, "create_only": True}).get("ok", False)
+        if ok and ttl_s and keepalive:
+            with self._ka_lock:
+                self._keepalives[self._k(key)] = ttl_s
+        return ok
+
+    def get(self, key) -> Optional[str]:
+        resp = self._call({"op": "get", "key": self._k(key)})
+        return resp.get("value") if resp.get("ok") else None
+
+    def get_prefix(self, prefix) -> dict[str, str]:
+        resp = self._call({"op": "get_prefix", "prefix": self._k(prefix)})
+        if not resp.get("ok"):
+            return {}
+        return {self._strip(k): v for k, v in resp.get("kvs", {}).items()}
+
+    def rm(self, key) -> bool:
+        self.release(key)
+        return self._call({"op": "rm", "key": self._k(key)}).get("ok", False)
+
+    def rm_prefix(self, prefix, guard_key=None) -> int:
+        resp = self._call({"op": "rm_prefix", "prefix": self._k(prefix),
+                           "guard_key": self._k(guard_key) if guard_key else None})
+        return resp.get("count", 0)
+
+    def bulk_set(self, kvs) -> bool:
+        return self._call({"op": "bulk_set",
+                           "kvs": {self._k(k): v for k, v in kvs.items()}}).get("ok", False)
+
+    def bulk_rm(self, keys) -> int:
+        return self._call({"op": "bulk_rm",
+                           "keys": [self._k(k) for k in keys]}).get("count", 0)
+
+    def release(self, key) -> None:
+        with self._ka_lock:
+            self._keepalives.pop(self._k(key), None)
+
+    def add_watch(self, prefix, cb) -> int:
+        wid = next(self._ids)
+        self._watches[wid] = (prefix, cb)
+        self._call({"op": "watch", "watch_id": wid, "prefix": self._k(prefix)})
+        return wid
+
+    def remove_watch(self, watch_id) -> None:
+        self._watches.pop(watch_id, None)
+        self._call({"op": "unwatch", "watch_id": watch_id})
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()

@@ -1,0 +1,65 @@
+"""Misc utilities (reference `common/utils.{h,cpp}`, `common/uuid.*`)."""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import uuid as _uuid
+
+
+def short_uuid() -> str:
+    """8-char request-id suffix (reference generates short uuids for
+    `method-threadid-shortuuid` service request ids, `service.cpp:44-51`)."""
+    return _uuid.uuid4().hex[:8]
+
+
+def generate_service_request_id(method: str) -> str:
+    """Service-generated request id `method-threadid-shortuuid`
+    (reference `http_service/service.cpp:44-51`)."""
+    return f"{method}-{threading.get_ident() & 0xFFFF}-{short_uuid()}"
+
+
+def is_port_available(port: int, host: str = "0.0.0.0") -> bool:
+    """Reference `common/utils.cpp:42`."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind((host, port))
+            return True
+        except OSError:
+            return False
+
+
+def pick_free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def get_local_ip() -> str:
+    """Best-effort local IP (reference `common/utils.cpp:85` uses a resolver;
+    we use the connected-UDP trick with a loopback fallback)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def join_namespace(namespace: str, key: str) -> str:
+    """etcd-style namespace prefixing (reference `common/utils.cpp:105-133`)."""
+    ns = namespace.strip("/")
+    return f"{ns}/{key}" if ns else key
+
+
+def get_logger(name: str) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logging.getLogger().handlers and not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s"))
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+    return logger
