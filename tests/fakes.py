@@ -1,0 +1,101 @@
+"""Shared test doubles: fake engine channel + registration helpers.
+
+The reference has no fake engine (SURVEY.md §4 names this the key testing
+gap); this module is the hermetic stand-in for channel-level behavior. The
+full in-process fake engine (heartbeats, Generations streams) lives in
+`xllm_service_tpu.testing.fake_engine`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+
+from xllm_service_tpu.common.types import InstanceMetaInfo, InstanceType, TpuTopology
+from xllm_service_tpu.rpc import instance_key
+
+
+class FakeChannel:
+    """Records control-plane calls; health and link results are scriptable."""
+
+    registry: dict[str, "FakeChannel"] = {}
+
+    def __init__(self, name: str, rpc_addr: str = ""):
+        self.name = name
+        self.healthy = True
+        self.link_ok = True
+        self.links: list[str] = []
+        self.unlinks: list[str] = []
+        self.cancels: list[str] = []
+        self.flips: list[str] = []
+        self.flip_ok = True
+        self.closed = False
+        FakeChannel.registry[name] = self
+
+    @classmethod
+    def factory(cls, name: str, rpc_addr: str) -> "FakeChannel":
+        return cls(name, rpc_addr)
+
+    @classmethod
+    def reset(cls) -> None:
+        cls.registry.clear()
+
+    def health(self, timeout_s: float = 1.0) -> bool:
+        return self.healthy
+
+    def link(self, peer: InstanceMetaInfo) -> bool:
+        if self.link_ok:
+            self.links.append(peer.name)
+        return self.link_ok
+
+    def unlink(self, peer_name: str) -> bool:
+        self.unlinks.append(peer_name)
+        return True
+
+    def cancel(self, service_request_id: str) -> bool:
+        self.cancels.append(service_request_id)
+        return True
+
+    def flip_role(self, new_type: str) -> bool:
+        if self.flip_ok:
+            self.flips.append(new_type)
+        return self.flip_ok
+
+    def models(self):
+        return []
+
+    def forward(self, path, payload):
+        return True, {}
+
+    def close(self) -> None:
+        self.closed = True
+
+
+def make_meta(name: str, itype: InstanceType = InstanceType.MIX,
+              **kw) -> InstanceMetaInfo:
+    return InstanceMetaInfo(
+        name=name, rpc_address=name, type=itype,
+        incarnation_id=kw.pop("incarnation_id", uuid.uuid4().hex[:8]),
+        topology=TpuTopology(slice_id=kw.pop("slice_id", "s0"),
+                             mesh_shape=[1], axis_names=["data"]),
+        **kw)
+
+
+def register_in_coord(coord, meta: InstanceMetaInfo, ttl_s: float = 3.0,
+                      keepalive: bool = True) -> None:
+    """Simulate an engine registering itself (reference: engines write their
+    meta to etcd under a TTL lease, SURVEY.md §3.4)."""
+    coord.set(instance_key(meta.type.value, meta.name), meta.to_json(),
+              ttl_s=ttl_s, keepalive=keepalive)
+
+
+def wait_until(pred, timeout: float = 3.0, interval: float = 0.02) -> bool:
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False

@@ -1,0 +1,300 @@
+"""InstanceMgr tests: registration/linking, failure state machine,
+incarnation replacement, RR selection, SLO selection + PD flips.
+
+Covers the reference scenarios of SURVEY.md §3.4 hermetically (the
+reference's own rpc_service_test.cpp left these as commented-out TODOs).
+"""
+
+import time
+
+import pytest
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.request import Request
+from xllm_service_tpu.common.types import (
+    InstanceRuntimeState,
+    InstanceType,
+    LoadMetrics,
+    RequestAction,
+)
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.scheduler.instance_mgr import InstanceMgr
+
+from fakes import FakeChannel, make_meta, register_in_coord, wait_until
+
+
+@pytest.fixture(autouse=True)
+def _reset_channels():
+    FakeChannel.reset()
+    yield
+    FakeChannel.reset()
+
+
+@pytest.fixture()
+def coord(store):
+    c = InMemoryCoordination(store)
+    yield c
+    c.close()
+
+
+def fast_opts(**kw) -> ServiceOptions:
+    return ServiceOptions(
+        health_probe_attempts=1, health_probe_timeout_s=0.05,
+        heartbeat_silence_to_suspect_s=0.2,
+        detect_disconnected_instance_interval_s=0.3,
+        reconcile_interval_s=0.05, lease_ttl_s=0.2, **kw)
+
+
+def make_mgr(coord, **kw) -> InstanceMgr:
+    return InstanceMgr(coord, fast_opts(), channel_factory=FakeChannel.factory,
+                       start_threads=kw.pop("start_threads", False), **kw)
+
+
+class TestRegistration:
+    def test_watch_registration_and_pd_linking(self, coord):
+        mgr = make_mgr(coord)
+        register_in_coord(coord, make_meta("p1", InstanceType.PREFILL))
+        assert wait_until(lambda: mgr.get_instance_meta("p1") is not None)
+        register_in_coord(coord, make_meta("d1", InstanceType.DECODE))
+        assert wait_until(lambda: mgr.get_instance_meta("d1") is not None)
+        # New decode was linked to existing prefill, both directions.
+        assert "d1" in FakeChannel.registry["p1"].links
+        assert "p1" in FakeChannel.registry["d1"].links
+        mgr.stop()
+
+    def test_link_failure_rolls_back(self, coord):
+        mgr = make_mgr(coord)
+        register_in_coord(coord, make_meta("p1", InstanceType.PREFILL))
+        assert wait_until(lambda: mgr.get_instance_meta("p1") is not None)
+        FakeChannel.registry["p1"].link_ok = False  # peer refuses the link
+        assert not mgr.register_instance(make_meta("d1", InstanceType.DECODE))
+        assert mgr.get_instance_meta("d1") is None
+        mgr.stop()
+
+    def test_incarnation_replacement(self, coord):
+        mgr = make_mgr(coord)
+        m1 = make_meta("i1", InstanceType.MIX, incarnation_id="inc-old")
+        register_in_coord(coord, m1)
+        assert wait_until(lambda: mgr.get_instance_meta("i1") is not None)
+        m2 = make_meta("i1", InstanceType.MIX, incarnation_id="inc-new")
+        register_in_coord(coord, m2)
+        assert wait_until(
+            lambda: (mgr.get_instance_meta("i1") or m1).incarnation_id == "inc-new")
+        mgr.stop()
+
+    def test_same_incarnation_refreshes_to_active(self, coord):
+        mgr = make_mgr(coord)
+        m = make_meta("i1", InstanceType.MIX, incarnation_id="inc-1")
+        register_in_coord(coord, m, ttl_s=0.25, keepalive=False)
+        assert wait_until(lambda: mgr.get_instance_meta("i1") is not None)
+        # Lease lapses; healthy probe => LEASE_LOST grace.
+        assert wait_until(lambda: mgr.get_instance_state("i1")
+                          == InstanceRuntimeState.LEASE_LOST)
+        register_in_coord(coord, m)  # re-registration, same incarnation
+        assert wait_until(lambda: mgr.get_instance_state("i1")
+                          == InstanceRuntimeState.ACTIVE)
+        mgr.stop()
+
+
+class TestFailureDetection:
+    def test_lease_lost_grace_when_probe_ok(self, coord):
+        mgr = make_mgr(coord)
+        register_in_coord(coord, make_meta("i1"), ttl_s=0.25, keepalive=False)
+        assert wait_until(lambda: mgr.get_instance_meta("i1") is not None)
+        assert wait_until(lambda: mgr.get_instance_state("i1")
+                          == InstanceRuntimeState.LEASE_LOST)
+        # LEASE_LOST instances remain schedulable.
+        assert mgr.get_next_instance_pair().prefill_name == "i1"
+        mgr.stop()
+
+    def test_suspect_when_probe_fails(self, coord):
+        mgr = make_mgr(coord)
+        register_in_coord(coord, make_meta("i1"), ttl_s=0.25, keepalive=False)
+        assert wait_until(lambda: mgr.get_instance_meta("i1") is not None)
+        FakeChannel.registry["i1"].healthy = False
+        assert wait_until(lambda: mgr.get_instance_state("i1")
+                          == InstanceRuntimeState.SUSPECT)
+        assert mgr.get_next_instance_pair().prefill_name == ""
+        mgr.stop()
+
+    def test_heartbeat_silence_promotes_to_suspect_then_evicts(self, coord):
+        failures = []
+        mgr = make_mgr(coord)
+        mgr.on_instance_failure = lambda n, inc, t: failures.append((n, inc))
+        register_in_coord(coord, make_meta("i1", incarnation_id="X"),
+                          ttl_s=0.25, keepalive=False)
+        assert wait_until(lambda: mgr.get_instance_meta("i1") is not None)
+        assert wait_until(lambda: mgr.get_instance_state("i1")
+                          == InstanceRuntimeState.LEASE_LOST)
+        # No heartbeats: reconcile promotes to SUSPECT then evicts.
+        deadline = time.time() + 3
+        while time.time() < deadline and mgr.get_instance_meta("i1") is not None:
+            mgr.reconcile_once()
+            time.sleep(0.05)
+        assert mgr.get_instance_meta("i1") is None
+        assert failures == [("i1", "X")]
+        mgr.stop()
+
+    def test_heartbeat_recovers_suspect(self, coord):
+        mgr = make_mgr(coord)
+        register_in_coord(coord, make_meta("i1", incarnation_id="X"),
+                          ttl_s=0.25, keepalive=False)
+        assert wait_until(lambda: mgr.get_instance_meta("i1") is not None)
+        FakeChannel.registry["i1"].healthy = False
+        assert wait_until(lambda: mgr.get_instance_state("i1")
+                          == InstanceRuntimeState.SUSPECT)
+        assert mgr.record_instance_heartbeat("i1", "X", LoadMetrics())
+        assert mgr.get_instance_state("i1") == InstanceRuntimeState.LEASE_LOST
+        mgr.stop()
+
+    def test_stale_incarnation_heartbeat_rejected(self, coord):
+        mgr = make_mgr(coord)
+        register_in_coord(coord, make_meta("i1", incarnation_id="new"))
+        assert wait_until(lambda: mgr.get_instance_meta("i1") is not None)
+        assert not mgr.record_instance_heartbeat("i1", "old")
+        assert mgr.record_instance_heartbeat("i1", "new")
+        mgr.stop()
+
+
+class TestSelection:
+    def test_round_robin_pairs(self, coord):
+        mgr = make_mgr(coord)
+        for n in ("p1", "p2"):
+            mgr.register_instance(make_meta(n, InstanceType.PREFILL),
+                                  link_peers=False)
+        for n in ("d1", "d2"):
+            mgr.register_instance(make_meta(n, InstanceType.DECODE),
+                                  link_peers=False)
+        pairs = {(mgr.get_next_instance_pair().prefill_name,
+                  mgr.get_next_instance_pair().decode_name)
+                 for _ in range(4)}
+        prefills = {mgr.get_next_instance_pair().prefill_name for _ in range(4)}
+        assert prefills == {"p1", "p2"}
+        mgr.stop()
+
+    def test_default_only_fleet(self, coord):
+        mgr = make_mgr(coord)
+        mgr.register_instance(make_meta("m1", InstanceType.DEFAULT),
+                              link_peers=False)
+        r = mgr.get_next_instance_pair()
+        assert r.prefill_name == "m1" and r.decode_name == ""
+        assert mgr.has_available_instances()
+        mgr.stop()
+
+    def test_mix_instance_serves_both_roles(self, coord):
+        mgr = make_mgr(coord)
+        mgr.register_instance(make_meta("mix1", InstanceType.MIX),
+                              link_peers=False)
+        r = mgr.get_next_instance_pair()
+        assert r.prefill_name == "mix1" and r.decode_name == ""
+        mgr.stop()
+
+
+class TestSlo:
+    def _mgr_with_profiles(self, coord):
+        mgr = make_mgr(coord)
+        ttft = [[128, 20.0], [512, 60.0], [2048, 200.0], [4096, 420.0]]
+        # p1 fast decode, p2 slower.
+        tpot_fast = [[1, 100, 5.0], [4, 1000, 10.0], [16, 8000, 30.0]]
+        tpot_slow = [[1, 100, 40.0], [4, 1000, 80.0], [16, 8000, 200.0]]
+        mgr.register_instance(make_meta(
+            "p1", InstanceType.PREFILL, ttft_profiling_data=ttft),
+            link_peers=False)
+        mgr.register_instance(make_meta(
+            "d1", InstanceType.DECODE, tpot_profiling_data=tpot_fast),
+            link_peers=False)
+        mgr.register_instance(make_meta(
+            "d2", InstanceType.DECODE, tpot_profiling_data=tpot_slow),
+            link_peers=False)
+        return mgr
+
+    def test_slo_picks_decode_meeting_tpot(self, coord):
+        mgr = self._mgr_with_profiles(coord)
+        req = Request(service_request_id="s1", token_ids=list(range(256)))
+        r = mgr.select_instance_pair_on_slo(req)
+        assert r.prefill_name == "p1"
+        assert r.decode_name == "d1"  # first decode meeting 50ms TPOT target
+        assert req.metrics.estimated_ttft_ms > 0
+        mgr.stop()
+
+    def test_overloaded_decode_flips_idle_prefill(self, coord):
+        mgr = make_mgr(coord)
+        tpot_awful = [[1, 100, 500.0], [4, 1000, 900.0], [16, 8000, 2000.0]]
+        mgr.register_instance(make_meta("p1", InstanceType.PREFILL),
+                              link_peers=False)
+        mgr.register_instance(make_meta("p2", InstanceType.PREFILL),
+                              link_peers=False)
+        mgr.register_instance(make_meta(
+            "d1", InstanceType.DECODE, tpot_profiling_data=tpot_awful),
+            link_peers=False)
+        req = Request(service_request_id="s1", token_ids=list(range(128)))
+        r = mgr.select_instance_pair_on_slo(req)
+        # One of the idle prefills should have been flipped to decode duty.
+        flipped = [n for n, ch in FakeChannel.registry.items()
+                   if "DECODE" in ch.flips]
+        assert flipped and r.decode_name in flipped
+        assert mgr.get_instance_meta(flipped[0]).type == InstanceType.DECODE
+        mgr.stop()
+
+    def test_request_metrics_accounting(self, coord):
+        mgr = make_mgr(coord)
+        mgr.register_instance(make_meta("p1", InstanceType.PREFILL),
+                              link_peers=False)
+        mgr.register_instance(make_meta("d1", InstanceType.DECODE),
+                              link_peers=False)
+        req = Request(service_request_id="s1", token_ids=list(range(64)))
+        req.routing.prefill_name = "p1"
+        req.routing.decode_name = "d1"
+        mgr.update_request_metrics(req, RequestAction.SCHEDULE)
+        assert mgr._request_loads["p1"].num_prefill_requests == 1
+        mgr.update_request_metrics(req, RequestAction.FINISH_PREFILL)
+        assert mgr._request_loads["p1"].num_prefill_requests == 0
+        assert mgr._request_loads["d1"].num_decode_requests == 1
+        req.num_generated_tokens = 10
+        mgr.update_request_metrics(req, RequestAction.FINISH_DECODE)
+        assert mgr._request_loads["d1"].num_decode_requests == 0
+        mgr.stop()
+
+
+class TestRoleFlip:
+    def test_flip_updates_coordination(self, coord):
+        mgr = make_mgr(coord)
+        mgr.register_instance(make_meta("i1", InstanceType.PREFILL),
+                              link_peers=False)
+        # Seed the coordination record as the engine would have.
+        register_in_coord(coord, mgr.get_instance_meta("i1"))
+        assert mgr.flip_instance_role("i1", InstanceType.DECODE)
+        assert mgr.get_instance_meta("i1").type == InstanceType.DECODE
+        from xllm_service_tpu.rpc import instance_key
+        assert coord.get(instance_key("DECODE", "i1")) is not None
+        assert coord.get(instance_key("PREFILL", "i1")) is None
+        assert FakeChannel.registry["i1"].flips == ["DECODE"]
+        mgr.stop()
+
+    def test_flip_rejected_by_engine(self, coord):
+        mgr = make_mgr(coord)
+        mgr.register_instance(make_meta("i1", InstanceType.PREFILL),
+                              link_peers=False)
+        FakeChannel.registry["i1"].flip_ok = False
+        assert not mgr.flip_instance_role("i1", InstanceType.DECODE)
+        assert mgr.get_instance_meta("i1").type == InstanceType.PREFILL
+        mgr.stop()
+
+
+class TestLoadMetricsSync:
+    def test_master_upload_and_replica_mirror(self, coord, store):
+        master = make_mgr(coord)
+        register_in_coord(coord, make_meta("i1"))
+        assert wait_until(lambda: master.get_instance_meta("i1") is not None)
+        master.record_instance_heartbeat(
+            "i1", "", LoadMetrics(waiting_requests_num=7))
+        master.upload_load_metrics()
+
+        replica_coord = InMemoryCoordination(store)
+        replica = InstanceMgr(replica_coord, fast_opts(), is_master=False,
+                              channel_factory=FakeChannel.factory,
+                              start_threads=False)
+        assert wait_until(
+            lambda: replica.get_load_infos().get("i1") is not None
+            and replica.get_load_infos()["i1"].load.waiting_requests_num == 7)
+        master.stop(); replica.stop(); replica_coord.close()

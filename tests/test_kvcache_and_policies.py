@@ -1,0 +1,154 @@
+"""GlobalKVCacheMgr + LB policy tests."""
+
+import pytest
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.hashing import prefix_block_hash_hexes
+from xllm_service_tpu.common.request import Request
+from xllm_service_tpu.common.types import InstanceType, KvCacheEvent, LoadMetrics
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.scheduler.global_kvcache_mgr import GlobalKVCacheMgr
+from xllm_service_tpu.scheduler.instance_mgr import InstanceMgr
+from xllm_service_tpu.scheduler.policies import create_policy
+
+from fakes import FakeChannel, make_meta, wait_until
+
+BLOCK = 16  # small block size for tests
+
+
+@pytest.fixture()
+def coord(store):
+    c = InMemoryCoordination(store)
+    yield c
+    c.close()
+
+
+@pytest.fixture(autouse=True)
+def _reset_channels():
+    FakeChannel.reset()
+    yield
+    FakeChannel.reset()
+
+
+def _opts(**kw):
+    return ServiceOptions(block_size=BLOCK, reconcile_interval_s=0.05, **kw)
+
+
+class TestGlobalKVCache:
+    def test_match_walks_until_first_miss(self, coord):
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        toks = list(range(BLOCK * 4))
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        # i1 holds blocks 0,1; i2 holds block 0 only. Block 2 missing.
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(stored=hashes[:2]))
+        mgr.record_updated_kvcaches("i2", KvCacheEvent(stored=hashes[:1]))
+        ov = mgr.match(toks)
+        assert ov.max_block_num == 4
+        assert ov.scores["i1"] == pytest.approx(2.0)
+        assert ov.scores["i2"] == pytest.approx(1.0)
+        # Block 3 stored but 2 missing: walk stops at 2, so 3 never counts.
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(stored=[hashes[3]]))
+        assert mgr.match(toks).scores["i1"] == pytest.approx(2.0)
+
+    def test_offload_demotion_chain(self, coord):
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        h = prefix_block_hash_hexes(list(range(BLOCK)), BLOCK)
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(stored=h))
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(offloaded=h))  # HBM->DRAM
+        ov = mgr.match(list(range(BLOCK)))
+        assert ov.scores["i1"] == pytest.approx(0.6)   # DRAM weight
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(offloaded=h))  # DRAM->SSD
+        assert mgr.match(list(range(BLOCK))).scores["i1"] == pytest.approx(0.3)
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(removed=h))
+        assert mgr.match(list(range(BLOCK))).scores == {}
+
+    def test_master_upload_replica_mirror(self, coord, store):
+        master = GlobalKVCacheMgr(coord, block_size=BLOCK, is_master=True)
+        toks = list(range(BLOCK * 2))
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        master.record_updated_kvcaches("i1", KvCacheEvent(stored=hashes))
+        master.upload_kvcache()
+        rc = InMemoryCoordination(store)
+        replica = GlobalKVCacheMgr(rc, block_size=BLOCK, is_master=False)
+        assert replica.match(toks).scores.get("i1") == pytest.approx(2.0)
+        # Delta replication: removal propagates.
+        master.record_updated_kvcaches("i1", KvCacheEvent(removed=hashes))
+        master.upload_kvcache()
+        assert wait_until(lambda: replica.match(toks).scores == {})
+        master.stop(); replica.stop(); rc.close()
+
+    def test_remove_instance(self, coord):
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        h = prefix_block_hash_hexes(list(range(BLOCK)), BLOCK)
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(stored=h))
+        mgr.record_updated_kvcaches("i2", KvCacheEvent(stored=h))
+        mgr.remove_instance("i1")
+        assert set(mgr.match(list(range(BLOCK))).scores) == {"i2"}
+
+
+class TestPolicies:
+    def _fleet(self, coord):
+        mgr = InstanceMgr(coord, _opts(), channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        for n in ("p1", "p2"):
+            mgr.register_instance(make_meta(n, InstanceType.PREFILL),
+                                  link_peers=False)
+        for n in ("d1", "d2"):
+            mgr.register_instance(make_meta(n, InstanceType.DECODE),
+                                  link_peers=False)
+        return mgr
+
+    def test_rr_policy(self, coord):
+        mgr = self._fleet(coord)
+        policy = create_policy("RR", mgr, None, _opts())
+        seen = {policy.select_instances_pair(Request()).prefill_name
+                for _ in range(4)}
+        assert seen == {"p1", "p2"}
+        mgr.stop()
+
+    def test_car_prefers_cache_hits(self, coord):
+        mgr = self._fleet(coord)
+        kv = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        opts = _opts()
+        policy = create_policy("CAR", mgr, kv, opts)
+        toks = list(range(BLOCK * 3))
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        kv.record_updated_kvcaches("p2", KvCacheEvent(stored=hashes))
+        kv.record_updated_kvcaches("d1", KvCacheEvent(stored=hashes[:1]))
+        r = policy.select_instances_pair(Request(token_ids=toks))
+        assert r.prefill_name == "p2"
+        assert r.decode_name == "d1"
+        mgr.stop()
+
+    def test_car_penalizes_load(self, coord):
+        mgr = self._fleet(coord)
+        kv = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        opts = _opts(max_waiting_requests=10)
+        policy = create_policy("CAR", mgr, kv, opts)
+        toks = list(range(BLOCK * 2))
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        # p1 has all blocks cached but is heavily loaded.
+        kv.record_updated_kvcaches("p1", KvCacheEvent(stored=hashes))
+        mgr.record_instance_heartbeat("p1", "", LoadMetrics(
+            waiting_requests_num=10, hbm_cache_usage_perc=0.99))
+        r = policy.select_instances_pair(Request(token_ids=toks))
+        assert r.prefill_name == "p2"   # cache hit outweighed by load
+        mgr.stop()
+
+    def test_car_untokenized_falls_back_rr(self, coord):
+        mgr = self._fleet(coord)
+        kv = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        policy = create_policy("CAR", mgr, kv, _opts())
+        r = policy.select_instances_pair(Request())
+        assert r.prefill_name in ("p1", "p2")
+        mgr.stop()
+
+    def test_slo_policy_untokenized_falls_back(self, coord):
+        mgr = self._fleet(coord)
+        policy = create_policy("SLO_AWARE", mgr, None, _opts())
+        assert policy.select_instances_pair(Request()).prefill_name in ("p1", "p2")
+        mgr.stop()
+
+    def test_unknown_policy_raises(self, coord):
+        with pytest.raises(ValueError):
+            create_policy("NOPE", None, None, _opts())

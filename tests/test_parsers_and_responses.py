@@ -1,0 +1,281 @@
+"""Output-parser + ResponseHandler + chat-template + tokenizer tests."""
+
+import base64
+import json
+
+import pytest
+
+from xllm_service_tpu.chat_template import JinjaChatTemplate
+from xllm_service_tpu.common.call_data import CollectingConnection
+from xllm_service_tpu.common.request import (
+    Request,
+    RequestOutput,
+    SamplingParams,
+    SequenceOutput,
+    Usage,
+)
+from xllm_service_tpu.scheduler.output_parsers import (
+    FamilyTags,
+    StreamChatParser,
+    parse_chat_output,
+    resolve_family_tags,
+)
+from xllm_service_tpu.scheduler.response_handler import ResponseHandler
+from xllm_service_tpu.tokenizer import SimpleTokenizer, TokenizerFactory
+from xllm_service_tpu.tokenizer.tiktoken import TiktokenTokenizer
+
+
+class TestFullParse:
+    TAGS = FamilyTags()
+
+    def test_plain_text(self):
+        p = parse_chat_output("hello world", "stop", self.TAGS)
+        assert p.content == "hello world"
+        assert p.reasoning_content == ""
+        assert p.tool_calls == []
+        assert p.finish_reason == "stop"
+
+    def test_reasoning_split(self):
+        p = parse_chat_output("<think>step by step</think>the answer is 4",
+                              "stop", self.TAGS)
+        assert p.reasoning_content == "step by step"
+        assert p.content == "the answer is 4"
+
+    def test_tool_call_and_finish_rewrite(self):
+        text = ('I will check the weather.\n<tool_call>\n'
+                '{"name": "get_weather", "arguments": {"city": "Paris"}}\n'
+                '</tool_call>')
+        p = parse_chat_output(text, "stop", self.TAGS)
+        assert len(p.tool_calls) == 1
+        assert p.tool_calls[0].name == "get_weather"
+        assert json.loads(p.tool_calls[0].arguments) == {"city": "Paris"}
+        assert p.finish_reason == "tool_calls"   # stop -> tool_calls rewrite
+        assert "tool_call" not in p.content
+
+    def test_implicit_reasoning_family(self):
+        tags = resolve_family_tags("deepseek-r1-distill")
+        p = parse_chat_output("chain of thought</think>final", "stop", tags)
+        assert p.reasoning_content == "chain of thought"
+        assert p.content == "final"
+
+    def test_family_resolution(self):
+        assert resolve_family_tags("Qwen3-32B") == FamilyTags()
+        assert resolve_family_tags("deepseek-v3").tool_open == "<|tool▁call▁begin|>"
+        assert resolve_family_tags("unknown-model") == FamilyTags()
+        # Explicit parser name overrides model id.
+        assert resolve_family_tags("foo", tool_call_parser="kimi").tool_open \
+            == "<|tool_call_begin|>"
+
+
+class TestStreamParse:
+    def _collect(self, chunks, tags=FamilyTags()):
+        parser = StreamChatParser(tags)
+        events = []
+        for c in chunks:
+            events.extend(parser.feed(c))
+        events.extend(parser.finalize())
+        return events, parser
+
+    def test_content_only(self):
+        events, _ = self._collect(["hel", "lo"])
+        assert "".join(e.text for e in events if e.kind == "content") == "hello"
+
+    def test_reasoning_tag_split_across_chunks(self):
+        events, _ = self._collect(["<th", "ink>rea", "soning</th", "ink>ans"])
+        reasoning = "".join(e.text for e in events if e.kind == "reasoning")
+        content = "".join(e.text for e in events if e.kind == "content")
+        assert reasoning == "reasoning"
+        assert content == "ans"
+
+    def test_tool_call_streamed(self):
+        payload = '{"name": "f", "arguments": {"x": 1}}'
+        events, parser = self._collect(
+            ["before <tool_call>", payload[:10], payload[10:], "</tool_call> after"])
+        tool_events = [e for e in events if e.kind == "tool_call"]
+        assert len(tool_events) == 1
+        assert tool_events[0].tool_name == "f"
+        assert json.loads(tool_events[0].tool_args_delta) == {"x": 1}
+        assert parser.saw_tool_call
+        content = "".join(e.text for e in events if e.kind == "content")
+        assert "before" in content and "after" in content
+
+    def test_unterminated_tool_block_flushes_as_content(self):
+        events, parser = self._collect(["<tool_call>oops no json"])
+        assert not parser.saw_tool_call
+        content = "".join(e.text for e in events if e.kind == "content")
+        assert "oops no json" in content
+
+
+def _chat_request(stream=True, **kw):
+    return Request(service_request_id="s1", request_id="chatcmpl-1",
+                   model="m", stream=stream, **kw)
+
+
+class TestResponseHandler:
+    def test_streaming_chat_chunks(self):
+        rh = ResponseHandler("qwen3")
+        req = _chat_request(include_usage=True)
+        state = rh.create_chat_stream_state(req)
+        conn = CollectingConnection(stream=True)
+        out1 = RequestOutput(service_request_id="s1", outputs=[
+            SequenceOutput(index=0, text="<think>hm</think>he", token_ids=[1])])
+        assert rh.send_chat_delta(conn, state, req, out1)
+        out2 = RequestOutput(service_request_id="s1", outputs=[
+            SequenceOutput(index=0, text="llo", token_ids=[2],
+                           finish_reason="stop")],
+            usage=Usage(5, 2), finished=True)
+        assert rh.send_chat_delta(conn, state, req, out2)
+        assert conn.finished
+        deltas = [c["choices"][0]["delta"] for c in conn.payloads if c["choices"]]
+        assert deltas[0] == {"role": "assistant", "content": ""}
+        reasoning = "".join(d.get("reasoning_content", "") for d in deltas)
+        content = "".join(d.get("content", "") or "" for d in deltas)
+        assert reasoning == "hm"
+        assert content == "hello"
+        finish = [c["choices"][0]["finish_reason"]
+                  for c in conn.payloads if c["choices"]]
+        assert "stop" in finish
+        usage_chunks = [c for c in conn.payloads if c.get("usage")]
+        assert usage_chunks and usage_chunks[-1]["usage"]["total_tokens"] == 7
+
+    def test_streaming_tool_call_finish_rewrite(self):
+        rh = ResponseHandler("qwen3")
+        req = _chat_request()
+        state = rh.create_chat_stream_state(req)
+        conn = CollectingConnection(stream=True)
+        out = RequestOutput(service_request_id="s1", outputs=[
+            SequenceOutput(index=0,
+                           text='<tool_call>{"name":"f","arguments":{}}</tool_call>',
+                           finish_reason="stop")], finished=True)
+        rh.send_chat_delta(conn, state, req, out)
+        finish = [c["choices"][0]["finish_reason"]
+                  for c in conn.payloads if c["choices"]]
+        assert "tool_calls" in finish
+        tool_deltas = [c["choices"][0]["delta"].get("tool_calls")
+                       for c in conn.payloads
+                       if c["choices"] and c["choices"][0]["delta"].get("tool_calls")]
+        assert tool_deltas[0][0]["function"]["name"] == "f"
+
+    def test_non_stream_chat_result(self):
+        rh = ResponseHandler("qwen3")
+        req = _chat_request(stream=False)
+        conn = CollectingConnection()
+        out = RequestOutput(service_request_id="s1", outputs=[
+            SequenceOutput(index=0, text="<think>x</think>hi",
+                           finish_reason="stop")],
+            usage=Usage(3, 1), finished=True)
+        assert rh.send_chat_result(conn, req, out)
+        body = conn.payloads[0]
+        msg = body["choices"][0]["message"]
+        assert msg["content"] == "hi"
+        assert msg["reasoning_content"] == "x"
+        assert body["usage"]["prompt_tokens"] == 3
+
+    def test_completion_stream_and_result(self):
+        rh = ResponseHandler("")
+        req = Request(service_request_id="s1", request_id="cmpl-1", model="m",
+                      stream=True, include_usage=True)
+        conn = CollectingConnection(stream=True)
+        rh.send_completion_delta(conn, req, RequestOutput(
+            outputs=[SequenceOutput(index=0, text="abc")]))
+        rh.send_completion_delta(conn, req, RequestOutput(
+            outputs=[SequenceOutput(index=0, text="def", finish_reason="length")],
+            usage=Usage(2, 4), finished=True))
+        assert conn.finished
+        texts = "".join(c["choices"][0]["text"]
+                        for c in conn.payloads if c["choices"])
+        assert texts == "abcdef"
+        conn2 = CollectingConnection()
+        rh.send_completion_result(conn2, Request(stream=False, model="m",
+                                                 request_id="cmpl-2"),
+                                  RequestOutput(outputs=[
+                                      SequenceOutput(index=0, text="xyz",
+                                                     finish_reason="stop")],
+                                      usage=Usage(1, 1), finished=True))
+        assert conn2.payloads[0]["choices"][0]["text"] == "xyz"
+
+    def test_logprobs_rendering(self):
+        from xllm_service_tpu.common.request import LogProb, LogProbData
+
+        rh = ResponseHandler("")
+        req = _chat_request(stream=False,
+                            sampling=SamplingParams(logprobs=True))
+        conn = CollectingConnection()
+        out = RequestOutput(outputs=[SequenceOutput(
+            index=0, text="hi", finish_reason="stop",
+            logprobs=[LogProb(token="hi", token_id=5, logprob=-0.1,
+                              top_logprobs=[LogProbData("hi", 5, -0.1)])])],
+            finished=True)
+        rh.send_chat_result(conn, req, out)
+        lp = conn.payloads[0]["choices"][0]["logprobs"]
+        assert lp["content"][0]["token"] == "hi"
+        assert lp["content"][0]["top_logprobs"][0]["logprob"] == -0.1
+
+
+class TestChatTemplate:
+    def test_default_template(self):
+        t = JinjaChatTemplate()
+        out = t.apply([{"role": "user", "content": "hi"}])
+        assert "<|im_start|>user\nhi<|im_end|>" in out
+        assert out.endswith("<|im_start|>assistant\n")
+
+    def test_tools_and_kwargs(self):
+        tmpl = ("{% if tools %}TOOLS:{{ tools | length }}\n{% endif %}"
+                "{% if enable_thinking %}THINK\n{% endif %}"
+                "{% for m in messages %}{{ m.content }}{% endfor %}")
+        t = JinjaChatTemplate(tmpl)
+        out = t.apply([{"role": "user", "content": "q"}],
+                      tools=[{"type": "function", "function": {"name": "f"}}],
+                      chat_template_kwargs={"enable_thinking": True})
+        assert out == "TOOLS:1\nTHINK\nq"
+
+    def test_multimodal_placeholder(self):
+        t = JinjaChatTemplate("{{ messages[0].content }}")
+        out = t.apply([{"role": "user", "content": [
+            {"type": "text", "text": "look: "},
+            {"type": "image_url", "image_url": {"url": "http://x/im.png"}}]}])
+        assert out == "look: <|multimodal_placeholder|>"
+
+
+class TestTokenizers:
+    def test_simple_roundtrip(self):
+        tok = SimpleTokenizer()
+        ids = tok.encode("héllo!")
+        assert tok.decode(ids) == "héllo!"
+
+    def test_factory_fallback(self):
+        assert isinstance(TokenizerFactory.create_tokenizer(""), SimpleTokenizer)
+
+    def test_tiktoken_bpe(self, tmp_path):
+        # Tiny vocab: bytes a,b,c + merges "ab", "abc".
+        vocab = {b"a": 0, b"b": 1, b"c": 2, b"ab": 3, b"abc": 4}
+        lines = "\n".join(
+            f"{base64.b64encode(k).decode()} {v}" for k, v in vocab.items())
+        f = tmp_path / "vocab.tiktoken"
+        f.write_text(lines)
+        tok = TiktokenTokenizer(f, special_tokens={"<|eot|>": 100})
+        assert tok.encode("abc") == [4]
+        assert tok.encode("abab") == [3, 3]
+        assert tok.encode("cab") == [2, 3]
+        assert tok.encode("ab<|eot|>c") == [3, 100, 2]
+        assert tok.decode([4, 100], skip_special_tokens=False) == "abc<|eot|>"
+        assert tok.decode([4, 100]) == "abc"
+
+    def test_factory_detects_tiktoken_dir(self, tmp_path):
+        (tmp_path / "m.tiktoken").write_text(
+            base64.b64encode(b"a").decode() + " 0")
+        tok = TokenizerFactory.create_tokenizer(str(tmp_path))
+        assert isinstance(tok, TiktokenTokenizer)
+
+    def test_hf_tokenizer(self, tmp_path):
+        # Build a minimal HF tokenizer.json (WordLevel) hermetically.
+        from tokenizers import Tokenizer as HFTok
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        t = HFTok(WordLevel({"hello": 0, "world": 1, "[UNK]": 2}, unk_token="[UNK]"))
+        t.pre_tokenizer = Whitespace()
+        t.save(str(tmp_path / "tokenizer.json"))
+        tok = TokenizerFactory.create_tokenizer(str(tmp_path))
+        assert tok.encode("hello world") == [0, 1]
+        assert tok.vocab_size() == 3

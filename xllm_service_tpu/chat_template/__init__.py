@@ -1,0 +1,13 @@
+"""Jinja chat templating.
+
+Parity: reference `chat_template/jinja_chat_template.{h,cpp}` (minja-based;
+SURVEY.md §2.8): renders the model's `chat_template` with messages, a tools
+array, and extra `chat_template_kwargs` context, with
+`add_generation_prompt=true` (`jinja_chat_template.cpp:26-37,105-117`).
+Multimodal content parts are flattened to text + placeholders
+(`jinja_chat_template.cpp:119-137`).
+"""
+
+from .jinja_chat_template import JinjaChatTemplate, DEFAULT_CHAT_TEMPLATE
+
+__all__ = ["JinjaChatTemplate", "DEFAULT_CHAT_TEMPLATE"]

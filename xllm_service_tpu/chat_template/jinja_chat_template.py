@@ -1,0 +1,82 @@
+"""Jinja2 chat-template renderer (reference `jinja_chat_template.cpp`)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jinja2
+
+# Generic ChatML-style fallback for models shipping no template.
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ '<|im_start|>' + message['role'] + '\n' + message['content'] + '<|im_end|>' + '\n' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|im_start|>assistant\n' }}{% endif %}"
+)
+
+# Reference placeholder for non-text content items
+# (`jinja_chat_template.cpp:119-137` inserts "mm place holder").
+MM_PLACEHOLDER = "<|multimodal_placeholder|>"
+
+
+def _flatten_content(content: Any) -> str:
+    """OpenAI content can be a string or a list of typed parts; flatten
+    non-text parts to placeholders."""
+    if content is None:
+        return ""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        parts = []
+        for item in content:
+            if isinstance(item, dict):
+                if item.get("type") == "text":
+                    parts.append(item.get("text", ""))
+                else:
+                    parts.append(MM_PLACEHOLDER)
+            else:
+                parts.append(str(item))
+        return "".join(parts)
+    return str(content)
+
+
+class JinjaChatTemplate:
+    def __init__(self, template: Optional[str] = None,
+                 bos_token: str = "", eos_token: str = ""):
+        self._env = jinja2.Environment(
+            loader=jinja2.BaseLoader(),
+            trim_blocks=True, lstrip_blocks=True,
+            extensions=["jinja2.ext.loopcontrols"],
+        )
+        # Helpers HF templates commonly use.
+        self._env.filters["tojson"] = lambda v, **kw: __import__("json").dumps(v, **kw)
+        self._env.globals["raise_exception"] = _raise_exception
+        self._template = self._env.from_string(template or DEFAULT_CHAT_TEMPLATE)
+        self._bos = bos_token
+        self._eos = eos_token
+
+    def apply(self, messages: list[dict[str, Any]],
+              tools: Optional[list[dict[str, Any]]] = None,
+              chat_template_kwargs: Optional[dict[str, Any]] = None,
+              add_generation_prompt: bool = True) -> str:
+        """Render the prompt (reference `jinja_chat_template.cpp:105-117`:
+        messages + tools + extra kwargs, add_generation_prompt=true)."""
+        norm_messages = [
+            {**m, "content": _flatten_content(m.get("content"))}
+            for m in messages
+        ]
+        ctx: dict[str, Any] = {
+            "messages": norm_messages,
+            "add_generation_prompt": add_generation_prompt,
+            "bos_token": self._bos,
+            "eos_token": self._eos,
+        }
+        if tools:
+            ctx["tools"] = tools
+        if chat_template_kwargs:
+            ctx.update(chat_template_kwargs)
+        return self._template.render(**ctx)
+
+
+def _raise_exception(msg: str):
+    raise jinja2.TemplateError(msg)

@@ -1,0 +1,33 @@
+"""L2 RPC plane: service↔engine wire contract and channels.
+
+Parity: reference `rpc_service/` + `proto/` (SURVEY.md §2.3). Both sides of
+the contract are ours to define (the reference's engine submodule is empty,
+SURVEY.md §0); we use HTTP+JSON framing over aiohttp/requests rather than
+brpc+protobuf — the *behavioral* contract (fire-and-forget enriched request
+forwarding, batched Generations streaming back, heartbeats with KV-cache
+events and load metrics, Link/Unlink peer introduction) is preserved.
+"""
+
+from .channel import EngineChannel
+
+__all__ = ["EngineChannel"]
+
+# Coordination key layout (reference key scheme `XLLM:<TYPE>:<name>`,
+# `instance_mgr.cpp:45-50`; `XLLM:SERVICE:`, `XLLM:CACHE:`,
+# `XLLM:LOADMETRICS:` per SURVEY.md §3.4-3.5).
+INSTANCE_KEY_PREFIX = "XLLM:INSTANCE:"       # + "<TYPE>:<name>"
+SERVICE_KEY_PREFIX = "XLLM:SERVICE:"         # + "<ip:rpc_port>"
+MASTER_KEY = "XLLM:SERVICE:MASTER"
+CACHE_KEY_PREFIX = "XLLM:CACHE:"             # + block-hash hex
+LOADMETRICS_KEY_PREFIX = "XLLM:LOADMETRICS:"  # + instance name
+
+
+def instance_key(type_str: str, name: str) -> str:
+    return f"{INSTANCE_KEY_PREFIX}{type_str}:{name}"
+
+
+def parse_instance_key(key: str) -> tuple[str, str]:
+    """-> (type, name)"""
+    rest = key[len(INSTANCE_KEY_PREFIX):]
+    type_str, _, name = rest.partition(":")
+    return type_str, name

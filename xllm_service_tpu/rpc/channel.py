@@ -1,0 +1,113 @@
+"""Synchronous control-plane channel to one engine instance.
+
+Parity: the reference caches one brpc channel per instance with 3 retries and
+configurable timeouts (`instance_mgr.cpp:480-498`) and calls the engine's
+`XllmAPIService` (Completions/ChatCompletions/Models) and `DisaggPDService`
+(LinkInstance/UnlinkInstance) stubs. Here the engine speaks HTTP+JSON; the
+channel wraps `requests` with retries. Used from manager threads; the
+asyncio HTTP frontend uses its own aiohttp session for hot-path forwarding.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import requests
+
+from ..common.types import InstanceMetaInfo
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_TIMEOUT_S = 5.0
+DEFAULT_RETRIES = 3
+
+
+class EngineChannel:
+    def __init__(self, name: str, base_url: Optional[str] = None,
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 retries: int = DEFAULT_RETRIES):
+        # `name` is the engine's HTTP address (reference: InstanceMetaInfo.name
+        # doubles as the HTTP endpoint, `xllm_rpc_service.proto:31-46`).
+        self.name = name
+        self.base_url = base_url or (
+            name if name.startswith("http") else f"http://{name}")
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self._session = requests.Session()
+
+    def _post(self, path: str, payload: dict[str, Any],
+              timeout_s: Optional[float] = None) -> tuple[bool, Any]:
+        err: Any = None
+        for _ in range(self.retries):
+            try:
+                r = self._session.post(self.base_url + path, json=payload,
+                                       timeout=timeout_s or self.timeout_s)
+                if r.status_code == 200:
+                    try:
+                        return True, r.json()
+                    except json.JSONDecodeError:
+                        return True, r.text
+                err = f"HTTP {r.status_code}: {r.text[:200]}"
+            except requests.RequestException as e:
+                err = str(e)
+        return False, err
+
+    def _get(self, path: str, timeout_s: Optional[float] = None) -> tuple[bool, Any]:
+        try:
+            r = self._session.get(self.base_url + path,
+                                  timeout=timeout_s or self.timeout_s)
+            if r.status_code == 200:
+                try:
+                    return True, r.json()
+                except json.JSONDecodeError:
+                    return True, r.text
+            return False, f"HTTP {r.status_code}"
+        except requests.RequestException as e:
+            return False, str(e)
+
+    # ---- control plane -----------------------------------------------------
+    def health(self, timeout_s: float = 1.0) -> bool:
+        """Reference probes HTTP GET /health (`instance_mgr.cpp:500-539`)."""
+        ok, _ = self._get("/health", timeout_s=timeout_s)
+        return ok
+
+    def link(self, peer: InstanceMetaInfo) -> bool:
+        """Introduce a PD peer for KV-transfer setup (reference
+        `DisaggPDService.LinkInstance`, `instance_mgr.cpp:1087-1113`)."""
+        ok, err = self._post("/rpc/link", {"peer": json.loads(peer.to_json())})
+        if not ok:
+            logger.warning("link %s -> %s failed: %s", self.name, peer.name, err)
+        return ok
+
+    def unlink(self, peer_name: str) -> bool:
+        ok, _ = self._post("/rpc/unlink", {"peer_name": peer_name})
+        return ok
+
+    def flip_role(self, new_type: str) -> bool:
+        """Dynamic PD-role switch (reference flips types via engine contract,
+        `instance_mgr.cpp:1023-1063`; TPU engine swaps compiled programs)."""
+        ok, _ = self._post("/rpc/flip_role", {"type": new_type})
+        return ok
+
+    def cancel(self, service_request_id: str) -> bool:
+        """Propagate client disconnect / service-side cancellation to the
+        engine (reference cancels via the engine contract on disconnect,
+        `scheduler.cpp:507-521`)."""
+        ok, _ = self._post("/rpc/cancel",
+                           {"service_request_id": service_request_id})
+        return ok
+
+    def models(self) -> list[dict[str, Any]]:
+        ok, body = self._get("/v1/models")
+        if ok and isinstance(body, dict):
+            return body.get("data", [])
+        return []
+
+    # ---- data plane (sync fallback; the frontend normally forwards async) --
+    def forward(self, path: str, payload: dict[str, Any]) -> tuple[bool, Any]:
+        return self._post(path, payload)
+
+    def close(self) -> None:
+        self._session.close()

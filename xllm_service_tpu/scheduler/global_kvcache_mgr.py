@@ -1,0 +1,176 @@
+"""Global prefix-KV-cache index.
+
+Parity: reference `scheduler/managers/global_kvcache_mgr.{h,cpp}`
+(SURVEY.md §2.5): a replicated map ``block-hash → CacheLocations{hbm,dram,
+ssd instance sets}``. Heartbeat deltas feed it; `match()` walks a prompt's
+chained block hashes until first miss and scores candidate instances; the
+master batches deltas to coordination every sync tick and replicas mirror
+via watch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional, Sequence
+
+from ..common.hashing import prefix_block_hash_hexes
+from ..common.types import CacheLocations, KvCacheEvent, OverlapScores
+from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..rpc import CACHE_KEY_PREFIX, MASTER_KEY
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+# Tier weights for scoring: an HBM hit is worth more than a DRAM/SSD hit
+# (those require onload before reuse). The reference scores matched block
+# counts per instance (`global_kvcache_mgr.cpp:73-131`); tiering the score is
+# our refinement of the HBM→DRAM→SSD demotion chain it maintains
+# (`global_kvcache_mgr.cpp:177-225`).
+TIER_WEIGHTS = {"hbm": 1.0, "dram": 0.6, "ssd": 0.3}
+
+
+class GlobalKVCacheMgr:
+    def __init__(self, coord: CoordinationClient, block_size: int = 128,
+                 is_master: bool = True):
+        self._coord = coord
+        self._block_size = block_size
+        self._is_master = is_master
+        self._lock = threading.Lock()
+        self._cache: dict[str, CacheLocations] = {}
+        # Master-side pending delta for the upload loop
+        # (`global_kvcache_mgr.cpp:227-247`).
+        self._dirty: set[str] = set()
+        self._removed: set[str] = set()
+        self._watch_id: Optional[int] = None
+        if not is_master:
+            self._watch_id = coord.add_watch(CACHE_KEY_PREFIX, self._on_cache_event)
+        self._load_existing()
+
+    def _load_existing(self) -> None:
+        for key, val in self._coord.get_prefix(CACHE_KEY_PREFIX).items():
+            try:
+                loc = CacheLocations.from_dict(json.loads(val))
+            except (json.JSONDecodeError, TypeError):
+                continue
+            with self._lock:
+                self._cache[key[len(CACHE_KEY_PREFIX):]] = loc
+
+    # ---------------------------------------------------------------- match
+    def match(self, token_ids: Sequence[int]) -> OverlapScores:
+        """Walk full blocks of the prompt; accumulate per-instance scores
+        until the first block absent from the global index (reference
+        `global_kvcache_mgr.cpp:73-131`)."""
+        hashes = prefix_block_hash_hexes(token_ids, self._block_size)
+        scores: dict[str, float] = {}
+        matched = 0
+        with self._lock:
+            for h in hashes:
+                loc = self._cache.get(h)
+                if loc is None or loc.empty():
+                    break
+                matched += 1
+                for tier, weight in TIER_WEIGHTS.items():
+                    for inst in getattr(loc, tier):
+                        scores[inst] = scores.get(inst, 0.0) + weight
+        return OverlapScores(scores=scores, max_block_num=len(hashes))
+
+    # -------------------------------------------------------------- ingest
+    def record_updated_kvcaches(self, instance: str, event: KvCacheEvent) -> None:
+        """Heartbeat delta ingest (reference `global_kvcache_mgr.cpp:177-225`):
+        stored → HBM set; offloaded → demote HBM→DRAM→SSD; removed → erase
+        everywhere."""
+        if event.empty():
+            return
+        with self._lock:
+            for h in event.stored:
+                loc = self._cache.setdefault(h, CacheLocations())
+                loc.hbm.add(instance)
+                loc.dram.discard(instance)
+                loc.ssd.discard(instance)
+                self._dirty.add(h)
+            for h in event.offloaded:
+                loc = self._cache.setdefault(h, CacheLocations())
+                if instance in loc.hbm:
+                    loc.hbm.discard(instance)
+                    loc.dram.add(instance)
+                elif instance in loc.dram:
+                    loc.dram.discard(instance)
+                    loc.ssd.add(instance)
+                else:
+                    loc.dram.add(instance)
+                self._dirty.add(h)
+            for h in event.removed:
+                loc = self._cache.get(h)
+                if loc is None:
+                    continue
+                loc.remove_instance(instance)
+                if loc.empty():
+                    del self._cache[h]
+                    self._removed.add(h)
+                    self._dirty.discard(h)
+                else:
+                    self._dirty.add(h)
+
+    def remove_instance(self, instance: str) -> None:
+        """Drop a dead instance from every location set."""
+        with self._lock:
+            dead = []
+            for h, loc in self._cache.items():
+                before = (len(loc.hbm), len(loc.dram), len(loc.ssd))
+                loc.remove_instance(instance)
+                if (len(loc.hbm), len(loc.dram), len(loc.ssd)) != before:
+                    if loc.empty():
+                        dead.append(h)
+                    else:
+                        self._dirty.add(h)
+            for h in dead:
+                del self._cache[h]
+                self._removed.add(h)
+                self._dirty.discard(h)
+
+    # ------------------------------------------------------- sync (master)
+    def upload_kvcache(self) -> None:
+        """Master: batched delta upload (reference
+        `global_kvcache_mgr.cpp:227-247`; guarded on mastership like the
+        reference's guarded bulk ops, `etcd_client.cpp:149-160`)."""
+        with self._lock:
+            upserts = {CACHE_KEY_PREFIX + h: json.dumps(self._cache[h].to_dict())
+                       for h in self._dirty if h in self._cache}
+            removals = [CACHE_KEY_PREFIX + h for h in self._removed]
+            self._dirty.clear()
+            self._removed.clear()
+        if upserts:
+            self._coord.bulk_set(upserts)
+        if removals:
+            self._coord.bulk_rm(removals)
+
+    def _on_cache_event(self, events: list[KeyEvent], _prefix: str) -> None:
+        """Replica mirror (reference `global_kvcache_mgr.cpp:133-175`)."""
+        with self._lock:
+            for ev in events:
+                h = ev.key[len(CACHE_KEY_PREFIX):]
+                if ev.type == WatchEventType.PUT:
+                    try:
+                        self._cache[h] = CacheLocations.from_dict(json.loads(ev.value))
+                    except (json.JSONDecodeError, TypeError):
+                        continue
+                else:
+                    self._cache.pop(h, None)
+
+    def set_as_master(self) -> None:
+        if self._is_master:
+            return
+        self._is_master = True
+        if self._watch_id is not None:
+            self._coord.remove_watch(self._watch_id)
+            self._watch_id = None
+
+    def num_blocks(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+    def stop(self) -> None:
+        if self._watch_id is not None:
+            self._coord.remove_watch(self._watch_id)
+            self._watch_id = None

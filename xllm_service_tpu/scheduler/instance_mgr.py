@@ -1,0 +1,667 @@
+"""Instance fleet state machine.
+
+Parity: reference `scheduler/managers/instance_mgr.{h,cpp}` (1,678 LoC — the
+reference's largest component; SURVEY.md §2.5, §3.4). Responsibilities:
+
+- Coordination watches on per-type instance prefixes; boot-time load.
+- Registration: channel creation, TimePredictor fit from profiled tables,
+  P↔D peer linking with rollback on partial failure, round-robin index
+  insert with O(1) swap-remove.
+- Incarnation tracking: stale-heartbeat rejection, instance-replacement
+  detection (same name, new incarnation).
+- Three-state failure detection: DELETE event → health probe → LEASE_LOST
+  (grace, still schedulable) or SUSPECT (excluded); 1s reconcile thread
+  promotes silent LEASE_LOST → SUSPECT and evicts old SUSPECTs
+  (deregister: unlink peers, cancel bound in-flight requests, drop state).
+- Scheduling reads: RR pair selection with SUSPECT skip + DEFAULT/MIX
+  fallback, load snapshots for CAR, SLO-aware pair selection with dynamic
+  PD-role flipping.
+- Master replicas: master uploads load metrics to coordination; non-masters
+  mirror via watch.
+
+Lock discipline (reference documents a two-lock order,
+`instance_mgr.h:156-162`): `_cluster_lock` guards fleet membership/indices;
+`_metrics_lock` guards load/latency/request accounting. Never take
+`_cluster_lock` while holding `_metrics_lock`; RPCs are issued outside locks.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..common.config import ServiceOptions
+from ..common.time_predictor import TimePredictor
+from ..common.types import (
+    InstanceLoadInfo,
+    InstanceMetaInfo,
+    InstanceRuntimeState,
+    InstanceType,
+    LatencyMetrics,
+    LoadMetrics,
+    RequestAction,
+    Routing,
+    now_ms,
+)
+from ..common.request import Request
+from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..rpc import (
+    INSTANCE_KEY_PREFIX,
+    LOADMETRICS_KEY_PREFIX,
+    MASTER_KEY,
+    instance_key,
+    parse_instance_key,
+)
+from ..rpc.channel import EngineChannel
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+# Roles that serve prefill-side / decode-side traffic.
+_PREFILL_TYPES = (InstanceType.PREFILL, InstanceType.MIX, InstanceType.DEFAULT)
+_DECODE_TYPES = (InstanceType.DECODE, InstanceType.MIX)
+
+
+@dataclass
+class _RequestLoad:
+    """Per-instance in-flight accounting for the SLO predictor
+    (reference `request_metrics_`, `instance_mgr.h:173-195`)."""
+
+    num_prefill_requests: int = 0
+    num_prefill_tokens: int = 0
+    num_decode_requests: int = 0
+    num_decode_tokens: int = 0
+
+
+@dataclass
+class _Entry:
+    meta: InstanceMetaInfo
+    state: InstanceRuntimeState = InstanceRuntimeState.ACTIVE
+    channel: Optional[EngineChannel] = None
+    predictor: TimePredictor = field(default_factory=TimePredictor)
+    last_heartbeat_ms: int = field(default_factory=now_ms)
+    state_since_ms: int = field(default_factory=now_ms)
+
+    def schedulable(self) -> bool:
+        # SUSPECT instances are excluded from scheduling; LEASE_LOST are in a
+        # grace window and still schedulable (reference
+        # `is_instance_schedulable`, `instance_mgr.cpp:63-66`).
+        return self.state != InstanceRuntimeState.SUSPECT
+
+
+class InstanceMgr:
+    def __init__(self, coord: CoordinationClient, options: ServiceOptions,
+                 is_master: bool = True,
+                 channel_factory: Callable[[str, str], EngineChannel] | None = None,
+                 start_threads: bool = True):
+        self._coord = coord
+        self._opts = options
+        self._is_master = is_master
+        self._channel_factory = channel_factory or (
+            lambda name, rpc_addr: EngineChannel(name))
+        # L1: fleet membership + indices.
+        self._cluster_lock = threading.RLock()
+        self._instances: dict[str, _Entry] = {}
+        self._prefill_index: list[str] = []
+        self._decode_index: list[str] = []
+        self._rr_prefill = 0
+        self._rr_decode = 0
+        # L2: metrics.
+        self._metrics_lock = threading.Lock()
+        self._load_metrics: dict[str, LoadMetrics] = {}
+        self._latency_metrics: dict[str, LatencyMetrics] = {}
+        self._request_loads: dict[str, _RequestLoad] = {}
+        self._updated_load_names: set[str] = set()
+        self._removed_load_names: set[str] = set()
+        # Hook for request cancellation on instance death (reference keeps a
+        # Scheduler back-pointer, `instance_mgr.h:196-198`).
+        self.on_instance_failure: Optional[Callable[[str, str, InstanceType], None]] = None
+        # Heartbeat KV-event sink (wired to GlobalKVCacheMgr by Scheduler).
+        self.on_kvcache_event = None
+
+        self._watch_ids: list[int] = []
+        self._stopped = threading.Event()
+        self._watch_ids.append(
+            coord.add_watch(INSTANCE_KEY_PREFIX, self._on_instance_event))
+        if not is_master:
+            self._watch_ids.append(
+                coord.add_watch(LOADMETRICS_KEY_PREFIX, self._on_loadmetrics_event))
+            self._on_loadmetrics_event(
+                [KeyEvent(WatchEventType.PUT, k, v) for k, v in
+                 coord.get_prefix(LOADMETRICS_KEY_PREFIX).items()], "")
+        self._load_existing()
+        self._reconciler: Optional[threading.Thread] = None
+        if start_threads:
+            self._reconciler = threading.Thread(
+                target=self._reconcile_loop, name="instance-reconcile", daemon=True)
+            self._reconciler.start()
+
+    # ------------------------------------------------------------------ boot
+    def _load_existing(self) -> None:
+        """Boot-time fleet load (reference `instance_mgr.cpp:150-182`)."""
+        for key, val in self._coord.get_prefix(INSTANCE_KEY_PREFIX).items():
+            try:
+                meta = InstanceMetaInfo.from_json(val)
+            except (json.JSONDecodeError, TypeError) as e:
+                logger.warning("bad instance meta at %s: %s", key, e)
+                continue
+            self.register_instance(meta, link_peers=False)
+        # Existing fleet is assumed already linked pairwise; only new
+        # registrations trigger link fan-out.
+
+    # ------------------------------------------------------- watch callbacks
+    def _on_instance_event(self, events: list[KeyEvent], _prefix: str) -> None:
+        for ev in events:
+            type_str, name = parse_instance_key(ev.key)
+            if ev.type == WatchEventType.PUT:
+                try:
+                    meta = InstanceMetaInfo.from_json(ev.value)
+                except (json.JSONDecodeError, TypeError) as e:
+                    logger.warning("bad instance meta for %s: %s", name, e)
+                    continue
+                self._handle_instance_put(meta)
+            else:
+                self._handle_instance_delete(name)
+
+    def _handle_instance_put(self, meta: InstanceMetaInfo) -> None:
+        with self._cluster_lock:
+            cur = self._instances.get(meta.name)
+        if cur is None:
+            self.register_instance(meta)
+            return
+        if cur.meta.incarnation_id == meta.incarnation_id:
+            # Refresh registration → back to ACTIVE (reference
+            # `instance_mgr.cpp:575-586,783-799`).
+            with self._cluster_lock:
+                cur.meta = meta
+                self._set_state(cur, InstanceRuntimeState.ACTIVE)
+            return
+        # New incarnation: instance replacement (reference
+        # `instance_mgr.cpp:588-601`).
+        logger.info("instance %s replaced (incarnation %s -> %s)",
+                    meta.name, cur.meta.incarnation_id, meta.incarnation_id)
+        self.deregister_instance(meta.name, reason="replaced")
+        self.register_instance(meta)
+
+    def _handle_instance_delete(self, name: str) -> None:
+        """Lease lapse: probe health, then LEASE_LOST (grace) or SUSPECT
+        (reference `instance_mgr.cpp:500-539,604-661`)."""
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            channel = entry.channel if entry else None
+        if entry is None:
+            return
+        ok = False
+        if channel is not None:
+            for _ in range(self._opts.health_probe_attempts):
+                if channel.health(timeout_s=self._opts.health_probe_timeout_s):
+                    ok = True
+                    break
+                time.sleep(0.01 if self._stopped.is_set() else
+                           min(self._opts.health_probe_timeout_s, 1.0))
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            if entry is None:
+                return
+            self._set_state(entry, InstanceRuntimeState.LEASE_LOST if ok
+                            else InstanceRuntimeState.SUSPECT)
+        logger.info("instance %s lease lost; probe %s -> %s", name,
+                    "ok" if ok else "failed", entry.state.value)
+
+    def _on_loadmetrics_event(self, events: list[KeyEvent], _prefix: str) -> None:
+        """Non-master replicas mirror load metrics from coordination
+        (reference `instance_mgr.cpp:665-706`)."""
+        with self._metrics_lock:
+            for ev in events:
+                name = ev.key[len(LOADMETRICS_KEY_PREFIX):]
+                if ev.type == WatchEventType.PUT:
+                    try:
+                        d = json.loads(ev.value)
+                    except json.JSONDecodeError:
+                        continue
+                    self._load_metrics[name] = LoadMetrics.from_dict(
+                        d.get("load", {}))
+                    self._latency_metrics[name] = LatencyMetrics.from_dict(
+                        d.get("latency", {}))
+                else:
+                    self._load_metrics.pop(name, None)
+                    self._latency_metrics.pop(name, None)
+
+    # --------------------------------------------------------- registration
+    def register_instance(self, meta: InstanceMetaInfo,
+                          link_peers: bool = True) -> bool:
+        """Reference `instance_mgr.cpp:1155-1210,1289-1396`."""
+        channel = self._channel_factory(meta.name, meta.rpc_address)
+        entry = _Entry(meta=meta, channel=channel)
+        if meta.ttft_profiling_data:
+            entry.predictor.fit_ttft(meta.ttft_profiling_data)
+        if meta.tpot_profiling_data:
+            entry.predictor.fit_tpot(meta.tpot_profiling_data)
+
+        # Link fan-out OUTSIDE locks (reference async-outside-lock pattern,
+        # `instance_mgr.cpp:1189-1202`): new P links to all D, new D to all P,
+        # MIX to all peers; rollback on partial failure (1324-1336).
+        if link_peers and meta.type in (InstanceType.PREFILL,
+                                        InstanceType.DECODE, InstanceType.MIX):
+            peers = self._link_targets(meta)
+            linked: list[_Entry] = []
+            failed = False
+            for peer in peers:
+                if peer.channel is not None and not peer.channel.link(meta):
+                    failed = True
+                    break
+                if channel.link(peer.meta):
+                    linked.append(peer)
+                else:
+                    failed = True
+                    break
+            if failed:
+                for peer in linked:
+                    if peer.channel is not None:
+                        peer.channel.unlink(meta.name)
+                    channel.unlink(peer.meta.name)
+                logger.warning("registration of %s rolled back: link failure",
+                               meta.name)
+                channel.close()
+                return False
+
+        with self._cluster_lock:
+            old = self._instances.get(meta.name)
+            if old is not None and old.channel is not None and old.channel is not channel:
+                old.channel.close()
+            self._instances[meta.name] = entry
+            self._index_insert(meta.name, meta.type)
+        with self._metrics_lock:
+            self._load_metrics.setdefault(meta.name, LoadMetrics())
+            self._request_loads.setdefault(meta.name, _RequestLoad())
+        logger.info("registered instance %s type=%s incarnation=%s",
+                    meta.name, meta.type.value, meta.incarnation_id)
+        return True
+
+    def _link_targets(self, meta: InstanceMetaInfo) -> list[_Entry]:
+        with self._cluster_lock:
+            if meta.type == InstanceType.PREFILL:
+                types = (InstanceType.DECODE, InstanceType.MIX)
+            elif meta.type == InstanceType.DECODE:
+                types = (InstanceType.PREFILL, InstanceType.MIX)
+            else:  # MIX links to all PD peers
+                types = (InstanceType.PREFILL, InstanceType.DECODE,
+                         InstanceType.MIX)
+            return [e for e in self._instances.values()
+                    if e.meta.type in types and e.meta.name != meta.name]
+
+    def deregister_instance(self, name: str, reason: str = "") -> None:
+        """Unlink peers → drop indices → cancel bound requests → drop state
+        (reference `instance_mgr.cpp:1212-1265`)."""
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            if entry is None:
+                return
+            peers = self._link_targets(entry.meta)
+            incarnation = entry.meta.incarnation_id
+            itype = entry.meta.type
+        for peer in peers:
+            if peer.channel is not None:
+                peer.channel.unlink(name)
+        with self._cluster_lock:
+            entry = self._instances.pop(name, None)
+            if entry is None:
+                return
+            self._index_remove(name)
+            if entry.channel is not None:
+                entry.channel.close()
+        with self._metrics_lock:
+            self._load_metrics.pop(name, None)
+            self._latency_metrics.pop(name, None)
+            self._request_loads.pop(name, None)
+            self._removed_load_names.add(name)
+            self._updated_load_names.discard(name)
+        logger.info("deregistered instance %s (%s)", name, reason)
+        if self.on_instance_failure is not None:
+            self.on_instance_failure(name, incarnation, itype)
+
+    # ------------------------------------------------------------- indices
+    def _index_insert(self, name: str, itype: InstanceType) -> None:
+        self._index_remove(name)
+        if itype in _PREFILL_TYPES and name not in self._prefill_index:
+            self._prefill_index.append(name)
+        if itype in _DECODE_TYPES and name not in self._decode_index:
+            self._decode_index.append(name)
+
+    def _index_remove(self, name: str) -> None:
+        # O(1) swap-remove (reference `instance_mgr.cpp:1398-1428`).
+        for index in (self._prefill_index, self._decode_index):
+            if name in index:
+                i = index.index(name)
+                index[i] = index[-1]
+                index.pop()
+
+    # ----------------------------------------------------------- heartbeats
+    def record_instance_heartbeat(self, name: str, incarnation_id: str,
+                                  load: Optional[LoadMetrics] = None,
+                                  latency: Optional[LatencyMetrics] = None) -> bool:
+        """Incarnation-checked heartbeat ingest; SUSPECT → LEASE_LOST
+        recovery (reference `instance_mgr.cpp:451-478`)."""
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            if entry is None:
+                return False
+            if incarnation_id and entry.meta.incarnation_id and \
+                    incarnation_id != entry.meta.incarnation_id:
+                return False  # stale heartbeat from a dead incarnation
+            entry.last_heartbeat_ms = now_ms()
+            if entry.state == InstanceRuntimeState.SUSPECT:
+                self._set_state(entry, InstanceRuntimeState.LEASE_LOST)
+        if load is not None or latency is not None:
+            with self._metrics_lock:
+                if load is not None:
+                    self._load_metrics[name] = load
+                if latency is not None:
+                    self._latency_metrics[name] = latency
+                self._updated_load_names.add(name)
+        return True
+
+    def _set_state(self, entry: _Entry, state: InstanceRuntimeState) -> None:
+        if entry.state != state:
+            entry.state = state
+            entry.state_since_ms = now_ms()
+
+    # ------------------------------------------------------------ reconcile
+    def _reconcile_loop(self) -> None:
+        while not self._stopped.wait(self._opts.reconcile_interval_s):
+            self.reconcile_once()
+
+    def reconcile_once(self) -> None:
+        """One pass of the 1s reconcile thread (reference
+        `instance_mgr.cpp:719-781`): LEASE_LOST with heartbeat silence →
+        SUSPECT; SUSPECT older than eviction window → deregister."""
+        now = now_ms()
+        to_evict: list[str] = []
+        with self._cluster_lock:
+            for name, entry in self._instances.items():
+                if entry.state == InstanceRuntimeState.LEASE_LOST:
+                    silence = now - entry.last_heartbeat_ms
+                    if silence > self._opts.heartbeat_silence_to_suspect_s * 1000:
+                        self._set_state(entry, InstanceRuntimeState.SUSPECT)
+                        logger.info("instance %s: LEASE_LOST -> SUSPECT "
+                                    "(heartbeat silence %dms)", name, silence)
+                if entry.state == InstanceRuntimeState.SUSPECT:
+                    age = now - entry.state_since_ms
+                    if age > self._opts.detect_disconnected_instance_interval_s * 1000:
+                        to_evict.append(name)
+        for name in to_evict:
+            self.deregister_instance(name, reason="suspect eviction")
+
+    # ------------------------------------------------------ scheduling reads
+    def get_next_instance_pair(self) -> Routing:
+        """RR with SUSPECT skip; DEFAULT/MIX-only fallback when no decode
+        fleet exists (reference `instance_mgr.cpp:203-254`)."""
+        with self._cluster_lock:
+            prefill = self._rr_pick(self._prefill_index, "prefill")
+            if prefill is None:
+                return Routing()
+            if not self._decode_index:
+                return Routing(prefill_name=prefill)
+            decode = self._rr_pick(self._decode_index, "decode")
+            if decode is None:
+                return Routing(prefill_name=prefill)
+            if decode == prefill:
+                # A MIX instance picked for both roles serves both stages.
+                return Routing(prefill_name=prefill)
+            return Routing(prefill_name=prefill, decode_name=decode)
+
+    def _rr_pick(self, index: list[str], which: str) -> Optional[str]:
+        if not index:
+            return None
+        cursor = self._rr_prefill if which == "prefill" else self._rr_decode
+        n = len(index)
+        for i in range(n):
+            name = index[(cursor + i) % n]
+            entry = self._instances.get(name)
+            if entry is not None and entry.schedulable():
+                new_cursor = (cursor + i + 1) % n
+                if which == "prefill":
+                    self._rr_prefill = new_cursor
+                else:
+                    self._rr_decode = new_cursor
+                return name
+        return None
+
+    def get_load_infos(self) -> dict[str, InstanceLoadInfo]:
+        """Snapshot for CAR scoring (reference `get_load_metrics`,
+        `instance_mgr.cpp:287-359`)."""
+        with self._cluster_lock:
+            base = {name: (e.meta.type, e.schedulable())
+                    for name, e in self._instances.items()}
+        out: dict[str, InstanceLoadInfo] = {}
+        with self._metrics_lock:
+            for name, (itype, sched) in base.items():
+                out[name] = InstanceLoadInfo(
+                    name=name, type=itype,
+                    load=self._load_metrics.get(name, LoadMetrics()),
+                    latency=self._latency_metrics.get(name, LatencyMetrics()),
+                    schedulable=sched)
+        return out
+
+    def bind_request_instance_incarnations(self, req: Request) -> None:
+        """Reference `instance_mgr.cpp:408-449`: record the incarnations the
+        request is bound to, for stale-output suppression and targeted
+        cancellation."""
+        with self._cluster_lock:
+            p = self._instances.get(req.routing.prefill_name)
+            d = self._instances.get(req.routing.decode_name)
+            req.prefill_incarnation = p.meta.incarnation_id if p else ""
+            req.decode_incarnation = d.meta.incarnation_id if d else ""
+
+    def get_channel(self, name: str) -> Optional[EngineChannel]:
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            return entry.channel if entry else None
+
+    def get_instance_meta(self, name: str) -> Optional[InstanceMetaInfo]:
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            return entry.meta if entry else None
+
+    def get_instance_state(self, name: str) -> Optional[InstanceRuntimeState]:
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            return entry.state if entry else None
+
+    def list_instances(self, itype: Optional[InstanceType] = None) -> list[InstanceMetaInfo]:
+        with self._cluster_lock:
+            return [e.meta for e in self._instances.values()
+                    if itype is None or e.meta.type == itype]
+
+    def has_available_instances(self) -> bool:
+        """Readiness gate (reference `instance_mgr.cpp:1430-1472`): at least
+        one schedulable prefill-capable instance, and if any pure PREFILL
+        exists without MIX/DEFAULT, at least one schedulable decode."""
+        with self._cluster_lock:
+            return any(
+                self._instances[n].schedulable() for n in self._prefill_index
+                if n in self._instances)
+
+    # ------------------------------------------------- SLO core + role flips
+    def update_request_metrics(self, req: Request, action: RequestAction) -> None:
+        """Per-action token/request accounting (reference
+        `instance_mgr.cpp:825-903`)."""
+        pname, dname = req.routing.prefill_name, req.routing.decode_name or req.routing.prefill_name
+        ntok = len(req.token_ids) or req.metrics.prompt_tokens
+        with self._metrics_lock:
+            pl = self._request_loads.setdefault(pname, _RequestLoad())
+            dl = self._request_loads.setdefault(dname, _RequestLoad())
+            if action == RequestAction.SCHEDULE:
+                pl.num_prefill_requests += 1
+                pl.num_prefill_tokens += ntok
+            elif action == RequestAction.FINISH_PREFILL:
+                pl.num_prefill_requests = max(0, pl.num_prefill_requests - 1)
+                pl.num_prefill_tokens = max(0, pl.num_prefill_tokens - ntok)
+                dl.num_decode_requests += 1
+                dl.num_decode_tokens += ntok
+            elif action == RequestAction.DECODE_STEP:
+                dl.num_decode_tokens += 1
+            elif action == RequestAction.FINISH_DECODE:
+                dl.num_decode_requests = max(0, dl.num_decode_requests - 1)
+                dl.num_decode_tokens = max(
+                    0, dl.num_decode_tokens - ntok - req.num_generated_tokens)
+
+    def select_instance_pair_on_slo(self, req: Request) -> Routing:
+        """SLO-aware pair selection with dynamic PD flipping (reference
+        `instance_mgr.cpp:905-1063`):
+
+        1. prefill = argmin estimated prefill completion time (TTFT predictor
+           over queued prefill tokens + this prompt).
+        2. decode = first decode instance whose predicted TPOT at (batch+1)
+           meets `target_tpot_ms`.
+        3. If no decode meets the target and prefill headroom exists, flip an
+           idle PREFILL → DECODE; if decode fleet is over-provisioned (an
+           idle decode) flip one DECODE → PREFILL.
+        """
+        prompt_len = len(req.token_ids)
+        with self._cluster_lock:
+            prefills = [(n, self._instances[n]) for n in self._prefill_index
+                        if n in self._instances and self._instances[n].schedulable()]
+            decodes = [(n, self._instances[n]) for n in self._decode_index
+                       if n in self._instances and self._instances[n].schedulable()]
+        if not prefills:
+            return Routing()
+
+        with self._metrics_lock:
+            loads = {n: self._request_loads.get(n, _RequestLoad())
+                     for n, _ in prefills + decodes}
+
+        # 1) best prefill by estimated time-to-serve this prompt.
+        def prefill_cost(item):
+            name, entry = item
+            ld = loads[name]
+            if entry.predictor.has_ttft:
+                return (entry.predictor.predict_ttft(ld.num_prefill_tokens + prompt_len))
+            return float(ld.num_prefill_tokens + prompt_len)
+
+        best_prefill_name, best_prefill = min(prefills, key=prefill_cost)
+        req.metrics.estimated_ttft_ms = best_prefill.predictor.predict_ttft(
+            loads[best_prefill_name].num_prefill_tokens + prompt_len)
+
+        if not decodes:
+            return Routing(prefill_name=best_prefill_name)
+
+        # 2) first decode meeting the TPOT target.
+        chosen_decode: Optional[str] = None
+        for name, entry in decodes:
+            ld = loads[name]
+            tpot = entry.predictor.predict_tpot(
+                ld.num_decode_requests + 1, ld.num_decode_tokens + prompt_len) \
+                if entry.predictor.has_tpot else 0.0
+            if tpot <= self._opts.target_tpot_ms:
+                chosen_decode = name
+                break
+
+        if chosen_decode is None:
+            # 3) overloaded decode fleet: flip an idle prefill to decode
+            # (reference P→D flip when no decode meets TPOT target,
+            # `instance_mgr.cpp:1023-1063`), then fall back least-loaded.
+            idle_prefill = next(
+                (n for n, _ in prefills
+                 if n != best_prefill_name
+                 and loads[n].num_prefill_requests == 0
+                 and self.get_instance_meta(n) is not None
+                 and self.get_instance_meta(n).type == InstanceType.PREFILL),
+                None)
+            if idle_prefill is not None and len(prefills) > 1:
+                self.flip_instance_role(idle_prefill, InstanceType.DECODE)
+                chosen_decode = idle_prefill
+            else:
+                chosen_decode = min(
+                    decodes, key=lambda it: loads[it[0]].num_decode_tokens)[0]
+        else:
+            # Opportunistic D→P flip when some decode instance is completely
+            # idle and prefill queue is deep (reference auto flip at zero
+            # decode load, `instance_mgr.cpp:900-902`).
+            if len(decodes) > 1 and loads[best_prefill_name].num_prefill_requests > 0:
+                idle_decode = next(
+                    (n for n, e in decodes
+                     if n != chosen_decode
+                     and loads[n].num_decode_requests == 0
+                     and e.meta.type == InstanceType.DECODE),
+                    None)
+                surplus = sum(1 for n, _ in decodes
+                              if loads[n].num_decode_requests == 0)
+                if idle_decode is not None and surplus > 1:
+                    self.flip_instance_role(idle_decode, InstanceType.PREFILL)
+
+        if chosen_decode == best_prefill_name:
+            return Routing(prefill_name=best_prefill_name)
+        return Routing(prefill_name=best_prefill_name, decode_name=chosen_decode)
+
+    def flip_instance_role(self, name: str, new_type: InstanceType) -> bool:
+        """Dynamic PD-role switch: tell the engine to swap programs, then
+        update indices + coordination record (reference
+        `flip_prefill_to_decode/flip_decode_to_prefill`,
+        `instance_mgr.cpp:1023-1063`)."""
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            if entry is None:
+                return False
+            channel = entry.channel
+            old_type = entry.meta.type
+        if old_type == new_type:
+            return True
+        if channel is not None and not channel.flip_role(new_type.value):
+            logger.warning("role flip %s -> %s rejected by engine %s",
+                           old_type.value, new_type.value, name)
+            return False
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            if entry is None:
+                return False
+            entry.meta.type = new_type
+            self._index_insert(name, new_type)
+            meta_json = entry.meta.to_json()
+        # Move the coordination record so replicas converge.
+        self._coord.rm(instance_key(old_type.value, name))
+        self._coord.set(instance_key(new_type.value, name), meta_json)
+        logger.info("flipped instance %s: %s -> %s", name, old_type.value,
+                    new_type.value)
+        return True
+
+    # ----------------------------------------------------- master sync loop
+    def upload_load_metrics(self) -> None:
+        """Master: push updated load metrics to coordination; replicas mirror
+        (reference `instance_mgr.cpp:372-391`)."""
+        with self._metrics_lock:
+            updated = {n: json.dumps({
+                "load": self._load_metrics.get(n, LoadMetrics()).to_dict(),
+                "latency": self._latency_metrics.get(n, LatencyMetrics()).to_dict(),
+            }) for n in self._updated_load_names if n in self._load_metrics}
+            removed = list(self._removed_load_names)
+            self._updated_load_names.clear()
+            self._removed_load_names.clear()
+        if updated:
+            self._coord.bulk_set({LOADMETRICS_KEY_PREFIX + n: v
+                                  for n, v in updated.items()})
+        if removed:
+            self._coord.bulk_rm([LOADMETRICS_KEY_PREFIX + n for n in removed])
+
+    def set_as_master(self) -> None:
+        """Replica promotion: drop the mirror watch, start uploading
+        (reference `instance_mgr.cpp:393-396`)."""
+        if self._is_master:
+            return
+        self._is_master = True
+        for wid in list(self._watch_ids[1:]):
+            self._coord.remove_watch(wid)
+        self._watch_ids = self._watch_ids[:1]
+
+    def stop(self) -> None:
+        self._stopped.set()
+        for wid in self._watch_ids:
+            self._coord.remove_watch(wid)
+        self._watch_ids.clear()
+        with self._cluster_lock:
+            for entry in self._instances.values():
+                if entry.channel is not None:
+                    entry.channel.close()

@@ -1,0 +1,23 @@
+"""Load-balance policies (reference `scheduler/loadbalance_policy/`,
+SURVEY.md §2.6): RR (default), CAR (cache-aware), SLO_AWARE (predictive with
+dynamic PD flipping)."""
+
+from .base import LoadBalancePolicy
+from .round_robin import RoundRobinPolicy
+from .cache_aware import CacheAwareRoutingPolicy
+from .slo_aware import SloAwarePolicy
+
+__all__ = ["LoadBalancePolicy", "RoundRobinPolicy", "CacheAwareRoutingPolicy",
+           "SloAwarePolicy", "create_policy"]
+
+
+def create_policy(name: str, instance_mgr, kvcache_mgr, options):
+    """Reference `scheduler.cpp:84-91` policy selection."""
+    name = (name or "RR").upper()
+    if name == "RR":
+        return RoundRobinPolicy(instance_mgr)
+    if name == "CAR":
+        return CacheAwareRoutingPolicy(instance_mgr, kvcache_mgr, options)
+    if name == "SLO_AWARE":
+        return SloAwarePolicy(instance_mgr)
+    raise ValueError(f"unknown load balance policy: {name}")

@@ -1,0 +1,51 @@
+"""Cache-aware routing (CAR).
+
+Parity: reference `cache_aware_routing.cpp:22-85` —
+``score = matched_blocks / max_block_num − hbm_cache_usage_perc −
+waiting / max_waiting`` per candidate, argmax per role; prefix match comes
+from the GlobalKVCacheMgr.
+"""
+
+from __future__ import annotations
+
+from .base import LoadBalancePolicy
+from ...common.request import Request
+from ...common.types import InstanceType, Routing
+
+_PREFILL_TYPES = (InstanceType.PREFILL, InstanceType.MIX, InstanceType.DEFAULT)
+_DECODE_TYPES = (InstanceType.DECODE, InstanceType.MIX)
+
+
+class CacheAwareRoutingPolicy(LoadBalancePolicy):
+    def __init__(self, instance_mgr, kvcache_mgr, options):
+        self._mgr = instance_mgr
+        self._kv = kvcache_mgr
+        self._opts = options
+
+    def select_instances_pair(self, request: Request) -> Routing:
+        if not request.token_ids:
+            return self._mgr.get_next_instance_pair()
+        overlap = self._kv.match(request.token_ids)
+        infos = self._mgr.get_load_infos()
+        max_blocks = max(overlap.max_block_num, 1)
+        max_waiting = max(self._opts.max_waiting_requests, 1)
+
+        def score(info) -> float:
+            matched = overlap.scores.get(info.name, 0.0)
+            return (matched / max_blocks
+                    - info.load.hbm_cache_usage_perc
+                    - info.load.waiting_requests_num / max_waiting)
+
+        prefills = [i for i in infos.values()
+                    if i.schedulable and i.type in _PREFILL_TYPES]
+        decodes = [i for i in infos.values()
+                   if i.schedulable and i.type in _DECODE_TYPES]
+        if not prefills:
+            return Routing()
+        best_p = max(prefills, key=score)
+        if not decodes:
+            return Routing(prefill_name=best_p.name)
+        best_d = max(decodes, key=score)
+        if best_d.name == best_p.name:
+            return Routing(prefill_name=best_p.name)
+        return Routing(prefill_name=best_p.name, decode_name=best_d.name)

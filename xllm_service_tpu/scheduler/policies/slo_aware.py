@@ -1,0 +1,19 @@
+"""SLO-aware policy (reference `slo_aware_policy.cpp:26-38`): untokenized
+requests fall back to RR; tokenized ones go through the InstanceMgr's
+predictive TTFT/TPOT selection with dynamic PD flipping."""
+
+from __future__ import annotations
+
+from .base import LoadBalancePolicy
+from ...common.request import Request
+from ...common.types import Routing
+
+
+class SloAwarePolicy(LoadBalancePolicy):
+    def __init__(self, instance_mgr):
+        self._mgr = instance_mgr
+
+    def select_instances_pair(self, request: Request) -> Routing:
+        if not request.token_ids:
+            return self._mgr.get_next_instance_pair()
+        return self._mgr.select_instance_pair_on_slo(request)

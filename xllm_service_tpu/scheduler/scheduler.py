@@ -1,0 +1,425 @@
+"""Central orchestrator.
+
+Parity: reference `scheduler/scheduler.{h,cpp}` (733 LoC, SURVEY.md §2.4,
+§3.2-3.5):
+
+- ctor: tokenizer + chat template, coordination client, self-registration
+  under `XLLM:SERVICE:<addr>` with a TTL lease, master election by
+  create-if-absent on `XLLM:SERVICE:MASTER`, InstanceMgr + GlobalKVCacheMgr +
+  LB policy construction, master 3s upload loop, replica watch-takeover.
+- `schedule()`: chat-template apply → tokenize → `select_instances_pair` →
+  bind incarnations → SLO accounting.
+- `record_new_request()`: request registry keyed by service_request_id with
+  per-request output-ordering lane pinning; output callbacks built from
+  ResponseHandler (streaming parse state per request).
+- `handle_generation()`: registry lookup, client-disconnect cancellation,
+  TTFT/ITL metrics, callback dispatch on the pinned lane.
+- `clear_requests_on_failed_instance()`: cancel-and-surface for requests
+  bound to a dead (instance, incarnation, role).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Optional
+
+from ..chat_template import JinjaChatTemplate
+from ..common.call_data import ClientConnection
+from ..common.config import ServiceOptions
+from ..common.metrics import ITL_MS, TTFT_MS
+from ..common.ordered_executor import OrderedExecutor
+from ..common.request import (
+    Request,
+    RequestOutput,
+    SequenceOutput,
+    Status,
+    StatusCode,
+    Usage,
+)
+from ..common.types import (
+    InstanceType,
+    KvCacheEvent,
+    LatencyMetrics,
+    LoadMetrics,
+    RequestAction,
+    now_ms,
+)
+from ..coordination import CoordinationClient, connect
+from ..coordination.base import KeyEvent, WatchEventType
+from ..rpc import MASTER_KEY, SERVICE_KEY_PREFIX
+from ..scheduler.global_kvcache_mgr import GlobalKVCacheMgr
+from ..scheduler.instance_mgr import InstanceMgr
+from ..scheduler.policies import create_policy
+from ..scheduler.response_handler import ChatStreamState, ResponseHandler
+from ..tokenizer import TokenizerFactory
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class _RequestState:
+    __slots__ = ("request", "conn", "lane", "kind", "stream_state",
+                 "accum", "first_token_ms", "last_token_ms", "finished")
+
+    def __init__(self, request: Request, conn: ClientConnection, lane: int,
+                 kind: str, stream_state: Optional[ChatStreamState]):
+        self.request = request
+        self.conn = conn
+        self.lane = lane
+        self.kind = kind                  # "chat" | "completion"
+        self.stream_state = stream_state  # only for streaming chat
+        self.accum: dict[int, SequenceOutput] = {}   # non-stream aggregation
+        self.first_token_ms: Optional[int] = None
+        self.last_token_ms: Optional[int] = None
+        self.finished = False
+
+
+class Scheduler:
+    def __init__(self, options: ServiceOptions,
+                 coord: Optional[CoordinationClient] = None,
+                 start_threads: bool = True):
+        self._opts = options
+        self._coord = coord or connect(
+            options.coordination_addr, options.coordination_namespace,
+            options.coordination_username, options.coordination_password)
+        self.self_addr = f"{options.host}:{options.rpc_port}"
+
+        # NLP components (reference `scheduler.cpp:35-58`).
+        self.tokenizer = TokenizerFactory.create_tokenizer(options.tokenizer_path)
+        template = TokenizerFactory.load_chat_template(options.tokenizer_path)
+        self.chat_template = JinjaChatTemplate(template)
+
+        # Self-registration + master election (reference
+        # `scheduler.cpp:72-76,170-184`).
+        self._coord.set(SERVICE_KEY_PREFIX + self.self_addr,
+                        json.dumps({"rpc_address": self.self_addr}),
+                        ttl_s=options.lease_ttl_s)
+        self.is_master = self._coord.create_if_absent(
+            MASTER_KEY, self.self_addr, ttl_s=options.lease_ttl_s)
+
+        self.instance_mgr = InstanceMgr(self._coord, options,
+                                        is_master=self.is_master,
+                                        start_threads=start_threads)
+        self.kvcache_mgr = GlobalKVCacheMgr(self._coord, options.block_size,
+                                            is_master=self.is_master)
+        self.instance_mgr.on_instance_failure = self._on_instance_failure
+        self.lb_policy = create_policy(options.load_balance_policy,
+                                       self.instance_mgr, self.kvcache_mgr,
+                                       options)
+        self.response_handler = ResponseHandler(
+            options.model_id, options.tool_call_parser,
+            options.reasoning_parser)
+
+        # Request registry + ordered output lanes (reference
+        # `scheduler.h:127-133`).
+        self._requests: dict[str, _RequestState] = {}
+        self._req_lock = threading.Lock()
+        self._output_executor = OrderedExecutor(options.num_output_threads)
+
+        self._stopped = threading.Event()
+        self._master_watch_id: Optional[int] = None
+        if not self.is_master:
+            self._master_watch_id = self._coord.add_watch(
+                MASTER_KEY, self._on_master_event)
+        self._sync_thread: Optional[threading.Thread] = None
+        if start_threads:
+            self._sync_thread = threading.Thread(
+                target=self._sync_loop, name="scheduler-sync", daemon=True)
+            self._sync_thread.start()
+
+    # --------------------------------------------------------------- master
+    def _on_master_event(self, events: list[KeyEvent], _prefix: str) -> None:
+        """Replica takeover on master-key expiry (reference
+        `scheduler.cpp:200-217`)."""
+        for ev in events:
+            if ev.key == MASTER_KEY and ev.type == WatchEventType.DELETE:
+                if self._coord.create_if_absent(MASTER_KEY, self.self_addr,
+                                                ttl_s=self._opts.lease_ttl_s):
+                    logger.info("service %s promoted to master", self.self_addr)
+                    self.is_master = True
+                    self.instance_mgr.set_as_master()
+                    self.kvcache_mgr.set_as_master()
+                    if self._master_watch_id is not None:
+                        self._coord.remove_watch(self._master_watch_id)
+                        self._master_watch_id = None
+
+    def _sync_loop(self) -> None:
+        """Master 3s upload loop (reference `scheduler.cpp:160-168`) + stale
+        request GC."""
+        while not self._stopped.wait(self._opts.sync_interval_s):
+            self.sync_once()
+
+    def sync_once(self) -> None:
+        if self.is_master:
+            self.kvcache_mgr.upload_kvcache()
+            self.instance_mgr.upload_load_metrics()
+        self._gc_stale_requests()
+
+    def _gc_stale_requests(self) -> None:
+        deadline = now_ms() - int(self._opts.request_timeout_s * 1000)
+        stale: list[_RequestState] = []
+        with self._req_lock:
+            for sid, st in list(self._requests.items()):
+                if st.request.latest_generate_time_ms < deadline:
+                    stale.append(self._requests.pop(sid))
+        for st in stale:
+            logger.warning("request %s timed out; cancelling",
+                           st.request.service_request_id)
+            self._cancel_on_engines(st.request)
+            self._output_executor.submit_to_lane(
+                st.lane, lambda s=st: s.conn.finish_with_error(
+                    504, "request timed out"))
+
+    # ------------------------------------------------------------- schedule
+    def schedule(self, request: Request) -> Status:
+        """Reference `scheduler.cpp:107-153`."""
+        if request.messages and not request.prompt:
+            try:
+                request.prompt = self.chat_template.apply(
+                    request.messages, request.tools,
+                    request.chat_template_kwargs)
+            except Exception as e:  # noqa: BLE001 — template errors are client errors
+                return Status(StatusCode.INVALID_ARGUMENT,
+                              f"chat template error: {e}")
+        if not request.token_ids and request.prompt:
+            request.token_ids = self.tokenizer.encode(request.prompt)
+        request.metrics.prompt_tokens = len(request.token_ids)
+
+        routing = self.lb_policy.select_instances_pair(request)
+        if not routing.valid():
+            return Status(StatusCode.UNAVAILABLE, "no available instances")
+        request.routing = routing
+        self.instance_mgr.bind_request_instance_incarnations(request)
+        request.metrics.schedule_time_ms = now_ms()
+        self.instance_mgr.update_request_metrics(request, RequestAction.SCHEDULE)
+        return Status(StatusCode.OK)
+
+    # ------------------------------------------------------ request registry
+    def record_new_request(self, request: Request, conn: ClientConnection,
+                           kind: str) -> None:
+        """Register the in-flight request and build its output path
+        (reference `record_new_request` overloads, `scheduler.cpp:279-414`)."""
+        lane = self._output_executor.lane_for(request.service_request_id)
+        stream_state = None
+        if kind == "chat" and request.stream:
+            stream_state = self.response_handler.create_chat_stream_state(request)
+        st = _RequestState(request, conn, lane, kind, stream_state)
+        with self._req_lock:
+            self._requests[request.service_request_id] = st
+
+    def has_request(self, service_request_id: str) -> bool:
+        with self._req_lock:
+            return service_request_id in self._requests
+
+    def num_inflight_requests(self) -> int:
+        with self._req_lock:
+            return len(self._requests)
+
+    # ------------------------------------------------------------- heartbeat
+    def handle_instance_heartbeat(self, payload: dict[str, Any]) -> bool:
+        """Reference `scheduler.cpp:186-198` + RPC `Heartbeat`."""
+        name = payload.get("name", "")
+        incarnation = payload.get("incarnation_id", "")
+        load = LoadMetrics.from_dict(payload.get("load_metrics", {})) \
+            if payload.get("load_metrics") else None
+        latency = LatencyMetrics.from_dict(payload.get("latency_metrics", {})) \
+            if payload.get("latency_metrics") else None
+        known = self.instance_mgr.record_instance_heartbeat(
+            name, incarnation, load, latency)
+        kv = payload.get("kv_cache_event")
+        if known and kv:
+            self.kvcache_mgr.record_updated_kvcaches(
+                name, KvCacheEvent.from_dict(kv))
+        return known
+
+    # ----------------------------------------------------------- generation
+    def handle_generation(self, output: RequestOutput) -> bool:
+        """One Generations delta from an engine (reference
+        `scheduler.cpp:484-559`). Returns False if the request is unknown
+        (signals the engine to stop generating)."""
+        with self._req_lock:
+            st = self._requests.get(output.service_request_id)
+        if st is None or st.finished:
+            return False
+        req = st.request
+        req.touch()
+
+        # Client-disconnect cancellation (reference `scheduler.cpp:507-521`).
+        if st.conn.is_disconnected():
+            logger.info("client of %s disconnected; cancelling",
+                        req.service_request_id)
+            self._finish_request(st)
+            self._cancel_on_engines(req)
+            return False
+
+        self._update_token_metrics(st, output)
+        if output.finished:
+            st.finished = True
+        self._output_executor.submit_to_lane(
+            st.lane, lambda: self._deliver(st, output))
+        return True
+
+    def _update_token_metrics(self, st: _RequestState,
+                              output: RequestOutput) -> None:
+        """TTFT vs ITL histograms + SLO accounting (reference
+        `scheduler.cpp:561-587`)."""
+        req = st.request
+        n_new = sum(len(s.token_ids) or (1 if s.text else 0)
+                    for s in output.outputs)
+        now = now_ms()
+        if st.first_token_ms is None and n_new:
+            st.first_token_ms = now
+            TTFT_MS.observe(now - req.created_time_ms)
+            req.prefill_stage_finished = True
+            req.metrics.prefill_finish_time_ms = now
+            self.instance_mgr.update_request_metrics(
+                req, RequestAction.FINISH_PREFILL)
+        elif n_new:
+            if st.last_token_ms is not None:
+                ITL_MS.observe(now - st.last_token_ms)
+            self.instance_mgr.update_request_metrics(
+                req, RequestAction.DECODE_STEP)
+        if n_new:
+            st.last_token_ms = now
+            req.num_generated_tokens += n_new
+
+    def _deliver(self, st: _RequestState, output: RequestOutput) -> None:
+        """Runs on the request's pinned lane (ordering guarantee)."""
+        req = st.request
+        if req.trace_callback is not None:
+            req.trace_callback(req.service_request_id, output.to_dict())
+        if not output.status.ok():
+            st.conn.finish_with_error(
+                503 if output.status.code == StatusCode.UNAVAILABLE else 500,
+                output.status.message or output.status.code.name)
+            self._remove_request(st, output)
+            return
+        ok = True
+        if req.stream:
+            if st.kind == "chat":
+                ok = self.response_handler.send_chat_delta(
+                    st.conn, st.stream_state, req, output)
+            else:
+                ok = self.response_handler.send_completion_delta(
+                    st.conn, req, output)
+        else:
+            self._accumulate(st, output)
+            if output.finished:
+                final = self._final_output(st, output)
+                if st.kind == "chat":
+                    ok = self.response_handler.send_chat_result(
+                        st.conn, req, final)
+                else:
+                    ok = self.response_handler.send_completion_result(
+                        st.conn, req, final)
+        if output.finished:
+            self._remove_request(st, output)
+        elif not ok:
+            # Downstream write failed: client gone.
+            st.finished = True
+            self._remove_request(st, output)
+            self._cancel_on_engines(req)
+
+    def _accumulate(self, st: _RequestState, output: RequestOutput) -> None:
+        for seq in output.outputs:
+            acc = st.accum.get(seq.index)
+            if acc is None:
+                acc = SequenceOutput(index=seq.index)
+                st.accum[seq.index] = acc
+            acc.text += seq.text
+            acc.token_ids.extend(seq.token_ids)
+            acc.logprobs.extend(seq.logprobs)
+            if seq.finish_reason:
+                acc.finish_reason = seq.finish_reason
+
+    def _final_output(self, st: _RequestState,
+                      last: RequestOutput) -> RequestOutput:
+        outputs = [st.accum[i] for i in sorted(st.accum)]
+        usage = last.usage or Usage(
+            num_prompt_tokens=st.request.metrics.prompt_tokens,
+            num_generated_tokens=st.request.num_generated_tokens)
+        return RequestOutput(
+            request_id=last.request_id,
+            service_request_id=last.service_request_id,
+            outputs=outputs, usage=usage, finished=True,
+            finished_on_prefill=last.finished_on_prefill)
+
+    def _remove_request(self, st: _RequestState,
+                        output: Optional[RequestOutput] = None) -> None:
+        """Reference `finish_request` (`scheduler.cpp:416-441`)."""
+        with self._req_lock:
+            self._requests.pop(st.request.service_request_id, None)
+        st.finished = True
+        st.request.metrics.finish_time_ms = now_ms()
+        self.instance_mgr.update_request_metrics(
+            st.request,
+            RequestAction.FINISH_DECODE if st.request.prefill_stage_finished
+            else RequestAction.FINISH_PREFILL)
+
+    def _finish_request(self, st: _RequestState) -> None:
+        self._remove_request(st)
+
+    def _cancel_on_engines(self, req: Request) -> None:
+        for name in {req.routing.prefill_name, req.routing.decode_name}:
+            if not name:
+                continue
+            ch = self.instance_mgr.get_channel(name)
+            if ch is not None:
+                try:
+                    ch.cancel(req.service_request_id)
+                except Exception:  # noqa: BLE001
+                    logger.exception("cancel RPC to %s failed", name)
+
+    # --------------------------------------------------------- failure path
+    def _on_instance_failure(self, name: str, incarnation: str,
+                             itype: InstanceType) -> None:
+        self.kvcache_mgr.remove_instance(name)
+        self.clear_requests_on_failed_instance(name, incarnation, itype)
+
+    def clear_requests_on_failed_instance(self, name: str, incarnation: str,
+                                          itype: InstanceType) -> None:
+        """Cancel-and-surface (reference `scheduler.cpp:443-482`): every
+        in-flight request bound to the dead (instance, incarnation, role)
+        gets a CANCELLED status; no transparent re-dispatch."""
+        victims: list[_RequestState] = []
+        with self._req_lock:
+            for sid, st in list(self._requests.items()):
+                r = st.request
+                hit = (
+                    (r.routing.prefill_name == name
+                     and (not incarnation or r.prefill_incarnation == incarnation)
+                     and not r.prefill_stage_finished)
+                    or (r.routing.decode_name == name
+                        and (not incarnation or r.decode_incarnation == incarnation))
+                    or (r.routing.decode_name == "" and
+                        r.routing.prefill_name == name
+                        and (not incarnation or r.prefill_incarnation == incarnation))
+                )
+                if hit:
+                    victims.append(self._requests.pop(sid))
+        for st in victims:
+            st.finished = True
+            self._output_executor.submit_to_lane(
+                st.lane,
+                lambda s=st: s.conn.finish_with_error(
+                    503, f"instance {name} failed; request cancelled"))
+            logger.info("cancelled request %s on failed instance %s",
+                        st.request.service_request_id, name)
+
+    # ------------------------------------------------------------ readiness
+    def has_available_instances(self) -> bool:
+        return self.instance_mgr.has_available_instances()
+
+    def get_channel(self, name: str):
+        return self.instance_mgr.get_channel(name)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.instance_mgr.stop()
+        self.kvcache_mgr.stop()
+        self._output_executor.shutdown()
+        self._coord.release(SERVICE_KEY_PREFIX + self.self_addr)
+        if self.is_master:
+            self._coord.release(MASTER_KEY)
+        self._coord.close()

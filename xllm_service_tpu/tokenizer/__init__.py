@@ -1,0 +1,25 @@
+"""L4c tokenization.
+
+Parity: reference `tokenizer/` (SURVEY.md §2.8) — a `Tokenizer` interface
+(`tokenizer.h:28-46`) with three backends selected by
+`TokenizerFactory` (`tokenizer_factory.cpp:9-32`):
+
+- tokenizer.json present → HuggingFace fast tokenizer. The reference binds
+  the Rust `tokenizers` crate through a hand-rolled C ABI cdylib
+  (`tokenizer/tokenizers/src/lib.rs`); here the same Rust core is reached
+  through the maintained `tokenizers` Python binding — native speed, no FFI
+  shim to maintain.
+- tiktoken vocab file → our own byte-level BPE over ranked merges
+  (reference `tiktoken_tokenizer.cpp`).
+- sentencepiece model → wraps the sentencepiece lib when importable
+  (absent in this environment; gated).
+
+Plus a hermetic `SimpleTokenizer` used by tests and by services run without
+model files.
+"""
+
+from .base import Tokenizer
+from .factory import TokenizerFactory
+from .simple import SimpleTokenizer
+
+__all__ = ["Tokenizer", "TokenizerFactory", "SimpleTokenizer"]

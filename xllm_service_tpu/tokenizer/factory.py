@@ -1,0 +1,103 @@
+"""Tokenizer backend selection.
+
+Parity: reference `tokenizer_factory.cpp:9-32` — `tokenizer.json` exists →
+Fast; tiktoken vocab → Tiktoken; else SentencePiece. We add: no path or
+nothing recognized → hermetic SimpleTokenizer (the service must still boot
+for fleets whose engines do their own tokenization).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .base import Tokenizer
+from .simple import SimpleTokenizer
+from .tiktoken import TiktokenTokenizer
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class HFTokenizer(Tokenizer):
+    """HuggingFace fast tokenizer (Rust core via the `tokenizers` binding).
+
+    Replaces the reference's hand-rolled Rust cdylib FFI
+    (`tokenizer/tokenizers/src/lib.rs:56-204`, `fast_tokenizer.cpp:20-30`).
+    """
+
+    def __init__(self, tokenizer_json: str | Path):
+        from tokenizers import Tokenizer as _HFTok
+
+        self._tok = _HFTok.from_file(str(tokenizer_json))
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def id_to_token(self, token_id: int):
+        return self._tok.id_to_token(token_id)
+
+    def token_to_id(self, token: str):
+        return self._tok.token_to_id(token)
+
+
+class TokenizerFactory:
+    @staticmethod
+    def create_tokenizer(tokenizer_path: str = "") -> Tokenizer:
+        if not tokenizer_path:
+            return SimpleTokenizer()
+        p = Path(tokenizer_path)
+        tokenizer_json = p / "tokenizer.json" if p.is_dir() else (
+            p if p.name == "tokenizer.json" else None)
+        if tokenizer_json is not None and tokenizer_json.exists():
+            return HFTokenizer(tokenizer_json)
+        # tiktoken vocab (`*.tiktoken`).
+        if p.is_dir():
+            for cand in p.glob("*.tiktoken"):
+                return TiktokenTokenizer(cand)
+        elif p.suffix == ".tiktoken" and p.exists():
+            return TiktokenTokenizer(p)
+        # sentencepiece model.
+        sp_model = p / "tokenizer.model" if p.is_dir() else (
+            p if p.suffix == ".model" else None)
+        if sp_model is not None and sp_model.exists():
+            try:
+                import sentencepiece  # noqa: F401
+
+                from .sentencepiece_tok import SentencePieceTokenizer
+
+                return SentencePieceTokenizer(sp_model)
+            except ImportError:
+                logger.warning("sentencepiece lib unavailable; "
+                               "falling back to SimpleTokenizer")
+        logger.warning("no recognizable tokenizer at %s; using SimpleTokenizer",
+                       tokenizer_path)
+        return SimpleTokenizer()
+
+    @staticmethod
+    def load_chat_template(tokenizer_path: str) -> Optional[str]:
+        """chat_template from tokenizer_config.json (reference
+        `tokenizer_args.h:30`, parsed by the args loader)."""
+        if not tokenizer_path:
+            return None
+        cfg = Path(tokenizer_path) / "tokenizer_config.json"
+        if not cfg.exists():
+            return None
+        try:
+            data = json.loads(cfg.read_text())
+        except json.JSONDecodeError:
+            return None
+        tmpl = data.get("chat_template")
+        if isinstance(tmpl, list):  # some models ship multiple named templates
+            for item in tmpl:
+                if item.get("name") == "default":
+                    return item.get("template")
+            return tmpl[0].get("template") if tmpl else None
+        return tmpl

@@ -1,0 +1,38 @@
+"""SentencePiece backend (reference `sentencepiece_tokenizer.cpp`, 337 LoC).
+
+Gated on the `sentencepiece` package (not present in every deployment
+image); the factory falls back when missing.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .base import Tokenizer
+
+
+class SentencePieceTokenizer(Tokenizer):
+    def __init__(self, model_path: str | Path):
+        import sentencepiece as spm
+
+        self._sp = spm.SentencePieceProcessor(model_file=str(model_path))
+
+    def encode(self, text: str) -> list[int]:
+        return list(self._sp.encode(text))
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._sp.decode(list(ids))
+
+    def vocab_size(self) -> int:
+        return self._sp.vocab_size()
+
+    def id_to_token(self, token_id: int) -> Optional[str]:
+        try:
+            return self._sp.id_to_piece(token_id)
+        except IndexError:
+            return None
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        tid = self._sp.piece_to_id(token)
+        return tid if tid != self._sp.unk_id() or token == self._sp.id_to_piece(self._sp.unk_id()) else None
