@@ -1,0 +1,49 @@
+"""Run a standalone fake engine instance against a coordination server.
+
+Parity with the reference's `examples/rpc_client_test.cpp` (registers a
+hand-driven fake instance against a running service; SURVEY.md §2.10) —
+useful for driving a real master process without TPU hardware:
+
+    python -m xllm_service_tpu.coordination.server --port 12379 &
+    python -m xllm_service_tpu.master --coordination-addr 127.0.0.1:12379 &
+    python examples/run_fake_engine.py --coordination-addr 127.0.0.1:12379
+"""
+
+import argparse
+import signal
+import sys
+import threading
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from xllm_service_tpu.common.types import InstanceType
+from xllm_service_tpu.coordination import connect
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--coordination-addr", default="127.0.0.1:12379")
+    p.add_argument("--type", default="MIX",
+                   choices=[t.value for t in InstanceType])
+    p.add_argument("--reply", default="Hello from the fake engine!")
+    p.add_argument("--model", default="fake-model")
+    args = p.parse_args()
+
+    coord = connect(args.coordination_addr)
+    engine = FakeEngine(coord, FakeEngineConfig(
+        instance_type=InstanceType.parse(args.type),
+        models=[args.model], reply_text=args.reply)).start()
+    print(f"fake engine {engine.name} ({args.type}) registered; Ctrl-C to stop",
+          flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    engine.stop()
+    coord.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,187 @@
+"""End-to-end slice (SURVEY.md §7.2 checkpoint A): HTTP client → master →
+fake engine → streamed tokens. Plus failure drills over the full stack."""
+
+import json
+import time
+
+import pytest
+import requests
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.coordination.memory import InMemoryCoordination, MemoryStore
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import wait_until
+
+
+@pytest.fixture()
+def cluster(store):
+    """One master + one MIX fake engine sharing an in-memory coordination
+    'cluster'."""
+    opts = ServiceOptions(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        lease_ttl_s=1.0, reconcile_interval_s=0.1,
+        heartbeat_silence_to_suspect_s=0.5,
+        detect_disconnected_instance_interval_s=0.5,
+        health_probe_attempts=1, health_probe_timeout_s=0.3,
+        sync_interval_s=0.2)
+    master = Master(opts, coord=InMemoryCoordination(store))
+    master.start()
+    engine = FakeEngine(InMemoryCoordination(store),
+                        FakeEngineConfig()).start()
+    assert wait_until(
+        lambda: master.scheduler.instance_mgr.get_instance_meta(engine.name)
+        is not None, timeout=5)
+    yield master, engine
+    engine.stop()
+    master.stop()
+
+
+def _base(master) -> str:
+    return f"http://127.0.0.1:{master.http_port}"
+
+
+class TestE2E:
+    def test_hello_and_models(self, cluster):
+        master, engine = cluster
+        r = requests.get(_base(master) + "/hello", timeout=5)
+        assert r.status_code == 200 and r.json()["status"] == "ok"
+        models = requests.get(_base(master) + "/v1/models", timeout=5).json()
+        assert [m["id"] for m in models["data"]] == ["fake-model"]
+
+    def test_non_stream_completion(self, cluster):
+        master, engine = cluster
+        r = requests.post(_base(master) + "/v1/completions", json={
+            "model": "fake-model", "prompt": "Say hi", "max_tokens": 64,
+        }, timeout=10)
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["object"] == "text_completion"
+        assert body["choices"][0]["text"] == "Hello from the fake engine!"
+        assert body["choices"][0]["finish_reason"] == "stop"
+        assert body["usage"]["prompt_tokens"] > 0
+        # The engine saw the enriched payload.
+        fwd = engine.accepted_requests[-1]
+        assert fwd["service_request_id"].startswith("completion-")
+        assert fwd["token_ids"]
+        assert fwd["routing"]["prefill_name"] == engine.name
+
+    def test_streaming_chat(self, cluster):
+        master, engine = cluster
+        r = requests.post(_base(master) + "/v1/chat/completions", json={
+            "model": "fake-model",
+            "messages": [{"role": "user", "content": "hi"}],
+            "stream": True, "max_tokens": 64,
+            "stream_options": {"include_usage": True},
+        }, stream=True, timeout=10)
+        assert r.status_code == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        events = []
+        for line in r.iter_lines():
+            if line.startswith(b"data: "):
+                events.append(line[len(b"data: "):])
+        assert events[-1] == b"[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        content = "".join(
+            (c["choices"][0]["delta"].get("content") or "")
+            for c in chunks if c.get("choices"))
+        assert content == "Hello from the fake engine!"
+        finish = [c["choices"][0].get("finish_reason")
+                  for c in chunks if c.get("choices")]
+        assert "stop" in finish
+        usage = [c for c in chunks if c.get("usage")]
+        assert usage and usage[-1]["usage"]["completion_tokens"] > 0
+
+    def test_metrics_endpoint(self, cluster):
+        master, engine = cluster
+        requests.post(_base(master) + "/v1/completions", json={
+            "model": "fake-model", "prompt": "x", "max_tokens": 8}, timeout=10)
+        text = requests.get(_base(master) + "/metrics", timeout=5).text
+        assert "server_request_in_total" in text
+        assert "time_to_first_token_latency_milliseconds" in text
+
+    def test_embeddings_not_supported(self, cluster):
+        master, _ = cluster
+        r = requests.post(_base(master) + "/v1/embeddings",
+                          json={"input": "x"}, timeout=5)
+        assert r.status_code == 501
+
+    def test_heartbeat_feeds_global_kvcache(self, cluster):
+        master, engine = cluster
+        requests.post(_base(master) + "/v1/completions", json={
+            "model": "fake-model",
+            "prompt": "tok " * 400,   # > 1 block of 128 tokens
+            "max_tokens": 8}, timeout=10)
+        assert wait_until(
+            lambda: master.scheduler.kvcache_mgr.num_blocks() > 0, timeout=5)
+
+
+class TestE2EFailure:
+    def test_engine_death_evicts_and_gates(self, cluster, store):
+        master, engine = cluster
+        engine.kill()
+        # Suspect eviction: instance disappears from the fleet.
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.get_instance_meta(engine.name)
+            is None, timeout=10)
+        # Readiness gate: API traffic rejected with 503.
+        r = requests.post(_base(master) + "/v1/completions", json={
+            "model": "fake-model", "prompt": "x"}, timeout=5)
+        assert r.status_code == 503
+
+    def test_engine_replacement_same_name(self, cluster, store):
+        master, engine = cluster
+        old_incarnation = engine.incarnation_id
+        engine.pause()
+        import uuid as _uuid
+
+        engine.incarnation_id = _uuid.uuid4().hex[:12]  # "restart"
+        engine.resume()
+        assert wait_until(
+            lambda: (master.scheduler.instance_mgr.get_instance_meta(engine.name)
+                     or engine.meta()).incarnation_id == engine.incarnation_id
+            and master.scheduler.instance_mgr.get_instance_meta(engine.name)
+            is not None, timeout=5)
+        assert master.scheduler.instance_mgr.get_instance_meta(
+            engine.name).incarnation_id != old_incarnation
+
+    def test_request_cancelled_when_engine_dies_midstream(self, store):
+        opts = ServiceOptions(
+            host="127.0.0.1", http_port=0, rpc_port=0,
+            lease_ttl_s=0.5, reconcile_interval_s=0.1,
+            heartbeat_silence_to_suspect_s=0.3,
+            detect_disconnected_instance_interval_s=0.3,
+            health_probe_attempts=1, health_probe_timeout_s=0.2,
+            sync_interval_s=0.2)
+        master = Master(opts, coord=InMemoryCoordination(store))
+        master.start()
+        engine = FakeEngine(
+            InMemoryCoordination(store),
+            FakeEngineConfig(reply_text="slow " * 200, chunk_size=5,
+                             delay_s=0.1)).start()
+        try:
+            assert wait_until(
+                lambda: master.scheduler.instance_mgr.get_instance_meta(
+                    engine.name) is not None, timeout=5)
+            r = requests.post(
+                f"http://127.0.0.1:{master.http_port}/v1/completions",
+                json={"model": "fake-model", "prompt": "x", "stream": True,
+                      "max_tokens": 1000},
+                stream=True, timeout=10)
+            it = r.iter_lines()
+            assert next(it)  # first chunk arrived
+            engine.kill()
+            # Cancel-and-surface: stream ends with an error payload.
+            saw_error = False
+            deadline = time.time() + 15
+            for line in it:
+                if time.time() > deadline:
+                    break
+                if line.startswith(b"data: ") and b"error" in line:
+                    saw_error = True
+                    break
+            assert saw_error
+        finally:
+            engine.stop()
+            master.stop()

@@ -1,0 +1,39 @@
+"""Opt-in request I/O tracing.
+
+Parity: reference `http_service/request_tracer.{h,cpp}` — appends
+`{timestamp, service_request_id, data}` JSONL under a mutex to
+`trace/trace.json`, gated by `--enable_request_trace`
+(`request_tracer.cpp:38-61`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+
+class RequestTracer:
+    def __init__(self, trace_dir: str = "trace", enabled: bool = False):
+        self._enabled = enabled
+        self._lock = threading.Lock()
+        self._path = Path(trace_dir) / "trace.json"
+        if enabled:
+            self._path.parent.mkdir(parents=True, exist_ok=True)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def log(self, service_request_id: str, data: Any) -> None:
+        if not self._enabled:
+            return
+        rec = {"timestamp": int(time.time() * 1000),
+               "service_request_id": service_request_id,
+               "data": data}
+        line = json.dumps(rec, ensure_ascii=False) + "\n"
+        with self._lock:
+            with self._path.open("a") as f:
+                f.write(line)

@@ -1,0 +1,380 @@
+"""OpenAI-compatible HTTP frontend + engine-facing RPC endpoints.
+
+Parity: reference `http_service/service.cpp` (SURVEY.md §2.2, §3.2) and
+`rpc_service/service.cpp` (§2.3, §3.3):
+
+HTTP app (client-facing, reference routes in `master.cpp:71-76`):
+- POST /v1/completions, /v1/chat/completions — parse body → Request with
+  service id `method-threadid-shortuuid` → `Scheduler::schedule` → forward
+  the **enriched** body (service_request_id, source_service_addr, token_ids,
+  routing) to the chosen prefill instance fire-and-forget
+  (`service.cpp:222-260,407-415,485-493`) → stream SSE back as Generations
+  arrive.
+- GET /v1/models — proxied/aggregated from instance metadata
+  (`service.cpp:317-357`).
+- POST /v1/embeddings — "not support" (`service.cpp:500-517`).
+- GET /metrics — Prometheus text (reference leaves this TODO-empty,
+  `service.cpp:526-532`; we implement it).
+- GET /hello, GET /health.
+
+RPC app (engine-facing, reference `XllmRpcService`):
+- POST /rpc/heartbeat → `Scheduler::handle_instance_heartbeat`.
+- POST /rpc/generations → batched deltas → `Scheduler::handle_generation`
+  (`rpc_service/service.cpp:149-215`).
+- GET /rpc/hello, /rpc/instance_info, /rpc/static_prefill_list,
+  /rpc/static_decode_list (P/D peer discovery for engines).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+from typing import Any, Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..common.metrics import REGISTRY, SERVER_REQUEST_IN_TOTAL
+from ..common.request import (
+    Request,
+    RequestOutput,
+    SamplingParams,
+    Status,
+    StatusCode,
+)
+from ..common.types import InstanceType
+from ..scheduler.scheduler import Scheduler
+from ..utils import generate_service_request_id, get_logger, short_uuid
+from .connection import AioConnection
+from .request_tracer import RequestTracer
+
+logger = get_logger(__name__)
+
+
+def _num(body: dict[str, Any], key: str, default, cast):
+    """OpenAI clients serialize unset optionals as explicit null; treat null
+    as default instead of crashing in int()/float()."""
+    v = body.get(key)
+    return cast(v) if v is not None else default
+
+
+def _parse_sampling(body: dict[str, Any]) -> SamplingParams:
+    sp = SamplingParams()
+    sp.max_tokens = _num(body, "max_tokens",
+                         _num(body, "max_completion_tokens", 16, int), int)
+    sp.temperature = _num(body, "temperature", 1.0, float)
+    sp.top_p = _num(body, "top_p", 1.0, float)
+    sp.top_k = _num(body, "top_k", -1, int)
+    sp.n = _num(body, "n", 1, int)
+    sp.frequency_penalty = _num(body, "frequency_penalty", 0.0, float)
+    sp.presence_penalty = _num(body, "presence_penalty", 0.0, float)
+    sp.repetition_penalty = _num(body, "repetition_penalty", 1.0, float)
+    stop = body.get("stop")
+    if isinstance(stop, str):
+        sp.stop = [stop]
+    elif isinstance(stop, list):
+        sp.stop = [str(s) for s in stop]
+    sp.stop_token_ids = list(body.get("stop_token_ids", ()))
+    if body.get("seed") is not None:
+        sp.seed = int(body["seed"])
+    lp = body.get("logprobs")
+    if isinstance(lp, bool):
+        sp.logprobs = lp
+        sp.top_logprobs = int(body.get("top_logprobs", 0) or 0)
+    elif isinstance(lp, int):  # completions-style int logprobs
+        sp.logprobs = lp > 0
+        sp.top_logprobs = lp
+    sp.ignore_eos = bool(body.get("ignore_eos", False))
+    sp.echo = bool(body.get("echo", False))
+    return sp
+
+
+def _error_response(code: int, message: str, etype: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": etype, "code": code}},
+        status=code)
+
+
+class XllmHttpService:
+    """Both aiohttp applications + forwarding client."""
+
+    def __init__(self, scheduler: Scheduler, tracer: Optional[RequestTracer] = None):
+        self.scheduler = scheduler
+        self.opts = scheduler._opts
+        self.tracer = tracer or RequestTracer(self.opts.trace_dir,
+                                              self.opts.enable_request_trace)
+        self._client: Optional[aiohttp.ClientSession] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # The event loop keeps only weak refs to tasks; hold forward tasks
+        # here so they can't be garbage-collected mid-flight.
+        self._forward_tasks: set[asyncio.Task] = set()
+
+    # ------------------------------------------------------------- HTTP app
+    def build_http_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._readiness_middleware])
+        app.router.add_post("/v1/completions", self.handle_completions)
+        app.router.add_post("/v1/chat/completions", self.handle_chat)
+        app.router.add_post("/v1/embeddings", self.handle_embeddings)
+        app.router.add_get("/v1/models", self.handle_models)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/hello", self.handle_hello)
+        app.router.add_get("/health", self.handle_hello)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    def build_rpc_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/rpc/heartbeat", self.handle_heartbeat)
+        app.router.add_post("/rpc/generations", self.handle_generations)
+        app.router.add_get("/rpc/hello", self.handle_hello)
+        app.router.add_get("/rpc/instance_info", self.handle_instance_info)
+        app.router.add_get("/rpc/static_prefill_list", self.handle_prefill_list)
+        app.router.add_get("/rpc/static_decode_list", self.handle_decode_list)
+        app.router.add_get("/health", self.handle_hello)
+        return app
+
+    async def _on_startup(self, app: web.Application) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._client = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=30))
+
+    async def _on_cleanup(self, app: web.Application) -> None:
+        if self._client is not None:
+            await self._client.close()
+
+    @web.middleware
+    async def _readiness_middleware(self, request: web.Request, handler):
+        # Readiness gate (reference stops the whole HTTP server while no
+        # instance group is viable, `master.cpp:101-135`; we keep the socket
+        # and reject API traffic with 503 — same client-observable contract).
+        if request.path.startswith("/v1/") and \
+                not self.scheduler.has_available_instances():
+            return _error_response(503, "no available instances",
+                                   "service_unavailable")
+        return await handler(request)
+
+    # ----------------------------------------------------------- completions
+    async def handle_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_generate(request, kind="completion")
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_generate(request, kind="chat")
+
+    async def _handle_generate(self, http_req: web.Request,
+                               kind: str) -> web.StreamResponse:
+        SERVER_REQUEST_IN_TOTAL.inc()
+        try:
+            body = await http_req.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            return _error_response(400, "request body must be a JSON object")
+
+        try:
+            req = Request(
+                service_request_id=generate_service_request_id(kind),
+                request_id=("chatcmpl-" if kind == "chat" else "cmpl-") + short_uuid(),
+                model=body.get("model", self.opts.model_id or ""),
+                stream=bool(body.get("stream", False)),
+                include_usage=bool((body.get("stream_options") or {})
+                                   .get("include_usage", False)),
+                offline=bool(body.get("offline", False)),
+                priority=int(body.get("priority") or 0),
+                sampling=_parse_sampling(body),
+            )
+        except (TypeError, ValueError, AttributeError) as e:
+            # Mistyped client fields (e.g. "max_tokens": null) are client
+            # errors, not 500s.
+            return _error_response(400, f"invalid request field: {e}")
+        if kind == "chat":
+            msgs = body.get("messages")
+            if not isinstance(msgs, list) or not msgs:
+                return _error_response(400, "messages must be a non-empty list")
+            req.messages = msgs
+            req.tools = body.get("tools") or []
+            req.chat_template_kwargs = body.get("chat_template_kwargs") or {}
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                if prompt and isinstance(prompt[0], int):
+                    req.token_ids = [int(t) for t in prompt]
+                else:
+                    prompt = "".join(str(p) for p in prompt)
+            if isinstance(prompt, str):
+                req.prompt = prompt
+            if not req.prompt and not req.token_ids:
+                return _error_response(400, "prompt must not be empty")
+        if self.tracer.enabled:
+            req.trace_callback = self.tracer.log
+            self.tracer.log(req.service_request_id, {"request": body})
+
+        # Schedule (tokenize + route) off the event loop — CPU-bound.
+        status = await asyncio.get_running_loop().run_in_executor(
+            None, self.scheduler.schedule, req)
+        if not status.ok():
+            return _error_response(
+                503 if status.code.name == "UNAVAILABLE" else 400,
+                status.message, "service_unavailable"
+                if status.code.name == "UNAVAILABLE" else "invalid_request_error")
+
+        conn = AioConnection(asyncio.get_running_loop(), req.stream)
+        self.scheduler.record_new_request(req, conn, kind)
+
+        # Enrich + forward to the prefill instance, fire-and-forget
+        # (reference `service.cpp:222-260,485-493`).
+        enriched = dict(body)
+        enriched["service_request_id"] = req.service_request_id
+        enriched["source_service_addr"] = self.scheduler.self_addr
+        enriched["token_ids"] = req.token_ids
+        enriched["routing"] = {"prefill_name": req.routing.prefill_name,
+                               "decode_name": req.routing.decode_name}
+        path = "/v1/chat/completions" if kind == "chat" else "/v1/completions"
+        task = asyncio.create_task(
+            self._forward_to_instance(req, conn, path, enriched))
+        self._forward_tasks.add(task)
+        task.add_done_callback(self._forward_tasks.discard)
+
+        return await self._respond(http_req, req, conn)
+
+    async def _forward_to_instance(self, req: Request, conn: AioConnection,
+                                   path: str, payload: dict[str, Any]) -> None:
+        url = f"http://{req.routing.prefill_name}{path}"
+        try:
+            assert self._client is not None
+            async with self._client.post(url, json=payload) as resp:
+                if resp.status != 200:
+                    text = await resp.text()
+                    raise RuntimeError(f"engine returned {resp.status}: {text[:200]}")
+        except Exception as e:  # noqa: BLE001 — surface any forward failure
+            logger.warning("forward of %s to %s failed: %s",
+                           req.service_request_id, url, e)
+            # Mirror reference handle_first_send_request failure path. Off
+            # the event loop: handle_generation can issue blocking cancel
+            # RPCs to engines.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.scheduler.handle_generation, RequestOutput(
+                    service_request_id=req.service_request_id,
+                    status=Status(StatusCode.UNAVAILABLE,
+                                  f"failed to reach prefill instance: {e}"),
+                    finished=True))
+
+    async def _respond(self, http_req: web.Request, req: Request,
+                       conn: AioConnection) -> web.StreamResponse:
+        timeout = self.opts.request_timeout_s
+        if req.stream:
+            resp = web.StreamResponse()
+            resp.headers["Content-Type"] = "text/event-stream"
+            resp.headers["Cache-Control"] = "no-cache"
+            resp.headers["Connection"] = "keep-alive"
+            await resp.prepare(http_req)
+            try:
+                while True:
+                    tag, item = await asyncio.wait_for(conn.queue.get(), timeout)
+                    if AioConnection.is_finish(tag):
+                        await resp.write(b"data: [DONE]\n\n")
+                        break
+                    if tag == "error":
+                        code, msg = item
+                        await resp.write(
+                            b"data: " + json.dumps(
+                                {"error": {"message": msg, "code": code}}
+                            ).encode() + b"\n\n")
+                        break
+                    await resp.write(
+                        b"data: " + json.dumps(item, ensure_ascii=False).encode()
+                        + b"\n\n")
+            except (asyncio.TimeoutError, ConnectionResetError, OSError):
+                conn.mark_disconnected()
+            except asyncio.CancelledError:
+                conn.mark_disconnected()
+                raise
+            with contextlib.suppress(ConnectionResetError):
+                await resp.write_eof()
+            return resp
+        # Non-stream.
+        try:
+            while True:
+                tag, item = await asyncio.wait_for(conn.queue.get(), timeout)
+                if AioConnection.is_finish(tag):
+                    continue  # finish after single payload: loop exits below
+                if tag == "error":
+                    code, msg = item
+                    return _error_response(code, msg, "server_error")
+                return web.json_response(item)
+        except asyncio.TimeoutError:
+            conn.mark_disconnected()
+            return _error_response(504, "request timed out", "timeout")
+        except asyncio.CancelledError:
+            conn.mark_disconnected()
+            raise
+
+    # -------------------------------------------------------- other routes
+    async def handle_models(self, request: web.Request) -> web.Response:
+        """Aggregate model list from instance metadata (reference proxies an
+        instance's Models RPC, `service.cpp:317-357`)."""
+        models: dict[str, dict[str, Any]] = {}
+        for meta in self.scheduler.instance_mgr.list_instances():
+            for m in meta.models or ([self.opts.model_id] if self.opts.model_id else []):
+                if m:
+                    models.setdefault(m, {
+                        "id": m, "object": "model", "created": 0,
+                        "owned_by": "xllm-service-tpu"})
+        return web.json_response({"object": "list",
+                                  "data": list(models.values())})
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        # Reference returns "not support" (`service.cpp:500-517`).
+        return _error_response(501, "embeddings not supported",
+                               "not_implemented")
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=REGISTRY.render_prometheus(),
+                            content_type="text/plain")
+
+    async def handle_hello(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok",
+                                  "master": self.scheduler.is_master})
+
+    # ----------------------------------------------------------- RPC routes
+    async def handle_heartbeat(self, request: web.Request) -> web.Response:
+        try:
+            payload = await request.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON")
+        known = await asyncio.get_running_loop().run_in_executor(
+            None, self.scheduler.handle_instance_heartbeat, payload)
+        return web.json_response({"ok": True, "known": known})
+
+    async def handle_generations(self, request: web.Request) -> web.Response:
+        """Batched generation deltas (reference `Generations` RPC,
+        `rpc_service/service.cpp:149-215`). Response tells the engine which
+        requests are dead so it can stop generating them."""
+        try:
+            payload = await request.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON")
+        results: dict[str, bool] = {}
+        loop = asyncio.get_running_loop()
+        for gen in payload.get("gens", ()):
+            out = RequestOutput.from_dict(gen)
+            alive = await loop.run_in_executor(
+                None, self.scheduler.handle_generation, out)
+            results[out.service_request_id] = alive
+        return web.json_response({"ok": True, "alive": results})
+
+    async def handle_instance_info(self, request: web.Request) -> web.Response:
+        name = request.query.get("name", "")
+        meta = self.scheduler.instance_mgr.get_instance_meta(name)
+        if meta is None:
+            return _error_response(404, f"unknown instance {name}")
+        return web.json_response(json.loads(meta.to_json()))
+
+    async def handle_prefill_list(self, request: web.Request) -> web.Response:
+        metas = self.scheduler.instance_mgr.list_instances(InstanceType.PREFILL)
+        return web.json_response({"instances": [m.name for m in metas]})
+
+    async def handle_decode_list(self, request: web.Request) -> web.Response:
+        metas = self.scheduler.instance_mgr.list_instances(InstanceType.DECODE)
+        return web.json_response({"instances": [m.name for m in metas]})

@@ -1,0 +1,132 @@
+"""Composition root + lifecycle for the orchestration service.
+
+Parity: reference `xllm_service/master.{h,cpp}` (SURVEY.md §2.1, §3.1):
+builds Scheduler → services, runs the HTTP frontend (client-facing) and the
+RPC endpoint (engine-facing) — the reference hosts two brpc servers on
+:8888/:8889 (`common/global_gflags.cpp:25,38`); here both are aiohttp sites
+in one event loop owned by a background thread. `main()` parses flags,
+checks ports, installs signal handlers.
+
+Run: ``python -m xllm_service_tpu.master --coordination-addr host:2379 ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import threading
+from typing import Optional
+
+from aiohttp import web
+
+from .common.config import ServiceOptions
+from .coordination import CoordinationClient
+from .http_service.service import XllmHttpService
+from .scheduler.scheduler import Scheduler
+from .utils import get_local_ip, get_logger, is_port_available
+
+logger = get_logger(__name__)
+
+
+class Master:
+    def __init__(self, options: ServiceOptions,
+                 coord: Optional[CoordinationClient] = None):
+        self.options = options
+        self.scheduler = Scheduler(options, coord=coord)
+        self.service = XllmHttpService(self.scheduler)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._start_error: Optional[BaseException] = None
+        self._runners: list[web.AppRunner] = []
+        self.http_port = options.http_port
+        self.rpc_port = options.rpc_port
+
+    # ---- background-thread serving (used by tests and `serve_forever`) ----
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run_loop,
+                                        name="master-loop", daemon=True)
+        self._thread.start()
+        if not self._started.wait(15):
+            raise RuntimeError("master failed to start (timed out)")
+        if self._start_error is not None:
+            raise RuntimeError("master failed to start") from self._start_error
+
+    def _run_loop(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._start_sites())
+        except BaseException as e:  # noqa: BLE001 — surfaced to start()
+            self._start_error = e
+            self._started.set()
+            self._loop.run_until_complete(self._stop_sites())
+            self._loop.close()
+            return
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.run_until_complete(self._stop_sites())
+            self._loop.close()
+
+    async def _start_sites(self) -> None:
+        http_runner = web.AppRunner(self.service.build_http_app())
+        await http_runner.setup()
+        http_site = web.TCPSite(http_runner, self.options.host, self.http_port)
+        await http_site.start()
+        self.http_port = http_site._server.sockets[0].getsockname()[1]
+
+        rpc_runner = web.AppRunner(self.service.build_rpc_app())
+        await rpc_runner.setup()
+        rpc_site = web.TCPSite(rpc_runner, self.options.host, self.rpc_port)
+        await rpc_site.start()
+        self.rpc_port = rpc_site._server.sockets[0].getsockname()[1]
+        # RPC startup hooks don't run through AppRunner unless registered on
+        # the app; the HTTP app's on_startup created the shared client.
+        self._runners = [http_runner, rpc_runner]
+        # Self-address must reflect the actual RPC port (engines stream
+        # Generations to it and resolve the master from coordination).
+        self.scheduler.update_self_addr(
+            f"{self._advertise_host()}:{self.rpc_port}")
+        logger.info("master serving HTTP on :%d, RPC on :%d (master=%s)",
+                    self.http_port, self.rpc_port, self.scheduler.is_master)
+
+    def _advertise_host(self) -> str:
+        if self.options.host in ("0.0.0.0", "::"):
+            return ("127.0.0.1" if not self.options.coordination_addr
+                    else get_local_ip())
+        return self.options.host
+
+    async def _stop_sites(self) -> None:
+        for runner in self._runners:
+            await runner.cleanup()
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="xllm-service-tpu master")
+    ServiceOptions.add_cli_args(parser)
+    args = parser.parse_args()
+    options = ServiceOptions.from_cli_args(args)
+    for port in (options.http_port, options.rpc_port):
+        if port and not is_port_available(port, options.host):
+            raise SystemExit(f"port {port} is not available")
+    master = Master(options)
+    master.start()
+    stop_event = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop_event.set())
+    stop_event.wait()
+    master.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -128,6 +128,23 @@ class Scheduler:
                 target=self._sync_loop, name="scheduler-sync", daemon=True)
             self._sync_thread.start()
 
+    def update_self_addr(self, addr: str) -> None:
+        """Re-register after the serving port is actually bound (ephemeral
+        ports are only known post-bind). Engines resolve the master address
+        from coordination, so the records must carry the real port."""
+        if addr == self.self_addr:
+            return
+        old = self.self_addr
+        self.self_addr = addr
+        self._coord.rm(SERVICE_KEY_PREFIX + old)
+        self._coord.set(SERVICE_KEY_PREFIX + addr,
+                        json.dumps({"rpc_address": addr}),
+                        ttl_s=self._opts.lease_ttl_s)
+        if self.is_master:
+            # Overwrite in place — we hold the lease. A rm+create would fire
+            # a DELETE watch event and race replica takeover (split brain).
+            self._coord.set(MASTER_KEY, addr, ttl_s=self._opts.lease_ttl_s)
+
     # --------------------------------------------------------------- master
     def _on_master_event(self, events: list[KeyEvent], _prefix: str) -> None:
         """Replica takeover on master-key expiry (reference

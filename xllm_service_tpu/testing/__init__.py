@@ -1,0 +1,6 @@
+"""Test fixtures: the in-process fake engine (SURVEY.md §4 names this the
+reference's missing piece and our e2e lever)."""
+
+from .fake_engine import FakeEngine
+
+__all__ = ["FakeEngine"]

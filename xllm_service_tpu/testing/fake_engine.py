@@ -1,0 +1,291 @@
+"""In-process fake engine implementing the full engine contract.
+
+The reference ships no fake backend (SURVEY.md §4); this is the permanent
+hermetic fixture for scheduler/failover/e2e tests AND a reference
+implementation of the engine side of the wire contract:
+
+- registers itself in coordination under `XLLM:INSTANCE:<TYPE>:<name>` with
+  a TTL lease (+incarnation id),
+- heartbeats to the master's RPC endpoint (load metrics + KV-cache events),
+- serves the engine HTTP surface: enriched /v1/completions +
+  /v1/chat/completions (fire-and-forget accept), /health, /rpc/link,
+  /rpc/unlink, /rpc/cancel, /rpc/flip_role,
+- streams canned Generations back to `source_service_addr` in configurable
+  chunks with configurable delays.
+
+Failure drills: `pause()` (stop heartbeats + lease), `kill()` (drop
+everything, refuse health), `set_unhealthy()`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import requests as _requests
+from aiohttp import web
+import asyncio
+
+from ..common.hashing import prefix_block_hash_hexes
+from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
+from ..coordination.base import CoordinationClient
+from ..rpc import instance_key
+from ..utils import get_logger, pick_free_port
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class FakeEngineConfig:
+    instance_type: InstanceType = InstanceType.MIX
+    models: list[str] = field(default_factory=lambda: ["fake-model"])
+    reply_text: str = "Hello from the fake engine!"
+    chunk_size: int = 4          # characters per Generations delta
+    delay_s: float = 0.0         # inter-delta delay
+    heartbeat_interval_s: float = 0.5
+    lease_ttl_s: float = 1.0
+    block_size: int = 128
+    emit_kv_events: bool = True
+    host: str = "127.0.0.1"
+
+
+class FakeEngine:
+    def __init__(self, coord: CoordinationClient,
+                 config: Optional[FakeEngineConfig] = None):
+        self.coord = coord
+        self.cfg = config or FakeEngineConfig()
+        self.port = pick_free_port(self.cfg.host)
+        self.name = f"{self.cfg.host}:{self.port}"
+        self.incarnation_id = uuid.uuid4().hex[:12]
+        self.instance_type = self.cfg.instance_type
+        self.links: list[str] = []
+        self.unlinks: list[str] = []
+        self.cancelled: set[str] = set()
+        self.accepted_requests: list[dict[str, Any]] = []
+        self.healthy = True
+        self._alive = True
+        self._paused = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._thread: Optional[threading.Thread] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._stored_hashes: list[str] = []
+        self._pending_kv_stored: list[str] = []
+        self._kv_lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, register: bool = True) -> "FakeEngine":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"fake-engine-{self.port}")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("fake engine failed to start")
+        if register:
+            self.register()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True,
+                                           name=f"fake-hb-{self.port}")
+        self._hb_thread.start()
+        return self
+
+    def meta(self) -> InstanceMetaInfo:
+        return InstanceMetaInfo(
+            name=self.name, rpc_address=self.name, type=self.instance_type,
+            dp_size=1,
+            topology=TpuTopology(slice_id="fake-slice", mesh_shape=[1],
+                                 axis_names=["data"],
+                                 host_addrs=[self.name]),
+            incarnation_id=self.incarnation_id,
+            register_ts_ms=int(time.time() * 1000),
+            models=list(self.cfg.models),
+            ttft_profiling_data=[[128, 10.0], [512, 30.0], [2048, 100.0]],
+            tpot_profiling_data=[[1, 100, 5.0], [8, 1000, 10.0],
+                                 [32, 8000, 20.0]],
+        )
+
+    def register(self) -> None:
+        self.coord.set(instance_key(self.instance_type.value, self.name),
+                       self.meta().to_json(), ttl_s=self.cfg.lease_ttl_s)
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        app = web.Application()
+        app.router.add_post("/v1/completions", self._h_completion)
+        app.router.add_post("/v1/chat/completions", self._h_chat)
+        app.router.add_get("/v1/models", self._h_models)
+        app.router.add_get("/health", self._h_health)
+        app.router.add_post("/rpc/link", self._h_link)
+        app.router.add_post("/rpc/unlink", self._h_unlink)
+        app.router.add_post("/rpc/cancel", self._h_cancel)
+        app.router.add_post("/rpc/flip_role", self._h_flip)
+
+        async def _start():
+            self._runner = web.AppRunner(app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self.cfg.host, self.port)
+            await site.start()
+
+        self._loop.run_until_complete(_start())
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.run_until_complete(self._runner.cleanup())
+            self._loop.close()
+
+    # ------------------------------------------------------- failure drills
+    def pause(self) -> None:
+        """Stop heartbeats + let the lease lapse (process-hang simulation)."""
+        self._paused = True
+        self.coord.release(instance_key(self.instance_type.value, self.name))
+
+    def resume(self) -> None:
+        self._paused = False
+        self.register()
+
+    def set_unhealthy(self) -> None:
+        self.healthy = False
+
+    def kill(self) -> None:
+        """Hard death: lease lapses, health probe fails, no heartbeats."""
+        self._alive = False
+        self._paused = True
+        self.healthy = False
+        self.coord.release(instance_key(self.instance_type.value, self.name))
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+    def stop(self) -> None:
+        self._alive = False
+        self._paused = True
+        self.coord.rm(instance_key(self.instance_type.value, self.name))
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ---------------------------------------------------------- heartbeats
+    def _heartbeat_loop(self) -> None:
+        while self._alive:
+            time.sleep(self.cfg.heartbeat_interval_s)
+            if self._paused or not self._alive:
+                continue
+            self.register()  # refresh registration (lease keepalive path)
+            master_addr = self.coord.get("XLLM:SERVICE:MASTER")
+            if not master_addr:
+                continue
+            with self._kv_lock:
+                stored = self._pending_kv_stored
+                self._pending_kv_stored = []
+            payload = {
+                "name": self.name,
+                "incarnation_id": self.incarnation_id,
+                "load_metrics": {
+                    "waiting_requests_num": 0,
+                    "running_requests_num": len(self.accepted_requests),
+                    "hbm_cache_usage_perc": 0.1,
+                },
+                "latency_metrics": {"recent_max_ttft": 12.0,
+                                    "recent_max_tbt": 4.0},
+                "kv_cache_event": {"stored": stored, "removed": [],
+                                   "offloaded": []},
+            }
+            try:
+                _requests.post(f"http://{master_addr}/rpc/heartbeat",
+                               json=payload, timeout=2)
+            except _requests.RequestException:
+                pass
+
+    # ------------------------------------------------------------ handlers
+    async def _h_health(self, req: web.Request) -> web.Response:
+        if not self.healthy:
+            return web.Response(status=503, text="unhealthy")
+        return web.json_response({"status": "ok"})
+
+    async def _h_models(self, req: web.Request) -> web.Response:
+        return web.json_response({"object": "list", "data": [
+            {"id": m, "object": "model"} for m in self.cfg.models]})
+
+    async def _h_link(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.links.append(body.get("peer", {}).get("name", ""))
+        return web.json_response({"ok": True})
+
+    async def _h_unlink(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.unlinks.append(body.get("peer_name", ""))
+        return web.json_response({"ok": True})
+
+    async def _h_cancel(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.cancelled.add(body.get("service_request_id", ""))
+        return web.json_response({"ok": True})
+
+    async def _h_flip(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.instance_type = InstanceType.parse(body.get("type"))
+        return web.json_response({"ok": True})
+
+    async def _h_completion(self, req: web.Request) -> web.Response:
+        return await self._accept(req, chat=False)
+
+    async def _h_chat(self, req: web.Request) -> web.Response:
+        return await self._accept(req, chat=True)
+
+    async def _accept(self, req: web.Request, chat: bool) -> web.Response:
+        body = await req.json()
+        self.accepted_requests.append(body)
+        sid = body.get("service_request_id", "")
+        source = body.get("source_service_addr", "")
+        token_ids = body.get("token_ids", [])
+        if self.cfg.emit_kv_events and token_ids:
+            with self._kv_lock:
+                self._pending_kv_stored.extend(
+                    prefix_block_hash_hexes(token_ids, self.cfg.block_size))
+        # Fire-and-forget: accept now, stream Generations from a thread.
+        threading.Thread(target=self._generate, daemon=True,
+                         args=(sid, source, body)).start()
+        return web.json_response({"ok": True})
+
+    # ----------------------------------------------------------- generation
+    def _generate(self, sid: str, source: str, body: dict[str, Any]) -> None:
+        text = self.cfg.reply_text
+        max_tokens = int(body.get("max_tokens", 1 << 30))
+        chunks = [text[i:i + self.cfg.chunk_size]
+                  for i in range(0, len(text), self.cfg.chunk_size)]
+        chunks = chunks[:max_tokens] or [""]
+        n = len(chunks)
+        prompt_tokens = len(body.get("token_ids", []))
+        for i, chunk in enumerate(chunks):
+            if sid in self.cancelled or not self._alive:
+                return
+            last = i == n - 1
+            gen: dict[str, Any] = {
+                "request_id": body.get("request_id", sid),
+                "service_request_id": sid,
+                "status": {"code": 0, "message": ""},
+                "outputs": [{"index": 0, "text": chunk, "token_ids": [i],
+                             "finish_reason": "stop" if last else "",
+                             "logprobs": []}],
+                "finished": last,
+            }
+            if last:
+                gen["usage"] = {"num_prompt_tokens": prompt_tokens,
+                                "num_generated_tokens": n}
+            try:
+                r = _requests.post(f"http://{source}/rpc/generations",
+                                   json={"gens": [gen]}, timeout=5)
+                alive = r.json().get("alive", {}).get(sid, True)
+                if not alive:
+                    return  # service told us to stop
+            except (_requests.RequestException, ValueError) as e:
+                logger.warning("fake engine: generations push failed: %s", e)
+                return
+            if self.cfg.delay_s and not last:
+                time.sleep(self.cfg.delay_s)
