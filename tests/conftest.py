@@ -7,11 +7,19 @@ multi-host test pattern; SURVEY.md §4) — env must be set before jax import.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU. A TPU-attach sitecustomize (if present) registers the TPU
+# plugin at interpreter start and pins the platform in-process, so the env
+# var alone is not enough — override via jax.config too (wins over the
+# hook). Tests run hermetic on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

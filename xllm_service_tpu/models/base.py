@@ -1,0 +1,130 @@
+"""Model contract shared by all families.
+
+Engine-facing surface per family:
+- ``init_params(cfg, rng) -> params`` — random init (benchmarks use random
+  weights; checkpoint loading via orbax slots in behind the same pytree).
+- ``prefill_forward(params, cfg, tokens, positions, kv_pages, page_tables,
+  prefix_lens, seq_lens) -> (logits_last, kv_pages)`` — dense causal
+  attention over the new suffix, K/V scattered into the paged pool.
+- ``decode_forward(params, cfg, tokens, positions, kv_pages, page_tables,
+  context_lens) -> (logits, kv_pages)`` — one step, paged attention.
+
+Layers are stacked (leading L dim) and iterated with `lax.scan` — one
+compiled layer body regardless of depth (fast compiles, XLA-friendly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    num_layers: int = 16
+    num_heads: int = 16
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    ffn_size: int = 5632
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    qkv_bias: bool = False          # Qwen2 family
+    dtype: Any = jnp.bfloat16
+    max_context_len: int = 8192
+    # MoE (deepseek family).
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+    num_shared_experts: int = 0
+    moe_ffn_size: int = 0           # per-expert ffn width
+    first_dense_layers: int = 1     # leading dense layers before MoE blocks
+    # Multimodal (qwen2_vl family).
+    vision: Optional["VisionConfig"] = None
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    num_layers: int = 4
+    num_heads: int = 16
+    out_tokens: int = 64            # visual tokens emitted per image
+
+
+@dataclass
+class ModelFamily:
+    name: str
+    init_params: Callable[..., Any]
+    prefill_forward: Callable[..., Any]
+    decode_forward: Callable[..., Any]
+    sharding_rules: Any = None
+
+
+_REGISTRY: dict[str, ModelFamily] = {}
+
+
+def register_model_family(family: ModelFamily) -> None:
+    _REGISTRY[family.name] = family
+
+
+def get_model_family(name: str) -> ModelFamily:
+    # Lazy imports so importing one family doesn't pull in all.
+    if name not in _REGISTRY:
+        if name in ("llama", "llama3"):
+            from . import llama  # noqa: F401
+        elif name in ("qwen2", "qwen2.5", "qwen"):
+            from . import qwen2  # noqa: F401
+        elif name in ("deepseek_moe", "deepseek"):
+            from . import deepseek_moe  # noqa: F401
+        elif name in ("qwen2_vl",):
+            from . import qwen2_vl  # noqa: F401
+    fam = _REGISTRY.get(name)
+    if fam is None:
+        raise ValueError(f"unknown model family: {name}")
+    return fam
+
+
+# ---- tiny/test/bench configs ------------------------------------------------
+def tiny_config(**kw) -> ModelConfig:
+    """CPU-test scale."""
+    defaults = dict(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4, num_kv_heads=2, head_dim=32, ffn_size=256,
+                    max_context_len=512)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def llama3_8b_config() -> ModelConfig:
+    return ModelConfig(name="llama", vocab_size=128256, hidden_size=4096,
+                       num_layers=32, num_heads=32, num_kv_heads=8,
+                       head_dim=128, ffn_size=14336, rope_theta=500000.0,
+                       max_context_len=8192)
+
+
+def llama3_70b_config() -> ModelConfig:
+    return ModelConfig(name="llama", vocab_size=128256, hidden_size=8192,
+                       num_layers=80, num_heads=64, num_kv_heads=8,
+                       head_dim=128, ffn_size=28672, rope_theta=500000.0,
+                       max_context_len=8192)
+
+
+def bench_1b_config() -> ModelConfig:
+    """~1.2B params — fits one v5e chip in bf16 with KV pool; used by
+    bench.py for single-chip decode throughput."""
+    return ModelConfig(name="llama", vocab_size=32768, hidden_size=2048,
+                       num_layers=16, num_heads=16, num_kv_heads=8,
+                       head_dim=128, ffn_size=8192, max_context_len=4096)

@@ -1,0 +1,184 @@
+"""Llama-3 family (functional JAX, stacked layers, paged KV).
+
+Covers BASELINE configs 1-2 (Llama-3-8B single-instance and PD-disagg) and
+the 70B north star. Architecture: RMSNorm, GQA attention with RoPE, SwiGLU
+MLP, optional tied embeddings. Layers are stacked with a leading L dim and
+executed with `lax.scan` — a single compiled layer body.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import (
+    apply_rope,
+    paged_attention_xla,
+    prefill_attention,
+    rms_norm,
+    write_decode_kv,
+    write_prefill_kv,
+)
+from ..parallel.sharding import ShardingRules
+from jax.sharding import PartitionSpec as P
+from ..parallel.mesh import AXIS_MODEL
+from .base import ModelConfig, ModelFamily, register_model_family
+
+Params = dict
+
+
+# Stacked-layer sharding rules (leading L dim on every layer tensor).
+LLAMA_STACKED_RULES = ShardingRules(rules=[
+    (r"embed/embedding", P(AXIS_MODEL, None)),
+    (r"(q_proj|k_proj|v_proj)/kernel", P(None, None, AXIS_MODEL)),
+    (r"(q_proj|k_proj|v_proj)/bias", P(None, AXIS_MODEL)),
+    (r"o_proj/kernel", P(None, AXIS_MODEL, None)),
+    (r"(gate_proj|up_proj)/kernel", P(None, None, AXIS_MODEL)),
+    (r"down_proj/kernel", P(None, AXIS_MODEL, None)),
+    (r"lm_head/kernel", P(None, AXIS_MODEL)),
+])
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
+    """Random init (truncated-normal-ish scaled); bf16 leaves."""
+    keys = jax.random.split(rng, 8)
+    D, L = cfg.hidden_size, cfg.num_layers
+    Hq, Hkv, hd, F = cfg.q_size, cfg.kv_size, cfg.head_dim, cfg.ffn_size
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    params: Params = {
+        "embed": {"embedding": dense(keys[0], (cfg.vocab_size, D), D)},
+        "layers": {
+            "input_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
+            "q_proj": {"kernel": dense(keys[1], (L, D, Hq), D)},
+            "k_proj": {"kernel": dense(keys[2], (L, D, Hkv), D)},
+            "v_proj": {"kernel": dense(keys[3], (L, D, Hkv), D)},
+            "o_proj": {"kernel": dense(keys[4], (L, Hq, D), Hq)},
+            "post_attn_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
+            "gate_proj": {"kernel": dense(keys[5], (L, D, F), D)},
+            "up_proj": {"kernel": dense(keys[6], (L, D, F), D)},
+            "down_proj": {"kernel": dense(keys[7], (L, F, D), F)},
+        },
+        "final_norm": {"scale": jnp.ones((D,), cfg.dtype)},
+    }
+    if cfg.qkv_bias:
+        params["layers"]["q_proj"]["bias"] = jnp.zeros((L, Hq), cfg.dtype)
+        params["layers"]["k_proj"]["bias"] = jnp.zeros((L, Hkv), cfg.dtype)
+        params["layers"]["v_proj"]["bias"] = jnp.zeros((L, Hkv), cfg.dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": dense(
+            jax.random.fold_in(rng, 99), (D, cfg.vocab_size), D)}
+    return params
+
+
+def _project_qkv(lp: Params, x: jax.Array, cfg: ModelConfig,
+                 positions: jax.Array):
+    """x: [B, S, D] (or [B, D] for decode with S folded) -> q,k,v heads."""
+    q = jnp.einsum("...d,df->...f", x, lp["q_proj"]["kernel"])
+    k = jnp.einsum("...d,df->...f", x, lp["k_proj"]["kernel"])
+    v = jnp.einsum("...d,df->...f", x, lp["v_proj"]["kernel"])
+    if "bias" in lp["q_proj"]:
+        q = q + lp["q_proj"]["bias"]
+        k = k + lp["k_proj"]["bias"]
+        v = v + lp["v_proj"]["bias"]
+    q = q.reshape(*q.shape[:-1], cfg.num_heads, cfg.head_dim)
+    k = k.reshape(*k.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(*v.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(lp: Params, x: jax.Array) -> jax.Array:
+    gate = jnp.einsum("...d,df->...f", x, lp["gate_proj"]["kernel"])
+    up = jnp.einsum("...d,df->...f", x, lp["up_proj"]["kernel"])
+    return jnp.einsum("...f,fd->...d", jax.nn.silu(gate) * up,
+                      lp["down_proj"]["kernel"])
+
+
+def _unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("...d,vd->...v", x, params["embed"]["embedding"])
+    else:
+        logits = jnp.einsum("...d,dv->...v", x, params["lm_head"]["kernel"])
+    return logits.astype(jnp.float32)
+
+
+def prefill_forward(params: Params, cfg: ModelConfig,
+                    tokens: jax.Array,        # [B, S] suffix token ids
+                    positions: jax.Array,     # [B, S] absolute positions
+                    kv_pages: jax.Array,      # [L, 2, P, ps, n_kv, hd]
+                    page_table: jax.Array,    # [B, max_pages]
+                    prefix_lens: jax.Array,   # [B] cached-prefix lengths
+                    seq_lens: jax.Array,      # [B] valid suffix lengths
+                    ) -> tuple[jax.Array, jax.Array]:
+    """Returns (last-token logits [B, V], updated kv_pages)."""
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    use_prefix = True
+
+    def layer(x, inputs):
+        lp, kv = inputs
+        h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
+        q, k, v = _project_qkv(lp, h, cfg, positions)
+        k_pages, v_pages = kv[0], kv[1]
+        k_pages, v_pages = write_prefill_kv(k_pages, v_pages, k, v,
+                                            page_table, prefix_lens)
+        attn = prefill_attention(q, k, v,
+                                 k_pages if use_prefix else None,
+                                 v_pages if use_prefix else None,
+                                 page_table, prefix_lens, seq_lens)
+        attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
+        x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
+        h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+        x = x + _mlp(lp, h2)
+        return x, jnp.stack([k_pages, v_pages])
+
+    x, new_kv = jax.lax.scan(layer, x, (params["layers"], kv_pages))
+    # Last valid token's hidden state per row.
+    idx = jnp.maximum(seq_lens - 1, 0)
+    last = x[jnp.arange(x.shape[0]), idx]
+    return _unembed(params, cfg, last), new_kv
+
+
+def decode_forward(params: Params, cfg: ModelConfig,
+                   tokens: jax.Array,         # [B] last sampled tokens
+                   positions: jax.Array,      # [B] their absolute positions
+                   kv_pages: jax.Array,       # [L, 2, P, ps, n_kv, hd]
+                   page_table: jax.Array,     # [B, max_pages]
+                   context_lens: jax.Array,   # [B] lens INCLUDING new token
+                   ) -> tuple[jax.Array, jax.Array]:
+    """One decode step. Returns (logits [B, V], updated kv_pages)."""
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)   # [B, D]
+
+    def layer(x, inputs):
+        lp, kv = inputs
+        h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
+        q, k, v = _project_qkv(lp, h, cfg, positions)             # [B, H, hd]
+        k_pages, v_pages = kv[0], kv[1]
+        k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
+                                           page_table, positions)
+        attn = paged_attention_xla(q, k_pages, v_pages, page_table,
+                                   context_lens)
+        attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
+        x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
+        h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+        x = x + _mlp(lp, h2)
+        return x, jnp.stack([k_pages, v_pages])
+
+    x, new_kv = jax.lax.scan(layer, x, (params["layers"], kv_pages))
+    return _unembed(params, cfg, x), new_kv
+
+
+register_model_family(ModelFamily(
+    name="llama",
+    init_params=init_params,
+    prefill_forward=prefill_forward,
+    decode_forward=decode_forward,
+    sharding_rules=LLAMA_STACKED_RULES,
+))

@@ -1,0 +1,174 @@
+"""Attention primitives for the paged-KV engine.
+
+Layouts:
+- KV pool (per layer): ``k_pages/v_pages: [num_pages, page_size, n_kv, hd]``
+  (stacked over layers by the engine: leading ``L`` dim).
+- ``page_tables: [B, max_pages]`` int32 — page ids per sequence, in order.
+- ``context_lens: [B]`` int32 — tokens currently in cache per sequence.
+
+Numerics: matmuls in model dtype (bf16 on TPU), softmax in f32.
+
+The XLA paged-attention path below is the portable implementation (runs on
+CPU test meshes and compiles well on TPU); `ops/pallas_paged_attention.py`
+provides the hand-written TPU kernel and the engine selects per backend.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    return ((x * jax.lax.rsqrt(var + eps)) * scale.astype(jnp.float32)).astype(dtype)
+
+
+def rope_cos_sin(positions: jax.Array, head_dim: int,
+                 theta: float) -> tuple[jax.Array, jax.Array]:
+    """positions [...] -> cos/sin [..., head_dim//2] in f32."""
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [..., n_heads, head_dim]; positions broadcastable to x.shape[:-2]."""
+    hd = x.shape[-1]
+    cos, sin = rope_cos_sin(positions, hd, theta)      # [..., hd/2]
+    cos = cos[..., None, :]                            # broadcast over heads
+    sin = sin[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _repeat_kv(kv: jax.Array, n_rep: int) -> jax.Array:
+    """GQA: repeat kv heads to match query heads. kv [..., n_kv, hd]."""
+    if n_rep == 1:
+        return kv
+    return jnp.repeat(kv, n_rep, axis=-2)
+
+
+# --------------------------------------------------------------- KV writes
+def write_prefill_kv(k_pages: jax.Array, v_pages: jax.Array,
+                     k: jax.Array, v: jax.Array,
+                     page_table: jax.Array, prefix_lens: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Scatter a prefill suffix's K/V into the paged pool.
+
+    k/v: [B, S, n_kv, hd] — token j of row b lands at absolute position
+    prefix_lens[b] + j (prefix blocks already cached are skipped).
+    """
+    B, S = k.shape[0], k.shape[1]
+    page_size = k_pages.shape[1]
+    pos = prefix_lens[:, None] + jnp.arange(S)[None, :]          # [B, S]
+    page_idx = jnp.take_along_axis(page_table, pos // page_size, axis=1)
+    slot = pos % page_size
+    b_flat = page_idx.reshape(-1)
+    s_flat = slot.reshape(-1)
+    k_pages = k_pages.at[b_flat, s_flat].set(
+        k.reshape(B * S, *k.shape[2:]), mode="drop")
+    v_pages = v_pages.at[b_flat, s_flat].set(
+        v.reshape(B * S, *v.shape[2:]), mode="drop")
+    return k_pages, v_pages
+
+
+def write_decode_kv(k_pages: jax.Array, v_pages: jax.Array,
+                    k: jax.Array, v: jax.Array,
+                    page_table: jax.Array, context_lens: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Append one token's K/V per sequence. k/v: [B, n_kv, hd]; the new token
+    occupies position context_lens[b]."""
+    page_size = k_pages.shape[1]
+    B = k.shape[0]
+    page_idx = jnp.take_along_axis(
+        page_table, (context_lens // page_size)[:, None], axis=1)[:, 0]
+    slot = context_lens % page_size
+    k_pages = k_pages.at[page_idx, slot].set(k, mode="drop")
+    v_pages = v_pages.at[page_idx, slot].set(v, mode="drop")
+    return k_pages, v_pages
+
+
+# ----------------------------------------------------------- prefill attn
+def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """[num_pages, ps, n_kv, hd] x [B, max_pages] -> [B, max_pages*ps, n_kv, hd]."""
+    g = pages[page_table]                     # [B, max_pages, ps, n_kv, hd]
+    B, mp, ps = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape(B, mp * ps, *g.shape[3:])
+
+
+def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      k_pages: jax.Array, v_pages: jax.Array,
+                      page_table: jax.Array,
+                      prefix_lens: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    """Causal attention for a (possibly prefix-cached) prefill chunk.
+
+    q/k/v: [B, S, n(_kv), hd] for the *suffix* being prefilled; queries also
+    attend to the cached prefix (first prefix_lens[b] tokens) read from the
+    paged pool. seq_lens[b] = valid suffix length (padding masked out).
+    Returns [B, S, n_heads, hd].
+    """
+    B, S, n_heads, hd = q.shape
+    n_kv = k.shape[2]
+    n_rep = n_heads // n_kv
+    scale = 1.0 / (hd ** 0.5)
+
+    kf = _repeat_kv(k, n_rep).astype(jnp.float32)
+    vf = _repeat_kv(v, n_rep).astype(jnp.float32)
+    qf = q.astype(jnp.float32) * scale
+
+    # Suffix-suffix scores, causal + padding mask.
+    ss = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    rows = jnp.arange(S)[None, :, None]
+    cols = jnp.arange(S)[None, None, :]
+    mask = (cols <= rows) & (cols < seq_lens[:, None, None])
+    ss = jnp.where(mask[:, None, :, :], ss, _NEG_INF)
+
+    has_prefix = k_pages is not None
+    if has_prefix:
+        pk = _repeat_kv(gather_pages(k_pages, page_table), n_rep).astype(jnp.float32)
+        pv = _repeat_kv(gather_pages(v_pages, page_table), n_rep).astype(jnp.float32)
+        T = pk.shape[1]
+        ps_scores = jnp.einsum("bqhd,bkhd->bhqk", qf, pk)
+        pmask = (jnp.arange(T)[None, :] < prefix_lens[:, None])  # [B, T]
+        ps_scores = jnp.where(pmask[:, None, None, :], ps_scores, _NEG_INF)
+        scores = jnp.concatenate([ps_scores, ss], axis=-1)
+        values = jnp.concatenate([pv, vf], axis=1)
+    else:
+        scores = ss
+        values = vf
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, values)
+    return out.astype(q.dtype)
+
+
+# ------------------------------------------------------------ decode attn
+def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                        page_table: jax.Array,
+                        context_lens: jax.Array) -> jax.Array:
+    """One-token-per-sequence paged attention (XLA path).
+
+    q: [B, n_heads, hd]; returns [B, n_heads, hd]. Assumes the new token's
+    K/V are already written (attends to positions < context_lens[b] + 1 ...
+    callers pass context_lens *including* the new token).
+    """
+    B, n_heads, hd = q.shape
+    n_kv = k_pages.shape[2]
+    n_rep = n_heads // n_kv
+    scale = 1.0 / (hd ** 0.5)
+
+    k = _repeat_kv(gather_pages(k_pages, page_table), n_rep)  # [B, T, H, hd]
+    v = _repeat_kv(gather_pages(v_pages, page_table), n_rep)
+    T = k.shape[1]
+    qf = q.astype(jnp.float32) * scale
+    scores = jnp.einsum("bhd,bkhd->bhk", qf, k.astype(jnp.float32))
+    mask = jnp.arange(T)[None, :] < context_lens[:, None]
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,21 @@
+"""Mesh + sharding utilities for the TPU engine plane.
+
+The reference's engine (empty submodule) ran TP/DP/EP via HCCL collectives;
+here parallelism is expressed as a `jax.sharding.Mesh` with named axes and
+GSPMD shardings — XLA inserts the collectives over ICI (SURVEY.md §2.12).
+"""
+
+from .mesh import MeshConfig, build_mesh, axis_size
+from .sharding import (
+    ShardingRules,
+    LLAMA_RULES,
+    MOE_RULES,
+    named_sharding,
+    shard_params,
+)
+
+__all__ = [
+    "MeshConfig", "build_mesh", "axis_size",
+    "ShardingRules", "LLAMA_RULES", "MOE_RULES",
+    "named_sharding", "shard_params",
+]

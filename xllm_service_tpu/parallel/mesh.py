@@ -1,0 +1,68 @@
+"""Device-mesh construction.
+
+Axis vocabulary (fixed across the framework):
+- ``data``   — data parallel (replica) axis; maps to the reference's
+  `dp_size` in InstanceMetaInfo (`xllm_rpc_service.proto:40-43`).
+- ``expert`` — expert parallel axis for MoE decode (BASELINE config 4).
+- ``seq``    — sequence/context parallel axis (ring attention, §5.7).
+- ``model``  — tensor parallel axis (heads / ffn sharding).
+
+A serving instance owns one mesh over its TPU sub-slice; the mesh shape and
+axis names are advertised in TpuTopology so the scheduler can place roles
+topology-aware (common/types.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_EXPERT = "expert"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+ALL_AXES = (AXIS_DATA, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+
+
+@dataclass
+class MeshConfig:
+    data: int = 1
+    expert: int = 1
+    seq: int = 1
+    model: int = 1
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.data, self.expert, self.seq, self.model)
+
+    def num_devices(self) -> int:
+        return int(np.prod(self.shape))
+
+    @classmethod
+    def for_devices(cls, n: int, tp: Optional[int] = None) -> "MeshConfig":
+        """Default layout: all devices on the model (TP) axis unless told
+        otherwise — serving decode is latency-bound, and TP over ICI is the
+        latency-optimal first choice (scaling-book recipe)."""
+        tp = tp or n
+        assert n % tp == 0, f"{n} devices not divisible by tp={tp}"
+        return cls(data=n // tp, model=tp)
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig.for_devices(len(devices))
+    if config.num_devices() != len(devices):
+        raise ValueError(
+            f"mesh {config.shape} needs {config.num_devices()} devices, "
+            f"got {len(devices)}")
+    arr = np.array(devices).reshape(config.shape)
+    return Mesh(arr, ALL_AXES)
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]

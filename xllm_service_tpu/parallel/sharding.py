@@ -1,0 +1,93 @@
+"""Parameter/activation sharding rules (GSPMD).
+
+Rules map parameter-path regexes → PartitionSpecs over the named mesh axes.
+XLA inserts the collectives (psum after row-parallel matmuls, all-gather
+where needed) — we only annotate layouts (scaling-book recipe: pick a mesh,
+annotate shardings, let XLA insert collectives).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DATA, AXIS_EXPERT, AXIS_MODEL
+
+
+@dataclass
+class ShardingRules:
+    """Ordered (path_regex, PartitionSpec) table; first match wins."""
+
+    rules: list[tuple[str, P]]
+    default: P = P()
+
+    def spec_for(self, path: str) -> P:
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                return spec
+        return self.default
+
+
+# Llama/Qwen family: column-parallel qkv/gate/up (shard output dim on
+# `model`), row-parallel o/down (shard input dim on `model` — XLA emits the
+# psum), vocab-sharded embeddings.
+LLAMA_RULES = ShardingRules(rules=[
+    (r"embed/embedding", P(AXIS_MODEL, None)),          # [vocab, d]
+    (r"(q_proj|k_proj|v_proj)/kernel", P(None, AXIS_MODEL)),   # [d, heads*hd]
+    (r"(q_proj|k_proj|v_proj)/bias", P(AXIS_MODEL)),
+    (r"o_proj/kernel", P(AXIS_MODEL, None)),            # [heads*hd, d]
+    (r"(gate_proj|up_proj)/kernel", P(None, AXIS_MODEL)),      # [d, ffn]
+    (r"down_proj/kernel", P(AXIS_MODEL, None)),         # [ffn, d]
+    (r"lm_head/kernel", P(None, AXIS_MODEL)),           # [d, vocab]
+    (r"(input_norm|post_attn_norm|final_norm)/scale", P()),
+])
+
+# MoE family adds expert-stacked tensors: leading expert dim on `expert`,
+# per-expert ffn on `model`.
+MOE_RULES = ShardingRules(rules=[
+    (r"experts/(gate_proj|up_proj)/kernel", P(AXIS_EXPERT, None, AXIS_MODEL)),
+    (r"experts/down_proj/kernel", P(AXIS_EXPERT, AXIS_MODEL, None)),
+    (r"(shared_expert|shared)/(gate_proj|up_proj)/kernel", P(None, AXIS_MODEL)),
+    (r"(shared_expert|shared)/down_proj/kernel", P(AXIS_MODEL, None)),
+    (r"router/kernel", P()),
+    *LLAMA_RULES.rules,
+])
+
+# KV pages: [layers, pages, page_size, kv_heads, head_dim] — kv heads on
+# `model` (must divide), pages replicated within an instance.
+KV_PAGES_SPEC = P(None, None, None, AXIS_MODEL, None)
+# Decode activations: batch on `data`.
+BATCH_SPEC = P(AXIS_DATA)
+
+
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def _flatten_path(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def tree_specs(params: Any, rules: ShardingRules) -> Any:
+    """PartitionSpec pytree matching `params` by path."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: rules.spec_for(_flatten_path(path)), params)
+
+
+def shard_params(params: Any, mesh: Mesh, rules: ShardingRules) -> Any:
+    """Device-put a param pytree with rule-derived shardings."""
+    specs = tree_specs(params, rules)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
