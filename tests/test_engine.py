@@ -1,0 +1,265 @@
+"""Engine tests: greedy correctness vs a naive reference loop, continuous
+batching, prefix-cache reuse, cancellation, page accounting."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common.request import RequestOutput, SamplingParams
+from xllm_service_tpu.engine.config import EngineConfig
+from xllm_service_tpu.engine.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.engine.kv_cache import KVPageManager
+from xllm_service_tpu.models.base import tiny_config
+
+
+def make_engine(**kw) -> InferenceEngine:
+    cfg = EngineConfig(
+        model=tiny_config(dtype=jnp.float32, max_context_len=256),
+        num_pages=kw.pop("num_pages", 64), page_size=16,
+        hash_block_size=32,
+        max_batch_size=kw.pop("max_batch_size", 4),
+        max_seq_len=256, prefill_buckets=(32, 64, 256), **kw)
+    return InferenceEngine(cfg)
+
+
+class Collector:
+    def __init__(self):
+        self.outputs: list[RequestOutput] = []
+        self.done = threading.Event()
+
+    def __call__(self, out: RequestOutput) -> None:
+        self.outputs.append(out)
+        if out.finished:
+            self.done.set()
+
+    @property
+    def tokens(self):
+        return [t for o in self.outputs for s in o.outputs for t in s.token_ids]
+
+    @property
+    def text(self):
+        return "".join(s.text for o in self.outputs for s in o.outputs)
+
+    @property
+    def finish_reason(self):
+        for o in self.outputs:
+            for s in o.outputs:
+                if s.finish_reason:
+                    return s.finish_reason
+        return ""
+
+
+def run_requests(engine, reqs, timeout=60):
+    for r in reqs:
+        engine.submit(r)
+    while any(not r.on_output.done.is_set() for r in reqs):
+        if not engine.step():
+            time.sleep(0.001)
+
+
+def naive_greedy(engine: InferenceEngine, prompt: list[int], n: int) -> list[int]:
+    """Reference loop: full dense prefill each step, argmax."""
+    from xllm_service_tpu.engine.kv_cache import GARBAGE_PAGE
+
+    cfg = engine.cfg
+    fam, mcfg = engine.family, cfg.model
+    out = []
+    toks = list(prompt)
+    for _ in range(n):
+        S = len(toks)
+        kv = jnp.zeros_like(engine.kv_pages)
+        pt = jnp.arange(1, cfg.pages_per_seq + 1, dtype=jnp.int32)[None, :]
+        logits, _ = fam.prefill_forward(
+            engine.params, mcfg, jnp.asarray([toks], jnp.int32),
+            jnp.arange(S)[None, :], kv, pt,
+            jnp.zeros((1,), jnp.int32), jnp.asarray([S], jnp.int32))
+        nxt = int(jnp.argmax(logits[0]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+class TestEngineCorrectness:
+    def test_greedy_matches_naive_loop(self):
+        engine = make_engine()
+        prompt = list(range(10, 30))
+        want = naive_greedy(engine, prompt, 8)
+        col = Collector()
+        req = EngineRequest("s1", "r1", token_ids=prompt,
+                            sampling=SamplingParams(max_tokens=8,
+                                                    temperature=0.0,
+                                                    ignore_eos=True),
+                            on_output=col)
+        run_requests(engine, [req])
+        assert col.tokens == want
+        assert col.finish_reason == "length"
+        usage = [o.usage for o in col.outputs if o.usage]
+        assert usage[0].num_prompt_tokens == 20
+        assert usage[0].num_generated_tokens == 8
+
+    def test_batched_equals_solo(self):
+        """Concurrent greedy sequences must not perturb each other."""
+        engine = make_engine()
+        prompts = [list(range(5, 20)), list(range(40, 70)),
+                   list(range(100, 140))]
+        want = [naive_greedy(engine, p, 6) for p in prompts]
+        cols = [Collector() for _ in prompts]
+        reqs = [EngineRequest(f"s{i}", f"r{i}", token_ids=p,
+                              sampling=SamplingParams(max_tokens=6,
+                                                      temperature=0.0,
+                                                      ignore_eos=True),
+                              on_output=c)
+                for i, (p, c) in enumerate(zip(prompts, cols))]
+        run_requests(engine, reqs)
+        for c, w in zip(cols, want):
+            assert c.tokens == w
+
+    def test_queueing_beyond_batch_size(self):
+        engine = make_engine(max_batch_size=2)
+        cols = [Collector() for _ in range(5)]
+        reqs = [EngineRequest(f"s{i}", token_ids=list(range(3 + i, 20 + i)),
+                              sampling=SamplingParams(max_tokens=4,
+                                                      temperature=0.0,
+                                                      ignore_eos=True),
+                              on_output=c)
+                for i, c in enumerate(cols)]
+        run_requests(engine, reqs)
+        for c in cols:
+            assert c.finish_reason == "length"
+            assert len(c.tokens) == 4
+        # All slots and pages returned.
+        assert len(engine._running) == 0
+        assert engine.page_mgr.usage_perc() <= \
+            engine.page_mgr.pages_per_block * 6 / (engine.cfg.num_pages - 1)
+
+    def test_prefix_cache_reuse_same_output(self):
+        engine = make_engine()
+        prompt = list(range(1, 65))   # 64 tokens = 2 hash blocks of 32
+        col1 = Collector()
+        run_requests(engine, [EngineRequest(
+            "a", token_ids=prompt,
+            sampling=SamplingParams(max_tokens=5, temperature=0.0,
+                                    ignore_eos=True), on_output=col1)])
+        assert engine.page_mgr.cached_block_count() >= 1
+        ev = engine.drain_kv_events()
+        assert ev.stored   # blocks advertised for global cache index
+        col2 = Collector()
+        run_requests(engine, [EngineRequest(
+            "b", token_ids=prompt,
+            sampling=SamplingParams(max_tokens=5, temperature=0.0,
+                                    ignore_eos=True), on_output=col2)])
+        assert col2.tokens == col1.tokens
+
+    def test_seeded_sampling_deterministic(self):
+        engine = make_engine()
+        prompt = list(range(50, 80))
+        sp = SamplingParams(max_tokens=6, temperature=0.8, top_k=20,
+                            seed=42, ignore_eos=True)
+        cols = [Collector(), Collector()]
+        for c in cols:
+            run_requests(engine, [EngineRequest(
+                f"s-{id(c)}", token_ids=prompt, sampling=sp, on_output=c)])
+        assert cols[0].tokens == cols[1].tokens
+
+    def test_logprobs_emitted(self):
+        engine = make_engine()
+        col = Collector()
+        run_requests(engine, [EngineRequest(
+            "lp", token_ids=list(range(12)),
+            sampling=SamplingParams(max_tokens=3, temperature=0.0,
+                                    logprobs=True, top_logprobs=3,
+                                    ignore_eos=True),
+            on_output=col)])
+        lps = [lp for o in col.outputs for s in o.outputs for lp in s.logprobs]
+        assert len(lps) == 3
+        assert all(len(lp.top_logprobs) == 3 for lp in lps)
+        assert all(lp.logprob <= 0 for lp in lps)
+        # Greedy chosen token must be the argmax == first top logprob.
+        assert lps[0].token_id == lps[0].top_logprobs[0].token_id
+
+    def test_cancellation(self):
+        engine = make_engine()
+        col = Collector()
+        engine.submit(EngineRequest(
+            "c1", token_ids=list(range(20)),
+            sampling=SamplingParams(max_tokens=200, temperature=0.0,
+                                    ignore_eos=True),
+            on_output=col))
+        for _ in range(3):
+            engine.step()
+        engine.cancel("c1")
+        for _ in range(5):
+            engine.step()
+        assert col.done.is_set()
+        assert len(engine._running) == 0
+
+    def test_stop_token_ids(self):
+        engine = make_engine()
+        prompt = list(range(10, 26))
+        first = naive_greedy(engine, prompt, 1)[0]
+        col = Collector()
+        run_requests(engine, [EngineRequest(
+            "st", token_ids=prompt,
+            sampling=SamplingParams(max_tokens=10, temperature=0.0,
+                                    stop_token_ids=[first], ignore_eos=True),
+            on_output=col)])
+        assert col.finish_reason == "stop"
+        assert len(col.tokens) == 1
+
+    def test_prompt_too_long_rejected(self):
+        engine = make_engine()
+        col = Collector()
+        engine.submit(EngineRequest(
+            "big", token_ids=list(range(300)),
+            sampling=SamplingParams(max_tokens=5), on_output=col))
+        assert col.done.is_set()
+        assert not col.outputs[0].status.ok()
+
+
+class TestKVPageManager:
+    def test_alloc_free(self):
+        mgr = KVPageManager(num_pages=9, page_size=16, hash_block_size=32)
+        a = mgr.allocate(4)
+        assert len(a) == 4 and 0 not in a   # garbage page never allocated
+        assert mgr.allocate(5) is None      # only 4 left
+        b = mgr.allocate(4)
+        assert len(b) == 4 and not (set(a) & set(b))
+        mgr.free(a)
+        assert mgr.num_free == 4
+
+    def test_prefix_cache_lifecycle(self):
+        mgr = KVPageManager(num_pages=17, page_size=16, hash_block_size=32)
+        toks = list(range(64))          # 2 blocks
+        pages = mgr.allocate(4)
+        stored, donated = mgr.store_prefix(toks, pages)
+        assert len(stored) == 2 and donated == set(pages)
+        ev = mgr.drain_events()
+        assert len(ev.stored) == 2
+        # Match takes references.
+        n, mpages, hashes = mgr.match_prefix(toks + [999])
+        assert n == 64 and mpages == pages
+        # Referenced blocks cannot be evicted.
+        assert mgr.allocate(14) is None
+        mgr.release_prefix(hashes)
+        mgr.release_prefix(stored)
+        # Now eviction can reclaim cached pages — lazily, oldest first:
+        # 12 free + one evicted block (2 pages) covers the request.
+        assert mgr.allocate(14) is not None
+        ev = mgr.drain_events()
+        assert len(ev.removed) == 1
+        assert mgr.cached_block_count() == 1
+
+    def test_partial_match_after_divergence(self):
+        mgr = KVPageManager(num_pages=17, page_size=16, hash_block_size=32)
+        toks = list(range(64))
+        pages = mgr.allocate(4)
+        stored, _ = mgr.store_prefix(toks, pages)
+        other = toks[:32] + [7777] * 32
+        n, mpages, hashes = mgr.match_prefix(other)
+        assert n == 32 and mpages == pages[:2]
+        mgr.release_prefix(hashes)
+        mgr.release_prefix(stored)

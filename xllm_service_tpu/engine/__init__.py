@@ -1,0 +1,13 @@
+"""L0 engine plane: the TPU-native inference runtime.
+
+Replaces the reference's empty `third_party/xllm` engine (SURVEY.md §0, §7):
+continuous batching over a paged KV cache in HBM, prefill and decode as
+separately compiled jit programs on a `jax.sharding.Mesh`, PREFILL/DECODE/
+MIX roles with live flips, block-hash prefix caching feeding the global
+cache index, and an agent speaking the orchestration wire contract.
+"""
+
+from .config import EngineConfig
+from .engine import InferenceEngine
+
+__all__ = ["EngineConfig", "InferenceEngine"]

@@ -1,0 +1,51 @@
+"""Engine runtime configuration."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..common.types import InstanceType
+from ..models.base import ModelConfig, tiny_config
+from ..parallel.mesh import MeshConfig
+
+
+@dataclass
+class EngineConfig:
+    model_id: str = "tiny-llama"
+    model_family: str = "llama"
+    model: ModelConfig = field(default_factory=tiny_config)
+    mesh: Optional[MeshConfig] = None      # None = all local devices on TP
+    role: InstanceType = InstanceType.MIX
+    # KV pool. Page 0 is reserved as the garbage page (inactive batch slots
+    # write there), so usable pages = num_pages - 1.
+    num_pages: int = 256
+    page_size: int = 16
+    # Prefix-cache block size for global-index hashing (must match the
+    # service's block_size, reference `global_gflags.cpp:114-116`).
+    hash_block_size: int = 128
+    # Batching.
+    max_batch_size: int = 8
+    max_seq_len: int = 2048
+    prefill_buckets: tuple[int, ...] = (128, 512, 2048)
+    # Sampling.
+    max_top_logprobs: int = 5
+    seed: int = 0
+
+    @property
+    def pages_per_seq(self) -> int:
+        return self.max_seq_len // self.page_size
+
+    def validate(self) -> None:
+        if self.max_seq_len % self.page_size:
+            raise ValueError("max_seq_len must be a multiple of page_size")
+        if self.hash_block_size % self.page_size:
+            raise ValueError("hash_block_size must be a multiple of page_size")
+        if self.max_seq_len > self.model.max_context_len:
+            raise ValueError("max_seq_len exceeds model max_context_len")
+        if not all(b % self.page_size == 0 for b in self.prefill_buckets):
+            raise ValueError("prefill buckets must be page-aligned")
+        if self.prefill_buckets != tuple(sorted(self.prefill_buckets)):
+            raise ValueError("prefill buckets must be ascending")
+        if self.prefill_buckets[-1] < self.max_seq_len:
+            raise ValueError("largest prefill bucket must cover max_seq_len")

@@ -1,0 +1,556 @@
+"""Continuous-batching inference engine.
+
+The TPU replacement for the reference's CUDA/Ascend engine decode loop
+(BASELINE north star: "paged-attention and continuous-batching decode loop
+become Pallas/XLA"). Design points for XLA:
+
+- **Two compiled programs**: prefill (one per length bucket) and decode
+  (one, fixed max_batch_size). Static shapes everywhere; per-request
+  variability (lengths, sampling params, active slots) is data, not shape.
+- **Paged KV pool** `[L, 2, pages, page_size, n_kv, hd]` lives on device and
+  is donated through every step (XLA updates in place).
+- **Admission control**: pages for prompt + max_new_tokens are reserved at
+  admission, so decode never OOMs mid-flight.
+- **Prefix cache**: longest block-aligned cached prefix is reused (pages
+  shared, suffix-only prefill); completed blocks are donated back and
+  reported as KvCacheEvents (feeds cluster-wide cache-aware routing).
+- Inactive batch slots write K/V to the reserved garbage page 0.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.request import (
+    LogProb,
+    LogProbData,
+    RequestOutput,
+    SamplingParams,
+    SequenceOutput,
+    Status,
+    StatusCode,
+    Usage,
+)
+from ..common.types import KvCacheEvent
+from ..models.base import get_model_family
+from ..parallel.mesh import build_mesh
+from ..parallel.sharding import shard_params
+from ..tokenizer.base import Tokenizer
+from ..tokenizer.simple import SimpleTokenizer
+from ..utils import get_logger
+from .config import EngineConfig
+from .kv_cache import GARBAGE_PAGE, KVPageManager, SequencePages
+from .sampling import SamplingState, record_tokens, sample_tokens
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class EngineRequest:
+    service_request_id: str
+    request_id: str = ""
+    token_ids: list[int] = field(default_factory=list)
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    # Called from the engine thread with each RequestOutput delta.
+    on_output: Callable[[RequestOutput], None] = lambda out: None
+
+
+@dataclass
+class _Sequence:
+    req: EngineRequest
+    pages: SequencePages
+    slot: int = -1
+    context_len: int = 0          # tokens whose KV is in the cache
+    prompt_len: int = 0
+    output_ids: list[int] = field(default_factory=list)
+    slot_key: Any = None
+    emitted_chars: int = 0
+    max_total_len: int = 0
+    finished: bool = False
+    cancelled: bool = False
+    logprobs: list[LogProb] = field(default_factory=list)
+
+
+class InferenceEngine:
+    def __init__(self, cfg: EngineConfig, mesh=None,
+                 tokenizer: Optional[Tokenizer] = None,
+                 eos_token_id: Optional[int] = None):
+        cfg.validate()
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else build_mesh(
+            cfg.mesh) if cfg.mesh else None
+        self.tokenizer = tokenizer or SimpleTokenizer()
+        self.eos_token_id = eos_token_id if eos_token_id is not None else \
+            getattr(self.tokenizer, "eos_id", None)
+        self.family = get_model_family(cfg.model_family)
+        mcfg = cfg.model
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        params = self.family.init_params(mcfg, rng)
+        if self.mesh is not None:
+            params = shard_params(params, self.mesh,
+                                  self.family.sharding_rules)
+        self.params = params
+        self.kv_pages = jnp.zeros(
+            (mcfg.num_layers, 2, cfg.num_pages, cfg.page_size,
+             mcfg.num_kv_heads, mcfg.head_dim), mcfg.dtype)
+        self.page_mgr = KVPageManager(cfg.num_pages, cfg.page_size,
+                                      cfg.hash_block_size)
+
+        B = cfg.max_batch_size
+        self._sampling = SamplingState.init(B, mcfg.vocab_size)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+        # Per-slot sampling keys (seeded requests pin their own).
+        self._slot_keys = jnp.zeros((B, 2), jnp.uint32)
+
+        # Host-side batch state.
+        self._page_tables = np.full((B, cfg.pages_per_seq), GARBAGE_PAGE,
+                                    np.int32)
+        self._last_tokens = np.zeros((B,), np.int32)
+        self._context_lens = np.zeros((B,), np.int32)   # incl. pending token
+        self._active = np.zeros((B,), bool)
+
+        self._waiting: deque[EngineRequest] = deque()
+        self._running: dict[int, _Sequence] = {}
+        self._free_slots = list(range(B - 1, -1, -1))
+        self._lock = threading.Condition()
+        self._cancelled: set[str] = set()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        self._build_programs()
+        # Telemetry for heartbeats (reference LatencyMetrics).
+        self.recent_max_ttft_ms = 0.0
+        self.recent_max_tbt_ms = 0.0
+        self.total_generated = 0
+
+    # -------------------------------------------------------- jit programs
+    def _build_programs(self) -> None:
+        cfg, mcfg, fam = self.cfg, self.cfg.model, self.family
+
+        def decode_step(params, kv_pages, token_counts, tokens, positions,
+                        page_tables, context_lens, temperature, top_k, top_p,
+                        freq_pen, pres_pen, rep_pen, active, keys):
+            logits, kv_pages = fam.decode_forward(
+                params, mcfg, tokens, positions, kv_pages, page_tables,
+                context_lens)
+            st = SamplingState(temperature, top_k, top_p, freq_pen, pres_pen,
+                               rep_pen, token_counts)
+            new_tokens, logprobs = sample_tokens(logits, st, keys,
+                                                 context_lens)
+            token_counts = record_tokens(token_counts, new_tokens, active)
+            chosen_lp = jnp.take_along_axis(
+                logprobs, new_tokens[:, None], axis=-1)[:, 0]
+            top_vals, top_ids = jax.lax.top_k(logprobs, cfg.max_top_logprobs)
+            return new_tokens, chosen_lp, top_vals, top_ids, kv_pages, token_counts
+
+        self._decode_step = jax.jit(decode_step, donate_argnums=(1, 2))
+
+        def prefill_step(params, kv_pages, tokens, positions, page_table,
+                         prefix_len, seq_len, temperature, top_k, top_p,
+                         freq_pen, pres_pen, rep_pen, token_counts_row, keys,
+                         steps):
+            logits, kv_pages = fam.prefill_forward(
+                params, mcfg, tokens, positions, kv_pages, page_table,
+                prefix_len, seq_len)
+            st = SamplingState(temperature, top_k, top_p, freq_pen, pres_pen,
+                               rep_pen, token_counts_row)
+            new_tokens, logprobs = sample_tokens(logits, st, keys, steps)
+            chosen_lp = jnp.take_along_axis(
+                logprobs, new_tokens[:, None], axis=-1)[:, 0]
+            top_vals, top_ids = jax.lax.top_k(logprobs, cfg.max_top_logprobs)
+            return new_tokens, chosen_lp, top_vals, top_ids, kv_pages
+
+        self._prefill_step = jax.jit(prefill_step, donate_argnums=(1,))
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "InferenceEngine":
+        self._thread = threading.Thread(target=self._loop, name="engine-loop",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._lock:
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # ---------------------------------------------------------------- API
+    def submit(self, req: EngineRequest) -> None:
+        if not req.token_ids:
+            req.on_output(RequestOutput(
+                service_request_id=req.service_request_id,
+                request_id=req.request_id,
+                status=Status(StatusCode.INVALID_ARGUMENT, "empty prompt"),
+                finished=True))
+            return
+        if len(req.token_ids) >= self.cfg.max_seq_len:
+            req.on_output(RequestOutput(
+                service_request_id=req.service_request_id,
+                request_id=req.request_id,
+                status=Status(StatusCode.INVALID_ARGUMENT,
+                              f"prompt length {len(req.token_ids)} exceeds "
+                              f"max_seq_len {self.cfg.max_seq_len}"),
+                finished=True))
+            return
+        with self._lock:
+            self._waiting.append(req)
+            self._lock.notify_all()
+
+    def cancel(self, service_request_id: str) -> None:
+        with self._lock:
+            self._cancelled.add(service_request_id)
+            self._lock.notify_all()
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "waiting": len(self._waiting),
+                "running": len(self._running),
+                "kv_usage_perc": self.page_mgr.usage_perc(),
+                "cached_blocks": self.page_mgr.cached_block_count(),
+                "total_generated": self.total_generated,
+            }
+
+    def drain_kv_events(self) -> KvCacheEvent:
+        return self.page_mgr.drain_events()
+
+    # ------------------------------------------------------------- the loop
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            did_work = self.step()
+            if not did_work:
+                with self._lock:
+                    if not self._waiting and not self._running:
+                        self._lock.wait(timeout=0.05)
+
+    def step(self) -> bool:
+        """One engine iteration: process cancellations, admit, decode."""
+        self._process_cancellations()
+        admitted = self._admit()
+        decoded = self._decode()
+        return admitted or decoded
+
+    def _process_cancellations(self) -> None:
+        with self._lock:
+            cancelled = self._cancelled
+            self._cancelled = set()
+            if not cancelled:
+                return
+            kept: deque[EngineRequest] = deque()
+            victims: list[EngineRequest] = []
+            for r in self._waiting:
+                (victims if r.service_request_id in cancelled else kept).append(r)
+            self._waiting = kept
+        # Callbacks run outside the lock (they may do slow I/O).
+        for r in victims:
+            self._emit_cancelled(r)
+        for slot, seq in list(self._running.items()):
+            if seq.req.service_request_id in cancelled:
+                seq.cancelled = True
+                self._finish_sequence(seq, "abort", emit=True)
+
+    def _emit_cancelled(self, req: EngineRequest) -> bool:
+        req.on_output(RequestOutput(
+            service_request_id=req.service_request_id,
+            request_id=req.request_id,
+            status=Status(StatusCode.CANCELLED, "cancelled"), finished=True))
+        return True
+
+    # ------------------------------------------------------------ admission
+    def _admit(self) -> bool:
+        admitted = False
+        while True:
+            with self._lock:
+                if not self._waiting or not self._free_slots:
+                    return admitted
+                req = self._waiting.popleft()
+            if not self._start_sequence(req):
+                # Not enough KV pages: put it back and stop admitting.
+                with self._lock:
+                    self._waiting.appendleft(req)
+                return admitted
+            admitted = True
+
+    def _start_sequence(self, req: EngineRequest) -> bool:
+        cfg = self.cfg
+        prompt = req.token_ids
+        P0 = len(prompt)
+        max_new = max(1, min(req.sampling.max_tokens,
+                             cfg.max_seq_len - P0))
+        max_total = min(P0 + max_new, cfg.max_seq_len)
+
+        # Prefix-cache match (block-aligned; keep at least 1 suffix token so
+        # prefill produces the next-token logits).
+        matched, cached_pages, cached_hashes = \
+            self.page_mgr.match_prefix(prompt)
+        if matched >= P0:
+            drop = (matched - P0) // cfg.hash_block_size + 1
+            self.page_mgr.release_prefix(cached_hashes[-drop:])
+            cached_hashes = cached_hashes[:-drop]
+            matched = len(cached_hashes) * cfg.hash_block_size
+            cached_pages = cached_pages[:matched // cfg.page_size]
+
+        total_pages = -(-max_total // cfg.page_size)   # ceil
+        own_needed = total_pages - len(cached_pages)
+        own_pages = self.page_mgr.allocate(own_needed)
+        if own_pages is None:
+            self.page_mgr.release_prefix(cached_hashes)
+            return False
+
+        seq = _Sequence(
+            req=req,
+            pages=SequencePages(cached_hashes=cached_hashes,
+                                cached_pages=cached_pages,
+                                own_pages=own_pages),
+            prompt_len=P0, context_len=P0, max_total_len=max_total)
+
+        t0 = time.monotonic()
+        first_token, lp = self._run_prefill(seq, prompt, matched)
+        self.recent_max_ttft_ms = max(self.recent_max_ttft_ms,
+                                      (time.monotonic() - t0) * 1000)
+
+        # Donate completed prompt blocks to the prefix cache.
+        stored, donated = self.page_mgr.store_prefix(
+            prompt, seq.pages.all_pages,
+            skip_blocks=matched // cfg.hash_block_size)
+        seq.pages.donated_hashes = stored
+        seq.pages.donated_pages = donated
+
+        with self._lock:
+            slot = self._free_slots.pop()
+        seq.slot = slot
+        self._running[slot] = seq
+        self._install_slot(seq, first_token)
+        self._emit_token(seq, first_token, lp)
+        if not seq.finished:
+            self._maybe_finish(seq)
+        return True
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b:
+                return b
+        return self.cfg.prefill_buckets[-1]
+
+    def _run_prefill(self, seq: _Sequence, prompt: list[int],
+                     matched: int) -> tuple[int, LogProb]:
+        cfg = self.cfg
+        suffix = prompt[matched:]
+        S = self._bucket_for(len(suffix))
+        toks = np.zeros((1, S), np.int32)
+        toks[0, :len(suffix)] = suffix
+        positions = np.zeros((1, S), np.int32)
+        positions[0, :] = matched + np.arange(S)
+        page_table = np.full((1, cfg.pages_per_seq), GARBAGE_PAGE, np.int32)
+        all_pages = seq.pages.all_pages
+        page_table[0, :len(all_pages)] = all_pages
+
+        sp = seq.req.sampling
+        counts_row = np.zeros((1, cfg.model.vocab_size), np.int32)
+        binc = np.bincount(np.asarray(prompt, np.int64),
+                           minlength=cfg.model.vocab_size)
+        counts_row[0] = binc[:cfg.model.vocab_size]
+        self._rng, slot_key = jax.random.split(self._rng)
+        if sp.seed is not None:
+            slot_key = jax.random.PRNGKey(sp.seed)
+        seq.slot_key = slot_key
+
+        new_tok, chosen_lp, top_vals, top_ids, self.kv_pages = \
+            self._prefill_step(
+                self.params, self.kv_pages, jnp.asarray(toks),
+                jnp.asarray(positions), jnp.asarray(page_table),
+                jnp.asarray([matched], jnp.int32),
+                jnp.asarray([len(suffix)], jnp.int32),
+                jnp.asarray([sp.temperature], jnp.float32),
+                jnp.asarray([sp.top_k], jnp.int32),
+                jnp.asarray([sp.top_p], jnp.float32),
+                jnp.asarray([sp.frequency_penalty], jnp.float32),
+                jnp.asarray([sp.presence_penalty], jnp.float32),
+                jnp.asarray([sp.repetition_penalty], jnp.float32),
+                jnp.asarray(counts_row), slot_key[None, :],
+                jnp.asarray([len(prompt)], jnp.int32))
+        token = int(new_tok[0])
+        lp = self._make_logprob(token, float(chosen_lp[0]),
+                                np.asarray(top_vals[0]), np.asarray(top_ids[0]),
+                                seq.req.sampling)
+        return token, lp
+
+    def _install_slot(self, seq: _Sequence, first_token: int) -> None:
+        """Set up batch-slot state for decode."""
+        slot, cfg, sp = seq.slot, self.cfg, seq.req.sampling
+        self._page_tables[slot] = GARBAGE_PAGE
+        pages = seq.pages.all_pages
+        self._page_tables[slot, :len(pages)] = pages
+        self._last_tokens[slot] = first_token
+        self._context_lens[slot] = seq.context_len + 1  # incl. pending token
+        self._active[slot] = True
+
+        B = cfg.max_batch_size
+        idx = jnp.asarray([slot])
+        st = self._sampling
+        st.temperature = st.temperature.at[idx].set(sp.temperature)
+        st.top_k = st.top_k.at[idx].set(sp.top_k)
+        st.top_p = st.top_p.at[idx].set(sp.top_p)
+        st.frequency_penalty = st.frequency_penalty.at[idx].set(sp.frequency_penalty)
+        st.presence_penalty = st.presence_penalty.at[idx].set(sp.presence_penalty)
+        st.repetition_penalty = st.repetition_penalty.at[idx].set(
+            sp.repetition_penalty if sp.repetition_penalty > 0 else 1.0)
+        counts = np.bincount(
+            np.asarray(seq.req.token_ids + [first_token], np.int64),
+            minlength=self.cfg.model.vocab_size)[:self.cfg.model.vocab_size]
+        st.token_counts = st.token_counts.at[slot].set(
+            jnp.asarray(counts, jnp.int32))
+        self._slot_keys = self._slot_keys.at[slot].set(seq.slot_key)
+
+    # -------------------------------------------------------------- decode
+    def _decode(self) -> bool:
+        if not self._running:
+            return False
+        t0 = time.monotonic()
+        st = self._sampling
+        positions = self._context_lens - 1   # new token's position
+        new_tokens, chosen_lp, top_vals, top_ids, self.kv_pages, new_counts = \
+            self._decode_step(
+                self.params, self.kv_pages, st.token_counts,
+                jnp.asarray(self._last_tokens), jnp.asarray(positions),
+                jnp.asarray(self._page_tables),
+                jnp.asarray(self._context_lens),
+                st.temperature, st.top_k, st.top_p, st.frequency_penalty,
+                st.presence_penalty, st.repetition_penalty,
+                jnp.asarray(self._active), self._slot_keys)
+        st.token_counts = new_counts
+        new_tokens_np = np.asarray(new_tokens)
+        chosen_np = np.asarray(chosen_lp)
+        top_vals_np = np.asarray(top_vals)
+        top_ids_np = np.asarray(top_ids)
+
+        self.recent_max_tbt_ms = max(self.recent_max_tbt_ms,
+                                     (time.monotonic() - t0) * 1000)
+        for slot, seq in list(self._running.items()):
+            if not self._active[slot]:
+                continue
+            token = int(new_tokens_np[slot])
+            seq.context_len += 1
+            self._context_lens[slot] += 1
+            self._last_tokens[slot] = token
+            lp = self._make_logprob(token, float(chosen_np[slot]),
+                                    top_vals_np[slot], top_ids_np[slot],
+                                    seq.req.sampling)
+            self._emit_token(seq, token, lp)
+            if not seq.finished:
+                self._maybe_finish(seq)
+        return True
+
+    # ----------------------------------------------------------- emission
+    def _make_logprob(self, token: int, chosen_lp: float,
+                      top_vals: np.ndarray, top_ids: np.ndarray,
+                      sp: SamplingParams) -> Optional[LogProb]:
+        if not sp.logprobs:
+            return None
+        tok_str = self.tokenizer.decode([token]) or ""
+        k = min(sp.top_logprobs, len(top_ids)) if sp.top_logprobs else 0
+        return LogProb(
+            token=tok_str, token_id=token, logprob=chosen_lp,
+            top_logprobs=[
+                LogProbData(self.tokenizer.decode([int(t)]) or "",
+                            int(t), float(v))
+                for t, v in zip(top_ids[:k], top_vals[:k])
+            ])
+
+    def _emit_token(self, seq: _Sequence, token: int,
+                    lp: Optional[LogProb]) -> None:
+        """Append + detokenize + stream the delta. The *pending* token (the
+        one just sampled) counts toward output immediately (matching the
+        reference's per-step DisaggStreamGeneration flow)."""
+        seq.output_ids.append(token)
+        if lp is not None:
+            seq.logprobs.append(lp)
+        self.total_generated += 1
+        sp = seq.req.sampling
+
+        finish_reason = ""
+        if (not sp.ignore_eos and self.eos_token_id is not None
+                and token == self.eos_token_id):
+            finish_reason = "stop"
+        elif token in sp.stop_token_ids:
+            finish_reason = "stop"
+        elif len(seq.output_ids) >= seq.max_total_len - seq.prompt_len:
+            finish_reason = "length"
+        elif seq.prompt_len + len(seq.output_ids) >= self.cfg.max_seq_len:
+            finish_reason = "length"
+
+        # Detokenize incrementally (drop the eos/stop token from text).
+        visible_ids = seq.output_ids[:-1] if finish_reason == "stop" and \
+            token == self.eos_token_id else seq.output_ids
+        text = self.tokenizer.decode(visible_ids)
+        # Stop strings.
+        if not finish_reason and sp.stop:
+            for s in sp.stop:
+                pos = text.find(s, max(0, seq.emitted_chars - len(s)))
+                if pos != -1:
+                    text = text[:pos]
+                    finish_reason = "stop"
+                    break
+        new_text = text[seq.emitted_chars:]
+        # Hold back trailing replacement char (partial UTF-8 sequence).
+        if new_text.endswith("�") and not finish_reason:
+            new_text = new_text[:-1]
+        seq.emitted_chars += len(new_text)
+
+        out = RequestOutput(
+            service_request_id=seq.req.service_request_id,
+            request_id=seq.req.request_id,
+            outputs=[SequenceOutput(
+                index=0, text=new_text, token_ids=[token],
+                finish_reason=finish_reason,
+                logprobs=[lp] if lp is not None else [])],
+            finished=bool(finish_reason),
+        )
+        if finish_reason:
+            out.usage = Usage(num_prompt_tokens=seq.prompt_len,
+                              num_generated_tokens=len(seq.output_ids))
+            out.finished_on_prefill = len(seq.output_ids) == 1
+            seq.finished = True
+        try:
+            seq.req.on_output(out)
+        except Exception:  # noqa: BLE001
+            logger.exception("engine output callback failed; cancelling %s",
+                             seq.req.service_request_id)
+            seq.cancelled = True
+        if seq.finished:
+            self._finish_sequence(seq, finish_reason, emit=False)
+
+    def _maybe_finish(self, seq: _Sequence) -> None:
+        """Mid-flight resource guard (admission reserves pages, so this only
+        trips on cancellation races)."""
+        if seq.cancelled:
+            self._finish_sequence(seq, "abort", emit=False)
+
+    def _finish_sequence(self, seq: _Sequence, reason: str,
+                         emit: bool = True) -> None:
+        if seq.slot >= 0 and seq.slot in self._running:
+            del self._running[seq.slot]
+            self._active[seq.slot] = False
+            self._page_tables[seq.slot] = GARBAGE_PAGE
+            self._context_lens[seq.slot] = 0
+            with self._lock:
+                self._free_slots.append(seq.slot)
+        seq.pages.release(self.page_mgr)
+        if emit and not seq.finished:
+            seq.req.on_output(RequestOutput(
+                service_request_id=seq.req.service_request_id,
+                request_id=seq.req.request_id,
+                status=Status(StatusCode.CANCELLED, reason), finished=True))
+        seq.finished = True

@@ -128,7 +128,7 @@ def prefill_forward(params: Params, cfg: ModelConfig,
         q, k, v = _project_qkv(lp, h, cfg, positions)
         k_pages, v_pages = kv[0], kv[1]
         k_pages, v_pages = write_prefill_kv(k_pages, v_pages, k, v,
-                                            page_table, prefix_lens)
+                                            page_table, prefix_lens, seq_lens)
         attn = prefill_attention(q, k, v,
                                  k_pages if use_prefix else None,
                                  v_pages if use_prefix else None,

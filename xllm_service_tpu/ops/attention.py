@@ -58,16 +58,23 @@ def _repeat_kv(kv: jax.Array, n_rep: int) -> jax.Array:
 # --------------------------------------------------------------- KV writes
 def write_prefill_kv(k_pages: jax.Array, v_pages: jax.Array,
                      k: jax.Array, v: jax.Array,
-                     page_table: jax.Array, prefix_lens: jax.Array) -> tuple[jax.Array, jax.Array]:
+                     page_table: jax.Array, prefix_lens: jax.Array,
+                     seq_lens: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Scatter a prefill suffix's K/V into the paged pool.
 
     k/v: [B, S, n_kv, hd] — token j of row b lands at absolute position
-    prefix_lens[b] + j (prefix blocks already cached are skipped).
+    prefix_lens[b] + j (prefix blocks already cached are skipped). Padding
+    positions (j >= seq_lens[b]) are redirected to the reserved garbage
+    page 0 so bucket padding never overwrites live cache lines.
     """
     B, S = k.shape[0], k.shape[1]
     page_size = k_pages.shape[1]
     pos = prefix_lens[:, None] + jnp.arange(S)[None, :]          # [B, S]
-    page_idx = jnp.take_along_axis(page_table, pos // page_size, axis=1)
+    valid = jnp.arange(S)[None, :] < seq_lens[:, None]
+    max_pages = page_table.shape[1]
+    page_idx = jnp.take_along_axis(
+        page_table, jnp.clip(pos // page_size, 0, max_pages - 1), axis=1)
+    page_idx = jnp.where(valid, page_idx, 0)
     slot = pos % page_size
     b_flat = page_idx.reshape(-1)
     s_flat = slot.reshape(-1)
