@@ -1,0 +1,95 @@
+"""Benchmark harness: steady-state decode throughput on real TPU.
+
+Measures the engine's hot path — the jit decode step (paged attention +
+sampling) at full batch — on whatever accelerator is attached, and prints
+ONE JSON line:
+
+    {"metric": "decode_tokens_per_sec_per_chip", "value": N,
+     "unit": "tok/s", "vs_baseline": R}
+
+Baseline: the reference publishes no numbers (BASELINE.md); its scheduler's
+default decode SLO is 50 ms TPOT (`global_gflags.cpp:128-132`), i.e.
+batch_size/0.05 tok/s/instance at the bench batch size. vs_baseline is
+measured throughput relative to that SLO-implied rate — >1.0 means every
+token beats the reference's default TPOT target at full batch.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from xllm_service_tpu.engine.config import EngineConfig
+    from xllm_service_tpu.engine.engine import InferenceEngine
+    from xllm_service_tpu.models.base import bench_1b_config, tiny_config
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    mcfg = bench_1b_config() if on_accel else tiny_config(dtype=jnp.float32)
+
+    B = 8
+    ctx = 512 if on_accel else 64
+    max_seq = 1024 if on_accel else 128
+    cfg = EngineConfig(
+        model_id="bench-1b", model=mcfg,
+        num_pages=(B * max_seq) // 16 + 64, page_size=16,
+        max_batch_size=B, max_seq_len=max_seq,
+        prefill_buckets=(128, 512, max_seq) if on_accel else (64, 128),
+        hash_block_size=128 if on_accel else 32,
+        decode_horizon=16 if on_accel else 4)
+    engine = InferenceEngine(cfg)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(10, mcfg.vocab_size - 10, ctx).tolist()
+               for _ in range(B)]
+
+    from xllm_service_tpu.common.request import SamplingParams
+    from xllm_service_tpu.engine.engine import EngineRequest
+
+    counts = {"tokens": 0}
+
+    def on_output(out):
+        counts["tokens"] += sum(len(s.token_ids) for s in out.outputs)
+
+    # Admit all B sequences (prefill) — not timed; we measure decode.
+    for i, p in enumerate(prompts):
+        engine.submit(EngineRequest(
+            f"bench-{i}", token_ids=p,
+            sampling=SamplingParams(max_tokens=max_seq - ctx - 8,
+                                    temperature=0.0, ignore_eos=True),
+            on_output=on_output))
+    while engine._waiting or len(engine._running) < B:
+        engine.step()
+        if not engine._waiting and engine._running:
+            break
+
+    # Warmup decode steps (compile + cache).
+    for _ in range(2):
+        engine.step()
+
+    n_steps = 16 if on_accel else 4   # horizons (tokens = steps * horizon)
+    start = counts["tokens"]
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        engine.step()
+    dt = time.perf_counter() - t0
+    generated = counts["tokens"] - start
+
+    toks_per_s = generated / dt
+    baseline = B / 0.050   # reference default TPOT SLO: 50ms/token at batch B
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(toks_per_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(toks_per_s / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

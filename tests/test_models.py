@@ -10,8 +10,8 @@ from xllm_service_tpu.models.base import tiny_config, get_model_family
 
 
 def alloc_pages(cfg, num_pages, page_size):
-    return jnp.zeros((cfg.num_layers, 2, num_pages, page_size,
-                      cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+    return jnp.zeros((cfg.num_layers, 2, num_pages, cfg.num_kv_heads,
+                      page_size, cfg.head_dim), cfg.dtype)
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,435 @@
+"""Engine instance agent: the process that owns one TPU engine and speaks
+the orchestration wire contract.
+
+Parity: the per-instance responsibilities implied by the reference
+(SURVEY.md §3.4 + `rpc_service/client.cpp` SDK): register in coordination
+under `XLLM:INSTANCE:<TYPE>:<name>` with a TTL lease + incarnation id,
+heartbeat every 3s with KvCacheEvents + Load/LatencyMetrics, accept
+enriched Completions/ChatCompletions, stream batched Generations to the
+service's RPC endpoint, serve /health probes, honor Link/Unlink/Cancel and
+dynamic role flips.
+
+Run: ``python -m xllm_service_tpu.engine.agent --coordination-addr ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import requests as _requests
+from aiohttp import web
+
+import jax
+
+from ..common.request import RequestOutput, SamplingParams
+from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
+from ..coordination import CoordinationClient, connect
+from ..rpc import MASTER_KEY, instance_key
+from ..tokenizer import TokenizerFactory
+from ..utils import get_local_ip, get_logger, pick_free_port
+from .config import EngineConfig
+from .engine import EngineRequest, InferenceEngine
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class AgentConfig:
+    host: str = "127.0.0.1"
+    port: int = 0                      # 0 = ephemeral
+    coordination_addr: str = ""
+    coordination_namespace: str = ""
+    instance_type: InstanceType = InstanceType.MIX
+    model_id: str = "tiny-llama"
+    tokenizer_path: str = ""
+    heartbeat_interval_s: float = 3.0
+    lease_ttl_s: float = 3.0
+    generation_flush_ms: float = 5.0   # batching window for Generations
+    slice_id: str = "slice-0"
+
+
+class GenerationStreamer:
+    """Batches RequestOutput deltas per destination service and POSTs
+    `{"gens": [...]}` (reference batched DisaggStreamGenerations,
+    `rpc_service/service.cpp:149-215`)."""
+
+    def __init__(self, engine: InferenceEngine, flush_ms: float):
+        self._engine = engine
+        self._q: "queue.Queue[Optional[tuple[str, dict]]]" = queue.Queue()
+        self._flush_s = flush_ms / 1000.0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="gen-streamer")
+        self._thread.start()
+
+    def push(self, dest_addr: str, output: RequestOutput) -> None:
+        self._q.put((dest_addr, output.to_dict()))
+
+    def _loop(self) -> None:
+        session = _requests.Session()
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            batch: dict[str, list[dict]] = {}
+            dest, gen = item
+            batch.setdefault(dest, []).append(gen)
+            deadline = time.monotonic() + self._flush_s
+            while True:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._flush(session, batch)
+                    return
+                batch.setdefault(nxt[0], []).append(nxt[1])
+            self._flush(session, batch)
+
+    def _flush(self, session: _requests.Session,
+               batch: dict[str, list[dict]]) -> None:
+        for dest, gens in batch.items():
+            try:
+                r = session.post(f"http://{dest}/rpc/generations",
+                                 json={"gens": gens}, timeout=10)
+                alive = r.json().get("alive", {})
+                for sid, ok in alive.items():
+                    if not ok:
+                        self._engine.cancel(sid)
+            except (_requests.RequestException, ValueError) as e:
+                logger.warning("generations push to %s failed: %s", dest, e)
+                # The service is unreachable; cancel these requests so the
+                # engine doesn't burn chips on a dead stream.
+                for g in gens:
+                    self._engine.cancel(g.get("service_request_id", ""))
+
+    def stop(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=5)
+
+
+class EngineAgent:
+    def __init__(self, engine_cfg: EngineConfig, agent_cfg: AgentConfig,
+                 coord: Optional[CoordinationClient] = None):
+        self.cfg = agent_cfg
+        self.coord = coord or connect(agent_cfg.coordination_addr,
+                                      agent_cfg.coordination_namespace)
+        tokenizer = TokenizerFactory.create_tokenizer(agent_cfg.tokenizer_path)
+        self.engine = InferenceEngine(engine_cfg, tokenizer=tokenizer)
+        self.port = agent_cfg.port or pick_free_port(agent_cfg.host)
+        self.name = f"{agent_cfg.host}:{self.port}"
+        self.incarnation_id = uuid.uuid4().hex[:12]
+        self.instance_type = agent_cfg.instance_type
+        self.streamer = GenerationStreamer(self.engine,
+                                           agent_cfg.generation_flush_ms)
+        self.linked_peers: dict[str, InstanceMetaInfo] = {}
+        self._alive = True
+        self._started = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------ metadata
+    def meta(self) -> InstanceMetaInfo:
+        ecfg = self.engine.cfg
+        mcfg = ecfg.model
+        devs = jax.devices()
+        return InstanceMetaInfo(
+            name=self.name, rpc_address=self.name, type=self.instance_type,
+            dp_size=1,
+            topology=TpuTopology(
+                slice_id=self.cfg.slice_id,
+                mesh_shape=list(self.engine.mesh.devices.shape)
+                if self.engine.mesh else [len(devs)],
+                axis_names=list(self.engine.mesh.axis_names)
+                if self.engine.mesh else ["data"],
+                host_addrs=[self.name]),
+            kv_page_size=ecfg.page_size,
+            kv_dtype=str(mcfg.dtype.__name__ if hasattr(mcfg.dtype, "__name__")
+                         else mcfg.dtype),
+            num_layers=mcfg.num_layers, num_kv_heads=mcfg.num_kv_heads,
+            head_dim=mcfg.head_dim,
+            max_context_len=ecfg.max_seq_len,
+            incarnation_id=self.incarnation_id,
+            register_ts_ms=int(time.time() * 1000),
+            models=[self.cfg.model_id],
+            # Profiled latency tables for the SLO predictor; measured tables
+            # can be dropped in here — these are conservative shapes.
+            ttft_profiling_data=[[128, 30.0], [512, 80.0], [2048, 250.0],
+                                 [4096, 520.0]],
+            tpot_profiling_data=[[1, 128, 6.0], [4, 2048, 9.0],
+                                 [8, 8192, 14.0], [16, 32768, 25.0]],
+        )
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "EngineAgent":
+        self.engine.start()
+        t = threading.Thread(target=self._run_server, daemon=True,
+                             name=f"agent-http-{self.port}")
+        t.start()
+        self._threads.append(t)
+        if not self._started.wait(30):
+            raise RuntimeError("engine agent HTTP server failed to start")
+        self.register()
+        hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                              name="agent-heartbeat")
+        hb.start()
+        self._threads.append(hb)
+        logger.info("engine agent %s (%s, model=%s) up",
+                    self.name, self.instance_type.value, self.cfg.model_id)
+        return self
+
+    def register(self) -> None:
+        self.coord.set(instance_key(self.instance_type.value, self.name),
+                       self.meta().to_json(), ttl_s=self.cfg.lease_ttl_s)
+
+    def stop(self) -> None:
+        self._alive = False
+        self.coord.rm(instance_key(self.instance_type.value, self.name))
+        self.streamer.stop()
+        self.engine.stop()
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self.coord.close()
+
+    def _run_server(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        app = web.Application()
+        app.router.add_post("/v1/completions", self._h_completion)
+        app.router.add_post("/v1/chat/completions", self._h_chat)
+        app.router.add_get("/v1/models", self._h_models)
+        app.router.add_get("/health", self._h_health)
+        app.router.add_get("/stats", self._h_stats)
+        app.router.add_post("/rpc/link", self._h_link)
+        app.router.add_post("/rpc/unlink", self._h_unlink)
+        app.router.add_post("/rpc/cancel", self._h_cancel)
+        app.router.add_post("/rpc/flip_role", self._h_flip)
+
+        async def _start():
+            self._runner = web.AppRunner(app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self.cfg.host, self.port)
+            await site.start()
+
+        self._loop.run_until_complete(_start())
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.run_until_complete(self._runner.cleanup())
+            self._loop.close()
+
+    # ----------------------------------------------------------- heartbeats
+    def _heartbeat_loop(self) -> None:
+        while self._alive:
+            time.sleep(self.cfg.heartbeat_interval_s)
+            if not self._alive:
+                return
+            try:
+                self.register()   # lease refresh via re-registration
+                master = self.coord.get(MASTER_KEY)
+                if not master:
+                    continue
+                stats = self.engine.stats()
+                ev = self.engine.drain_kv_events()
+                payload = {
+                    "name": self.name,
+                    "incarnation_id": self.incarnation_id,
+                    "load_metrics": {
+                        "waiting_requests_num": stats["waiting"],
+                        "running_requests_num": stats["running"],
+                        "hbm_cache_usage_perc": stats["kv_usage_perc"],
+                    },
+                    "latency_metrics": {
+                        "recent_max_ttft": self.engine.recent_max_ttft_ms,
+                        "recent_max_tbt": self.engine.recent_max_tbt_ms,
+                    },
+                    "kv_cache_event": ev.to_dict(),
+                }
+                self.engine.recent_max_ttft_ms = 0.0
+                self.engine.recent_max_tbt_ms = 0.0
+                _requests.post(f"http://{master}/rpc/heartbeat",
+                               json=payload, timeout=3)
+            except Exception:  # noqa: BLE001
+                logger.exception("heartbeat failed")
+
+    # ------------------------------------------------------------ handlers
+    async def _h_health(self, req: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _h_stats(self, req: web.Request) -> web.Response:
+        return web.json_response(self.engine.stats())
+
+    async def _h_models(self, req: web.Request) -> web.Response:
+        return web.json_response({"object": "list", "data": [
+            {"id": self.cfg.model_id, "object": "model"}]})
+
+    async def _h_link(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        peer = InstanceMetaInfo.from_json(json.dumps(body.get("peer", {})))
+        # KV-layout compatibility gate (replaces the reference's opaque
+        # k/v_cache_ids handshake with an explicit contract check).
+        mine = self.meta()
+        for f in ("kv_page_size", "num_layers", "num_kv_heads", "head_dim"):
+            if getattr(peer, f) and getattr(peer, f) != getattr(mine, f):
+                return web.json_response(
+                    {"ok": False,
+                     "error": f"kv layout mismatch on {f}"}, status=409)
+        self.linked_peers[peer.name] = peer
+        return web.json_response({"ok": True})
+
+    async def _h_unlink(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.linked_peers.pop(body.get("peer_name", ""), None)
+        return web.json_response({"ok": True})
+
+    async def _h_cancel(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.engine.cancel(body.get("service_request_id", ""))
+        return web.json_response({"ok": True})
+
+    async def _h_flip(self, req: web.Request) -> web.Response:
+        """Dynamic PD-role switch (reference `instance_mgr.cpp:1023-1063`).
+        The engine keeps its weights + KV pool; only the advertised role (and
+        hence the traffic mix routed here) changes."""
+        body = await req.json()
+        new_type = InstanceType.parse(body.get("type"))
+        old_key = instance_key(self.instance_type.value, self.name)
+        self.instance_type = new_type
+        self.coord.rm(old_key)
+        self.register()
+        return web.json_response({"ok": True})
+
+    async def _h_completion(self, req: web.Request) -> web.Response:
+        return await self._accept(req, chat=False)
+
+    async def _h_chat(self, req: web.Request) -> web.Response:
+        return await self._accept(req, chat=True)
+
+    async def _accept(self, req: web.Request, chat: bool) -> web.Response:
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        sid = body.get("service_request_id") or f"local-{uuid.uuid4().hex[:8]}"
+        source = body.get("source_service_addr", "")
+        token_ids = list(body.get("token_ids") or ())
+        if not token_ids:
+            # Standalone mode (no orchestrator enrichment): tokenize here.
+            prompt = body.get("prompt", "")
+            if chat and not prompt:
+                msgs = body.get("messages") or []
+                prompt = "\n".join(str(m.get("content", "")) for m in msgs)
+            token_ids = self.engine.tokenizer.encode(str(prompt))
+        sampling = self._sampling_from_body(body)
+
+        if not source:
+            return web.json_response(
+                {"error": "source_service_addr required (engine streams "
+                          "results to the service RPC endpoint)"}, status=400)
+
+        dest = source
+
+        def on_output(out: RequestOutput) -> None:
+            self.streamer.push(dest, out)
+
+        self.engine.submit(EngineRequest(
+            service_request_id=sid,
+            request_id=body.get("request_id", sid),
+            token_ids=token_ids, sampling=sampling, on_output=on_output))
+        return web.json_response({"ok": True, "service_request_id": sid})
+
+    @staticmethod
+    def _sampling_from_body(body: dict[str, Any]) -> SamplingParams:
+        sp = SamplingParams()
+        def num(key, default, cast):
+            v = body.get(key)
+            return cast(v) if v is not None else default
+        sp.max_tokens = num("max_tokens", num("max_completion_tokens", 16, int), int)
+        sp.temperature = num("temperature", 1.0, float)
+        sp.top_p = num("top_p", 1.0, float)
+        sp.top_k = num("top_k", -1, int)
+        sp.frequency_penalty = num("frequency_penalty", 0.0, float)
+        sp.presence_penalty = num("presence_penalty", 0.0, float)
+        sp.repetition_penalty = num("repetition_penalty", 1.0, float)
+        stop = body.get("stop")
+        sp.stop = [stop] if isinstance(stop, str) else \
+            [str(s) for s in stop] if isinstance(stop, list) else []
+        sp.stop_token_ids = list(body.get("stop_token_ids", ()))
+        if body.get("seed") is not None:
+            sp.seed = int(body["seed"])
+        lp = body.get("logprobs")
+        if isinstance(lp, bool):
+            sp.logprobs = lp
+            sp.top_logprobs = int(body.get("top_logprobs") or 0)
+        elif isinstance(lp, int):
+            sp.logprobs = lp > 0
+            sp.top_logprobs = lp
+        sp.ignore_eos = bool(body.get("ignore_eos", False))
+        return sp
+
+
+def main() -> None:
+    from ..models import base as model_base
+
+    p = argparse.ArgumentParser(description="xllm-service-tpu engine agent")
+    p.add_argument("--coordination-addr", default="127.0.0.1:12379")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--type", default="MIX",
+                   choices=[t.value for t in InstanceType])
+    p.add_argument("--model-id", default="bench-1b")
+    p.add_argument("--model-config", default="bench_1b",
+                   help="config factory in models.base (e.g. bench_1b, "
+                        "llama3_8b, tiny)")
+    p.add_argument("--tokenizer-path", default="")
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-seq-len", type=int, default=2048)
+    args = p.parse_args()
+
+    factory = {
+        "tiny": model_base.tiny_config,
+        "bench_1b": model_base.bench_1b_config,
+        "llama3_8b": model_base.llama3_8b_config,
+        "llama3_70b": model_base.llama3_70b_config,
+    }[args.model_config]
+    mcfg = factory()
+    ecfg = EngineConfig(
+        model_id=args.model_id, model=mcfg,
+        num_pages=args.num_pages, page_size=args.page_size,
+        max_batch_size=args.max_batch_size,
+        max_seq_len=min(args.max_seq_len, mcfg.max_context_len),
+        prefill_buckets=tuple(sorted(
+            {b for b in (128, 512, 2048)
+             if b < min(args.max_seq_len, mcfg.max_context_len)}
+            | {min(args.max_seq_len, mcfg.max_context_len)})),
+        role=InstanceType.parse(args.type))
+    agent = EngineAgent(
+        ecfg, AgentConfig(host=args.host, port=args.port,
+                          coordination_addr=args.coordination_addr,
+                          instance_type=InstanceType.parse(args.type),
+                          model_id=args.model_id,
+                          tokenizer_path=args.tokenizer_path))
+    agent.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        agent.stop()
+
+
+if __name__ == "__main__":
+    main()

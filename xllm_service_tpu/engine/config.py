@@ -31,6 +31,12 @@ class EngineConfig:
     # Sampling.
     max_top_logprobs: int = 5
     seed: int = 0
+    # Decode horizon: tokens generated per host roundtrip (lax.scan inside
+    # one jit call). 1 = lowest streaming latency; larger values amortize
+    # dispatch + transfer overhead (essential over remote-attached chips,
+    # still a win locally). Tokens past a stop condition within a horizon
+    # are discarded on the host.
+    decode_horizon: int = 1
 
     @property
     def pages_per_seq(self) -> int:

@@ -2,19 +2,25 @@
 
 The TPU replacement for the reference's CUDA/Ascend engine decode loop
 (BASELINE north star: "paged-attention and continuous-batching decode loop
-become Pallas/XLA"). Design points for XLA:
+become Pallas/XLA"). Design points for XLA and for remote-attached chips:
 
-- **Two compiled programs**: prefill (one per length bucket) and decode
-  (one, fixed max_batch_size). Static shapes everywhere; per-request
-  variability (lengths, sampling params, active slots) is data, not shape.
-- **Paged KV pool** `[L, 2, pages, page_size, n_kv, hd]` lives on device and
-  is donated through every step (XLA updates in place).
+- **Two compiled programs**: fused prefill+install (one per length bucket)
+  and multi-step decode (one, fixed max_batch_size, `lax.scan` over the
+  decode horizon). Static shapes everywhere; per-request variability
+  (lengths, sampling params, active slots) is data, not shape.
+- **Device-resident decode state**: KV pool, penalty histograms, sampling
+  controls, last tokens, context lengths, page tables and active mask live
+  in one pytree that is donated through every step — XLA updates in place,
+  and the host exchanges exactly one packed upload per admission and one
+  packed download per decode horizon (host↔device roundtrips are the
+  dominant cost on remote-attached accelerators).
 - **Admission control**: pages for prompt + max_new_tokens are reserved at
   admission, so decode never OOMs mid-flight.
 - **Prefix cache**: longest block-aligned cached prefix is reused (pages
   shared, suffix-only prefill); completed blocks are donated back and
   reported as KvCacheEvents (feeds cluster-wide cache-aware routing).
-- Inactive batch slots write K/V to the reserved garbage page 0.
+- Inactive batch slots write K/V to the reserved garbage page 0; a dead
+  slot's device page-table row is cleared before its pages are recycled.
 """
 
 from __future__ import annotations
@@ -72,7 +78,6 @@ class _Sequence:
     context_len: int = 0          # tokens whose KV is in the cache
     prompt_len: int = 0
     output_ids: list[int] = field(default_factory=list)
-    slot_key: Any = None
     emitted_chars: int = 0
     max_total_len: int = 0
     finished: bool = False
@@ -100,24 +105,29 @@ class InferenceEngine:
             params = shard_params(params, self.mesh,
                                   self.family.sharding_rules)
         self.params = params
-        self.kv_pages = jnp.zeros(
-            (mcfg.num_layers, 2, cfg.num_pages, cfg.page_size,
-             mcfg.num_kv_heads, mcfg.head_dim), mcfg.dtype)
         self.page_mgr = KVPageManager(cfg.num_pages, cfg.page_size,
                                       cfg.hash_block_size)
 
         B = cfg.max_batch_size
-        self._sampling = SamplingState.init(B, mcfg.vocab_size)
+        # Device-resident decode state (donated through every program).
+        self._dstate: dict[str, jax.Array] = {
+            "kv": jnp.zeros((mcfg.num_layers, 2, cfg.num_pages,
+                             mcfg.num_kv_heads, cfg.page_size,
+                             mcfg.head_dim), mcfg.dtype),
+            "counts": jnp.zeros((B, mcfg.vocab_size), jnp.int32),
+            "last": jnp.zeros((B,), jnp.int32),
+            "clens": jnp.zeros((B,), jnp.int32),
+            "pt": jnp.full((B, cfg.pages_per_seq), GARBAGE_PAGE, jnp.int32),
+            "active": jnp.zeros((B,), jnp.bool_),
+            "temp": jnp.ones((B,), jnp.float32),
+            "topk": jnp.zeros((B,), jnp.int32),
+            "topp": jnp.ones((B,), jnp.float32),
+            "fp": jnp.zeros((B,), jnp.float32),
+            "pp": jnp.zeros((B,), jnp.float32),
+            "rp": jnp.ones((B,), jnp.float32),
+            "keys": jnp.zeros((B, 2), jnp.uint32),
+        }
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
-        # Per-slot sampling keys (seeded requests pin their own).
-        self._slot_keys = jnp.zeros((B, 2), jnp.uint32)
-
-        # Host-side batch state.
-        self._page_tables = np.full((B, cfg.pages_per_seq), GARBAGE_PAGE,
-                                    np.int32)
-        self._last_tokens = np.zeros((B,), np.int32)
-        self._context_lens = np.zeros((B,), np.int32)   # incl. pending token
-        self._active = np.zeros((B,), bool)
 
         self._waiting: deque[EngineRequest] = deque()
         self._running: dict[int, _Sequence] = {}
@@ -133,44 +143,104 @@ class InferenceEngine:
         self.recent_max_tbt_ms = 0.0
         self.total_generated = 0
 
+    # ---------------------------------------------------------- properties
+    @property
+    def kv_pages(self) -> jax.Array:
+        return self._dstate["kv"]
+
     # -------------------------------------------------------- jit programs
     def _build_programs(self) -> None:
         cfg, mcfg, fam = self.cfg, self.cfg.model, self.family
+        P = cfg.pages_per_seq
+        K = cfg.max_top_logprobs
 
-        def decode_step(params, kv_pages, token_counts, tokens, positions,
-                        page_tables, context_lens, temperature, top_k, top_p,
-                        freq_pen, pres_pen, rep_pen, active, keys):
-            logits, kv_pages = fam.decode_forward(
-                params, mcfg, tokens, positions, kv_pages, page_tables,
-                context_lens)
-            st = SamplingState(temperature, top_k, top_p, freq_pen, pres_pen,
-                               rep_pen, token_counts)
-            new_tokens, logprobs = sample_tokens(logits, st, keys,
-                                                 context_lens)
-            token_counts = record_tokens(token_counts, new_tokens, active)
-            chosen_lp = jnp.take_along_axis(
-                logprobs, new_tokens[:, None], axis=-1)[:, 0]
-            top_vals, top_ids = jax.lax.top_k(logprobs, cfg.max_top_logprobs)
-            return new_tokens, chosen_lp, top_vals, top_ids, kv_pages, token_counts
+        def sampling_state(d):
+            return SamplingState(d["temp"], d["topk"], d["topp"], d["fp"],
+                                 d["pp"], d["rp"], d["counts"])
 
-        self._decode_step = jax.jit(decode_step, donate_argnums=(1, 2))
+        @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+        def decode_multi(params, d, horizon):
+            def step(d, _):
+                positions = d["clens"] - 1
+                logits, kv = fam.decode_forward(
+                    params, mcfg, d["last"], positions, d["kv"], d["pt"],
+                    d["clens"])
+                d = dict(d, kv=kv)
+                toks, logprobs = sample_tokens(
+                    logits, sampling_state(d), d["keys"], d["clens"])
+                d["counts"] = record_tokens(d["counts"], toks, d["active"])
+                chosen = jnp.take_along_axis(
+                    logprobs, toks[:, None], axis=-1)[:, 0]
+                tv, ti = jax.lax.top_k(logprobs, K)
+                d["last"] = jnp.where(d["active"], toks, d["last"])
+                d["clens"] = jnp.where(d["active"], d["clens"] + 1,
+                                       d["clens"])
+                return d, (toks, chosen, tv, ti)
 
-        def prefill_step(params, kv_pages, tokens, positions, page_table,
-                         prefix_len, seq_len, temperature, top_k, top_p,
-                         freq_pen, pres_pen, rep_pen, token_counts_row, keys,
-                         steps):
-            logits, kv_pages = fam.prefill_forward(
-                params, mcfg, tokens, positions, kv_pages, page_table,
-                prefix_len, seq_len)
-            st = SamplingState(temperature, top_k, top_p, freq_pen, pres_pen,
-                               rep_pen, token_counts_row)
-            new_tokens, logprobs = sample_tokens(logits, st, keys, steps)
-            chosen_lp = jnp.take_along_axis(
-                logprobs, new_tokens[:, None], axis=-1)[:, 0]
-            top_vals, top_ids = jax.lax.top_k(logprobs, cfg.max_top_logprobs)
-            return new_tokens, chosen_lp, top_vals, top_ids, kv_pages
+            d, ys = jax.lax.scan(step, d, None, length=horizon)
+            toks, chosen, tv, ti = ys
+            # Pack downloads: ints [H,B,1+K], floats [H,B,1+K].
+            ints = jnp.concatenate([toks[..., None], ti], axis=-1)
+            floats = jnp.concatenate([chosen[..., None], tv], axis=-1)
+            return d, ints, floats
 
-        self._prefill_step = jax.jit(prefill_step, donate_argnums=(1,))
+        self._decode_multi = decode_multi
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_install(params, d, tokens, ints, floats, counts_row, key):
+            """Prefill one sequence + install it into batch slot `slot`.
+
+            ints: [P + 3] = [page_row(P), slot, prefix_len, seq_len]
+            floats: [6] = [temperature, top_k, top_p, freq, pres, rep]
+            counts_row: [V] penalty histogram of the full prompt.
+            """
+            page_row = ints[:P]
+            slot = ints[P]
+            prefix_len = ints[P + 1]
+            seq_len = ints[P + 2]
+            logits, kv = fam.prefill_forward(
+                params, mcfg, tokens, prefix_len + jnp.arange(
+                    tokens.shape[1], dtype=jnp.int32)[None, :],
+                d["kv"], page_row[None, :], prefix_len[None],
+                seq_len[None])
+            d = dict(d, kv=kv)
+            st = SamplingState(
+                floats[0:1], floats[1:2].astype(jnp.int32), floats[2:3],
+                floats[3:4], floats[4:5], floats[5:6], counts_row[None, :])
+            toks, logprobs = sample_tokens(
+                logits, st, key[None, :], (prefix_len + seq_len)[None])
+            chosen = jnp.take_along_axis(logprobs, toks[:, None],
+                                         axis=-1)[:, 0]
+            tv, ti = jax.lax.top_k(logprobs, K)
+            # Install the slot.
+            d["pt"] = d["pt"].at[slot].set(page_row)
+            d["last"] = d["last"].at[slot].set(toks[0])
+            d["clens"] = d["clens"].at[slot].set(prefix_len + seq_len + 1)
+            d["active"] = d["active"].at[slot].set(True)
+            d["temp"] = d["temp"].at[slot].set(floats[0])
+            d["topk"] = d["topk"].at[slot].set(floats[1].astype(jnp.int32))
+            d["topp"] = d["topp"].at[slot].set(floats[2])
+            d["fp"] = d["fp"].at[slot].set(floats[3])
+            d["pp"] = d["pp"].at[slot].set(floats[4])
+            d["rp"] = d["rp"].at[slot].set(floats[5])
+            d["keys"] = d["keys"].at[slot].set(key)
+            d["counts"] = d["counts"].at[slot].set(
+                counts_row.at[toks[0]].add(1))
+            ints_out = jnp.concatenate([toks, ti[0]])
+            floats_out = jnp.concatenate([chosen, tv[0]])
+            return d, ints_out, floats_out
+
+        self._prefill_install = prefill_install
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def clear_slot(d, slot):
+            d = dict(d)
+            d["pt"] = d["pt"].at[slot].set(GARBAGE_PAGE)
+            d["active"] = d["active"].at[slot].set(False)
+            d["clens"] = d["clens"].at[slot].set(0)
+            return d
+
+        self._clear_slot = clear_slot
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceEngine":
@@ -209,6 +279,8 @@ class InferenceEngine:
             self._lock.notify_all()
 
     def cancel(self, service_request_id: str) -> None:
+        if not service_request_id:
+            return
         with self._lock:
             self._cancelled.add(service_request_id)
             self._lock.notify_all()
@@ -236,7 +308,8 @@ class InferenceEngine:
                         self._lock.wait(timeout=0.05)
 
     def step(self) -> bool:
-        """One engine iteration: process cancellations, admit, decode."""
+        """One engine iteration: process cancellations, admit, decode one
+        horizon."""
         self._process_cancellations()
         admitted = self._admit()
         decoded = self._decode()
@@ -261,12 +334,11 @@ class InferenceEngine:
                 seq.cancelled = True
                 self._finish_sequence(seq, "abort", emit=True)
 
-    def _emit_cancelled(self, req: EngineRequest) -> bool:
+    def _emit_cancelled(self, req: EngineRequest) -> None:
         req.on_output(RequestOutput(
             service_request_id=req.service_request_id,
             request_id=req.request_id,
             status=Status(StatusCode.CANCELLED, "cancelled"), finished=True))
-        return True
 
     # ------------------------------------------------------------ admission
     def _admit(self) -> bool:
@@ -315,9 +387,11 @@ class InferenceEngine:
                                 cached_pages=cached_pages,
                                 own_pages=own_pages),
             prompt_len=P0, context_len=P0, max_total_len=max_total)
+        with self._lock:
+            seq.slot = self._free_slots.pop()
 
         t0 = time.monotonic()
-        first_token, lp = self._run_prefill(seq, prompt, matched)
+        first_token, lp = self._run_prefill_install(seq, prompt, matched)
         self.recent_max_ttft_ms = max(self.recent_max_ttft_ms,
                                       (time.monotonic() - t0) * 1000)
 
@@ -328,14 +402,8 @@ class InferenceEngine:
         seq.pages.donated_hashes = stored
         seq.pages.donated_pages = donated
 
-        with self._lock:
-            slot = self._free_slots.pop()
-        seq.slot = slot
-        self._running[slot] = seq
-        self._install_slot(seq, first_token)
+        self._running[seq.slot] = seq
         self._emit_token(seq, first_token, lp)
-        if not seq.finished:
-            self._maybe_finish(seq)
         return True
 
     def _bucket_for(self, n: int) -> int:
@@ -344,113 +412,72 @@ class InferenceEngine:
                 return b
         return self.cfg.prefill_buckets[-1]
 
-    def _run_prefill(self, seq: _Sequence, prompt: list[int],
-                     matched: int) -> tuple[int, LogProb]:
+    def _run_prefill_install(self, seq: _Sequence, prompt: list[int],
+                             matched: int) -> tuple[int, Optional[LogProb]]:
         cfg = self.cfg
+        P = cfg.pages_per_seq
         suffix = prompt[matched:]
         S = self._bucket_for(len(suffix))
         toks = np.zeros((1, S), np.int32)
         toks[0, :len(suffix)] = suffix
-        positions = np.zeros((1, S), np.int32)
-        positions[0, :] = matched + np.arange(S)
-        page_table = np.full((1, cfg.pages_per_seq), GARBAGE_PAGE, np.int32)
-        all_pages = seq.pages.all_pages
-        page_table[0, :len(all_pages)] = all_pages
 
         sp = seq.req.sampling
-        counts_row = np.zeros((1, cfg.model.vocab_size), np.int32)
-        binc = np.bincount(np.asarray(prompt, np.int64),
-                           minlength=cfg.model.vocab_size)
-        counts_row[0] = binc[:cfg.model.vocab_size]
+        ints = np.full((P + 3,), GARBAGE_PAGE, np.int32)
+        all_pages = seq.pages.all_pages
+        ints[:len(all_pages)] = all_pages
+        ints[P] = seq.slot
+        ints[P + 1] = matched
+        ints[P + 2] = len(suffix)
+        floats = np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
+                             sp.frequency_penalty, sp.presence_penalty,
+                             sp.repetition_penalty if sp.repetition_penalty > 0
+                             else 1.0], np.float32)
+        counts_row = np.bincount(
+            np.asarray(prompt, np.int64),
+            minlength=cfg.model.vocab_size)[:cfg.model.vocab_size] \
+            .astype(np.int32)
         self._rng, slot_key = jax.random.split(self._rng)
         if sp.seed is not None:
             slot_key = jax.random.PRNGKey(sp.seed)
-        seq.slot_key = slot_key
 
-        new_tok, chosen_lp, top_vals, top_ids, self.kv_pages = \
-            self._prefill_step(
-                self.params, self.kv_pages, jnp.asarray(toks),
-                jnp.asarray(positions), jnp.asarray(page_table),
-                jnp.asarray([matched], jnp.int32),
-                jnp.asarray([len(suffix)], jnp.int32),
-                jnp.asarray([sp.temperature], jnp.float32),
-                jnp.asarray([sp.top_k], jnp.int32),
-                jnp.asarray([sp.top_p], jnp.float32),
-                jnp.asarray([sp.frequency_penalty], jnp.float32),
-                jnp.asarray([sp.presence_penalty], jnp.float32),
-                jnp.asarray([sp.repetition_penalty], jnp.float32),
-                jnp.asarray(counts_row), slot_key[None, :],
-                jnp.asarray([len(prompt)], jnp.int32))
-        token = int(new_tok[0])
-        lp = self._make_logprob(token, float(chosen_lp[0]),
-                                np.asarray(top_vals[0]), np.asarray(top_ids[0]),
+        self._dstate, ints_out, floats_out = self._prefill_install(
+            self.params, self._dstate, jnp.asarray(toks), jnp.asarray(ints),
+            jnp.asarray(floats), jnp.asarray(counts_row), slot_key)
+        ints_np = np.asarray(ints_out)
+        floats_np = np.asarray(floats_out)
+        token = int(ints_np[0])
+        lp = self._make_logprob(token, float(floats_np[0]),
+                                floats_np[1:], ints_np[1:],
                                 seq.req.sampling)
         return token, lp
-
-    def _install_slot(self, seq: _Sequence, first_token: int) -> None:
-        """Set up batch-slot state for decode."""
-        slot, cfg, sp = seq.slot, self.cfg, seq.req.sampling
-        self._page_tables[slot] = GARBAGE_PAGE
-        pages = seq.pages.all_pages
-        self._page_tables[slot, :len(pages)] = pages
-        self._last_tokens[slot] = first_token
-        self._context_lens[slot] = seq.context_len + 1  # incl. pending token
-        self._active[slot] = True
-
-        B = cfg.max_batch_size
-        idx = jnp.asarray([slot])
-        st = self._sampling
-        st.temperature = st.temperature.at[idx].set(sp.temperature)
-        st.top_k = st.top_k.at[idx].set(sp.top_k)
-        st.top_p = st.top_p.at[idx].set(sp.top_p)
-        st.frequency_penalty = st.frequency_penalty.at[idx].set(sp.frequency_penalty)
-        st.presence_penalty = st.presence_penalty.at[idx].set(sp.presence_penalty)
-        st.repetition_penalty = st.repetition_penalty.at[idx].set(
-            sp.repetition_penalty if sp.repetition_penalty > 0 else 1.0)
-        counts = np.bincount(
-            np.asarray(seq.req.token_ids + [first_token], np.int64),
-            minlength=self.cfg.model.vocab_size)[:self.cfg.model.vocab_size]
-        st.token_counts = st.token_counts.at[slot].set(
-            jnp.asarray(counts, jnp.int32))
-        self._slot_keys = self._slot_keys.at[slot].set(seq.slot_key)
 
     # -------------------------------------------------------------- decode
     def _decode(self) -> bool:
         if not self._running:
             return False
+        # Bound the horizon by the shortest remaining budget so we don't
+        # burn whole horizons of discarded tokens on nearly-done sequences.
+        horizon = self.cfg.decode_horizon
         t0 = time.monotonic()
-        st = self._sampling
-        positions = self._context_lens - 1   # new token's position
-        new_tokens, chosen_lp, top_vals, top_ids, self.kv_pages, new_counts = \
-            self._decode_step(
-                self.params, self.kv_pages, st.token_counts,
-                jnp.asarray(self._last_tokens), jnp.asarray(positions),
-                jnp.asarray(self._page_tables),
-                jnp.asarray(self._context_lens),
-                st.temperature, st.top_k, st.top_p, st.frequency_penalty,
-                st.presence_penalty, st.repetition_penalty,
-                jnp.asarray(self._active), self._slot_keys)
-        st.token_counts = new_counts
-        new_tokens_np = np.asarray(new_tokens)
-        chosen_np = np.asarray(chosen_lp)
-        top_vals_np = np.asarray(top_vals)
-        top_ids_np = np.asarray(top_ids)
-
+        self._dstate, ints, floats = self._decode_multi(
+            self.params, self._dstate, horizon)
+        ints_np = np.asarray(ints)      # [H, B, 1+K]
+        floats_np = np.asarray(floats)  # [H, B, 1+K]
+        elapsed = time.monotonic() - t0
         self.recent_max_tbt_ms = max(self.recent_max_tbt_ms,
-                                     (time.monotonic() - t0) * 1000)
-        for slot, seq in list(self._running.items()):
-            if not self._active[slot]:
-                continue
-            token = int(new_tokens_np[slot])
-            seq.context_len += 1
-            self._context_lens[slot] += 1
-            self._last_tokens[slot] = token
-            lp = self._make_logprob(token, float(chosen_np[slot]),
-                                    top_vals_np[slot], top_ids_np[slot],
-                                    seq.req.sampling)
-            self._emit_token(seq, token, lp)
-            if not seq.finished:
-                self._maybe_finish(seq)
+                                     elapsed * 1000 / max(1, horizon))
+
+        for h in range(ints_np.shape[0]):
+            for slot, seq in list(self._running.items()):
+                if seq.finished:
+                    continue
+                token = int(ints_np[h, slot, 0])
+                seq.context_len += 1
+                lp = self._make_logprob(
+                    token, float(floats_np[h, slot, 0]),
+                    floats_np[h, slot, 1:], ints_np[h, slot, 1:],
+                    seq.req.sampling)
+                self._emit_token(seq, token, lp)
         return True
 
     # ----------------------------------------------------------- emission
@@ -471,9 +498,7 @@ class InferenceEngine:
 
     def _emit_token(self, seq: _Sequence, token: int,
                     lp: Optional[LogProb]) -> None:
-        """Append + detokenize + stream the delta. The *pending* token (the
-        one just sampled) counts toward output immediately (matching the
-        reference's per-step DisaggStreamGeneration flow)."""
+        """Append + detokenize + stream the delta."""
         seq.output_ids.append(token)
         if lp is not None:
             seq.logprobs.append(lp)
@@ -529,22 +554,18 @@ class InferenceEngine:
             logger.exception("engine output callback failed; cancelling %s",
                              seq.req.service_request_id)
             seq.cancelled = True
-        if seq.finished:
-            self._finish_sequence(seq, finish_reason, emit=False)
-
-    def _maybe_finish(self, seq: _Sequence) -> None:
-        """Mid-flight resource guard (admission reserves pages, so this only
-        trips on cancellation races)."""
-        if seq.cancelled:
-            self._finish_sequence(seq, "abort", emit=False)
+        if seq.finished or seq.cancelled:
+            self._finish_sequence(seq, finish_reason or "abort", emit=False)
 
     def _finish_sequence(self, seq: _Sequence, reason: str,
                          emit: bool = True) -> None:
         if seq.slot >= 0 and seq.slot in self._running:
             del self._running[seq.slot]
-            self._active[seq.slot] = False
-            self._page_tables[seq.slot] = GARBAGE_PAGE
-            self._context_lens[seq.slot] = 0
+            # Clear the device page-table row BEFORE recycling pages — a
+            # stale row would let a dead slot scribble K/V into pages that a
+            # new sequence now owns.
+            self._dstate = self._clear_slot(self._dstate,
+                                            jnp.int32(seq.slot))
             with self._lock:
                 self._free_slots.append(seq.slot)
         seq.pages.release(self.page_mgr)

@@ -88,14 +88,21 @@ def sample_tokens(logits: jax.Array, st: SamplingState,
     """
     logits = apply_penalties(logits, st)
     greedy_tokens = jnp.argmax(logits, axis=-1)
-    safe_temp = jnp.maximum(st.temperature, 1e-6)[:, None]
-    scaled = logits / safe_temp
-    scaled = _mask_top_k(scaled, st.top_k)
-    scaled = _mask_top_p(scaled, st.top_p)
-    sampled = jax.vmap(
-        lambda key, step, row: jax.random.categorical(
-            jax.random.fold_in(key, step), row))(keys, steps, scaled)
-    tokens = jnp.where(st.temperature <= 0.0, greedy_tokens, sampled)
+
+    def _sample(_):
+        safe_temp = jnp.maximum(st.temperature, 1e-6)[:, None]
+        scaled = logits / safe_temp
+        scaled = _mask_top_k(scaled, st.top_k)
+        scaled = _mask_top_p(scaled, st.top_p)
+        sampled = jax.vmap(
+            lambda key, step, row: jax.random.categorical(
+                jax.random.fold_in(key, step), row))(keys, steps, scaled)
+        return jnp.where(st.temperature <= 0.0, greedy_tokens, sampled)
+
+    # The top-k/top-p masks cost full-vocab sorts; skip the whole branch at
+    # runtime when every slot is greedy (the common serving case).
+    tokens = jax.lax.cond(jnp.any(st.temperature > 0.0), _sample,
+                          lambda _: greedy_tokens, operand=None)
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     return tokens.astype(jnp.int32), logprobs
 

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import (
     apply_rope,
-    paged_attention_xla,
+    paged_attention,
     prefill_attention,
     rms_norm,
     write_decode_kv,
@@ -113,7 +113,7 @@ def _unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
 def prefill_forward(params: Params, cfg: ModelConfig,
                     tokens: jax.Array,        # [B, S] suffix token ids
                     positions: jax.Array,     # [B, S] absolute positions
-                    kv_pages: jax.Array,      # [L, 2, P, ps, n_kv, hd]
+                    kv_pages: jax.Array,      # [L, 2, P, n_kv, ps, hd]
                     page_table: jax.Array,    # [B, max_pages]
                     prefix_lens: jax.Array,   # [B] cached-prefix lengths
                     seq_lens: jax.Array,      # [B] valid suffix lengths
@@ -149,7 +149,7 @@ def prefill_forward(params: Params, cfg: ModelConfig,
 def decode_forward(params: Params, cfg: ModelConfig,
                    tokens: jax.Array,         # [B] last sampled tokens
                    positions: jax.Array,      # [B] their absolute positions
-                   kv_pages: jax.Array,       # [L, 2, P, ps, n_kv, hd]
+                   kv_pages: jax.Array,       # [L, 2, P, n_kv, ps, hd]
                    page_table: jax.Array,     # [B, max_pages]
                    context_lens: jax.Array,   # [B] lens INCLUDING new token
                    ) -> tuple[jax.Array, jax.Array]:
@@ -163,8 +163,8 @@ def decode_forward(params: Params, cfg: ModelConfig,
         k_pages, v_pages = kv[0], kv[1]
         k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
                                            page_table, positions)
-        attn = paged_attention_xla(q, k_pages, v_pages, page_table,
-                                   context_lens)
+        attn = paged_attention(q, k_pages, v_pages, page_table,
+                               context_lens)
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)

@@ -1,8 +1,10 @@
 """Attention primitives for the paged-KV engine.
 
 Layouts:
-- KV pool (per layer): ``k_pages/v_pages: [num_pages, page_size, n_kv, hd]``
-  (stacked over layers by the engine: leading ``L`` dim).
+- KV pool (per layer): ``k_pages/v_pages: [num_pages, n_kv, page_size, hd]``
+  (stacked over layers by the engine: leading ``L`` dim). The
+  (page_size, head_dim) minor dims match the bf16 (16, 128) TPU tile so the
+  Pallas decode kernel reads whole pages as aligned blocks.
 - ``page_tables: [B, max_pages]`` int32 — page ids per sequence, in order.
 - ``context_lens: [B]`` int32 — tokens currently in cache per sequence.
 
@@ -68,7 +70,7 @@ def write_prefill_kv(k_pages: jax.Array, v_pages: jax.Array,
     page 0 so bucket padding never overwrites live cache lines.
     """
     B, S = k.shape[0], k.shape[1]
-    page_size = k_pages.shape[1]
+    page_size = k_pages.shape[2]
     pos = prefix_lens[:, None] + jnp.arange(S)[None, :]          # [B, S]
     valid = jnp.arange(S)[None, :] < seq_lens[:, None]
     max_pages = page_table.shape[1]
@@ -76,11 +78,12 @@ def write_prefill_kv(k_pages: jax.Array, v_pages: jax.Array,
         page_table, jnp.clip(pos // page_size, 0, max_pages - 1), axis=1)
     page_idx = jnp.where(valid, page_idx, 0)
     slot = pos % page_size
-    b_flat = page_idx.reshape(-1)
+    p_flat = page_idx.reshape(-1)
     s_flat = slot.reshape(-1)
-    k_pages = k_pages.at[b_flat, s_flat].set(
+    # [N, n_kv, hd] scattered at (page, :, slot, :).
+    k_pages = k_pages.at[p_flat, :, s_flat, :].set(
         k.reshape(B * S, *k.shape[2:]), mode="drop")
-    v_pages = v_pages.at[b_flat, s_flat].set(
+    v_pages = v_pages.at[p_flat, :, s_flat, :].set(
         v.reshape(B * S, *v.shape[2:]), mode="drop")
     return k_pages, v_pages
 
@@ -90,22 +93,22 @@ def write_decode_kv(k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array, context_lens: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Append one token's K/V per sequence. k/v: [B, n_kv, hd]; the new token
     occupies position context_lens[b]."""
-    page_size = k_pages.shape[1]
+    page_size = k_pages.shape[2]
     B = k.shape[0]
     page_idx = jnp.take_along_axis(
         page_table, (context_lens // page_size)[:, None], axis=1)[:, 0]
     slot = context_lens % page_size
-    k_pages = k_pages.at[page_idx, slot].set(k, mode="drop")
-    v_pages = v_pages.at[page_idx, slot].set(v, mode="drop")
+    k_pages = k_pages.at[page_idx, :, slot, :].set(k, mode="drop")
+    v_pages = v_pages.at[page_idx, :, slot, :].set(v, mode="drop")
     return k_pages, v_pages
 
 
 # ----------------------------------------------------------- prefill attn
 def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:
-    """[num_pages, ps, n_kv, hd] x [B, max_pages] -> [B, max_pages*ps, n_kv, hd]."""
-    g = pages[page_table]                     # [B, max_pages, ps, n_kv, hd]
-    B, mp, ps = g.shape[0], g.shape[1], g.shape[2]
-    return g.reshape(B, mp * ps, *g.shape[3:])
+    """[num_pages, n_kv, ps, hd] x [B, max_pages] -> [B, max_pages*ps, n_kv, hd]."""
+    g = pages[page_table]                     # [B, max_pages, n_kv, ps, hd]
+    B, mp, n_kv, ps, hd = g.shape
+    return g.transpose(0, 1, 3, 2, 4).reshape(B, mp * ps, n_kv, hd)
 
 
 def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -165,7 +168,7 @@ def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     callers pass context_lens *including* the new token).
     """
     B, n_heads, hd = q.shape
-    n_kv = k_pages.shape[2]
+    n_kv = k_pages.shape[1]
     n_rep = n_heads // n_kv
     scale = 1.0 / (hd ** 0.5)
 
@@ -179,3 +182,20 @@ def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    page_table: jax.Array,
+                    context_lens: jax.Array) -> jax.Array:
+    """Backend dispatcher: hand-written Pallas kernel on TPU, XLA gather
+    fallback elsewhere (CPU test meshes). Selection happens at trace time —
+    both paths are numerically equivalent (tested)."""
+    import os
+
+    if jax.default_backend() != "cpu" and \
+            os.environ.get("XLLM_DISABLE_PALLAS_ATTENTION", "") in ("", "0"):
+        from .pallas_paged_attention import paged_attention_pallas
+
+        return paged_attention_pallas(q, k_pages, v_pages, page_table,
+                                      context_lens)
+    return paged_attention_xla(q, k_pages, v_pages, page_table, context_lens)

@@ -1,0 +1,127 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The engine's hottest op (SURVEY.md §7.3: "Pallas ragged paged-attention
+kernel quality drives the tok/s/chip north star"). One query token per
+sequence attends over that sequence's KV pages, located via its page table.
+
+Design (vs the XLA gather fallback in ops/attention.py):
+- grid = (batch, max_pages); the page table is a **scalar-prefetch** operand,
+  so each grid step's K/V page block is DMA'd straight from its physical
+  page (``index_map`` reads ``page_table[b, p]``) with Pallas' automatic
+  double-buffering — no [B, T, heads, hd] gather materialization in HBM.
+- online-softmax accumulation in VMEM scratch across the page dimension
+  (flash-attention style m/l/acc carry), GQA handled by a static loop over
+  KV heads with G query rows each.
+- KV page layout: ``[num_pages, n_kv, page_size, head_dim]`` — the per-page
+  block (1, n_kv, ps, hd) keeps (page_size, head_dim) as the minor dims,
+  matching the bf16 (16, 128) tile.
+
+Pages past a sequence's context length contribute nothing (masked; their
+page-table entries point at the reserved garbage page 0).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch
+            q_ref, k_ref, v_ref,                # blocks
+            o_ref,                              # output block
+            m_scr, l_scr, acc_scr,              # VMEM scratch
+            *, page_size: int, n_kv: int, group: int, scale: float):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    ctx = context_lens_ref[b]
+    start = p * page_size
+
+    @pl.when(start < ctx)
+    def _compute():
+        # Valid tokens in this page.
+        token_pos = start + jax.lax.broadcasted_iota(jnp.int32,
+                                                     (1, page_size), 1)
+        mask = (token_pos < ctx)
+        q = q_ref[0].astype(jnp.float32) * scale          # [n_q, hd]
+        for kv in range(n_kv):
+            qh = q[kv * group:(kv + 1) * group, :]        # [G, hd]
+            k = k_ref[0, kv].astype(jnp.float32)          # [ps, hd]
+            v = v_ref[0, kv].astype(jnp.float32)          # [ps, hd]
+            s = jax.lax.dot_general(
+                qh, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)       # [G, ps]
+            s = jnp.where(mask, s, _NEG_INF)
+            rows = slice(kv * group, (kv + 1) * group)
+            m_prev = m_scr[rows, :1]                      # [G, 1]
+            l_prev = l_scr[rows, :1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)     # [G, 1]
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            ps_ = jnp.exp(s - m_new)                      # [G, ps]
+            l_new = l_prev * alpha + jnp.sum(ps_, axis=1, keepdims=True)
+            acc_scr[rows, :] = acc_scr[rows, :] * alpha + \
+                jax.lax.dot_general(ps_, v, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            m_scr[rows, :1] = m_new
+            l_scr[rows, :1] = l_new
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-9)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_table: jax.Array,
+                           context_lens: jax.Array,
+                           interpret: bool = False) -> jax.Array:
+    """q: [B, n_q, hd]; k/v_pages: [pages, n_kv, ps, hd];
+    page_table: [B, max_pages] i32; context_lens: [B] i32 (incl. the new
+    token, whose K/V must already be written). Returns [B, n_q, hd]."""
+    B, n_q, hd = q.shape
+    _, n_kv, page_size, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    group = n_q // n_kv
+    scale = 1.0 / (hd ** 0.5)
+
+    kernel = functools.partial(_kernel, page_size=page_size, n_kv=n_kv,
+                               group=group, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, n_q, hd), lambda b, p, pt, cl: (b, 0, 0)),
+            pl.BlockSpec((1, n_kv, page_size, hd),
+                         lambda b, p, pt, cl: (pt[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, n_kv, page_size, hd),
+                         lambda b, p, pt, cl: (pt[b, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n_q, hd), lambda b, p, pt, cl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_q, 128), jnp.float32),   # m
+            pltpu.VMEM((n_q, 128), jnp.float32),   # l
+            pltpu.VMEM((n_q, hd), jnp.float32),    # acc
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, n_q, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(page_table, context_lens, q, k_pages, v_pages)

@@ -57,9 +57,9 @@ MOE_RULES = ShardingRules(rules=[
     *LLAMA_RULES.rules,
 ])
 
-# KV pages: [layers, pages, page_size, kv_heads, head_dim] — kv heads on
+# KV pages: [layers, 2, pages, kv_heads, page_size, head_dim] — kv heads on
 # `model` (must divide), pages replicated within an instance.
-KV_PAGES_SPEC = P(None, None, None, AXIS_MODEL, None)
+KV_PAGES_SPEC = P(None, None, None, AXIS_MODEL, None, None)
 # Decode activations: batch on `data`.
 BATCH_SPEC = P(AXIS_DATA)
 

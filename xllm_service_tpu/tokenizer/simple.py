@@ -27,7 +27,9 @@ class SimpleTokenizer(Tokenizer):
         data = bytearray()
         for i in ids:
             if i >= _BYTE_OFFSET:
-                data.append(i - _BYTE_OFFSET)
+                # Ids beyond the byte range (e.g. random bench vocabularies)
+                # fold back into bytes — decode must never throw.
+                data.append((i - _BYTE_OFFSET) % 256)
             elif not skip_special_tokens and i in self._special_by_id:
                 data.extend(self._special_by_id[i].encode("utf-8"))
         return data.decode("utf-8", errors="replace")
