@@ -24,21 +24,60 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+import msgpack
+import numpy as np
 import requests as _requests
 from aiohttp import web
 
 import jax
 
-from ..common.request import RequestOutput, SamplingParams
+from ..common.request import LogProb, RequestOutput, SamplingParams, Status, StatusCode
 from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
 from ..coordination import CoordinationClient, connect
 from ..rpc import MASTER_KEY, instance_key
 from ..tokenizer import TokenizerFactory
 from ..utils import get_local_ip, get_logger, pick_free_port
 from .config import EngineConfig
-from .engine import EngineRequest, InferenceEngine
+from .engine import EngineRequest, InferenceEngine, PrefillHandoff
 
 logger = get_logger(__name__)
+
+
+def pack_handoff(h: PrefillHandoff, source_service_addr: str) -> bytes:
+    """Serialize a PD handoff for the DCN transfer path (msgpack + raw
+    array bytes; bf16 carried as ml_dtypes bytes)."""
+    lp = h.first_logprob
+    return msgpack.packb({
+        "service_request_id": h.service_request_id,
+        "request_id": h.request_id,
+        "source_service_addr": source_service_addr,
+        "token_ids": h.token_ids,
+        "first_token": h.first_token,
+        "first_logprob": None if lp is None else {
+            "token": lp.token, "token_id": lp.token_id,
+            "logprob": lp.logprob,
+            "top": [(t.token, t.token_id, t.logprob)
+                    for t in lp.top_logprobs]},
+        "sampling": h.sampling.to_dict(),
+        "kv": {"bytes": h.kv_blob.tobytes(),
+               "shape": list(h.kv_blob.shape),
+               "dtype": str(h.kv_blob.dtype)},
+    }, use_bin_type=True)
+
+
+def unpack_handoff(data: bytes) -> dict:
+    obj = msgpack.unpackb(data, raw=False)
+    kv = obj["kv"]
+    dtype = kv["dtype"]
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        np_dtype = ml_dtypes.bfloat16
+    else:
+        np_dtype = np.dtype(dtype)
+    obj["kv_blob"] = np.frombuffer(kv["bytes"], dtype=np_dtype).reshape(
+        kv["shape"])
+    return obj
 
 
 @dataclass
@@ -215,6 +254,7 @@ class EngineAgent:
         app.router.add_post("/rpc/unlink", self._h_unlink)
         app.router.add_post("/rpc/cancel", self._h_cancel)
         app.router.add_post("/rpc/flip_role", self._h_flip)
+        app.router.add_post("/rpc/kv_transfer", self._h_kv_transfer)
 
         async def _start():
             self._runner = web.AppRunner(app)
@@ -344,11 +384,90 @@ class EngineAgent:
         def on_output(out: RequestOutput) -> None:
             self.streamer.push(dest, out)
 
+        # PD disaggregation: a PREFILL-role instance with a routed decode
+        # peer prefills, then ships KV + first token to the peer, which owns
+        # the stream from there (reference PD pipeline, SURVEY.md §2.12).
+        decode_name = (body.get("routing") or {}).get("decode_name", "")
+        if self.instance_type == InstanceType.PREFILL and decode_name \
+                and decode_name != self.name:
+
+            def on_prefill_done(h: PrefillHandoff,
+                                _peer: str = decode_name,
+                                _dest: str = dest) -> None:
+                threading.Thread(
+                    target=self._transfer_to_peer, daemon=True,
+                    args=(h, _peer, _dest),
+                    name=f"kv-transfer-{h.service_request_id}").start()
+
+            self.engine.submit(EngineRequest(
+                service_request_id=sid,
+                request_id=body.get("request_id", sid),
+                token_ids=token_ids, sampling=sampling,
+                prefill_only=True, on_prefill_done=on_prefill_done,
+                on_output=on_output))   # surfaces prefill-side errors
+            return web.json_response({"ok": True,
+                                      "service_request_id": sid})
+
         self.engine.submit(EngineRequest(
             service_request_id=sid,
             request_id=body.get("request_id", sid),
             token_ids=token_ids, sampling=sampling, on_output=on_output))
         return web.json_response({"ok": True, "service_request_id": sid})
+
+    def _transfer_to_peer(self, h: PrefillHandoff, peer: str,
+                          dest: str) -> None:
+        try:
+            r = _requests.post(f"http://{peer}/rpc/kv_transfer",
+                               data=pack_handoff(h, dest),
+                               headers={"Content-Type":
+                                        "application/msgpack"},
+                               timeout=60)
+            if r.status_code != 200:
+                raise RuntimeError(f"peer returned {r.status_code}: "
+                                   f"{r.text[:200]}")
+        except Exception as e:  # noqa: BLE001
+            logger.warning("KV transfer of %s to %s failed: %s",
+                           h.service_request_id, peer, e)
+            self.streamer.push(dest, RequestOutput(
+                service_request_id=h.service_request_id,
+                request_id=h.request_id,
+                status=Status(StatusCode.UNAVAILABLE,
+                              f"KV transfer to decode peer failed: {e}"),
+                finished=True))
+
+    async def _h_kv_transfer(self, req: web.Request) -> web.Response:
+        """Decode side of the PD handoff: accept prompt KV + first token,
+        inject into the local decode batch."""
+        data = await req.read()
+        try:
+            obj = unpack_handoff(data)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": f"bad handoff: {e}"},
+                                     status=400)
+        dest = obj.get("source_service_addr", "")
+        lp_d = obj.get("first_logprob")
+        lp = None
+        if lp_d:
+            from ..common.request import LogProbData
+
+            lp = LogProb(token=lp_d["token"], token_id=lp_d["token_id"],
+                         logprob=lp_d["logprob"],
+                         top_logprobs=[LogProbData(t[0], t[1], t[2])
+                                       for t in lp_d.get("top", ())])
+
+        def on_output(out: RequestOutput) -> None:
+            self.streamer.push(dest, out)
+
+        self.engine.submit(EngineRequest(
+            service_request_id=obj["service_request_id"],
+            request_id=obj.get("request_id", ""),
+            token_ids=list(obj["token_ids"]),
+            sampling=SamplingParams.from_dict(obj.get("sampling", {})),
+            injected_first_token=int(obj["first_token"]),
+            injected_kv=obj["kv_blob"],
+            injected_first_logprob=lp,
+            on_output=on_output))
+        return web.json_response({"ok": True})
 
     @staticmethod
     def _sampling_from_body(body: dict[str, Any]) -> SamplingParams:

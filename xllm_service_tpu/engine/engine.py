@@ -68,6 +68,36 @@ class EngineRequest:
     sampling: SamplingParams = field(default_factory=SamplingParams)
     # Called from the engine thread with each RequestOutput delta.
     on_output: Callable[[RequestOutput], None] = lambda out: None
+    # PD disaggregation: prefill-only requests run prefill, then hand the
+    # sequence (first token + KV pages) to `on_prefill_done` instead of
+    # entering the local decode batch (SURVEY.md §2.12 PD pipeline).
+    prefill_only: bool = False
+    on_prefill_done: Optional[Callable[["PrefillHandoff"], None]] = None
+    # Decode-side injection: sequence arrives with prompt KV precomputed.
+    injected_first_token: Optional[int] = None
+    injected_kv: Optional[np.ndarray] = None
+    injected_first_logprob: Optional["LogProb"] = None
+
+
+@dataclass
+class PrefillHandoff:
+    """Everything the decode peer needs to continue a prefilled sequence.
+
+    Replaces the reference's opaque engine-side KV transfer (negotiated via
+    Link ops with NIC endpoints, `instance_mgr.cpp:1087-1113`) with an
+    explicit contract: prompt token ids, the first sampled token (+logprob),
+    and the prompt's KV pages as one array [L, 2, n_pages, n_kv, ps, hd].
+    On-host here (DCN path); same-slice ICI device-to-device transfer slots
+    in behind the same structure.
+    """
+
+    service_request_id: str
+    request_id: str
+    token_ids: list[int]
+    first_token: int
+    first_logprob: Optional[LogProb]
+    sampling: SamplingParams
+    kv_blob: np.ndarray
 
 
 @dataclass
@@ -242,6 +272,45 @@ class InferenceEngine:
 
         self._clear_slot = clear_slot
 
+        @jax.jit
+        def extract_kv(d, page_ids):
+            """Gather a sequence's pages: [L, 2, n, n_kv, ps, hd]."""
+            return d["kv"][:, :, page_ids]
+
+        self._extract_kv = extract_kv
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def inject_install(d, kv_blob, ints, floats, counts_row, key):
+            """Install a remotely-prefilled sequence (PD decode side):
+            scatter the transferred prompt KV into local pages + install the
+            batch slot with the prefill-produced first token.
+
+            ints: [P + 3] = [page_row(P), slot, prompt_len, first_token].
+            """
+            page_row = ints[:P]
+            slot = ints[P]
+            plen = ints[P + 1]
+            first = ints[P + 2]
+            nb = kv_blob.shape[2]
+            d = dict(d)
+            d["kv"] = d["kv"].at[:, :, page_row[:nb]].set(
+                kv_blob.astype(d["kv"].dtype))
+            d["pt"] = d["pt"].at[slot].set(page_row)
+            d["last"] = d["last"].at[slot].set(first)
+            d["clens"] = d["clens"].at[slot].set(plen + 1)
+            d["active"] = d["active"].at[slot].set(True)
+            d["temp"] = d["temp"].at[slot].set(floats[0])
+            d["topk"] = d["topk"].at[slot].set(floats[1].astype(jnp.int32))
+            d["topp"] = d["topp"].at[slot].set(floats[2])
+            d["fp"] = d["fp"].at[slot].set(floats[3])
+            d["pp"] = d["pp"].at[slot].set(floats[4])
+            d["rp"] = d["rp"].at[slot].set(floats[5])
+            d["keys"] = d["keys"].at[slot].set(key)
+            d["counts"] = d["counts"].at[slot].set(counts_row)
+            return d
+
+        self._inject_install = inject_install
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceEngine":
         self._thread = threading.Thread(target=self._loop, name="engine-loop",
@@ -355,12 +424,32 @@ class InferenceEngine:
                 return admitted
             admitted = True
 
+    def _page_bucket(self, n_pages: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n_pages <= b // self.cfg.page_size:
+                return b // self.cfg.page_size
+        return self.cfg.pages_per_seq
+
+    def extract_kv_pages(self, pages: list[int]) -> np.ndarray:
+        """Fetch a sequence's KV pages to host (PD handoff, DCN path)."""
+        nb = self._page_bucket(len(pages))
+        ids = np.full((nb,), GARBAGE_PAGE, np.int32)
+        ids[:len(pages)] = pages
+        blob = self._extract_kv(self._dstate, jnp.asarray(ids))
+        return np.asarray(blob)[:, :, :len(pages)]
+
     def _start_sequence(self, req: EngineRequest) -> bool:
+        if req.injected_kv is not None:
+            return self._start_injected(req)
         cfg = self.cfg
         prompt = req.token_ids
         P0 = len(prompt)
-        max_new = max(1, min(req.sampling.max_tokens,
-                             cfg.max_seq_len - P0))
+        if req.prefill_only:
+            # Prefill role: produce exactly the first token, then hand off.
+            max_new = 1
+        else:
+            max_new = max(1, min(req.sampling.max_tokens,
+                                 cfg.max_seq_len - P0))
         max_total = min(P0 + max_new, cfg.max_seq_len)
 
         # Prefix-cache match (block-aligned; keep at least 1 suffix token so
@@ -402,8 +491,91 @@ class InferenceEngine:
         seq.pages.donated_hashes = stored
         seq.pages.donated_pages = donated
 
+        if req.prefill_only and req.on_prefill_done is not None:
+            # PD handoff: extract prompt KV, free local resources, and let
+            # the agent ship the sequence to its decode peer.
+            n_prompt_pages = -(-P0 // cfg.page_size)
+            blob = self.extract_kv_pages(
+                seq.pages.all_pages[:n_prompt_pages])
+            handoff = PrefillHandoff(
+                service_request_id=req.service_request_id,
+                request_id=req.request_id,
+                token_ids=list(prompt), first_token=first_token,
+                first_logprob=lp, sampling=req.sampling, kv_blob=blob)
+            self._dstate = self._clear_slot(self._dstate,
+                                            jnp.int32(seq.slot))
+            with self._lock:
+                self._free_slots.append(seq.slot)
+            seq.pages.release(self.page_mgr)
+            try:
+                req.on_prefill_done(handoff)
+            except Exception:  # noqa: BLE001
+                logger.exception("prefill handoff callback failed for %s",
+                                 req.service_request_id)
+            return True
+
         self._running[seq.slot] = seq
         self._emit_token(seq, first_token, lp)
+        return True
+
+    def _start_injected(self, req: EngineRequest) -> bool:
+        """PD decode side: admit a sequence whose prompt KV arrives from the
+        prefill peer."""
+        cfg = self.cfg
+        prompt = req.token_ids
+        P0 = len(prompt)
+        max_new = max(1, min(req.sampling.max_tokens,
+                             cfg.max_seq_len - P0))
+        max_total = min(P0 + max_new, cfg.max_seq_len)
+        total_pages = -(-max_total // cfg.page_size)
+        own_pages = self.page_mgr.allocate(total_pages)
+        if own_pages is None:
+            return False
+        seq = _Sequence(req=req, pages=SequencePages(own_pages=own_pages),
+                        prompt_len=P0, context_len=P0, max_total_len=max_total)
+        with self._lock:
+            seq.slot = self._free_slots.pop()
+
+        blob = req.injected_kv
+        nb = self._page_bucket(blob.shape[2])
+        if blob.shape[2] < nb:   # pad to the page bucket (jit shape reuse)
+            pad = np.zeros((*blob.shape[:2], nb - blob.shape[2],
+                            *blob.shape[3:]), blob.dtype)
+            blob = np.concatenate([blob, pad], axis=2)
+        first_token = int(req.injected_first_token)
+
+        P = cfg.pages_per_seq
+        sp = req.sampling
+        ints = np.full((P + 3,), GARBAGE_PAGE, np.int32)
+        ints[:len(own_pages)] = own_pages
+        ints[P] = seq.slot
+        ints[P + 1] = P0
+        ints[P + 2] = first_token
+        floats = np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
+                             sp.frequency_penalty, sp.presence_penalty,
+                             sp.repetition_penalty if sp.repetition_penalty > 0
+                             else 1.0], np.float32)
+        counts_row = np.bincount(
+            np.asarray(prompt + [first_token], np.int64),
+            minlength=cfg.model.vocab_size)[:cfg.model.vocab_size] \
+            .astype(np.int32)
+        self._rng, slot_key = jax.random.split(self._rng)
+        if sp.seed is not None:
+            slot_key = jax.random.PRNGKey(sp.seed)
+        self._dstate = self._inject_install(
+            self._dstate, jnp.asarray(blob), jnp.asarray(ints),
+            jnp.asarray(floats), jnp.asarray(counts_row), slot_key)
+
+        # Donate the transferred prompt blocks to the local prefix cache.
+        stored, donated = self.page_mgr.store_prefix(prompt,
+                                                     seq.pages.all_pages)
+        seq.pages.donated_hashes = stored
+        seq.pages.donated_pages = donated
+
+        self._running[seq.slot] = seq
+        # The decode side emits everything, starting with the prefill-
+        # produced first token (single ordered stream to the service).
+        self._emit_token(seq, first_token, req.injected_first_logprob)
         return True
 
     def _bucket_for(self, n: int) -> int:
