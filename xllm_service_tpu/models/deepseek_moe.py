@@ -1,0 +1,192 @@
+"""DeepSeek-V2-style MoE family (BASELINE config 4: expert-parallel decode).
+
+Mixture-of-experts transformer with shared + routed experts and top-k
+softmax gating, designed for **expert parallelism over the mesh `expert`
+axis**: expert-stacked weights `[L, E, D, F]` are sharded on E, every token
+is scored against all experts with a dense dispatch einsum, and the gated
+combine contracts the expert dimension — GSPMD turns that contraction into
+a psum over the expert axis (the TPU-idiomatic EP decode; no all-to-all
+token shuffling needed at serving batch sizes).
+
+Attention is GQA+RoPE as in the llama family (DeepSeek's MLA compression is
+a follow-up optimization; the serving contract — paged KV, prefill/decode
+programs — is identical). First-k-dense-layers is approximated as all-MoE
+with a shared expert (`first_dense_layers=0`), which preserves the
+compute/communication shape EP benchmarking cares about.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import (
+    paged_attention,
+    prefill_attention,
+    rms_norm,
+    write_decode_kv,
+    write_prefill_kv,
+)
+from ..parallel.mesh import AXIS_EXPERT, AXIS_MODEL
+from ..parallel.sharding import ShardingRules
+from .base import ModelConfig, ModelFamily, register_model_family
+from .llama import _project_qkv, _unembed
+
+Params = dict
+
+MOE_STACKED_RULES = ShardingRules(rules=[
+    (r"experts/(gate_proj|up_proj)/kernel",
+     P(None, AXIS_EXPERT, None, AXIS_MODEL)),          # [L, E, D, F]
+    (r"experts/down_proj/kernel",
+     P(None, AXIS_EXPERT, AXIS_MODEL, None)),          # [L, E, F, D]
+    (r"shared/(gate_proj|up_proj)/kernel", P(None, None, AXIS_MODEL)),
+    (r"shared/down_proj/kernel", P(None, AXIS_MODEL, None)),
+    (r"router/kernel", P()),
+    (r"embed/embedding", P(AXIS_MODEL, None)),
+    (r"(q_proj|k_proj|v_proj)/kernel", P(None, None, AXIS_MODEL)),
+    (r"o_proj/kernel", P(None, AXIS_MODEL, None)),
+    (r"lm_head/kernel", P(None, AXIS_MODEL)),
+])
+
+
+def deepseek_v2_lite_config() -> ModelConfig:
+    return ModelConfig(name="deepseek_moe", vocab_size=102400,
+                       hidden_size=2048, num_layers=27, num_heads=16,
+                       num_kv_heads=16, head_dim=128, ffn_size=10944,
+                       rope_theta=10000.0, max_context_len=32768,
+                       num_experts=64, num_experts_per_token=6,
+                       num_shared_experts=2, moe_ffn_size=1408,
+                       first_dense_layers=0)
+
+
+def tiny_moe_config(**kw) -> ModelConfig:
+    defaults = dict(name="deepseek_moe", vocab_size=512, hidden_size=128,
+                    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
+                    ffn_size=256, max_context_len=512, num_experts=4,
+                    num_experts_per_token=2, num_shared_experts=1,
+                    moe_ffn_size=64, first_dense_layers=0)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
+    keys = jax.random.split(rng, 12)
+    D, L, E = cfg.hidden_size, cfg.num_layers, cfg.num_experts
+    Hq, Hkv = cfg.q_size, cfg.kv_size
+    Fe = cfg.moe_ffn_size
+    Fs = cfg.moe_ffn_size * max(1, cfg.num_shared_experts)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    return {
+        "embed": {"embedding": dense(keys[0], (cfg.vocab_size, D), D)},
+        "layers": {
+            "input_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
+            "q_proj": {"kernel": dense(keys[1], (L, D, Hq), D)},
+            "k_proj": {"kernel": dense(keys[2], (L, D, Hkv), D)},
+            "v_proj": {"kernel": dense(keys[3], (L, D, Hkv), D)},
+            "o_proj": {"kernel": dense(keys[4], (L, Hq, D), Hq)},
+            "post_attn_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
+            "router": {"kernel": dense(keys[5], (L, D, E), D)
+                       .astype(jnp.float32)},
+            "experts": {
+                "gate_proj": {"kernel": dense(keys[6], (L, E, D, Fe), D)},
+                "up_proj": {"kernel": dense(keys[7], (L, E, D, Fe), D)},
+                "down_proj": {"kernel": dense(keys[8], (L, E, Fe, D), Fe)},
+            },
+            "shared": {
+                "gate_proj": {"kernel": dense(keys[9], (L, D, Fs), D)},
+                "up_proj": {"kernel": dense(keys[10], (L, D, Fs), D)},
+                "down_proj": {"kernel": dense(keys[11], (L, Fs, D), Fs)},
+            },
+        },
+        "final_norm": {"scale": jnp.ones((D,), cfg.dtype)},
+        "lm_head": {"kernel": dense(jax.random.fold_in(rng, 99),
+                                    (D, cfg.vocab_size), D)},
+    }
+
+
+def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """x: [..., D] -> [..., D]. Dense dispatch: all experts score all
+    tokens; the combine contracts the (sharded) expert axis."""
+    orig_shape = x.shape
+    x2 = x.reshape(-1, orig_shape[-1])                     # [T, D]
+    # Router in f32 for stable softmax.
+    logits = x2.astype(jnp.float32) @ lp["router"]["kernel"]   # [T, E]
+    k = cfg.num_experts_per_token
+    topv, topi = jax.lax.top_k(logits, k)
+    gates_k = jax.nn.softmax(topv, axis=-1)                # [T, k]
+    # Scatter the top-k gates back to a dense [T, E] map.
+    gates = jnp.zeros_like(logits).at[
+        jnp.arange(x2.shape[0])[:, None], topi].set(gates_k)
+
+    g = jnp.einsum("td,edf->etf", x2, lp["experts"]["gate_proj"]["kernel"])
+    u = jnp.einsum("td,edf->etf", x2, lp["experts"]["up_proj"]["kernel"])
+    h = jax.nn.silu(g) * u                                 # [E, T, Fe]
+    eo = jnp.einsum("etf,efd->etd", h, lp["experts"]["down_proj"]["kernel"])
+    routed = jnp.einsum("etd,te->td", eo.astype(jnp.float32),
+                        gates).astype(x.dtype)
+
+    sg = jnp.einsum("td,df->tf", x2, lp["shared"]["gate_proj"]["kernel"])
+    su = jnp.einsum("td,df->tf", x2, lp["shared"]["up_proj"]["kernel"])
+    shared = jnp.einsum("tf,fd->td", jax.nn.silu(sg) * su,
+                        lp["shared"]["down_proj"]["kernel"])
+    return (routed + shared).reshape(orig_shape)
+
+
+def _layer_factory(cfg: ModelConfig, mode: str, page_table, prefix_lens,
+                   seq_lens, positions, context_lens):
+    def layer(x, inputs):
+        lp, kv = inputs
+        h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
+        q, k, v = _project_qkv(lp, h, cfg, positions)
+        k_pages, v_pages = kv[0], kv[1]
+        if mode == "prefill":
+            k_pages, v_pages = write_prefill_kv(
+                k_pages, v_pages, k, v, page_table, prefix_lens, seq_lens)
+            attn = prefill_attention(q, k, v, k_pages, v_pages, page_table,
+                                     prefix_lens, seq_lens)
+        else:
+            k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
+                                               page_table, positions)
+            attn = paged_attention(q, k_pages, v_pages, page_table,
+                                   context_lens)
+        attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
+        x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
+        h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+        x = x + _moe_mlp(lp, h2, cfg)
+        return x, jnp.stack([k_pages, v_pages])
+
+    return layer
+
+
+def prefill_forward(params, cfg, tokens, positions, kv_pages, page_table,
+                    prefix_lens, seq_lens):
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    layer = _layer_factory(cfg, "prefill", page_table, prefix_lens,
+                           seq_lens, positions, None)
+    x, new_kv = jax.lax.scan(layer, x, (params["layers"], kv_pages))
+    idx = jnp.maximum(seq_lens - 1, 0)
+    last = x[jnp.arange(x.shape[0]), idx]
+    return _unembed(params, cfg, last), new_kv
+
+
+def decode_forward(params, cfg, tokens, positions, kv_pages, page_table,
+                   context_lens):
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    layer = _layer_factory(cfg, "decode", page_table, None, None, positions,
+                           context_lens)
+    x, new_kv = jax.lax.scan(layer, x, (params["layers"], kv_pages))
+    return _unembed(params, cfg, x), new_kv
+
+
+register_model_family(ModelFamily(
+    name="deepseek_moe",
+    init_params=init_params,
+    prefill_forward=prefill_forward,
+    decode_forward=decode_forward,
+    sharding_rules=MOE_STACKED_RULES,
+))

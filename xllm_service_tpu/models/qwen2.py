@@ -1,0 +1,40 @@
+"""Qwen2/2.5 family (BASELINE config 3: Qwen2-72B dynamic PD-ratio).
+
+Architecturally the llama family with per-projection qkv biases
+(`qkv_bias=True` in ModelConfig) and its own default dimensions; all forward
+paths are shared with models/llama.py (the bias is applied inside
+`_project_qkv` when present).
+"""
+
+from __future__ import annotations
+
+from .base import ModelConfig, ModelFamily, register_model_family
+from .llama import (
+    LLAMA_STACKED_RULES,
+    decode_forward,
+    init_params,
+    prefill_forward,
+)
+
+
+def qwen2_7b_config() -> ModelConfig:
+    return ModelConfig(name="qwen2", vocab_size=152064, hidden_size=3584,
+                       num_layers=28, num_heads=28, num_kv_heads=4,
+                       head_dim=128, ffn_size=18944, rope_theta=1000000.0,
+                       qkv_bias=True, max_context_len=32768)
+
+
+def qwen2_72b_config() -> ModelConfig:
+    return ModelConfig(name="qwen2", vocab_size=152064, hidden_size=8192,
+                       num_layers=80, num_heads=64, num_kv_heads=8,
+                       head_dim=128, ffn_size=29568, rope_theta=1000000.0,
+                       qkv_bias=True, max_context_len=32768)
+
+
+register_model_family(ModelFamily(
+    name="qwen2",
+    init_params=init_params,
+    prefill_forward=prefill_forward,
+    decode_forward=decode_forward,
+    sharding_rules=LLAMA_STACKED_RULES,
+))
