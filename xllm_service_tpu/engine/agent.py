@@ -411,7 +411,9 @@ class EngineAgent:
         self.engine.submit(EngineRequest(
             service_request_id=sid,
             request_id=body.get("request_id", sid),
-            token_ids=token_ids, sampling=sampling, on_output=on_output))
+            token_ids=token_ids, sampling=sampling, on_output=on_output,
+            offline=bool(body.get("offline", False)),
+            priority=int(body.get("priority") or 0)))
         return web.json_response({"ok": True, "service_request_id": sid})
 
     def _transfer_to_peer(self, h: PrefillHandoff, peer: str,

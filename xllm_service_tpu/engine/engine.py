@@ -68,6 +68,17 @@ class EngineRequest:
     sampling: SamplingParams = field(default_factory=SamplingParams)
     # Called from the engine thread with each RequestOutput delta.
     on_output: Callable[[RequestOutput], None] = lambda out: None
+    # Online/offline hybrid scheduling (reference carries only the
+    # `Request::offline` hook, `request/request.h:41` — the mechanism is
+    # ours): offline requests yield admission priority to online traffic
+    # and may be preempted (sequence re-queued as a continuation; generated
+    # tokens are kept and re-prefilled, so the client stream never repeats).
+    offline: bool = False
+    priority: int = 0
+    # Continuation state installed by preemption (internal).
+    resume_output_ids: list[int] = field(default_factory=list)
+    resume_emitted_chars: int = 0
+    resume_logprobs: list[LogProb] = field(default_factory=list)
     # PD disaggregation: prefill-only requests run prefill, then hand the
     # sequence (first token + KV pages) to `on_prefill_done` instead of
     # entering the local decode batch (SURVEY.md §2.12 PD pipeline).
@@ -172,6 +183,7 @@ class InferenceEngine:
         self.recent_max_ttft_ms = 0.0
         self.recent_max_tbt_ms = 0.0
         self.total_generated = 0
+        self.preemption_count = 0
 
     # ---------------------------------------------------------- properties
     @property
@@ -410,19 +422,79 @@ class InferenceEngine:
             status=Status(StatusCode.CANCELLED, "cancelled"), finished=True))
 
     # ------------------------------------------------------------ admission
+    def _pop_next_waiting(self) -> Optional[EngineRequest]:
+        """Admission order: online before offline; higher priority first
+        within a class; FIFO otherwise. Must hold the lock."""
+        if not self._waiting:
+            return None
+        best_i, best_key = 0, None
+        for i, r in enumerate(self._waiting):
+            key = (0 if not r.offline else 1, -r.priority, i)
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
+        self._waiting.rotate(-best_i)
+        req = self._waiting.popleft()
+        self._waiting.rotate(best_i)
+        return req
+
     def _admit(self) -> bool:
         admitted = False
         while True:
             with self._lock:
-                if not self._waiting or not self._free_slots:
+                if not self._free_slots:
                     return admitted
-                req = self._waiting.popleft()
+                req = self._pop_next_waiting()
+                if req is None:
+                    return admitted
             if not self._start_sequence(req):
-                # Not enough KV pages: put it back and stop admitting.
+                # Not enough KV pages. An online request may preempt a
+                # running offline sequence to make room.
+                if not req.offline and self._preempt_one_offline():
+                    if self._start_sequence(req):
+                        admitted = True
+                        continue
                 with self._lock:
                     self._waiting.appendleft(req)
                 return admitted
             admitted = True
+
+    def _preempt_one_offline(self) -> bool:
+        """Evict the most recently admitted offline sequence; its progress
+        is preserved as a continuation request (prompt + generated tokens
+        re-prefilled on readmission)."""
+        victim: Optional[_Sequence] = None
+        for seq in self._running.values():
+            if seq.req.offline and not seq.finished:
+                victim = seq   # dict preserves insertion order: keep last
+        if victim is None:
+            return False
+        req = victim.req
+        cont = EngineRequest(
+            service_request_id=req.service_request_id,
+            request_id=req.request_id,
+            token_ids=list(req.token_ids),
+            sampling=req.sampling, on_output=req.on_output,
+            offline=True, priority=req.priority,
+            resume_output_ids=list(victim.output_ids),
+            resume_emitted_chars=victim.emitted_chars,
+            resume_logprobs=list(victim.logprobs))
+        logger.info("preempting offline request %s after %d tokens",
+                    req.service_request_id, len(victim.output_ids))
+        self.preemption_count += 1
+        self._release_slot_and_pages(victim)
+        victim.finished = True
+        with self._lock:
+            self._waiting.append(cont)
+        return True
+
+    def _release_slot_and_pages(self, seq: _Sequence) -> None:
+        if seq.slot >= 0 and seq.slot in self._running:
+            del self._running[seq.slot]
+            self._dstate = self._clear_slot(self._dstate,
+                                            jnp.int32(seq.slot))
+            with self._lock:
+                self._free_slots.append(seq.slot)
+        seq.pages.release(self.page_mgr)
 
     def _page_bucket(self, n_pages: int) -> int:
         for b in self.cfg.prefill_buckets:
@@ -442,8 +514,9 @@ class InferenceEngine:
         if req.injected_kv is not None:
             return self._start_injected(req)
         cfg = self.cfg
-        prompt = req.token_ids
-        P0 = len(prompt)
+        # Continuations (offline preemption) re-prefill prompt + generated.
+        prompt = req.token_ids + req.resume_output_ids
+        P0 = len(req.token_ids)
         if req.prefill_only:
             # Prefill role: produce exactly the first token, then hand off.
             max_new = 1
@@ -451,6 +524,9 @@ class InferenceEngine:
             max_new = max(1, min(req.sampling.max_tokens,
                                  cfg.max_seq_len - P0))
         max_total = min(P0 + max_new, cfg.max_seq_len)
+        if len(prompt) >= cfg.max_seq_len:
+            self._emit_cancelled(req)
+            return True
 
         # Prefix-cache match (block-aligned; keep at least 1 suffix token so
         # prefill produces the next-token logits).
@@ -475,7 +551,10 @@ class InferenceEngine:
             pages=SequencePages(cached_hashes=cached_hashes,
                                 cached_pages=cached_pages,
                                 own_pages=own_pages),
-            prompt_len=P0, context_len=P0, max_total_len=max_total)
+            prompt_len=P0, context_len=len(prompt), max_total_len=max_total,
+            output_ids=list(req.resume_output_ids),
+            emitted_chars=req.resume_emitted_chars,
+            logprobs=list(req.resume_logprobs))
         with self._lock:
             seq.slot = self._free_slots.pop()
 
