@@ -1,0 +1,125 @@
+"""Qwen2-VL: vision encoder, placeholder splicing, and the ENCODE-role
+endpoint (EPD stage contract)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.models.base import get_model_family
+from xllm_service_tpu.models.qwen2_vl import (
+    IMAGE_TOKEN_ID,
+    encode_images,
+    splice_mm_embeds,
+    tiny_vl_config,
+)
+
+
+def alloc_pages(cfg, num_pages, page_size=16):
+    return jnp.zeros((cfg.num_layers, 2, num_pages, cfg.num_kv_heads,
+                      page_size, cfg.head_dim), cfg.dtype)
+
+
+class TestQwen2VL:
+    def _setup(self):
+        cfg = tiny_vl_config(dtype=jnp.float32, image_token_id=100)
+        fam = get_model_family("qwen2_vl")
+        params = fam.init_params(cfg, jax.random.PRNGKey(0))
+        return cfg, fam, params
+
+    def test_encoder_shapes(self):
+        cfg, fam, params = self._setup()
+        pixels = jax.random.normal(jax.random.PRNGKey(1), (2, 28, 28, 3))
+        emb = encode_images(params, cfg, pixels)
+        assert emb.shape == (2, cfg.vision.out_tokens, cfg.hidden_size)
+        assert bool(jnp.all(jnp.isfinite(emb)))
+
+    def test_splice_replaces_placeholders(self):
+        cfg, fam, params = self._setup()
+        img_tok = 100   # use an in-vocab id for the tiny config
+        toks = jnp.array([[1, img_tok, img_tok, 4, 5]], jnp.int32)
+        mm = jnp.ones((1, 2, cfg.hidden_size), jnp.float32) * 7.0
+        x = splice_mm_embeds(params, cfg, toks, mm, image_token_id=img_tok)
+        np.testing.assert_allclose(np.asarray(x[0, 1]), 7.0)
+        np.testing.assert_allclose(np.asarray(x[0, 2]), 7.0)
+        # Non-placeholder positions keep their token embeddings.
+        ref = params["embed"]["embedding"][jnp.array([1])][0]
+        np.testing.assert_allclose(np.asarray(x[0, 0]), np.asarray(ref))
+
+    def test_multimodal_prefill_runs_and_differs(self):
+        """Visual embeddings must influence the logits."""
+        cfg, fam, params = self._setup()
+        img_tok = 100
+        T = 12
+        toks = jnp.asarray([[2, img_tok, img_tok, 5, 6, 7, 8, 9, 10, 11,
+                             12, 13]], jnp.int32)
+        pt = jnp.arange(4, dtype=jnp.int32)[None, :]
+        pos = jnp.arange(T)[None, :]
+        pixels = jax.random.normal(jax.random.PRNGKey(2), (1, 28, 28, 3))
+        mm = encode_images(params, cfg, pixels)[:, :2]
+
+        import functools
+        from xllm_service_tpu.models import qwen2_vl as vl
+
+        with_img, _ = vl.prefill_forward(
+            params, cfg, toks, pos, alloc_pages(cfg, 8), pt,
+            jnp.zeros((1,), jnp.int32), jnp.asarray([T], jnp.int32),
+            mm_embeds=jax.lax.cond(
+                True, lambda: mm, lambda: mm))   # exercise traced path
+        # Splicing under a different image must change the logits.
+        pixels2 = jax.random.normal(jax.random.PRNGKey(3), (1, 28, 28, 3))
+        mm2 = encode_images(params, cfg, pixels2)[:, :2]
+        with_img2, _ = vl.prefill_forward(
+            params, cfg, toks, pos, alloc_pages(cfg, 8), pt,
+            jnp.zeros((1,), jnp.int32), jnp.asarray([T], jnp.int32),
+            mm_embeds=mm2)
+        assert not np.allclose(np.asarray(with_img), np.asarray(with_img2))
+
+    def test_splice_uses_default_image_token(self):
+        cfg, fam, params = self._setup()
+        toks = jnp.array([[1, 2, 3]], jnp.int32)
+        # No placeholders: splice is identity.
+        mm = jnp.ones((1, 2, cfg.hidden_size), jnp.float32)
+        x = splice_mm_embeds(params, cfg, toks, mm)
+        ref = params["embed"]["embedding"][toks].astype(cfg.dtype)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(ref))
+        assert IMAGE_TOKEN_ID == 151655
+
+
+class TestEncodeEndpoint:
+    def test_encode_role_over_http(self, store):
+        import msgpack
+        import requests
+
+        from xllm_service_tpu.common.types import InstanceType
+        from xllm_service_tpu.coordination.memory import InMemoryCoordination
+        from xllm_service_tpu.engine.agent import AgentConfig, EngineAgent
+        from xllm_service_tpu.engine.config import EngineConfig
+
+        ecfg = EngineConfig(
+            model_id="tiny-vl", model_family="qwen2_vl",
+            model=tiny_vl_config(dtype=jnp.float32, max_context_len=256,
+                                 image_token_id=100),
+            num_pages=32, page_size=16, hash_block_size=32,
+            max_batch_size=2, max_seq_len=128, prefill_buckets=(64, 128))
+        agent = EngineAgent(
+            ecfg, AgentConfig(host="127.0.0.1", model_id="tiny-vl",
+                              instance_type=InstanceType.ENCODE,
+                              heartbeat_interval_s=5, lease_ttl_s=5),
+            coord=InMemoryCoordination(store)).start()
+        try:
+            pixels = np.random.default_rng(0).normal(
+                size=(1, 28, 28, 3)).astype(np.float32)
+            r = requests.post(
+                f"http://{agent.name}/rpc/encode",
+                data=msgpack.packb({"bytes": pixels.tobytes(),
+                                    "shape": list(pixels.shape),
+                                    "dtype": "float32"}, use_bin_type=True),
+                timeout=60)
+            assert r.status_code == 200, r.text
+            obj = msgpack.unpackb(r.content, raw=False)
+            emb = np.frombuffer(obj["bytes"],
+                                np.float32).reshape(obj["shape"])
+            assert emb.shape == (1, 4, 128)
+            assert np.isfinite(emb).all()
+        finally:
+            agent.stop()

@@ -255,6 +255,7 @@ class EngineAgent:
         app.router.add_post("/rpc/cancel", self._h_cancel)
         app.router.add_post("/rpc/flip_role", self._h_flip)
         app.router.add_post("/rpc/kv_transfer", self._h_kv_transfer)
+        app.router.add_post("/rpc/encode", self._h_encode)
 
         async def _start():
             self._runner = web.AppRunner(app)
@@ -436,6 +437,40 @@ class EngineAgent:
                 status=Status(StatusCode.UNAVAILABLE,
                               f"KV transfer to decode peer failed: {e}"),
                 finished=True))
+
+    async def _h_encode(self, req: web.Request) -> web.Response:
+        """EPD ENCODE stage: run the vision encoder on pixel arrays and
+        return visual embeddings (msgpack). The reference claims EPD with no
+        service mechanism (README.md:47); this endpoint + InstanceType.ENCODE
+        define the contract: encode instances pin vision-encoder FLOPs to
+        dedicated chips so they never contend with prefill/decode."""
+        fam = self.engine.family
+        encode_fn = None
+        try:
+            from ..models import qwen2_vl as _vl
+
+            if self.engine.cfg.model_family == "qwen2_vl":
+                encode_fn = _vl.encode_images
+        except ImportError:
+            pass
+        if encode_fn is None:
+            return web.json_response(
+                {"error": f"model family {self.engine.cfg.model_family} "
+                          "has no vision encoder"}, status=400)
+        data = await req.read()
+        obj = msgpack.unpackb(data, raw=False)
+        pixels = np.frombuffer(obj["bytes"], dtype=np.dtype(obj["dtype"])) \
+            .reshape(obj["shape"])
+        import jax.numpy as jnp
+
+        embeds = encode_fn(self.engine.params, self.engine.cfg.model,
+                           jnp.asarray(pixels))
+        embeds_np = np.asarray(embeds.astype(jnp.float32))
+        return web.Response(body=msgpack.packb({
+            "bytes": embeds_np.tobytes(),
+            "shape": list(embeds_np.shape),
+            "dtype": "float32"}, use_bin_type=True),
+            content_type="application/msgpack")
 
     async def _h_kv_transfer(self, req: web.Request) -> web.Response:
         """Decode side of the PD handoff: accept prompt KV + first token,

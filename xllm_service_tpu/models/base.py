@@ -45,6 +45,7 @@ class ModelConfig:
     first_dense_layers: int = 1     # leading dense layers before MoE blocks
     # Multimodal (qwen2_vl family).
     vision: Optional["VisionConfig"] = None
+    image_token_id: int = 151655   # <|image_pad|> placeholder id
 
     @property
     def q_size(self) -> int:

@@ -120,6 +120,17 @@ def prefill_forward(params: Params, cfg: ModelConfig,
                     ) -> tuple[jax.Array, jax.Array]:
     """Returns (last-token logits [B, V], updated kv_pages)."""
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    return prefill_from_embeddings(params, cfg, x, positions, kv_pages,
+                                   page_table, prefix_lens, seq_lens)
+
+
+def prefill_from_embeddings(params: Params, cfg: ModelConfig,
+                            x: jax.Array, positions: jax.Array,
+                            kv_pages: jax.Array, page_table: jax.Array,
+                            prefix_lens: jax.Array, seq_lens: jax.Array,
+                            ) -> tuple[jax.Array, jax.Array]:
+    """Prefill body over precomputed input embeddings (multimodal families
+    splice visual tokens before calling this)."""
     use_prefix = True
 
     def layer(x, inputs):

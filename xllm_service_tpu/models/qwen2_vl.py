@@ -1,0 +1,175 @@
+"""Qwen2-VL family (BASELINE config 5: multimodal EPD three-stage
+disaggregation: encode / prefill / decode).
+
+Components:
+- **Vision encoder**: patch-embedding ViT with bidirectional attention,
+  projected to the LM hidden size — this is the ENCODE stage, pinned to
+  dedicated chips in EPD deployments (the reference only *claims* EPD,
+  README.md:47, with no service code; the role + contract here are ours:
+  InstanceType.ENCODE + the agent's /rpc/encode endpoint).
+- **LM**: the qwen2 text stack. `prefill_forward` accepts optional
+  `mm_embeds` which are spliced into positions whose token id equals
+  `image_token_id` (the chat template's multimodal placeholder).
+
+Decode is unchanged — visual content only affects prefill, which is why
+EPD separates the encode stage: encoder FLOPs never contend with decode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import rms_norm
+from ..parallel.mesh import AXIS_MODEL
+from ..parallel.sharding import ShardingRules
+from .base import ModelConfig, ModelFamily, VisionConfig, register_model_family
+from . import llama as _llama
+from . import qwen2 as _qwen2  # noqa: F401  (registers the text family)
+
+Params = dict
+
+IMAGE_TOKEN_ID = 151655   # Qwen2-VL's <|image_pad|> id (placeholder splice)
+
+QWEN2_VL_RULES = ShardingRules(rules=[
+    (r"vision/", P()),   # encoder replicated (small; pinned to its chips)
+    *_llama.LLAMA_STACKED_RULES.rules,
+])
+
+
+def tiny_vl_config(**kw) -> ModelConfig:
+    defaults = dict(
+        name="qwen2_vl", vocab_size=512, hidden_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=32, ffn_size=256,
+        qkv_bias=True, max_context_len=512,
+        vision=VisionConfig(image_size=28, patch_size=14, hidden_size=64,
+                            num_layers=2, num_heads=4, out_tokens=4))
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
+    params = _llama.init_params(cfg, rng)
+    v = cfg.vision
+    assert v is not None, "qwen2_vl requires a VisionConfig"
+    keys = jax.random.split(jax.random.fold_in(rng, 7), 8)
+    Dv, Lv = v.hidden_size, v.num_layers
+    patch_dim = 3 * v.patch_size * v.patch_size
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    n_patches = (v.image_size // v.patch_size) ** 2
+    params["vision"] = {
+        "patch_embed": {"kernel": dense(keys[0], (patch_dim, Dv), patch_dim)},
+        "pos_embed": dense(keys[1], (n_patches, Dv), Dv),
+        "layers": {
+            "norm1": {"scale": jnp.ones((Lv, Dv), cfg.dtype)},
+            "qkv": {"kernel": dense(keys[2], (Lv, Dv, 3 * Dv), Dv)},
+            "proj": {"kernel": dense(keys[3], (Lv, Dv, Dv), Dv)},
+            "norm2": {"scale": jnp.ones((Lv, Dv), cfg.dtype)},
+            "fc1": {"kernel": dense(keys[4], (Lv, Dv, 4 * Dv), Dv)},
+            "fc2": {"kernel": dense(keys[5], (Lv, 4 * Dv, Dv), 4 * Dv)},
+        },
+        "merger": {"kernel": dense(keys[6], (Dv, cfg.hidden_size), Dv)},
+    }
+    return params
+
+
+def encode_images(params: Params, cfg: ModelConfig,
+                  pixels: jax.Array) -> jax.Array:
+    """pixels: [N, H, W, 3] -> visual embeddings [N, out_tokens, D_lm].
+
+    The ENCODE stage: patchify → ViT (bidirectional) → average-pool groups
+    of patches down to `out_tokens` → project to the LM width.
+    """
+    v = cfg.vision
+    N = pixels.shape[0]
+    p = v.patch_size
+    grid = v.image_size // p
+    # Patchify: [N, grid, p, grid, p, 3] -> [N, grid*grid, p*p*3].
+    x = pixels.reshape(N, grid, p, grid, p, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(N, grid * grid, p * p * 3)
+    x = x.astype(cfg.dtype) @ params["vision"]["patch_embed"]["kernel"]
+    x = x + params["vision"]["pos_embed"][None, :, :]
+
+    vp = params["vision"]["layers"]
+    n_heads = v.num_heads
+    hd = v.hidden_size // n_heads
+
+    def layer(x, lp):
+        h = rms_norm(x, lp["norm1"]["scale"], 1e-6)
+        qkv = jnp.einsum("ntd,df->ntf", h, lp["qkv"]["kernel"])
+        q, k, vv = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(*q.shape[:-1], n_heads, hd)
+        k = k.reshape(*k.shape[:-1], n_heads, hd)
+        vv = vv.reshape(*vv.shape[:-1], n_heads, hd)
+        s = jnp.einsum("nqhd,nkhd->nhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / (hd ** 0.5)
+        a = jnp.einsum("nhqk,nkhd->nqhd", jax.nn.softmax(s, axis=-1),
+                       vv.astype(jnp.float32)).astype(x.dtype)
+        a = a.reshape(*a.shape[:-2], v.hidden_size)
+        x = x + jnp.einsum("ntd,df->ntf", a, lp["proj"]["kernel"])
+        h2 = rms_norm(x, lp["norm2"]["scale"], 1e-6)
+        m = jnp.einsum("ntd,df->ntf", h2, lp["fc1"]["kernel"])
+        x = x + jnp.einsum("ntf,fd->ntd", jax.nn.gelu(m),
+                           lp["fc2"]["kernel"])
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, vp)
+    # Pool patches down to out_tokens visual tokens.
+    T = x.shape[1]
+    group = max(1, T // v.out_tokens)
+    pooled = x[:, :group * v.out_tokens].reshape(
+        N, v.out_tokens, group, v.hidden_size).mean(axis=2)
+    return jnp.einsum("ntd,df->ntf", pooled,
+                      params["vision"]["merger"]["kernel"])
+
+
+def splice_mm_embeds(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                     mm_embeds: Optional[jax.Array],
+                     image_token_id: Optional[int] = None) -> jax.Array:
+    """Token embedding with placeholder positions replaced by visual
+    embeddings. tokens [B, S]; mm_embeds [B, n_mm, D] (per-row visual
+    tokens, consumed in order by each row's placeholder positions)."""
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    if mm_embeds is None:
+        return x
+    if image_token_id is None:
+        image_token_id = cfg.image_token_id
+    is_img = (tokens == image_token_id)
+    # k-th placeholder in a row takes that row's k-th visual token.
+    order = jnp.cumsum(is_img, axis=1) - 1
+    order = jnp.clip(order, 0, mm_embeds.shape[1] - 1)
+    gathered = jnp.take_along_axis(
+        mm_embeds.astype(cfg.dtype), order[..., None], axis=1)
+    return jnp.where(is_img[..., None], gathered, x)
+
+
+def prefill_forward(params, cfg, tokens, positions, kv_pages, page_table,
+                    prefix_lens, seq_lens, mm_embeds=None):
+    """Text prefill with optional visual-embedding splice. Reuses the llama
+    stacked-layer body by substituting the input embeddings."""
+    if mm_embeds is None:
+        return _llama.prefill_forward(params, cfg, tokens, positions,
+                                      kv_pages, page_table, prefix_lens,
+                                      seq_lens)
+    # Splice, then run the llama layers on the substituted embeddings by
+    # temporarily routing the embedding lookup through an identity table.
+    x = splice_mm_embeds(params, cfg, tokens, mm_embeds)
+    return _llama.prefill_from_embeddings(params, cfg, x, positions,
+                                          kv_pages, page_table, prefix_lens,
+                                          seq_lens)
+
+
+register_model_family(ModelFamily(
+    name="qwen2_vl",
+    init_params=init_params,
+    prefill_forward=prefill_forward,
+    decode_forward=_llama.decode_forward,
+    sharding_rules=QWEN2_VL_RULES,
+))
