@@ -1,0 +1,626 @@
+// xllm-service-tpu coordination server (native).
+//
+// Standalone C++17 binary replacing the external etcd cluster the reference
+// depends on (reference wraps etcd-cpp-apiv3 in scheduler/etcd_client/;
+// SURVEY.md §2.7). Speaks the framework's newline-delimited JSON protocol
+// (see xllm_service_tpu/coordination/server.py — the Python client and this
+// server are wire-compatible; both are covered by the same test suite).
+//
+// Capabilities (etcd-parity as used by the orchestration plane):
+//   - put (plain / TTL-leased / create-if-absent), refresh (lease keepalive)
+//   - get, get_prefix, rm, guarded rm_prefix, bulk_set, bulk_rm
+//   - recursive prefix watches with PUT/DELETE push events
+//   - lease expiry sweep -> DELETE events (the liveness primitive instance
+//     failure detection builds on)
+//   - optional username/password auth
+//
+// Single-threaded poll() event loop; no external dependencies.
+//
+// Build: g++ -O2 -std=c++17 -o coordination_server coordination_server.cpp
+// Run:   ./coordination_server --port 2379 [--username u --password p]
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <optional>
+#include <string>
+#include <unordered_map>
+#include <variant>
+#include <vector>
+
+// ---------------------------------------------------------------- JSON ----
+// Minimal JSON value + parser + writer (objects, arrays, strings, numbers,
+// bools, null) — sufficient for the coordination protocol.
+struct Json;
+using JsonObject = std::map<std::string, Json>;
+using JsonArray = std::vector<Json>;
+
+struct Json {
+  std::variant<std::nullptr_t, bool, double, std::string, JsonArray,
+               JsonObject>
+      v = nullptr;
+
+  bool is_null() const { return std::holds_alternative<std::nullptr_t>(v); }
+  bool is_str() const { return std::holds_alternative<std::string>(v); }
+  bool is_obj() const { return std::holds_alternative<JsonObject>(v); }
+  bool is_arr() const { return std::holds_alternative<JsonArray>(v); }
+  const std::string& str() const { return std::get<std::string>(v); }
+  double num() const { return std::get<double>(v); }
+  bool boolean() const { return std::get<bool>(v); }
+  const JsonObject& obj() const { return std::get<JsonObject>(v); }
+  const JsonArray& arr() const { return std::get<JsonArray>(v); }
+
+  const Json* find(const std::string& key) const {
+    if (!is_obj()) return nullptr;
+    auto it = obj().find(key);
+    return it == obj().end() ? nullptr : &it->second;
+  }
+  std::string get_str(const std::string& key,
+                      const std::string& dflt = "") const {
+    const Json* j = find(key);
+    return j && j->is_str() ? j->str() : dflt;
+  }
+  std::optional<double> get_num(const std::string& key) const {
+    const Json* j = find(key);
+    if (j && std::holds_alternative<double>(j->v)) return j->num();
+    return std::nullopt;
+  }
+  bool get_bool(const std::string& key, bool dflt = false) const {
+    const Json* j = find(key);
+    return j && std::holds_alternative<bool>(j->v) ? j->boolean() : dflt;
+  }
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& s) : s_(s) {}
+
+  bool parse(Json* out) {
+    skip_ws();
+    if (!value(out)) return false;
+    skip_ws();
+    return pos_ == s_.size();
+  }
+
+ private:
+  const std::string& s_;
+  size_t pos_ = 0;
+
+  void skip_ws() {
+    while (pos_ < s_.size() && (s_[pos_] == ' ' || s_[pos_] == '\t' ||
+                                s_[pos_] == '\n' || s_[pos_] == '\r'))
+      pos_++;
+  }
+  bool literal(const char* lit) {
+    size_t n = strlen(lit);
+    if (s_.compare(pos_, n, lit) != 0) return false;
+    pos_ += n;
+    return true;
+  }
+  bool value(Json* out) {
+    skip_ws();
+    if (pos_ >= s_.size()) return false;
+    char c = s_[pos_];
+    if (c == '{') return object(out);
+    if (c == '[') return array(out);
+    if (c == '"') {
+      std::string str;
+      if (!string_(&str)) return false;
+      out->v = std::move(str);
+      return true;
+    }
+    if (c == 't') { out->v = true; return literal("true"); }
+    if (c == 'f') { out->v = false; return literal("false"); }
+    if (c == 'n') { out->v = nullptr; return literal("null"); }
+    return number(out);
+  }
+  bool object(Json* out) {
+    pos_++;  // '{'
+    JsonObject obj;
+    skip_ws();
+    if (pos_ < s_.size() && s_[pos_] == '}') { pos_++; out->v = std::move(obj); return true; }
+    while (true) {
+      skip_ws();
+      std::string key;
+      if (!string_(&key)) return false;
+      skip_ws();
+      if (pos_ >= s_.size() || s_[pos_] != ':') return false;
+      pos_++;
+      Json val;
+      if (!value(&val)) return false;
+      obj.emplace(std::move(key), std::move(val));
+      skip_ws();
+      if (pos_ >= s_.size()) return false;
+      if (s_[pos_] == ',') { pos_++; continue; }
+      if (s_[pos_] == '}') { pos_++; break; }
+      return false;
+    }
+    out->v = std::move(obj);
+    return true;
+  }
+  bool array(Json* out) {
+    pos_++;  // '['
+    JsonArray arr;
+    skip_ws();
+    if (pos_ < s_.size() && s_[pos_] == ']') { pos_++; out->v = std::move(arr); return true; }
+    while (true) {
+      Json val;
+      if (!value(&val)) return false;
+      arr.push_back(std::move(val));
+      skip_ws();
+      if (pos_ >= s_.size()) return false;
+      if (s_[pos_] == ',') { pos_++; continue; }
+      if (s_[pos_] == ']') { pos_++; break; }
+      return false;
+    }
+    out->v = std::move(arr);
+    return true;
+  }
+  bool string_(std::string* out) {
+    if (pos_ >= s_.size() || s_[pos_] != '"') return false;
+    pos_++;
+    out->clear();
+    while (pos_ < s_.size()) {
+      char c = s_[pos_++];
+      if (c == '"') return true;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) return false;
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) return false;
+            unsigned cp = std::stoul(s_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // UTF-8 encode (surrogate pairs for completeness).
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos_ + 6 <= s_.size() &&
+                s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+              unsigned lo = std::stoul(s_.substr(pos_ + 2, 4), nullptr, 16);
+              pos_ += 6;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            if (cp < 0x80) {
+              out->push_back(static_cast<char>(cp));
+            } else if (cp < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else if (cp < 0x10000) {
+              out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            }
+            break;
+          }
+          default: return false;
+        }
+      } else {
+        out->push_back(c);
+      }
+    }
+    return false;
+  }
+  bool number(Json* out) {
+    size_t start = pos_;
+    if (pos_ < s_.size() && (s_[pos_] == '-' || s_[pos_] == '+')) pos_++;
+    while (pos_ < s_.size() &&
+           (isdigit(static_cast<unsigned char>(s_[pos_])) || s_[pos_] == '.' ||
+            s_[pos_] == 'e' || s_[pos_] == 'E' || s_[pos_] == '-' ||
+            s_[pos_] == '+'))
+      pos_++;
+    if (pos_ == start) return false;
+    try {
+      out->v = std::stod(s_.substr(start, pos_ - start));
+    } catch (...) {
+      return false;
+    }
+    return true;
+  }
+};
+
+void json_escape(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+// ---------------------------------------------------------------- store ----
+using Clock = std::chrono::steady_clock;
+
+struct Entry {
+  std::string value;
+  std::optional<Clock::time_point> expire_at;
+};
+
+struct Watch {
+  int fd;
+  double client_watch_id;
+  std::string prefix;
+};
+
+struct Conn {
+  int fd;
+  std::string rbuf;
+  std::string wbuf;
+  bool authed = true;
+  bool closing = false;
+};
+
+class Server {
+ public:
+  Server(int port, std::string username, std::string password)
+      : username_(std::move(username)), password_(std::move(password)) {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+        0) {
+      perror("bind");
+      exit(1);
+    }
+    listen(listen_fd_, 128);
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    fprintf(stderr, "coordination server (native) listening on :%d\n",
+            ntohs(addr.sin_port));
+    fflush(stderr);
+  }
+
+  [[noreturn]] void run() {
+    while (true) {
+      std::vector<pollfd> pfds;
+      pfds.push_back({listen_fd_, POLLIN, 0});
+      for (auto& [fd, c] : conns_) {
+        short ev = POLLIN;
+        if (!c->wbuf.empty()) ev |= POLLOUT;
+        pfds.push_back({fd, ev, 0});
+      }
+      poll(pfds.data(), pfds.size(), 50);
+      if (pfds[0].revents & POLLIN) accept_conn();
+      std::vector<int> dead;
+      for (size_t i = 1; i < pfds.size(); i++) {
+        auto it = conns_.find(pfds[i].fd);
+        if (it == conns_.end()) continue;
+        Conn* c = it->second.get();
+        if (pfds[i].revents & (POLLERR | POLLHUP)) {
+          dead.push_back(c->fd);
+          continue;
+        }
+        if (pfds[i].revents & POLLIN) {
+          if (!read_conn(c)) dead.push_back(c->fd);
+        }
+        if (pfds[i].revents & POLLOUT) {
+          if (!flush_conn(c)) dead.push_back(c->fd);
+        }
+      }
+      for (int fd : dead) close_conn(fd);
+      sweep_expired();
+    }
+  }
+
+ private:
+  int listen_fd_;
+  std::string username_, password_;
+  std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+  std::map<std::string, Entry> data_;  // ordered: efficient prefix scans
+  std::vector<Watch> watches_;
+
+  void accept_conn() {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto conn = std::make_unique<Conn>();
+    conn->fd = fd;
+    conn->authed = username_.empty();
+    conns_[fd] = std::move(conn);
+  }
+
+  void close_conn(int fd) {
+    watches_.erase(
+        std::remove_if(watches_.begin(), watches_.end(),
+                       [fd](const Watch& w) { return w.fd == fd; }),
+        watches_.end());
+    conns_.erase(fd);
+    close(fd);
+  }
+
+  bool read_conn(Conn* c) {
+    char buf[65536];
+    while (true) {
+      ssize_t n = recv(c->fd, buf, sizeof(buf), MSG_DONTWAIT);
+      if (n > 0) {
+        c->rbuf.append(buf, static_cast<size_t>(n));
+        if (c->rbuf.size() > (64u << 20)) return false;  // runaway line
+        continue;
+      }
+      if (n == 0) return false;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      return false;
+    }
+    size_t start = 0;
+    while (true) {
+      size_t nl = c->rbuf.find('\n', start);
+      if (nl == std::string::npos) break;
+      std::string line = c->rbuf.substr(start, nl - start);
+      start = nl + 1;
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (!line.empty()) handle_line(c, line);
+    }
+    c->rbuf.erase(0, start);
+    return flush_conn(c);
+  }
+
+  bool flush_conn(Conn* c) {
+    while (!c->wbuf.empty()) {
+      ssize_t n =
+          send(c->fd, c->wbuf.data(), c->wbuf.size(), MSG_DONTWAIT | MSG_NOSIGNAL);
+      if (n > 0) {
+        c->wbuf.erase(0, static_cast<size_t>(n));
+        continue;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+      return false;
+    }
+    return true;
+  }
+
+  void send_json(Conn* c, const std::string& body) {
+    c->wbuf += body;
+    c->wbuf.push_back('\n');
+  }
+
+  static std::string ok_response(const Json* id, bool ok,
+                                 const std::string& extra = "") {
+    std::string out = "{";
+    if (id && !id->is_null()) {
+      out += "\"id\": " + std::to_string(static_cast<long long>(id->num())) +
+             ", ";
+    }
+    out += std::string("\"ok\": ") + (ok ? "true" : "false");
+    if (!extra.empty()) out += ", " + extra;
+    out += "}";
+    return out;
+  }
+
+  void emit_event(const std::string& type, const std::string& key,
+                  const std::string& value) {
+    for (const Watch& w : watches_) {
+      if (key.compare(0, w.prefix.size(), w.prefix) != 0) continue;
+      auto it = conns_.find(w.fd);
+      if (it == conns_.end()) continue;
+      std::string msg = "{\"event\": \"watch\", \"watch_id\": " +
+                        std::to_string(static_cast<long long>(w.client_watch_id)) +
+                        ", \"prefix\": ";
+      json_escape(w.prefix, &msg);
+      msg += ", \"events\": [{\"type\": \"" + type + "\", \"key\": ";
+      json_escape(key, &msg);
+      msg += ", \"value\": ";
+      json_escape(value, &msg);
+      msg += "}]}";
+      send_json(it->second.get(), msg);
+    }
+  }
+
+  void sweep_expired() {
+    auto now = Clock::now();
+    std::vector<std::string> expired;
+    for (const auto& [k, e] : data_) {
+      if (e.expire_at && *e.expire_at <= now) expired.push_back(k);
+    }
+    for (const std::string& k : expired) {
+      data_.erase(k);
+      emit_event("DELETE", k, "");
+    }
+    // Push any queued watch events.
+    for (auto& [fd, c] : conns_) flush_conn(c.get());
+  }
+
+  void handle_line(Conn* c, const std::string& line) {
+    Json req;
+    JsonParser parser(line);
+    if (!parser.parse(&req) || !req.is_obj()) {
+      send_json(c, "{\"ok\": false, \"error\": \"bad json\"}");
+      return;
+    }
+    const Json* id = req.find("id");
+    std::string op = req.get_str("op");
+
+    if (op == "auth") {
+      c->authed = username_.empty() ||
+                  (req.get_str("username") == username_ &&
+                   req.get_str("password") == password_);
+      send_json(c, ok_response(id, c->authed));
+      return;
+    }
+    if (!c->authed) {
+      send_json(c, ok_response(id, false, "\"error\": \"unauthenticated\""));
+      return;
+    }
+
+    if (op == "ping") {
+      send_json(c, ok_response(id, true));
+    } else if (op == "put") {
+      std::string key = req.get_str("key");
+      std::string value = req.get_str("value");
+      bool create_only = req.get_bool("create_only");
+      auto ttl = req.get_num("ttl");
+      auto it = data_.find(key);
+      if (create_only && it != data_.end()) {
+        bool expired = it->second.expire_at &&
+                       *it->second.expire_at <= Clock::now();
+        if (!expired) {
+          send_json(c, ok_response(id, false));
+          return;
+        }
+      }
+      Entry e;
+      e.value = value;
+      if (ttl && *ttl > 0)
+        e.expire_at = Clock::now() + std::chrono::microseconds(
+                                         static_cast<int64_t>(*ttl * 1e6));
+      data_[key] = std::move(e);
+      emit_event("PUT", key, value);
+      send_json(c, ok_response(id, true));
+    } else if (op == "refresh") {
+      std::string key = req.get_str("key");
+      auto ttl = req.get_num("ttl");
+      auto it = data_.find(key);
+      bool ok = false;
+      if (it != data_.end() && it->second.expire_at && ttl) {
+        it->second.expire_at =
+            Clock::now() +
+            std::chrono::microseconds(static_cast<int64_t>(*ttl * 1e6));
+        ok = true;
+      }
+      send_json(c, ok_response(id, ok));
+    } else if (op == "get") {
+      auto it = data_.find(req.get_str("key"));
+      std::string extra = "\"value\": ";
+      if (it == data_.end()) {
+        extra += "null";
+      } else {
+        json_escape(it->second.value, &extra);
+      }
+      send_json(c, ok_response(id, true, extra));
+    } else if (op == "get_prefix") {
+      std::string prefix = req.get_str("prefix");
+      std::string extra = "\"kvs\": {";
+      bool first = true;
+      for (auto it = data_.lower_bound(prefix);
+           it != data_.end() &&
+           it->first.compare(0, prefix.size(), prefix) == 0;
+           ++it) {
+        if (!first) extra += ", ";
+        first = false;
+        json_escape(it->first, &extra);
+        extra += ": ";
+        json_escape(it->second.value, &extra);
+      }
+      extra += "}";
+      send_json(c, ok_response(id, true, extra));
+    } else if (op == "rm") {
+      std::string key = req.get_str("key");
+      bool ok = data_.erase(key) > 0;
+      if (ok) emit_event("DELETE", key, "");
+      send_json(c, ok_response(id, ok));
+    } else if (op == "rm_prefix") {
+      std::string prefix = req.get_str("prefix");
+      const Json* guard = req.find("guard_key");
+      int count = 0;
+      bool guard_ok = !guard || guard->is_null() ||
+                      data_.count(guard->str()) > 0;
+      if (guard_ok) {
+        std::vector<std::string> keys;
+        for (auto it = data_.lower_bound(prefix);
+             it != data_.end() &&
+             it->first.compare(0, prefix.size(), prefix) == 0;
+             ++it)
+          keys.push_back(it->first);
+        for (const std::string& k : keys) {
+          data_.erase(k);
+          emit_event("DELETE", k, "");
+          count++;
+        }
+      }
+      send_json(c, ok_response(id, true,
+                               "\"count\": " + std::to_string(count)));
+    } else if (op == "bulk_set") {
+      const Json* kvs = req.find("kvs");
+      if (kvs && kvs->is_obj()) {
+        for (const auto& [k, v] : kvs->obj()) {
+          data_[k] = Entry{v.is_str() ? v.str() : "", std::nullopt};
+          emit_event("PUT", k, v.is_str() ? v.str() : "");
+        }
+      }
+      send_json(c, ok_response(id, true));
+    } else if (op == "bulk_rm") {
+      const Json* keys = req.find("keys");
+      int count = 0;
+      if (keys && keys->is_arr()) {
+        for (const Json& k : keys->arr()) {
+          if (k.is_str() && data_.erase(k.str()) > 0) {
+            emit_event("DELETE", k.str(), "");
+            count++;
+          }
+        }
+      }
+      send_json(c, ok_response(id, true,
+                               "\"count\": " + std::to_string(count)));
+    } else if (op == "watch") {
+      auto wid = req.get_num("watch_id");
+      watches_.push_back(
+          {c->fd, wid ? *wid : 0.0, req.get_str("prefix")});
+      send_json(c, ok_response(id, true));
+    } else if (op == "unwatch") {
+      auto wid = req.get_num("watch_id");
+      int fd = c->fd;
+      watches_.erase(std::remove_if(watches_.begin(), watches_.end(),
+                                    [&](const Watch& w) {
+                                      return w.fd == fd && wid &&
+                                             w.client_watch_id == *wid;
+                                    }),
+                     watches_.end());
+      send_json(c, ok_response(id, true));
+    } else {
+      send_json(c, ok_response(id, false, "\"error\": \"unknown op\""));
+    }
+  }
+};
+
+int main(int argc, char** argv) {
+  int port = 2379;
+  std::string username, password;
+  for (int i = 1; i < argc - 1; i++) {
+    std::string arg = argv[i];
+    if (arg == "--port") port = atoi(argv[++i]);
+    else if (arg == "--username") username = argv[++i];
+    else if (arg == "--password") password = argv[++i];
+  }
+  Server server(port, username, password);
+  server.run();
+  return 0;
+}

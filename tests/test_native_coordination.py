@@ -1,0 +1,170 @@
+"""Native (C++) coordination server tested through the SAME Python client
+as the in-process backend — the two servers are wire-compatible."""
+
+import re
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from xllm_service_tpu.coordination.base import WatchEventType
+from xllm_service_tpu.coordination.client import TcpCoordinationClient
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def native_server():
+    binary = REPO / "csrc" / "coordination_server"
+    build = subprocess.run(["make", "-C", str(REPO / "csrc")],
+                           capture_output=True, text=True)
+    if build.returncode != 0 or not binary.exists():
+        pytest.skip(f"native build failed: {build.stderr[-500:]}")
+    proc = subprocess.Popen([str(binary), "--port", "0"],
+                            stderr=subprocess.PIPE, text=True)
+    # Parse the bound port from stderr.
+    line = proc.stderr.readline()
+    m = re.search(r"listening on :(\d+)", line)
+    assert m, f"unexpected server banner: {line!r}"
+    port = int(m.group(1))
+    time.sleep(0.1)
+    yield port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+        self.cv = threading.Condition()
+
+    def __call__(self, events, prefix):
+        with self.cv:
+            self.events.extend(events)
+            self.cv.notify_all()
+
+    def wait_for(self, pred, timeout=5.0):
+        with self.cv:
+            return self.cv.wait_for(lambda: pred(self.events), timeout)
+
+
+class TestNativeServer:
+    def test_kv_roundtrip(self, native_server):
+        c = TcpCoordinationClient(f"127.0.0.1:{native_server}")
+        assert c.set("a/b", 'va"l\nue')      # exercises JSON escaping
+        assert c.get("a/b") == 'va"l\nue'
+        c.bulk_set({"a/c": "2", "z": "3"})
+        assert c.get_prefix("a/") == {"a/b": 'va"l\nue', "a/c": "2"}
+        assert c.rm("a/b")
+        assert c.get("a/b") is None
+        assert c.bulk_rm(["a/c", "missing"]) == 1
+        c.close()
+
+    def test_unicode_values(self, native_server):
+        c = TcpCoordinationClient(f"127.0.0.1:{native_server}")
+        meta = '{"name": "host:1", "模型": "型号", "emoji": "🚀"}'
+        assert c.set("uni", meta)
+        assert c.get("uni") == meta
+        c.close()
+
+    def test_lease_expiry_and_watch(self, native_server):
+        owner = TcpCoordinationClient(f"127.0.0.1:{native_server}")
+        observer = TcpCoordinationClient(f"127.0.0.1:{native_server}")
+        sink = _Sink()
+        observer.add_watch("svc/", sink)
+        owner.set("svc/me", "alive", ttl_s=0.3)
+        assert sink.wait_for(lambda ev: any(
+            e.type == WatchEventType.PUT and e.key == "svc/me" for e in ev))
+        time.sleep(0.9)
+        assert observer.get("svc/me") == "alive"   # keepalive holds it
+        owner.close()                              # process death
+        assert sink.wait_for(lambda ev: any(
+            e.type == WatchEventType.DELETE and e.key == "svc/me"
+            for e in ev), timeout=8.0)
+        observer.close()
+
+    def test_create_if_absent_election(self, native_server):
+        a = TcpCoordinationClient(f"127.0.0.1:{native_server}")
+        b = TcpCoordinationClient(f"127.0.0.1:{native_server}")
+        assert a.create_if_absent("EL/MASTER", "a", ttl_s=0.3)
+        assert not b.create_if_absent("EL/MASTER", "b", ttl_s=0.3)
+        a.close()
+        deadline = time.time() + 5
+        won = False
+        while time.time() < deadline:
+            if b.create_if_absent("EL/MASTER", "b", ttl_s=0.3):
+                won = True
+                break
+            time.sleep(0.05)
+        assert won
+        b.close()
+
+    def test_guarded_rm_prefix(self, native_server):
+        c = TcpCoordinationClient(f"127.0.0.1:{native_server}")
+        c.set("G/CACHE/a", "1")
+        c.set("G/CACHE/b", "2")
+        assert c.rm_prefix("G/CACHE/", guard_key="G/MASTER") == 0
+        c.set("G/MASTER", "me")
+        assert c.rm_prefix("G/CACHE/", guard_key="G/MASTER") == 2
+        c.close()
+
+    def test_auth(self):
+        binary = REPO / "csrc" / "coordination_server"
+        if not binary.exists():
+            pytest.skip("native binary missing")
+        proc = subprocess.Popen(
+            [str(binary), "--port", "0", "--username", "u",
+             "--password", "p"], stderr=subprocess.PIPE, text=True)
+        try:
+            m = re.search(r":(\d+)", proc.stderr.readline())
+            port = int(m.group(1))
+            ok = TcpCoordinationClient(f"127.0.0.1:{port}",
+                                       username="u", password="p")
+            assert ok.set("k", "v")
+            ok.close()
+            from xllm_service_tpu.coordination.client import CoordinationError
+            with pytest.raises(CoordinationError):
+                TcpCoordinationClient(f"127.0.0.1:{port}",
+                                      username="u", password="wrong")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_full_service_stack_on_native_coordination(self, native_server):
+        """Master + fake engine coordinated by the NATIVE server."""
+        import requests
+
+        from xllm_service_tpu.common.config import ServiceOptions
+        from xllm_service_tpu.master import Master
+        from xllm_service_tpu.testing.fake_engine import (
+            FakeEngine,
+            FakeEngineConfig,
+        )
+        from fakes import wait_until
+
+        addr = f"127.0.0.1:{native_server}"
+        opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                              coordination_addr=addr,
+                              coordination_namespace="native-e2e",
+                              lease_ttl_s=1.0, sync_interval_s=0.3)
+        master = Master(opts)
+        master.start()
+        engine = FakeEngine(
+            TcpCoordinationClient(addr, namespace="native-e2e"),
+            FakeEngineConfig()).start()
+        try:
+            assert wait_until(
+                lambda: master.scheduler.instance_mgr.get_instance_meta(
+                    engine.name) is not None, timeout=10)
+            r = requests.post(
+                f"http://127.0.0.1:{master.http_port}/v1/completions",
+                json={"model": "fake-model", "prompt": "hi",
+                      "max_tokens": 32}, timeout=10)
+            assert r.status_code == 200, r.text
+            assert r.json()["choices"][0]["text"] == \
+                "Hello from the fake engine!"
+        finally:
+            engine.stop()
+            master.stop()
