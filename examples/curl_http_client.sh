@@ -1,0 +1,3 @@
+#!/bin/sh
+# Parity with reference examples/curl_http_client.sh
+curl -s "${1:-http://127.0.0.1:18888}/hello"

@@ -1,0 +1,60 @@
+"""HTTP client driver: stream + non-stream completions against a running
+service (parity with reference `examples/http_client_test.cpp`).
+
+    python examples/http_client.py --base http://127.0.0.1:18888
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import requests
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--base", default="http://127.0.0.1:18888")
+    p.add_argument("--model", default="")
+    p.add_argument("--prompt", default="Tell me a story about TPUs.")
+    p.add_argument("--max-tokens", type=int, default=64)
+    args = p.parse_args()
+
+    model = args.model
+    if not model:
+        models = requests.get(args.base + "/v1/models", timeout=10).json()
+        model = models["data"][0]["id"] if models.get("data") else "default"
+
+    print("== non-stream ==")
+    r = requests.post(args.base + "/v1/completions", json={
+        "model": model, "prompt": args.prompt,
+        "max_tokens": args.max_tokens}, timeout=300)
+    print(json.dumps(r.json(), indent=2)[:1000])
+
+    print("\n== stream ==")
+    r = requests.post(args.base + "/v1/chat/completions", json={
+        "model": model, "stream": True,
+        "stream_options": {"include_usage": True},
+        "messages": [{"role": "user", "content": args.prompt}],
+        "max_tokens": args.max_tokens}, stream=True, timeout=300)
+    for line in r.iter_lines():
+        if not line.startswith(b"data: "):
+            continue
+        payload = line[6:]
+        if payload == b"[DONE]":
+            print("\n[DONE]")
+            break
+        chunk = json.loads(payload)
+        if chunk.get("choices"):
+            delta = chunk["choices"][0].get("delta", {})
+            sys.stdout.write(delta.get("content") or
+                             chunk["choices"][0].get("text") or "")
+            sys.stdout.flush()
+        elif chunk.get("usage"):
+            print(f"\nusage: {chunk['usage']}")
+
+
+if __name__ == "__main__":
+    main()
