@@ -42,7 +42,7 @@ def main() -> None:
         max_batch_size=B, max_seq_len=max_seq,
         prefill_buckets=(128, 512, max_seq) if on_accel else (64, 128),
         hash_block_size=128 if on_accel else 32,
-        decode_horizon=16 if on_accel else 4)
+        decode_horizon=32 if on_accel else 4)
     engine = InferenceEngine(cfg)
 
     rng = np.random.default_rng(0)
@@ -73,7 +73,7 @@ def main() -> None:
     for _ in range(2):
         engine.step()
 
-    n_steps = 16 if on_accel else 4   # horizons (tokens = steps * horizon)
+    n_steps = 10 if on_accel else 4   # horizons (tokens = steps * horizon)
     start = counts["tokens"]
     t0 = time.perf_counter()
     for _ in range(n_steps):

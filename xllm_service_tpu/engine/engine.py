@@ -167,6 +167,7 @@ class InferenceEngine:
             "pp": jnp.zeros((B,), jnp.float32),
             "rp": jnp.ones((B,), jnp.float32),
             "keys": jnp.zeros((B, 2), jnp.uint32),
+            "want_lp": jnp.zeros((B,), jnp.bool_),
         }
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
 
@@ -209,11 +210,26 @@ class InferenceEngine:
                     d["clens"])
                 d = dict(d, kv=kv)
                 toks, logprobs = sample_tokens(
-                    logits, sampling_state(d), d["keys"], d["clens"])
+                    logits, sampling_state(d), d["keys"], d["clens"],
+                    want_logprobs=d["want_lp"])
                 d["counts"] = record_tokens(d["counts"], toks, d["active"])
-                chosen = jnp.take_along_axis(
-                    logprobs, toks[:, None], axis=-1)[:, 0]
-                tv, ti = jax.lax.top_k(logprobs, K)
+
+                # Full-vocab log_softmax + top-k cost real bandwidth; only
+                # pay when some slot asked for logprobs.
+                def _with_lp(_):
+                    chosen = jnp.take_along_axis(
+                        logprobs, toks[:, None], axis=-1)[:, 0]
+                    tv, ti = jax.lax.top_k(logprobs, K)
+                    return chosen, tv, ti
+
+                def _no_lp(_):
+                    B_ = toks.shape[0]
+                    return (jnp.zeros((B_,), jnp.float32),
+                            jnp.zeros((B_, K), jnp.float32),
+                            jnp.zeros((B_, K), jnp.int32))
+
+                chosen, tv, ti = jax.lax.cond(
+                    jnp.any(d["want_lp"]), _with_lp, _no_lp, operand=None)
                 d["last"] = jnp.where(d["active"], toks, d["last"])
                 d["clens"] = jnp.where(d["active"], d["clens"] + 1,
                                        d["clens"])
@@ -221,10 +237,13 @@ class InferenceEngine:
 
             d, ys = jax.lax.scan(step, d, None, length=horizon)
             toks, chosen, tv, ti = ys
-            # Pack downloads: ints [H,B,1+K], floats [H,B,1+K].
-            ints = jnp.concatenate([toks[..., None], ti], axis=-1)
-            floats = jnp.concatenate([chosen[..., None], tv], axis=-1)
-            return d, ints, floats
+            # ONE packed download [H, B, 2+2K] f32 (token/ids are exact in
+            # f32 below 2^24): each host->device round trip costs tens of
+            # ms on remote-attached chips.
+            packed = jnp.concatenate(
+                [toks[..., None].astype(jnp.float32), chosen[..., None],
+                 tv, ti.astype(jnp.float32)], axis=-1)
+            return d, packed
 
         self._decode_multi = decode_multi
 
@@ -232,7 +251,8 @@ class InferenceEngine:
         def prefill_install(params, d, tokens, ints, floats, counts_row, key):
             """Prefill one sequence + install it into batch slot `slot`.
 
-            ints: [P + 3] = [page_row(P), slot, prefix_len, seq_len]
+            ints: [P + 4] = [page_row(P), slot, prefix_len, seq_len,
+                             want_logprobs]
             floats: [6] = [temperature, top_k, top_p, freq, pres, rep]
             counts_row: [V] penalty histogram of the full prompt.
             """
@@ -266,11 +286,13 @@ class InferenceEngine:
             d["pp"] = d["pp"].at[slot].set(floats[4])
             d["rp"] = d["rp"].at[slot].set(floats[5])
             d["keys"] = d["keys"].at[slot].set(key)
+            d["want_lp"] = d["want_lp"].at[slot].set(ints[P + 3] > 0)
             d["counts"] = d["counts"].at[slot].set(
                 counts_row.at[toks[0]].add(1))
-            ints_out = jnp.concatenate([toks, ti[0]])
-            floats_out = jnp.concatenate([chosen, tv[0]])
-            return d, ints_out, floats_out
+            packed = jnp.concatenate(
+                [toks.astype(jnp.float32), chosen, tv[0],
+                 ti[0].astype(jnp.float32)])
+            return d, packed
 
         self._prefill_install = prefill_install
 
@@ -297,7 +319,8 @@ class InferenceEngine:
             scatter the transferred prompt KV into local pages + install the
             batch slot with the prefill-produced first token.
 
-            ints: [P + 3] = [page_row(P), slot, prompt_len, first_token].
+            ints: [P + 4] = [page_row(P), slot, prompt_len, first_token,
+                             want_logprobs].
             """
             page_row = ints[:P]
             slot = ints[P]
@@ -318,6 +341,7 @@ class InferenceEngine:
             d["pp"] = d["pp"].at[slot].set(floats[4])
             d["rp"] = d["rp"].at[slot].set(floats[5])
             d["keys"] = d["keys"].at[slot].set(key)
+            d["want_lp"] = d["want_lp"].at[slot].set(ints[P + 3] > 0)
             d["counts"] = d["counts"].at[slot].set(counts_row)
             return d
 
@@ -625,11 +649,12 @@ class InferenceEngine:
 
         P = cfg.pages_per_seq
         sp = req.sampling
-        ints = np.full((P + 3,), GARBAGE_PAGE, np.int32)
+        ints = np.full((P + 4,), GARBAGE_PAGE, np.int32)
         ints[:len(own_pages)] = own_pages
         ints[P] = seq.slot
         ints[P + 1] = P0
         ints[P + 2] = first_token
+        ints[P + 3] = 1 if sp.logprobs else 0
         floats = np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
                              sp.frequency_penalty, sp.presence_penalty,
                              sp.repetition_penalty if sp.repetition_penalty > 0
@@ -673,12 +698,13 @@ class InferenceEngine:
         toks[0, :len(suffix)] = suffix
 
         sp = seq.req.sampling
-        ints = np.full((P + 3,), GARBAGE_PAGE, np.int32)
+        ints = np.full((P + 4,), GARBAGE_PAGE, np.int32)
         all_pages = seq.pages.all_pages
         ints[:len(all_pages)] = all_pages
         ints[P] = seq.slot
         ints[P + 1] = matched
         ints[P + 2] = len(suffix)
+        ints[P + 3] = 1 if sp.logprobs else 0
         floats = np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
                              sp.frequency_penalty, sp.presence_penalty,
                              sp.repetition_penalty if sp.repetition_penalty > 0
@@ -691,14 +717,15 @@ class InferenceEngine:
         if sp.seed is not None:
             slot_key = jax.random.PRNGKey(sp.seed)
 
-        self._dstate, ints_out, floats_out = self._prefill_install(
+        self._dstate, packed = self._prefill_install(
             self.params, self._dstate, jnp.asarray(toks), jnp.asarray(ints),
             jnp.asarray(floats), jnp.asarray(counts_row), slot_key)
-        ints_np = np.asarray(ints_out)
-        floats_np = np.asarray(floats_out)
-        token = int(ints_np[0])
-        lp = self._make_logprob(token, float(floats_np[0]),
-                                floats_np[1:], ints_np[1:],
+        packed_np = np.asarray(packed)
+        K = self.cfg.max_top_logprobs
+        token = int(packed_np[0])
+        lp = self._make_logprob(token, float(packed_np[1]),
+                                packed_np[2:2 + K],
+                                packed_np[2 + K:].astype(np.int64),
                                 seq.req.sampling)
         return token, lp
 
@@ -709,24 +736,25 @@ class InferenceEngine:
         # Bound the horizon by the shortest remaining budget so we don't
         # burn whole horizons of discarded tokens on nearly-done sequences.
         horizon = self.cfg.decode_horizon
+        K = self.cfg.max_top_logprobs
         t0 = time.monotonic()
-        self._dstate, ints, floats = self._decode_multi(
+        self._dstate, packed = self._decode_multi(
             self.params, self._dstate, horizon)
-        ints_np = np.asarray(ints)      # [H, B, 1+K]
-        floats_np = np.asarray(floats)  # [H, B, 1+K]
+        packed_np = np.asarray(packed)   # [H, B, 2+2K]
         elapsed = time.monotonic() - t0
         self.recent_max_tbt_ms = max(self.recent_max_tbt_ms,
                                      elapsed * 1000 / max(1, horizon))
 
-        for h in range(ints_np.shape[0]):
+        for h in range(packed_np.shape[0]):
             for slot, seq in list(self._running.items()):
                 if seq.finished:
                     continue
-                token = int(ints_np[h, slot, 0])
+                token = int(packed_np[h, slot, 0])
                 seq.context_len += 1
                 lp = self._make_logprob(
-                    token, float(floats_np[h, slot, 0]),
-                    floats_np[h, slot, 1:], ints_np[h, slot, 1:],
+                    token, float(packed_np[h, slot, 1]),
+                    packed_np[h, slot, 2:2 + K],
+                    packed_np[h, slot, 2 + K:].astype(np.int64),
                     seq.req.sampling)
                 self._emit_token(seq, token, lp)
         return True

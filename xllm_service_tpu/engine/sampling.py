@@ -77,7 +77,8 @@ def _mask_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
 
 
 def sample_tokens(logits: jax.Array, st: SamplingState,
-                  keys: jax.Array, steps: jax.Array) -> tuple[jax.Array, jax.Array]:
+                  keys: jax.Array, steps: jax.Array,
+                  want_logprobs=None) -> tuple[jax.Array, jax.Array]:
     """logits [B, V] f32, keys [B] per-slot PRNG keys, steps [B] i32 ->
     (tokens [B] i32, logprobs_full [B, V] f32).
 
@@ -103,7 +104,14 @@ def sample_tokens(logits: jax.Array, st: SamplingState,
     # runtime when every slot is greedy (the common serving case).
     tokens = jax.lax.cond(jnp.any(st.temperature > 0.0), _sample,
                           lambda _: greedy_tokens, operand=None)
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    if want_logprobs is None:
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+    else:
+        # Full-vocab log_softmax is bandwidth; skip unless requested.
+        logprobs = jax.lax.cond(
+            jnp.any(want_logprobs),
+            lambda _: jax.nn.log_softmax(logits, axis=-1),
+            lambda _: jnp.zeros_like(logits), operand=None)
     return tokens.astype(jnp.int32), logprobs
 
 

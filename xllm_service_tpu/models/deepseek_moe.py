@@ -137,13 +137,15 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     return (routed + shared).reshape(orig_shape)
 
 
-def _layer_factory(cfg: ModelConfig, mode: str, page_table, prefix_lens,
-                   seq_lens, positions, context_lens):
-    def layer(x, inputs):
-        lp, kv = inputs
+def _run_layers(params, cfg, x, kv_pages, mode, page_table, prefix_lens,
+                seq_lens, positions, context_lens):
+    """Unrolled layer loop with in-place KV writebacks (see
+    models/llama.py for why not `lax.scan`)."""
+    for l in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
         h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
         q, k, v = _project_qkv(lp, h, cfg, positions)
-        k_pages, v_pages = kv[0], kv[1]
+        k_pages, v_pages = kv_pages[l, 0], kv_pages[l, 1]
         if mode == "prefill":
             k_pages, v_pages = write_prefill_kv(
                 k_pages, v_pages, k, v, page_table, prefix_lens, seq_lens)
@@ -158,29 +160,29 @@ def _layer_factory(cfg: ModelConfig, mode: str, page_table, prefix_lens,
         x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         x = x + _moe_mlp(lp, h2, cfg)
-        return x, jnp.stack([k_pages, v_pages])
-
-    return layer
+        kv_pages = jax.lax.dynamic_update_index_in_dim(
+            kv_pages, jnp.stack([k_pages, v_pages]), l, 0)
+    return x, kv_pages
 
 
 def prefill_forward(params, cfg, tokens, positions, kv_pages, page_table,
                     prefix_lens, seq_lens):
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
-    layer = _layer_factory(cfg, "prefill", page_table, prefix_lens,
-                           seq_lens, positions, None)
-    x, new_kv = jax.lax.scan(layer, x, (params["layers"], kv_pages))
+    x, kv_pages = _run_layers(params, cfg, x, kv_pages, "prefill",
+                              page_table, prefix_lens, seq_lens, positions,
+                              None)
     idx = jnp.maximum(seq_lens - 1, 0)
     last = x[jnp.arange(x.shape[0]), idx]
-    return _unembed(params, cfg, last), new_kv
+    return _unembed(params, cfg, last), kv_pages
 
 
 def decode_forward(params, cfg, tokens, positions, kv_pages, page_table,
                    context_lens):
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
-    layer = _layer_factory(cfg, "decode", page_table, None, None, positions,
-                           context_lens)
-    x, new_kv = jax.lax.scan(layer, x, (params["layers"], kv_pages))
-    return _unembed(params, cfg, x), new_kv
+    x, kv_pages = _run_layers(params, cfg, x, kv_pages, "decode",
+                              page_table, None, None, positions,
+                              context_lens)
+    return _unembed(params, cfg, x), kv_pages
 
 
 register_model_family(ModelFamily(

@@ -4,20 +4,22 @@ The engine's hottest op (SURVEY.md §7.3: "Pallas ragged paged-attention
 kernel quality drives the tok/s/chip north star"). One query token per
 sequence attends over that sequence's KV pages, located via its page table.
 
-Design (vs the XLA gather fallback in ops/attention.py):
-- grid = (batch, max_pages); the page table is a **scalar-prefetch** operand,
-  so each grid step's K/V page block is DMA'd straight from its physical
-  page (``index_map`` reads ``page_table[b, p]``) with Pallas' automatic
-  double-buffering — no [B, T, heads, hd] gather materialization in HBM.
-- online-softmax accumulation in VMEM scratch across the page dimension
-  (flash-attention style m/l/acc carry), GQA handled by a static loop over
-  KV heads with G query rows each.
-- KV page layout: ``[num_pages, n_kv, page_size, head_dim]`` — the per-page
-  block (1, n_kv, ps, hd) keeps (page_size, head_dim) as the minor dims,
-  matching the bf16 (16, 128) tile.
+Design (v2 — manual double-buffered DMA):
+- grid = (batch,). K/V pools stay in HBM (`memory_space=ANY`); the kernel
+  walks only the pages the sequence actually occupies (`cdiv(ctx, ps)` —
+  a *dynamic* trip count, unlike a grid dimension) and DMAs each page into
+  a 2-slot VMEM scratch ring, prefetching page i+1 while computing page i.
+- page table + context lengths are scalar-prefetch operands (SMEM) so DMA
+  source addresses are computable before compute starts.
+- online-softmax accumulation (flash-style m/l/acc) in VMEM scratch; GQA
+  via a static loop over KV heads with G query rows each.
+- KV page layout ``[num_pages, n_kv, page_size, head_dim]``: one page is a
+  contiguous (n_kv, ps, hd) block whose minor dims match the bf16
+  (16, 128) tile.
 
-Pages past a sequence's context length contribute nothing (masked; their
-page-table entries point at the reserved garbage page 0).
+vs the v1 grid-over-pages version: no DMA for garbage pages past the
+context length (the old version fetched all `max_pages` table slots), and
+~B× fewer grid steps.
 """
 
 from __future__ import annotations
@@ -32,56 +34,105 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch
-            q_ref, k_ref, v_ref,                # blocks
-            o_ref,                              # output block
-            m_scr, l_scr, acc_scr,              # VMEM scratch
-            *, page_size: int, n_kv: int, group: int, scale: float):
+def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
+            q_ref,                              # VMEM block [1, n_q, hd]
+            k_hbm, v_hbm,                       # full pools in HBM/ANY
+            o_ref,                              # VMEM block [1, n_q, hd]
+            k_buf, v_buf, sems,                 # scratch: 2-slot chunk ring
+            m_scr, l_scr, acc_scr,
+            *, page_size: int, n_kv: int, group: int, scale: float,
+            max_pages: int, chunk: int):
     b = pl.program_id(0)
-    p = pl.program_id(1)
-
-    @pl.when(p == 0)
-    def _init():
-        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
-
     ctx = context_lens_ref[b]
-    start = p * page_size
+    n_pages = jnp.minimum(pl.cdiv(ctx, page_size), max_pages)
+    n_chunks = pl.cdiv(n_pages, chunk)
 
-    @pl.when(start < ctx)
-    def _compute():
-        # Valid tokens in this page.
-        token_pos = start + jax.lax.broadcasted_iota(jnp.int32,
-                                                     (1, page_size), 1)
-        mask = (token_pos < ctx)
-        q = q_ref[0].astype(jnp.float32) * scale          # [n_q, hd]
-        for kv in range(n_kv):
-            qh = q[kv * group:(kv + 1) * group, :]        # [G, hd]
-            k = k_ref[0, kv].astype(jnp.float32)          # [ps, hd]
-            v = v_ref[0, kv].astype(jnp.float32)          # [ps, hd]
-            s = jax.lax.dot_general(
-                qh, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)       # [G, ps]
-            s = jnp.where(mask, s, _NEG_INF)
-            rows = slice(kv * group, (kv + 1) * group)
-            m_prev = m_scr[rows, :1]                      # [G, 1]
-            l_prev = l_scr[rows, :1]
-            m_cur = jnp.max(s, axis=1, keepdims=True)     # [G, 1]
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            ps_ = jnp.exp(s - m_new)                      # [G, ps]
-            l_new = l_prev * alpha + jnp.sum(ps_, axis=1, keepdims=True)
-            acc_scr[rows, :] = acc_scr[rows, :] * alpha + \
-                jax.lax.dot_general(ps_, v, (((1,), (0,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-            m_scr[rows, :1] = m_new
-            l_scr[rows, :1] = l_new
+    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(p == pl.num_programs(1) - 1)
-    def _finalize():
-        l = jnp.maximum(l_scr[:, :1], 1e-9)
-        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+    def start_chunk(slot, c):
+        # One DMA per page (pages are non-contiguous), all signaling the
+        # slot's semaphores; waits are batched per chunk.
+        base = c * chunk
+        for j in range(chunk):
+            p = base + j
+
+            @pl.when(p < n_pages)
+            def _():
+                page = page_table_ref[b, p]
+                pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot, j],
+                                      sems.at[slot, 0]).start()
+                pltpu.make_async_copy(v_hbm.at[page], v_buf.at[slot, j],
+                                      sems.at[slot, 1]).start()
+
+    def wait_chunk(slot, c):
+        base = c * chunk
+        for j in range(chunk):
+            p = base + j
+
+            @pl.when(p < n_pages)
+            def _():
+                page = page_table_ref[b, p]
+                pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot, j],
+                                      sems.at[slot, 0]).wait()
+                pltpu.make_async_copy(v_hbm.at[page], v_buf.at[slot, j],
+                                      sems.at[slot, 1]).wait()
+
+    @pl.when(n_chunks > 0)
+    def _run():
+        start_chunk(0, 0)
+
+        def body(c, _):
+            slot = jax.lax.rem(c, 2)
+
+            @pl.when(c + 1 < n_chunks)
+            def _prefetch():
+                start_chunk(1 - slot, c + 1)
+
+            wait_chunk(slot, c)
+
+            span = chunk * page_size
+            start = c * span
+            token_pos = start + jax.lax.broadcasted_iota(
+                jnp.int32, (1, span), 1)
+            mask = token_pos < ctx
+            q = q_ref[0].astype(jnp.float32) * scale       # [n_q, hd]
+            for kv in range(n_kv):
+                qh = q[kv * group:(kv + 1) * group, :]     # [G, hd]
+                # [chunk, ps, hd] -> [chunk*ps, hd] keys for this head.
+                k = k_buf[slot, :, kv].astype(jnp.float32).reshape(span, -1)
+                v = v_buf[slot, :, kv].astype(jnp.float32).reshape(span, -1)
+                # Zero V rows past the context: their probabilities are 0,
+                # but 0 x garbage from never-DMA'd sub-buffers must not
+                # reach the accumulator (0 x NaN = NaN). Column-oriented
+                # iota (Mosaic cannot transpose 1-bit vectors).
+                vmask = (start + jax.lax.broadcasted_iota(
+                    jnp.int32, (span, 1), 0)) < ctx
+                v = jnp.where(vmask, v, 0.0)
+                s = jax.lax.dot_general(
+                    qh, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)    # [G, span]
+                s = jnp.where(mask, s, _NEG_INF)
+                rows = slice(kv * group, (kv + 1) * group)
+                m_prev = m_scr[rows, :1]
+                l_prev = l_scr[rows, :1]
+                m_cur = jnp.max(s, axis=1, keepdims=True)
+                m_new = jnp.maximum(m_prev, m_cur)
+                alpha = jnp.exp(m_prev - m_new)
+                p_ = jnp.exp(s - m_new)
+                l_new = l_prev * alpha + jnp.sum(p_, axis=1, keepdims=True)
+                acc_scr[rows, :] = acc_scr[rows, :] * alpha + \
+                    jax.lax.dot_general(p_, v, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+                m_scr[rows, :1] = m_new
+                l_scr[rows, :1] = l_new
+            return ()
+
+        jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
+
+    l = jnp.maximum(l_scr[:, :1], 1e-9)
+    o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -98,20 +149,23 @@ def paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
     group = n_q // n_kv
     scale = 1.0 / (hd ** 0.5)
 
+    chunk = min(8, max_pages)
     kernel = functools.partial(_kernel, page_size=page_size, n_kv=n_kv,
-                               group=group, scale=scale)
+                               group=group, scale=scale,
+                               max_pages=max_pages, chunk=chunk)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, max_pages),
+        grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, n_q, hd), lambda b, p, pt, cl: (b, 0, 0)),
-            pl.BlockSpec((1, n_kv, page_size, hd),
-                         lambda b, p, pt, cl: (pt[b, p], 0, 0, 0)),
-            pl.BlockSpec((1, n_kv, page_size, hd),
-                         lambda b, p, pt, cl: (pt[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, n_q, hd), lambda b, pt, cl: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # k pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # v pool stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, n_q, hd), lambda b, p, pt, cl: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, n_q, hd), lambda b, pt, cl: (b, 0, 0)),
         scratch_shapes=[
+            pltpu.VMEM((2, chunk, n_kv, page_size, hd), k_pages.dtype),
+            pltpu.VMEM((2, chunk, n_kv, page_size, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.VMEM((n_q, 128), jnp.float32),   # m
             pltpu.VMEM((n_q, 128), jnp.float32),   # l
             pltpu.VMEM((n_q, hd), jnp.float32),    # acc
@@ -122,6 +176,6 @@ def paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, n_q, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
+            dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(page_table, context_lens, q, k_pages, v_pages)
