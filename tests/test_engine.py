@@ -263,3 +263,61 @@ class TestKVPageManager:
         assert n == 32 and mpages == pages[:2]
         mgr.release_prefix(hashes)
         mgr.release_prefix(stored)
+
+
+class TestEngineResilience:
+    def test_step_failure_fails_inflight_requests(self):
+        """A step-level failure (e.g. kernel compile error on real hardware)
+        must surface to clients instead of hanging them (found in live
+        verification: the loop thread died and requests hung)."""
+        engine = make_engine()
+        col = Collector()
+        engine.submit(EngineRequest(
+            "boom", token_ids=list(range(16)),
+            sampling=SamplingParams(max_tokens=50, temperature=0.0,
+                                    ignore_eos=True), on_output=col))
+        engine.step()          # admit + first token
+
+        def explode(*a, **k):
+            raise RuntimeError("Mosaic failed to compile TPU kernel")
+
+        engine._decode_multi = explode
+        engine.start()         # loop thread hits the failure
+        assert col.done.is_set() or col.done.wait(10)
+        engine.stop()
+        final = col.outputs[-1]
+        assert not final.status.ok()
+        assert "engine failure" in final.status.message
+        assert engine.stats()["running"] == 0
+        # The engine still accepts new work afterwards (fresh program path).
+        engine2 = make_engine()
+        col2 = Collector()
+        run_requests(engine2, [EngineRequest(
+            "ok", token_ids=list(range(16)),
+            sampling=SamplingParams(max_tokens=2, temperature=0.0,
+                                    ignore_eos=True), on_output=col2)])
+        assert col2.finish_reason == "length"
+
+    def test_prefill_failure_fails_that_request(self):
+        """Prefill-program failure mid-admission must error the triggering
+        request (it is in neither _waiting nor _running at that point) and
+        leak no slot/pages (code-review finding)."""
+        engine = make_engine()
+
+        def explode(*a, **k):
+            raise RuntimeError("prefill compile failure")
+
+        engine._run_prefill_install = explode
+        col = Collector()
+        engine.submit(EngineRequest(
+            "pboom", token_ids=list(range(16)),
+            sampling=SamplingParams(max_tokens=4, temperature=0.0,
+                                    ignore_eos=True), on_output=col))
+        engine.start()
+        assert col.done.is_set() or col.done.wait(10)
+        engine.stop()
+        assert not col.outputs[-1].status.ok()
+        assert "prefill failure" in col.outputs[-1].status.message
+        assert len(col.outputs) == 1            # exactly one error callback
+        assert len(engine._free_slots) == engine.cfg.max_batch_size
+        assert engine.page_mgr.num_free == engine.cfg.num_pages - 1

@@ -406,11 +406,57 @@ class InferenceEngine:
     # ------------------------------------------------------------- the loop
     def _loop(self) -> None:
         while not self._stopped.is_set():
-            did_work = self.step()
+            try:
+                did_work = self.step()
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                logger.exception("engine step failed; failing in-flight "
+                                 "requests")
+                self._fail_all(str(e))
+                did_work = True
             if not did_work:
                 with self._lock:
                     if not self._waiting and not self._running:
                         self._lock.wait(timeout=0.05)
+
+    def _fail_all(self, message: str) -> None:
+        """A step-level failure (e.g. a compile error) poisons the batch:
+        surface it to every in-flight request instead of hanging them.
+
+        Cleanup deliberately avoids the compiled helper programs (the device
+        path just failed, and donated buffers may be invalidated): host-side
+        bookkeeping is released first, then the small device-side slot
+        arrays are rebuilt from fresh host constants."""
+        with self._lock:
+            waiting = list(self._waiting)
+            self._waiting.clear()
+        running = list(self._running.values())
+        self._running.clear()
+        victims = [seq.req for seq in running] + waiting
+        for seq in running:
+            seq.finished = True
+            with self._lock:
+                if seq.slot >= 0:
+                    self._free_slots.append(seq.slot)
+            try:
+                seq.pages.release(self.page_mgr)
+            except Exception:  # noqa: BLE001
+                logger.exception("page release after step failure")
+        # Rebuild slot state without invoking jit programs.
+        B, cfg = self.cfg.max_batch_size, self.cfg
+        self._dstate["pt"] = jnp.full((B, cfg.pages_per_seq), GARBAGE_PAGE,
+                                      jnp.int32)
+        self._dstate["active"] = jnp.zeros((B,), jnp.bool_)
+        self._dstate["clens"] = jnp.zeros((B,), jnp.int32)
+        for req in victims:
+            try:
+                req.on_output(RequestOutput(
+                    service_request_id=req.service_request_id,
+                    request_id=req.request_id,
+                    status=Status(StatusCode.UNKNOWN,
+                                  f"engine failure: {message[:300]}"),
+                    finished=True))
+            except Exception:  # noqa: BLE001
+                logger.exception("failure callback")
 
     def step(self) -> bool:
         """One engine iteration: process cancellations, admit, decode one
@@ -583,7 +629,26 @@ class InferenceEngine:
             seq.slot = self._free_slots.pop()
 
         t0 = time.monotonic()
-        first_token, lp = self._run_prefill_install(seq, prompt, matched)
+        try:
+            first_token, lp = self._run_prefill_install(seq, prompt, matched)
+        except Exception as e:  # noqa: BLE001 — e.g. compile error on device
+            # Fail THIS request visibly and return its resources, then
+            # re-raise so the loop's _fail_all can deal with potentially
+            # invalidated (donated) device state.
+            with self._lock:
+                self._free_slots.append(seq.slot)
+            seq.pages.release(self.page_mgr)
+            seq.finished = True
+            try:
+                req.on_output(RequestOutput(
+                    service_request_id=req.service_request_id,
+                    request_id=req.request_id,
+                    status=Status(StatusCode.UNKNOWN,
+                                  f"engine prefill failure: {str(e)[:300]}"),
+                    finished=True))
+            except Exception:  # noqa: BLE001
+                logger.exception("prefill failure callback")
+            raise
         self.recent_max_ttft_ms = max(self.recent_max_ttft_ms,
                                       (time.monotonic() - t0) * 1000)
 

@@ -188,11 +188,18 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array,
                     context_lens: jax.Array) -> jax.Array:
     """Backend dispatcher: hand-written Pallas kernel on TPU, XLA gather
-    fallback elsewhere (CPU test meshes). Selection happens at trace time —
-    both paths are numerically equivalent (tested)."""
+    fallback elsewhere (CPU test meshes) and for shapes outside the
+    kernel's tiling constraints. Selection happens at trace time — both
+    paths are numerically equivalent (tested)."""
     import os
 
-    if jax.default_backend() != "cpu" and \
+    n_heads, hd = q.shape[-2], q.shape[-1]
+    n_kv = k_pages.shape[1]
+    # Mosaic tiling: the last dim of a VMEM page slice must be a multiple
+    # of 128 (lane width); GQA grouping needs n_heads % n_kv == 0.
+    kernel_ok = (hd % 128 == 0 and n_heads % n_kv == 0
+                 and q.dtype in (jnp.bfloat16, jnp.float32))
+    if kernel_ok and jax.default_backend() != "cpu" and \
             os.environ.get("XLLM_DISABLE_PALLAS_ATTENTION", "") in ("", "0"):
         from .pallas_paged_attention import paged_attention_pallas
 
