@@ -33,7 +33,7 @@ def main() -> None:
     on_accel = backend not in ("cpu",)
     mcfg = bench_1b_config() if on_accel else tiny_config(dtype=jnp.float32)
 
-    B = 8
+    B = 16 if jax.default_backend() != "cpu" else 8
     ctx = 512 if on_accel else 64
     max_seq = 1024 if on_accel else 128
     cfg = EngineConfig(
