@@ -116,3 +116,34 @@ class TestRealEngineE2E:
         r = requests.get(f"http://{agent.name}/stats", timeout=5)
         stats = r.json()
         assert "kv_usage_perc" in stats and "cached_blocks" in stats
+
+
+class TestNChoices:
+    def test_n_greater_than_one(self, cluster):
+        master, agent = cluster
+        r = requests.post(_base(master) + "/v1/completions", json={
+            "model": "tiny-llama", "prompt": "pick a number",
+            "max_tokens": 4, "temperature": 0, "ignore_eos": True, "n": 3,
+        }, timeout=120)
+        assert r.status_code == 200, r.text
+        body = r.json()
+        choices = body["choices"]
+        assert sorted(c["index"] for c in choices) == [0, 1, 2]
+        # Greedy => all three choices identical text.
+        assert len({c["text"] for c in choices}) == 1
+        assert all(c["finish_reason"] == "length" for c in choices)
+        assert body["usage"]["completion_tokens"] == 12
+        assert body["usage"]["prompt_tokens"] > 0
+
+    def test_n_with_seed_distinct_choices(self, cluster):
+        master, agent = cluster
+        r = requests.post(_base(master) + "/v1/completions", json={
+            "model": "tiny-llama", "prompt": "vary " * 30,
+            "max_tokens": 5, "temperature": 1.5, "top_k": 200, "seed": 7,
+            "ignore_eos": True, "n": 2,
+        }, timeout=120)
+        body = r.json()
+        assert len(body["choices"]) == 2
+        # Per-choice seeds (seed+k) should usually give distinct samples.
+        texts = {c["text"] for c in body["choices"]}
+        assert len(texts) == 2

@@ -95,6 +95,43 @@ class AgentConfig:
     slice_id: str = "slice-0"
 
 
+class _ChoiceAggregator:
+    """Merges n engine sequences into one OpenAI request: re-indexes each
+    choice's outputs and defers `finished`/usage until the last choice
+    completes."""
+
+    def __init__(self, n: int, push):
+        self._n = n
+        self._remaining = n
+        self._push = push
+        self._prompt_tokens = 0
+        self._generated = 0
+        self._lock = threading.Lock()
+
+    def callback_for(self, index: int):
+        def cb(out: RequestOutput) -> None:
+            for seq_out in out.outputs:
+                seq_out.index = index
+            if out.finished:
+                with self._lock:
+                    self._remaining -= 1
+                    last = self._remaining == 0
+                    if out.usage is not None:
+                        self._prompt_tokens = out.usage.num_prompt_tokens
+                        self._generated += out.usage.num_generated_tokens
+                    if last:
+                        from ..common.request import Usage
+
+                        out.usage = Usage(
+                            num_prompt_tokens=self._prompt_tokens,
+                            num_generated_tokens=self._generated)
+                    else:
+                        out.finished = False
+                        out.usage = None
+            self._push(out)
+        return cb
+
+
 class GenerationStreamer:
     """Batches RequestOutput deltas per destination service and POSTs
     `{"gens": [...]}` (reference batched DisaggStreamGenerations,
@@ -409,12 +446,33 @@ class EngineAgent:
             return web.json_response({"ok": True,
                                       "service_request_id": sid})
 
-        self.engine.submit(EngineRequest(
-            service_request_id=sid,
-            request_id=body.get("request_id", sid),
-            token_ids=token_ids, sampling=sampling, on_output=on_output,
-            offline=bool(body.get("offline", False)),
-            priority=int(body.get("priority") or 0)))
+        # n > 1: fan out into n engine sequences sharing the prompt (the
+        # prefix cache dedupes their prefill); choice k's outputs are
+        # re-indexed, and `finished` is withheld until every choice is done
+        # (the service closes the stream on the first finished delta).
+        n = max(1, sampling.n)
+        if n == 1:
+            self.engine.submit(EngineRequest(
+                service_request_id=sid,
+                request_id=body.get("request_id", sid),
+                token_ids=token_ids, sampling=sampling, on_output=on_output,
+                offline=bool(body.get("offline", False)),
+                priority=int(body.get("priority") or 0)))
+            return web.json_response({"ok": True, "service_request_id": sid})
+
+        agg = _ChoiceAggregator(n, lambda out: self.streamer.push(dest, out))
+        for k in range(n):
+            sub_sampling = sampling
+            if sampling.seed is not None:
+                sub_sampling = SamplingParams.from_dict(sampling.to_dict())
+                sub_sampling.seed = sampling.seed + k
+            self.engine.submit(EngineRequest(
+                service_request_id=sid,
+                request_id=body.get("request_id", sid),
+                token_ids=list(token_ids), sampling=sub_sampling,
+                on_output=agg.callback_for(k),
+                offline=bool(body.get("offline", False)),
+                priority=int(body.get("priority") or 0)))
         return web.json_response({"ok": True, "service_request_id": sid})
 
     def _transfer_to_peer(self, h: PrefillHandoff, peer: str,
@@ -513,6 +571,7 @@ class EngineAgent:
             v = body.get(key)
             return cast(v) if v is not None else default
         sp.max_tokens = num("max_tokens", num("max_completion_tokens", 16, int), int)
+        sp.n = num("n", 1, int)
         sp.temperature = num("temperature", 1.0, float)
         sp.top_p = num("top_p", 1.0, float)
         sp.top_k = num("top_k", -1, int)
