@@ -1,0 +1,69 @@
+"""Service-replica HA drill (SURVEY.md §3.5): two masters, master death,
+watch-driven takeover, continued serving through the survivor."""
+
+import requests
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.coordination.memory import InMemoryCoordination, MemoryStore
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.rpc import MASTER_KEY
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import wait_until
+
+
+def _opts():
+    return ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                          lease_ttl_s=0.5, sync_interval_s=0.2,
+                          reconcile_interval_s=0.1,
+                          heartbeat_silence_to_suspect_s=1.0,
+                          detect_disconnected_instance_interval_s=2.0)
+
+
+class TestHAFailover:
+    def test_replica_takeover_and_serving(self, store):
+        m1 = Master(_opts(), coord=InMemoryCoordination(store))
+        m1.start()
+        m2 = Master(_opts(), coord=InMemoryCoordination(store))
+        m2.start()
+        assert m1.scheduler.is_master and not m2.scheduler.is_master
+
+        engine = FakeEngine(InMemoryCoordination(store),
+                            FakeEngineConfig(heartbeat_interval_s=0.2,
+                                             lease_ttl_s=0.5)).start()
+        try:
+            # Both replicas see the instance (watch-driven registration).
+            for m in (m1, m2):
+                assert wait_until(
+                    lambda m=m: m.scheduler.instance_mgr.get_instance_meta(
+                        engine.name) is not None, timeout=5)
+
+            # Serving works through BOTH replicas (any replica routes).
+            for m in (m1, m2):
+                r = requests.post(
+                    f"http://127.0.0.1:{m.http_port}/v1/completions",
+                    json={"model": "fake-model", "prompt": "hi",
+                          "max_tokens": 32}, timeout=10)
+                assert r.status_code == 200, r.text
+
+            # Master dies -> replica must win the election and keep serving.
+            m1.stop()
+            assert wait_until(lambda: m2.scheduler.is_master, timeout=5)
+            coord = InMemoryCoordination(store)
+            assert coord.get(MASTER_KEY) == m2.scheduler.self_addr
+            coord.close()
+
+            # The new master performs master duties: engines heartbeat to it
+            # (they resolve MASTER_KEY) and serving continues.
+            before = m2.scheduler.instance_mgr.get_load_infos()[
+                engine.name].load.running_requests_num
+            r = requests.post(
+                f"http://127.0.0.1:{m2.http_port}/v1/completions",
+                json={"model": "fake-model", "prompt": "after failover",
+                      "max_tokens": 32}, timeout=10)
+            assert r.status_code == 200, r.text
+            assert r.json()["choices"][0]["text"] == \
+                "Hello from the fake engine!"
+        finally:
+            engine.stop()
+            m2.stop()
