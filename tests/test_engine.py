@@ -321,3 +321,72 @@ class TestEngineResilience:
         assert len(col.outputs) == 1            # exactly one error callback
         assert len(engine._free_slots) == engine.cfg.max_batch_size
         assert engine.page_mgr.num_free == engine.cfg.num_pages - 1
+
+
+class TestChunkedPrefill:
+    def _engine(self, chunk):
+        cfg = EngineConfig(
+            model=tiny_config(dtype=jnp.float32, max_context_len=256),
+            num_pages=64, page_size=16, hash_block_size=32,
+            max_batch_size=4, max_seq_len=256, prefill_buckets=(32, 64, 256),
+            prefill_chunk_tokens=chunk)
+        return InferenceEngine(cfg)
+
+    def test_chunked_matches_unchunked(self):
+        chunked = self._engine(32)
+        plain = self._engine(0)
+        prompt = list(range(3, 120))    # 117 tokens -> 3 chunks + final
+        want = naive_greedy(plain, prompt, 5)
+        col = Collector()
+        run_requests(chunked, [EngineRequest(
+            "c", token_ids=prompt,
+            sampling=SamplingParams(max_tokens=5, temperature=0.0,
+                                    ignore_eos=True), on_output=col)])
+        assert col.tokens == want
+
+    def test_decode_interleaves_with_chunked_prefill(self):
+        engine = self._engine(32)
+        short_col = Collector()
+        engine.submit(EngineRequest(
+            "short", token_ids=list(range(10)),
+            sampling=SamplingParams(max_tokens=30, temperature=0.0,
+                                    ignore_eos=True), on_output=short_col))
+        engine.step()           # short admitted + first token
+        tokens_before = len(short_col.tokens)
+        long_col = Collector()
+        engine.submit(EngineRequest(
+            "long", token_ids=list(range(5, 200)),   # 195 tokens, 6 chunks
+            sampling=SamplingParams(max_tokens=3, temperature=0.0,
+                                    ignore_eos=True), on_output=long_col))
+        # During the chunked admission of 'long', 'short' keeps decoding.
+        interleaved = 0
+        while engine._prefilling is not None or not long_col.done.is_set():
+            before = len(short_col.tokens)
+            engine.step()
+            if engine._prefilling is not None and \
+                    len(short_col.tokens) > before:
+                interleaved += 1
+            if short_col.done.is_set() and long_col.done.is_set():
+                break
+        assert interleaved >= 2   # decode progressed during prefill chunks
+        while not (short_col.done.is_set() and long_col.done.is_set()):
+            engine.step()
+        assert len(long_col.tokens) == 3
+        assert len(short_col.tokens) == 30
+
+    def test_chunked_prefill_cancellation(self):
+        engine = self._engine(32)
+        col = Collector()
+        engine.submit(EngineRequest(
+            "cx", token_ids=list(range(200)),
+            sampling=SamplingParams(max_tokens=5, temperature=0.0,
+                                    ignore_eos=True), on_output=col))
+        engine.step()            # starts chunked admission
+        assert engine._prefilling is not None
+        engine.cancel("cx")
+        engine.step()
+        assert engine._prefilling is None
+        assert col.done.is_set()
+        assert not col.outputs[-1].status.ok()
+        assert len(engine._free_slots) == engine.cfg.max_batch_size
+        assert engine.page_mgr.num_free == engine.cfg.num_pages - 1

@@ -31,6 +31,11 @@ class EngineConfig:
     # Sampling.
     max_top_logprobs: int = 5
     seed: int = 0
+    # Chunked prefill: prompts longer than this are written to the KV pool
+    # in chunks of this many tokens across engine iterations, so running
+    # decodes keep streaming while a long prompt prefills. 0 disables
+    # (whole-suffix prefill in one program call). Must be page-aligned.
+    prefill_chunk_tokens: int = 0
     # Decode horizon: tokens generated per host roundtrip (lax.scan inside
     # one jit call). 1 = lowest streaming latency; larger values amortize
     # dispatch + transfer overhead (essential over remote-attached chips,
@@ -55,3 +60,5 @@ class EngineConfig:
             raise ValueError("prefill buckets must be ascending")
         if self.prefill_buckets[-1] < self.max_seq_len:
             raise ValueError("largest prefill bucket must cover max_seq_len")
+        if self.prefill_chunk_tokens % self.page_size:
+            raise ValueError("prefill_chunk_tokens must be page-aligned")

@@ -173,6 +173,8 @@ class InferenceEngine:
 
         self._waiting: deque[EngineRequest] = deque()
         self._running: dict[int, _Sequence] = {}
+        # In-flight chunked prefill (at most one; decode interleaves).
+        self._prefilling: Optional[dict[str, Any]] = None
         self._free_slots = list(range(B - 1, -1, -1))
         self._lock = threading.Condition()
         self._cancelled: set[str] = set()
@@ -347,6 +349,24 @@ class InferenceEngine:
 
         self._inject_install = inject_install
 
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_chunk(params, d, tokens, ints):
+            """One non-final chunk of a chunked prefill: writes the
+            chunk's KV (attending to the already-written prefix) and
+            discards logits. ints: [P + 2] = [page_row(P), prefix_len,
+            seq_len]."""
+            page_row = ints[:P]
+            prefix_len = ints[P]
+            seq_len = ints[P + 1]
+            _, kv = fam.prefill_forward(
+                params, mcfg, tokens,
+                prefix_len + jnp.arange(tokens.shape[1],
+                                        dtype=jnp.int32)[None, :],
+                d["kv"], page_row[None, :], prefix_len[None], seq_len[None])
+            return dict(d, kv=kv)
+
+        self._prefill_chunk = prefill_chunk
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceEngine":
         self._thread = threading.Thread(target=self._loop, name="engine-loop",
@@ -432,6 +452,18 @@ class InferenceEngine:
         running = list(self._running.values())
         self._running.clear()
         victims = [seq.req for seq in running] + waiting
+        if self._prefilling is not None:
+            st = self._prefilling
+            self._prefilling = None
+            pseq = st["seq"]
+            pseq.finished = True
+            with self._lock:
+                self._free_slots.append(pseq.slot)
+            try:
+                pseq.pages.release(self.page_mgr)
+            except Exception:  # noqa: BLE001
+                logger.exception("prefilling release after step failure")
+            victims.append(st["req"])
         for seq in running:
             seq.finished = True
             with self._lock:
@@ -459,12 +491,16 @@ class InferenceEngine:
                 logger.exception("failure callback")
 
     def step(self) -> bool:
-        """One engine iteration: process cancellations, admit, decode one
-        horizon."""
+        """One engine iteration: process cancellations, advance at most one
+        prefill chunk (or admit), decode one horizon. Chunked prefill keeps
+        long-prompt admission from stalling running decodes."""
         self._process_cancellations()
-        admitted = self._admit()
+        if self._prefilling is not None:
+            worked = self._advance_prefill()
+        else:
+            worked = self._admit()
         decoded = self._decode()
-        return admitted or decoded
+        return worked or decoded
 
     def _process_cancellations(self) -> None:
         with self._lock:
@@ -477,6 +513,16 @@ class InferenceEngine:
             for r in self._waiting:
                 (victims if r.service_request_id in cancelled else kept).append(r)
             self._waiting = kept
+        if self._prefilling is not None and \
+                self._prefilling["seq"].req.service_request_id in cancelled:
+            st = self._prefilling
+            self._prefilling = None
+            seq = st["seq"]
+            with self._lock:
+                self._free_slots.append(seq.slot)
+            seq.pages.release(self.page_mgr)
+            seq.finished = True
+            victims.append(seq.req)
         # Callbacks run outside the lock (they may do slow I/O).
         for r in victims:
             self._emit_cancelled(r)
@@ -628,34 +674,89 @@ class InferenceEngine:
         with self._lock:
             seq.slot = self._free_slots.pop()
 
-        t0 = time.monotonic()
+        # Chunked prefill: long suffixes are written chunk-by-chunk across
+        # engine iterations so running decodes keep making progress.
+        C = cfg.prefill_chunk_tokens
+        if C > 0 and len(prompt) - matched > C:
+            self._prefilling = {"seq": seq, "req": req, "prompt": prompt,
+                                "cache_matched": matched,
+                                "written": matched, "t0": time.monotonic()}
+            return True
+        return self._finish_admission(seq, req, prompt, matched, matched,
+                                      time.monotonic())
+
+    def _advance_prefill(self) -> bool:
+        """One chunk of the in-flight chunked prefill."""
+        st = self._prefilling
+        assert st is not None
+        seq, req, prompt = st["seq"], st["req"], st["prompt"]
+        C = self.cfg.prefill_chunk_tokens
+        remaining = len(prompt) - st["written"]
+        if remaining <= C:
+            self._prefilling = None
+            return self._finish_admission(seq, req, prompt,
+                                          st["cache_matched"],
+                                          st["written"], st["t0"])
+        P = self.cfg.pages_per_seq
+        chunk = np.asarray([prompt[st["written"]:st["written"] + C]],
+                           np.int32)
+        ints = np.full((P + 2,), GARBAGE_PAGE, np.int32)
+        pages = seq.pages.all_pages
+        ints[:len(pages)] = pages
+        ints[P] = st["written"]
+        ints[P + 1] = C
         try:
-            first_token, lp = self._run_prefill_install(seq, prompt, matched)
+            self._dstate = self._prefill_chunk(
+                self.params, self._dstate, jnp.asarray(chunk),
+                jnp.asarray(ints))
+        except Exception as e:  # noqa: BLE001
+            self._prefilling = None
+            self._fail_admission(seq, req, e)
+            raise
+        st["written"] += C
+        return True
+
+    def _fail_admission(self, seq: _Sequence, req: EngineRequest,
+                        e: Exception) -> None:
+        """Return a mid-admission sequence's resources and surface the
+        failure to its client."""
+        with self._lock:
+            self._free_slots.append(seq.slot)
+        seq.pages.release(self.page_mgr)
+        seq.finished = True
+        try:
+            req.on_output(RequestOutput(
+                service_request_id=req.service_request_id,
+                request_id=req.request_id,
+                status=Status(StatusCode.UNKNOWN,
+                              f"engine prefill failure: {str(e)[:300]}"),
+                finished=True))
+        except Exception:  # noqa: BLE001
+            logger.exception("prefill failure callback")
+
+    def _finish_admission(self, seq: _Sequence, req: EngineRequest,
+                          prompt: list[int], cache_matched: int,
+                          prefix_written: int, t0: float) -> bool:
+        """Final prefill chunk (+sample first token) and slot install."""
+        cfg = self.cfg
+        P0 = seq.prompt_len
+        try:
+            first_token, lp = self._run_prefill_install(seq, prompt,
+                                                        prefix_written)
         except Exception as e:  # noqa: BLE001 — e.g. compile error on device
             # Fail THIS request visibly and return its resources, then
             # re-raise so the loop's _fail_all can deal with potentially
             # invalidated (donated) device state.
-            with self._lock:
-                self._free_slots.append(seq.slot)
-            seq.pages.release(self.page_mgr)
-            seq.finished = True
-            try:
-                req.on_output(RequestOutput(
-                    service_request_id=req.service_request_id,
-                    request_id=req.request_id,
-                    status=Status(StatusCode.UNKNOWN,
-                                  f"engine prefill failure: {str(e)[:300]}"),
-                    finished=True))
-            except Exception:  # noqa: BLE001
-                logger.exception("prefill failure callback")
+            self._fail_admission(seq, req, e)
             raise
         self.recent_max_ttft_ms = max(self.recent_max_ttft_ms,
                                       (time.monotonic() - t0) * 1000)
 
-        # Donate completed prompt blocks to the prefix cache.
+        # Donate completed prompt blocks to the prefix cache (skip only the
+        # blocks matched FROM the cache; self-written chunks are donated).
         stored, donated = self.page_mgr.store_prefix(
             prompt, seq.pages.all_pages,
-            skip_blocks=matched // cfg.hash_block_size)
+            skip_blocks=cache_matched // cfg.hash_block_size)
         seq.pages.donated_hashes = stored
         seq.pages.donated_pages = donated
 
