@@ -196,12 +196,14 @@ class GenerationStreamer:
 
 class EngineAgent:
     def __init__(self, engine_cfg: EngineConfig, agent_cfg: AgentConfig,
-                 coord: Optional[CoordinationClient] = None):
+                 coord: Optional[CoordinationClient] = None,
+                 params: Optional[dict] = None):
         self.cfg = agent_cfg
         self.coord = coord or connect(agent_cfg.coordination_addr,
                                       agent_cfg.coordination_namespace)
         tokenizer = TokenizerFactory.create_tokenizer(agent_cfg.tokenizer_path)
-        self.engine = InferenceEngine(engine_cfg, tokenizer=tokenizer)
+        self.engine = InferenceEngine(engine_cfg, tokenizer=tokenizer,
+                                      params=params)
         self.port = agent_cfg.port or pick_free_port(agent_cfg.host)
         self.name = f"{agent_cfg.host}:{self.port}"
         self.incarnation_id = uuid.uuid4().hex[:12]
@@ -609,6 +611,9 @@ def main() -> None:
                    help="config factory in models.base (e.g. bench_1b, "
                         "llama3_8b, tiny)")
     p.add_argument("--tokenizer-path", default="")
+    p.add_argument("--checkpoint-path", default="",
+                   help="HF safetensors dir (llama/qwen2 families) or an "
+                        "orbax checkpoint dir")
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--page-size", type=int, default=16)
@@ -632,12 +637,30 @@ def main() -> None:
              if b < min(args.max_seq_len, mcfg.max_context_len)}
             | {min(args.max_seq_len, mcfg.max_context_len)})),
         role=InstanceType.parse(args.type))
+    params = None
+    if args.checkpoint_path:
+        from pathlib import Path
+
+        from .. import models as _models
+        from ..models import loader as _loader
+        from ..parallel.mesh import build_mesh as _build_mesh
+
+        mesh = _build_mesh(ecfg.mesh) if ecfg.mesh else None
+        fam = _models.get_model_family(ecfg.model_family)
+        if list(Path(args.checkpoint_path).glob("*.safetensors")):
+            params = _loader.load_hf_llama_safetensors(
+                args.checkpoint_path, mcfg, mesh=mesh,
+                rules=fam.sharding_rules)
+        else:
+            params = _loader.load_params(args.checkpoint_path, mcfg,
+                                         mesh=mesh, rules=fam.sharding_rules)
     agent = EngineAgent(
         ecfg, AgentConfig(host=args.host, port=args.port,
                           coordination_addr=args.coordination_addr,
                           instance_type=InstanceType.parse(args.type),
                           model_id=args.model_id,
-                          tokenizer_path=args.tokenizer_path))
+                          tokenizer_path=args.tokenizer_path),
+        params=params)
     agent.start()
     try:
         while True:

@@ -129,7 +129,8 @@ class _Sequence:
 class InferenceEngine:
     def __init__(self, cfg: EngineConfig, mesh=None,
                  tokenizer: Optional[Tokenizer] = None,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 params: Optional[dict] = None):
         cfg.validate()
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else build_mesh(
@@ -140,11 +141,14 @@ class InferenceEngine:
         self.family = get_model_family(cfg.model_family)
         mcfg = cfg.model
 
-        rng = jax.random.PRNGKey(cfg.seed)
-        params = self.family.init_params(mcfg, rng)
-        if self.mesh is not None:
-            params = shard_params(params, self.mesh,
-                                  self.family.sharding_rules)
+        if params is None:
+            # Random init (benchmarks / tests); real weights come through
+            # models/loader.py and are passed in pre-sharded.
+            rng = jax.random.PRNGKey(cfg.seed)
+            params = self.family.init_params(mcfg, rng)
+            if self.mesh is not None:
+                params = shard_params(params, self.mesh,
+                                      self.family.sharding_rules)
         self.params = params
         self.page_mgr = KVPageManager(cfg.num_pages, cfg.page_size,
                                       cfg.hash_block_size)

@@ -1,0 +1,151 @@
+"""Checkpoint loading: HuggingFace safetensors → stacked param pytrees.
+
+The engine serves random-init weights by default (benchmarks); this module
+loads real checkpoints. HF llama/qwen2-style weight names are mapped onto
+the framework's stacked-layer pytree (leading L dim, see models/llama.py)
+and optionally sharded straight onto the mesh (per-tensor `device_put`
+with the family's GSPMD rules — no full-model host copy per device).
+
+Orbax round-trip (`save_params`/`load_params`) covers framework-native
+checkpoints (engine restarts, converted models).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ModelConfig
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+Params = dict
+
+# HF per-layer name -> (our path, transpose?) for llama/qwen2 families.
+# HF Linear stores [out, in]; our kernels are [in, out] -> transpose.
+_HF_LAYER_MAP = {
+    "input_layernorm.weight": ("input_norm/scale", False),
+    "self_attn.q_proj.weight": ("q_proj/kernel", True),
+    "self_attn.k_proj.weight": ("k_proj/kernel", True),
+    "self_attn.v_proj.weight": ("v_proj/kernel", True),
+    "self_attn.o_proj.weight": ("o_proj/kernel", True),
+    "self_attn.q_proj.bias": ("q_proj/bias", False),
+    "self_attn.k_proj.bias": ("k_proj/bias", False),
+    "self_attn.v_proj.bias": ("v_proj/bias", False),
+    "post_attention_layernorm.weight": ("post_attn_norm/scale", False),
+    "mlp.gate_proj.weight": ("gate_proj/kernel", True),
+    "mlp.up_proj.weight": ("up_proj/kernel", True),
+    "mlp.down_proj.weight": ("down_proj/kernel", True),
+}
+_HF_TOP_MAP = {
+    "model.embed_tokens.weight": ("embed/embedding", False),
+    "model.norm.weight": ("final_norm/scale", False),
+    "lm_head.weight": ("lm_head/kernel", True),
+}
+
+
+def _set_path(tree: dict, path: str, value) -> None:
+    parts = path.split("/")
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def load_hf_llama_safetensors(ckpt_dir: str | Path, cfg: ModelConfig,
+                              mesh=None, rules=None) -> Params:
+    """Load an HF llama/qwen2 checkpoint directory (*.safetensors shards)
+    into the stacked pytree. Missing lm_head falls back to tied embeddings
+    semantics only if cfg.tie_embeddings is set."""
+    from safetensors import safe_open
+
+    ckpt_dir = Path(ckpt_dir)
+    files = sorted(ckpt_dir.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors in {ckpt_dir}")
+
+    L = cfg.num_layers
+    # Collect per-layer tensors then stack along L.
+    layer_acc: dict[str, list[Optional[np.ndarray]]] = {}
+    tree: Params = {}
+    seen = set()
+
+    def place(name: str, tensor: np.ndarray) -> None:
+        if name in _HF_TOP_MAP:
+            path, transpose = _HF_TOP_MAP[name]
+            _set_path(tree, path, np.ascontiguousarray(
+                tensor.T if transpose else tensor))
+            seen.add(name)
+            return
+        if not name.startswith("model.layers."):
+            logger.warning("unmapped checkpoint tensor: %s", name)
+            return
+        rest = name[len("model.layers."):]
+        idx_str, _, leaf = rest.partition(".")
+        if leaf not in _HF_LAYER_MAP:
+            logger.warning("unmapped layer tensor: %s", name)
+            return
+        idx = int(idx_str)
+        path, transpose = _HF_LAYER_MAP[leaf]
+        layer_acc.setdefault(path, [None] * L)[idx] = np.ascontiguousarray(
+            tensor.T if transpose else tensor)
+        seen.add(name)
+
+    for f in files:
+        with safe_open(str(f), framework="numpy") as sf:
+            for name in sf.keys():
+                place(name, sf.get_tensor(name))
+
+    for path, tensors in layer_acc.items():
+        missing = [i for i, t in enumerate(tensors) if t is None]
+        if missing:
+            raise ValueError(f"checkpoint missing layers {missing} for {path}")
+        _set_path(tree, f"layers/{path}", np.stack(tensors))
+
+    if "lm_head" not in tree and not cfg.tie_embeddings:
+        # Tied checkpoints ship no lm_head; honor tying implicitly.
+        logger.info("no lm_head in checkpoint; tying to embeddings")
+        tree["lm_head"] = {"kernel": np.ascontiguousarray(
+            tree["embed"]["embedding"].T)}
+
+    return _finalize(tree, cfg, mesh, rules)
+
+
+def _finalize(tree: Params, cfg: ModelConfig, mesh, rules) -> Params:
+    """Cast to model dtype and (optionally) shard leaf-by-leaf."""
+    if mesh is not None and rules is not None:
+        from jax.sharding import NamedSharding
+
+        from ..parallel.sharding import tree_specs
+
+        specs = tree_specs(tree, rules)
+
+        def put(leaf, spec):
+            return jax.device_put(jnp.asarray(leaf, cfg.dtype),
+                                  NamedSharding(mesh, spec))
+
+        return jax.tree.map(put, tree, specs)
+    return jax.tree.map(lambda a: jnp.asarray(a, cfg.dtype), tree)
+
+
+# ---------------------------------------------------------------- orbax ----
+def save_params(params: Params, path: str | Path) -> None:
+    """Framework-native checkpoint (orbax)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(Path(path).absolute(), params, force=True)
+
+
+def load_params(path: str | Path, cfg: ModelConfig,
+                mesh=None, rules=None) -> Params:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(Path(path).absolute())
+    return _finalize(params, cfg, mesh, rules)
