@@ -147,3 +147,12 @@ class TestNChoices:
         # Per-choice seeds (seed+k) should usually give distinct samples.
         texts = {c["text"] for c in body["choices"]}
         assert len(texts) == 2
+
+
+class TestAgentMetrics:
+    def test_prometheus_metrics(self, cluster):
+        master, agent = cluster
+        r = requests.get(f"http://{agent.name}/metrics", timeout=5)
+        assert r.status_code == 200
+        assert "engine_generated_tokens_total" in r.text
+        assert "engine_kv_usage_perc" in r.text

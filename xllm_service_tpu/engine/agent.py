@@ -289,6 +289,7 @@ class EngineAgent:
         app.router.add_get("/v1/models", self._h_models)
         app.router.add_get("/health", self._h_health)
         app.router.add_get("/stats", self._h_stats)
+        app.router.add_get("/metrics", self._h_metrics)
         app.router.add_post("/rpc/link", self._h_link)
         app.router.add_post("/rpc/unlink", self._h_unlink)
         app.router.add_post("/rpc/cancel", self._h_cancel)
@@ -350,6 +351,33 @@ class EngineAgent:
 
     async def _h_stats(self, req: web.Request) -> web.Response:
         return web.json_response(self.engine.stats())
+
+    async def _h_metrics(self, req: web.Request) -> web.Response:
+        """Prometheus text exposition of engine state (the service's
+        /metrics covers the orchestration plane; this covers the chip)."""
+        st = self.engine.stats()
+        lines = [
+            "# TYPE engine_waiting_requests gauge",
+            f"engine_waiting_requests {st['waiting']}",
+            "# TYPE engine_running_requests gauge",
+            f"engine_running_requests {st['running']}",
+            "# TYPE engine_kv_usage_perc gauge",
+            f"engine_kv_usage_perc {st['kv_usage_perc']:.6f}",
+            "# TYPE engine_cached_prefix_blocks gauge",
+            f"engine_cached_prefix_blocks {st['cached_blocks']}",
+            "# TYPE engine_generated_tokens_total counter",
+            f"engine_generated_tokens_total {st['total_generated']}",
+            "# TYPE engine_preemptions_total counter",
+            f"engine_preemptions_total {self.engine.preemption_count}",
+            "# TYPE engine_recent_max_ttft_milliseconds gauge",
+            f"engine_recent_max_ttft_milliseconds "
+            f"{self.engine.recent_max_ttft_ms:.3f}",
+            "# TYPE engine_recent_max_tbt_milliseconds gauge",
+            f"engine_recent_max_tbt_milliseconds "
+            f"{self.engine.recent_max_tbt_ms:.3f}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
 
     async def _h_models(self, req: web.Request) -> web.Response:
         return web.json_response({"object": "list", "data": [
