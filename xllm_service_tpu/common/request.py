@@ -217,6 +217,7 @@ class Request:
     prompt: str = ""
     messages: list[dict[str, Any]] = field(default_factory=list)
     tools: list[dict[str, Any]] = field(default_factory=list)
+    has_images: bool = False
     chat_template_kwargs: dict[str, Any] = field(default_factory=dict)
     token_ids: list[int] = field(default_factory=list)
     sampling: SamplingParams = field(default_factory=SamplingParams)

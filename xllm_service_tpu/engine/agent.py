@@ -211,6 +211,7 @@ class EngineAgent:
         self.streamer = GenerationStreamer(self.engine,
                                            agent_cfg.generation_flush_ms)
         self.linked_peers: dict[str, InstanceMetaInfo] = {}
+        self.encode_count = 0
         self._alive = True
         self._started = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -433,6 +434,25 @@ class EngineAgent:
         sid = body.get("service_request_id") or f"local-{uuid.uuid4().hex[:8]}"
         source = body.get("source_service_addr", "")
         token_ids = list(body.get("token_ids") or ())
+
+        # EPD multimodal: extract images, encode (locally or on the routed
+        # ENCODE instance), and rebuild token ids with image-token runs the
+        # model splices embeddings into (BASELINE config 5).
+        mm_embeds = None
+        if chat and self.engine.cfg.model_family == "qwen2_vl":
+            pixels = self._extract_images(body.get("messages") or [])
+            if pixels is not None:
+                encode_name = (body.get("routing") or {}).get(
+                    "encode_name", "")
+                try:
+                    mm_embeds = await asyncio.get_running_loop() \
+                        .run_in_executor(None, self._encode_pixels, pixels,
+                                         encode_name)
+                except Exception as e:  # noqa: BLE001
+                    return web.json_response(
+                        {"error": f"vision encode failed: {e}"}, status=502)
+                token_ids = self._build_mm_token_ids(
+                    body.get("messages") or [])
         if not token_ids:
             # Standalone mode (no orchestrator enrichment): tokenize here.
             prompt = body.get("prompt", "")
@@ -471,6 +491,7 @@ class EngineAgent:
                 service_request_id=sid,
                 request_id=body.get("request_id", sid),
                 token_ids=token_ids, sampling=sampling,
+                mm_embeds=mm_embeds,
                 prefill_only=True, on_prefill_done=on_prefill_done,
                 on_output=on_output))   # surfaces prefill-side errors
             return web.json_response({"ok": True,
@@ -486,6 +507,7 @@ class EngineAgent:
                 service_request_id=sid,
                 request_id=body.get("request_id", sid),
                 token_ids=token_ids, sampling=sampling, on_output=on_output,
+                mm_embeds=mm_embeds,
                 offline=bool(body.get("offline", False)),
                 priority=int(body.get("priority") or 0)))
             return web.json_response({"ok": True, "service_request_id": sid})
@@ -501,6 +523,7 @@ class EngineAgent:
                 request_id=body.get("request_id", sid),
                 token_ids=list(token_ids), sampling=sub_sampling,
                 on_output=agg.callback_for(k),
+                mm_embeds=mm_embeds,
                 offline=bool(body.get("offline", False)),
                 priority=int(body.get("priority") or 0)))
         return web.json_response({"ok": True, "service_request_id": sid})
@@ -547,6 +570,7 @@ class EngineAgent:
                           "has no vision encoder"}, status=400)
         data = await req.read()
         obj = msgpack.unpackb(data, raw=False)
+        self.encode_count += 1
         pixels = np.frombuffer(obj["bytes"], dtype=np.dtype(obj["dtype"])) \
             .reshape(obj["shape"])
         import jax.numpy as jnp
@@ -593,6 +617,95 @@ class EngineAgent:
             injected_first_logprob=lp,
             on_output=on_output))
         return web.json_response({"ok": True})
+
+    # ------------------------------------------------------- multimodal
+    def _extract_images(self, messages: list[dict]) -> Optional[np.ndarray]:
+        """Collect image parts from chat messages as [N, S, S, 3] float32
+        (S = the vision encoder's input size). Supports data-URI
+        `image_url` parts (PIL-decoded) and raw `image_data` parts
+        (base64 float32 + shape)."""
+        import base64
+        import io
+
+        vision = self.engine.cfg.model.vision
+        if vision is None:
+            return None
+        size = vision.image_size
+        out: list[np.ndarray] = []
+        for m in messages:
+            content = m.get("content")
+            if not isinstance(content, list):
+                continue
+            for part in content:
+                if not isinstance(part, dict):
+                    continue
+                ptype = str(part.get("type", ""))
+                if ptype == "image_url":
+                    url = (part.get("image_url") or {}).get("url", "")
+                    if not url.startswith("data:"):
+                        raise ValueError(
+                            "only data: URIs are supported for images")
+                    from PIL import Image
+
+                    raw = base64.b64decode(url.split(",", 1)[1])
+                    img = Image.open(io.BytesIO(raw)).convert("RGB") \
+                        .resize((size, size))
+                    out.append(np.asarray(img, np.float32) / 255.0)
+                elif ptype == "image_data":
+                    arr = np.frombuffer(
+                        base64.b64decode(part["data"]),
+                        np.float32).reshape(part["shape"])
+                    out.append(arr.astype(np.float32))
+        return np.stack(out) if out else None
+
+    def _encode_pixels(self, pixels: np.ndarray,
+                       encode_name: str) -> np.ndarray:
+        """ENCODE stage: remote on the routed instance, local fallback.
+        Returns flattened [n_images * out_tokens, D] float32."""
+        if encode_name and encode_name != self.name:
+            r = _requests.post(
+                f"http://{encode_name}/rpc/encode",
+                data=msgpack.packb({"bytes": pixels.tobytes(),
+                                    "shape": list(pixels.shape),
+                                    "dtype": "float32"}, use_bin_type=True),
+                timeout=60)
+            r.raise_for_status()
+            obj = msgpack.unpackb(r.content, raw=False)
+            embeds = np.frombuffer(obj["bytes"], np.float32) \
+                .reshape(obj["shape"])
+        else:
+            import jax.numpy as jnp
+
+            from ..models.qwen2_vl import encode_images
+
+            embeds = np.asarray(encode_images(
+                self.engine.params, self.engine.cfg.model,
+                jnp.asarray(pixels)).astype(jnp.float32))
+        return embeds.reshape(-1, embeds.shape[-1])
+
+    def _build_mm_token_ids(self, messages: list[dict]) -> list[int]:
+        """Token ids with each image part expanded to `out_tokens` copies of
+        the model's image placeholder token."""
+        mcfg = self.engine.cfg.model
+        out_tokens = mcfg.vision.out_tokens if mcfg.vision else 0
+        tok = self.engine.tokenizer
+        ids: list[int] = []
+        for m in messages:
+            content = m.get("content")
+            if isinstance(content, str):
+                ids.extend(tok.encode(content + "\n"))
+                continue
+            if not isinstance(content, list):
+                continue
+            for part in content:
+                if not isinstance(part, dict):
+                    ids.extend(tok.encode(str(part)))
+                elif part.get("type") == "text":
+                    ids.extend(tok.encode(part.get("text", "")))
+                elif str(part.get("type", "")).startswith("image"):
+                    ids.extend([mcfg.image_token_id] * out_tokens)
+            ids.extend(tok.encode("\n"))
+        return ids
 
     @staticmethod
     def _sampling_from_body(body: dict[str, Any]) -> SamplingParams:

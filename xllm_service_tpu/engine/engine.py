@@ -84,6 +84,9 @@ class EngineRequest:
     # entering the local decode batch (SURVEY.md §2.12 PD pipeline).
     prefill_only: bool = False
     on_prefill_done: Optional[Callable[["PrefillHandoff"], None]] = None
+    # Multimodal (qwen2_vl family): visual embeddings [n_mm_tokens, D]
+    # spliced into image-placeholder token positions during prefill.
+    mm_embeds: Optional[np.ndarray] = None
     # Decode-side injection: sequence arrives with prompt KV precomputed.
     injected_first_token: Optional[int] = None
     injected_kv: Optional[np.ndarray] = None
@@ -253,24 +256,34 @@ class InferenceEngine:
 
         self._decode_multi = decode_multi
 
+        is_vl = cfg.model_family == "qwen2_vl"
+
         @partial(jax.jit, donate_argnums=(1,))
-        def prefill_install(params, d, tokens, ints, floats, counts_row, key):
+        def prefill_install(params, d, tokens, ints, floats, counts_row, key,
+                            mm):
             """Prefill one sequence + install it into batch slot `slot`.
 
             ints: [P + 4] = [page_row(P), slot, prefix_len, seq_len,
                              want_logprobs]
             floats: [6] = [temperature, top_k, top_p, freq, pres, rep]
             counts_row: [V] penalty histogram of the full prompt.
+            mm: [1, M, D] visual embeddings (VL family; dummy otherwise).
             """
             page_row = ints[:P]
             slot = ints[P]
             prefix_len = ints[P + 1]
             seq_len = ints[P + 2]
-            logits, kv = fam.prefill_forward(
-                params, mcfg, tokens, prefix_len + jnp.arange(
-                    tokens.shape[1], dtype=jnp.int32)[None, :],
-                d["kv"], page_row[None, :], prefix_len[None],
-                seq_len[None])
+            positions = prefix_len + jnp.arange(
+                tokens.shape[1], dtype=jnp.int32)[None, :]
+            if is_vl:
+                logits, kv = fam.prefill_forward(
+                    params, mcfg, tokens, positions, d["kv"],
+                    page_row[None, :], prefix_len[None], seq_len[None],
+                    mm_embeds=mm)
+            else:
+                logits, kv = fam.prefill_forward(
+                    params, mcfg, tokens, positions, d["kv"],
+                    page_row[None, :], prefix_len[None], seq_len[None])
             d = dict(d, kv=kv)
             st = SamplingState(
                 floats[0:1], floats[1:2].astype(jnp.int32), floats[2:3],
@@ -681,7 +694,7 @@ class InferenceEngine:
         # Chunked prefill: long suffixes are written chunk-by-chunk across
         # engine iterations so running decodes keep making progress.
         C = cfg.prefill_chunk_tokens
-        if C > 0 and len(prompt) - matched > C:
+        if C > 0 and len(prompt) - matched > C and req.mm_embeds is None:
             self._prefilling = {"seq": seq, "req": req, "prompt": prompt,
                                 "cache_matched": matched,
                                 "written": matched, "t0": time.monotonic()}
@@ -887,9 +900,15 @@ class InferenceEngine:
         if sp.seed is not None:
             slot_key = jax.random.PRNGKey(sp.seed)
 
+        mm = seq.req.mm_embeds
+        if mm is None:
+            mm_arr = jnp.zeros((1, 1, cfg.model.hidden_size),
+                               cfg.model.dtype)
+        else:
+            mm_arr = jnp.asarray(mm, cfg.model.dtype)[None]
         self._dstate, packed = self._prefill_install(
             self.params, self._dstate, jnp.asarray(toks), jnp.asarray(ints),
-            jnp.asarray(floats), jnp.asarray(counts_row), slot_key)
+            jnp.asarray(floats), jnp.asarray(counts_row), slot_key, mm_arr)
         packed_np = np.asarray(packed)
         K = self.cfg.max_top_logprobs
         token = int(packed_np[0])

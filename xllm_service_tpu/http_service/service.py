@@ -195,6 +195,12 @@ class XllmHttpService:
             req.messages = msgs
             req.tools = body.get("tools") or []
             req.chat_template_kwargs = body.get("chat_template_kwargs") or {}
+            req.has_images = any(
+                isinstance(m.get("content"), list) and any(
+                    isinstance(part, dict)
+                    and str(part.get("type", "")).startswith("image")
+                    for part in m["content"])
+                for m in msgs if isinstance(m, dict))
         else:
             prompt = body.get("prompt", "")
             if isinstance(prompt, list):
@@ -229,7 +235,8 @@ class XllmHttpService:
         enriched["source_service_addr"] = self.scheduler.self_addr
         enriched["token_ids"] = req.token_ids
         enriched["routing"] = {"prefill_name": req.routing.prefill_name,
-                               "decode_name": req.routing.decode_name}
+                               "decode_name": req.routing.decode_name,
+                               "encode_name": req.routing.encode_name}
         path = "/v1/chat/completions" if kind == "chat" else "/v1/completions"
         task = asyncio.create_task(
             self._forward_to_instance(req, conn, path, enriched))

@@ -107,8 +107,10 @@ class InstanceMgr:
         self._instances: dict[str, _Entry] = {}
         self._prefill_index: list[str] = []
         self._decode_index: list[str] = []
+        self._encode_index: list[str] = []
         self._rr_prefill = 0
         self._rr_decode = 0
+        self._rr_encode = 0
         # L2: metrics.
         self._metrics_lock = threading.Lock()
         self._load_metrics: dict[str, LoadMetrics] = {}
@@ -330,10 +332,13 @@ class InstanceMgr:
             self._prefill_index.append(name)
         if itype in _DECODE_TYPES and name not in self._decode_index:
             self._decode_index.append(name)
+        if itype == InstanceType.ENCODE and name not in self._encode_index:
+            self._encode_index.append(name)
 
     def _index_remove(self, name: str) -> None:
         # O(1) swap-remove (reference `instance_mgr.cpp:1398-1428`).
-        for index in (self._prefill_index, self._decode_index):
+        for index in (self._prefill_index, self._decode_index,
+                      self._encode_index):
             if name in index:
                 i = index.index(name)
                 index[i] = index[-1]
@@ -429,6 +434,21 @@ class InstanceMgr:
                     self._rr_decode = new_cursor
                 return name
         return None
+
+    def get_next_encode_instance(self) -> str:
+        """RR over ENCODE-role instances (EPD three-stage routing; the
+        reference only claims EPD — README.md:47 — the mechanism is ours)."""
+        with self._cluster_lock:
+            if not self._encode_index:
+                return ""
+            n = len(self._encode_index)
+            for i in range(n):
+                name = self._encode_index[(self._rr_encode + i) % n]
+                entry = self._instances.get(name)
+                if entry is not None and entry.schedulable():
+                    self._rr_encode = (self._rr_encode + i + 1) % n
+                    return name
+            return ""
 
     def get_load_infos(self) -> dict[str, InstanceLoadInfo]:
         """Snapshot for CAR scoring (reference `get_load_metrics`,

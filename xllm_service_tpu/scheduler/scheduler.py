@@ -206,6 +206,11 @@ class Scheduler:
         routing = self.lb_policy.select_instances_pair(request)
         if not routing.valid():
             return Status(StatusCode.UNAVAILABLE, "no available instances")
+        if request.has_images:
+            # EPD: pin the vision-encode stage to a dedicated ENCODE
+            # instance when the fleet has one (BASELINE config 5).
+            routing.encode_name = \
+                self.instance_mgr.get_next_encode_instance()
         request.routing = routing
         self.instance_mgr.bind_request_instance_incarnations(request)
         request.metrics.schedule_time_ms = now_ms()
