@@ -135,3 +135,52 @@ class TestEPD:
         }, timeout=120)
         assert r.status_code == 200, r.text
         assert r.json()["usage"]["completion_tokens"] == 4
+
+    def test_http_image_url_rejected_cleanly(self, epd_cluster):
+        """Non-data URLs must 400 (zero-egress), not 500 (review finding)."""
+        master, mix, encode = epd_cluster
+        body = _chat_body(seed=1)
+        body["messages"][0]["content"][1]["image_url"]["url"] = \
+            "https://example.com/cat.png"
+        r = requests.post(_base(master) + "/v1/chat/completions", json=body,
+                          timeout=30)
+        # The agent rejects with 400; the service surfaces the forward
+        # failure (engine returned non-200) as 503 to the client.
+        assert r.status_code in (400, 503)
+        assert "data:" in r.text or "image" in r.text.lower() \
+            or "unavailable" in r.text.lower()
+
+    def test_unknown_image_part_type_rejected(self, epd_cluster):
+        """Unsupported image kinds must error, never silently mis-splice
+        (review finding: placeholder/embedding alignment)."""
+        master, mix, encode = epd_cluster
+        body = _chat_body(seed=1)
+        body["messages"][0]["content"].append(
+            {"type": "image_file", "file_id": "f123"})
+        r = requests.post(_base(master) + "/v1/chat/completions", json=body,
+                          timeout=30)
+        assert r.status_code in (400, 503)
+
+    def test_multimodal_skips_prefix_cache(self, epd_cluster):
+        """Image-blind token ids must never share cached KV across images
+        (review finding). Long identical text + different images."""
+        master, mix, encode = epd_cluster
+        long_text = "repeat this exact text many times " * 2  # > hash block (32 byte-tokens)
+
+        def run(seed):
+            body = _chat_body(seed)
+            body["messages"][0]["content"][0]["text"] = long_text
+            body["logprobs"] = True
+            r = requests.post(_base(master) + "/v1/chat/completions",
+                              json=body, timeout=120)
+            assert r.status_code == 200, r.text
+            choice = r.json()["choices"][0]
+            return tuple(round(t["logprob"], 5)
+                         for t in choice["logprobs"]["content"])
+
+        cached_before = mix.engine.stats()["cached_blocks"]
+        lp1, lp2 = run(11), run(12)
+        # No multimodal blocks were donated to the prefix cache...
+        assert mix.engine.stats()["cached_blocks"] == cached_before
+        # ...and the second request was NOT poisoned by the first's KV.
+        assert lp1 != lp2

@@ -87,17 +87,46 @@ class TestStreamParse:
         assert reasoning == "reasoning"
         assert content == "ans"
 
-    def test_tool_call_streamed(self):
+    def test_tool_call_streamed_incrementally(self):
         payload = '{"name": "f", "arguments": {"x": 1}}'
         events, parser = self._collect(
             ["before <tool_call>", payload[:10], payload[10:], "</tool_call> after"])
         tool_events = [e for e in events if e.kind == "tool_call"]
-        assert len(tool_events) == 1
+        # First event names the call; subsequent ones stream arguments.
         assert tool_events[0].tool_name == "f"
-        assert json.loads(tool_events[0].tool_args_delta) == {"x": 1}
+        assert tool_events[0].tool_id
+        args = "".join(e.tool_args_delta for e in tool_events)
+        assert json.loads(args) == {"x": 1}
+        assert all(e.tool_index == 0 for e in tool_events)
         assert parser.saw_tool_call
         content = "".join(e.text for e in events if e.kind == "content")
         assert "before" in content and "after" in content
+
+    def test_tool_args_stream_char_by_char(self):
+        """Arguments arrive as true deltas even one char at a time, with
+        nested braces and braces inside strings."""
+        payload = ('{"name": "g", "arguments": '
+                   '{"s": "a}b{", "nested": {"k": [1, 2]}}}')
+        chunks = ["<tool_call>"] + list(payload) + ["</tool_call>"]
+        events, parser = self._collect(chunks)
+        tool_events = [e for e in events if e.kind == "tool_call"]
+        assert tool_events[0].tool_name == "g"
+        args = "".join(e.tool_args_delta for e in tool_events)
+        assert json.loads(args) == {"s": "a}b{", "nested": {"k": [1, 2]}}
+        # Incremental: arguments arrived across many events.
+        assert len(tool_events) > 3
+
+    def test_two_tool_calls_streamed(self):
+        text = ('<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+                '<tool_call>{"name": "b", "arguments": {"y": 2}}</tool_call>')
+        events, parser = self._collect([text[:25], text[25:60], text[60:]])
+        tool_events = [e for e in events if e.kind == "tool_call"]
+        names = [e.tool_name for e in tool_events if e.tool_name]
+        assert names == ["a", "b"]
+        assert {e.tool_index for e in tool_events} == {0, 1}
+        args1 = "".join(e.tool_args_delta for e in tool_events
+                        if e.tool_index == 1)
+        assert json.loads(args1) == {"y": 2}
 
     def test_unterminated_tool_block_flushes_as_content(self):
         events, parser = self._collect(["<tool_call>oops no json"])

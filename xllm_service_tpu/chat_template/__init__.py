@@ -8,6 +8,10 @@ Multimodal content parts are flattened to text + placeholders
 (`jinja_chat_template.cpp:119-137`).
 """
 
-from .jinja_chat_template import JinjaChatTemplate, DEFAULT_CHAT_TEMPLATE
+from .jinja_chat_template import (
+    DEFAULT_CHAT_TEMPLATE,
+    JinjaChatTemplate,
+    MM_PLACEHOLDER,
+)
 
-__all__ = ["JinjaChatTemplate", "DEFAULT_CHAT_TEMPLATE"]
+__all__ = ["JinjaChatTemplate", "DEFAULT_CHAT_TEMPLATE", "MM_PLACEHOLDER"]

@@ -35,6 +35,7 @@ from ..common.request import LogProb, RequestOutput, SamplingParams, Status, Sta
 from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
 from ..coordination import CoordinationClient, connect
 from ..rpc import MASTER_KEY, instance_key
+from ..chat_template import MM_PLACEHOLDER, JinjaChatTemplate
 from ..tokenizer import TokenizerFactory
 from ..utils import get_local_ip, get_logger, pick_free_port
 from .config import EngineConfig
@@ -202,6 +203,8 @@ class EngineAgent:
         self.coord = coord or connect(agent_cfg.coordination_addr,
                                       agent_cfg.coordination_namespace)
         tokenizer = TokenizerFactory.create_tokenizer(agent_cfg.tokenizer_path)
+        self.chat_template = JinjaChatTemplate(
+            TokenizerFactory.load_chat_template(agent_cfg.tokenizer_path))
         self.engine = InferenceEngine(engine_cfg, tokenizer=tokenizer,
                                       params=params)
         self.port = agent_cfg.port or pick_free_port(agent_cfg.host)
@@ -440,7 +443,13 @@ class EngineAgent:
         # model splices embeddings into (BASELINE config 5).
         mm_embeds = None
         if chat and self.engine.cfg.model_family == "qwen2_vl":
-            pixels = self._extract_images(body.get("messages") or [])
+            try:
+                pixels = self._extract_images(body.get("messages") or [])
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            except Exception as e:  # noqa: BLE001 — bad base64/PIL data
+                return web.json_response(
+                    {"error": f"invalid image payload: {e}"}, status=400)
             if pixels is not None:
                 encode_name = (body.get("routing") or {}).get(
                     "encode_name", "")
@@ -573,11 +582,18 @@ class EngineAgent:
         self.encode_count += 1
         pixels = np.frombuffer(obj["bytes"], dtype=np.dtype(obj["dtype"])) \
             .reshape(obj["shape"])
-        import jax.numpy as jnp
 
-        embeds = encode_fn(self.engine.params, self.engine.cfg.model,
-                           jnp.asarray(pixels))
-        embeds_np = np.asarray(embeds.astype(jnp.float32))
+        def _run_encoder() -> np.ndarray:
+            # Off the event loop: first call may hit a multi-second XLA
+            # compile, which must not freeze health probes / link RPCs.
+            import jax.numpy as jnp
+
+            embeds = encode_fn(self.engine.params, self.engine.cfg.model,
+                               jnp.asarray(pixels))
+            return np.asarray(embeds.astype(jnp.float32))
+
+        embeds_np = await asyncio.get_running_loop().run_in_executor(
+            None, _run_encoder)
         return web.Response(body=msgpack.packb({
             "bytes": embeds_np.tobytes(),
             "shape": list(embeds_np.shape),
@@ -619,6 +635,14 @@ class EngineAgent:
         return web.json_response({"ok": True})
 
     # ------------------------------------------------------- multimodal
+    @staticmethod
+    def _is_image_part(part: Any) -> bool:
+        """Single predicate shared by extraction and token building (the
+        service's routing check uses the same startswith rule) — the two
+        sides MUST agree or placeholder runs and embeddings mis-align."""
+        return isinstance(part, dict) and \
+            str(part.get("type", "")).startswith("image")
+
     def _extract_images(self, messages: list[dict]) -> Optional[np.ndarray]:
         """Collect image parts from chat messages as [N, S, S, 3] float32
         (S = the vision encoder's input size). Supports data-URI
@@ -637,7 +661,7 @@ class EngineAgent:
             if not isinstance(content, list):
                 continue
             for part in content:
-                if not isinstance(part, dict):
+                if not self._is_image_part(part):
                     continue
                 ptype = str(part.get("type", ""))
                 if ptype == "image_url":
@@ -656,6 +680,12 @@ class EngineAgent:
                         base64.b64decode(part["data"]),
                         np.float32).reshape(part["shape"])
                     out.append(arr.astype(np.float32))
+                else:
+                    # Must raise: _build_mm_token_ids emits a placeholder
+                    # run for EVERY image-typed part, so silently skipping
+                    # one here would mis-align the embedding splice.
+                    raise ValueError(
+                        f"unsupported image part type: {ptype}")
         return np.stack(out) if out else None
 
     def _encode_pixels(self, pixels: np.ndarray,
@@ -684,27 +714,26 @@ class EngineAgent:
         return embeds.reshape(-1, embeds.shape[-1])
 
     def _build_mm_token_ids(self, messages: list[dict]) -> list[int]:
-        """Token ids with each image part expanded to `out_tokens` copies of
-        the model's image placeholder token."""
+        """Token ids for a multimodal prompt: the chat template renders
+        normally (each image part becomes one MM_PLACEHOLDER marker), then
+        each marker expands to `out_tokens` copies of the model's image
+        placeholder token — so multimodal prompts keep the exact same role
+        structure/system prompt as text-only ones.
+
+        Note: the service's routing-side token count (one marker per image)
+        undercounts the engine's actual prompt by (out_tokens-1) per image;
+        usage reported to clients uses the engine's own count."""
         mcfg = self.engine.cfg.model
         out_tokens = mcfg.vision.out_tokens if mcfg.vision else 0
         tok = self.engine.tokenizer
+        rendered = self.chat_template.apply(messages)
         ids: list[int] = []
-        for m in messages:
-            content = m.get("content")
-            if isinstance(content, str):
-                ids.extend(tok.encode(content + "\n"))
-                continue
-            if not isinstance(content, list):
-                continue
-            for part in content:
-                if not isinstance(part, dict):
-                    ids.extend(tok.encode(str(part)))
-                elif part.get("type") == "text":
-                    ids.extend(tok.encode(part.get("text", "")))
-                elif str(part.get("type", "")).startswith("image"):
-                    ids.extend([mcfg.image_token_id] * out_tokens)
-            ids.extend(tok.encode("\n"))
+        segments = rendered.split(MM_PLACEHOLDER)
+        for i, segment in enumerate(segments):
+            if i > 0:
+                ids.extend([mcfg.image_token_id] * out_tokens)
+            if segment:
+                ids.extend(tok.encode(segment))
         return ids
 
     @staticmethod

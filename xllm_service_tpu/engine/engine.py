@@ -662,9 +662,15 @@ class InferenceEngine:
             return True
 
         # Prefix-cache match (block-aligned; keep at least 1 suffix token so
-        # prefill produces the next-token logits).
-        matched, cached_pages, cached_hashes = \
-            self.page_mgr.match_prefix(prompt)
+        # prefill produces the next-token logits). Multimodal sequences are
+        # excluded entirely: their token ids are image-blind (identical
+        # placeholder runs for different images), so cached KV could be
+        # silently reused across different images.
+        if req.mm_embeds is not None:
+            matched, cached_pages, cached_hashes = 0, [], []
+        else:
+            matched, cached_pages, cached_hashes = \
+                self.page_mgr.match_prefix(prompt)
         if matched >= P0:
             drop = (matched - P0) // cfg.hash_block_size + 1
             self.page_mgr.release_prefix(cached_hashes[-drop:])
@@ -771,11 +777,13 @@ class InferenceEngine:
 
         # Donate completed prompt blocks to the prefix cache (skip only the
         # blocks matched FROM the cache; self-written chunks are donated).
-        stored, donated = self.page_mgr.store_prefix(
-            prompt, seq.pages.all_pages,
-            skip_blocks=cache_matched // cfg.hash_block_size)
-        seq.pages.donated_hashes = stored
-        seq.pages.donated_pages = donated
+        # Multimodal KV is never donated — the hash ignores image content.
+        if req.mm_embeds is None:
+            stored, donated = self.page_mgr.store_prefix(
+                prompt, seq.pages.all_pages,
+                skip_blocks=cache_matched // cfg.hash_block_size)
+            seq.pages.donated_hashes = stored
+            seq.pages.donated_pages = donated
 
         if req.prefill_only and req.on_prefill_done is not None:
             # PD handoff: extract prompt KV, free local resources, and let
@@ -905,6 +913,17 @@ class InferenceEngine:
             mm_arr = jnp.zeros((1, 1, cfg.model.hidden_size),
                                cfg.model.dtype)
         else:
+            # Pad the visual-token count to a bucket (4 images' worth) so a
+            # new image count doesn't force a fresh XLA compile mid-serving.
+            # Padding rows are never read: the splice consumes exactly as
+            # many rows as there are placeholder tokens.
+            vis = cfg.model.vision
+            unit = max(1, (vis.out_tokens if vis else 1) * 4)
+            M = -(-mm.shape[0] // unit) * unit
+            if mm.shape[0] < M:
+                mm = np.concatenate(
+                    [mm, np.zeros((M - mm.shape[0], mm.shape[1]),
+                                  mm.dtype)])
             mm_arr = jnp.asarray(mm, cfg.model.dtype)[None]
         self._dstate, packed = self._prefill_install(
             self.params, self._dstate, jnp.asarray(toks), jnp.asarray(ints),

@@ -171,15 +171,25 @@ class StreamChatParser:
     token-level streaming inside a JSON body is not attempted — the
     arguments string is still delivered incrementally per tool call)."""
 
+    # Matches the hermes/qwen tool header up to the start of the arguments
+    # value, enabling incremental argument streaming.
+    _HEADER_RE = re.compile(
+        r'\s*\{\s*"name"\s*:\s*"([^"]*)"\s*,\s*'
+        r'"(?:arguments|parameters)"\s*:\s*', re.S)
+
     def __init__(self, tags: FamilyTags):
         self._tags = tags
         self._buf = ""
         self._state = "reasoning" if tags.implicit_reasoning_open else "normal"
-        self._tool_body = ""
         self._tool_count = 0
         self.saw_tool_call = False
         self._all_tags = [tags.reasoning_open, tags.reasoning_close,
                           tags.tool_open, tags.tool_close]
+        # Incremental tool-argument scanner state.
+        self._args_depth = 0
+        self._args_in_str = False
+        self._args_escape = False
+        self._args_started = False
 
     def _holdback_len(self, s: str) -> int:
         """Longest suffix of s that is a proper prefix of any tag."""
@@ -234,24 +244,107 @@ class StreamChatParser:
             self._buf = self._buf[idx + len(t.reasoning_close):]
             self._state = "normal"
             return True
-        # tool state: wait for the close tag.
-        idx = self._buf.find(t.tool_close)
-        if idx == -1:
+        if self._state == "tool_tail":
+            # Swallow the payload's closing brace/whitespace + close tag.
+            idx = self._buf.find(t.tool_close)
+            if idx == -1:
+                hold = self._holdback_len(self._buf)
+                keep = self._buf[len(self._buf) - hold:] if hold else ""
+                self._buf = keep
+                return False
+            self._buf = self._buf[idx + len(t.tool_close):]
+            self._state = "normal"
+            return True
+        if self._state == "tool":
+            # Header phase: stream the name as soon as the hermes/qwen
+            # header parses; arguments then stream incrementally (OpenAI
+            # tool_calls delta behavior — the reference delegates this to
+            # its engine StreamOutputParser).
+            m = self._HEADER_RE.match(self._buf)
+            idx = self._buf.find(t.tool_close)
+            if m is not None and (idx == -1 or m.end() <= idx):
+                self.saw_tool_call = True
+                events.append(StreamEvent(
+                    kind="tool_call", tool_index=self._tool_count,
+                    tool_id=_new_tool_call_id(), tool_name=m.group(1)))
+                self._buf = self._buf[m.end():]
+                self._state = "tool_args"
+                self._args_depth = 0
+                self._args_in_str = False
+                self._args_escape = False
+                self._args_started = False
+                return True
+            if idx == -1:
+                return False
+            # No parseable header before the close tag: fall back to the
+            # whole-body parse (name\njson variants etc.).
+            body = self._buf[:idx]
+            self._buf = self._buf[idx + len(t.tool_close):]
+            self._state = "normal"
+            tc = _parse_tool_payload(body)
+            if tc is not None:
+                self.saw_tool_call = True
+                events.append(StreamEvent(
+                    kind="tool_call", tool_index=self._tool_count,
+                    tool_id=tc.id, tool_name=tc.name,
+                    tool_args_delta=tc.arguments))
+                self._tool_count += 1
+            else:
+                events.append(StreamEvent(
+                    kind="content", text=t.tool_open + body + t.tool_close))
+            return True
+        # tool_args state: stream the JSON arguments value char-by-char,
+        # tracking nesting so we stop exactly at the value's end.
+        end = self._scan_args_value()
+        if end is None:
+            if self._buf:
+                events.append(StreamEvent(kind="tool_call",
+                                          tool_index=self._tool_count,
+                                          tool_args_delta=self._buf))
+                self._buf = ""
             return False
-        body = self._buf[:idx]
-        self._buf = self._buf[idx + len(t.tool_close):]
-        self._state = "normal"
-        tc = _parse_tool_payload(body)
-        if tc is not None:
-            self.saw_tool_call = True
-            events.append(StreamEvent(
-                kind="tool_call", tool_index=self._tool_count,
-                tool_id=tc.id, tool_name=tc.name, tool_args_delta=tc.arguments))
-            self._tool_count += 1
-        else:
-            events.append(StreamEvent(kind="content",
-                                      text=t.tool_open + body + t.tool_close))
+        if end > 0:
+            events.append(StreamEvent(kind="tool_call",
+                                      tool_index=self._tool_count,
+                                      tool_args_delta=self._buf[:end]))
+        self._buf = self._buf[end:]
+        self._tool_count += 1
+        self._state = "tool_tail"
         return True
+
+    def _scan_args_value(self):
+        """Advance the JSON scanner over the buffer; return the index one
+        past the arguments value if it completes, else None (all buffered
+        chars are safely emittable)."""
+        for i, ch in enumerate(self._buf):
+            if self._args_in_str:
+                if self._args_escape:
+                    self._args_escape = False
+                elif ch == "\\":
+                    self._args_escape = True
+                elif ch == '"':
+                    self._args_in_str = False
+                    if self._args_depth == 0:
+                        return i + 1          # bare string value
+                continue
+            if ch == '"':
+                self._args_in_str = True
+                self._args_started = True
+            elif ch in "{[":
+                self._args_depth += 1
+                self._args_started = True
+            elif ch in "}]":
+                if self._args_depth == 0:
+                    return i                  # enclosing payload's brace
+                self._args_depth -= 1
+                if self._args_depth == 0:
+                    return i + 1
+            elif not self._args_started and not ch.isspace():
+                self._args_started = True     # number/bool/null scalar
+            elif self._args_started and self._args_depth == 0 and \
+                    (ch in ",}" or ch.isspace()):
+                return i                      # scalar ended
+        return None
 
     def finalize(self) -> list[StreamEvent]:
         """Flush whatever is buffered at stream end."""
@@ -266,7 +359,12 @@ class StreamChatParser:
             else:
                 events.append(StreamEvent(kind="content",
                                           text=self._tags.tool_open + self._buf))
-        elif self._buf:
+        elif self._state == "tool_args" and self._buf:
+            # Truncated stream: flush what we have of the arguments.
+            events.append(StreamEvent(kind="tool_call",
+                                      tool_index=self._tool_count,
+                                      tool_args_delta=self._buf))
+        elif self._state not in ("tool_tail",) and self._buf:
             events.append(StreamEvent(
                 kind="reasoning" if self._state == "reasoning" else "content",
                 text=self._buf))

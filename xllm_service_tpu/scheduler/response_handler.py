@@ -141,12 +141,20 @@ class ResponseHandler:
                         chunks.append(chunk(seq.index,
                                             {"reasoning_content": ev.text}))
                     elif ev.kind == "tool_call":
-                        chunks.append(chunk(seq.index, {"tool_calls": [{
-                            "index": ev.tool_index, "id": ev.tool_id,
-                            "type": "function",
-                            "function": {"name": ev.tool_name,
-                                         "arguments": ev.tool_args_delta},
-                        }]}))
+                        # OpenAI delta shape: first delta carries id/type/
+                        # name; argument-only deltas carry just the index +
+                        # arguments fragment.
+                        tc_delta: dict[str, Any] = {"index": ev.tool_index}
+                        fn: dict[str, Any] = {}
+                        if ev.tool_id:
+                            tc_delta["id"] = ev.tool_id
+                            tc_delta["type"] = "function"
+                        if ev.tool_name:
+                            fn["name"] = ev.tool_name
+                        fn["arguments"] = ev.tool_args_delta
+                        tc_delta["function"] = fn
+                        chunks.append(chunk(seq.index,
+                                            {"tool_calls": [tc_delta]}))
             elif seq.text:
                 chunks.append(chunk(seq.index, {"content": seq.text}, logprobs=lp))
             if seq.finish_reason:
