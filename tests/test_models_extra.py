@@ -150,3 +150,87 @@ class TestRingAttention:
             got = ring_attention(q, k, v, mesh, seq_axis="seq")
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestMLA:
+    def _setup(self):
+        from xllm_service_tpu.models.deepseek_moe import tiny_mla_config
+
+        cfg = tiny_mla_config(dtype=jnp.float32)
+        fam = get_model_family("deepseek_moe")
+        params = fam.init_params(cfg, jax.random.PRNGKey(0))
+        return cfg, fam, params
+
+    def test_cache_entry_is_compressed(self):
+        cfg, fam, params = self._setup()
+        # The pool stores one latent per token: n_kv=1, hd = dc + dr.
+        assert cfg.num_kv_heads == 1
+        assert cfg.head_dim == cfg.kv_lora_rank + cfg.qk_rope_head_dim
+        assert "k_up" in params["layers"] and "kv_down" in params["layers"]
+
+    def test_decode_matches_prefill(self):
+        cfg, fam, params = self._setup()
+        T = 21
+        toks = jax.random.randint(jax.random.PRNGKey(5), (1, T), 0,
+                                  cfg.vocab_size)
+        pt = jnp.arange(4, dtype=jnp.int32)[None, :]
+        pos = jnp.arange(T)[None, :]
+        kv = alloc_pages(cfg, 8)
+        full, _ = fam.prefill_forward(params, cfg, toks, pos, kv, pt,
+                                      jnp.zeros((1,), jnp.int32),
+                                      jnp.array([T], jnp.int32))
+        kv2 = alloc_pages(cfg, 8)
+        _, kv2 = fam.prefill_forward(params, cfg, toks[:, :T - 1],
+                                     pos[:, :T - 1], kv2, pt,
+                                     jnp.zeros((1,), jnp.int32),
+                                     jnp.array([T - 1], jnp.int32))
+        dec, _ = fam.decode_forward(params, cfg, toks[:, T - 1],
+                                    jnp.array([T - 1], jnp.int32), kv2, pt,
+                                    jnp.array([T], jnp.int32))
+        np.testing.assert_allclose(np.asarray(full), np.asarray(dec),
+                                   rtol=5e-4, atol=5e-4)
+
+    def test_mla_engine_end_to_end(self):
+        """MLA model through the continuous-batching engine."""
+        from xllm_service_tpu.engine.config import EngineConfig
+        from xllm_service_tpu.engine.engine import EngineRequest, InferenceEngine
+        from xllm_service_tpu.common.request import SamplingParams
+        from xllm_service_tpu.models.deepseek_moe import tiny_mla_config
+        from test_engine import Collector, run_requests
+
+        cfg = EngineConfig(
+            model_family="deepseek_moe",
+            model=tiny_mla_config(dtype=jnp.float32, max_context_len=256),
+            num_pages=32, page_size=16, hash_block_size=32,
+            max_batch_size=2, max_seq_len=128, prefill_buckets=(32, 128))
+        engine = InferenceEngine(cfg)
+        col = Collector()
+        run_requests(engine, [EngineRequest(
+            "mla", token_ids=list(range(10, 40)),
+            sampling=SamplingParams(max_tokens=4, temperature=0.0,
+                                    ignore_eos=True), on_output=col)])
+        assert len(col.tokens) == 4
+        assert col.finish_reason == "length"
+
+    def test_mla_sharded_matches_single_device(self):
+        cfg, fam, params = self._setup()
+        from xllm_service_tpu.models.deepseek_moe import MOE_STACKED_RULES
+        from xllm_service_tpu.parallel.mesh import MeshConfig, build_mesh
+        from xllm_service_tpu.parallel.sharding import shard_params
+
+        mesh = build_mesh(MeshConfig(expert=2, model=2),
+                          devices=jax.devices()[:4])
+        sharded = shard_params(params, mesh, MOE_STACKED_RULES)
+        T = 16
+        toks = jax.random.randint(jax.random.PRNGKey(6), (1, T), 0,
+                                  cfg.vocab_size)
+        pt = jnp.arange(4, dtype=jnp.int32)[None, :]
+        pos = jnp.arange(T)[None, :]
+        args = (toks, pos, alloc_pages(cfg, 8), pt,
+                jnp.zeros((1,), jnp.int32), jnp.array([T], jnp.int32))
+        ref, _ = fam.prefill_forward(params, cfg, *args)
+        with mesh:
+            got, _ = jax.jit(
+                lambda p, *a: fam.prefill_forward(p, cfg, *a))(sharded, *args)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   rtol=2e-3, atol=2e-3)

@@ -37,6 +37,15 @@ class ModelConfig:
     qkv_bias: bool = False          # Qwen2 family
     dtype: Any = jnp.bfloat16
     max_context_len: int = 8192
+    # MLA — multi-head latent attention (deepseek family). kv_lora_rank>0
+    # enables it; the paged cache then stores one [kv_lora_rank +
+    # qk_rope_head_dim] latent per token (set num_kv_heads=1 and
+    # head_dim=kv_lora_rank+qk_rope_head_dim so the engine's pool layout
+    # matches).
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
     # MoE (deepseek family).
     num_experts: int = 0
     num_experts_per_token: int = 2

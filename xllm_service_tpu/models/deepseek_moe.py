@@ -8,11 +8,16 @@ combine contracts the expert dimension — GSPMD turns that contraction into
 a psum over the expert axis (the TPU-idiomatic EP decode; no all-to-all
 token shuffling needed at serving batch sizes).
 
-Attention is GQA+RoPE as in the llama family (DeepSeek's MLA compression is
-a follow-up optimization; the serving contract — paged KV, prefill/decode
-programs — is identical). First-k-dense-layers is approximated as all-MoE
-with a shared expert (`first_dense_layers=0`), which preserves the
-compute/communication shape EP benchmarking cares about.
+Attention is **MLA (multi-head latent attention)** when
+`kv_lora_rank > 0` (the DeepSeek-V2 design): the paged cache stores one
+compressed latent `[kv_lora_rank ‖ rope_dim]` per token, the per-head K
+up-projection is absorbed into the query, and the V up-projection is
+applied after attention — so the framework's paged-attention ops run
+unchanged over latents and the KV cache shrinks by the heads factor.
+GQA+RoPE remains available for non-MLA configs. First-k-dense-layers is
+approximated as all-MoE with a shared expert (`first_dense_layers=0`),
+which preserves the compute/communication shape EP benchmarking cares
+about.
 """
 
 from __future__ import annotations
@@ -36,6 +41,11 @@ from .llama import _project_qkv, _unembed
 Params = dict
 
 MOE_STACKED_RULES = ShardingRules(rules=[
+    # MLA tensors: heads on the model axis; shared latent projections
+    # replicated.
+    (r"(k_up|v_up)/kernel", P(None, AXIS_MODEL, None, None)),  # [L, H, ., .]
+    (r"(kv_down|k_rope)/kernel", P()),
+    (r"kv_norm/scale", P()),
     (r"experts/(gate_proj|up_proj)/kernel",
      P(None, AXIS_EXPERT, None, AXIS_MODEL)),          # [L, E, D, F]
     (r"experts/down_proj/kernel",
@@ -51,10 +61,15 @@ MOE_STACKED_RULES = ShardingRules(rules=[
 
 
 def deepseek_v2_lite_config() -> ModelConfig:
+    """DeepSeek-V2-Lite with MLA: the paged cache stores one compressed
+    latent (kv_lora_rank=512 + rope 64 = 576 dims) per token — advertised to
+    the engine as num_kv_heads=1, head_dim=576."""
     return ModelConfig(name="deepseek_moe", vocab_size=102400,
                        hidden_size=2048, num_layers=27, num_heads=16,
-                       num_kv_heads=16, head_dim=128, ffn_size=10944,
+                       num_kv_heads=1, head_dim=576, ffn_size=10944,
                        rope_theta=10000.0, max_context_len=32768,
+                       kv_lora_rank=512, qk_nope_head_dim=128,
+                       qk_rope_head_dim=64, v_head_dim=128,
                        num_experts=64, num_experts_per_token=6,
                        num_shared_experts=2, moe_ffn_size=1408,
                        first_dense_layers=0)
@@ -70,8 +85,22 @@ def tiny_moe_config(**kw) -> ModelConfig:
     return ModelConfig(**defaults)
 
 
+def tiny_mla_config(**kw) -> ModelConfig:
+    """Tiny MLA+MoE config: cache entry = 32 latent + 16 rope = 48 dims."""
+    defaults = dict(name="deepseek_moe", vocab_size=512, hidden_size=128,
+                    num_layers=2, num_heads=4, num_kv_heads=1, head_dim=48,
+                    ffn_size=256, max_context_len=512,
+                    kv_lora_rank=32, qk_nope_head_dim=32,
+                    qk_rope_head_dim=16, v_head_dim=32,
+                    num_experts=4, num_experts_per_token=2,
+                    num_shared_experts=1, moe_ffn_size=64,
+                    first_dense_layers=0)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
 def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
-    keys = jax.random.split(rng, 12)
+    keys = jax.random.split(rng, 16)
     D, L, E = cfg.hidden_size, cfg.num_layers, cfg.num_experts
     Hq, Hkv = cfg.q_size, cfg.kv_size
     Fe = cfg.moe_ffn_size
@@ -81,14 +110,33 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
         return (jax.random.normal(key, shape, jnp.float32)
                 * (fan_in ** -0.5)).astype(cfg.dtype)
 
-    return {
-        "embed": {"embedding": dense(keys[0], (cfg.vocab_size, D), D)},
-        "layers": {
-            "input_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
+    if cfg.kv_lora_rank > 0:
+        # MLA projections (DeepSeek-V2): shared compressed latent + a
+        # decoupled rope key; per-head up-projections absorbed at decode.
+        H, dn, dr = cfg.num_heads, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+        dc, dv = cfg.kv_lora_rank, cfg.v_head_dim
+        attn = {
+            "q_proj": {"kernel": dense(keys[1], (L, D, H * (dn + dr)), D)},
+            "kv_down": {"kernel": dense(keys[2], (L, D, dc), D)},
+            "k_rope": {"kernel": dense(keys[3], (L, D, dr), D)},
+            "kv_norm": {"scale": jnp.ones((L, dc), cfg.dtype)},
+            "k_up": {"kernel": dense(keys[12], (L, H, dn, dc), dc)},
+            "v_up": {"kernel": dense(keys[13], (L, H, dc, dv), dc)},
+            "o_proj": {"kernel": dense(keys[4], (L, H * dv, D), H * dv)},
+        }
+    else:
+        attn = {
             "q_proj": {"kernel": dense(keys[1], (L, D, Hq), D)},
             "k_proj": {"kernel": dense(keys[2], (L, D, Hkv), D)},
             "v_proj": {"kernel": dense(keys[3], (L, D, Hkv), D)},
             "o_proj": {"kernel": dense(keys[4], (L, Hq, D), Hq)},
+        }
+
+    return {
+        "embed": {"embedding": dense(keys[0], (cfg.vocab_size, D), D)},
+        "layers": {
+            "input_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
+            **attn,
             "post_attn_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
             "router": {"kernel": dense(keys[5], (L, D, E), D)
                        .astype(jnp.float32)},
@@ -137,26 +185,81 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     return (routed + shared).reshape(orig_shape)
 
 
+def _mla_attention(lp, cfg, h, mode, k_pages, v_pages, page_table,
+                   prefix_lens, seq_lens, positions, context_lens):
+    """MLA (DeepSeek-V2): the cache stores one [kv_lora_rank ‖ rope] latent
+    per token; per-head K up-projection is absorbed into the query and the
+    V up-projection applied after attention — so the existing paged
+    attention ops run unchanged over latents (n_kv=1).
+
+    Returns (attn_out flattened [..., H*dv], k_pages, v_pages)."""
+    from ..ops.attention import apply_rope, paged_attention_xla
+
+    H, dn = cfg.num_heads, cfg.qk_nope_head_dim
+    dr, dc, dv = cfg.qk_rope_head_dim, cfg.kv_lora_rank, cfg.v_head_dim
+
+    # Latent + decoupled rope key (one shared "kv head").
+    c = jnp.einsum("...d,dc->...c", h, lp["kv_down"]["kernel"])
+    c = rms_norm(c, lp["kv_norm"]["scale"], cfg.rms_eps)
+    k_r = jnp.einsum("...d,dr->...r", h, lp["k_rope"]["kernel"])
+    k_r = apply_rope(k_r[..., None, :], positions, cfg.rope_theta)[..., 0, :]
+    entry = jnp.concatenate([c, k_r], axis=-1)[..., None, :]  # [..., 1, dc+dr]
+
+    # Queries: nope part absorbed through the K up-projection.
+    q = jnp.einsum("...d,df->...f", h, lp["q_proj"]["kernel"])
+    q = q.reshape(*q.shape[:-1], H, dn + dr)
+    q_nope, q_rope = q[..., :dn], q[..., dn:]
+    q_rope = apply_rope(q_rope, positions, cfg.rope_theta)
+    q_c = jnp.einsum("...hd,hdc->...hc", q_nope, lp["k_up"]["kernel"])
+    q_lat = jnp.concatenate([q_c, q_rope], axis=-1)   # [..., H, dc+dr]
+    # True scale is over the uncompressed per-head key width.
+    scale = 1.0 / ((dn + dr) ** 0.5)
+
+    if mode == "prefill":
+        k_pages, v_pages = write_prefill_kv(k_pages, v_pages, entry, entry,
+                                            page_table, prefix_lens, seq_lens)
+        attn = prefill_attention(q_lat, entry, entry, k_pages, v_pages,
+                                 page_table, prefix_lens, seq_lens,
+                                 scale=scale)
+    else:
+        k_pages, v_pages = write_decode_kv(k_pages, v_pages, entry, entry,
+                                           page_table, positions)
+        attn = paged_attention_xla(q_lat, k_pages, v_pages, page_table,
+                                   context_lens, scale=scale)
+    # The weighted sum over [c ‖ k_rope] entries: keep the latent part,
+    # apply the absorbed V up-projection per head.
+    ctx = attn[..., :dc]                              # [..., H, dc]
+    out = jnp.einsum("...hc,hcv->...hv", ctx, lp["v_up"]["kernel"])
+    return out.reshape(*out.shape[:-2], H * dv), k_pages, v_pages
+
+
 def _run_layers(params, cfg, x, kv_pages, mode, page_table, prefix_lens,
                 seq_lens, positions, context_lens):
     """Unrolled layer loop with in-place KV writebacks (see
     models/llama.py for why not `lax.scan`)."""
+    use_mla = cfg.kv_lora_rank > 0
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
         h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
-        q, k, v = _project_qkv(lp, h, cfg, positions)
         k_pages, v_pages = kv_pages[l, 0], kv_pages[l, 1]
-        if mode == "prefill":
-            k_pages, v_pages = write_prefill_kv(
-                k_pages, v_pages, k, v, page_table, prefix_lens, seq_lens)
-            attn = prefill_attention(q, k, v, k_pages, v_pages, page_table,
-                                     prefix_lens, seq_lens)
+        if use_mla:
+            attn, k_pages, v_pages = _mla_attention(
+                lp, cfg, h, mode, k_pages, v_pages, page_table,
+                prefix_lens, seq_lens, positions, context_lens)
         else:
-            k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
-                                               page_table, positions)
-            attn = paged_attention(q, k_pages, v_pages, page_table,
-                                   context_lens)
-        attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
+            q, k, v = _project_qkv(lp, h, cfg, positions)
+            if mode == "prefill":
+                k_pages, v_pages = write_prefill_kv(
+                    k_pages, v_pages, k, v, page_table, prefix_lens,
+                    seq_lens)
+                attn = prefill_attention(q, k, v, k_pages, v_pages,
+                                         page_table, prefix_lens, seq_lens)
+            else:
+                k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
+                                                   page_table, positions)
+                attn = paged_attention(q, k_pages, v_pages, page_table,
+                                       context_lens)
+            attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         x = x + _moe_mlp(lp, h2, cfg)

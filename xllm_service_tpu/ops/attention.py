@@ -114,7 +114,8 @@ def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:
 def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       k_pages: jax.Array, v_pages: jax.Array,
                       page_table: jax.Array,
-                      prefix_lens: jax.Array, seq_lens: jax.Array) -> jax.Array:
+                      prefix_lens: jax.Array, seq_lens: jax.Array,
+                      scale: float | None = None) -> jax.Array:
     """Causal attention for a (possibly prefix-cached) prefill chunk.
 
     q/k/v: [B, S, n(_kv), hd] for the *suffix* being prefilled; queries also
@@ -125,7 +126,8 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     B, S, n_heads, hd = q.shape
     n_kv = k.shape[2]
     n_rep = n_heads // n_kv
-    scale = 1.0 / (hd ** 0.5)
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
 
     kf = _repeat_kv(k, n_rep).astype(jnp.float32)
     vf = _repeat_kv(v, n_rep).astype(jnp.float32)
@@ -160,7 +162,8 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ------------------------------------------------------------ decode attn
 def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                         page_table: jax.Array,
-                        context_lens: jax.Array) -> jax.Array:
+                        context_lens: jax.Array,
+                        scale: float | None = None) -> jax.Array:
     """One-token-per-sequence paged attention (XLA path).
 
     q: [B, n_heads, hd]; returns [B, n_heads, hd]. Assumes the new token's
@@ -170,7 +173,8 @@ def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     B, n_heads, hd = q.shape
     n_kv = k_pages.shape[1]
     n_rep = n_heads // n_kv
-    scale = 1.0 / (hd ** 0.5)
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
 
     k = _repeat_kv(gather_pages(k_pages, page_table), n_rep)  # [B, T, H, hd]
     v = _repeat_kv(gather_pages(v_pages, page_table), n_rep)
