@@ -158,8 +158,8 @@ def paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, n_q, hd), lambda b, pt, cl: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # k pool stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),   # v pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # k pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # v pool stays in HBM
         ],
         out_specs=pl.BlockSpec((1, n_q, hd), lambda b, pt, cl: (b, 0, 0)),
         scratch_shapes=[

@@ -68,12 +68,14 @@ def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (k_nxt, v_nxt, m_new, l_new, acc_new), None
 
-    # pvary: mark the constant initial carries as device-varying so the
-    # scan carry types line up with the ring-permuted outputs.
-    m0 = jax.lax.pvary(jnp.full((B, H, S, 1), _NEG_INF, jnp.float32),
-                       axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((B, H, S, 1), jnp.float32), axis_name)
-    acc0 = jax.lax.pvary(jnp.zeros((B, S, H, hd), jnp.float32), axis_name)
+    # Mark the constant initial carries as device-varying so the scan
+    # carry types line up with the ring-permuted outputs.
+    def _vary(x):
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+    m0 = _vary(jnp.full((B, H, S, 1), _NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, S, 1), jnp.float32))
+    acc0 = _vary(jnp.zeros((B, S, H, hd), jnp.float32))
     (k_f, v_f, m, l, acc), _ = jax.lax.scan(
         hop, (k, v, m0, l0, acc0), jnp.arange(n))
     l_b = jnp.swapaxes(l[..., 0], 1, 2)[..., None]          # [B, S, H, 1]
