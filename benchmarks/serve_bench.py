@@ -1,0 +1,166 @@
+"""Full-stack serving benchmark: req/s + TTFT/E2E percentiles through the
+real HTTP path (client → master → engine agent → TPU → SSE back).
+
+This measures the BASELINE.json north-star metrics ("req/s + p50/p99 TTFT")
+on whatever accelerator is attached; `bench.py` (repo root) remains the
+driver's single-line engine-throughput metric.
+
+    python benchmarks/serve_bench.py --requests 32 --concurrency 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import requests
+
+
+def percentile(xs, p):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    k = min(len(xs) - 1, int(round((p / 100) * (len(xs) - 1))))
+    return xs[k]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--prompt-tokens", type=int, default=256)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--model-config", default="auto",
+                    help="auto = bench_1b on accelerator, tiny on CPU")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from xllm_service_tpu.common.config import ServiceOptions
+    from xllm_service_tpu.coordination.memory import (
+        InMemoryCoordination,
+        MemoryStore,
+    )
+    from xllm_service_tpu.engine.agent import AgentConfig, EngineAgent
+    from xllm_service_tpu.engine.config import EngineConfig
+    from xllm_service_tpu.master import Master
+    from xllm_service_tpu.models import base as model_base
+
+    on_accel = jax.default_backend() != "cpu"
+    if args.model_config == "auto":
+        args.model_config = "bench_1b" if on_accel else "tiny"
+    if args.model_config == "tiny":
+        mcfg = model_base.tiny_config(
+            dtype=jnp.float32, max_context_len=1024)
+        max_seq, pages, horizon = 512, 256, 4
+        buckets = (128, 512)
+    else:
+        mcfg = getattr(model_base, args.model_config + "_config")()
+        max_seq, pages, horizon = 1024, 16 * 1024 // 16, 8
+        buckets = (128, 512, 1024)
+
+    store = MemoryStore()
+    opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                          lease_ttl_s=3.0, sync_interval_s=1.0)
+    master = Master(opts, coord=InMemoryCoordination(store))
+    master.start()
+    ecfg = EngineConfig(
+        model_id="bench", model=mcfg, num_pages=pages, page_size=16,
+        max_batch_size=16, max_seq_len=max_seq, prefill_buckets=buckets,
+        decode_horizon=horizon)
+    agent = EngineAgent(
+        ecfg, AgentConfig(host="127.0.0.1", model_id="bench",
+                          generation_flush_ms=2.0),
+        coord=InMemoryCoordination(store)).start()
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            master.scheduler.instance_mgr.get_instance_meta(agent.name) is None:
+        time.sleep(0.1)
+
+    base = f"http://127.0.0.1:{master.http_port}"
+    rng = np.random.default_rng(0)
+    vocab = mcfg.vocab_size
+
+    # Warmup: compile prefill bucket + decode program.
+    requests.post(base + "/v1/completions", json={
+        "model": "bench",
+        "prompt": [int(t) for t in rng.integers(10, vocab - 10,
+                                                args.prompt_tokens)],
+        "max_tokens": 4, "temperature": 0, "ignore_eos": True}, timeout=600)
+
+    ttfts, e2es, errors = [], [], [0]
+    lock = threading.Lock()
+    work = list(range(args.requests))
+
+    def worker():
+        while True:
+            with lock:
+                if not work:
+                    return
+                work.pop()
+            prompt = [int(t) for t in rng.integers(10, vocab - 10,
+                                                   args.prompt_tokens)]
+            t0 = time.perf_counter()
+            try:
+                r = requests.post(base + "/v1/completions", json={
+                    "model": "bench", "prompt": prompt,
+                    "max_tokens": args.max_tokens, "temperature": 0,
+                    "ignore_eos": True, "stream": True}, stream=True,
+                    timeout=600)
+                ttft = None
+                for line in r.iter_lines():
+                    if line.startswith(b"data: ") and ttft is None:
+                        ttft = time.perf_counter() - t0
+                e2e = time.perf_counter() - t0
+                with lock:
+                    ttfts.append(ttft * 1000)
+                    e2es.append(e2e * 1000)
+            except Exception:  # noqa: BLE001
+                with lock:
+                    errors[0] += 1
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker)
+               for _ in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+
+    n_ok = len(e2es)
+    total_tokens = n_ok * args.max_tokens
+    report = {
+        "backend": jax.default_backend(),
+        "model_config": args.model_config,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "prompt_tokens": args.prompt_tokens,
+        "max_tokens": args.max_tokens,
+        "errors": errors[0],
+        "req_per_s": round(n_ok / wall, 3),
+        "decode_tok_per_s": round(total_tokens / wall, 1),
+        "ttft_ms": {"p50": round(percentile(ttfts, 50), 1),
+                    "p90": round(percentile(ttfts, 90), 1),
+                    "p99": round(percentile(ttfts, 99), 1),
+                    "mean": round(statistics.mean(ttfts), 1) if ttfts else 0},
+        "e2e_ms": {"p50": round(percentile(e2es, 50), 1),
+                   "p99": round(percentile(e2es, 99), 1)},
+    }
+    print(json.dumps(report, indent=2))
+    agent.stop()
+    master.stop()
+    store.close()
+
+
+if __name__ == "__main__":
+    main()
