@@ -258,17 +258,27 @@ class InferenceEngine:
 
         is_vl = cfg.model_family == "qwen2_vl"
 
+        V = mcfg.vocab_size
+
         @partial(jax.jit, donate_argnums=(1,))
-        def prefill_install(params, d, tokens, ints, floats, counts_row, key,
-                            mm):
+        def prefill_install(params, d, packed_in, mm):
             """Prefill one sequence + install it into batch slot `slot`.
 
-            ints: [P + 4] = [page_row(P), slot, prefix_len, seq_len,
-                             want_logprobs]
-            floats: [6] = [temperature, top_k, top_p, freq, pres, rep]
-            counts_row: [V] penalty histogram of the full prompt.
+            packed_in: ONE int32 upload (host↔device roundtrips are the
+            dominant admission cost on remote-attached chips), laid out as
+            [tokens(S) | ints(P+4) | floats_bits(6) | counts(V) | key(2)]
+            where ints = [page_row(P), slot, prefix_len, seq_len,
+            want_logprobs], floats (temperature, top_k, top_p, freq, pres,
+            rep) are f32 bit-cast to i32, and key is the uint32 PRNG key.
             mm: [1, M, D] visual embeddings (VL family; dummy otherwise).
             """
+            S = packed_in.shape[0] - (P + 4) - 6 - V - 2
+            tokens = packed_in[:S][None, :]
+            ints = packed_in[S:S + P + 4]
+            floats = jax.lax.bitcast_convert_type(
+                packed_in[S + P + 4:S + P + 10], jnp.float32)
+            counts_row = packed_in[S + P + 10:S + P + 10 + V]
+            key = jax.lax.bitcast_convert_type(packed_in[-2:], jnp.uint32)
             page_row = ints[:P]
             slot = ints[P]
             prefix_len = ints[P + 1]
@@ -925,9 +935,12 @@ class InferenceEngine:
                     [mm, np.zeros((M - mm.shape[0], mm.shape[1]),
                                   mm.dtype)])
             mm_arr = jnp.asarray(mm, cfg.model.dtype)[None]
+        # ONE packed upload per admission (see prefill_install's docstring).
+        packed_in = np.concatenate([
+            toks[0], ints, floats.view(np.int32), counts_row,
+            np.asarray(slot_key).view(np.int32).reshape(-1)[:2]])
         self._dstate, packed = self._prefill_install(
-            self.params, self._dstate, jnp.asarray(toks), jnp.asarray(ints),
-            jnp.asarray(floats), jnp.asarray(counts_row), slot_key, mm_arr)
+            self.params, self._dstate, jnp.asarray(packed_in), mm_arr)
         packed_np = np.asarray(packed)
         K = self.cfg.max_top_logprobs
         token = int(packed_np[0])
