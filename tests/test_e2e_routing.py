@@ -1,0 +1,132 @@
+"""Routing-policy e2e: cache-aware affinity and SLO-aware placement through
+the full master + fake-engine stack."""
+
+import pytest
+import requests
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.coordination.memory import InMemoryCoordination, MemoryStore
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import wait_until
+
+
+def _cluster(store, policy: str, n_engines: int = 2):
+    opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                          load_balance_policy=policy,
+                          lease_ttl_s=1.0, sync_interval_s=0.2,
+                          reconcile_interval_s=0.1, block_size=128)
+    master = Master(opts, coord=InMemoryCoordination(store))
+    master.start()
+    engines = [FakeEngine(InMemoryCoordination(store),
+                          FakeEngineConfig(heartbeat_interval_s=0.2,
+                                           lease_ttl_s=1.0)).start()
+               for _ in range(n_engines)]
+    for e in engines:
+        assert wait_until(
+            lambda e=e: master.scheduler.instance_mgr.get_instance_meta(e.name)
+            is not None, timeout=5)
+    return master, engines
+
+
+class TestCacheAwareRouting:
+    def test_repeat_prompt_routes_to_cache_holder(self, store):
+        master, engines = _cluster(store, "CAR")
+        try:
+            base = f"http://127.0.0.1:{master.http_port}"
+            prompt = "cache affinity " * 40   # > 1 hash block of 128 tokens
+            r1 = requests.post(base + "/v1/completions", json={
+                "model": "fake-model", "prompt": prompt, "max_tokens": 16,
+            }, timeout=10)
+            assert r1.status_code == 200
+            first_engine = next(e for e in engines if e.accepted_requests)
+            # Wait for the heartbeat KV event to reach the global index.
+            assert wait_until(
+                lambda: master.scheduler.kvcache_mgr.num_blocks() > 0,
+                timeout=5)
+            # The same prefix must now route to the holder every time.
+            for _ in range(3):
+                r = requests.post(base + "/v1/completions", json={
+                    "model": "fake-model", "prompt": prompt,
+                    "max_tokens": 16}, timeout=10)
+                assert r.status_code == 200
+            assert len(first_engine.accepted_requests) == 4
+            other = next(e for e in engines if e is not first_engine)
+            assert len(other.accepted_requests) == 0
+        finally:
+            for e in engines:
+                e.stop()
+            master.stop()
+
+    def test_untokenizable_requests_still_balance(self, store):
+        master, engines = _cluster(store, "CAR")
+        try:
+            base = f"http://127.0.0.1:{master.http_port}"
+            # Distinct prompts, no shared prefix: load should spread.
+            for i in range(6):
+                r = requests.post(base + "/v1/completions", json={
+                    "model": "fake-model", "prompt": f"unique {i} " * 30,
+                    "max_tokens": 8}, timeout=10)
+                assert r.status_code == 200
+            counts = sorted(len(e.accepted_requests) for e in engines)
+            assert sum(counts) == 6
+        finally:
+            for e in engines:
+                e.stop()
+            master.stop()
+
+
+class TestSloAwareRouting:
+    def test_routes_prefill_to_fastest_predictor(self, store):
+        opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                              load_balance_policy="SLO_AWARE",
+                              lease_ttl_s=1.0, sync_interval_s=0.2,
+                              reconcile_interval_s=0.1)
+        master = Master(opts, coord=InMemoryCoordination(store))
+        master.start()
+        from xllm_service_tpu.common.types import InstanceType
+
+        fast = FakeEngine(InMemoryCoordination(store), FakeEngineConfig(
+            instance_type=InstanceType.PREFILL,
+            heartbeat_interval_s=0.2, lease_ttl_s=1.0))
+        slow = FakeEngine(InMemoryCoordination(store), FakeEngineConfig(
+            instance_type=InstanceType.PREFILL,
+            heartbeat_interval_s=0.2, lease_ttl_s=1.0))
+        decode = FakeEngine(InMemoryCoordination(store), FakeEngineConfig(
+            instance_type=InstanceType.DECODE,
+            heartbeat_interval_s=0.2, lease_ttl_s=1.0))
+        # Override profiling tables BEFORE registration.
+        fast.meta_override = {"ttft": [[128, 5.0], [512, 12.0], [2048, 40.0]]}
+        slow.meta_override = {"ttft": [[128, 500.0], [512, 1200.0],
+                                       [2048, 4000.0]]}
+        orig_meta = FakeEngine.meta
+
+        def meta_with_override(self):
+            m = orig_meta(self)
+            ov = getattr(self, "meta_override", None)
+            if ov and "ttft" in ov:
+                m.ttft_profiling_data = ov["ttft"]
+            return m
+
+        FakeEngine.meta = meta_with_override
+        try:
+            for e in (fast, slow, decode):
+                e.start()
+                assert wait_until(
+                    lambda e=e: master.scheduler.instance_mgr
+                    .get_instance_meta(e.name) is not None, timeout=5)
+            base = f"http://127.0.0.1:{master.http_port}"
+            for i in range(4):
+                r = requests.post(base + "/v1/completions", json={
+                    "model": "fake-model", "prompt": "route me " * 50,
+                    "max_tokens": 8}, timeout=10)
+                assert r.status_code == 200, r.text
+            # All prefills should land on the fast instance.
+            assert len(fast.accepted_requests) == 4
+            assert len(slow.accepted_requests) == 0
+        finally:
+            FakeEngine.meta = orig_meta
+            for e in (fast, slow, decode):
+                e.stop()
+            master.stop()
