@@ -168,3 +168,59 @@ class TestNativeServer:
         finally:
             engine.stop()
             master.stop()
+
+
+class TestServerRestartResilience:
+    def test_client_survives_server_restart(self):
+        """Kill + restart the native server on the same port: the client
+        reconnects, re-creates its leased keys, and watches fire again."""
+        binary = REPO / "csrc" / "coordination_server"
+        if not binary.exists():
+            pytest.skip("native binary missing")
+        import socket as _socket
+
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        def start():
+            p = subprocess.Popen([str(binary), "--port", str(port)],
+                                 stderr=subprocess.PIPE, text=True)
+            p.stderr.readline()
+            return p
+
+        proc = start()
+        try:
+            owner = TcpCoordinationClient(f"127.0.0.1:{port}")
+            observer = TcpCoordinationClient(f"127.0.0.1:{port}")
+            sink = _Sink()
+            observer.add_watch("svc/", sink)
+            assert owner.set("svc/me", "alive", ttl_s=0.5)
+            assert sink.wait_for(lambda ev: any(e.key == "svc/me"
+                                                for e in ev))
+
+            proc.terminate()
+            proc.wait(timeout=5)
+            time.sleep(0.3)
+            proc = start()
+
+            # The owner's keepalive must re-create the key on the fresh
+            # (empty) server, and the observer's re-subscribed watch must
+            # see it as a new PUT.
+            deadline = time.time() + 10
+            recreated = False
+            while time.time() < deadline:
+                if observer.get("svc/me") == "alive":
+                    recreated = True
+                    break
+                time.sleep(0.1)
+            assert recreated
+            n_puts = sum(1 for e in sink.events
+                         if e.type == WatchEventType.PUT
+                         and e.key == "svc/me")
+            assert n_puts >= 2   # original + post-restart re-creation
+            owner.close()
+            observer.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)

@@ -26,31 +26,38 @@ class CoordinationError(RuntimeError):
 
 
 class TcpCoordinationClient(CoordinationClient):
+    """Resilience (the reference inherits this from the etcd client):
+    on connection loss the client reconnects with backoff, re-authenticates,
+    re-subscribes every watch, and the keepalive loop RE-CREATES leased keys
+    whose refresh fails — so a coordination-server restart (in-memory state
+    lost) converges back to the live fleet's registrations."""
+
     def __init__(self, addr: str, namespace: str = "",
                  username: str = "", password: str = "",
                  timeout_s: float = 10.0):
         host, _, port = addr.rpartition(":")
-        self._sock = socket.create_connection((host or "127.0.0.1", int(port)),
-                                              timeout=timeout_s)
-        self._sock.settimeout(None)
-        self._rfile = self._sock.makefile("rb")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._auth = (username, password) if username else None
         self._wlock = threading.Lock()
         self._ns = namespace.strip("/")
         self._ids = itertools.count(1)
         self._pending: dict[int, tuple[threading.Event, dict]] = {}
         self._plock = threading.Lock()
         self._watches: dict[int, tuple[str, WatchCallback]] = {}
-        self._keepalives: dict[str, float] = {}
+        # key -> (ttl, last_value) so a failed refresh can re-create.
+        self._keepalives: dict[str, tuple[float, str]] = {}
         self._ka_lock = threading.Lock()
         self._closed = threading.Event()
         self._timeout_s = timeout_s
+        self._gen = 0            # connection generation (reconnects bump it)
+        self._connect(initial=True)
         self._reader = threading.Thread(target=self._read_loop,
                                         name="coord-reader", daemon=True)
         self._reader.start()
         self._ka_thread = threading.Thread(target=self._keepalive_loop,
                                            name="coord-ka", daemon=True)
         self._ka_thread.start()
-        if username:
+        if self._auth:
             resp = self._call({"op": "auth", "username": username,
                                "password": password})
             if not resp.get("ok"):
@@ -60,6 +67,53 @@ class TcpCoordinationClient(CoordinationClient):
         if not self._call({"op": "ping"}).get("ok"):
             raise CoordinationError("coordination ping failed")
 
+    def _connect(self, initial: bool = False) -> None:
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout_s)
+        self._sock.settimeout(None)
+        self._rfile = self._sock.makefile("rb")
+        self._gen += 1
+
+    def _reconnect_loop(self) -> bool:
+        """Re-establish the connection + session state. Returns False if
+        the client was closed while retrying."""
+        backoff = 0.1
+        while not self._closed.is_set():
+            try:
+                self._connect()
+            except OSError:
+                if self._closed.wait(backoff):
+                    return False
+                backoff = min(backoff * 2, 2.0)
+                continue
+            logger.info("coordination reconnected to %s:%d", *self._addr)
+            if self._auth:
+                self._send_raw({"op": "auth", "id": next(self._ids),
+                                "username": self._auth[0],
+                                "password": self._auth[1]})
+            # Re-subscribe watches (server lost them with the connection).
+            for wid, (prefix, _cb) in list(self._watches.items()):
+                self._send_raw({"op": "watch", "id": next(self._ids),
+                                "watch_id": wid,
+                                "prefix": self._k(prefix)})
+            # Force immediate keepalive re-creation of leased keys.
+            with self._ka_lock:
+                items = list(self._keepalives.items())
+            for key, (ttl, value) in items:
+                self._send_raw({"op": "put", "id": next(self._ids),
+                                "key": key, "value": value, "ttl": ttl})
+            return True
+        return False
+
+    def _send_raw(self, req: dict) -> bool:
+        data = (json.dumps(req) + "\n").encode()
+        try:
+            with self._wlock:
+                self._sock.sendall(data)
+            return True
+        except OSError:
+            return False
+
     # ---- plumbing ----------------------------------------------------------
     def _k(self, key: str) -> str:
         return f"{self._ns}/{key}" if self._ns else key
@@ -68,6 +122,32 @@ class TcpCoordinationClient(CoordinationClient):
         return key[len(self._ns) + 1:] if self._ns else key
 
     def _read_loop(self) -> None:
+        while not self._closed.is_set():
+            self._read_one_connection()
+            if self._closed.is_set():
+                return
+            # Close the dead socket so concurrent writers fail fast instead
+            # of buffering into a black hole for their full call timeout.
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._fail_pending()
+            if not self._reconnect_loop():
+                return
+            # Calls issued while we were reconnecting wrote to the dead
+            # socket; fail them too so their callers retry on the new one.
+            self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        with self._plock:
+            for ev, resp in self._pending.values():
+                resp["ok"] = False
+                resp["error"] = "connection closed"
+                ev.set()
+            self._pending.clear()
+
+    def _read_one_connection(self) -> None:
         try:
             for line in self._rfile:
                 msg = json.loads(line)
@@ -93,14 +173,6 @@ class TcpCoordinationClient(CoordinationClient):
                     waiter[0].set()
         except (OSError, ValueError):
             pass
-        finally:
-            # Fail all pending calls on disconnect.
-            with self._plock:
-                for ev, resp in self._pending.values():
-                    resp["ok"] = False
-                    resp["error"] = "connection closed"
-                    ev.set()
-                self._pending.clear()
 
     def _call(self, req: dict) -> dict:
         if self._closed.is_set():
@@ -134,10 +206,16 @@ class TcpCoordinationClient(CoordinationClient):
             now = _time.monotonic()
             with self._ka_lock:
                 items = list(self._keepalives.items())
-            for key, ttl in items:
+            for key, (ttl, value) in items:
                 if now - last_refresh.get(key, 0.0) >= ttl / 3.0:
                     last_refresh[key] = now
-                    self._call({"op": "refresh", "key": key, "ttl": ttl})
+                    ok = self._call({"op": "refresh", "key": key,
+                                     "ttl": ttl}).get("ok", False)
+                    if not ok and not self._closed.is_set():
+                        # Key vanished (server restart / lease raced out):
+                        # re-create it — registrations must converge back.
+                        self._call({"op": "put", "key": key, "value": value,
+                                    "ttl": ttl})
 
     # ---- CoordinationClient ------------------------------------------------
     def set(self, key, value, ttl_s=None, keepalive=True) -> bool:
@@ -145,7 +223,7 @@ class TcpCoordinationClient(CoordinationClient):
                          "ttl": ttl_s}).get("ok", False)
         if ok and ttl_s and keepalive:
             with self._ka_lock:
-                self._keepalives[self._k(key)] = ttl_s
+                self._keepalives[self._k(key)] = (ttl_s, value)
         return ok
 
     def create_if_absent(self, key, value, ttl_s=None, keepalive=True) -> bool:
@@ -153,7 +231,7 @@ class TcpCoordinationClient(CoordinationClient):
                          "ttl": ttl_s, "create_only": True}).get("ok", False)
         if ok and ttl_s and keepalive:
             with self._ka_lock:
-                self._keepalives[self._k(key)] = ttl_s
+                self._keepalives[self._k(key)] = (ttl_s, value)
         return ok
 
     def get(self, key) -> Optional[str]:
