@@ -64,10 +64,17 @@ def main() -> None:
             sampling=SamplingParams(max_tokens=max_seq - ctx - 8,
                                     temperature=0.0, ignore_eos=True),
             on_output=on_output))
+    admit_deadline = time.perf_counter() + 600
     while engine._waiting or len(engine._running) < B:
         engine.step()
         if not engine._waiting and engine._running:
             break
+        if time.perf_counter() > admit_deadline:
+            print(json.dumps({"metric": "decode_tokens_per_sec_per_chip",
+                              "value": 0.0, "unit": "tok/s",
+                              "vs_baseline": 0.0,
+                              "error": "admission stalled"}))
+            return
 
     # Warmup decode steps (compile + cache).
     for _ in range(2):

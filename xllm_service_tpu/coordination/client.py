@@ -41,16 +41,22 @@ class TcpCoordinationClient(CoordinationClient):
         self._wlock = threading.Lock()
         self._ns = namespace.strip("/")
         self._ids = itertools.count(1)
-        self._pending: dict[int, tuple[threading.Event, dict]] = {}
+        # rid -> (event, response, connection generation it was sent on).
+        self._pending: dict[int, tuple[threading.Event, dict, int]] = {}
         self._plock = threading.Lock()
         self._watches: dict[int, tuple[str, WatchCallback]] = {}
-        # key -> (ttl, last_value) so a failed refresh can re-create.
-        self._keepalives: dict[str, tuple[float, str]] = {}
+        # key -> (ttl, last_value, create_only) so a failed refresh can
+        # re-create with the ORIGINAL semantics (an election key must never
+        # be re-asserted with a plain put — that would overwrite a new
+        # winner and split-brain).
+        self._keepalives: dict[str, tuple[float, str, bool]] = {}
         self._ka_lock = threading.Lock()
         self._closed = threading.Event()
         self._timeout_s = timeout_s
-        self._gen = 0            # connection generation (reconnects bump it)
-        self._connect(initial=True)
+        # Connection generation, bumped under _wlock with each (re)connect;
+        # lets reconnect fail exactly the calls sent on dead connections.
+        self._gen = 0
+        self._connect()
         self._reader = threading.Thread(target=self._read_loop,
                                         name="coord-reader", daemon=True)
         self._reader.start()
@@ -67,12 +73,13 @@ class TcpCoordinationClient(CoordinationClient):
         if not self._call({"op": "ping"}).get("ok"):
             raise CoordinationError("coordination ping failed")
 
-    def _connect(self, initial: bool = False) -> None:
-        self._sock = socket.create_connection(self._addr,
-                                              timeout=self._timeout_s)
-        self._sock.settimeout(None)
-        self._rfile = self._sock.makefile("rb")
-        self._gen += 1
+    def _connect(self) -> None:
+        sock = socket.create_connection(self._addr, timeout=self._timeout_s)
+        sock.settimeout(None)
+        with self._wlock:
+            self._sock = sock
+            self._gen += 1
+        self._rfile = sock.makefile("rb")
 
     def _reconnect_loop(self) -> bool:
         """Re-establish the connection + session state. Returns False if
@@ -88,20 +95,37 @@ class TcpCoordinationClient(CoordinationClient):
                 continue
             logger.info("coordination reconnected to %s:%d", *self._addr)
             if self._auth:
+                # Synchronous auth exchange (we ARE the reader thread here,
+                # so reading the response line directly is safe). A silent
+                # auth failure would leave the session half-broken.
                 self._send_raw({"op": "auth", "id": next(self._ids),
                                 "username": self._auth[0],
                                 "password": self._auth[1]})
+                try:
+                    line = self._rfile.readline()
+                    if not json.loads(line).get("ok"):
+                        logger.error("coordination re-auth REJECTED after "
+                                     "reconnect; retrying connection")
+                        self._sock.close()
+                        if self._closed.wait(backoff):
+                            return False
+                        backoff = min(backoff * 2, 2.0)
+                        continue
+                except (OSError, ValueError):
+                    continue
             # Re-subscribe watches (server lost them with the connection).
             for wid, (prefix, _cb) in list(self._watches.items()):
                 self._send_raw({"op": "watch", "id": next(self._ids),
                                 "watch_id": wid,
                                 "prefix": self._k(prefix)})
-            # Force immediate keepalive re-creation of leased keys.
+            # Re-create leased keys immediately, honoring create_only (an
+            # election key lost to a new winner must NOT be clobbered).
             with self._ka_lock:
                 items = list(self._keepalives.items())
-            for key, (ttl, value) in items:
+            for key, (ttl, value, create_only) in items:
                 self._send_raw({"op": "put", "id": next(self._ids),
-                                "key": key, "value": value, "ttl": ttl})
+                                "key": key, "value": value, "ttl": ttl,
+                                "create_only": create_only})
             return True
         return False
 
@@ -125,7 +149,7 @@ class TcpCoordinationClient(CoordinationClient):
         while not self._closed.is_set():
             self._read_one_connection()
             if self._closed.is_set():
-                return
+                break
             # Close the dead socket so concurrent writers fail fast instead
             # of buffering into a black hole for their full call timeout.
             try:
@@ -134,18 +158,25 @@ class TcpCoordinationClient(CoordinationClient):
                 pass
             self._fail_pending()
             if not self._reconnect_loop():
+                self._fail_pending()
                 return
-            # Calls issued while we were reconnecting wrote to the dead
-            # socket; fail them too so their callers retry on the new one.
-            self._fail_pending()
+            # Calls issued while we were reconnecting went to the dead
+            # socket; fail exactly those (generation check protects calls
+            # already sent on the fresh connection).
+            with self._wlock:
+                cur_gen = self._gen
+            self._fail_pending(older_than=cur_gen)
+        self._fail_pending()
 
-    def _fail_pending(self) -> None:
+    def _fail_pending(self, older_than: Optional[int] = None) -> None:
         with self._plock:
-            for ev, resp in self._pending.values():
+            doomed = [rid for rid, (_, _, gen) in self._pending.items()
+                      if older_than is None or gen < older_than]
+            for rid in doomed:
+                ev, resp, _ = self._pending.pop(rid)
                 resp["ok"] = False
                 resp["error"] = "connection closed"
                 ev.set()
-            self._pending.clear()
 
     def _read_one_connection(self) -> None:
         try:
@@ -170,7 +201,7 @@ class TcpCoordinationClient(CoordinationClient):
                     waiter = self._pending.pop(rid, None)
                 if waiter is not None:
                     waiter[1].update(msg)
-                    waiter[0].set()
+                    waiter[0].set()  # (ev, resp, gen)
         except (OSError, ValueError):
             pass
 
@@ -180,11 +211,11 @@ class TcpCoordinationClient(CoordinationClient):
         rid = next(self._ids)
         req["id"] = rid
         ev, resp = threading.Event(), {}
-        with self._plock:
-            self._pending[rid] = (ev, resp)
         data = (json.dumps(req) + "\n").encode()
         try:
             with self._wlock:
+                with self._plock:
+                    self._pending[rid] = (ev, resp, self._gen)
                 self._sock.sendall(data)
         except OSError as e:
             with self._plock:
@@ -206,16 +237,23 @@ class TcpCoordinationClient(CoordinationClient):
             now = _time.monotonic()
             with self._ka_lock:
                 items = list(self._keepalives.items())
-            for key, (ttl, value) in items:
+            for key, (ttl, value, create_only) in items:
                 if now - last_refresh.get(key, 0.0) >= ttl / 3.0:
                     last_refresh[key] = now
                     ok = self._call({"op": "refresh", "key": key,
                                      "ttl": ttl}).get("ok", False)
                     if not ok and not self._closed.is_set():
                         # Key vanished (server restart / lease raced out):
-                        # re-create it — registrations must converge back.
-                        self._call({"op": "put", "key": key, "value": value,
-                                    "ttl": ttl})
+                        # re-create with the ORIGINAL create_only semantics.
+                        resp = self._call({"op": "put", "key": key,
+                                           "value": value, "ttl": ttl,
+                                           "create_only": create_only})
+                        if create_only and resp and not resp.get("ok"):
+                            # Someone else now holds the election key: we
+                            # are no longer the owner — stop claiming it.
+                            # (Owners detect demotion via verify_ownership.)
+                            with self._ka_lock:
+                                self._keepalives.pop(key, None)
 
     # ---- CoordinationClient ------------------------------------------------
     def set(self, key, value, ttl_s=None, keepalive=True) -> bool:
@@ -223,7 +261,7 @@ class TcpCoordinationClient(CoordinationClient):
                          "ttl": ttl_s}).get("ok", False)
         if ok and ttl_s and keepalive:
             with self._ka_lock:
-                self._keepalives[self._k(key)] = (ttl_s, value)
+                self._keepalives[self._k(key)] = (ttl_s, value, False)
         return ok
 
     def create_if_absent(self, key, value, ttl_s=None, keepalive=True) -> bool:
@@ -231,7 +269,7 @@ class TcpCoordinationClient(CoordinationClient):
                          "ttl": ttl_s, "create_only": True}).get("ok", False)
         if ok and ttl_s and keepalive:
             with self._ka_lock:
-                self._keepalives[self._k(key)] = (ttl_s, value)
+                self._keepalives[self._k(key)] = (ttl_s, value, True)
         return ok
 
     def get(self, key) -> Optional[str]:

@@ -166,6 +166,15 @@ class GlobalKVCacheMgr:
             self._coord.remove_watch(self._watch_id)
             self._watch_id = None
 
+    def set_as_replica(self) -> None:
+        if not self._is_master:
+            return
+        self._is_master = False
+        if self._watch_id is None:
+            self._watch_id = self._coord.add_watch(CACHE_KEY_PREFIX,
+                                                   self._on_cache_event)
+        self._load_existing()
+
     def num_blocks(self) -> int:
         with self._lock:
             return len(self._cache)

@@ -676,6 +676,18 @@ class InstanceMgr:
             self._coord.remove_watch(wid)
         self._watch_ids = self._watch_ids[:1]
 
+    def set_as_replica(self) -> None:
+        """Demotion (a master that lost its coordination lease to a new
+        winner): stop uploading, mirror load metrics again."""
+        if not self._is_master:
+            return
+        self._is_master = False
+        self._watch_ids.append(self._coord.add_watch(
+            LOADMETRICS_KEY_PREFIX, self._on_loadmetrics_event))
+        self._on_loadmetrics_event(
+            [KeyEvent(WatchEventType.PUT, k, v) for k, v in
+             self._coord.get_prefix(LOADMETRICS_KEY_PREFIX).items()], "")
+
     def stop(self) -> None:
         self._stopped.set()
         for wid in self._watch_ids:

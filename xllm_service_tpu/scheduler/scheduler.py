@@ -169,6 +169,20 @@ class Scheduler:
 
     def sync_once(self) -> None:
         if self.is_master:
+            # Verify we still hold the election key: after a coordination
+            # outage a replica may have legitimately won while our lease
+            # was lapsed (the client will NOT re-assert a create_only key
+            # someone else holds) — demote instead of split-braining.
+            owner = self._coord.get(MASTER_KEY)
+            if owner is not None and owner != self.self_addr:
+                logger.warning("lost mastership to %s; demoting", owner)
+                self.is_master = False
+                self.instance_mgr.set_as_replica()
+                self.kvcache_mgr.set_as_replica()
+                if self._master_watch_id is None:
+                    self._master_watch_id = self._coord.add_watch(
+                        MASTER_KEY, self._on_master_event)
+        if self.is_master:
             self.kvcache_mgr.upload_kvcache()
             self.instance_mgr.upload_load_metrics()
         self._gc_stale_requests()
