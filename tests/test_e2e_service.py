@@ -185,3 +185,53 @@ class TestE2EFailure:
         finally:
             engine.stop()
             master.stop()
+
+
+class TestAdminAndTracing:
+    def test_live_config_reload(self, cluster):
+        """Reference parity: target_ttft/target_tpot are live-reloadable
+        with validation (global_gflags.cpp:122-132)."""
+        master, _ = cluster
+        base = _base(master)
+        cfg = requests.get(base + "/admin/config", timeout=5).json()
+        assert cfg["target_tpot_ms"] == 50.0
+        r = requests.post(base + "/admin/config",
+                          json={"target_tpot_ms": 25.0,
+                                "target_ttft_ms": 500.0}, timeout=5)
+        assert r.status_code == 200
+        assert master.scheduler._opts.target_tpot_ms == 25.0
+        # Validation: non-positive targets and unknown keys rejected.
+        assert requests.post(base + "/admin/config",
+                             json={"target_tpot_ms": -1},
+                             timeout=5).status_code == 400
+        assert requests.post(base + "/admin/config",
+                             json={"http_port": 1},
+                             timeout=5).status_code == 400
+
+    def test_request_tracing(self, store, tmp_path):
+        """Opt-in JSONL request tracing (reference RequestTracer)."""
+        opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                              lease_ttl_s=1.0, sync_interval_s=0.3,
+                              enable_request_trace=True,
+                              trace_dir=str(tmp_path))
+        master = Master(opts, coord=InMemoryCoordination(store))
+        master.start()
+        engine = FakeEngine(InMemoryCoordination(store),
+                            FakeEngineConfig()).start()
+        try:
+            assert wait_until(
+                lambda: master.scheduler.instance_mgr.get_instance_meta(
+                    engine.name) is not None, timeout=5)
+            r = requests.post(
+                f"http://127.0.0.1:{master.http_port}/v1/completions",
+                json={"model": "fake-model", "prompt": "trace me",
+                      "max_tokens": 16}, timeout=10)
+            assert r.status_code == 200
+            trace = (tmp_path / "trace.json").read_text().splitlines()
+            assert len(trace) >= 2   # request record + output deltas
+            first = json.loads(trace[0])
+            assert first["service_request_id"].startswith("completion-")
+            assert first["data"]["request"]["prompt"] == "trace me"
+        finally:
+            engine.stop()
+            master.stop()

@@ -120,6 +120,8 @@ class XllmHttpService:
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/hello", self.handle_hello)
         app.router.add_get("/health", self.handle_hello)
+        app.router.add_get("/admin/config", self.handle_get_config)
+        app.router.add_post("/admin/config", self.handle_set_config)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -343,6 +345,43 @@ class XllmHttpService:
     async def handle_hello(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok",
                                   "master": self.scheduler.is_master})
+
+    # Live-reloadable knobs (reference exposes target_ttft/target_tpot as
+    # brpc-reloadable flags with validation, `global_gflags.cpp:122-132`).
+    _RELOADABLE = {"target_ttft_ms": float, "target_tpot_ms": float,
+                   "max_waiting_requests": int, "request_timeout_s": float,
+                   "enable_request_trace": bool}
+
+    async def handle_get_config(self, request: web.Request) -> web.Response:
+        import dataclasses
+
+        return web.json_response({
+            f.name: getattr(self.opts, f.name)
+            for f in dataclasses.fields(self.opts)
+            if isinstance(getattr(self.opts, f.name), (int, float, str, bool))
+        })
+
+    async def handle_set_config(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON")
+        applied = {}
+        for key, value in (body or {}).items():
+            caster = self._RELOADABLE.get(key)
+            if caster is None:
+                return _error_response(
+                    400, f"{key} is not live-reloadable "
+                         f"(reloadable: {sorted(self._RELOADABLE)})")
+            try:
+                cast_value = caster(value)
+            except (TypeError, ValueError):
+                return _error_response(400, f"bad value for {key}")
+            if key.startswith("target_") and cast_value <= 0:
+                return _error_response(400, f"{key} must be positive")
+            setattr(self.opts, key, cast_value)
+            applied[key] = cast_value
+        return web.json_response({"ok": True, "applied": applied})
 
     # ----------------------------------------------------------- RPC routes
     async def handle_heartbeat(self, request: web.Request) -> web.Response:
