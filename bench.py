@@ -92,7 +92,6 @@ def main() -> None:
         prefill_buckets=(128, 512, max_seq) if on_accel else (64, 128),
         hash_block_size=128 if on_accel else 32,
         decode_horizon=32 if on_accel else 4)
-    engine = InferenceEngine(cfg)
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(10, mcfg.vocab_size - 10, ctx).tolist()
@@ -106,15 +105,16 @@ def main() -> None:
     def on_output(out):
         counts["tokens"] += sum(len(s.token_ids) for s in out.outputs)
 
-    # Admit all B sequences (prefill) — not timed; we measure decode.
-    for i, p in enumerate(prompts):
-        engine.submit(EngineRequest(
-            f"bench-{i}", token_ids=p,
-            sampling=SamplingParams(max_tokens=max_seq - ctx - 8,
-                                    temperature=0.0, ignore_eos=True),
-            on_output=on_output))
     admit_deadline = time.perf_counter() + 600
     try:
+        engine = InferenceEngine(cfg)
+        # Admit all B sequences (prefill) — not timed; we measure decode.
+        for i, p in enumerate(prompts):
+            engine.submit(EngineRequest(
+                f"bench-{i}", token_ids=p,
+                sampling=SamplingParams(max_tokens=max_seq - ctx - 8,
+                                        temperature=0.0, ignore_eos=True),
+                on_output=on_output))
         while engine._waiting or len(engine._running) < B:
             engine.step()
             if not engine._waiting and engine._running:

@@ -209,6 +209,9 @@ class TestEngineCorrectness:
             on_output=col)])
         assert col.finish_reason == "stop"
         assert len(col.tokens) == 1
+        # OpenAI/vLLM semantics: the matched stop token's text must not
+        # leak into visible content.
+        assert engine.tokenizer.decode([first]) not in col.text
 
     def test_prompt_too_long_rejected(self):
         engine = make_engine()

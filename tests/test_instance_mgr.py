@@ -255,6 +255,27 @@ class TestSlo:
         assert mgr._request_loads["d1"].num_decode_requests == 0
         mgr.stop()
 
+    def test_cancel_before_first_token_leaks_no_decode_load(self, coord):
+        """A request that errors/disconnects before producing a token must
+        reverse only its SCHEDULE increments; it must NOT credit the decode
+        instance with load (the FINISH_PREFILL path would, and that load
+        would never be reversed — skewing SLO/CAR routing forever)."""
+        mgr = make_mgr(coord)
+        mgr.register_instance(make_meta("p1", InstanceType.PREFILL),
+                              link_peers=False)
+        mgr.register_instance(make_meta("d1", InstanceType.DECODE),
+                              link_peers=False)
+        req = Request(service_request_id="s1", token_ids=list(range(64)))
+        req.routing.prefill_name = "p1"
+        req.routing.decode_name = "d1"
+        mgr.update_request_metrics(req, RequestAction.SCHEDULE)
+        mgr.update_request_metrics(req, RequestAction.CANCEL)
+        assert mgr._request_loads["p1"].num_prefill_requests == 0
+        assert mgr._request_loads["p1"].num_prefill_tokens == 0
+        assert mgr._request_loads["d1"].num_decode_requests == 0
+        assert mgr._request_loads["d1"].num_decode_tokens == 0
+        mgr.stop()
+
 
 class TestRoleFlip:
     def test_flip_updates_coordination(self, coord):

@@ -138,6 +138,78 @@ class TestScheduleFlow:
         assert not sched.has_request("s3")
         sched.stop()
 
+    def test_duplicate_delta_seq_dropped(self, store):
+        """A retried Generations POST (same delta_seq) must be acked but
+        not re-delivered or re-counted."""
+        sched = make_scheduler(store)
+        fleet(sched, make_meta("m1", InstanceType.MIX))
+        req = Request(service_request_id="d1", request_id="r", model="m",
+                      stream=True, prompt="hi")
+        assert sched.schedule(req).ok()
+        conn = CollectingConnection(stream=True)
+        sched.record_new_request(req, conn, "chat")
+        out = RequestOutput(
+            service_request_id="d1", request_id="r", delta_seq=1,
+            outputs=[SequenceOutput(index=0, text="x", token_ids=[1])])
+        assert sched.handle_generation(out)
+        assert sched.handle_generation(out)   # duplicate: acked, dropped
+        _drain(sched)
+        assert req.num_generated_tokens == 1
+        texts = [p for p in conn.payloads
+                 if p["choices"][0]["delta"].get("content") == "x"]
+        assert len(texts) == 1
+        sched.stop()
+
+    def test_pre_token_exit_paths_leak_no_load(self, store):
+        """Disconnect, error, and GC-timeout before the first token must
+        leave all load accounting at zero (ADVICE r1: FINISH_PREFILL on
+        those paths leaked decode load; GC leaked prefill load)."""
+        sched = make_scheduler(store, request_timeout_s=0.0)
+        fleet(sched, make_meta("m1", InstanceType.MIX))
+
+        def loads():
+            rl = sched.instance_mgr._request_loads.get("m1")
+            if rl is None:
+                return (0, 0, 0, 0)
+            return (rl.num_prefill_requests, rl.num_prefill_tokens,
+                    rl.num_decode_requests, rl.num_decode_tokens)
+
+        # Disconnect path.
+        req = Request(service_request_id="g1", request_id="r", model="m",
+                      stream=True, prompt="hi")
+        assert sched.schedule(req).ok()
+        conn = CollectingConnection(stream=True)
+        sched.record_new_request(req, conn, "chat")
+        conn.disconnected = True
+        sched.handle_generation(RequestOutput(
+            service_request_id="g1",
+            outputs=[SequenceOutput(index=0, text="x", token_ids=[1])]))
+        assert loads() == (0, 0, 0, 0)
+
+        # Error-status path.
+        req = Request(service_request_id="g2", request_id="r", model="m",
+                      stream=False, prompt="hi")
+        assert sched.schedule(req).ok()
+        sched.record_new_request(req, CollectingConnection(), "chat")
+        sched.handle_generation(RequestOutput(
+            service_request_id="g2",
+            status=Status(StatusCode.RESOURCE_EXHAUSTED, "full"),
+            finished=True))
+        _drain(sched)
+        assert loads() == (0, 0, 0, 0)
+
+        # GC-timeout path (request_timeout_s=0 → instantly stale).
+        req = Request(service_request_id="g3", request_id="r", model="m",
+                      stream=False, prompt="hi")
+        assert sched.schedule(req).ok()
+        sched.record_new_request(req, CollectingConnection(), "chat")
+        req.latest_generate_time_ms -= 1
+        sched._gc_stale_requests()
+        _drain(sched)
+        assert not sched.has_request("g3")
+        assert loads() == (0, 0, 0, 0)
+        sched.stop()
+
     def test_error_status_surfaces(self, store):
         sched = make_scheduler(store)
         fleet(sched, make_meta("m1", InstanceType.MIX))

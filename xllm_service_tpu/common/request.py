@@ -89,6 +89,11 @@ class RequestOutput:
     # condition at first token) — lets the scheduler account FINISH_PREFILL
     # vs FINISH_DECODE (reference proto field `finished_on_prefill_instance`).
     finished_on_prefill: bool = False
+    # Monotonic per-request delivery sequence number, assigned by the engine
+    # agent's streamer. The Generations POST is retried on transient network
+    # failure; the service dedupes on this so a retry whose original was in
+    # fact processed (response lost) cannot double-deliver deltas.
+    delta_seq: Optional[int] = None
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {
@@ -119,6 +124,8 @@ class RequestOutput:
             "finished": self.finished,
             "finished_on_prefill": self.finished_on_prefill,
         }
+        if self.delta_seq is not None:
+            d["delta_seq"] = self.delta_seq
         if self.usage is not None:
             d["usage"] = {
                 "num_prompt_tokens": self.usage.num_prompt_tokens,
@@ -158,6 +165,7 @@ class RequestOutput:
             usage=Usage(usage.get("num_prompt_tokens", 0), usage.get("num_generated_tokens", 0)) if usage else None,
             finished=bool(d.get("finished", False)),
             finished_on_prefill=bool(d.get("finished_on_prefill", False)),
+            delta_seq=d.get("delta_seq"),
         )
 
 

@@ -70,6 +70,11 @@ class RequestAction(str, enum.Enum):
     FINISH_PREFILL = "FINISH_PREFILL"
     DECODE_STEP = "DECODE_STEP"
     FINISH_DECODE = "FINISH_DECODE"
+    # Request left before producing a token (error / disconnect / GC
+    # timeout): reverse only the SCHEDULE increments. Using FINISH_PREFILL
+    # here would credit the decode instance with load it never received and
+    # permanently skew SLO/CAR routing.
+    CANCEL = "CANCEL"
 
 
 @dataclass

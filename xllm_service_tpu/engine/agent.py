@@ -133,66 +133,125 @@ class _ChoiceAggregator:
         return cb
 
 
+_NOTHING = object()   # queue-timeout marker distinct from the stop sentinel
+
+
 class GenerationStreamer:
     """Batches RequestOutput deltas per destination service and POSTs
     `{"gens": [...]}` (reference batched DisaggStreamGenerations,
-    `rpc_service/service.cpp:149-215`)."""
+    `rpc_service/service.cpp:149-215`).
+
+    Delivery semantics: each delta carries a per-request monotonic
+    `delta_seq` (the service dedupes on it, so retries are safe even when
+    the original POST was processed but its response lost). A failed dest
+    keeps its gens queued per-dest and is retried after a backoff WITHOUT
+    blocking flushes to healthy dests; only after `FLUSH_RETRIES`
+    consecutive failures are that dest's requests cancelled."""
+
+    # One transient blip (service GC pause, connection reset) must not kill
+    # every in-flight stream on the instance: retry before cancelling.
+    FLUSH_RETRIES = 2
+    RETRY_BACKOFF_S = 0.25
 
     def __init__(self, engine: InferenceEngine, flush_ms: float):
         self._engine = engine
         self._q: "queue.Queue[Optional[tuple[str, dict]]]" = queue.Queue()
         self._flush_s = flush_ms / 1000.0
+        self._seq_lock = threading.Lock()
+        self._seqs: dict[str, int] = {}
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="gen-streamer")
         self._thread.start()
 
     def push(self, dest_addr: str, output: RequestOutput) -> None:
+        sid = output.service_request_id
+        with self._seq_lock:
+            seq = self._seqs.get(sid, 0) + 1
+            if output.finished:
+                self._seqs.pop(sid, None)
+            else:
+                self._seqs[sid] = seq
+        output.delta_seq = seq
         self._q.put((dest_addr, output.to_dict()))
 
     def _loop(self) -> None:
         session = _requests.Session()
+        # Per-dest unsent gens (order preserved) + failure bookkeeping.
+        pending: dict[str, list[dict]] = {}
+        attempts: dict[str, int] = {}
+        next_try: dict[str, float] = {}
+        stopping = False
         while True:
-            item = self._q.get()
-            if item is None:
+            now = time.monotonic()
+            if stopping and not pending:
                 return
-            batch: dict[str, list[dict]] = {}
-            dest, gen = item
-            batch.setdefault(dest, []).append(gen)
-            deadline = time.monotonic() + self._flush_s
-            while True:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
-                    break
-                try:
-                    nxt = self._q.get(timeout=timeout)
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    self._flush(session, batch)
-                    return
-                batch.setdefault(nxt[0], []).append(nxt[1])
-            self._flush(session, batch)
-
-    def _flush(self, session: _requests.Session,
-               batch: dict[str, list[dict]]) -> None:
-        for dest, gens in batch.items():
+            if pending:
+                wait = max(0.0, min(next_try.get(d, now)
+                                    for d in pending) - now)
+            else:
+                wait = None   # idle: block until the next delta
             try:
-                r = session.post(f"http://{dest}/rpc/generations",
-                                 json={"gens": gens}, timeout=10)
-                alive = r.json().get("alive", {})
-                for sid, ok in alive.items():
-                    if not ok:
-                        self._engine.cancel(sid)
-            except (_requests.RequestException, ValueError) as e:
-                logger.warning("generations push to %s failed: %s", dest, e)
-                # The service is unreachable; cancel these requests so the
-                # engine doesn't burn chips on a dead stream.
-                for g in gens:
-                    self._engine.cancel(g.get("service_request_id", ""))
+                item = self._q.get(timeout=wait)
+            except queue.Empty:
+                item = _NOTHING
+            if item is None:
+                stopping = True
+            elif item is not _NOTHING:
+                # Batch for one flush interval, preserving per-dest order.
+                pending.setdefault(item[0], []).append(item[1])
+                deadline = time.monotonic() + self._flush_s
+                while True:
+                    timeout = deadline - time.monotonic()
+                    if timeout <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=timeout)
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        stopping = True
+                        break
+                    pending.setdefault(nxt[0], []).append(nxt[1])
+
+            now = time.monotonic()
+            for dest in list(pending):
+                if not stopping and next_try.get(dest, 0.0) > now:
+                    continue
+                if self._send(session, dest, pending[dest]):
+                    del pending[dest]
+                    attempts.pop(dest, None)
+                    next_try.pop(dest, None)
+                else:
+                    n = attempts.get(dest, 0) + 1
+                    if stopping or n > self.FLUSH_RETRIES:
+                        # Repeatedly unreachable: cancel these requests so
+                        # the engine doesn't burn chips on a dead stream.
+                        for g in pending.pop(dest):
+                            self._engine.cancel(
+                                g.get("service_request_id", ""))
+                        attempts.pop(dest, None)
+                        next_try.pop(dest, None)
+                    else:
+                        attempts[dest] = n
+                        next_try[dest] = now + self.RETRY_BACKOFF_S * n
+
+    def _send(self, session: _requests.Session, dest: str,
+              gens: list[dict]) -> bool:
+        try:
+            r = session.post(f"http://{dest}/rpc/generations",
+                             json={"gens": gens}, timeout=10)
+            alive = r.json().get("alive", {})
+            for sid, ok in alive.items():
+                if not ok:
+                    self._engine.cancel(sid)
+            return True
+        except (_requests.RequestException, ValueError) as e:
+            logger.warning("generations push to %s failed: %s", dest, e)
+            return False
 
     def stop(self) -> None:
         self._q.put(None)
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=15)
 
 
 class EngineAgent:

@@ -1016,9 +1016,11 @@ class InferenceEngine:
         elif seq.prompt_len + len(seq.output_ids) >= self.cfg.max_seq_len:
             finish_reason = "length"
 
-        # Detokenize incrementally (drop the eos/stop token from text).
-        visible_ids = seq.output_ids[:-1] if finish_reason == "stop" and \
-            token == self.eos_token_id else seq.output_ids
+        # Detokenize incrementally. On "stop" the matched token (eos OR a
+        # stop_token_ids hit) is excluded from visible text — OpenAI/vLLM
+        # semantics; clients never see the stop token leak into content.
+        visible_ids = seq.output_ids[:-1] if finish_reason == "stop" \
+            else seq.output_ids
         text = self.tokenizer.decode(visible_ids)
         # Stop strings.
         if not finish_reason and sp.stop:

@@ -528,6 +528,10 @@ class InstanceMgr:
                 dl.num_decode_requests = max(0, dl.num_decode_requests - 1)
                 dl.num_decode_tokens = max(
                     0, dl.num_decode_tokens - ntok - req.num_generated_tokens)
+            elif action == RequestAction.CANCEL:
+                # Pre-first-token exit: only the SCHEDULE increments exist.
+                pl.num_prefill_requests = max(0, pl.num_prefill_requests - 1)
+                pl.num_prefill_tokens = max(0, pl.num_prefill_tokens - ntok)
 
     def select_instance_pair_on_slo(self, req: Request) -> Routing:
         """SLO-aware pair selection with dynamic PD flipping (reference

@@ -60,7 +60,8 @@ logger = get_logger(__name__)
 
 class _RequestState:
     __slots__ = ("request", "conn", "lane", "kind", "stream_state",
-                 "accum", "first_token_ms", "last_token_ms", "finished")
+                 "accum", "first_token_ms", "last_token_ms", "finished",
+                 "exited", "last_delta_seq")
 
     def __init__(self, request: Request, conn: ClientConnection, lane: int,
                  kind: str, stream_state: Optional[ChatStreamState]):
@@ -73,6 +74,11 @@ class _RequestState:
         self.first_token_ms: Optional[int] = None
         self.last_token_ms: Optional[int] = None
         self.finished = False
+        # Exit accounting ran (exactly-once guard across the normal-finish,
+        # disconnect, GC-timeout and instance-failure paths).
+        self.exited = False
+        # Highest engine delta_seq processed — dedup for retried deliveries.
+        self.last_delta_seq = 0
 
 
 class Scheduler:
@@ -112,9 +118,11 @@ class Scheduler:
             options.reasoning_parser)
 
         # Request registry + ordered output lanes (reference
-        # `scheduler.h:127-133`).
+        # `scheduler.h:127-133`). RLock: exit paths run accounting while
+        # holding it so a concurrent first-token delta can't interleave a
+        # FINISH_PREFILL after a CANCEL (which would leak decode load).
         self._requests: dict[str, _RequestState] = {}
-        self._req_lock = threading.Lock()
+        self._req_lock = threading.RLock()
         self._output_executor = OrderedExecutor(options.num_output_threads)
 
         self._stopped = threading.Event()
@@ -189,12 +197,12 @@ class Scheduler:
 
     def _gc_stale_requests(self) -> None:
         deadline = now_ms() - int(self._opts.request_timeout_s * 1000)
-        stale: list[_RequestState] = []
         with self._req_lock:
-            for sid, st in list(self._requests.items()):
-                if st.request.latest_generate_time_ms < deadline:
-                    stale.append(self._requests.pop(sid))
+            stale = [st for st in self._requests.values()
+                     if st.request.latest_generate_time_ms < deadline]
         for st in stale:
+            if not self._remove_request(st):
+                continue   # a concurrent path finished it first
             logger.warning("request %s timed out; cancelling",
                            st.request.service_request_id)
             self._cancel_on_engines(st.request)
@@ -273,25 +281,40 @@ class Scheduler:
     def handle_generation(self, output: RequestOutput) -> bool:
         """One Generations delta from an engine (reference
         `scheduler.cpp:484-559`). Returns False if the request is unknown
-        (signals the engine to stop generating)."""
+        (signals the engine to stop generating).
+
+        Lookup, dedup, disconnect check and token accounting run under
+        `_req_lock` so they are atomic w.r.t. the exit paths (GC timeout,
+        instance failure) that pop the request and reverse its accounting.
+        """
+        disconnected = False
         with self._req_lock:
             st = self._requests.get(output.service_request_id)
-        if st is None or st.finished:
-            return False
-        req = st.request
-        req.touch()
-
-        # Client-disconnect cancellation (reference `scheduler.cpp:507-521`).
-        if st.conn.is_disconnected():
+            if st is None or st.finished:
+                return False
+            req = st.request
+            req.touch()
+            if output.delta_seq is not None:
+                if output.delta_seq <= st.last_delta_seq:
+                    # Duplicate delivery: the agent retried a POST whose
+                    # original was processed but whose response was lost.
+                    # Already handled — ack, don't re-deliver.
+                    return True
+                st.last_delta_seq = output.delta_seq
+            # Client-disconnect cancellation (reference
+            # `scheduler.cpp:507-521`).
+            if st.conn.is_disconnected():
+                self._remove_request(st)
+                disconnected = True
+            else:
+                self._update_token_metrics(st, output)
+                if output.finished:
+                    st.finished = True
+        if disconnected:
             logger.info("client of %s disconnected; cancelling",
                         req.service_request_id)
-            self._finish_request(st)
             self._cancel_on_engines(req)
             return False
-
-        self._update_token_metrics(st, output)
-        if output.finished:
-            st.finished = True
         self._output_executor.submit_to_lane(
             st.lane, lambda: self._deliver(st, output))
         return True
@@ -382,16 +405,30 @@ class Scheduler:
             finished_on_prefill=last.finished_on_prefill)
 
     def _remove_request(self, st: _RequestState,
-                        output: Optional[RequestOutput] = None) -> None:
-        """Reference `finish_request` (`scheduler.cpp:416-441`)."""
+                        output: Optional[RequestOutput] = None) -> bool:
+        """Reference `finish_request` (`scheduler.cpp:416-441`). Idempotent:
+        returns True only for the call that actually performed the exit
+        (callers gate their error/cancel side effects on it)."""
         with self._req_lock:
             self._requests.pop(st.request.service_request_id, None)
-        st.finished = True
-        st.request.metrics.finish_time_ms = now_ms()
+            if st.exited:
+                return False
+            st.exited = True
+            st.finished = True
+            st.request.metrics.finish_time_ms = now_ms()
+            self._account_request_exit(st.request)
+        return True
+
+    def _account_request_exit(self, req: Request) -> None:
+        """Reverse this request's load-accounting increments on any exit
+        path. After the first token (FINISH_PREFILL already credited the
+        decode side) the reversal is FINISH_DECODE; before it, CANCEL
+        reverses only SCHEDULE — emitting FINISH_PREFILL for a request that
+        never produced a token would leak decode load forever."""
         self.instance_mgr.update_request_metrics(
-            st.request,
-            RequestAction.FINISH_DECODE if st.request.prefill_stage_finished
-            else RequestAction.FINISH_PREFILL)
+            req,
+            RequestAction.FINISH_DECODE if req.prefill_stage_finished
+            else RequestAction.CANCEL)
 
     def _finish_request(self, st: _RequestState) -> None:
         self._remove_request(st)
@@ -433,9 +470,13 @@ class Scheduler:
                         and (not incarnation or r.prefill_incarnation == incarnation))
                 )
                 if hit:
-                    victims.append(self._requests.pop(sid))
+                    victims.append(st)
         for st in victims:
-            st.finished = True
+            # _remove_request reverses the surviving peer's accounting for
+            # this request (the dead instance's load entries are dropped
+            # with it); idempotent vs concurrent finish/GC.
+            if not self._remove_request(st):
+                continue
             self._output_executor.submit_to_lane(
                 st.lane,
                 lambda s=st: s.conn.finish_with_error(
