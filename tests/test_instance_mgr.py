@@ -247,12 +247,21 @@ class TestSlo:
         req.routing.decode_name = "d1"
         mgr.update_request_metrics(req, RequestAction.SCHEDULE)
         assert mgr._request_loads["p1"].num_prefill_requests == 1
-        mgr.update_request_metrics(req, RequestAction.FINISH_PREFILL)
+        mgr.update_request_metrics(req, RequestAction.FINISH_PREFILL,
+                                   n_new=2)
         assert mgr._request_loads["p1"].num_prefill_requests == 0
         assert mgr._request_loads["d1"].num_decode_requests == 1
+        # 3 more deltas of 2, 5, 1 tokens: credits total ntok + 10.
+        for n in (2, 5, 1):
+            mgr.update_request_metrics(req, RequestAction.DECODE_STEP,
+                                       n_new=n)
         req.num_generated_tokens = 10
         mgr.update_request_metrics(req, RequestAction.FINISH_DECODE)
         assert mgr._request_loads["d1"].num_decode_requests == 0
+        # Exact balance, not max(0, ...)-clamped drift: under-crediting
+        # here collapses decode load toward phantom-idle over time.
+        assert mgr._request_loads["d1"].num_decode_tokens == 0
+        assert mgr._request_loads["p1"].num_prefill_tokens == 0
         mgr.stop()
 
     def test_cancel_before_first_token_leaks_no_decode_load(self, coord):

@@ -165,14 +165,18 @@ class GenerationStreamer:
 
     def push(self, dest_addr: str, output: RequestOutput) -> None:
         sid = output.service_request_id
+        # seq assignment AND enqueue under one lock: the scheduler's dedup
+        # relies on queue order == seq order per request, which concurrent
+        # pushers would otherwise break (later seq enqueued first → earlier
+        # delta dropped as a "duplicate").
         with self._seq_lock:
             seq = self._seqs.get(sid, 0) + 1
             if output.finished:
                 self._seqs.pop(sid, None)
             else:
                 self._seqs[sid] = seq
-        output.delta_seq = seq
-        self._q.put((dest_addr, output.to_dict()))
+            output.delta_seq = seq
+            self._q.put((dest_addr, output.to_dict()))
 
     def _loop(self) -> None:
         session = _requests.Session()
@@ -240,6 +244,9 @@ class GenerationStreamer:
         try:
             r = session.post(f"http://{dest}/rpc/generations",
                              json={"gens": gens}, timeout=10)
+            # A JSON error page (4xx/5xx) must route through retry/cancel,
+            # not count as delivery.
+            r.raise_for_status()
             alive = r.json().get("alive", {})
             for sid, ok in alive.items():
                 if not ok:

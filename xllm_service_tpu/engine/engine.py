@@ -59,6 +59,11 @@ from .sampling import SamplingState, record_tokens, sample_tokens
 
 logger = get_logger(__name__)
 
+# How many stop tokens (eos + stop_token_ids) each batch slot carries on
+# device for mid-horizon deactivation. Longer lists still work — the host
+# stop check covers the rest; the device just can't freeze the slot early.
+NUM_STOP_IDS = 4
+
 
 @dataclass
 class EngineRequest:
@@ -175,6 +180,12 @@ class InferenceEngine:
             "rp": jnp.ones((B,), jnp.float32),
             "keys": jnp.zeros((B, 2), jnp.uint32),
             "want_lp": jnp.zeros((B,), jnp.bool_),
+            # Per-slot device-side stop tokens (eos + first stop_token_ids,
+            # -1 padded): the decode scan deactivates a slot the moment it
+            # samples one, so dead slots stop growing their attention
+            # window mid-horizon. Host stop handling remains authoritative
+            # (it also covers stop strings and >NUM_STOP_IDS lists).
+            "stop_ids": jnp.full((B, NUM_STOP_IDS), -1, jnp.int32),
         }
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
 
@@ -239,9 +250,17 @@ class InferenceEngine:
 
                 chosen, tv, ti = jax.lax.cond(
                     jnp.any(d["want_lp"]), _with_lp, _no_lp, operand=None)
-                d["last"] = jnp.where(d["active"], toks, d["last"])
-                d["clens"] = jnp.where(d["active"], d["clens"] + 1,
+                # Device-side stop: a slot that sampled one of its stop
+                # tokens freezes (no clens growth, no further KV writes
+                # grow its window) for the rest of the horizon. The stop
+                # token itself is still emitted (host appends it and
+                # finishes the sequence).
+                hit = jnp.any(toks[:, None] == d["stop_ids"], axis=-1)
+                advance = d["active"] & ~hit
+                d["last"] = jnp.where(advance, toks, d["last"])
+                d["clens"] = jnp.where(advance, d["clens"] + 1,
                                        d["clens"])
+                d["active"] = advance
                 return d, (toks, chosen, tv, ti)
 
             d, ys = jax.lax.scan(step, d, None, length=horizon)
@@ -266,18 +285,20 @@ class InferenceEngine:
 
             packed_in: ONE int32 upload (host↔device roundtrips are the
             dominant admission cost on remote-attached chips), laid out as
-            [tokens(S) | ints(P+4) | floats_bits(6) | counts(V) | key(2)]
+            [tokens(S) | ints(P+4+NS) | floats_bits(6) | counts(V) | key(2)]
             where ints = [page_row(P), slot, prefix_len, seq_len,
-            want_logprobs], floats (temperature, top_k, top_p, freq, pres,
-            rep) are f32 bit-cast to i32, and key is the uint32 PRNG key.
+            want_logprobs, stop_ids(NS)], floats (temperature, top_k,
+            top_p, freq, pres, rep) are f32 bit-cast to i32, and key is the
+            uint32 PRNG key.
             mm: [1, M, D] visual embeddings (VL family; dummy otherwise).
             """
-            S = packed_in.shape[0] - (P + 4) - 6 - V - 2
+            NS = NUM_STOP_IDS
+            S = packed_in.shape[0] - (P + 4 + NS) - 6 - V - 2
             tokens = packed_in[:S][None, :]
-            ints = packed_in[S:S + P + 4]
+            ints = packed_in[S:S + P + 4 + NS]
             floats = jax.lax.bitcast_convert_type(
-                packed_in[S + P + 4:S + P + 10], jnp.float32)
-            counts_row = packed_in[S + P + 10:S + P + 10 + V]
+                packed_in[S + P + 4 + NS:S + P + 10 + NS], jnp.float32)
+            counts_row = packed_in[S + P + 10 + NS:S + P + 10 + NS + V]
             key = jax.lax.bitcast_convert_type(packed_in[-2:], jnp.uint32)
             page_row = ints[:P]
             slot = ints[P]
@@ -316,6 +337,8 @@ class InferenceEngine:
             d["rp"] = d["rp"].at[slot].set(floats[5])
             d["keys"] = d["keys"].at[slot].set(key)
             d["want_lp"] = d["want_lp"].at[slot].set(ints[P + 3] > 0)
+            d["stop_ids"] = d["stop_ids"].at[slot].set(
+                ints[P + 4:P + 4 + NS])
             d["counts"] = d["counts"].at[slot].set(
                 counts_row.at[toks[0]].add(1))
             packed = jnp.concatenate(
@@ -348,8 +371,8 @@ class InferenceEngine:
             scatter the transferred prompt KV into local pages + install the
             batch slot with the prefill-produced first token.
 
-            ints: [P + 4] = [page_row(P), slot, prompt_len, first_token,
-                             want_logprobs].
+            ints: [P + 4 + NUM_STOP_IDS] = [page_row(P), slot, prompt_len,
+                  first_token, want_logprobs, stop_ids(NUM_STOP_IDS)].
             """
             page_row = ints[:P]
             slot = ints[P]
@@ -371,6 +394,8 @@ class InferenceEngine:
             d["rp"] = d["rp"].at[slot].set(floats[5])
             d["keys"] = d["keys"].at[slot].set(key)
             d["want_lp"] = d["want_lp"].at[slot].set(ints[P + 3] > 0)
+            d["stop_ids"] = d["stop_ids"].at[slot].set(
+                ints[P + 4:P + 4 + NUM_STOP_IDS])
             d["counts"] = d["counts"].at[slot].set(counts_row)
             return d
 
@@ -506,6 +531,7 @@ class InferenceEngine:
                                       jnp.int32)
         self._dstate["active"] = jnp.zeros((B,), jnp.bool_)
         self._dstate["clens"] = jnp.zeros((B,), jnp.int32)
+        self._dstate["stop_ids"] = jnp.full((B, NUM_STOP_IDS), -1, jnp.int32)
         for req in victims:
             try:
                 req.on_output(RequestOutput(
@@ -850,12 +876,13 @@ class InferenceEngine:
 
         P = cfg.pages_per_seq
         sp = req.sampling
-        ints = np.full((P + 4,), GARBAGE_PAGE, np.int32)
+        ints = np.full((P + 4 + NUM_STOP_IDS,), GARBAGE_PAGE, np.int32)
         ints[:len(own_pages)] = own_pages
         ints[P] = seq.slot
         ints[P + 1] = P0
         ints[P + 2] = first_token
         ints[P + 3] = 1 if sp.logprobs else 0
+        ints[P + 4:P + 4 + NUM_STOP_IDS] = self._device_stop_ids(sp)
         floats = np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
                              sp.frequency_penalty, sp.presence_penalty,
                              sp.repetition_penalty if sp.repetition_penalty > 0
@@ -889,6 +916,20 @@ class InferenceEngine:
                 return b
         return self.cfg.prefill_buckets[-1]
 
+    def _device_stop_ids(self, sp: SamplingParams) -> np.ndarray:
+        """The first NUM_STOP_IDS stop tokens for device-side slot
+        deactivation (-1 padded; see decode_multi)."""
+        ids: list[int] = []
+        if not sp.ignore_eos and self.eos_token_id is not None:
+            ids.append(int(self.eos_token_id))
+        for t in sp.stop_token_ids:
+            if len(ids) >= NUM_STOP_IDS:
+                break
+            if int(t) not in ids:
+                ids.append(int(t))
+        ids += [-1] * (NUM_STOP_IDS - len(ids))
+        return np.asarray(ids, np.int32)
+
     def _run_prefill_install(self, seq: _Sequence, prompt: list[int],
                              matched: int) -> tuple[int, Optional[LogProb]]:
         cfg = self.cfg
@@ -899,13 +940,14 @@ class InferenceEngine:
         toks[0, :len(suffix)] = suffix
 
         sp = seq.req.sampling
-        ints = np.full((P + 4,), GARBAGE_PAGE, np.int32)
+        ints = np.full((P + 4 + NUM_STOP_IDS,), GARBAGE_PAGE, np.int32)
         all_pages = seq.pages.all_pages
         ints[:len(all_pages)] = all_pages
         ints[P] = seq.slot
         ints[P + 1] = matched
         ints[P + 2] = len(suffix)
         ints[P + 3] = 1 if sp.logprobs else 0
+        ints[P + 4:P + 4 + NUM_STOP_IDS] = self._device_stop_ids(sp)
         floats = np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
                              sp.frequency_penalty, sp.presence_penalty,
                              sp.repetition_penalty if sp.repetition_penalty > 0
@@ -954,9 +996,17 @@ class InferenceEngine:
     def _decode(self) -> bool:
         if not self._running:
             return False
-        # Bound the horizon by the shortest remaining budget so we don't
-        # burn whole horizons of discarded tokens on nearly-done sequences.
+        # Bound the horizon by the shortest remaining token budget among
+        # running sequences so we never burn a whole horizon of discarded
+        # tokens on a nearly-done sequence. Rounded DOWN to a power of two:
+        # never overshoots, and keeps the decode_multi compile cache to
+        # log2(decode_horizon) entries (horizon is a static argument).
         horizon = self.cfg.decode_horizon
+        rem = min((s.max_total_len - s.prompt_len - len(s.output_ids)
+                   for s in self._running.values() if not s.finished),
+                  default=horizon)
+        if 0 < rem < horizon:
+            horizon = 1 << (rem.bit_length() - 1)
         K = self.cfg.max_top_logprobs
         t0 = time.monotonic()
         self._dstate, packed = self._decode_multi(

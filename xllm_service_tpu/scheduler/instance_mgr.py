@@ -506,9 +506,13 @@ class InstanceMgr:
                 if n in self._instances)
 
     # ------------------------------------------------- SLO core + role flips
-    def update_request_metrics(self, req: Request, action: RequestAction) -> None:
+    def update_request_metrics(self, req: Request, action: RequestAction,
+                               n_new: int = 1) -> None:
         """Per-action token/request accounting (reference
-        `instance_mgr.cpp:825-903`)."""
+        `instance_mgr.cpp:825-903`). `n_new` = generated tokens carried by
+        this delta; credits must sum to exactly `ntok +
+        num_generated_tokens` so the FINISH_DECODE/CANCEL reversal zeroes
+        out instead of drifting (clamped drift still skews SLO routing)."""
         pname, dname = req.routing.prefill_name, req.routing.decode_name or req.routing.prefill_name
         ntok = len(req.token_ids) or req.metrics.prompt_tokens
         with self._metrics_lock:
@@ -521,9 +525,9 @@ class InstanceMgr:
                 pl.num_prefill_requests = max(0, pl.num_prefill_requests - 1)
                 pl.num_prefill_tokens = max(0, pl.num_prefill_tokens - ntok)
                 dl.num_decode_requests += 1
-                dl.num_decode_tokens += ntok
+                dl.num_decode_tokens += ntok + n_new
             elif action == RequestAction.DECODE_STEP:
-                dl.num_decode_tokens += 1
+                dl.num_decode_tokens += n_new
             elif action == RequestAction.FINISH_DECODE:
                 dl.num_decode_requests = max(0, dl.num_decode_requests - 1)
                 dl.num_decode_tokens = max(

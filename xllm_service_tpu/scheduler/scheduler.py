@@ -333,12 +333,12 @@ class Scheduler:
             req.prefill_stage_finished = True
             req.metrics.prefill_finish_time_ms = now
             self.instance_mgr.update_request_metrics(
-                req, RequestAction.FINISH_PREFILL)
+                req, RequestAction.FINISH_PREFILL, n_new=n_new)
         elif n_new:
             if st.last_token_ms is not None:
                 ITL_MS.observe(now - st.last_token_ms)
             self.instance_mgr.update_request_metrics(
-                req, RequestAction.DECODE_STEP)
+                req, RequestAction.DECODE_STEP, n_new=n_new)
         if n_new:
             st.last_token_ms = now
             req.num_generated_tokens += n_new
@@ -429,9 +429,6 @@ class Scheduler:
             req,
             RequestAction.FINISH_DECODE if req.prefill_stage_finished
             else RequestAction.CANCEL)
-
-    def _finish_request(self, st: _RequestState) -> None:
-        self._remove_request(st)
 
     def _cancel_on_engines(self, req: Request) -> None:
         for name in {req.routing.prefill_name, req.routing.decode_name}:
