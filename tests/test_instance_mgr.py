@@ -51,6 +51,20 @@ def make_mgr(coord, **kw) -> InstanceMgr:
 
 
 class TestRegistration:
+    def test_boot_time_link_fanout(self, coord):
+        """A master that starts AFTER engines registered (or restarts under
+        a live fleet) must link every pre-existing P<->D pair (reference
+        `instance_mgr.cpp:150-182`)."""
+        register_in_coord(coord, make_meta("p1", InstanceType.PREFILL))
+        register_in_coord(coord, make_meta("p2", InstanceType.PREFILL))
+        register_in_coord(coord, make_meta("d1", InstanceType.DECODE))
+        mgr = make_mgr(coord)
+        # Every P<->D pair linked in both directions.
+        assert "d1" in FakeChannel.registry["p1"].links
+        assert "d1" in FakeChannel.registry["p2"].links
+        assert set(FakeChannel.registry["d1"].links) == {"p1", "p2"}
+        mgr.stop()
+
     def test_watch_registration_and_pd_linking(self, coord):
         mgr = make_mgr(coord)
         register_in_coord(coord, make_meta("p1", InstanceType.PREFILL))
@@ -187,6 +201,36 @@ class TestSelection:
                               link_peers=False)
         r = mgr.get_next_instance_pair()
         assert r.prefill_name == "mix1" and r.decode_name == ""
+        mgr.stop()
+
+    def test_prefill_only_fleet_not_ready(self, coord):
+        """Readiness (reference `instance_mgr.cpp:1430-1472`): a fleet with
+        only PREFILL instances must report NOT ready — accepted traffic
+        could never reach a decode peer. Adding one decode (or MIX) makes
+        it ready; a SUSPECT decode revokes readiness again."""
+        mgr = make_mgr(coord)
+        mgr.register_instance(make_meta("p1", InstanceType.PREFILL),
+                              link_peers=False)
+        mgr.register_instance(make_meta("p2", InstanceType.PREFILL),
+                              link_peers=False)
+        assert not mgr.has_available_instances()
+        mgr.register_instance(make_meta("d1", InstanceType.DECODE),
+                              link_peers=False)
+        assert mgr.has_available_instances()
+        # Decode goes SUSPECT -> not ready again.
+        FakeChannel.registry["d1"].healthy = False
+        mgr._handle_instance_delete("d1")
+        assert not mgr.has_available_instances()
+        mgr.stop()
+
+    def test_decode_only_fleet_not_ready(self, coord):
+        mgr = make_mgr(coord)
+        mgr.register_instance(make_meta("d1", InstanceType.DECODE),
+                              link_peers=False)
+        assert not mgr.has_available_instances()
+        mgr.register_instance(make_meta("mix1", InstanceType.MIX),
+                              link_peers=False)
+        assert mgr.has_available_instances()
         mgr.stop()
 
 

@@ -143,16 +143,22 @@ class InstanceMgr:
 
     # ------------------------------------------------------------------ boot
     def _load_existing(self) -> None:
-        """Boot-time fleet load (reference `instance_mgr.cpp:150-182`)."""
+        """Boot-time fleet load WITH link fan-out (reference
+        `instance_mgr.cpp:150-182`): when the master starts after engines
+        registered (or restarts under a live fleet), every pre-existing
+        P↔D pair still gets linked — each instance links to the peers
+        already loaded before it, which covers all pairs; engine-side link
+        is idempotent."""
         for key, val in self._coord.get_prefix(INSTANCE_KEY_PREFIX).items():
             try:
                 meta = InstanceMetaInfo.from_json(val)
             except (json.JSONDecodeError, TypeError) as e:
                 logger.warning("bad instance meta at %s: %s", key, e)
                 continue
-            self.register_instance(meta, link_peers=False)
-        # Existing fleet is assumed already linked pairwise; only new
-        # registrations trigger link fan-out.
+            if not self.register_instance(meta):
+                logger.warning("boot-time registration of %s failed (link "
+                               "fan-out); its lease will re-register it",
+                               meta.name)
 
     # ------------------------------------------------------- watch callbacks
     def _on_instance_event(self, events: list[KeyEvent], _prefix: str) -> None:
@@ -497,13 +503,26 @@ class InstanceMgr:
                     if itype is None or e.meta.type == itype]
 
     def has_available_instances(self) -> bool:
-        """Readiness gate (reference `instance_mgr.cpp:1430-1472`): at least
-        one schedulable prefill-capable instance, and if any pure PREFILL
-        exists without MIX/DEFAULT, at least one schedulable decode."""
+        """Readiness gate (reference `instance_mgr.cpp:1430-1472`): ready
+        iff a schedulable DEFAULT or MIX exists (serves both roles), or a
+        schedulable PREFILL *and* a schedulable DECODE both exist. A
+        prefill-only fleet must report NOT ready — it would accept traffic
+        that can never reach a decode peer."""
         with self._cluster_lock:
-            return any(
-                self._instances[n].schedulable() for n in self._prefill_index
-                if n in self._instances)
+            has_default = has_prefill = has_decode = False
+            for e in self._instances.values():
+                if not e.schedulable():
+                    continue
+                t = e.meta.type
+                if t in (InstanceType.DEFAULT, InstanceType.MIX):
+                    has_default = True
+                elif t == InstanceType.PREFILL:
+                    has_prefill = True
+                elif t == InstanceType.DECODE:
+                    has_decode = True
+                if has_default or (has_prefill and has_decode):
+                    return True
+            return has_default or (has_prefill and has_decode)
 
     # ------------------------------------------------- SLO core + role flips
     def update_request_metrics(self, req: Request, action: RequestAction,
