@@ -27,12 +27,13 @@ def _engine_cfg() -> EngineConfig:
         max_batch_size=4, max_seq_len=256, prefill_buckets=(32, 64, 256))
 
 
-def _agent(store, itype: InstanceType) -> EngineAgent:
+def _agent(store, itype: InstanceType, device_kv: bool = True) -> EngineAgent:
     return EngineAgent(
         _engine_cfg(),
         AgentConfig(host="127.0.0.1", model_id="tiny-llama",
                     instance_type=itype,
-                    heartbeat_interval_s=0.3, lease_ttl_s=1.0),
+                    heartbeat_interval_s=0.3, lease_ttl_s=1.0,
+                    enable_device_kv_transfer=device_kv),
         coord=InMemoryCoordination(store)).start()
 
 
@@ -128,6 +129,22 @@ class TestPDDisaggregation:
                  for e in events[:-1] if b'"choices"' in e]
         assert len("".join(texts)) > 0
 
+    def test_device_transfer_path_used(self, pd_cluster):
+        """With transfer servers available on both sides, the handoff must
+        ride the device path (KV pulled device-to-device), not the host
+        msgpack bounce."""
+        master, prefill, decode = pd_cluster
+        assert prefill.kv_transfer is not None
+        assert decode.kv_transfer is not None
+        before = prefill.kv_device_sent
+        r = requests.post(_base(master) + "/v1/completions", json=BODY,
+                          timeout=120)
+        assert r.status_code == 200, r.text
+        assert prefill.kv_device_sent == before + 1
+        assert prefill.kv_host_sent == 0
+        assert decode.kv_device_received >= 1
+        assert decode.kv_host_received == 0
+
     def test_decode_kv_transfer_populates_prefix_cache(self, pd_cluster):
         master, prefill, decode = pd_cluster
         requests.post(_base(master) + "/v1/completions",
@@ -138,3 +155,41 @@ class TestPDDisaggregation:
             lambda: prefill.engine.stats()["cached_blocks"] > 0, timeout=5)
         assert wait_until(
             lambda: decode.engine.stats()["cached_blocks"] > 0, timeout=5)
+
+
+class TestHostFallbackPath:
+    def test_host_path_matches_device_path(self, pd_cluster):
+        """The DCN host-msgpack fallback (device transfer disabled) must
+        produce the same output as the device path — same PrefillHandoff
+        contract, different transport."""
+        master, _, _ = pd_cluster
+        device_text = requests.post(
+            _base(master) + "/v1/completions", json=BODY,
+            timeout=120).json()["choices"][0]["text"]
+
+        store2 = MemoryStore(expiry_tick_s=0.05)
+        opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                              lease_ttl_s=1.0, sync_interval_s=0.3,
+                              reconcile_interval_s=0.1)
+        m2 = Master(opts, coord=InMemoryCoordination(store2))
+        m2.start()
+        p2 = _agent(store2, InstanceType.PREFILL, device_kv=False)
+        d2 = _agent(store2, InstanceType.DECODE, device_kv=False)
+        try:
+            assert p2.kv_transfer is None and d2.kv_transfer is None
+            assert wait_until(
+                lambda: m2.scheduler.instance_mgr.get_instance_meta(p2.name)
+                is not None
+                and m2.scheduler.instance_mgr.get_instance_meta(d2.name)
+                is not None, timeout=10)
+            r = requests.post(f"http://127.0.0.1:{m2.http_port}"
+                              "/v1/completions", json=BODY, timeout=120)
+            assert r.status_code == 200, r.text
+            assert r.json()["choices"][0]["text"] == device_text
+            assert p2.kv_host_sent == 1 and p2.kv_device_sent == 0
+            assert d2.kv_host_received == 1
+        finally:
+            p2.stop()
+            d2.stop()
+            m2.stop()
+            store2.close()

@@ -96,6 +96,10 @@ class TpuTopology:
     axis_names: list[str] = field(default_factory=list)
     host_addrs: list[str] = field(default_factory=list)
     chip_coords: list[list[int]] = field(default_factory=list)
+    # JAX transfer-server endpoint for device-path KV pulls (the TPU
+    # analog of the reference's RDMA device_ips/ports); "" = host path
+    # only.
+    kv_transfer_addr: str = ""
 
     def num_devices(self) -> int:
         n = 1

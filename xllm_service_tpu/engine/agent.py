@@ -44,11 +44,14 @@ from .engine import EngineRequest, InferenceEngine, PrefillHandoff
 logger = get_logger(__name__)
 
 
-def pack_handoff(h: PrefillHandoff, source_service_addr: str) -> bytes:
-    """Serialize a PD handoff for the DCN transfer path (msgpack + raw
-    array bytes; bf16 carried as ml_dtypes bytes)."""
+def pack_handoff(h: PrefillHandoff, source_service_addr: str,
+                 kv_ref: Optional[dict] = None) -> bytes:
+    """Serialize a PD handoff control message. With `kv_ref` (device
+    transfer path) the KV stays on device and only the pull descriptor is
+    sent; otherwise the blob is downloaded and carried inline (DCN host
+    path; msgpack + raw array bytes, bf16 as ml_dtypes bytes)."""
     lp = h.first_logprob
-    return msgpack.packb({
+    msg: dict[str, Any] = {
         "service_request_id": h.service_request_id,
         "request_id": h.request_id,
         "source_service_addr": source_service_addr,
@@ -60,24 +63,30 @@ def pack_handoff(h: PrefillHandoff, source_service_addr: str) -> bytes:
             "top": [(t.token, t.token_id, t.logprob)
                     for t in lp.top_logprobs]},
         "sampling": h.sampling.to_dict(),
-        "kv": {"bytes": h.kv_blob.tobytes(),
-               "shape": list(h.kv_blob.shape),
-               "dtype": str(h.kv_blob.dtype)},
-    }, use_bin_type=True)
+    }
+    if kv_ref is not None:
+        msg["kv_ref"] = kv_ref
+    else:
+        blob = np.asarray(h.kv_blob)
+        msg["kv"] = {"bytes": blob.tobytes(),
+                     "shape": list(blob.shape),
+                     "dtype": str(blob.dtype)}
+    return msgpack.packb(msg, use_bin_type=True)
 
 
 def unpack_handoff(data: bytes) -> dict:
     obj = msgpack.unpackb(data, raw=False)
-    kv = obj["kv"]
-    dtype = kv["dtype"]
-    if dtype == "bfloat16":
-        import ml_dtypes
+    kv = obj.get("kv")
+    if kv is not None:
+        dtype = kv["dtype"]
+        if dtype == "bfloat16":
+            import ml_dtypes
 
-        np_dtype = ml_dtypes.bfloat16
-    else:
-        np_dtype = np.dtype(dtype)
-    obj["kv_blob"] = np.frombuffer(kv["bytes"], dtype=np_dtype).reshape(
-        kv["shape"])
+            np_dtype = ml_dtypes.bfloat16
+        else:
+            np_dtype = np.dtype(dtype)
+        obj["kv_blob"] = np.frombuffer(kv["bytes"], dtype=np_dtype).reshape(
+            kv["shape"])
     return obj
 
 
@@ -94,6 +103,10 @@ class AgentConfig:
     lease_ttl_s: float = 3.0
     generation_flush_ms: float = 5.0   # batching window for Generations
     slice_id: str = "slice-0"
+    # Device-path PD KV transfer (JAX transfer server). Auto-disabled when
+    # the runtime lacks support or the engine spans >1 device (sharded
+    # pulls need matching mesh layouts — host path covers that case).
+    enable_device_kv_transfer: bool = True
 
 
 class _ChoiceAggregator:
@@ -279,8 +292,27 @@ class EngineAgent:
         self.instance_type = agent_cfg.instance_type
         self.streamer = GenerationStreamer(self.engine,
                                            agent_cfg.generation_flush_ms)
+        self.kv_transfer = None
+        if agent_cfg.enable_device_kv_transfer and (
+                self.engine.mesh is None or self.engine.mesh.size == 1):
+            from .kv_transfer import KvTransferManager
+
+            dev = next(iter(self.engine.kv_pages.devices()))
+            self.kv_transfer = KvTransferManager.create(dev, agent_cfg.host)
+            if self.kv_transfer is not None:
+                logger.info("device KV transfer server on %s",
+                            self.kv_transfer.address)
         self.linked_peers: dict[str, InstanceMetaInfo] = {}
+        # Handoff idempotency: sid -> receive time. A device-path control
+        # POST whose response is lost makes the prefill side retry via the
+        # host path; without this the same sequence would inject twice.
+        self._handoffs_seen: dict[str, float] = {}
         self.encode_count = 0
+        # PD transfer-path telemetry (also surfaced in /stats).
+        self.kv_device_sent = 0
+        self.kv_host_sent = 0
+        self.kv_device_received = 0
+        self.kv_host_received = 0
         self._alive = True
         self._started = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -301,7 +333,9 @@ class EngineAgent:
                 if self.engine.mesh else [len(devs)],
                 axis_names=list(self.engine.mesh.axis_names)
                 if self.engine.mesh else ["data"],
-                host_addrs=[self.name]),
+                host_addrs=[self.name],
+                kv_transfer_addr=self.kv_transfer.address
+                if self.kv_transfer is not None else ""),
             kv_page_size=ecfg.page_size,
             kv_dtype=str(mcfg.dtype.__name__ if hasattr(mcfg.dtype, "__name__")
                          else mcfg.dtype),
@@ -345,6 +379,8 @@ class EngineAgent:
         self._alive = False
         self.coord.rm(instance_key(self.instance_type.value, self.name))
         self.streamer.stop()
+        if self.kv_transfer is not None:
+            self.kv_transfer.close()
         self.engine.stop()
         if self._loop is not None and self._loop.is_running():
             self._loop.call_soon_threadsafe(self._loop.stop)
@@ -389,6 +425,8 @@ class EngineAgent:
                 return
             try:
                 self.register()   # lease refresh via re-registration
+                if self.kv_transfer is not None:
+                    self.kv_transfer.gc()   # free never-pulled KV offers
                 master = self.coord.get(MASTER_KEY)
                 if not master:
                     continue
@@ -420,7 +458,15 @@ class EngineAgent:
         return web.json_response({"status": "ok"})
 
     async def _h_stats(self, req: web.Request) -> web.Response:
-        return web.json_response(self.engine.stats())
+        return web.json_response({
+            **self.engine.stats(),
+            "kv_transfer": {
+                "device_sent": self.kv_device_sent,
+                "host_sent": self.kv_host_sent,
+                "device_received": self.kv_device_received,
+                "host_received": self.kv_host_received,
+            },
+        })
 
     async def _h_metrics(self, req: web.Request) -> web.Response:
         """Prometheus text exposition of engine state (the service's
@@ -605,15 +651,30 @@ class EngineAgent:
 
     def _transfer_to_peer(self, h: PrefillHandoff, peer: str,
                           dest: str) -> None:
+        """Ship a prefilled sequence to its decode peer. Device path first
+        (KV pulled device-to-device via the peer's transfer connection —
+        ICI within a slice, DCN fabric across), host-msgpack fallback
+        behind the same PrefillHandoff contract."""
+        peer_meta = self.linked_peers.get(peer)
+        if (self.kv_transfer is not None and peer_meta is not None
+                and peer_meta.topology.kv_transfer_addr):
+            desc = None
+            try:
+                desc = self.kv_transfer.offer(
+                    h.service_request_id, h.kv_blob, self.incarnation_id)
+                self._post_handoff(peer, pack_handoff(h, dest, kv_ref=desc))
+                self.kv_transfer.release(desc["uuid"])
+                self.kv_device_sent += 1
+                return
+            except Exception as e:  # noqa: BLE001
+                if desc is not None:
+                    self.kv_transfer.release(desc["uuid"])
+                logger.warning(
+                    "device KV transfer of %s to %s failed (%s); falling "
+                    "back to host path", h.service_request_id, peer, e)
         try:
-            r = _requests.post(f"http://{peer}/rpc/kv_transfer",
-                               data=pack_handoff(h, dest),
-                               headers={"Content-Type":
-                                        "application/msgpack"},
-                               timeout=60)
-            if r.status_code != 200:
-                raise RuntimeError(f"peer returned {r.status_code}: "
-                                   f"{r.text[:200]}")
+            self._post_handoff(peer, pack_handoff(h, dest))
+            self.kv_host_sent += 1
         except Exception as e:  # noqa: BLE001
             logger.warning("KV transfer of %s to %s failed: %s",
                            h.service_request_id, peer, e)
@@ -623,6 +684,16 @@ class EngineAgent:
                 status=Status(StatusCode.UNAVAILABLE,
                               f"KV transfer to decode peer failed: {e}"),
                 finished=True))
+
+    @staticmethod
+    def _post_handoff(peer: str, payload: bytes) -> None:
+        r = _requests.post(f"http://{peer}/rpc/kv_transfer",
+                           data=payload,
+                           headers={"Content-Type": "application/msgpack"},
+                           timeout=60)
+        if r.status_code != 200:
+            raise RuntimeError(f"peer returned {r.status_code}: "
+                               f"{r.text[:200]}")
 
     async def _h_encode(self, req: web.Request) -> web.Response:
         """EPD ENCODE stage: run the vision encoder on pixel arrays and
@@ -668,13 +739,46 @@ class EngineAgent:
 
     async def _h_kv_transfer(self, req: web.Request) -> web.Response:
         """Decode side of the PD handoff: accept prompt KV + first token,
-        inject into the local decode batch."""
+        inject into the local decode batch. KV arrives either inline
+        (host/DCN msgpack path) or as a `kv_ref` descriptor this side pulls
+        device-to-device from the prefill peer's transfer server."""
         data = await req.read()
         try:
             obj = unpack_handoff(data)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": f"bad handoff: {e}"},
                                      status=400)
+        sid = obj.get("service_request_id", "")
+        now = time.monotonic()
+        for k, ts in list(self._handoffs_seen.items()):
+            if now - ts > 600:
+                self._handoffs_seen.pop(k, None)
+        if sid in self._handoffs_seen:
+            # Duplicate delivery (prefill retried after a lost response):
+            # the sequence is already injected — ack, don't re-inject.
+            return web.json_response({"ok": True, "duplicate": True})
+        self._handoffs_seen[sid] = now
+        if "kv_blob" not in obj:
+            ref = obj.get("kv_ref")
+            if ref is None or self.kv_transfer is None:
+                return web.json_response(
+                    {"error": "no KV payload and no device-transfer "
+                              "capability"}, status=400)
+            try:
+                # Off the event loop: the pull blocks on the device fabric.
+                obj["kv_blob"] = await asyncio.get_running_loop() \
+                    .run_in_executor(None, self.kv_transfer.pull, ref)
+                self.kv_device_received += 1
+            except Exception as e:  # noqa: BLE001
+                # Unmark: the prefill side will retry via the host path,
+                # which must not be rejected as a duplicate.
+                self._handoffs_seen.pop(sid, None)
+                logger.warning("device KV pull for %s failed: %s",
+                               obj.get("service_request_id"), e)
+                return web.json_response(
+                    {"error": f"device KV pull failed: {e}"}, status=502)
+        else:
+            self.kv_host_received += 1
         dest = obj.get("source_service_addr", "")
         lp_d = obj.get("first_logprob")
         lp = None

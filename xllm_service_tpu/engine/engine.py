@@ -94,7 +94,8 @@ class EngineRequest:
     mm_embeds: Optional[np.ndarray] = None
     # Decode-side injection: sequence arrives with prompt KV precomputed.
     injected_first_token: Optional[int] = None
-    injected_kv: Optional[np.ndarray] = None
+    # np.ndarray (host/DCN path) or jax.Array (device/ICI pull path).
+    injected_kv: Optional[Any] = None
     injected_first_logprob: Optional["LogProb"] = None
 
 
@@ -116,7 +117,9 @@ class PrefillHandoff:
     first_token: int
     first_logprob: Optional[LogProb]
     sampling: SamplingParams
-    kv_blob: np.ndarray
+    # Device-resident (jax.Array). The agent downloads it only when the
+    # handoff falls back to the host/DCN msgpack path.
+    kv_blob: Any
 
 
 @dataclass
@@ -671,13 +674,20 @@ class InferenceEngine:
                 return b // self.cfg.page_size
         return self.cfg.pages_per_seq
 
-    def extract_kv_pages(self, pages: list[int]) -> np.ndarray:
-        """Fetch a sequence's KV pages to host (PD handoff, DCN path)."""
+    def extract_kv_pages_device(self, pages: list[int]) -> jax.Array:
+        """Gather a sequence's KV pages, staying device-resident (PD
+        handoff; the agent downloads only on the host/DCN fallback path —
+        the device path offers this buffer to the peer's transfer server
+        untouched)."""
         nb = self._page_bucket(len(pages))
         ids = np.full((nb,), GARBAGE_PAGE, np.int32)
         ids[:len(pages)] = pages
         blob = self._extract_kv(self._dstate, jnp.asarray(ids))
-        return np.asarray(blob)[:, :, :len(pages)]
+        return blob[:, :, :len(pages)]
+
+    def extract_kv_pages(self, pages: list[int]) -> np.ndarray:
+        """Fetch a sequence's KV pages to host (PD handoff, DCN path)."""
+        return np.asarray(self.extract_kv_pages_device(pages))
 
     def _start_sequence(self, req: EngineRequest) -> bool:
         if req.injected_kv is not None:
@@ -825,7 +835,7 @@ class InferenceEngine:
             # PD handoff: extract prompt KV, free local resources, and let
             # the agent ship the sequence to its decode peer.
             n_prompt_pages = -(-P0 // cfg.page_size)
-            blob = self.extract_kv_pages(
+            blob = self.extract_kv_pages_device(
                 seq.pages.all_pages[:n_prompt_pages])
             handoff = PrefillHandoff(
                 service_request_id=req.service_request_id,
@@ -869,9 +879,12 @@ class InferenceEngine:
         blob = req.injected_kv
         nb = self._page_bucket(blob.shape[2])
         if blob.shape[2] < nb:   # pad to the page bucket (jit shape reuse)
-            pad = np.zeros((*blob.shape[:2], nb - blob.shape[2],
+            # np for host blobs (DCN path), jnp for device blobs (ICI
+            # transfer path) — a device blob must never bounce via host.
+            xp = jnp if isinstance(blob, jax.Array) else np
+            pad = xp.zeros((*blob.shape[:2], nb - blob.shape[2],
                             *blob.shape[3:]), blob.dtype)
-            blob = np.concatenate([blob, pad], axis=2)
+            blob = xp.concatenate([blob, pad], axis=2)
         first_token = int(req.injected_first_token)
 
         P = cfg.pages_per_seq

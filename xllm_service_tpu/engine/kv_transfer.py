@@ -1,0 +1,133 @@
+"""Device-path KV transfer for PD disaggregation.
+
+Reference analog: the engine-side RDMA contract negotiated through Link
+ops (`/root/reference/xllm_service/scheduler/managers/instance_mgr.cpp:
+1087-1113` — `device_ips/ports/k,v_cache_ids` exchanged so prefill KV
+never bounces through a host). On TPU the equivalent transport is the JAX
+transfer server (`jax.experimental.transfer`): the prefill engine offers
+the extracted KV pages as *device* buffers under a request-derived id,
+and the decode engine pulls them device-to-device (ICI within a slice,
+DCN fabric across slices) — no host serialization on either side.
+
+The control hop stays on the existing `/rpc/kv_transfer` HTTP endpoint:
+instead of the msgpack blob, the prefill side sends a small descriptor
+`{addr, uuid, shape, dtype}`. The host-msgpack path remains as fallback
+whenever either side lacks a transfer server (or the pull fails), behind
+the same `PrefillHandoff` contract.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+# An offer the decode peer never pulled (transfer failed mid-flight) is
+# dropped after this long so the KV buffers can be freed.
+OFFER_TTL_S = 120.0
+
+
+def transfer_uuid(service_request_id: str, incarnation: str = "") -> int:
+    """Stable 63-bit id for one handoff."""
+    digest = hashlib.blake2b(
+        f"{service_request_id}|{incarnation}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big") & 0x7FFF_FFFF_FFFF_FFFF
+
+
+class KvTransferManager:
+    """One per engine agent: owns a transfer server bound to the engine's
+    backend and a cache of connections to peer servers."""
+
+    def __init__(self, device: jax.Device, listen_ip: str = "127.0.0.1"):
+        from jax.experimental import transfer as _xfer
+
+        self._device = device
+        self._server = _xfer.start_transfer_server(
+            device.client, f"{listen_ip}:0", [f"{listen_ip}:0"])
+        self._conns: dict[str, Any] = {}
+        self._lock = threading.Lock()
+        # uuid -> (arrays, deadline): keeps offered buffers alive until the
+        # peer confirms the pull (release()) or the TTL lapses.
+        self._pending: dict[int, tuple[Any, float]] = {}
+
+    @classmethod
+    def create(cls, device: jax.Device,
+               listen_ip: str = "127.0.0.1") -> Optional["KvTransferManager"]:
+        """None when the runtime lacks transfer-server support (the caller
+        falls back to the host path)."""
+        try:
+            return cls(device, listen_ip)
+        except Exception as e:  # noqa: BLE001 — optional capability
+            logger.info("device KV transfer unavailable: %s", e)
+            return None
+
+    @property
+    def address(self) -> str:
+        return self._server.address()
+
+    # ------------------------------------------------------------ prefill
+    def offer(self, service_request_id: str, blob: jax.Array,
+              incarnation: str = "") -> dict[str, Any]:
+        """Schedule `blob` for a device-to-device pull; returns the wire
+        descriptor for the control message."""
+        uid = transfer_uuid(service_request_id, incarnation)
+        self.gc()
+        with self._lock:
+            self._pending[uid] = ([blob], time.monotonic() + OFFER_TTL_S)
+        self._server.await_pull(uid, [blob])
+        return {
+            "addr": self.address,
+            "uuid": uid,
+            "shape": list(blob.shape),
+            "dtype": str(blob.dtype),
+        }
+
+    def release(self, uuid: int) -> None:
+        with self._lock:
+            self._pending.pop(uuid, None)
+
+    def gc(self) -> None:
+        """Drop expired offers so their KV buffers can be freed. Called on
+        every offer AND from the agent's heartbeat loop — an idle agent
+        must still release buffers whose peer died before pulling."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [u for u, (_, dl) in self._pending.items() if dl < now]
+            for u in dead:
+                self._pending.pop(u, None)
+        if dead:
+            logger.warning("dropped %d expired KV-transfer offers", len(dead))
+
+    def close(self) -> None:
+        """Drop all held references (offered buffers, peer connections).
+        The underlying server socket is freed with the object."""
+        with self._lock:
+            self._pending.clear()
+            self._conns.clear()
+        self._server = None
+
+    # ------------------------------------------------------------- decode
+    def pull(self, desc: dict[str, Any]) -> jax.Array:
+        """Pull the offered KV pages straight into this engine's device
+        memory."""
+        addr = desc["addr"]
+        with self._lock:
+            conn = self._conns.get(addr)
+        if conn is None:
+            conn = self._server.connect(addr)
+            with self._lock:
+                self._conns[addr] = conn
+        spec = jax.ShapeDtypeStruct(
+            tuple(desc["shape"]), jnp.dtype(desc["dtype"]),
+            sharding=jax.sharding.SingleDeviceSharding(self._device))
+        out = conn.pull(int(desc["uuid"]), [spec])
+        return out[0]

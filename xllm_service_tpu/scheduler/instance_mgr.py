@@ -522,7 +522,7 @@ class InstanceMgr:
                     has_decode = True
                 if has_default or (has_prefill and has_decode):
                     return True
-            return has_default or (has_prefill and has_decode)
+            return False
 
     # ------------------------------------------------- SLO core + role flips
     def update_request_metrics(self, req: Request, action: RequestAction,
