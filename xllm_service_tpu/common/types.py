@@ -214,6 +214,20 @@ class KvCacheEvent:
     def empty(self) -> bool:
         return not (self.stored or self.removed or self.offloaded)
 
+    def merge(self, other: "KvCacheEvent") -> None:
+        """Union of two replicas' deltas (dp_size>1: the instance-level
+        event is the union of its replicas'; a block removed by one replica
+        but stored by another stays stored)."""
+        removed_here = set(other.stored)
+        self.removed = [h for h in self.removed if h not in removed_here]
+        stored_there = set(self.stored)
+        self.stored += [h for h in other.stored if h not in stored_there]
+        kept = set(self.stored)
+        self.removed += [h for h in other.removed
+                         if h not in kept and h not in set(self.removed)]
+        self.offloaded += [h for h in other.offloaded
+                           if h not in set(self.offloaded)]
+
     def to_dict(self) -> dict[str, Any]:
         return asdict(self)
 

@@ -103,6 +103,11 @@ class AgentConfig:
     lease_ttl_s: float = 3.0
     generation_flush_ms: float = 5.0   # batching window for Generations
     slice_id: str = "slice-0"
+    # Model replicas behind this one registration (reference dp_size,
+    # `xllm_rpc_service.proto:40-43`): each replica is an independent
+    # continuous-batching engine; requests are dispatched prefix-affine
+    # with a load guard. Replicas land on local devices round-robin.
+    dp_size: int = 1
     # Device-path PD KV transfer (JAX transfer server). Auto-disabled when
     # the runtime lacks support or the engine spans >1 device (sharded
     # pulls need matching mesh layouts — host path covers that case).
@@ -152,7 +157,9 @@ _NOTHING = object()   # queue-timeout marker distinct from the stop sentinel
 class GenerationStreamer:
     """Batches RequestOutput deltas per destination service and POSTs
     `{"gens": [...]}` (reference batched DisaggStreamGenerations,
-    `rpc_service/service.cpp:149-215`).
+    `rpc_service/service.cpp:149-215`). `engine` is anything with a
+    `cancel(service_request_id)` — the agent passes itself to fan
+    cancellations across dp replicas.
 
     Delivery semantics: each delta carries a per-request monotonic
     `delta_seq` (the service dedupes on it, so retries are safe even when
@@ -284,13 +291,34 @@ class EngineAgent:
         tokenizer = TokenizerFactory.create_tokenizer(agent_cfg.tokenizer_path)
         self.chat_template = JinjaChatTemplate(
             TokenizerFactory.load_chat_template(agent_cfg.tokenizer_path))
-        self.engine = InferenceEngine(engine_cfg, tokenizer=tokenizer,
-                                      params=params)
+        dp = max(1, agent_cfg.dp_size)
+        if dp > 1 and engine_cfg.mesh:
+            logger.warning("dp_size>1 with an engine-internal mesh is not "
+                           "supported yet; forcing dp_size=1")
+            dp = 1
+        devs = jax.devices()
+        self.engines: list[InferenceEngine] = []
+        for i in range(dp):
+            dev = devs[i % len(devs)]
+            with jax.default_device(dev):
+                if i == 0:
+                    eng = InferenceEngine(engine_cfg, tokenizer=tokenizer,
+                                          params=params)
+                else:
+                    # Replicate the first replica's weights (same values on
+                    # every replica; a copy only when the device differs).
+                    eng = InferenceEngine(
+                        engine_cfg, tokenizer=tokenizer,
+                        params=jax.device_put(self.engines[0].params, dev))
+            self.engines.append(eng)
+        self.engine = self.engines[0]   # config/metadata accessor
+        self._rr_replica = 0
         self.port = agent_cfg.port or pick_free_port(agent_cfg.host)
         self.name = f"{agent_cfg.host}:{self.port}"
         self.incarnation_id = uuid.uuid4().hex[:12]
         self.instance_type = agent_cfg.instance_type
-        self.streamer = GenerationStreamer(self.engine,
+        # Pass the agent itself: cancel() fans out across replicas.
+        self.streamer = GenerationStreamer(self,
                                            agent_cfg.generation_flush_ms)
         self.kv_transfer = None
         if agent_cfg.enable_device_kv_transfer and (
@@ -319,6 +347,34 @@ class EngineAgent:
         self._runner: Optional[web.AppRunner] = None
         self._threads: list[threading.Thread] = []
 
+    # --------------------------------------------------------- dp dispatch
+    def cancel(self, service_request_id: str) -> None:
+        """Fan a cancellation across all replicas (each ignores unknown
+        ids)."""
+        for eng in self.engines:
+            eng.cancel(service_request_id)
+
+    def _pick_engine(self, token_ids: list[int]) -> InferenceEngine:
+        """Replica dispatch: prefix-affine (the same prompt prefix lands on
+        the same replica, so its prefix cache actually hits) with a load
+        guard (spill to the least-loaded replica when the affine one is a
+        full batch deeper than the lightest)."""
+        if len(self.engines) == 1:
+            return self.engines[0]
+        block = self.engine.cfg.hash_block_size
+        key = hash(tuple(token_ids[:block])) if token_ids else self._rr_replica
+        self._rr_replica += 1
+        affine = self.engines[key % len(self.engines)]
+
+        def depth(e: InferenceEngine) -> int:
+            s = e.stats()
+            return s["waiting"] + s["running"]
+
+        lightest = min(self.engines, key=depth)
+        if depth(affine) > depth(lightest) + self.engine.cfg.max_batch_size:
+            return lightest
+        return affine
+
     # ------------------------------------------------------------ metadata
     def meta(self) -> InstanceMetaInfo:
         ecfg = self.engine.cfg
@@ -326,7 +382,7 @@ class EngineAgent:
         devs = jax.devices()
         return InstanceMetaInfo(
             name=self.name, rpc_address=self.name, type=self.instance_type,
-            dp_size=1,
+            dp_size=len(self.engines),
             topology=TpuTopology(
                 slice_id=self.cfg.slice_id,
                 mesh_shape=list(self.engine.mesh.devices.shape)
@@ -355,7 +411,8 @@ class EngineAgent:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "EngineAgent":
-        self.engine.start()
+        for eng in self.engines:
+            eng.start()
         t = threading.Thread(target=self._run_server, daemon=True,
                              name=f"agent-http-{self.port}")
         t.start()
@@ -381,7 +438,8 @@ class EngineAgent:
         self.streamer.stop()
         if self.kv_transfer is not None:
             self.kv_transfer.close()
-        self.engine.stop()
+        for eng in self.engines:
+            eng.stop()
         if self._loop is not None and self._loop.is_running():
             self._loop.call_soon_threadsafe(self._loop.stop)
         self.coord.close()
@@ -430,8 +488,10 @@ class EngineAgent:
                 master = self.coord.get(MASTER_KEY)
                 if not master:
                     continue
-                stats = self.engine.stats()
-                ev = self.engine.drain_kv_events()
+                stats = self.aggregate_stats()
+                ev = self.engines[0].drain_kv_events()
+                for eng in self.engines[1:]:
+                    ev.merge(eng.drain_kv_events())
                 payload = {
                     "name": self.name,
                     "incarnation_id": self.incarnation_id,
@@ -441,25 +501,41 @@ class EngineAgent:
                         "hbm_cache_usage_perc": stats["kv_usage_perc"],
                     },
                     "latency_metrics": {
-                        "recent_max_ttft": self.engine.recent_max_ttft_ms,
-                        "recent_max_tbt": self.engine.recent_max_tbt_ms,
+                        "recent_max_ttft": max(
+                            e.recent_max_ttft_ms for e in self.engines),
+                        "recent_max_tbt": max(
+                            e.recent_max_tbt_ms for e in self.engines),
                     },
                     "kv_cache_event": ev.to_dict(),
                 }
-                self.engine.recent_max_ttft_ms = 0.0
-                self.engine.recent_max_tbt_ms = 0.0
+                for eng in self.engines:
+                    eng.recent_max_ttft_ms = 0.0
+                    eng.recent_max_tbt_ms = 0.0
                 _requests.post(f"http://{master}/rpc/heartbeat",
                                json=payload, timeout=3)
             except Exception:  # noqa: BLE001
                 logger.exception("heartbeat failed")
 
     # ------------------------------------------------------------ handlers
+    def aggregate_stats(self) -> dict[str, Any]:
+        """Instance-level stats = sum over replicas (kv usage: max — the
+        scheduler treats it as a saturation signal)."""
+        per = [e.stats() for e in self.engines]
+        return {
+            "waiting": sum(s["waiting"] for s in per),
+            "running": sum(s["running"] for s in per),
+            "kv_usage_perc": max(s["kv_usage_perc"] for s in per),
+            "cached_blocks": sum(s["cached_blocks"] for s in per),
+            "total_generated": sum(s["total_generated"] for s in per),
+            "dp_size": len(self.engines),
+        }
+
     async def _h_health(self, req: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
     async def _h_stats(self, req: web.Request) -> web.Response:
         return web.json_response({
-            **self.engine.stats(),
+            **self.aggregate_stats(),
             "kv_transfer": {
                 "device_sent": self.kv_device_sent,
                 "host_sent": self.kv_host_sent,
@@ -471,7 +547,7 @@ class EngineAgent:
     async def _h_metrics(self, req: web.Request) -> web.Response:
         """Prometheus text exposition of engine state (the service's
         /metrics covers the orchestration plane; this covers the chip)."""
-        st = self.engine.stats()
+        st = self.aggregate_stats()
         lines = [
             "# TYPE engine_waiting_requests gauge",
             f"engine_waiting_requests {st['waiting']}",
@@ -484,13 +560,16 @@ class EngineAgent:
             "# TYPE engine_generated_tokens_total counter",
             f"engine_generated_tokens_total {st['total_generated']}",
             "# TYPE engine_preemptions_total counter",
-            f"engine_preemptions_total {self.engine.preemption_count}",
+            f"engine_preemptions_total "
+            f"{sum(e.preemption_count for e in self.engines)}",
             "# TYPE engine_recent_max_ttft_milliseconds gauge",
             f"engine_recent_max_ttft_milliseconds "
-            f"{self.engine.recent_max_ttft_ms:.3f}",
+            f"{max(e.recent_max_ttft_ms for e in self.engines):.3f}",
             "# TYPE engine_recent_max_tbt_milliseconds gauge",
             f"engine_recent_max_tbt_milliseconds "
-            f"{self.engine.recent_max_tbt_ms:.3f}",
+            f"{max(e.recent_max_tbt_ms for e in self.engines):.3f}",
+            "# TYPE engine_dp_size gauge",
+            f"engine_dp_size {len(self.engines)}",
         ]
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
@@ -520,7 +599,7 @@ class EngineAgent:
 
     async def _h_cancel(self, req: web.Request) -> web.Response:
         body = await req.json()
-        self.engine.cancel(body.get("service_request_id", ""))
+        self.cancel(body.get("service_request_id", ""))
         return web.json_response({"ok": True})
 
     async def _h_flip(self, req: web.Request) -> web.Response:
@@ -608,7 +687,7 @@ class EngineAgent:
                     args=(h, _peer, _dest),
                     name=f"kv-transfer-{h.service_request_id}").start()
 
-            self.engine.submit(EngineRequest(
+            self._pick_engine(token_ids).submit(EngineRequest(
                 service_request_id=sid,
                 request_id=body.get("request_id", sid),
                 token_ids=token_ids, sampling=sampling,
@@ -623,8 +702,9 @@ class EngineAgent:
         # re-indexed, and `finished` is withheld until every choice is done
         # (the service closes the stream on the first finished delta).
         n = max(1, sampling.n)
+        engine = self._pick_engine(token_ids)
         if n == 1:
-            self.engine.submit(EngineRequest(
+            engine.submit(EngineRequest(
                 service_request_id=sid,
                 request_id=body.get("request_id", sid),
                 token_ids=token_ids, sampling=sampling, on_output=on_output,
@@ -633,13 +713,15 @@ class EngineAgent:
                 priority=int(body.get("priority") or 0)))
             return web.json_response({"ok": True, "service_request_id": sid})
 
+        # All n choices go to ONE replica so its prefix cache dedupes the
+        # shared prompt prefill.
         agg = _ChoiceAggregator(n, lambda out: self.streamer.push(dest, out))
         for k in range(n):
             sub_sampling = sampling
             if sampling.seed is not None:
                 sub_sampling = SamplingParams.from_dict(sampling.to_dict())
                 sub_sampling.seed = sampling.seed + k
-            self.engine.submit(EngineRequest(
+            engine.submit(EngineRequest(
                 service_request_id=sid,
                 request_id=body.get("request_id", sid),
                 token_ids=list(token_ids), sampling=sub_sampling,
@@ -793,7 +875,7 @@ class EngineAgent:
         def on_output(out: RequestOutput) -> None:
             self.streamer.push(dest, out)
 
-        self.engine.submit(EngineRequest(
+        self._pick_engine(list(obj["token_ids"])).submit(EngineRequest(
             service_request_id=obj["service_request_id"],
             request_id=obj.get("request_id", ""),
             token_ids=list(obj["token_ids"]),
@@ -958,6 +1040,8 @@ def main() -> None:
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--dp-size", type=int, default=1,
+                   help="model replicas behind this registration")
     args = p.parse_args()
 
     factory = {
@@ -999,7 +1083,8 @@ def main() -> None:
                           coordination_addr=args.coordination_addr,
                           instance_type=InstanceType.parse(args.type),
                           model_id=args.model_id,
-                          tokenizer_path=args.tokenizer_path),
+                          tokenizer_path=args.tokenizer_path,
+                          dp_size=args.dp_size),
         params=params)
     agent.start()
     try:
