@@ -1,0 +1,110 @@
+"""Sequence/context-parallel serving prefill (SURVEY.md §5.7): long
+prefix-free prompts prefill with ring attention over the mesh's seq axis;
+output must match the single-device engine exactly (greedy)."""
+
+import threading
+
+import jax.numpy as jnp
+
+from xllm_service_tpu.common.request import RequestOutput, SamplingParams
+from xllm_service_tpu.engine.config import EngineConfig
+from xllm_service_tpu.engine.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.models.base import tiny_config
+from xllm_service_tpu.parallel.mesh import MeshConfig
+
+
+def make_cfg(**kw) -> EngineConfig:
+    return EngineConfig(
+        model=tiny_config(dtype=jnp.float32, max_context_len=512),
+        num_pages=64, page_size=16, hash_block_size=32,
+        max_batch_size=2, max_seq_len=512,
+        prefill_buckets=(32, 64, 128, 512),
+        seq_parallel_min_tokens=kw.pop("sp_min", 64), **kw)
+
+
+class Collector:
+    def __init__(self):
+        self.outputs: list[RequestOutput] = []
+        self.done = threading.Event()
+
+    def __call__(self, out: RequestOutput) -> None:
+        self.outputs.append(out)
+        if out.finished:
+            self.done.set()
+
+    @property
+    def tokens(self):
+        return [t for o in self.outputs for s in o.outputs
+                for t in s.token_ids]
+
+
+def run_one(engine: InferenceEngine, prompt, n=5):
+    col = Collector()
+    engine.submit(EngineRequest(
+        "sp1", token_ids=prompt,
+        sampling=SamplingParams(max_tokens=n, temperature=0.0,
+                                ignore_eos=True),
+        on_output=col))
+    for _ in range(400):
+        if col.done.is_set():
+            break
+        engine.step()
+    assert col.done.is_set()
+    return col.tokens
+
+
+class TestSeqParallelPrefill:
+    def test_ring_prefill_matches_single_device(self):
+        # 100-token prompt >= sp_min 64 -> bucket 128, divisible by sp=4.
+        prompt = [(i * 7 + 3) % 200 + 10 for i in range(100)]
+        single = InferenceEngine(make_cfg())
+        want = run_one(single, prompt)
+
+        sp_engine = InferenceEngine(make_cfg(mesh=MeshConfig(seq=4)))
+        assert sp_engine.seq_parallel == 4
+        assert sp_engine._prefill_install_sp is not None
+        used = {"sp": 0}
+        real = sp_engine._prefill_install_sp
+
+        def spy(*a, **k):
+            used["sp"] += 1
+            return real(*a, **k)
+
+        sp_engine._prefill_install_sp = spy
+        got = run_one(sp_engine, prompt)
+        assert used["sp"] == 1, "ring-attention program was not used"
+        assert got == want
+
+    def test_short_prompt_uses_standard_path(self):
+        sp_engine = InferenceEngine(make_cfg(mesh=MeshConfig(seq=4)))
+        used = {"sp": 0}
+        real = sp_engine._prefill_install_sp
+
+        def spy(*a, **k):
+            used["sp"] += 1
+            return real(*a, **k)
+
+        sp_engine._prefill_install_sp = spy
+        single = InferenceEngine(make_cfg())
+        prompt = list(range(20, 50))   # 30 tokens < sp_min
+        assert run_one(sp_engine, prompt) == run_one(single, prompt)
+        assert used["sp"] == 0
+
+    def test_prefix_cached_prompt_uses_standard_path(self):
+        """Second submission of the same long prompt hits the prefix cache
+        -> must route to the standard (prefix-aware) program and still
+        produce identical output."""
+        prompt = [(i * 5 + 1) % 180 + 10 for i in range(100)]
+        sp_engine = InferenceEngine(make_cfg(mesh=MeshConfig(seq=4)))
+        first = run_one(sp_engine, prompt)
+        used = {"sp": 0}
+        real = sp_engine._prefill_install_sp
+
+        def spy(*a, **k):
+            used["sp"] += 1
+            return real(*a, **k)
+
+        sp_engine._prefill_install_sp = spy
+        second = run_one(sp_engine, prompt)
+        assert second == first
+        assert used["sp"] == 0   # cached prefix -> standard path

@@ -42,6 +42,12 @@ class EngineConfig:
     # still a win locally). Tokens past a stop condition within a horizon
     # are discarded on the host.
     decode_horizon: int = 1
+    # Sequence/context parallelism (SURVEY.md §5.7): when the engine's mesh
+    # has a `seq` axis of size > 1, uncached prompts whose suffix is at
+    # least this many tokens prefill with ring attention sharded over that
+    # axis (blockwise ring over ICI; ops/ring_attention.py). Shorter or
+    # prefix-cached prompts use the standard path.
+    seq_parallel_min_tokens: int = 1024
 
     @property
     def pages_per_seq(self) -> int:

@@ -25,6 +25,7 @@ become Pallas/XLA"). Design points for XLA and for remote-attached chips:
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from collections import deque
@@ -144,8 +145,15 @@ class InferenceEngine:
                  params: Optional[dict] = None):
         cfg.validate()
         self.cfg = cfg
-        self.mesh = mesh if mesh is not None else build_mesh(
-            cfg.mesh) if cfg.mesh else None
+        if mesh is not None:
+            self.mesh = mesh
+        elif cfg.mesh:
+            # Use exactly the devices the configured mesh asks for (a host
+            # may expose more, e.g. the virtual CPU test mesh).
+            self.mesh = build_mesh(
+                cfg.mesh, devices=jax.devices()[:cfg.mesh.num_devices()])
+        else:
+            self.mesh = None
         self.tokenizer = tokenizer or SimpleTokenizer()
         self.eos_token_id = eos_token_id if eos_token_id is not None else \
             getattr(self.tokenizer, "eos_id", None)
@@ -161,6 +169,10 @@ class InferenceEngine:
                 params = shard_params(params, self.mesh,
                                       self.family.sharding_rules)
         self.params = params
+        # Context parallelism: size of the mesh's seq axis (1 = off).
+        from ..parallel.mesh import AXIS_SEQ
+        self.seq_parallel = (int(self.mesh.shape[AXIS_SEQ])
+                             if self.mesh is not None else 1)
         self.page_mgr = KVPageManager(cfg.num_pages, cfg.page_size,
                                       cfg.hash_block_size)
 
@@ -282,8 +294,7 @@ class InferenceEngine:
 
         V = mcfg.vocab_size
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def prefill_install(params, d, packed_in, mm):
+        def make_prefill_install(use_ring: bool):
             """Prefill one sequence + install it into batch slot `slot`.
 
             packed_in: ONE int32 upload (host↔device roundtrips are the
@@ -294,62 +305,85 @@ class InferenceEngine:
             top_p, freq, pres, rep) are f32 bit-cast to i32, and key is the
             uint32 PRNG key.
             mm: [1, M, D] visual embeddings (VL family; dummy otherwise).
-            """
-            NS = NUM_STOP_IDS
-            S = packed_in.shape[0] - (P + 4 + NS) - 6 - V - 2
-            tokens = packed_in[:S][None, :]
-            ints = packed_in[S:S + P + 4 + NS]
-            floats = jax.lax.bitcast_convert_type(
-                packed_in[S + P + 4 + NS:S + P + 10 + NS], jnp.float32)
-            counts_row = packed_in[S + P + 10 + NS:S + P + 10 + NS + V]
-            key = jax.lax.bitcast_convert_type(packed_in[-2:], jnp.uint32)
-            page_row = ints[:P]
-            slot = ints[P]
-            prefix_len = ints[P + 1]
-            seq_len = ints[P + 2]
-            positions = prefix_len + jnp.arange(
-                tokens.shape[1], dtype=jnp.int32)[None, :]
-            if is_vl:
-                logits, kv = fam.prefill_forward(
-                    params, mcfg, tokens, positions, d["kv"],
-                    page_row[None, :], prefix_len[None], seq_len[None],
-                    mm_embeds=mm)
-            else:
-                logits, kv = fam.prefill_forward(
-                    params, mcfg, tokens, positions, d["kv"],
-                    page_row[None, :], prefix_len[None], seq_len[None])
-            d = dict(d, kv=kv)
-            st = SamplingState(
-                floats[0:1], floats[1:2].astype(jnp.int32), floats[2:3],
-                floats[3:4], floats[4:5], floats[5:6], counts_row[None, :])
-            toks, logprobs = sample_tokens(
-                logits, st, key[None, :], (prefix_len + seq_len)[None])
-            chosen = jnp.take_along_axis(logprobs, toks[:, None],
-                                         axis=-1)[:, 0]
-            tv, ti = jax.lax.top_k(logprobs, K)
-            # Install the slot.
-            d["pt"] = d["pt"].at[slot].set(page_row)
-            d["last"] = d["last"].at[slot].set(toks[0])
-            d["clens"] = d["clens"].at[slot].set(prefix_len + seq_len + 1)
-            d["active"] = d["active"].at[slot].set(True)
-            d["temp"] = d["temp"].at[slot].set(floats[0])
-            d["topk"] = d["topk"].at[slot].set(floats[1].astype(jnp.int32))
-            d["topp"] = d["topp"].at[slot].set(floats[2])
-            d["fp"] = d["fp"].at[slot].set(floats[3])
-            d["pp"] = d["pp"].at[slot].set(floats[4])
-            d["rp"] = d["rp"].at[slot].set(floats[5])
-            d["keys"] = d["keys"].at[slot].set(key)
-            d["want_lp"] = d["want_lp"].at[slot].set(ints[P + 3] > 0)
-            d["stop_ids"] = d["stop_ids"].at[slot].set(
-                ints[P + 4:P + 4 + NS])
-            d["counts"] = d["counts"].at[slot].set(
-                counts_row.at[toks[0]].add(1))
-            packed = jnp.concatenate(
-                [toks.astype(jnp.float32), chosen, tv[0],
-                 ti[0].astype(jnp.float32)])
-            return d, packed
 
-        self._prefill_install = prefill_install
+            use_ring: trace the suffix self-attention as ring attention
+            over the mesh's seq axis (context parallelism; the caller only
+            routes prefix-free long prompts here).
+            """
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_install(params, d, packed_in, mm):
+                from ..ops.attention import sequence_parallel_prefill
+                from ..parallel.mesh import AXIS_SEQ
+
+                NS = NUM_STOP_IDS
+                S = packed_in.shape[0] - (P + 4 + NS) - 6 - V - 2
+                tokens = packed_in[:S][None, :]
+                ints = packed_in[S:S + P + 4 + NS]
+                floats = jax.lax.bitcast_convert_type(
+                    packed_in[S + P + 4 + NS:S + P + 10 + NS], jnp.float32)
+                counts_row = packed_in[S + P + 10 + NS:S + P + 10 + NS + V]
+                key = jax.lax.bitcast_convert_type(packed_in[-2:],
+                                                   jnp.uint32)
+                page_row = ints[:P]
+                slot = ints[P]
+                prefix_len = ints[P + 1]
+                seq_len = ints[P + 2]
+                positions = prefix_len + jnp.arange(
+                    tokens.shape[1], dtype=jnp.int32)[None, :]
+                sp_ctx = (sequence_parallel_prefill(self.mesh, AXIS_SEQ)
+                          if use_ring else contextlib.nullcontext())
+                with sp_ctx:
+                    if is_vl:
+                        logits, kv = fam.prefill_forward(
+                            params, mcfg, tokens, positions, d["kv"],
+                            page_row[None, :], prefix_len[None],
+                            seq_len[None], mm_embeds=mm)
+                    else:
+                        logits, kv = fam.prefill_forward(
+                            params, mcfg, tokens, positions, d["kv"],
+                            page_row[None, :], prefix_len[None],
+                            seq_len[None])
+                d = dict(d, kv=kv)
+                st = SamplingState(
+                    floats[0:1], floats[1:2].astype(jnp.int32), floats[2:3],
+                    floats[3:4], floats[4:5], floats[5:6],
+                    counts_row[None, :])
+                toks, logprobs = sample_tokens(
+                    logits, st, key[None, :], (prefix_len + seq_len)[None])
+                chosen = jnp.take_along_axis(logprobs, toks[:, None],
+                                             axis=-1)[:, 0]
+                tv, ti = jax.lax.top_k(logprobs, K)
+                # Install the slot.
+                d["pt"] = d["pt"].at[slot].set(page_row)
+                d["last"] = d["last"].at[slot].set(toks[0])
+                d["clens"] = d["clens"].at[slot].set(prefix_len + seq_len + 1)
+                d["active"] = d["active"].at[slot].set(True)
+                d["temp"] = d["temp"].at[slot].set(floats[0])
+                d["topk"] = d["topk"].at[slot].set(
+                    floats[1].astype(jnp.int32))
+                d["topp"] = d["topp"].at[slot].set(floats[2])
+                d["fp"] = d["fp"].at[slot].set(floats[3])
+                d["pp"] = d["pp"].at[slot].set(floats[4])
+                d["rp"] = d["rp"].at[slot].set(floats[5])
+                d["keys"] = d["keys"].at[slot].set(key)
+                d["want_lp"] = d["want_lp"].at[slot].set(ints[P + 3] > 0)
+                d["stop_ids"] = d["stop_ids"].at[slot].set(
+                    ints[P + 4:P + 4 + NS])
+                d["counts"] = d["counts"].at[slot].set(
+                    counts_row.at[toks[0]].add(1))
+                packed = jnp.concatenate(
+                    [toks.astype(jnp.float32), chosen, tv[0],
+                     ti[0].astype(jnp.float32)])
+                return d, packed
+
+            return prefill_install
+
+        self._prefill_install = make_prefill_install(False)
+        # Ring-attention variant for long prefix-free prompts, only when
+        # the mesh actually has a seq axis to shard over.
+        self._prefill_install_sp = (
+            make_prefill_install(True) if self.seq_parallel > 1 else None)
 
         @partial(jax.jit, donate_argnums=(0,))
         def clear_slot(d, slot):
@@ -743,6 +777,13 @@ class InferenceEngine:
         with self._lock:
             seq.slot = self._free_slots.pop()
 
+        # Sequence-parallel prefill takes precedence over chunking: the
+        # ring spreads the long suffix across the seq axis in ONE program
+        # call, so there is nothing to interleave.
+        if self._sp_applicable(len(prompt) - matched, matched, req):
+            return self._finish_admission(seq, req, prompt, matched,
+                                          matched, time.monotonic())
+
         # Chunked prefill: long suffixes are written chunk-by-chunk across
         # engine iterations so running decodes keep making progress.
         C = cfg.prefill_chunk_tokens
@@ -929,6 +970,19 @@ class InferenceEngine:
                 return b
         return self.cfg.prefill_buckets[-1]
 
+    def _sp_applicable(self, suffix_len: int, matched: int,
+                       req: EngineRequest) -> bool:
+        """Route to the ring-attention prefill program? Requires a seq mesh
+        axis, a prefix-free prompt (the ring path has no paged-prefix term
+        — trace-time constraint, see ops.attention), no multimodal splice,
+        enough tokens to be worth the collectives, and a bucket the seq
+        axis divides evenly."""
+        return (self._prefill_install_sp is not None
+                and matched == 0
+                and req.mm_embeds is None
+                and suffix_len >= self.cfg.seq_parallel_min_tokens
+                and self._bucket_for(suffix_len) % self.seq_parallel == 0)
+
     def _device_stop_ids(self, sp: SamplingParams) -> np.ndarray:
         """The first NUM_STOP_IDS stop tokens for device-side slot
         deactivation (-1 padded; see decode_multi)."""
@@ -994,7 +1048,10 @@ class InferenceEngine:
         packed_in = np.concatenate([
             toks[0], ints, floats.view(np.int32), counts_row,
             np.asarray(slot_key).view(np.int32).reshape(-1)[:2]])
-        self._dstate, packed = self._prefill_install(
+        prog = (self._prefill_install_sp
+                if self._sp_applicable(len(suffix), matched, seq.req)
+                else self._prefill_install)
+        self._dstate, packed = prog(
             self.params, self._dstate, jnp.asarray(packed_in), mm_arr)
         packed_np = np.asarray(packed)
         K = self.cfg.max_top_logprobs

@@ -17,10 +17,31 @@ provides the hand-written TPU kernel and the engine selects per backend.
 
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
+
+# Sequence-parallel prefill context (SURVEY.md §5.7). The engine activates
+# this while TRACING its long-prefill program; `prefill_attention` then
+# routes the suffix self-attention through the blockwise ring op sharded
+# over the mesh's seq axis. Trace-time only — the engine guarantees the
+# prompt has no cached prefix on this path (prefix attention would need a
+# traced branch, which XLA cannot take on a dynamic prefix_lens).
+_sp_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def sequence_parallel_prefill(mesh, seq_axis: str = "seq"):
+    prev = getattr(_sp_ctx, "cfg", None)
+    _sp_ctx.cfg = (mesh, seq_axis)
+    try:
+        yield
+    finally:
+        _sp_ctx.cfg = prev
 
 
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
@@ -128,6 +149,18 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     n_rep = n_heads // n_kv
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
+
+    sp = getattr(_sp_ctx, "cfg", None)
+    if sp is not None:
+        # Context-parallel path: ring attention over the seq mesh axis.
+        # Queries past seq_lens are end-padding; causal masking keeps them
+        # out of every valid query's window and the engine discards their
+        # outputs, so the pure-causal ring is exact here.
+        from .ring_attention import ring_attention
+
+        mesh, seq_axis = sp
+        return ring_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
+                              mesh, seq_axis=seq_axis)
 
     kf = _repeat_kv(k, n_rep).astype(jnp.float32)
     vf = _repeat_kv(v, n_rep).astype(jnp.float32)
