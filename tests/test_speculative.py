@@ -1,0 +1,137 @@
+"""Speculative decoding (prompt-lookup drafts + one-forward verify):
+greedy outputs must be IDENTICAL to the non-speculative engine; sampling
+requests silently fall back to the normal decode path."""
+
+import threading
+
+import jax.numpy as jnp
+
+from xllm_service_tpu.common.request import RequestOutput, SamplingParams
+from xllm_service_tpu.engine.config import EngineConfig
+from xllm_service_tpu.engine.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.models.base import tiny_config
+
+
+def make_engine(speculate_k=0, **kw) -> InferenceEngine:
+    return InferenceEngine(EngineConfig(
+        model=tiny_config(dtype=jnp.float32, max_context_len=512),
+        num_pages=128, page_size=16, hash_block_size=32,
+        max_batch_size=kw.pop("max_batch_size", 2), max_seq_len=512,
+        prefill_buckets=(32, 64, 512), speculate_k=speculate_k, **kw))
+
+
+class Collector:
+    def __init__(self):
+        self.outputs: list[RequestOutput] = []
+        self.done = threading.Event()
+
+    def __call__(self, out: RequestOutput) -> None:
+        self.outputs.append(out)
+        if out.finished:
+            self.done.set()
+
+    @property
+    def tokens(self):
+        return [t for o in self.outputs for s in o.outputs
+                for t in s.token_ids]
+
+    @property
+    def finish_reason(self):
+        for o in self.outputs:
+            for s in o.outputs:
+                if s.finish_reason:
+                    return s.finish_reason
+        return ""
+
+
+def run_all(engine, reqs, max_steps=800):
+    cols = []
+    for r in reqs:
+        engine.submit(r)
+        cols.append(r.on_output)
+    for _ in range(max_steps):
+        if all(c.done.is_set() for c in cols):
+            break
+        engine.step()
+    assert all(c.done.is_set() for c in cols)
+    return cols
+
+
+def greedy_req(sid, prompt, n=32, **kw):
+    col = Collector()
+    return EngineRequest(sid, token_ids=prompt,
+                         sampling=SamplingParams(max_tokens=n,
+                                                 temperature=0.0,
+                                                 ignore_eos=True, **kw),
+                         on_output=col)
+
+
+REPETITIVE = [5, 6, 7, 8] * 10
+VARIED = [(i * 13 + 2) % 400 + 10 for i in range(40)]
+
+
+class TestSpeculativeDecoding:
+    def test_greedy_identical_to_normal(self):
+        base = run_all(make_engine(0), [greedy_req("a", REPETITIVE),
+                                        greedy_req("b", VARIED)])
+        spec = run_all(make_engine(4), [greedy_req("a", REPETITIVE),
+                                        greedy_req("b", VARIED)])
+        for b, s in zip(base, spec):
+            assert s.tokens == b.tokens
+
+    def test_spec_path_actually_used_and_accepts(self):
+        engine = make_engine(4)
+        calls = {"n": 0}
+        real = engine._spec_verify
+
+        def spy(*a):
+            calls["n"] += 1
+            return real(*a)
+
+        engine._spec_verify = spy
+        (col,) = run_all(engine, [greedy_req("a", REPETITIVE, n=96)])
+        assert len(col.tokens) == 96
+        # Fewer verify calls than tokens -> drafts were accepted.
+        assert 0 < calls["n"] < 96
+
+    def test_stop_token_respected(self):
+        base_engine = make_engine(0)
+        (b,) = run_all(base_engine, [greedy_req("a", REPETITIVE, n=8)])
+        stop_tok = b.tokens[3]
+        col = Collector()
+        req = EngineRequest(
+            "s", token_ids=REPETITIVE,
+            sampling=SamplingParams(max_tokens=32, temperature=0.0,
+                                    stop_token_ids=[stop_tok],
+                                    ignore_eos=True),
+            on_output=col)
+        run_all(make_engine(4), [req])
+        assert col.finish_reason == "stop"
+        assert col.tokens == b.tokens[:4]
+
+    def test_sampling_request_uses_normal_path(self):
+        engine = make_engine(4)
+        calls = {"n": 0}
+        real = engine._spec_verify
+
+        def spy(*a):
+            calls["n"] += 1
+            return real(*a)
+
+        engine._spec_verify = spy
+        col = Collector()
+        req = EngineRequest(
+            "s", token_ids=VARIED,
+            sampling=SamplingParams(max_tokens=8, temperature=0.8, seed=7,
+                                    ignore_eos=True),
+            on_output=col)
+        run_all(engine, [req])
+        assert calls["n"] == 0
+        assert len(col.tokens) == 8
+
+    def test_budget_respected(self):
+        """Spec can emit up to K+1 tokens per cycle; the budget cut must
+        still be exact."""
+        (c,) = run_all(make_engine(4), [greedy_req("a", REPETITIVE, n=5)])
+        assert len(c.tokens) == 5
+        assert c.finish_reason == "length"

@@ -1069,7 +1069,12 @@ def main() -> None:
         from ..models import loader as _loader
         from ..parallel.mesh import build_mesh as _build_mesh
 
-        mesh = _build_mesh(ecfg.mesh) if ecfg.mesh else None
+        # Slice to exactly the devices the mesh asks for (matches
+        # InferenceEngine's own construction; hosts may expose more).
+        mesh = _build_mesh(
+            ecfg.mesh,
+            devices=jax.devices()[:ecfg.mesh.num_devices()]) \
+            if ecfg.mesh else None
         fam = _models.get_model_family(ecfg.model_family)
         if list(Path(args.checkpoint_path).glob("*.safetensors")):
             params = _loader.load_hf_llama_safetensors(

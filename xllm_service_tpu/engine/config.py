@@ -42,6 +42,16 @@ class EngineConfig:
     # still a win locally). Tokens past a stop condition within a horizon
     # are discarded on the host.
     decode_horizon: int = 1
+    # Speculative decoding (prompt-lookup / n-gram drafts, verified in one
+    # batched multi-token forward; greedy-exact). 0 disables. Used only
+    # when every running sequence is greedy with no penalties/logprobs —
+    # otherwise the engine silently runs the normal decode path.
+    # Known limitation: the verify forward currently runs the XLA
+    # gather-based prefill attention, which materializes each slot's full
+    # gathered K/V — sized for moderate batch*context products; the paged
+    # multi-query Pallas kernel for verify is TPU follow-up work.
+    speculate_k: int = 0
+    speculate_ngram: int = 3
     # Sequence/context parallelism (SURVEY.md §5.7): when the engine's mesh
     # has a `seq` axis of size > 1, uncached prompts whose suffix is at
     # least this many tokens prefill with ring attention sharded over that

@@ -136,6 +136,11 @@ class _Sequence:
     finished: bool = False
     cancelled: bool = False
     logprobs: list[LogProb] = field(default_factory=list)
+    # Speculative decoding: incremental n-gram -> continuation-position
+    # index over (prompt + generated); draft proposal stays O(ngram) per
+    # cycle instead of rescanning the whole history.
+    ngram_map: Optional[dict] = None
+    ngram_indexed: int = 0
 
 
 class InferenceEngine:
@@ -384,6 +389,64 @@ class InferenceEngine:
         # the mesh actually has a seq axis to shard over.
         self._prefill_install_sp = (
             make_prefill_install(True) if self.seq_parallel > 1 else None)
+
+        self._spec_verify = None
+        if cfg.speculate_k > 0 and fam.verify_forward is not None:
+            Kd = cfg.speculate_k
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def spec_verify(params, d, drafts):
+                """Speculative verify: one forward over [last ‖ drafts]
+                per slot against the paged cache; accepts the longest
+                draft prefix matching the model's own greedy predictions
+                plus one correction/bonus token (greedy-exact).
+
+                drafts: [B, Kd] int32, -1 where no draft exists (never
+                matches an argmax, so such slots emit exactly the normal
+                decode token). Returns packed [B, 1+Kd+1]:
+                [accept_len, emitted tokens (acc+1 valid)].
+                """
+                tokens = jnp.concatenate([d["last"][:, None], drafts],
+                                         axis=1)            # [B, Kd+1]
+                prefix = jnp.maximum(d["clens"] - 1, 0)
+                positions = prefix[:, None] + jnp.arange(
+                    Kd + 1, dtype=jnp.int32)[None, :]
+                seq_lens = jnp.where(d["active"], Kd + 1, 0)
+                logits, kv = fam.verify_forward(
+                    params, mcfg, tokens, positions, d["kv"], d["pt"],
+                    prefix, seq_lens)
+                d = dict(d, kv=kv)
+                preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                match = (drafts == preds[:, :Kd]).astype(jnp.int32)
+                acc = jnp.cumprod(match, axis=1).sum(axis=1)   # [B]
+                # Emitted tokens are preds[:, :acc+1] (accepted drafts ==
+                # their predictions; position acc holds the correction).
+                steps = jnp.arange(Kd + 1, dtype=jnp.int32)[None, :]
+                emit_mask = (steps <= acc[:, None]) & d["active"][:, None]
+                # Device-side stop freeze (mirrors decode_multi): truncate
+                # acceptance at the first emitted stop token.
+                is_stop = jnp.any(
+                    preds[:, :, None] == d["stop_ids"][:, None, :], axis=-1)
+                stop_hit = emit_mask & is_stop
+                any_stop = jnp.any(stop_hit, axis=1)
+                first_stop = jnp.argmax(stop_hit, axis=1)
+                acc = jnp.where(any_stop, jnp.minimum(acc, first_stop), acc)
+                n_emit = acc + 1
+                last_tok = jnp.take_along_axis(
+                    preds, acc[:, None], axis=1)[:, 0]
+                advance = d["active"] & ~any_stop
+                d["last"] = jnp.where(advance, last_tok, d["last"])
+                d["clens"] = jnp.where(advance, d["clens"] + n_emit,
+                                       d["clens"])
+                d["active"] = advance
+                packed = jnp.concatenate([acc[:, None], preds], axis=1)
+                return d, packed
+
+            self._spec_verify = spec_verify
+        elif cfg.speculate_k > 0:
+            logger.warning("model family %s has no verify_forward; "
+                           "speculative decoding disabled",
+                           cfg.model_family)
 
         @partial(jax.jit, donate_argnums=(0,))
         def clear_slot(d, slot):
@@ -1066,6 +1129,8 @@ class InferenceEngine:
     def _decode(self) -> bool:
         if not self._running:
             return False
+        if self._spec_verify is not None and self._spec_eligible():
+            return self._decode_speculative()
         # Bound the horizon by the shortest remaining token budget among
         # running sequences so we never burn a whole horizon of discarded
         # tokens on a nearly-done sequence. Rounded DOWN to a power of two:
@@ -1098,6 +1163,79 @@ class InferenceEngine:
                     packed_np[h, slot, 2 + K:].astype(np.int64),
                     seq.req.sampling)
                 self._emit_token(seq, token, lp)
+        return True
+
+    # ----------------------------------------------- speculative decoding
+    def _spec_eligible(self) -> bool:
+        """The verify program is greedy-exact only for plain greedy
+        sampling: every running sequence must be temperature-0 with no
+        penalties and no logprobs, else this step uses the normal path."""
+        for seq in self._running.values():
+            sp = seq.req.sampling
+            if (seq.finished or sp.temperature != 0.0 or sp.logprobs
+                    or sp.frequency_penalty != 0.0
+                    or sp.presence_penalty != 0.0
+                    or sp.repetition_penalty not in (0.0, 1.0)):
+                return False
+        return True
+
+    def _propose_drafts(self, seq: _Sequence) -> list[int]:
+        """Prompt-lookup drafts: continuation of the most recent earlier
+        occurrence of the trailing n-gram in (prompt + generated). The
+        n-gram index is maintained incrementally — proposal is O(ngram +
+        new tokens) per cycle, not a rescan of the whole history (which at
+        32k contexts would cost more host time than the verify step)."""
+        K, n = self.cfg.speculate_k, self.cfg.speculate_ngram
+        hist = seq.req.token_ids + seq.output_ids
+        if len(hist) <= n:
+            return []
+        if seq.ngram_map is None:
+            seq.ngram_map = {}
+            seq.ngram_indexed = 0
+        # Index n-grams whose continuation position is strictly before the
+        # tail (the tail itself must match an EARLIER occurrence).
+        upto = len(hist) - n - 1
+        for p in range(seq.ngram_indexed, upto):
+            seq.ngram_map[tuple(hist[p:p + n])] = p + n
+        seq.ngram_indexed = max(seq.ngram_indexed, upto)
+        pos = seq.ngram_map.get(tuple(hist[-n:]))
+        if pos is None:
+            return []
+        return hist[pos:pos + K]
+
+    def _decode_speculative(self) -> bool:
+        """One propose+verify cycle: up to speculate_k+1 tokens per
+        sequence per device roundtrip (vs 1/step on the normal path)."""
+        K = self.cfg.speculate_k
+        B = self.cfg.max_batch_size
+        drafts = np.full((B, K), -1, np.int32)   # -1: never accepted
+        for slot, seq in self._running.items():
+            if seq.finished:
+                continue
+            d = self._propose_drafts(seq)
+            drafts[slot, :len(d)] = d
+        n_seqs = sum(1 for s in self._running.values() if not s.finished)
+        t0 = time.monotonic()
+        self._dstate, packed = self._spec_verify(
+            self.params, self._dstate, jnp.asarray(drafts))
+        out = np.asarray(packed)                 # [B, 1 + K + 1]
+        elapsed = time.monotonic() - t0
+
+        emitted = 0
+        for slot, seq in list(self._running.items()):
+            if seq.finished:
+                continue
+            acc = int(out[slot, 0])
+            for i in range(acc + 1):
+                if seq.finished:
+                    break
+                token = int(out[slot, 1 + i])
+                seq.context_len += 1
+                emitted += 1
+                self._emit_token(seq, token, None)
+        per_seq = emitted / max(1, n_seqs)
+        self.recent_max_tbt_ms = max(
+            self.recent_max_tbt_ms, elapsed * 1000 / max(1.0, per_seq))
         return True
 
     # ----------------------------------------------------------- emission

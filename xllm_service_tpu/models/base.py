@@ -82,6 +82,10 @@ class ModelFamily:
     prefill_forward: Callable[..., Any]
     decode_forward: Callable[..., Any]
     sharding_rules: Any = None
+    # Optional speculative-decoding verify: forward over a short
+    # multi-token block returning per-position logits [B, S, V]. Families
+    # without it simply never take the speculative path.
+    verify_forward: Optional[Callable[..., Any]] = None
 
 
 _REGISTRY: dict[str, ModelFamily] = {}

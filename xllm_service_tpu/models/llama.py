@@ -128,6 +128,7 @@ def prefill_from_embeddings(params: Params, cfg: ModelConfig,
                             x: jax.Array, positions: jax.Array,
                             kv_pages: jax.Array, page_table: jax.Array,
                             prefix_lens: jax.Array, seq_lens: jax.Array,
+                            all_logits: bool = False,
                             ) -> tuple[jax.Array, jax.Array]:
     """Prefill body over precomputed input embeddings (multimodal families
     splice visual tokens before calling this).
@@ -136,6 +137,10 @@ def prefill_from_embeddings(params: Params, cfg: ModelConfig,
     `dynamic_update_index_in_dim` KV writebacks — with the KV pool donated,
     XLA updates it in place. (A `lax.scan` whose ys re-stack the pool
     copies the entire KV cache every call — measured ~2x decode cost.)
+
+    all_logits=True returns logits for EVERY position [B, S, V] (the
+    speculative-decoding verify path needs per-position predictions);
+    default returns only the last valid token's [B, V].
     """
 
     def layer_body(l, x, k_pages, v_pages):
@@ -156,10 +161,29 @@ def prefill_from_embeddings(params: Params, cfg: ModelConfig,
         x, k_pages, v_pages = layer_body(l, x, kv_pages[l, 0], kv_pages[l, 1])
         kv_pages = jax.lax.dynamic_update_index_in_dim(
             kv_pages, jnp.stack([k_pages, v_pages]), l, 0)
+    if all_logits:
+        return _unembed(params, cfg, x), kv_pages
     # Last valid token's hidden state per row.
     idx = jnp.maximum(seq_lens - 1, 0)
     last = x[jnp.arange(x.shape[0]), idx]
     return _unembed(params, cfg, last), kv_pages
+
+
+def verify_forward(params: Params, cfg: ModelConfig,
+                   tokens: jax.Array,        # [B, S] block to verify
+                   positions: jax.Array,     # [B, S]
+                   kv_pages: jax.Array, page_table: jax.Array,
+                   prefix_lens: jax.Array,   # [B] KV already in cache
+                   seq_lens: jax.Array,      # [B] valid block lengths
+                   ) -> tuple[jax.Array, jax.Array]:
+    """Speculative-decoding verify: one forward over a short multi-token
+    block per sequence (last accepted token + draft tokens), returning
+    logits at EVERY block position [B, S, V] + updated KV. Structurally a
+    batched mini-prefill against the paged cache."""
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    return prefill_from_embeddings(params, cfg, x, positions, kv_pages,
+                                   page_table, prefix_lens, seq_lens,
+                                   all_logits=True)
 
 
 def decode_forward(params: Params, cfg: ModelConfig,
@@ -199,4 +223,5 @@ register_model_family(ModelFamily(
     prefill_forward=prefill_forward,
     decode_forward=decode_forward,
     sharding_rules=LLAMA_STACKED_RULES,
+    verify_forward=verify_forward,
 ))

@@ -14,6 +14,7 @@ from .llama import (
     decode_forward,
     init_params,
     prefill_forward,
+    verify_forward,
 )
 
 
@@ -37,4 +38,5 @@ register_model_family(ModelFamily(
     prefill_forward=prefill_forward,
     decode_forward=decode_forward,
     sharding_rules=LLAMA_STACKED_RULES,
+    verify_forward=verify_forward,
 ))

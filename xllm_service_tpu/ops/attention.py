@@ -155,12 +155,13 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # Context-parallel path: ring attention over the seq mesh axis.
         # Queries past seq_lens are end-padding; causal masking keeps them
         # out of every valid query's window and the engine discards their
-        # outputs, so the pure-causal ring is exact here.
+        # outputs, so the pure-causal ring is exact here. K/V go in at
+        # their GQA head count — the ring repeats them only at use, so the
+        # ppermute traffic stays n_rep times smaller.
         from .ring_attention import ring_attention
 
         mesh, seq_axis = sp
-        return ring_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
-                              mesh, seq_axis=seq_axis)
+        return ring_attention(q, k, v, mesh, seq_axis=seq_axis, scale=scale)
 
     kf = _repeat_kv(k, n_rep).astype(jnp.float32)
     vf = _repeat_kv(v, n_rep).astype(jnp.float32)

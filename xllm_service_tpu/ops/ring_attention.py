@@ -27,12 +27,18 @@ _NEG_INF = -1e30
 
 
 def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
-                          axis_name: str) -> jax.Array:
-    """Per-device body. q/k/v: [B, S_loc, H, hd] (local chunks)."""
+                          axis_name: str,
+                          scale: float | None = None) -> jax.Array:
+    """Per-device body. q: [B, S_loc, H, hd]; k/v: [B, S_loc, H_kv, hd]
+    (GQA: H_kv may divide H — K/V rotate around the ring at their small
+    head count and are repeated only at use, so ICI traffic stays at the
+    KV size, not the query size)."""
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, S, H, hd = q.shape
-    scale = 1.0 / (hd ** 0.5)
+    n_rep = H // k.shape[2]
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
     qf = q.astype(jnp.float32) * scale
 
     rows = jnp.arange(S)[:, None]
@@ -41,7 +47,9 @@ def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     def hop(carry, step):
         k_cur, v_cur, m, l, acc = carry
         src = (my_idx - step) % n        # which chunk is visiting
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32))
+        k_use = jnp.repeat(k_cur, n_rep, axis=2) if n_rep > 1 else k_cur
+        v_use = jnp.repeat(v_cur, n_rep, axis=2) if n_rep > 1 else v_cur
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_use.astype(jnp.float32))
         # Causal structure across chunks.
         intra = jnp.where(cols <= rows, 0.0, _NEG_INF)       # same chunk
         full = jnp.zeros((S, S), jnp.float32)                # earlier chunk
@@ -60,7 +68,7 @@ def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         # acc: [B, S, H, hd]; alpha: [B, H, S, 1] -> align axes.
         alpha_b = jnp.swapaxes(alpha[..., 0], 1, 2)[..., None]  # [B, S, H, 1]
         acc_new = acc * alpha_b + jnp.swapaxes(
-            jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)),
+            jnp.einsum("bhqk,bkhd->bhqd", p, v_use.astype(jnp.float32)),
             1, 2)
         # Rotate K/V to the next device on the ring.
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -84,11 +92,14 @@ def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   mesh: Mesh, seq_axis: str = "seq") -> jax.Array:
-    """q/k/v: [B, S, H, hd] with S divisible by the seq-axis size; returns
-    causal self-attention output, sequence-parallel over `seq_axis`."""
+                   mesh: Mesh, seq_axis: str = "seq",
+                   scale: float | None = None) -> jax.Array:
+    """q: [B, S, H, hd], k/v: [B, S, H_kv, hd] (H_kv | H for GQA) with S
+    divisible by the seq-axis size; returns causal self-attention output,
+    sequence-parallel over `seq_axis`."""
     spec = P(None, seq_axis, None, None)
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=seq_axis),
+        functools.partial(_ring_attention_local, axis_name=seq_axis,
+                          scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
