@@ -396,18 +396,35 @@ class XllmHttpService:
     async def handle_generations(self, request: web.Request) -> web.Response:
         """Batched generation deltas (reference `Generations` RPC,
         `rpc_service/service.cpp:149-215`). Response tells the engine which
-        requests are dead so it can stop generating them."""
+        requests are dead so it can stop generating them.
+
+        This is the service plane's hottest ingest loop: the whole batch is
+        parsed and dispatched in ONE executor hop (an await per delta would
+        serialize the event loop against the worker pool), and the wire
+        format may be msgpack (binary, the engine agent's default — the
+        reference uses batched protobuf here for the same reason) or JSON.
+        """
+        body = await request.read()
         try:
-            payload = await request.json()
-        except json.JSONDecodeError:
-            return _error_response(400, "invalid JSON")
-        results: dict[str, bool] = {}
-        loop = asyncio.get_running_loop()
-        for gen in payload.get("gens", ()):
-            out = RequestOutput.from_dict(gen)
-            alive = await loop.run_in_executor(
-                None, self.scheduler.handle_generation, out)
-            results[out.service_request_id] = alive
+            if request.content_type == "application/msgpack":
+                import msgpack
+
+                payload = msgpack.unpackb(body, raw=False)
+            else:
+                payload = json.loads(body)
+        except Exception:  # noqa: BLE001 — malformed body
+            return _error_response(400, "invalid payload")
+
+        def ingest_batch() -> dict[str, bool]:
+            results: dict[str, bool] = {}
+            for gen in payload.get("gens", ()):
+                out = RequestOutput.from_dict(gen)
+                results[out.service_request_id] = \
+                    self.scheduler.handle_generation(out)
+            return results
+
+        results = await asyncio.get_running_loop().run_in_executor(
+            None, ingest_batch)
         return web.json_response({"ok": True, "alive": results})
 
     async def handle_instance_info(self, request: web.Request) -> web.Response:
