@@ -308,3 +308,73 @@ class TestTokenizers:
         tok = TokenizerFactory.create_tokenizer(str(tmp_path))
         assert tok.encode("hello world") == [0, 1]
         assert tok.vocab_size() == 3
+
+
+class TestTokenizerArgs:
+    """Full TokenizerArgs surface (reference tokenizer_args.{h,cpp})."""
+
+    def _write_cfg(self, tmp_path, **extra):
+        cfg = {
+            "add_bos_token": True,
+            "bos_token": {"content": "<s>"},
+            "eos_token": "</s>",
+            "pad_token": "<pad>",
+            "tokenizer_class": "TikTokenTokenizer",
+            "chat_template": "CFG-TEMPLATE",
+            **extra,
+        }
+        (tmp_path / "tokenizer_config.json").write_text(json.dumps(cfg))
+
+    def test_args_loaded_from_config(self, tmp_path):
+        self._write_cfg(tmp_path, added_tokens_decoder={
+            "100": {"content": "<|eot|>"}, "101": {"content": "<|pad|>"}})
+        args = TokenizerFactory.load_args(str(tmp_path))
+        assert args.add_bos_token is True
+        assert args.bos_token == "<s>"        # dict .content form
+        assert args.eos_token == "</s>"       # plain string form
+        assert args.pad_token == "<pad>"
+        assert args.tokenizer_class == "TikTokenTokenizer"
+        assert ("<|eot|>", 100) in args.special_tokens
+        assert args.chat_template == "CFG-TEMPLATE"
+
+    def test_chat_template_json_takes_priority(self, tmp_path):
+        self._write_cfg(tmp_path)
+        (tmp_path / "chat_template.json").write_text(
+            json.dumps({"chat_template": "FILE-TEMPLATE"}))
+        args = TokenizerFactory.load_args(str(tmp_path))
+        assert args.chat_template == "FILE-TEMPLATE"
+        assert TokenizerFactory.load_chat_template(str(tmp_path)) == \
+            "FILE-TEMPLATE"
+
+    def test_tiktoken_with_pattern_specials_and_prefix(self, tmp_path):
+        vocab = {b"a": 0, b"b": 1, b"c": 2, b" ": 5, b"ab": 3, b"abc": 4,
+                 b"ab ": 6}
+        lines = "\n".join(
+            f"{base64.b64encode(k).decode()} {v}" for k, v in vocab.items())
+        (tmp_path / "m.tiktoken").write_text(lines)
+        self._write_cfg(
+            tmp_path,
+            tokenizer_type="tiktoken",
+            # \p{L} word-property split: needs the `regex` module (re2 in
+            # the reference); trailing-space run NOT merged across words.
+            pattern=r"\p{L}+|\s+",
+            prefix_tokens=["<|bos|>"],
+            added_tokens_decoder={"100": {"content": "<|eot|>"},
+                                  "101": {"content": "<|bos|>"}})
+        tok = TokenizerFactory.create_tokenizer(str(tmp_path))
+        assert isinstance(tok, TiktokenTokenizer)
+        # Prefix token id prepended; pattern splits words so "ab " cannot
+        # merge across the word boundary (id 6 unused).
+        assert tok.encode("ab ab") == [101, 3, 5, 3]
+        assert tok.encode("ab<|eot|>c") == [101, 3, 100, 2]
+        # Without the pattern the space WOULD merge into "ab ".
+        plain = TiktokenTokenizer(tmp_path / "m.tiktoken")
+        assert plain.encode("ab ab") == [6, 3]
+
+    def test_special_token_without_id_gets_appended(self, tmp_path):
+        (tmp_path / "v.tiktoken").write_text(
+            base64.b64encode(b"a").decode() + " 0")
+        tok = TiktokenTokenizer(tmp_path / "v.tiktoken",
+                                special_tokens={"<|x|>": -1})
+        assert tok.token_to_id("<|x|>") == 1   # max rank + 1
+        assert tok.vocab_size() == 2

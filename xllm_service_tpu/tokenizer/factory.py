@@ -50,30 +50,61 @@ class HFTokenizer(Tokenizer):
 
 class TokenizerFactory:
     @staticmethod
-    def create_tokenizer(tokenizer_path: str = "") -> Tokenizer:
+    def load_args(tokenizer_path: str = ""):
+        from .args import TokenizerArgs, load_tokenizer_args
+
+        if not tokenizer_path:
+            return TokenizerArgs()
+        return load_tokenizer_args(tokenizer_path)
+
+    @staticmethod
+    def create_tokenizer(tokenizer_path: str = "",
+                         args=None) -> Tokenizer:
+        """Reference selection order (`tokenizer_factory.cpp:9-32`, args
+        loaded first): tokenizer.json → Fast; args say tiktoken (or a
+        *.tiktoken vocab exists) → Tiktoken with pattern/special/prefix
+        tokens from args; else SentencePiece. We add: no path or nothing
+        recognized → hermetic SimpleTokenizer."""
         if not tokenizer_path:
             return SimpleTokenizer()
+        if args is None:
+            args = TokenizerFactory.load_args(tokenizer_path)
         p = Path(tokenizer_path)
         tokenizer_json = p / "tokenizer.json" if p.is_dir() else (
             p if p.name == "tokenizer.json" else None)
         if tokenizer_json is not None and tokenizer_json.exists():
             return HFTokenizer(tokenizer_json)
-        # tiktoken vocab (`*.tiktoken`).
-        if p.is_dir():
-            for cand in p.glob("*.tiktoken"):
-                return TiktokenTokenizer(cand)
-        elif p.suffix == ".tiktoken" and p.exists():
-            return TiktokenTokenizer(p)
+
+        is_tiktoken = (args.tokenizer_type == "tiktoken"
+                       or args.tokenizer_class == "TikTokenTokenizer"
+                       or (p.is_dir() and any(p.glob("*.tiktoken")))
+                       or p.suffix == ".tiktoken")
+        if is_tiktoken:
+            vocab = p
+            if p.is_dir():
+                named = p / args.vocab_file
+                if named.exists() and named.suffix == ".tiktoken":
+                    vocab = named
+            try:
+                return TiktokenTokenizer(
+                    vocab, pattern=args.pattern or None,
+                    special_tokens=dict(args.special_tokens),
+                    prefix_tokens=args.prefix_tokens)
+            except FileNotFoundError:
+                logger.warning("tiktoken requested but no vocab at %s", p)
+
         # sentencepiece model.
-        sp_model = p / "tokenizer.model" if p.is_dir() else (
+        sp_model = p / args.vocab_file if p.is_dir() else (
             p if p.suffix == ".model" else None)
+        if sp_model is not None and not sp_model.exists() and p.is_dir():
+            sp_model = p / "tokenizer.model"
         if sp_model is not None and sp_model.exists():
             try:
                 import sentencepiece  # noqa: F401
 
                 from .sentencepiece_tok import SentencePieceTokenizer
 
-                return SentencePieceTokenizer(sp_model)
+                return SentencePieceTokenizer(sp_model, args=args)
             except ImportError:
                 logger.warning("sentencepiece lib unavailable; "
                                "falling back to SimpleTokenizer")
@@ -83,21 +114,10 @@ class TokenizerFactory:
 
     @staticmethod
     def load_chat_template(tokenizer_path: str) -> Optional[str]:
-        """chat_template from tokenizer_config.json (reference
-        `tokenizer_args.h:30`, parsed by the args loader)."""
+        """chat_template via the args loader (reference
+        `tokenizer_args.cpp:8-28,36-42`: chat_template.json /
+        chat_template.jinja take priority over tokenizer_config.json)."""
         if not tokenizer_path:
             return None
-        cfg = Path(tokenizer_path) / "tokenizer_config.json"
-        if not cfg.exists():
-            return None
-        try:
-            data = json.loads(cfg.read_text())
-        except json.JSONDecodeError:
-            return None
-        tmpl = data.get("chat_template")
-        if isinstance(tmpl, list):  # some models ship multiple named templates
-            for item in tmpl:
-                if item.get("name") == "default":
-                    return item.get("template")
-            return tmpl[0].get("template") if tmpl else None
-        return tmpl
+        return TokenizerFactory.load_args(tokenizer_path).chat_template \
+            or None

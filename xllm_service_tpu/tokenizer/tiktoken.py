@@ -1,19 +1,29 @@
 """Tiktoken-style byte-level BPE.
 
-Parity: reference `tiktoken_tokenizer.cpp` (470 LoC) — BPE over a vocab file
-of `base64(token_bytes) rank` lines with optional special tokens. The
-regex pre-splitting (re2 in the reference) is applied when a pattern is
-provided; otherwise BPE runs over the raw bytes.
+Parity: reference `tiktoken_tokenizer.cpp` (470 LoC) — BPE over a vocab
+file of `base64(token_bytes) rank` lines, with:
+
+- regex pre-splitting (re2 in the reference; the `regex` module here —
+  tiktoken-family patterns use `\\p{L}`-class properties stdlib `re`
+  can't express),
+- special tokens (escaped alternation split, longest-first, so special
+  strings embedded in user text encode to their single ids),
+- prefix tokens prepended to every encode (reference
+  `tiktoken_tokenizer.cpp:63-70`).
 """
 
 from __future__ import annotations
 
 import base64
-import re
 from pathlib import Path
 from typing import Optional, Sequence
 
 from .base import Tokenizer
+
+try:                      # `regex` supports \p{...}; stdlib re does not.
+    import regex as _re
+except ImportError:       # pragma: no cover - regex ships in this image
+    import re as _re
 
 
 def _bpe_merge(piece: bytes, ranks: dict[bytes, int]) -> list[bytes]:
@@ -35,29 +45,63 @@ def _bpe_merge(piece: bytes, ranks: dict[bytes, int]) -> list[bytes]:
 class TiktokenTokenizer(Tokenizer):
     def __init__(self, vocab_path: str | Path,
                  pattern: Optional[str] = None,
-                 special_tokens: dict[str, int] | None = None):
+                 special_tokens: dict[str, int] | None = None,
+                 prefix_tokens: Sequence[str] = ()):
+        """vocab_path: the vocab file, or a model dir (first *.tiktoken
+        inside; pass a TokenizerArgs-driven path from the factory)."""
+        p = Path(vocab_path)
+        if p.is_dir():
+            cands = sorted(p.glob("*.tiktoken"))
+            if not cands:
+                raise FileNotFoundError(f"no *.tiktoken under {p}")
+            p = cands[0]
         self._ranks: dict[bytes, int] = {}
-        for line in Path(vocab_path).read_text().splitlines():
+        for line in p.read_text().splitlines():
             if not line.strip():
                 continue
             tok_b64, _, rank = line.partition(" ")
             self._ranks[base64.b64decode(tok_b64)] = int(rank)
         self._id_to_bytes = {v: k for k, v in self._ranks.items()}
-        self._special = dict(special_tokens or {})
+
+        # Special tokens not given explicit ids get sequential ids after
+        # the vocab (reference `load_special_tokens`,
+        # `tiktoken_tokenizer.cpp:79-96`).
+        self._special: dict[str, int] = {}
+        next_id = (max(self._ranks.values()) + 1) if self._ranks else 0
+        for tok, tid in (special_tokens or {}).items():
+            if tid is None or tid < 0:
+                tid = next_id
+                next_id += 1
+            self._special[tok] = int(tid)
+            next_id = max(next_id, int(tid) + 1)
         self._special_by_id = {v: k for k, v in self._special.items()}
-        self._pattern = re.compile(pattern) if pattern else None
+
+        self._pattern = _re.compile(pattern) if pattern else None
         if self._special:
-            self._special_split = re.compile(
-                "(" + "|".join(re.escape(t) for t in sorted(
+            # Longest-first alternation: overlapping specials resolve to
+            # the longest match (reference escapes + joins with "|").
+            self._special_split = _re.compile(
+                "(" + "|".join(_re.escape(t) for t in sorted(
                     self._special, key=len, reverse=True)) + ")")
         else:
             self._special_split = None
+        # Prefix token ids prepended to every encode (reference
+        # `tiktoken_tokenizer.cpp:63-70`).
+        self._prefix_ids: list[int] = []
+        for tok in prefix_tokens:
+            tid = self.token_to_id(tok)
+            if tid is not None:
+                self._prefix_ids.append(tid)
 
     def _encode_ordinary(self, text: str) -> list[int]:
         out: list[int] = []
         chunks = (self._pattern.findall(text) if self._pattern else [text])
         for chunk in chunks:
+            if not isinstance(chunk, str):   # groups in user patterns
+                chunk = next((c for c in chunk if c), "")
             data = chunk.encode("utf-8")
+            if not data:
+                continue
             rank = self._ranks.get(data)
             if rank is not None:
                 out.append(rank)
@@ -67,9 +111,10 @@ class TiktokenTokenizer(Tokenizer):
         return out
 
     def encode(self, text: str) -> list[int]:
+        out: list[int] = list(self._prefix_ids)
         if not self._special_split:
-            return self._encode_ordinary(text)
-        out: list[int] = []
+            out.extend(self._encode_ordinary(text))
+            return out
         for part in self._special_split.split(text):
             if not part:
                 continue
