@@ -145,6 +145,26 @@ class TestPDDisaggregation:
         assert decode.kv_device_received >= 1
         assert decode.kv_host_received == 0
 
+    def test_unlinked_peer_handoff_rejected(self, pd_cluster):
+        """The link-time KV-layout gate only protects if the transfer
+        itself enforces the link: a handoff from an unlinked sender must
+        be refused."""
+        import msgpack as _mp
+
+        _, _, decode = pd_cluster
+        msg = _mp.packb({
+            "service_request_id": "rogue-1", "request_id": "rogue-1",
+            "source_service_addr": "127.0.0.1:1", "token_ids": [1, 2, 3],
+            "first_token": 1, "sampling": {},
+            "source_instance": "127.0.0.1:59999",   # never linked
+            "kv": {"bytes": b"", "shape": [0], "dtype": "float32"},
+        }, use_bin_type=True)
+        r = requests.post(f"http://{decode.name}/rpc/kv_transfer",
+                          data=msg,
+                          headers={"Content-Type": "application/msgpack"},
+                          timeout=30)
+        assert r.status_code == 403
+
     def test_decode_kv_transfer_populates_prefix_cache(self, pd_cluster):
         master, prefill, decode = pd_cluster
         requests.post(_base(master) + "/v1/completions",

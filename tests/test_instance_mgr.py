@@ -273,11 +273,21 @@ class TestSlo:
             link_peers=False)
         req = Request(service_request_id="s1", token_ids=list(range(128)))
         r = mgr.select_instance_pair_on_slo(req)
-        # One of the idle prefills should have been flipped to decode duty.
+        # The request itself routes to the (overloaded) existing decode —
+        # the flip must NOT run on the request path (no engine RPC inside
+        # schedule); it is queued for the reconcile thread.
+        assert r.decode_name == "d1"
+        assert not any("DECODE" in ch.flips
+                       for ch in FakeChannel.registry.values())
+        mgr.reconcile_once()   # reconcile performs the queued flip
         flipped = [n for n, ch in FakeChannel.registry.items()
                    if "DECODE" in ch.flips]
-        assert flipped and r.decode_name in flipped
+        assert flipped
         assert mgr.get_instance_meta(flipped[0]).type == InstanceType.DECODE
+        # Subsequent requests can now use the flipped decode capacity.
+        r2 = mgr.select_instance_pair_on_slo(
+            Request(service_request_id="s2", token_ids=list(range(128))))
+        assert r2.decode_name == flipped[0]
         mgr.stop()
 
     def test_request_metrics_accounting(self, coord):

@@ -45,16 +45,20 @@ logger = get_logger(__name__)
 
 
 def pack_handoff(h: PrefillHandoff, source_service_addr: str,
-                 kv_ref: Optional[dict] = None) -> bytes:
+                 kv_ref: Optional[dict] = None,
+                 source_instance: str = "") -> bytes:
     """Serialize a PD handoff control message. With `kv_ref` (device
     transfer path) the KV stays on device and only the pull descriptor is
     sent; otherwise the blob is downloaded and carried inline (DCN host
-    path; msgpack + raw array bytes, bf16 as ml_dtypes bytes)."""
+    path; msgpack + raw array bytes, bf16 as ml_dtypes bytes).
+    `source_instance` identifies the sending prefill instance — the decode
+    side only accepts handoffs from linked peers."""
     lp = h.first_logprob
     msg: dict[str, Any] = {
         "service_request_id": h.service_request_id,
         "request_id": h.request_id,
         "source_service_addr": source_service_addr,
+        "source_instance": source_instance,
         "token_ids": h.token_ids,
         "first_token": h.first_token,
         "first_logprob": None if lp is None else {
@@ -788,7 +792,8 @@ class EngineAgent:
             try:
                 desc = self.kv_transfer.offer(
                     h.service_request_id, h.kv_blob, self.incarnation_id)
-                self._post_handoff(peer, pack_handoff(h, dest, kv_ref=desc))
+                self._post_handoff(peer, pack_handoff(
+                    h, dest, kv_ref=desc, source_instance=self.name))
                 self.kv_transfer.release(desc["uuid"])
                 self.kv_device_sent += 1
                 return
@@ -799,7 +804,8 @@ class EngineAgent:
                     "device KV transfer of %s to %s failed (%s); falling "
                     "back to host path", h.service_request_id, peer, e)
         try:
-            self._post_handoff(peer, pack_handoff(h, dest))
+            self._post_handoff(peer, pack_handoff(
+                h, dest, source_instance=self.name))
             self.kv_host_sent += 1
         except Exception as e:  # noqa: BLE001
             logger.warning("KV transfer of %s to %s failed: %s",
@@ -874,6 +880,15 @@ class EngineAgent:
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": f"bad handoff: {e}"},
                                      status=400)
+        # Enforce the P-D link on the transfer itself (the link-time
+        # KV-layout gate protects nothing if any peer can push a handoff;
+        # reference analog: transfers ride endpoints negotiated by Link
+        # ops, `instance_mgr.cpp:1087-1113`).
+        src = obj.get("source_instance", "")
+        if src not in self.linked_peers:
+            return web.json_response(
+                {"error": f"instance {src or '<unknown>'} is not a linked "
+                          "peer; rejecting KV handoff"}, status=403)
         sid = obj.get("service_request_id", "")
         now = time.monotonic()
         for k, ts in list(self._handoffs_seen.items()):

@@ -111,6 +111,9 @@ class InstanceMgr:
         self._rr_prefill = 0
         self._rr_decode = 0
         self._rr_encode = 0
+        # Pending async role flips (performed by the reconcile thread).
+        self._flip_lock = threading.Lock()
+        self._pending_flips: dict[str, InstanceType] = {}
         # L2: metrics.
         self._metrics_lock = threading.Lock()
         self._load_metrics: dict[str, LoadMetrics] = {}
@@ -416,6 +419,9 @@ class InstanceMgr:
                         to_evict.append(name)
         for name in to_evict:
             self.deregister_instance(name, reason="suspect eviction")
+        # SLO role flips requested on the scheduling path run here, off
+        # the client's critical path.
+        self.drain_pending_flips()
 
     # ------------------------------------------------------ scheduling reads
     def get_next_instance_pair(self) -> Routing:
@@ -619,9 +625,12 @@ class InstanceMgr:
                 break
 
         if chosen_decode is None:
-            # 3) overloaded decode fleet: flip an idle prefill to decode
-            # (reference P→D flip when no decode meets TPOT target,
-            # `instance_mgr.cpp:1023-1063`), then fall back least-loaded.
+            # 3) overloaded decode fleet: REQUEST a P→D flip of an idle
+            # prefill (reference `instance_mgr.cpp:1023-1063`); the flip's
+            # engine RPC + coordination writes run on the reconcile path —
+            # never on this request path, where a slow engine would stall
+            # the client's TTFT. This request falls back least-loaded; the
+            # flipped capacity serves the ones after it.
             idle_prefill = next(
                 (n for n, _ in prefills
                  if n != best_prefill_name
@@ -630,11 +639,9 @@ class InstanceMgr:
                  and self.get_instance_meta(n).type == InstanceType.PREFILL),
                 None)
             if idle_prefill is not None and len(prefills) > 1:
-                self.flip_instance_role(idle_prefill, InstanceType.DECODE)
-                chosen_decode = idle_prefill
-            else:
-                chosen_decode = min(
-                    decodes, key=lambda it: loads[it[0]].num_decode_tokens)[0]
+                self.request_flip(idle_prefill, InstanceType.DECODE)
+            chosen_decode = min(
+                decodes, key=lambda it: loads[it[0]].num_decode_tokens)[0]
         else:
             # Opportunistic D→P flip when some decode instance is completely
             # idle and prefill queue is deep (reference auto flip at zero
@@ -649,11 +656,27 @@ class InstanceMgr:
                 surplus = sum(1 for n, _ in decodes
                               if loads[n].num_decode_requests == 0)
                 if idle_decode is not None and surplus > 1:
-                    self.flip_instance_role(idle_decode, InstanceType.PREFILL)
+                    self.request_flip(idle_decode, InstanceType.PREFILL)
 
         if chosen_decode == best_prefill_name:
             return Routing(prefill_name=best_prefill_name)
         return Routing(prefill_name=best_prefill_name, decode_name=chosen_decode)
+
+    def request_flip(self, name: str, new_type: InstanceType) -> None:
+        """Enqueue a role flip to be performed by the reconcile thread
+        (engine RPC + coordination writes stay off the request path)."""
+        with self._flip_lock:
+            self._pending_flips[name] = new_type
+
+    def drain_pending_flips(self) -> None:
+        with self._flip_lock:
+            pending = dict(self._pending_flips)
+            self._pending_flips.clear()
+        for name, new_type in pending.items():
+            try:
+                self.flip_instance_role(name, new_type)
+            except Exception:  # noqa: BLE001 — keep the reconcile loop up
+                logger.exception("async role flip of %s failed", name)
 
     def flip_instance_role(self, name: str, new_type: InstanceType) -> bool:
         """Dynamic PD-role switch: tell the engine to swap programs, then
