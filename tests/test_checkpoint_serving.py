@@ -1,0 +1,173 @@
+"""Real-checkpoint serving drill (VERDICT r1 #9, hermetic variant): an
+HF-layout checkpoint directory (safetensors shards + tokenizer.json +
+tokenizer_config.json with chat template and added tokens) is loaded
+through models/loader.py and served end-to-end — client → master (HF
+tokenizer + Jinja template) → engine agent → SSE — exercising the full
+tokenizer-args path with a real (non-Simple) tokenizer."""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+import requests
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.types import InstanceType
+from xllm_service_tpu.coordination.memory import InMemoryCoordination, MemoryStore
+from xllm_service_tpu.engine.agent import AgentConfig, EngineAgent
+from xllm_service_tpu.engine.config import EngineConfig
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.models.base import tiny_config
+from xllm_service_tpu.models.loader import load_hf_llama_safetensors
+from xllm_service_tpu.tokenizer import TokenizerFactory
+from xllm_service_tpu.tokenizer.factory import HFTokenizer
+
+from fakes import wait_until
+from test_loader import make_hf_checkpoint
+
+TEMPLATE = ("{% for message in messages %}{{ message['role'] }} : "
+            "{{ message['content'] }} \n{% endfor %}"
+            "{% if add_generation_prompt %}assistant :{% endif %}")
+
+
+def make_model_dir(tmp_path, cfg):
+    """Checkpoint + HF tokenizer + config, one directory like a real
+    HF model snapshot."""
+    make_hf_checkpoint(tmp_path, cfg)
+
+    from tokenizers import Tokenizer as HFTok
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    words = ["user", "assistant", "system", ":", "hello", "world",
+             "what", "is", "up", "\n", "[UNK]", "<|eot|>"]
+    vocab = {w: i for i, w in enumerate(words)}
+    t = HFTok(WordLevel(vocab, unk_token="[UNK]"))
+    t.pre_tokenizer = Whitespace()
+    t.save(str(tmp_path / "tokenizer.json"))
+
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps({
+        "chat_template": TEMPLATE,
+        "eos_token": {"content": "<|eot|>"},
+        "add_bos_token": False,
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "added_tokens_decoder": {
+            str(vocab["<|eot|>"]): {"content": "<|eot|>"}},
+    }))
+    return tmp_path
+
+
+@pytest.fixture(scope="module")
+def ckpt_cluster(tmp_path_factory):
+    model_dir = make_model_dir(
+        tmp_path_factory.mktemp("model"),
+        tiny_config(dtype=jnp.float32, max_context_len=256))
+    cfg = tiny_config(dtype=jnp.float32, max_context_len=256)
+    store = MemoryStore(expiry_tick_s=0.05)
+    opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                          lease_ttl_s=1.0, sync_interval_s=0.3,
+                          reconcile_interval_s=0.1,
+                          tokenizer_path=str(model_dir))
+    master = Master(opts, coord=InMemoryCoordination(store))
+    master.start()
+    params = load_hf_llama_safetensors(model_dir, cfg)
+    ecfg = EngineConfig(
+        model_id="ckpt-llama", model=cfg,
+        num_pages=64, page_size=16, hash_block_size=32,
+        max_batch_size=4, max_seq_len=256, prefill_buckets=(32, 64, 256))
+    agent = EngineAgent(
+        ecfg,
+        AgentConfig(host="127.0.0.1", model_id="ckpt-llama",
+                    instance_type=InstanceType.MIX,
+                    tokenizer_path=str(model_dir),
+                    heartbeat_interval_s=0.3, lease_ttl_s=1.0),
+        coord=InMemoryCoordination(store), params=params)
+    agent.start()
+    assert wait_until(
+        lambda: master.scheduler.instance_mgr.get_instance_meta(agent.name)
+        is not None, timeout=10)
+    yield master, agent, model_dir
+    agent.stop()
+    master.stop()
+    store.close()
+
+
+class TestCheckpointServing:
+    def test_real_tokenizer_selected(self, ckpt_cluster):
+        master, agent, model_dir = ckpt_cluster
+        assert isinstance(master.scheduler.tokenizer, HFTokenizer)
+        assert isinstance(agent.engine.tokenizer, HFTokenizer)
+        assert TokenizerFactory.load_chat_template(str(model_dir)) == \
+            TEMPLATE
+
+    def test_chat_completion_over_checkpoint(self, ckpt_cluster):
+        master, agent, model_dir = ckpt_cluster
+        base = f"http://127.0.0.1:{master.http_port}"
+        r = requests.post(base + "/v1/chat/completions", json={
+            "model": "ckpt-llama",
+            "messages": [{"role": "user", "content": "hello world"}],
+            "max_tokens": 8, "temperature": 0, "ignore_eos": True,
+        }, timeout=120)
+        assert r.status_code == 200, r.text
+        body = r.json()
+        choice = body["choices"][0]
+        assert choice["finish_reason"] == "length"
+        # Prompt tokenized by the HF tokenizer through the rendered
+        # template: "user : hello world \n assistant :".
+        tok = master.scheduler.tokenizer
+        rendered = master.scheduler.chat_template.apply(
+            [{"role": "user", "content": "hello world"}])
+        assert "user" in rendered and "assistant" in rendered
+        assert body["usage"]["prompt_tokens"] == len(tok.encode(rendered))
+        # Output decodes through the same vocab (WordLevel ids -> words).
+        assert isinstance(choice["message"]["content"], str)
+
+    def test_served_output_matches_direct_forward(self, ckpt_cluster):
+        """The served greedy continuation equals running the loaded
+        checkpoint directly through the engine (weights really came from
+        the safetensors, not random init)."""
+        import threading
+
+        from xllm_service_tpu.common.request import SamplingParams
+        from xllm_service_tpu.engine.engine import (
+            EngineRequest,
+            InferenceEngine,
+        )
+
+        master, agent, model_dir = ckpt_cluster
+        base = f"http://127.0.0.1:{master.http_port}"
+        prompt = "what is up"
+        r = requests.post(base + "/v1/completions", json={
+            "model": "ckpt-llama", "prompt": prompt,
+            "max_tokens": 6, "temperature": 0, "ignore_eos": True,
+        }, timeout=120)
+        assert r.status_code == 200, r.text
+        served_text = r.json()["choices"][0]["text"]
+
+        cfg = tiny_config(dtype=jnp.float32, max_context_len=256)
+        params = load_hf_llama_safetensors(model_dir, cfg)
+        engine = InferenceEngine(
+            EngineConfig(model_id="direct", model=cfg, num_pages=64,
+                         page_size=16, hash_block_size=32, max_batch_size=4,
+                         max_seq_len=256, prefill_buckets=(32, 64, 256)),
+            tokenizer=TokenizerFactory.create_tokenizer(str(model_dir)),
+            params=params)
+        done = threading.Event()
+        texts = []
+
+        def cb(out):
+            texts.extend(s.text for s in out.outputs)
+            if out.finished:
+                done.set()
+
+        engine.submit(EngineRequest(
+            "direct", token_ids=engine.tokenizer.encode(prompt),
+            sampling=SamplingParams(max_tokens=6, temperature=0.0,
+                                    ignore_eos=True),
+            on_output=cb))
+        for _ in range(300):
+            if done.is_set():
+                break
+            engine.step()
+        assert done.is_set()
+        assert "".join(texts) == served_text
