@@ -232,6 +232,15 @@ class TestAdminAndTracing:
             first = json.loads(trace[0])
             assert first["service_request_id"].startswith("completion-")
             assert first["data"]["request"]["prompt"] == "trace me"
+            # Span breakdown emitted at request exit.
+            spans = [json.loads(ln)["data"] for ln in trace
+                     if json.loads(ln)["data"].get("type") == "spans"]
+            assert spans, "no span record in trace"
+            sp = spans[0]
+            assert sp["total_ms"] >= (sp["ttft_ms"] or 0) >= 0
+            assert sp["prompt_tokens"] > 0
+            assert sp["generated_tokens"] > 0
+            assert sp["prefill_instance"] == engine.name
         finally:
             engine.stop()
             master.stop()

@@ -417,7 +417,37 @@ class Scheduler:
             st.finished = True
             st.request.metrics.finish_time_ms = now_ms()
             self._account_request_exit(st.request)
+        self._trace_spans(st)
         return True
+
+    def _trace_spans(self, st: _RequestState) -> None:
+        """Per-request latency span breakdown, appended to the request
+        trace at exit (extends the reference's raw I/O JSONL with timing
+        the SLO predictor can be audited against)."""
+        r = st.request
+        if r.trace_callback is None:
+            return
+        m = r.metrics
+        spans = {
+            "type": "spans",
+            "created_ms": r.created_time_ms,
+            "schedule_delay_ms": (m.schedule_time_ms - r.created_time_ms)
+            if m.schedule_time_ms else None,
+            "ttft_ms": (m.prefill_finish_time_ms - r.created_time_ms)
+            if m.prefill_finish_time_ms else None,
+            "decode_ms": (m.finish_time_ms - m.prefill_finish_time_ms)
+            if m.prefill_finish_time_ms else None,
+            "total_ms": m.finish_time_ms - r.created_time_ms,
+            "estimated_ttft_ms": m.estimated_ttft_ms,
+            "prompt_tokens": m.prompt_tokens,
+            "generated_tokens": r.num_generated_tokens,
+            "prefill_instance": r.routing.prefill_name,
+            "decode_instance": r.routing.decode_name,
+        }
+        try:
+            r.trace_callback(r.service_request_id, spans)
+        except Exception:  # noqa: BLE001 — tracing must never break exit
+            logger.exception("span trace emit failed")
 
     def _account_request_exit(self, req: Request) -> None:
         """Reverse this request's load-accounting increments on any exit
