@@ -23,21 +23,23 @@ from jax.sharding import Mesh
 
 AXIS_DATA = "data"
 AXIS_EXPERT = "expert"
+AXIS_PIPE = "pipe"
 AXIS_SEQ = "seq"
 AXIS_MODEL = "model"
-ALL_AXES = (AXIS_DATA, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+ALL_AXES = (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_MODEL)
 
 
 @dataclass
 class MeshConfig:
     data: int = 1
     expert: int = 1
+    pipe: int = 1
     seq: int = 1
     model: int = 1
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.data, self.expert, self.seq, self.model)
+        return (self.data, self.expert, self.pipe, self.seq, self.model)
 
     def num_devices(self) -> int:
         return int(np.prod(self.shape))
