@@ -355,6 +355,21 @@ class TestRoleFlip:
         assert FakeChannel.registry["i1"].flips == ["DECODE"]
         mgr.stop()
 
+    def test_flip_relinks_for_new_role(self, coord):
+        """A flipped instance must be linked to the peers of its NEW role
+        (the handoff gate rejects unlinked senders, so an unlinked flipped
+        decode would 403 every KV transfer routed to it)."""
+        mgr = make_mgr(coord)
+        mgr.register_instance(make_meta("p1", InstanceType.PREFILL),
+                              link_peers=False)
+        mgr.register_instance(make_meta("p2", InstanceType.PREFILL),
+                              link_peers=False)
+        assert mgr.flip_instance_role("p2", InstanceType.DECODE)
+        # Both directions of every new P<->D pair.
+        assert "p2" in FakeChannel.registry["p1"].links
+        assert "p1" in FakeChannel.registry["p2"].links
+        mgr.stop()
+
     def test_flip_rejected_by_engine(self, coord):
         mgr = make_mgr(coord)
         mgr.register_instance(make_meta("i1", InstanceType.PREFILL),

@@ -135,3 +135,15 @@ class TestSpeculativeDecoding:
         (c,) = run_all(make_engine(4), [greedy_req("a", REPETITIVE, n=5)])
         assert len(c.tokens) == 5
         assert c.finish_reason == "length"
+
+    def test_budget_edge_does_not_corrupt_neighbor(self):
+        """A sequence exhausting its budget mid-verify must not perturb a
+        batch neighbor (overflow writes land in the garbage page / own
+        slack pages, and the verify block is clamped to the remaining
+        budget)."""
+        base = run_all(make_engine(0), [greedy_req("a", REPETITIVE, n=3),
+                                        greedy_req("b", VARIED, n=40)])
+        spec = run_all(make_engine(4), [greedy_req("a", REPETITIVE, n=3),
+                                        greedy_req("b", VARIED, n=40)])
+        for b, s in zip(base, spec):
+            assert s.tokens == b.tokens

@@ -400,7 +400,7 @@ class InferenceEngine:
             Kd = cfg.speculate_k
 
             @partial(jax.jit, donate_argnums=(1,))
-            def spec_verify(params, d, drafts):
+            def spec_verify(params, d, drafts, room):
                 """Speculative verify: one forward over [last ‖ drafts]
                 per slot against the paged cache; accepts the longest
                 draft prefix matching the model's own greedy predictions
@@ -408,7 +408,12 @@ class InferenceEngine:
 
                 drafts: [B, Kd] int32, -1 where no draft exists (never
                 matches an argmax, so such slots emit exactly the normal
-                decode token). Returns packed [B, 1+Kd+1]:
+                decode token). room: [B] int32 — per-slot block bound
+                (<= Kd+1), clamped to the remaining token budget so
+                near-finished sequences neither write nor accept past it
+                (overflow writes would be absorbed by the garbage page,
+                but bounding here avoids the wasted work entirely).
+                Returns packed [B, 1+Kd+1]:
                 [accept_len, emitted tokens (acc+1 valid)].
                 """
                 tokens = jnp.concatenate([d["last"][:, None], drafts],
@@ -416,7 +421,8 @@ class InferenceEngine:
                 prefix = jnp.maximum(d["clens"] - 1, 0)
                 positions = prefix[:, None] + jnp.arange(
                     Kd + 1, dtype=jnp.int32)[None, :]
-                seq_lens = jnp.where(d["active"], Kd + 1, 0)
+                seq_lens = jnp.where(d["active"],
+                                     jnp.minimum(room, Kd + 1), 0)
                 logits, kv = fam.verify_forward(
                     params, mcfg, tokens, positions, d["kv"], d["pt"],
                     prefix, seq_lens)
@@ -424,6 +430,8 @@ class InferenceEngine:
                 preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 match = (drafts == preds[:, :Kd]).astype(jnp.int32)
                 acc = jnp.cumprod(match, axis=1).sum(axis=1)   # [B]
+                # Acceptance bounded by the block room (emit <= room).
+                acc = jnp.minimum(acc, jnp.maximum(seq_lens - 1, 0))
                 # Emitted tokens are preds[:, :acc+1] (accepted drafts ==
                 # their predictions; position acc holds the correction).
                 steps = jnp.arange(Kd + 1, dtype=jnp.int32)[None, :]
@@ -1219,15 +1227,20 @@ class InferenceEngine:
         K = self.cfg.speculate_k
         B = self.cfg.max_batch_size
         drafts = np.full((B, K), -1, np.int32)   # -1: never accepted
+        room = np.ones((B,), np.int32)
         for slot, seq in self._running.items():
             if seq.finished:
                 continue
+            # Block bound: tokens this sequence may still emit this cycle.
+            rem = seq.max_total_len - seq.prompt_len - len(seq.output_ids)
+            room[slot] = max(1, min(K + 1, rem))
             d = self._propose_drafts(seq)
             drafts[slot, :len(d)] = d
         n_seqs = sum(1 for s in self._running.values() if not s.finished)
         t0 = time.monotonic()
         self._dstate, packed = self._spec_verify(
-            self.params, self._dstate, jnp.asarray(drafts))
+            self.params, self._dstate, jnp.asarray(drafts),
+            jnp.asarray(room))
         out = np.asarray(packed)                 # [B, 1 + K + 1]
         elapsed = time.monotonic() - t0
 

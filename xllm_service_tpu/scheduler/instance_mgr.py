@@ -702,6 +702,21 @@ class InstanceMgr:
             entry.meta.type = new_type
             self._index_insert(name, new_type)
             meta_json = entry.meta.to_json()
+            meta = entry.meta
+            chan = entry.channel
+        # Link fan-out for the NEW role (outside locks): a flipped P->D
+        # must be linked to every prefill (and vice versa) or their KV
+        # handoffs get rejected by the linked-peer gate on the decode
+        # side. Best effort — a failed pair falls back at handoff time.
+        for peer in self._link_targets(meta):
+            try:
+                if peer.channel is not None:
+                    peer.channel.link(meta)
+                if chan is not None:
+                    chan.link(peer.meta)
+            except Exception:  # noqa: BLE001
+                logger.exception("post-flip link of %s <-> %s failed",
+                                 name, peer.meta.name)
         # Move the coordination record so replicas converge.
         self._coord.rm(instance_key(old_type.value, name))
         self._coord.set(instance_key(new_type.value, name), meta_json)

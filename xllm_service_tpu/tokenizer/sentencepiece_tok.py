@@ -79,7 +79,11 @@ class SentencePieceTokenizer(Tokenizer):
         return "".join(pieces)
 
     def vocab_size(self) -> int:
-        return self._sp.vocab_size() + len(self._special)
+        # Only specials OUTSIDE the sp id space extend the vocab; standard
+        # checkpoints re-declare <s>/</s>/<unk> (ids inside the model) in
+        # added_tokens_decoder and must not inflate the count.
+        base = self._sp.vocab_size()
+        return base + sum(1 for i in self._special_by_id if i >= base)
 
     def id_to_token(self, token_id: int) -> Optional[str]:
         if token_id in self._special_by_id:
