@@ -1119,7 +1119,10 @@ def main() -> None:
             {b for b in (128, 512, 2048)
              if b < min(args.max_seq_len, mcfg.max_context_len)}
             | {min(args.max_seq_len, mcfg.max_context_len)})),
-        role=InstanceType.parse(args.type))
+        role=InstanceType.parse(args.type),
+        # Pre-compile horizon variants on real chips so the first
+        # short-budget request doesn't hit a mid-serving XLA compile.
+        warmup_programs=jax.default_backend() != "cpu")
     params = None
     if args.checkpoint_path:
         from pathlib import Path

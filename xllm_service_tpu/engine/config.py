@@ -42,6 +42,13 @@ class EngineConfig:
     # still a win locally). Tokens past a stop condition within a horizon
     # are discarded on the host.
     decode_horizon: int = 1
+    # Pre-compile every power-of-two decode horizon (and the spec-verify
+    # program) at engine start. The budget-bounded horizon's first use of
+    # each value otherwise compiles mid-serving (~tens of seconds on TPU —
+    # a latency spike for whoever is streaming at that moment). Off by
+    # default to keep CPU test startup fast; the agent CLI enables it on
+    # accelerator backends.
+    warmup_programs: bool = False
     # Speculative decoding (prompt-lookup / n-gram drafts, verified in one
     # batched multi-token forward; greedy-exact). 0 disables. Used only
     # when every running sequence is greedy with no penalties/logprobs —

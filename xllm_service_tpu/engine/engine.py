@@ -220,6 +220,8 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
 
         self._build_programs()
+        if cfg.warmup_programs:
+            self._warmup_programs()
         # Telemetry for heartbeats (reference LatencyMetrics).
         self.recent_max_ttft_ms = 0.0
         self.recent_max_tbt_ms = 0.0
@@ -531,6 +533,26 @@ class InferenceEngine:
             return dict(d, kv=kv)
 
         self._prefill_chunk = prefill_chunk
+
+    def _warmup_programs(self) -> None:
+        """Compile every horizon variant (and spec verify) before serving.
+        Safe on the empty batch: no slot is active, so state doesn't
+        change and stray KV writes land on the garbage page."""
+        t0 = time.monotonic()
+        h = 1
+        while h <= self.cfg.decode_horizon:
+            self._dstate, packed = self._decode_multi(
+                self.params, self._dstate, h)
+            jax.block_until_ready(packed)
+            h <<= 1
+        if self._spec_verify is not None:
+            B, K = self.cfg.max_batch_size, self.cfg.speculate_k
+            self._dstate, packed = self._spec_verify(
+                self.params, self._dstate,
+                jnp.full((B, K), -1, jnp.int32), jnp.ones((B,), jnp.int32))
+            jax.block_until_ready(packed)
+        logger.info("decode program warmup done in %.1fs",
+                    time.monotonic() - t0)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceEngine":
