@@ -517,19 +517,26 @@ class InferenceEngine:
         self._inject_install = inject_install
 
         @partial(jax.jit, donate_argnums=(1,))
-        def prefill_chunk(params, d, tokens, ints):
+        def prefill_chunk(params, d, tokens, ints, mm):
             """One non-final chunk of a chunked prefill: writes the
             chunk's KV (attending to the already-written prefix) and
             discards logits. ints: [P + 2] = [page_row(P), prefix_len,
-            seq_len]."""
+            seq_len]. mm: this chunk's visual-embedding slice (VL; dummy
+            otherwise) — placeholders in the chunk consume it in order."""
             page_row = ints[:P]
             prefix_len = ints[P]
             seq_len = ints[P + 1]
-            _, kv = fam.prefill_forward(
-                params, mcfg, tokens,
-                prefix_len + jnp.arange(tokens.shape[1],
-                                        dtype=jnp.int32)[None, :],
-                d["kv"], page_row[None, :], prefix_len[None], seq_len[None])
+            positions = prefix_len + jnp.arange(
+                tokens.shape[1], dtype=jnp.int32)[None, :]
+            if is_vl:
+                _, kv = fam.prefill_forward(
+                    params, mcfg, tokens, positions, d["kv"],
+                    page_row[None, :], prefix_len[None], seq_len[None],
+                    mm_embeds=mm)
+            else:
+                _, kv = fam.prefill_forward(
+                    params, mcfg, tokens, positions, d["kv"],
+                    page_row[None, :], prefix_len[None], seq_len[None])
             return dict(d, kv=kv)
 
         self._prefill_chunk = prefill_chunk
@@ -883,9 +890,11 @@ class InferenceEngine:
                                           matched, time.monotonic())
 
         # Chunked prefill: long suffixes are written chunk-by-chunk across
-        # engine iterations so running decodes keep making progress.
+        # engine iterations so running decodes keep making progress
+        # (multimodal composes: each chunk consumes its own slice of the
+        # visual embeddings).
         C = cfg.prefill_chunk_tokens
-        if C > 0 and len(prompt) - matched > C and req.mm_embeds is None:
+        if C > 0 and len(prompt) - matched > C:
             self._prefilling = {"seq": seq, "req": req, "prompt": prompt,
                                 "cache_matched": matched,
                                 "written": matched, "t0": time.monotonic()}
@@ -913,10 +922,12 @@ class InferenceEngine:
         ints[:len(pages)] = pages
         ints[P] = st["written"]
         ints[P + 1] = C
+        mm_arr = self._mm_chunk_array(req, prompt, st["written"],
+                                      st["written"] + C)
         try:
             self._dstate = self._prefill_chunk(
                 self.params, self._dstate, jnp.asarray(chunk),
-                jnp.asarray(ints))
+                jnp.asarray(ints), mm_arr)
         except Exception as e:  # noqa: BLE001
             self._prefilling = None
             self._fail_admission(seq, req, e)
@@ -1069,6 +1080,31 @@ class InferenceEngine:
                 return b
         return self.cfg.prefill_buckets[-1]
 
+    def _count_placeholders(self, tokens: list[int]) -> int:
+        tid = self.cfg.model.image_token_id
+        return sum(1 for t in tokens if t == tid)
+
+    def _mm_chunk_array(self, req: EngineRequest, prompt: list[int],
+                        start: int, end: int) -> jnp.ndarray:
+        """The visual-embedding slice consumed by prompt[start:end],
+        bucket-padded (chunked prefill composes with multimodal: chunk k's
+        placeholders consume rows starting at the count of placeholders
+        in earlier chunks)."""
+        mcfg = self.cfg.model
+        if req.mm_embeds is None:
+            return jnp.zeros((1, 1, mcfg.hidden_size), mcfg.dtype)
+        offset = self._count_placeholders(prompt[:start])
+        n = self._count_placeholders(prompt[start:end])
+        mm = np.asarray(req.mm_embeds)[offset:offset + n]
+        vis = mcfg.vision
+        unit = max(1, (vis.out_tokens if vis else 1) * 4)
+        M = max(unit, -(-max(1, mm.shape[0]) // unit) * unit)
+        if mm.shape[0] < M:
+            mm = np.concatenate(
+                [mm, np.zeros((M - mm.shape[0], mcfg.hidden_size),
+                              mm.dtype if mm.size else np.float32)])
+        return jnp.asarray(mm, mcfg.dtype)[None]
+
     def _sp_applicable(self, suffix_len: int, matched: int,
                        req: EngineRequest) -> bool:
         """Route to the ring-attention prefill program? Requires a seq mesh
@@ -1126,23 +1162,12 @@ class InferenceEngine:
         if sp.seed is not None:
             slot_key = jax.random.PRNGKey(sp.seed)
 
-        mm = seq.req.mm_embeds
-        if mm is None:
-            mm_arr = jnp.zeros((1, 1, cfg.model.hidden_size),
-                               cfg.model.dtype)
-        else:
-            # Pad the visual-token count to a bucket (4 images' worth) so a
-            # new image count doesn't force a fresh XLA compile mid-serving.
-            # Padding rows are never read: the splice consumes exactly as
-            # many rows as there are placeholder tokens.
-            vis = cfg.model.vision
-            unit = max(1, (vis.out_tokens if vis else 1) * 4)
-            M = -(-mm.shape[0] // unit) * unit
-            if mm.shape[0] < M:
-                mm = np.concatenate(
-                    [mm, np.zeros((M - mm.shape[0], mm.shape[1]),
-                                  mm.dtype)])
-            mm_arr = jnp.asarray(mm, cfg.model.dtype)[None]
+        # Visual embeddings for THIS suffix only (earlier chunks consumed
+        # their own slices); padded to a bucket (4 images' worth) so a new
+        # image count doesn't force a fresh XLA compile mid-serving.
+        # Padding rows are never read: the splice consumes exactly as many
+        # rows as there are placeholder tokens in the suffix.
+        mm_arr = self._mm_chunk_array(seq.req, prompt, matched, len(prompt))
         # ONE packed upload per admission (see prefill_install's docstring).
         packed_in = np.concatenate([
             toks[0], ints, floats.view(np.int32), counts_row,
