@@ -122,6 +122,7 @@ class XllmHttpService:
         app.router.add_get("/health", self.handle_hello)
         app.router.add_get("/admin/config", self.handle_get_config)
         app.router.add_post("/admin/config", self.handle_set_config)
+        app.router.add_get("/admin/planner", self.handle_planner)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -360,6 +361,16 @@ class XllmHttpService:
             for f in dataclasses.fields(self.opts)
             if isinstance(getattr(self.opts, f.name), (int, float, str, bool))
         })
+
+    async def handle_planner(self, request: web.Request) -> web.Response:
+        """Latest fleet-planning decision (scale hints + requested flips;
+        reference Planner component, docs/en/overview.md:56-60)."""
+        import dataclasses
+
+        d = self.scheduler.planner.last_decision
+        if d is None:
+            return web.json_response({"decision": None})
+        return web.json_response({"decision": dataclasses.asdict(d)})
 
     async def handle_set_config(self, request: web.Request) -> web.Response:
         try:

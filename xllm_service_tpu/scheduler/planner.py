@@ -1,0 +1,114 @@
+"""Planner: fleet-level scaling + PD-ratio decisions.
+
+Reference parity: `docs/en/overview.md:56-60` names the Planner ("makes
+global optimized decisions, such as instances scaling in/out or PD role
+switching") as a system component but ships no code for it — the design
+here is ours. The Planner runs on the master's sync cadence and:
+
+- computes fleet pressure from heartbeat telemetry (waiting depth, KV
+  usage, recent TTFT/TPOT vs the SLO targets),
+- enacts PD-ratio corrections through InstanceMgr.request_flip (executed
+  by the reconcile thread, never a request path),
+- publishes scale-out/in *hints* to a coordination key
+  (`XLLM:PLANNER:decision`) and the admin API — the actual instance
+  lifecycle belongs to an external autoscaler (on TPU: whatever manages
+  slice reservations), which watches that key.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+from ..common.config import ServiceOptions
+from ..common.types import InstanceType
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+PLANNER_KEY = "XLLM:PLANNER:decision"
+
+
+@dataclass
+class PlanDecision:
+    ts_ms: int = 0
+    # Positive = add instances, negative = remove (hint for an external
+    # autoscaler; the service never kills instances itself).
+    scale_hint: int = 0
+    prefill_pressure: float = 0.0
+    decode_pressure: float = 0.0
+    kv_pressure: float = 0.0
+    flips_requested: list = field(default_factory=list)
+    reasons: list = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+
+class Planner:
+    # Pressure thresholds (fractions of capacity / SLO).
+    SCALE_OUT_PRESSURE = 1.5    # waiting ≥ 1.5x running capacity
+    SCALE_IN_PRESSURE = 0.1     # fleet nearly idle
+    KV_PRESSURE = 0.92          # KV pools nearly full
+    MIN_FLEET = 1
+
+    def __init__(self, instance_mgr, options: ServiceOptions):
+        self._mgr = instance_mgr
+        self._opts = options
+        self.last_decision: Optional[PlanDecision] = None
+
+    def plan_once(self) -> PlanDecision:
+        d = PlanDecision(ts_ms=int(time.time() * 1000))
+        infos = list(self._mgr.get_load_infos().values())
+        if not infos:
+            d.scale_hint = self.MIN_FLEET
+            d.reasons.append("no instances registered")
+            return self._finish(d)
+
+        n = len(infos)
+        waiting = sum(i.load.waiting_requests_num for i in infos)
+        running = sum(i.load.running_requests_num for i in infos)
+        kv_max = max(i.load.hbm_cache_usage_perc for i in infos)
+        capacity = max(1, running + n)   # rough headroom proxy
+        pressure = waiting / capacity
+        d.kv_pressure = kv_max
+
+        prefills = [i for i in infos if i.type == InstanceType.PREFILL]
+        decodes = [i for i in infos if i.type == InstanceType.DECODE]
+        d.prefill_pressure = (
+            sum(i.load.waiting_requests_num for i in prefills) /
+            max(1, len(prefills))) if prefills else 0.0
+        d.decode_pressure = (
+            sum(i.load.running_requests_num for i in decodes) /
+            max(1, len(decodes))) if decodes else 0.0
+
+        # TPOT SLO breach on decodes with idle prefills -> request a flip
+        # (the same corrective the SLO policy applies per-request, but
+        # driven fleet-wide from telemetry).
+        slow_decode = any(
+            i.latency.recent_max_tbt > self._opts.target_tpot_ms
+            for i in decodes)
+        idle_prefill = next(
+            (i.name for i in prefills if i.load.waiting_requests_num == 0
+             and i.load.running_requests_num == 0), None)
+        if slow_decode and idle_prefill and len(prefills) > 1:
+            self._mgr.request_flip(idle_prefill, InstanceType.DECODE)
+            d.flips_requested.append([idle_prefill, "DECODE"])
+            d.reasons.append("decode TPOT over target; flipping idle "
+                             "prefill")
+
+        if pressure >= self.SCALE_OUT_PRESSURE or kv_max >= self.KV_PRESSURE:
+            d.scale_hint = max(1, round(n * 0.5))
+            d.reasons.append(
+                f"pressure={pressure:.2f} kv={kv_max:.2f}: scale out")
+        elif pressure <= self.SCALE_IN_PRESSURE and waiting == 0 \
+                and n > self.MIN_FLEET and kv_max < 0.5:
+            d.scale_hint = -1
+            d.reasons.append(f"fleet idle (running={running}): scale in")
+        return self._finish(d)
+
+    def _finish(self, d: PlanDecision) -> PlanDecision:
+        self.last_decision = d
+        return d

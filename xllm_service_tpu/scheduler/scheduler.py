@@ -113,6 +113,8 @@ class Scheduler:
         self.lb_policy = create_policy(options.load_balance_policy,
                                        self.instance_mgr, self.kvcache_mgr,
                                        options)
+        from .planner import Planner
+        self.planner = Planner(self.instance_mgr, options)
         self.response_handler = ResponseHandler(
             options.model_id, options.tool_call_parser,
             options.reasoning_parser)
@@ -193,6 +195,14 @@ class Scheduler:
         if self.is_master:
             self.kvcache_mgr.upload_kvcache()
             self.instance_mgr.upload_load_metrics()
+            # Fleet-level planning (scale hints + PD-ratio correction;
+            # reference Planner component, docs/en/overview.md:56-60).
+            try:
+                from .planner import PLANNER_KEY
+                decision = self.planner.plan_once()
+                self._coord.set(PLANNER_KEY, decision.to_json())
+            except Exception:  # noqa: BLE001 — planning must not kill sync
+                logger.exception("planner pass failed")
         self._gc_stale_requests()
 
     def _gc_stale_requests(self) -> None:
