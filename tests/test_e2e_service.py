@@ -232,10 +232,17 @@ class TestAdminAndTracing:
             first = json.loads(trace[0])
             assert first["service_request_id"].startswith("completion-")
             assert first["data"]["request"]["prompt"] == "trace me"
-            # Span breakdown emitted at request exit.
-            spans = [json.loads(ln)["data"] for ln in trace
-                     if json.loads(ln)["data"].get("type") == "spans"]
-            assert spans, "no span record in trace"
+
+            # Span breakdown is emitted at request exit on the output
+            # lane — it may land just after the HTTP response returns.
+            def _spans():
+                lines = (tmp_path / "trace.json").read_text().splitlines()
+                return [json.loads(ln)["data"] for ln in lines
+                        if json.loads(ln)["data"].get("type") == "spans"]
+
+            assert wait_until(lambda: bool(_spans()), timeout=5), \
+                "no span record in trace"
+            spans = _spans()
             sp = spans[0]
             assert sp["total_ms"] >= (sp["ttft_ms"] or 0) >= 0
             assert sp["prompt_tokens"] > 0
