@@ -87,9 +87,11 @@ class TestTensorParallelServing:
             s2.close()
         assert got == want
 
-    def test_tp2_pd_disaggregation(self):
-        """PD pair of TP-sharded engines: handoff must ride the host path
-        (device transfer is single-device-only for now) and match MIX."""
+    def test_tp2_pd_disaggregation_device_path(self):
+        """PD pair of TP-sharded engines with identical mesh topologies:
+        the handoff rides the device path shard-for-shard (the pull
+        reconstructs the sender's partition spec on the receiver's mesh)
+        and output matches MIX."""
         m1, a1, s1 = _cluster(tp=2)
         try:
             want = _run(m1)
@@ -103,10 +105,10 @@ class TestTensorParallelServing:
                                             InstanceType.DECODE))
         try:
             prefill, decode = a2
-            assert prefill.kv_transfer is None   # multi-device -> host path
+            assert prefill.kv_transfer is not None
             got = _run(m2)
-            assert prefill.kv_host_sent == 1
-            assert decode.kv_host_received == 1
+            assert prefill.kv_device_sent == 1
+            assert decode.kv_device_received == 1
         finally:
             for a in a2:
                 a.stop()

@@ -113,8 +113,9 @@ class AgentConfig:
     # with a load guard. Replicas land on local devices round-robin.
     dp_size: int = 1
     # Device-path PD KV transfer (JAX transfer server). Auto-disabled when
-    # the runtime lacks support or the engine spans >1 device (sharded
-    # pulls need matching mesh layouts — host path covers that case).
+    # the runtime lacks support; sharded engines use it only with peers
+    # advertising an identical mesh topology (shard layouts must line
+    # up) — mismatched pairs fall back to the host path.
     enable_device_kv_transfer: bool = True
 
 
@@ -332,12 +333,12 @@ class EngineAgent:
         self.streamer = GenerationStreamer(self,
                                            agent_cfg.generation_flush_ms)
         self.kv_transfer = None
-        if agent_cfg.enable_device_kv_transfer and (
-                self.engine.mesh is None or self.engine.mesh.size == 1):
+        if agent_cfg.enable_device_kv_transfer:
             from .kv_transfer import KvTransferManager
 
             dev = next(iter(self.engine.kv_pages.devices()))
-            self.kv_transfer = KvTransferManager.create(dev, agent_cfg.host)
+            self.kv_transfer = KvTransferManager.create(
+                dev, agent_cfg.host, mesh=self.engine.mesh)
             if self.kv_transfer is not None:
                 logger.info("device KV transfer server on %s",
                             self.kv_transfer.address)
@@ -787,7 +788,8 @@ class EngineAgent:
         behind the same PrefillHandoff contract."""
         peer_meta = self.linked_peers.get(peer)
         if (self.kv_transfer is not None and peer_meta is not None
-                and peer_meta.topology.kv_transfer_addr):
+                and peer_meta.topology.kv_transfer_addr
+                and self._same_mesh_topology(peer_meta)):
             desc = None
             try:
                 desc = self.kv_transfer.offer(
@@ -816,6 +818,17 @@ class EngineAgent:
                 status=Status(StatusCode.UNAVAILABLE,
                               f"KV transfer to decode peer failed: {e}"),
                 finished=True))
+
+    def _same_mesh_topology(self, peer_meta: InstanceMetaInfo) -> bool:
+        """Sharded device pulls reconstruct the sender's partition spec on
+        the receiver's mesh — shard layouts must match, so the device path
+        requires an identical mesh topology on both ends. Mismatched pairs
+        (or sharded->unsharded) fall back to the host path, which
+        re-materializes on the receiver however it likes."""
+        mine = self.meta().topology
+        theirs = peer_meta.topology
+        return (mine.mesh_shape == theirs.mesh_shape
+                and mine.axis_names == theirs.axis_names)
 
     @staticmethod
     def _post_handoff(peer: str, payload: bytes) -> None:

@@ -45,12 +45,18 @@ def transfer_uuid(service_request_id: str, incarnation: str = "") -> int:
 
 class KvTransferManager:
     """One per engine agent: owns a transfer server bound to the engine's
-    backend and a cache of connections to peer servers."""
+    backend and a cache of connections to peer servers. For sharded
+    engines (TP over the model axis) the pull reconstructs the same
+    partition spec on the receiving mesh — shards move device-to-device
+    without ever being gathered (requires the PD pair to advertise
+    identical mesh topologies; the agent gates on that)."""
 
-    def __init__(self, device: jax.Device, listen_ip: str = "127.0.0.1"):
+    def __init__(self, device: jax.Device, listen_ip: str = "127.0.0.1",
+                 mesh=None):
         from jax.experimental import transfer as _xfer
 
         self._device = device
+        self._mesh = mesh
         self._server = _xfer.start_transfer_server(
             device.client, f"{listen_ip}:0", [f"{listen_ip}:0"])
         self._conns: dict[str, Any] = {}
@@ -60,12 +66,12 @@ class KvTransferManager:
         self._pending: dict[int, tuple[Any, float]] = {}
 
     @classmethod
-    def create(cls, device: jax.Device,
-               listen_ip: str = "127.0.0.1") -> Optional["KvTransferManager"]:
+    def create(cls, device: jax.Device, listen_ip: str = "127.0.0.1",
+               mesh=None) -> Optional["KvTransferManager"]:
         """None when the runtime lacks transfer-server support (the caller
         falls back to the host path)."""
         try:
-            return cls(device, listen_ip)
+            return cls(device, listen_ip, mesh=mesh)
         except Exception as e:  # noqa: BLE001 — optional capability
             logger.info("device KV transfer unavailable: %s", e)
             return None
@@ -84,12 +90,20 @@ class KvTransferManager:
         with self._lock:
             self._pending[uid] = ([blob], time.monotonic() + OFFER_TTL_S)
         self._server.await_pull(uid, [blob])
-        return {
+        desc = {
             "addr": self.address,
             "uuid": uid,
             "shape": list(blob.shape),
             "dtype": str(blob.dtype),
         }
+        sharding = getattr(blob, "sharding", None)
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            # Partition spec rebuilt on the receiving mesh (identical
+            # topology, gated by the agent). Axis entries are
+            # None | str | tuple[str,...].
+            desc["spec"] = [list(p) if isinstance(p, tuple) else p
+                            for p in sharding.spec]
+        return desc
 
     def release(self, uuid: int) -> None:
         with self._lock:
@@ -126,8 +140,17 @@ class KvTransferManager:
             conn = self._server.connect(addr)
             with self._lock:
                 self._conns[addr] = conn
+        pspec = desc.get("spec")
+        if pspec is not None and self._mesh is not None:
+            sharding = jax.sharding.NamedSharding(
+                self._mesh,
+                jax.sharding.PartitionSpec(
+                    *[tuple(p) if isinstance(p, list) else p
+                      for p in pspec]))
+        else:
+            sharding = jax.sharding.SingleDeviceSharding(self._device)
         spec = jax.ShapeDtypeStruct(
             tuple(desc["shape"]), jnp.dtype(desc["dtype"]),
-            sharding=jax.sharding.SingleDeviceSharding(self._device))
+            sharding=sharding)
         out = conn.pull(int(desc["uuid"]), [spec])
         return out[0]
