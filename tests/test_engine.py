@@ -265,6 +265,55 @@ class TestEngineCorrectness:
         assert len(c1.tokens) == 2 and c1.tokens[1] == stop_tok
         assert c2.tokens == want2
 
+    def test_incremental_detokenization_multibyte(self):
+        """The per-token decode is incremental (no O(n^2) full re-decode);
+        a UTF-8 char split across byte-level tokens must be held back
+        until complete and then emitted exactly once."""
+        import base64
+
+        from xllm_service_tpu.tokenizer.tiktoken import TiktokenTokenizer
+
+        # Byte-level vocab: "é" = 0xC3 0xA9 split across two tokens.
+        vocab = {b"a": 0, b"\xc3": 1, b"\xa9": 2, b"b": 3}
+        lines = "\n".join(f"{base64.b64encode(k).decode()} {v}"
+                          for k, v in vocab.items())
+        import tempfile
+        with tempfile.NamedTemporaryFile("w", suffix=".tiktoken",
+                                         delete=False) as f:
+            f.write(lines)
+            path = f.name
+        tok = TiktokenTokenizer(path)
+
+        engine = make_engine()
+        engine.tokenizer = tok
+        from xllm_service_tpu.engine.engine import _Sequence
+        from xllm_service_tpu.engine.kv_cache import SequencePages
+
+        seq = _Sequence(req=EngineRequest("x", token_ids=[0]),
+                        pages=SequencePages(), prompt_len=1,
+                        max_total_len=32)
+        calls = {"n": 0}
+        real = tok.decode
+
+        def spy(ids, **kw):
+            calls["n"] += 1
+            calls["last"] = list(ids)
+            return real(ids, **kw)
+
+        tok.decode = spy
+        seq.output_ids = [0]
+        assert engine._incremental_text(seq) == "a"
+        seq.output_ids = [0, 1]           # partial UTF-8: held back
+        assert engine._incremental_text(seq) == "a�"
+        assert seq.decoded_ok == 1        # partial byte NOT finalized
+        seq.output_ids = [0, 1, 2]        # completes "é"
+        assert engine._incremental_text(seq) == "aé"
+        seq.output_ids = [0, 1, 2, 3]
+        assert engine._incremental_text(seq) == "aéb"
+        # Incremental: the last decode call saw only the 1-token tail,
+        # not the whole history.
+        assert calls["last"] == [3]
+
     def test_prompt_too_long_rejected(self):
         engine = make_engine()
         col = Collector()

@@ -141,6 +141,10 @@ class _Sequence:
     # cycle instead of rescanning the whole history.
     ngram_map: Optional[dict] = None
     ngram_indexed: int = 0
+    # Incremental detokenization: text finalized so far + how many output
+    # tokens it covers (tokens past it are the pending multi-byte tail).
+    decoded_text: str = ""
+    decoded_ok: int = 0
 
 
 class InferenceEngine:
@@ -1313,6 +1317,24 @@ class InferenceEngine:
         return True
 
     # ----------------------------------------------------------- emission
+    def _incremental_text(self, seq: _Sequence,
+                          exclude_last: bool = False) -> str:
+        """Visible text so far, decoding only tokens past the finalized
+        boundary. A tail whose decode ends in U+FFFD (partial UTF-8
+        sequence) stays pending until later tokens resolve it (or a cap is
+        hit — genuinely invalid bytes stay replacement chars, matching the
+        full-decode semantics)."""
+        end = len(seq.output_ids) - (1 if exclude_last else 0)
+        tail_ids = seq.output_ids[seq.decoded_ok:end]
+        if not tail_ids:
+            return seq.decoded_text
+        tail = self.tokenizer.decode(tail_ids)
+        if not tail.endswith("�") or len(tail_ids) > 16:
+            seq.decoded_text += tail
+            seq.decoded_ok = end
+            return seq.decoded_text
+        return seq.decoded_text + tail
+
     def _make_logprob(self, token: int, chosen_lp: float,
                       top_vals: np.ndarray, top_ids: np.ndarray,
                       sp: SamplingParams) -> Optional[LogProb]:
@@ -1348,12 +1370,14 @@ class InferenceEngine:
         elif seq.prompt_len + len(seq.output_ids) >= self.cfg.max_seq_len:
             finish_reason = "length"
 
-        # Detokenize incrementally. On "stop" the matched token (eos OR a
-        # stop_token_ids hit) is excluded from visible text — OpenAI/vLLM
-        # semantics; clients never see the stop token leak into content.
-        visible_ids = seq.output_ids[:-1] if finish_reason == "stop" \
-            else seq.output_ids
-        text = self.tokenizer.decode(visible_ids)
+        # Detokenize incrementally — only the undecoded tail is decoded
+        # per token, NOT the whole output (that is O(n^2) per sequence and
+        # real host cost with BPE tokenizers at long generations). On
+        # "stop" the matched token (eos OR a stop_token_ids hit) is
+        # excluded from visible text — OpenAI/vLLM semantics; clients
+        # never see the stop token leak into content.
+        text = self._incremental_text(seq,
+                                      exclude_last=finish_reason == "stop")
         # Stop strings.
         if not finish_reason and sp.stop:
             for s in sp.stop:
