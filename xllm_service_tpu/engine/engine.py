@@ -1220,18 +1220,24 @@ class InferenceEngine:
             self.tpot_samples.append(
                 (len(live), sum(s.context_len for s in live), ms_per_tok))
 
-        for h in range(packed_np.shape[0]):
-            for slot, seq in list(self._running.items()):
-                if seq.finished:
-                    continue
-                token = int(packed_np[h, slot, 0])
-                seq.context_len += 1
-                lp = self._make_logprob(
-                    token, float(packed_np[h, slot, 1]),
+        H = packed_np.shape[0]
+        for slot, seq in list(self._running.items()):
+            if seq.finished:
+                continue
+            tokens: list[int] = []
+            lps: list[Optional[LogProb]] = []
+            for h in range(H):
+                tokens.append(int(packed_np[h, slot, 0]))
+                lps.append(self._make_logprob(
+                    int(packed_np[h, slot, 0]),
+                    float(packed_np[h, slot, 1]),
                     packed_np[h, slot, 2:2 + K],
                     packed_np[h, slot, 2 + K:].astype(np.int64),
-                    seq.req.sampling)
-                self._emit_token(seq, token, lp)
+                    seq.req.sampling))
+            seq.context_len += H
+            # ONE delta per sequence per horizon (tokens past a stop are
+            # discarded inside _emit_tokens).
+            self._emit_tokens(seq, tokens, lps)
         return True
 
     # ----------------------------------------------- speculative decoding
@@ -1300,13 +1306,10 @@ class InferenceEngine:
             if seq.finished:
                 continue
             acc = int(out[slot, 0])
-            for i in range(acc + 1):
-                if seq.finished:
-                    break
-                token = int(out[slot, 1 + i])
-                seq.context_len += 1
-                emitted += 1
-                self._emit_token(seq, token, None)
+            tokens = [int(out[slot, 1 + i]) for i in range(acc + 1)]
+            seq.context_len += len(tokens)
+            emitted += len(tokens)
+            self._emit_tokens(seq, tokens, [None] * len(tokens))
         per_seq = emitted / max(1, n_seqs)
         ms_per_tok = elapsed * 1000 / max(1.0, per_seq)
         self.recent_max_tbt_ms = max(self.recent_max_tbt_ms, ms_per_tok)
@@ -1352,53 +1355,74 @@ class InferenceEngine:
 
     def _emit_token(self, seq: _Sequence, token: int,
                     lp: Optional[LogProb]) -> None:
-        """Append + detokenize + stream the delta."""
-        seq.output_ids.append(token)
-        if lp is not None:
-            seq.logprobs.append(lp)
-        self.total_generated += 1
+        self._emit_tokens(seq, [token], [lp])
+
+    def _emit_tokens(self, seq: _Sequence, tokens: list[int],
+                     lps: list[Optional[LogProb]]) -> None:
+        """Append + detokenize + stream ONE delta covering all `tokens`
+        (a decode horizon / accepted speculation run): batching here cuts
+        the per-token delta count through the streamer, the Generations
+        hop and the scheduler by the horizon factor. Stops/budget are
+        still checked per token; tokens past a finish are discarded."""
         sp = seq.req.sampling
-
+        out_tokens: list[int] = []
+        out_lps: list[LogProb] = []
+        pieces: list[str] = []
         finish_reason = ""
-        if (not sp.ignore_eos and self.eos_token_id is not None
-                and token == self.eos_token_id):
-            finish_reason = "stop"
-        elif token in sp.stop_token_ids:
-            finish_reason = "stop"
-        elif len(seq.output_ids) >= seq.max_total_len - seq.prompt_len:
-            finish_reason = "length"
-        elif seq.prompt_len + len(seq.output_ids) >= self.cfg.max_seq_len:
-            finish_reason = "length"
+        for token, lp in zip(tokens, lps):
+            seq.output_ids.append(token)
+            if lp is not None:
+                seq.logprobs.append(lp)
+            self.total_generated += 1
 
-        # Detokenize incrementally — only the undecoded tail is decoded
-        # per token, NOT the whole output (that is O(n^2) per sequence and
-        # real host cost with BPE tokenizers at long generations). On
-        # "stop" the matched token (eos OR a stop_token_ids hit) is
-        # excluded from visible text — OpenAI/vLLM semantics; clients
-        # never see the stop token leak into content.
-        text = self._incremental_text(seq,
-                                      exclude_last=finish_reason == "stop")
-        # Stop strings.
-        if not finish_reason and sp.stop:
-            for s in sp.stop:
-                pos = text.find(s, max(0, seq.emitted_chars - len(s)))
-                if pos != -1:
-                    text = text[:pos]
-                    finish_reason = "stop"
-                    break
-        new_text = text[seq.emitted_chars:]
-        # Hold back trailing replacement char (partial UTF-8 sequence).
-        if new_text.endswith("�") and not finish_reason:
-            new_text = new_text[:-1]
-        seq.emitted_chars += len(new_text)
+            if (not sp.ignore_eos and self.eos_token_id is not None
+                    and token == self.eos_token_id):
+                finish_reason = "stop"
+            elif token in sp.stop_token_ids:
+                finish_reason = "stop"
+            elif len(seq.output_ids) >= seq.max_total_len - seq.prompt_len:
+                finish_reason = "length"
+            elif seq.prompt_len + len(seq.output_ids) >= self.cfg.max_seq_len:
+                finish_reason = "length"
 
+            # Detokenize incrementally — only the undecoded tail is
+            # decoded per token, NOT the whole output (that is O(n^2) per
+            # sequence and real host cost with BPE tokenizers at long
+            # generations). On "stop" the matched token (eos OR a
+            # stop_token_ids hit) is excluded from visible text —
+            # OpenAI/vLLM semantics; clients never see the stop token leak
+            # into content.
+            text = self._incremental_text(
+                seq, exclude_last=finish_reason == "stop")
+            # Stop strings.
+            if not finish_reason and sp.stop:
+                for s in sp.stop:
+                    pos = text.find(s, max(0, seq.emitted_chars - len(s)))
+                    if pos != -1:
+                        text = text[:pos]
+                        finish_reason = "stop"
+                        break
+            new_text = text[seq.emitted_chars:]
+            # Hold back trailing replacement char (partial UTF-8 sequence).
+            if new_text.endswith("�") and not finish_reason:
+                new_text = new_text[:-1]
+            seq.emitted_chars += len(new_text)
+            pieces.append(new_text)
+            out_tokens.append(token)
+            if lp is not None:
+                out_lps.append(lp)
+            if finish_reason:
+                break
+
+        if not out_tokens:
+            return
         out = RequestOutput(
             service_request_id=seq.req.service_request_id,
             request_id=seq.req.request_id,
             outputs=[SequenceOutput(
-                index=0, text=new_text, token_ids=[token],
+                index=0, text="".join(pieces), token_ids=out_tokens,
                 finish_reason=finish_reason,
-                logprobs=[lp] if lp is not None else [])],
+                logprobs=out_lps)],
             finished=bool(finish_reason),
         )
         if finish_reason:
