@@ -196,24 +196,43 @@ def decode_forward(params: Params, cfg: ModelConfig,
     """One decode step. Returns (logits [B, V], updated kv_pages).
 
     Unrolled layer loop + in-place KV writebacks (see
-    prefill_from_embeddings for why not `lax.scan`)."""
+    prefill_from_embeddings for why not `lax.scan`). Set
+    XLLM_KV_WRITEBACK=scatter to write the token's K/V directly into the
+    full [L, 2, ...] pool instead of the per-layer slice/stack/update
+    pattern — numerically identical (parity-tested); which one XLA keeps
+    fully in-place differs per backend, so it is an env-flagged A/B for
+    TPU profiling (round-1 measured the slice/stack pattern fastest)."""
+    import os
+    scatter = os.environ.get("XLLM_KV_WRITEBACK", "") == "scatter"
+    page_size = kv_pages.shape[4]
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)   # [B, D]
 
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
         h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
         q, k, v = _project_qkv(lp, h, cfg, positions)             # [B, H, hd]
-        k_pages, v_pages = kv_pages[l, 0], kv_pages[l, 1]
-        k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
-                                           page_table, positions)
+        if scatter:
+            page_idx = jnp.take_along_axis(
+                page_table, (positions // page_size)[:, None], axis=1)[:, 0]
+            slot = positions % page_size
+            kv_pages = kv_pages.at[l, 0, page_idx, :, slot, :].set(
+                k, mode="drop")
+            kv_pages = kv_pages.at[l, 1, page_idx, :, slot, :].set(
+                v, mode="drop")
+            k_pages, v_pages = kv_pages[l, 0], kv_pages[l, 1]
+        else:
+            k_pages, v_pages = kv_pages[l, 0], kv_pages[l, 1]
+            k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
+                                               page_table, positions)
         attn = paged_attention(q, k_pages, v_pages, page_table,
                                context_lens)
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         x = x + _mlp(lp, h2)
-        kv_pages = jax.lax.dynamic_update_index_in_dim(
-            kv_pages, jnp.stack([k_pages, v_pages]), l, 0)
+        if not scatter:
+            kv_pages = jax.lax.dynamic_update_index_in_dim(
+                kv_pages, jnp.stack([k_pages, v_pages]), l, 0)
     return _unembed(params, cfg, x), kv_pages
 
 
