@@ -1,0 +1,83 @@
+"""MoE expert-parallel PD pair e2e (SURVEY §7.3 hard part #5: the
+interaction between expert-sharded decode meshes and the PD link
+topology): a prefill+decode pair of expert-sharded DeepSeek-MoE engines
+must disaggregate correctly — device-path KV handoff between identical
+EP meshes — with output equal to a MIX instance."""
+
+import jax.numpy as jnp
+import pytest
+import requests
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.types import InstanceType
+from xllm_service_tpu.coordination.memory import InMemoryCoordination, MemoryStore
+from xllm_service_tpu.engine.agent import AgentConfig, EngineAgent
+from xllm_service_tpu.engine.config import EngineConfig
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.models.deepseek_moe import tiny_moe_config
+from xllm_service_tpu.parallel.mesh import MeshConfig
+
+from fakes import wait_until
+
+BODY = {"model": "tiny-moe", "prompt": "route me through the experts",
+        "max_tokens": 6, "temperature": 0, "ignore_eos": True}
+
+
+def _cfg() -> EngineConfig:
+    return EngineConfig(
+        model_id="tiny-moe", model_family="deepseek_moe",
+        model=tiny_moe_config(dtype=jnp.float32, max_context_len=256),
+        mesh=MeshConfig(expert=2, model=2),
+        num_pages=64, page_size=16, hash_block_size=32,
+        max_batch_size=4, max_seq_len=256, prefill_buckets=(32, 64, 256))
+
+
+def _cluster(itypes):
+    store = MemoryStore(expiry_tick_s=0.05)
+    opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                          lease_ttl_s=1.0, sync_interval_s=0.3,
+                          reconcile_interval_s=0.1)
+    master = Master(opts, coord=InMemoryCoordination(store))
+    master.start()
+    agents = [EngineAgent(
+        _cfg(),
+        AgentConfig(host="127.0.0.1", model_id="tiny-moe",
+                    instance_type=t, heartbeat_interval_s=0.3,
+                    lease_ttl_s=1.0),
+        coord=InMemoryCoordination(store)).start() for t in itypes]
+    assert wait_until(
+        lambda: all(master.scheduler.instance_mgr.get_instance_meta(a.name)
+                    is not None for a in agents), timeout=10)
+    return master, agents, store
+
+
+def _run(master):
+    r = requests.post(f"http://127.0.0.1:{master.http_port}/v1/completions",
+                      json=BODY, timeout=180)
+    assert r.status_code == 200, r.text
+    return r.json()["choices"][0]["text"]
+
+
+class TestMoeExpertParallelPD:
+    def test_ep_pd_matches_mix(self):
+        m1, a1, s1 = _cluster([InstanceType.MIX])
+        try:
+            assert a1[0].engine.mesh.shape["expert"] == 2
+            want = _run(m1)
+        finally:
+            for a in a1:
+                a.stop()
+            m1.stop()
+            s1.close()
+
+        m2, a2, s2 = _cluster([InstanceType.PREFILL, InstanceType.DECODE])
+        try:
+            prefill, decode = a2
+            got = _run(m2)
+            assert prefill.kv_device_sent + prefill.kv_host_sent == 1
+        finally:
+            for a in a2:
+                a.stop()
+            m2.stop()
+            s2.close()
+        assert got == want
