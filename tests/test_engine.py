@@ -464,11 +464,10 @@ class TestChunkedPrefill:
                                     ignore_eos=True), on_output=long_col))
         # During the chunked admission of 'long', 'short' keeps decoding.
         interleaved = 0
-        while engine._prefilling is not None or not long_col.done.is_set():
+        while engine._prefillings or not long_col.done.is_set():
             before = len(short_col.tokens)
             engine.step()
-            if engine._prefilling is not None and \
-                    len(short_col.tokens) > before:
+            if engine._prefillings and len(short_col.tokens) > before:
                 interleaved += 1
             if short_col.done.is_set() and long_col.done.is_set():
                 break
@@ -486,11 +485,69 @@ class TestChunkedPrefill:
             sampling=SamplingParams(max_tokens=5, temperature=0.0,
                                     ignore_eos=True), on_output=col))
         engine.step()            # starts chunked admission
-        assert engine._prefilling is not None
+        assert engine._prefillings
         engine.cancel("cx")
         engine.step()
-        assert engine._prefilling is None
+        assert not engine._prefillings
         assert col.done.is_set()
         assert not col.outputs[-1].status.ok()
         assert len(engine._free_slots) == engine.cfg.max_batch_size
         assert engine.page_mgr.num_free == engine.cfg.num_pages - 1
+
+
+class TestConcurrentChunkedPrefills:
+    def _engine(self, chunk):
+        return make_engine(prefill_chunk_tokens=chunk)
+
+    def test_two_long_prompts_progress_together(self):
+        """Both long prompts are in flight at once (round-robin chunks) and
+        a short prompt admits past them instead of queuing behind."""
+        engine = self._engine(32)
+        plain = self._engine(0)
+        p1 = list(range(3, 150))
+        p2 = list(range(7, 160))
+        want1 = naive_greedy(plain, p1, 3)
+        want2 = naive_greedy(plain, p2, 3)
+        c1, c2, c3 = Collector(), Collector(), Collector()
+        engine.submit(EngineRequest(
+            "l1", token_ids=p1,
+            sampling=SamplingParams(max_tokens=3, temperature=0.0,
+                                    ignore_eos=True), on_output=c1))
+        engine.submit(EngineRequest(
+            "l2", token_ids=p2,
+            sampling=SamplingParams(max_tokens=3, temperature=0.0,
+                                    ignore_eos=True), on_output=c2))
+        engine.step()
+        engine.step()
+        assert len(engine._prefillings) == 2   # both in flight together
+        # A short prompt admits immediately despite two chunked prefills.
+        engine.submit(EngineRequest(
+            "short", token_ids=list(range(8)),
+            sampling=SamplingParams(max_tokens=2, temperature=0.0,
+                                    ignore_eos=True), on_output=c3))
+        engine.step()
+        assert c3.tokens, "short prompt stalled behind chunked prefills"
+        for _ in range(200):
+            if c1.done.is_set() and c2.done.is_set() and c3.done.is_set():
+                break
+            engine.step()
+        assert c1.tokens == want1
+        assert c2.tokens == want2
+        assert len(c3.tokens) == 2
+
+    def test_third_long_prompt_waits_for_capacity(self):
+        engine = self._engine(32)   # max_concurrent_prefills = 2
+        cols = [Collector() for _ in range(3)]
+        for i, c in enumerate(cols):
+            engine.submit(EngineRequest(
+                f"l{i}", token_ids=list(range(5 + i, 150 + i)),
+                sampling=SamplingParams(max_tokens=2, temperature=0.0,
+                                        ignore_eos=True), on_output=c))
+        engine.step()
+        assert len(engine._prefillings) == 2
+        assert len(engine._waiting) == 1       # third deferred
+        for _ in range(300):
+            if all(c.done.is_set() for c in cols):
+                break
+            engine.step()
+        assert all(len(c.tokens) == 2 for c in cols)

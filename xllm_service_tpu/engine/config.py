@@ -36,6 +36,10 @@ class EngineConfig:
     # decodes keep streaming while a long prompt prefills. 0 disables
     # (whole-suffix prefill in one program call). Must be page-aligned.
     prefill_chunk_tokens: int = 0
+    # How many chunked prefills may be in flight at once (advanced
+    # round-robin, one chunk per engine step): >1 keeps several long
+    # prompts progressing fairly; short prompts always admit past them.
+    max_concurrent_prefills: int = 2
     # Decode horizon: tokens generated per host roundtrip (lax.scan inside
     # one jit call). 1 = lowest streaming latency; larger values amortize
     # dispatch + transfer overhead (essential over remote-attached chips,

@@ -215,8 +215,9 @@ class InferenceEngine:
 
         self._waiting: deque[EngineRequest] = deque()
         self._running: dict[int, _Sequence] = {}
-        # In-flight chunked prefill (at most one; decode interleaves).
-        self._prefilling: Optional[dict[str, Any]] = None
+        # In-flight chunked prefills (up to cfg.max_concurrent_prefills;
+        # one chunk advances per step, round-robin; decode interleaves).
+        self._prefillings: deque[dict[str, Any]] = deque()
         self._free_slots = list(range(B - 1, -1, -1))
         self._lock = threading.Condition()
         self._cancelled: set[str] = set()
@@ -650,9 +651,7 @@ class InferenceEngine:
         running = list(self._running.values())
         self._running.clear()
         victims = [seq.req for seq in running] + waiting
-        if self._prefilling is not None:
-            st = self._prefilling
-            self._prefilling = None
+        for st in list(self._prefillings):
             pseq = st["seq"]
             pseq.finished = True
             with self._lock:
@@ -662,6 +661,7 @@ class InferenceEngine:
             except Exception:  # noqa: BLE001
                 logger.exception("prefilling release after step failure")
             victims.append(st["req"])
+        self._prefillings.clear()
         for seq in running:
             seq.finished = True
             with self._lock:
@@ -690,14 +690,15 @@ class InferenceEngine:
                 logger.exception("failure callback")
 
     def step(self) -> bool:
-        """One engine iteration: process cancellations, advance at most one
-        prefill chunk (or admit), decode one horizon. Chunked prefill keeps
-        long-prompt admission from stalling running decodes."""
+        """One engine iteration: process cancellations, admit (short
+        prompts are never stuck behind an in-flight long prefill), advance
+        one chunk of one in-flight chunked prefill (round-robin), decode
+        one horizon. Chunked prefill keeps long-prompt admission from
+        stalling running decodes."""
         self._process_cancellations()
-        if self._prefilling is not None:
-            worked = self._advance_prefill()
-        else:
-            worked = self._admit()
+        worked = self._admit()
+        if self._prefillings:
+            worked = self._advance_prefill() or worked
         decoded = self._decode()
         return worked or decoded
 
@@ -712,10 +713,9 @@ class InferenceEngine:
             for r in self._waiting:
                 (victims if r.service_request_id in cancelled else kept).append(r)
             self._waiting = kept
-        if self._prefilling is not None and \
-                self._prefilling["seq"].req.service_request_id in cancelled:
-            st = self._prefilling
-            self._prefilling = None
+        for st in [st for st in self._prefillings
+                   if st["seq"].req.service_request_id in cancelled]:
+            self._prefillings.remove(st)
             seq = st["seq"]
             with self._lock:
                 self._free_slots.append(seq.slot)
@@ -754,6 +754,7 @@ class InferenceEngine:
 
     def _admit(self) -> bool:
         admitted = False
+        C = self.cfg.prefill_chunk_tokens
         while True:
             with self._lock:
                 if not self._free_slots:
@@ -761,6 +762,16 @@ class InferenceEngine:
                 req = self._pop_next_waiting()
                 if req is None:
                     return admitted
+            # Chunk-capacity gate (conservative: ignores a possible prefix
+            # cache hit): a long prompt that would need chunking waits its
+            # turn rather than exceeding the concurrent-prefill bound.
+            if (C > 0 and len(req.token_ids) + len(req.resume_output_ids) > C
+                    and req.injected_kv is None
+                    and len(self._prefillings) >=
+                    self.cfg.max_concurrent_prefills):
+                with self._lock:
+                    self._waiting.appendleft(req)
+                return admitted
             if not self._start_sequence(req):
                 # Not enough KV pages. An online request may preempt a
                 # running offline sequence to make room.
@@ -899,22 +910,25 @@ class InferenceEngine:
         # visual embeddings).
         C = cfg.prefill_chunk_tokens
         if C > 0 and len(prompt) - matched > C:
-            self._prefilling = {"seq": seq, "req": req, "prompt": prompt,
-                                "cache_matched": matched,
-                                "written": matched, "t0": time.monotonic()}
+            self._prefillings.append(
+                {"seq": seq, "req": req, "prompt": prompt,
+                 "cache_matched": matched,
+                 "written": matched, "t0": time.monotonic()})
             return True
         return self._finish_admission(seq, req, prompt, matched, matched,
                                       time.monotonic())
 
     def _advance_prefill(self) -> bool:
-        """One chunk of the in-flight chunked prefill."""
-        st = self._prefilling
-        assert st is not None
+        """One chunk of ONE in-flight chunked prefill (round-robin across
+        the concurrent set: every long prompt makes progress, none owns
+        the engine)."""
+        if not self._prefillings:
+            return False
+        st = self._prefillings.popleft()
         seq, req, prompt = st["seq"], st["req"], st["prompt"]
         C = self.cfg.prefill_chunk_tokens
         remaining = len(prompt) - st["written"]
         if remaining <= C:
-            self._prefilling = None
             return self._finish_admission(seq, req, prompt,
                                           st["cache_matched"],
                                           st["written"], st["t0"])
@@ -933,10 +947,10 @@ class InferenceEngine:
                 self.params, self._dstate, jnp.asarray(chunk),
                 jnp.asarray(ints), mm_arr)
         except Exception as e:  # noqa: BLE001
-            self._prefilling = None
             self._fail_admission(seq, req, e)
             raise
         st["written"] += C
+        self._prefillings.append(st)   # back of the round-robin
         return True
 
     def _fail_admission(self, seq: _Sequence, req: EngineRequest,
