@@ -310,9 +310,43 @@ class TestEngineCorrectness:
         assert engine._incremental_text(seq) == "aé"
         seq.output_ids = [0, 1, 2, 3]
         assert engine._incremental_text(seq) == "aéb"
-        # Incremental: the last decode call saw only the 1-token tail,
-        # not the whole history.
-        assert calls["last"] == [3]
+        # Incremental: per-token decode calls see a BOUNDED window
+        # (context + tail), never the whole history.
+        for _ in range(30):
+            seq.output_ids.append(0)
+            engine._incremental_text(seq)
+            assert len(calls["last"]) <= 2 * engine.DETOK_WINDOW + 1
+        assert engine._incremental_text(seq).endswith("a" * 30)
+
+    def test_incremental_detok_preserves_word_boundaries(self):
+        """decode(A)+decode(B) != decode(A+B) for SentencePiece-style
+        tokenizers (the run's leading word marker is stripped) — the
+        incremental path must diff WITH context so streamed text keeps its
+        inter-word spaces."""
+
+        class SpLikeTokenizer:
+            """Minimal SentencePiece-decode semantics: pieces carry a
+            leading ▁ word marker; decode joins pieces, ▁ -> space, and
+            strips the overall leading space."""
+
+            PIECES = {0: "▁Hello", 1: "▁world", 2: "▁again", 3: "!"}
+
+            def decode(self, ids, skip_special_tokens=True):
+                s = "".join(self.PIECES[int(i)] for i in ids)
+                return s.replace("▁", " ").lstrip(" ")
+
+        engine = make_engine()
+        engine.tokenizer = SpLikeTokenizer()
+        from xllm_service_tpu.engine.engine import _Sequence
+        from xllm_service_tpu.engine.kv_cache import SequencePages
+
+        seq = _Sequence(req=EngineRequest("x", token_ids=[0]),
+                        pages=SequencePages(), prompt_len=1,
+                        max_total_len=32)
+        for i, want in [(0, "Hello"), (1, "Hello world"),
+                        (3, "Hello world!"), (2, "Hello world! again")]:
+            seq.output_ids.append(i)
+            assert engine._incremental_text(seq) == want
 
     def test_prompt_too_long_rejected(self):
         engine = make_engine()

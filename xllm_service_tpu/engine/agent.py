@@ -427,17 +427,17 @@ class EngineAgent:
     def meta(self) -> InstanceMetaInfo:
         ecfg = self.engine.cfg
         mcfg = ecfg.model
-        devs = jax.devices()
         ttft_table, tpot_table = self.profiling_tables()
         return InstanceMetaInfo(
             name=self.name, rpc_address=self.name, type=self.instance_type,
             dp_size=len(self.engines),
             topology=TpuTopology(
                 slice_id=self.cfg.slice_id,
-                mesh_shape=list(self.engine.mesh.devices.shape)
-                if self.engine.mesh else [len(devs)],
-                axis_names=list(self.engine.mesh.axis_names)
-                if self.engine.mesh else ["data"],
+                # Describes THIS engine's mesh (mesh-less = one device),
+                # not the host's device count — the device-KV-transfer
+                # gate compares these between peers.
+                mesh_shape=self._mesh_shape(),
+                axis_names=self._mesh_axes(),
                 host_addrs=[self.name],
                 kv_transfer_addr=self.kv_transfer.address
                 if self.kv_transfer is not None else ""),
@@ -819,16 +819,24 @@ class EngineAgent:
                               f"KV transfer to decode peer failed: {e}"),
                 finished=True))
 
+    def _mesh_shape(self) -> list[int]:
+        return list(self.engine.mesh.devices.shape) \
+            if self.engine.mesh else [1]
+
+    def _mesh_axes(self) -> list[str]:
+        return list(self.engine.mesh.axis_names) \
+            if self.engine.mesh else ["data"]
+
     def _same_mesh_topology(self, peer_meta: InstanceMetaInfo) -> bool:
         """Sharded device pulls reconstruct the sender's partition spec on
         the receiver's mesh — shard layouts must match, so the device path
         requires an identical mesh topology on both ends. Mismatched pairs
         (or sharded->unsharded) fall back to the host path, which
-        re-materializes on the receiver however it likes."""
-        mine = self.meta().topology
+        re-materializes on the receiver however it likes. (Cheap field
+        reads — this runs on every handoff.)"""
         theirs = peer_meta.topology
-        return (mine.mesh_shape == theirs.mesh_shape
-                and mine.axis_names == theirs.axis_names)
+        return (self._mesh_shape() == theirs.mesh_shape
+                and self._mesh_axes() == theirs.axis_names)
 
     @staticmethod
     def _post_handoff(peer: str, payload: bytes) -> None:

@@ -755,23 +755,33 @@ class InferenceEngine:
     def _admit(self) -> bool:
         admitted = False
         C = self.cfg.prefill_chunk_tokens
+        deferred: list[EngineRequest] = []
+
+        def _requeue_deferred():
+            if deferred:
+                with self._lock:
+                    for r in reversed(deferred):
+                        self._waiting.appendleft(r)
+
         while True:
             with self._lock:
                 if not self._free_slots:
+                    _requeue_deferred()
                     return admitted
                 req = self._pop_next_waiting()
                 if req is None:
+                    _requeue_deferred()
                     return admitted
             # Chunk-capacity gate (conservative: ignores a possible prefix
             # cache hit): a long prompt that would need chunking waits its
-            # turn rather than exceeding the concurrent-prefill bound.
+            # turn — but SKIP it rather than stop, so short prompts behind
+            # it still admit this step (no head-of-line blocking).
             if (C > 0 and len(req.token_ids) + len(req.resume_output_ids) > C
                     and req.injected_kv is None
                     and len(self._prefillings) >=
                     self.cfg.max_concurrent_prefills):
-                with self._lock:
-                    self._waiting.appendleft(req)
-                return admitted
+                deferred.append(req)
+                continue
             if not self._start_sequence(req):
                 # Not enough KV pages. An online request may preempt a
                 # running offline sequence to make room.
@@ -781,6 +791,7 @@ class InferenceEngine:
                         continue
                 with self._lock:
                     self._waiting.appendleft(req)
+                _requeue_deferred()
                 return admitted
             admitted = True
 
@@ -1334,23 +1345,40 @@ class InferenceEngine:
         return True
 
     # ----------------------------------------------------------- emission
+    # Finalized-context window for the incremental diff: the tail is
+    # always decoded TOGETHER with the last few finalized tokens, because
+    # decode(A)+decode(B) != decode(A+B) for tokenizers with boundary
+    # rules (SentencePiece strips each run's leading word marker — naive
+    # concatenation would eat inter-word spaces).
+    DETOK_WINDOW = 8
+
     def _incremental_text(self, seq: _Sequence,
                           exclude_last: bool = False) -> str:
-        """Visible text so far, decoding only tokens past the finalized
-        boundary. A tail whose decode ends in U+FFFD (partial UTF-8
-        sequence) stays pending until later tokens resolve it (or a cap is
-        hit — genuinely invalid bytes stay replacement chars, matching the
-        full-decode semantics)."""
+        """Visible text so far, decoding only a bounded window per token
+        (not the whole output — O(n^2) at long generations). A tail whose
+        decode ends in U+FFFD (partial UTF-8 sequence) stays pending until
+        later tokens resolve it (or a cap is hit — genuinely invalid bytes
+        stay replacement chars, matching full-decode semantics)."""
         end = len(seq.output_ids) - (1 if exclude_last else 0)
-        tail_ids = seq.output_ids[seq.decoded_ok:end]
-        if not tail_ids:
+        if end <= seq.decoded_ok:
             return seq.decoded_text
-        tail = self.tokenizer.decode(tail_ids)
-        if not tail.endswith("�") or len(tail_ids) > 16:
-            seq.decoded_text += tail
+        start = max(0, seq.decoded_ok - self.DETOK_WINDOW)
+        prev = self.tokenizer.decode(seq.output_ids[start:seq.decoded_ok]) \
+            if seq.decoded_ok > start else ""
+        cur = self.tokenizer.decode(seq.output_ids[start:end])
+        if cur.startswith(prev):
+            piece = cur[len(prev):]
+        else:
+            # Rare (window-boundary normalization): fall back to the exact
+            # full decode.
+            seq.decoded_text = self.tokenizer.decode(seq.output_ids[:end])
             seq.decoded_ok = end
             return seq.decoded_text
-        return seq.decoded_text + tail
+        if not piece.endswith("�") or (end - seq.decoded_ok) > 16:
+            seq.decoded_text += piece
+            seq.decoded_ok = end
+            return seq.decoded_text
+        return seq.decoded_text + piece
 
     def _make_logprob(self, token: int, chosen_lp: float,
                       top_vals: np.ndarray, top_ids: np.ndarray,
