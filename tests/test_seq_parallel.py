@@ -108,3 +108,27 @@ class TestSeqParallelPrefill:
         second = run_one(sp_engine, prompt)
         assert second == first
         assert used["sp"] == 0   # cached prefix -> standard path
+
+
+class TestContextParallelDecode:
+    def test_cp_decode_matches_single_device(self):
+        """With a seq mesh axis, the KV pool shards over pages and decode
+        attention runs the flash-merge CP op — greedy output must be
+        identical to the single-device engine for both short prompts
+        (standard prefill into the sharded pool) and long prompts (ring
+        prefill)."""
+        single = InferenceEngine(make_cfg())
+        cp = InferenceEngine(make_cfg(mesh=MeshConfig(seq=4)))
+        assert cp.seq_parallel == 4
+        short = list(range(40, 70))
+        long = [(i * 11 + 5) % 300 + 10 for i in range(100)]
+        assert run_one(cp, short) == run_one(single, short)
+        assert run_one(cp, long) == run_one(single, long)
+
+    def test_num_pages_divisibility_enforced(self):
+        import pytest as _pytest
+
+        cfg = make_cfg(mesh=MeshConfig(seq=4))
+        cfg.num_pages = 63   # not divisible by 4
+        with _pytest.raises(ValueError):
+            InferenceEngine(cfg)

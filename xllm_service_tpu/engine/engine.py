@@ -187,10 +187,23 @@ class InferenceEngine:
 
         B = cfg.max_batch_size
         # Device-resident decode state (donated through every program).
+        kv0 = jnp.zeros((mcfg.num_layers, 2, cfg.num_pages,
+                         mcfg.num_kv_heads, cfg.page_size,
+                         mcfg.head_dim), mcfg.dtype)
+        if self.seq_parallel > 1:
+            # Context-parallel decode: the page pool shards over the seq
+            # axis; attention merges per-shard flash stats (one psum per
+            # step) instead of gathering pages.
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+            from ..parallel.mesh import AXIS_SEQ as _SEQ
+            if cfg.num_pages % self.seq_parallel:
+                raise ValueError("num_pages must divide by the seq-axis "
+                                 "size for context-parallel decode")
+            kv0 = jax.device_put(
+                kv0, NamedSharding(self.mesh,
+                                   _P(None, None, _SEQ, None, None, None)))
         self._dstate: dict[str, jax.Array] = {
-            "kv": jnp.zeros((mcfg.num_layers, 2, cfg.num_pages,
-                             mcfg.num_kv_heads, cfg.page_size,
-                             mcfg.head_dim), mcfg.dtype),
+            "kv": kv0,
             "counts": jnp.zeros((B, mcfg.vocab_size), jnp.int32),
             "last": jnp.zeros((B,), jnp.int32),
             "clens": jnp.zeros((B,), jnp.int32),
@@ -255,11 +268,18 @@ class InferenceEngine:
 
         @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
         def decode_multi(params, d, horizon):
+            from ..ops.attention import decode_context_parallel
+            from ..parallel.mesh import AXIS_SEQ as _SEQ
+
+            cp_ctx = (decode_context_parallel(self.mesh, _SEQ)
+                      if self.seq_parallel > 1 else contextlib.nullcontext())
+
             def step(d, _):
                 positions = d["clens"] - 1
-                logits, kv = fam.decode_forward(
-                    params, mcfg, d["last"], positions, d["kv"], d["pt"],
-                    d["clens"])
+                with cp_ctx:
+                    logits, kv = fam.decode_forward(
+                        params, mcfg, d["last"], positions, d["kv"],
+                        d["pt"], d["clens"])
                 d = dict(d, kv=kv)
                 toks, logprobs = sample_tokens(
                     logits, sampling_state(d), d["keys"], d["clens"],

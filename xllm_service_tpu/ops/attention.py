@@ -44,6 +44,22 @@ def sequence_parallel_prefill(mesh, seq_axis: str = "seq"):
         _sp_ctx.cfg = prev
 
 
+# Context-parallel DECODE context: the engine activates this while tracing
+# its decode program when the KV pool is sharded over the seq axis;
+# `paged_attention` then routes through the flash-stats-merge CP op.
+_cp_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def decode_context_parallel(mesh, seq_axis: str = "seq"):
+    prev = getattr(_cp_ctx, "cfg", None)
+    _cp_ctx.cfg = (mesh, seq_axis)
+    try:
+        yield
+    finally:
+        _cp_ctx.cfg = prev
+
+
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     dtype = x.dtype
     x = x.astype(jnp.float32)
@@ -225,11 +241,21 @@ def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array,
                     context_lens: jax.Array) -> jax.Array:
-    """Backend dispatcher: hand-written Pallas kernel on TPU, XLA gather
-    fallback elsewhere (CPU test meshes) and for shapes outside the
-    kernel's tiling constraints. Selection happens at trace time — both
-    paths are numerically equivalent (tested)."""
+    """Backend dispatcher: context-parallel op when the engine traced
+    under `decode_context_parallel` (pool sharded over the seq axis),
+    hand-written Pallas kernel on TPU, XLA gather fallback elsewhere (CPU
+    test meshes) and for shapes outside the kernel's tiling constraints.
+    Selection happens at trace time — all paths are numerically
+    equivalent (tested)."""
     import os
+
+    cp = getattr(_cp_ctx, "cfg", None)
+    if cp is not None:
+        from .cp_paged_attention import cp_paged_attention
+
+        mesh, seq_axis = cp
+        return cp_paged_attention(q, k_pages, v_pages, page_table,
+                                  context_lens, mesh, seq_axis=seq_axis)
 
     n_heads, hd = q.shape[-2], q.shape[-1]
     n_kv = k_pages.shape[1]

@@ -1,0 +1,102 @@
+"""Context-parallel paged DECODE attention: the KV page pool sharded over
+the mesh `seq` axis (SURVEY §5.7 — ring attention covers prefill; this
+covers decode once a sequence's context outgrows one device's HBM).
+
+Mechanism: pages are sharded round-robin-by-range across the seq axis
+(device d owns pages [d*P/n, (d+1)*P/n)). Each device computes flash
+statistics (m, l, acc) for every query over ONLY the pages it owns
+(page-table entries outside its range are masked), then the per-device
+partials merge with a log-sum-exp reduction over the axis:
+
+    m_g   = pmax(m)
+    l_g   = psum(l * exp(m - m_g))
+    acc_g = psum(acc * exp(m - m_g))
+    out   = acc_g / l_g
+
+One psum pair over ICI per decode step — no device ever materializes
+another shard's pages.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _local_partial(q, k_pages, v_pages, page_table, context_lens,
+                   axis_name: str, scale):
+    """Per-device body. k/v_pages: the LOCAL page shard
+    [P_loc, n_kv, ps, hd]; page ids in page_table are global."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    P_loc = k_pages.shape[0]
+    lo = my * P_loc
+
+    B, H, hd = q.shape
+    n_kv = k_pages.shape[1]
+    ps = k_pages.shape[2]
+    n_rep = H // n_kv
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+
+    #
+
+    # Local gather: clamp global ids into the local shard; out-of-range
+    # entries keep index 0 and are masked out of the softmax.
+    local_idx = page_table - lo                         # [B, max_pages]
+    owned = (local_idx >= 0) & (local_idx < P_loc)
+    safe_idx = jnp.where(owned, local_idx, 0)
+    g = k_pages[safe_idx]                               # [B, mp, n_kv, ps, hd]
+    gv = v_pages[safe_idx]
+    Bq, mp = safe_idx.shape
+    k = g.transpose(0, 1, 3, 2, 4).reshape(B, mp * ps, n_kv, hd)
+    v = gv.transpose(0, 1, 3, 2, 4).reshape(B, mp * ps, n_kv, hd)
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+
+    qf = q.astype(jnp.float32) * scale
+    scores = jnp.einsum("bhd,bkhd->bhk", qf, k.astype(jnp.float32))
+    pos = jnp.arange(mp * ps)[None, :]
+    valid = (pos < context_lens[:, None]) & \
+        jnp.repeat(owned, ps, axis=1)                   # [B, mp*ps]
+    scores = jnp.where(valid[:, None, :], scores, _NEG_INF)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)          # [B, H, 1]
+    p = jnp.exp(scores - m)
+    p = jnp.where(scores <= _NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32))
+
+    # Merge flash stats across the seq axis.
+    m_g = jax.lax.pmax(m, axis_name)
+    w = jnp.exp(jnp.where(m <= _NEG_INF / 2, _NEG_INF, m) - m_g)
+    w = jnp.where(m <= _NEG_INF / 2, 0.0, w)
+    l_g = jax.lax.psum(l * w, axis_name)
+    acc_g = jax.lax.psum(acc * w[..., 0][..., None], axis_name)
+    out = acc_g / jnp.maximum(l_g[..., 0][..., None], 1e-9)
+    return out.astype(q.dtype)
+
+
+def cp_paged_attention(q: jax.Array, k_pages: jax.Array,
+                       v_pages: jax.Array, page_table: jax.Array,
+                       context_lens: jax.Array, mesh: Mesh,
+                       seq_axis: str = "seq",
+                       scale: float | None = None) -> jax.Array:
+    """q: [B, n_heads, hd]; k/v_pages: [num_pages, n_kv, ps, hd] sharded
+    (or shardable) on the page axis over `seq_axis`; num_pages must divide
+    by the axis size. Returns [B, n_heads, hd], identical to
+    single-device paged attention (parity-tested)."""
+    fn = shard_map(
+        functools.partial(_local_partial, axis_name=seq_axis, scale=scale),
+        mesh=mesh,
+        in_specs=(P(), P(seq_axis), P(seq_axis), P(), P()),
+        out_specs=P(),
+    )
+    return fn(q, k_pages, v_pages, page_table, context_lens)
