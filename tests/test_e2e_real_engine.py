@@ -190,3 +190,73 @@ class TestLiveProfilingTables:
         # under the 30ms+ cold-start default at short prompts).
         measured = entry.predictor.predict_ttft(16)
         assert measured >= 0.0
+
+
+class TestGracefulDrain:
+    def test_drain_excludes_from_scheduling_and_finishes_inflight(self,
+                                                                  store):
+        """A draining instance takes no new traffic (scheduler excludes it
+        on the next refresh) but its in-flight stream finishes intact —
+        the reference kills instances abruptly (cancel-and-surface)."""
+        import threading
+
+        from xllm_service_tpu.coordination.memory import InMemoryCoordination
+
+        opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,
+                              lease_ttl_s=1.0, sync_interval_s=0.3,
+                              reconcile_interval_s=0.1)
+        master = Master(opts, coord=InMemoryCoordination(store))
+        master.start()
+        ecfg = EngineConfig(
+            model_id="tiny-llama",
+            model=tiny_config(dtype=jnp.float32, max_context_len=256),
+            num_pages=64, page_size=16, hash_block_size=32,
+            max_batch_size=4, max_seq_len=256,
+            prefill_buckets=(32, 64, 256))
+        agent = EngineAgent(
+            ecfg,
+            AgentConfig(host="127.0.0.1", model_id="tiny-llama",
+                        heartbeat_interval_s=0.2, lease_ttl_s=1.0),
+            coord=InMemoryCoordination(store)).start()
+        try:
+            assert wait_until(
+                lambda: master.scheduler.instance_mgr.get_instance_meta(
+                    agent.name) is not None, timeout=10)
+            base = f"http://127.0.0.1:{master.http_port}"
+
+            # Long-running streaming request in flight during the drain.
+            result = {}
+
+            def long_req():
+                r = requests.post(base + "/v1/completions", json={
+                    "model": "tiny-llama", "prompt": "drain me",
+                    "max_tokens": 40, "temperature": 0,
+                    "ignore_eos": True, "stream": True},
+                    stream=True, timeout=120)
+                chunks = [ln for ln in r.iter_lines()
+                          if ln.startswith(b"data: ")]
+                result["done"] = chunks[-1] == b"data: [DONE]"
+                result["n"] = len(chunks)
+
+            t = threading.Thread(target=long_req)
+            t.start()
+            assert wait_until(
+                lambda: agent.aggregate_stats()["running"] > 0, timeout=30)
+
+            dr = threading.Thread(target=agent.drain,
+                                  kwargs={"timeout_s": 60})
+            dr.start()
+            # Scheduler stops routing here once the draining flag lands.
+            assert wait_until(
+                lambda: not master.scheduler.has_available_instances(),
+                timeout=10)
+            r = requests.post(base + "/v1/completions", json={
+                "model": "tiny-llama", "prompt": "new", "max_tokens": 4},
+                timeout=30)
+            assert r.status_code == 503
+            t.join(timeout=120)
+            dr.join(timeout=120)
+            assert result.get("done"), result
+            assert result["n"] > 2
+        finally:
+            master.stop()

@@ -141,6 +141,9 @@ class InstanceMetaInfo:
     # fitted by TimePredictor at registration.
     ttft_profiling_data: list[list[float]] = field(default_factory=list)
     tpot_profiling_data: list[list[float]] = field(default_factory=list)
+    # Graceful shutdown: a draining instance stays registered (in-flight
+    # streams finish) but is excluded from scheduling.
+    draining: bool = False
     # Lifecycle.
     incarnation_id: str = ""
     register_ts_ms: int = 0

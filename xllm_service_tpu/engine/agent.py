@@ -347,6 +347,7 @@ class EngineAgent:
         # POST whose response is lost makes the prefill side retry via the
         # host path; without this the same sequence would inject twice.
         self._handoffs_seen: dict[str, float] = {}
+        self._draining = False
         self.encode_count = 0
         # PD transfer-path telemetry (also surfaced in /stats).
         self.kv_device_sent = 0
@@ -478,8 +479,28 @@ class EngineAgent:
         return self
 
     def register(self) -> None:
+        meta = self.meta()
+        meta.draining = self._draining
         self.coord.set(instance_key(self.instance_type.value, self.name),
-                       self.meta().to_json(), ttl_s=self.cfg.lease_ttl_s)
+                       meta.to_json(), ttl_s=self.cfg.lease_ttl_s)
+
+    def drain(self, timeout_s: float = 60.0) -> None:
+        """Graceful shutdown: advertise draining (the scheduler stops
+        routing here on the next registration refresh), let in-flight
+        requests finish, then stop. The reference has no drain — instances
+        die abruptly and their requests are cancel-and-surfaced; this
+        keeps live streams intact across planned restarts."""
+        logger.info("agent %s draining (timeout %.0fs)", self.name,
+                    timeout_s)
+        self._draining = True
+        self.register()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            stats = self.aggregate_stats()
+            if stats["running"] == 0 and stats["waiting"] == 0:
+                break
+            time.sleep(0.2)
+        self.stop()
 
     def stop(self) -> None:
         self._alive = False
@@ -1175,6 +1196,14 @@ def main() -> None:
                           dp_size=args.dp_size),
         params=params)
     agent.start()
+    import signal as _signal
+
+    def _sigterm(_sig, _frm):
+        # Planned restarts drain: stop taking traffic, finish streams.
+        agent.drain(timeout_s=60.0)
+        raise SystemExit(0)
+
+    _signal.signal(_signal.SIGTERM, _sigterm)
     try:
         while True:
             time.sleep(3600)

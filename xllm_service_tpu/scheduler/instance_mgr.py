@@ -88,8 +88,11 @@ class _Entry:
     def schedulable(self) -> bool:
         # SUSPECT instances are excluded from scheduling; LEASE_LOST are in a
         # grace window and still schedulable (reference
-        # `is_instance_schedulable`, `instance_mgr.cpp:63-66`).
-        return self.state != InstanceRuntimeState.SUSPECT
+        # `is_instance_schedulable`, `instance_mgr.cpp:63-66`). DRAINING
+        # instances (graceful shutdown: finish in-flight, take no new
+        # traffic) are excluded while still alive.
+        return self.state != InstanceRuntimeState.SUSPECT \
+            and not self.meta.draining
 
 
 class InstanceMgr:
