@@ -260,3 +260,28 @@ class TestGracefulDrain:
             assert result["n"] > 2
         finally:
             master.stop()
+
+
+class TestEmbeddings:
+    def test_embeddings_end_to_end(self, cluster):
+        """/v1/embeddings through the full stack (the reference 501s this
+        endpoint; we serve mean-pooled final hidden states)."""
+        master, agent = cluster
+        base = _base(master)
+        r = requests.post(base + "/v1/embeddings", json={
+            "model": "tiny-llama",
+            "input": ["hello world", "a completely different sentence"],
+        }, timeout=120)
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["object"] == "list"
+        assert len(body["data"]) == 2
+        v0 = body["data"][0]["embedding"]
+        v1 = body["data"][1]["embedding"]
+        assert len(v0) == agent.engine.cfg.model.hidden_size
+        assert v0 != v1
+        assert body["usage"]["prompt_tokens"] > 0
+        # Deterministic: same input -> same vector.
+        r2 = requests.post(base + "/v1/embeddings", json={
+            "model": "tiny-llama", "input": "hello world"}, timeout=120)
+        assert r2.json()["data"][0]["embedding"] == v0

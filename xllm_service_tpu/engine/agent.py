@@ -520,6 +520,7 @@ class EngineAgent:
         app = web.Application()
         app.router.add_post("/v1/completions", self._h_completion)
         app.router.add_post("/v1/chat/completions", self._h_chat)
+        app.router.add_post("/v1/embeddings", self._h_embeddings)
         app.router.add_get("/v1/models", self._h_models)
         app.router.add_get("/health", self._h_health)
         app.router.add_get("/stats", self._h_stats)
@@ -683,6 +684,38 @@ class EngineAgent:
         self.coord.rm(old_key)
         self.register()
         return web.json_response({"ok": True})
+
+    async def _h_embeddings(self, req: web.Request) -> web.Response:
+        """OpenAI embeddings over the engine's embed forward (the
+        reference stubs this endpoint as "not support",
+        `http_service/service.cpp:500-517`)."""
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not isinstance(inputs, list) or not inputs:
+            return web.json_response(
+                {"error": "input must be a string or list of strings"},
+                status=400)
+        tok = self.engine.tokenizer
+        token_lists = [tok.encode(str(t)) or [0] for t in inputs]
+        try:
+            vecs = await asyncio.get_running_loop().run_in_executor(
+                None, self._pick_engine(token_lists[0]).embed, token_lists)
+        except NotImplementedError as e:
+            return web.json_response({"error": str(e)}, status=501)
+        n_tokens = sum(len(t) for t in token_lists)
+        return web.json_response({
+            "object": "list",
+            "model": body.get("model", self.cfg.model_id),
+            "data": [{"object": "embedding", "index": i,
+                      "embedding": [float(x) for x in v]}
+                     for i, v in enumerate(vecs)],
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        })
 
     async def _h_completion(self, req: web.Request) -> web.Response:
         return await self._accept(req, chat=False)

@@ -642,6 +642,29 @@ class InferenceEngine:
     def drain_kv_events(self) -> KvCacheEvent:
         return self.page_mgr.drain_events()
 
+    def embed(self, token_id_lists: list[list[int]]) -> np.ndarray:
+        """Text embeddings for a batch of token lists -> [n, D] f32
+        (mean-pooled final hidden states; bucketed program cache). Raises
+        if the family has no embed_forward."""
+        if self.family.embed_forward is None:
+            raise NotImplementedError(
+                f"model family {self.cfg.model_family} has no "
+                "embedding forward")
+        if not hasattr(self, "_embed_prog"):
+            self._embed_prog = jax.jit(
+                lambda p, t, sl: self.family.embed_forward(
+                    p, self.cfg.model, t, sl))
+        out: list[np.ndarray] = []
+        for ids in token_id_lists:
+            ids = ids[:self.cfg.max_seq_len]
+            S = self._bucket_for(max(1, len(ids)))
+            toks = np.zeros((1, S), np.int32)
+            toks[0, :len(ids)] = ids
+            vec = self._embed_prog(self.params, jnp.asarray(toks),
+                                   jnp.asarray([len(ids)], jnp.int32))
+            out.append(np.asarray(vec)[0])
+        return np.stack(out)
+
     # ------------------------------------------------------------- the loop
     def _loop(self) -> None:
         while not self._stopped.is_set():

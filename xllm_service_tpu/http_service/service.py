@@ -335,9 +335,27 @@ class XllmHttpService:
                                   "data": list(models.values())})
 
     async def handle_embeddings(self, request: web.Request) -> web.Response:
-        # Reference returns "not support" (`service.cpp:500-517`).
-        return _error_response(501, "embeddings not supported",
-                               "not_implemented")
+        """Synchronous proxy to an engine's embedding forward. (The
+        reference returns "not support" here, `service.cpp:500-517` — we
+        exceed it; engines whose model family lacks an embed forward still
+        501.)"""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON")
+        routing = self.scheduler.instance_mgr.get_next_instance_pair()
+        if not routing.valid():
+            return _error_response(503, "no available instances",
+                                   "service_unavailable")
+        ch = self.scheduler.get_channel(routing.prefill_name)
+        if ch is None:
+            return _error_response(503, "instance channel unavailable",
+                                   "service_unavailable")
+        ok, resp = await asyncio.get_running_loop().run_in_executor(
+            None, ch.forward, "/v1/embeddings", body)
+        if not ok:
+            return _error_response(502, f"engine error: {resp}")
+        return web.json_response(resp)
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=REGISTRY.render_prometheus(),

@@ -86,6 +86,9 @@ class ModelFamily:
     # multi-token block returning per-position logits [B, S, V]. Families
     # without it simply never take the speculative path.
     verify_forward: Optional[Callable[..., Any]] = None
+    # Optional text-embedding forward ([B, S] tokens -> [B, D] pooled);
+    # families without it 501 /v1/embeddings like the reference.
+    embed_forward: Optional[Callable[..., Any]] = None
 
 
 _REGISTRY: dict[str, ModelFamily] = {}

@@ -169,6 +169,38 @@ def prefill_from_embeddings(params: Params, cfg: ModelConfig,
     return _unembed(params, cfg, last), kv_pages
 
 
+def embed_forward(params: Params, cfg: ModelConfig,
+                  tokens: jax.Array,      # [B, S] padded token ids
+                  seq_lens: jax.Array,    # [B] valid lengths
+                  ) -> jax.Array:
+    """Text embeddings: dense causal forward (no paged cache), final norm,
+    mean-pool over valid positions -> [B, D] f32. Powers /v1/embeddings —
+    which the reference stubs as "not support"
+    (`http_service/service.cpp:500-517`)."""
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :],
+                                 (B, S))
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+
+    def layer_body(l, x):
+        lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
+        h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
+        q, k, v = _project_qkv(lp, h, cfg, positions)
+        attn = prefill_attention(q, k, v, None, None, None,
+                                 jnp.zeros((B,), jnp.int32), seq_lens)
+        attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
+        x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
+        h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+        return x + _mlp(lp, h2)
+
+    for l in range(cfg.num_layers):
+        x = layer_body(l, x)
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps)
+    mask = (jnp.arange(S)[None, :] < seq_lens[:, None])[..., None]
+    summed = jnp.sum(jnp.where(mask, x.astype(jnp.float32), 0.0), axis=1)
+    return summed / jnp.maximum(seq_lens[:, None], 1)
+
+
 def verify_forward(params: Params, cfg: ModelConfig,
                    tokens: jax.Array,        # [B, S] block to verify
                    positions: jax.Array,     # [B, S]
@@ -243,4 +275,5 @@ register_model_family(ModelFamily(
     decode_forward=decode_forward,
     sharding_rules=LLAMA_STACKED_RULES,
     verify_forward=verify_forward,
+    embed_forward=embed_forward,
 ))

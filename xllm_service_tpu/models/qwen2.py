@@ -13,6 +13,7 @@ from .llama import (
     LLAMA_STACKED_RULES,
     decode_forward,
     init_params,
+    embed_forward,
     prefill_forward,
     verify_forward,
 )
@@ -39,4 +40,5 @@ register_model_family(ModelFamily(
     decode_forward=decode_forward,
     sharding_rules=LLAMA_STACKED_RULES,
     verify_forward=verify_forward,
+    embed_forward=embed_forward,
 ))
