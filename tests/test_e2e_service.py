@@ -101,11 +101,14 @@ class TestE2E:
         assert "server_request_in_total" in text
         assert "time_to_first_token_latency_milliseconds" in text
 
-    def test_embeddings_not_supported(self, cluster):
+    def test_embeddings_proxied_to_engine(self, cluster):
+        """/v1/embeddings proxies to the routed engine (real engines serve
+        it — test_e2e_real_engine; the fake engine has no such endpoint,
+        so the proxy surfaces an upstream error, not the old hard 501)."""
         master, _ = cluster
         r = requests.post(_base(master) + "/v1/embeddings",
-                          json={"input": "x"}, timeout=5)
-        assert r.status_code == 501
+                          json={"input": "x"}, timeout=10)
+        assert r.status_code == 502
 
     def test_heartbeat_feeds_global_kvcache(self, cluster):
         master, engine = cluster
