@@ -585,3 +585,41 @@ class TestConcurrentChunkedPrefills:
                 break
             engine.step()
         assert all(len(c.tokens) == 2 for c in cols)
+
+
+class TestLogitBias:
+    def test_bias_forces_token(self):
+        """A +100 bias on a chosen token makes greedy pick it every step;
+        an unbiased request is unaffected."""
+        engine = make_engine()
+        prompt = list(range(10, 30))
+        forced = 123
+        biased, plain = Collector(), Collector()
+        run_requests(engine, [
+            EngineRequest("b", token_ids=prompt,
+                          sampling=SamplingParams(
+                              max_tokens=4, temperature=0.0,
+                              ignore_eos=True,
+                              logit_bias={forced: 100.0}),
+                          on_output=biased),
+            EngineRequest("p", token_ids=prompt,
+                          sampling=SamplingParams(max_tokens=4,
+                                                  temperature=0.0,
+                                                  ignore_eos=True),
+                          on_output=plain),
+        ])
+        assert biased.tokens == [forced] * 4
+        assert plain.tokens == naive_greedy(engine, prompt, 4)
+
+    def test_negative_bias_suppresses_token(self):
+        engine = make_engine()
+        prompt = list(range(40, 60))
+        first = naive_greedy(engine, prompt, 1)[0]
+        col = Collector()
+        run_requests(engine, [EngineRequest(
+            "nb", token_ids=prompt,
+            sampling=SamplingParams(max_tokens=3, temperature=0.0,
+                                    ignore_eos=True,
+                                    logit_bias={first: -100.0}),
+            on_output=col)])
+        assert first not in col.tokens

@@ -193,6 +193,9 @@ class SamplingParams:
     seed: Optional[int] = None
     ignore_eos: bool = False
     echo: bool = False
+    # OpenAI logit_bias: token id -> additive bias (first NUM_BIAS entries
+    # applied device-side).
+    logit_bias: dict[int, float] = field(default_factory=dict)
 
     def to_dict(self) -> dict[str, Any]:
         from dataclasses import asdict
@@ -201,7 +204,12 @@ class SamplingParams:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "SamplingParams":
-        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+        sp = cls(**{k: v for k, v in d.items()
+                    if k in cls.__dataclass_fields__})
+        # JSON round-trips dict keys as strings.
+        sp.logit_bias = {int(k): float(v)
+                         for k, v in (sp.logit_bias or {}).items()}
+        return sp
 
 
 @dataclass

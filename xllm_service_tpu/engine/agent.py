@@ -1150,6 +1150,12 @@ class EngineAgent:
             sp.logprobs = lp > 0
             sp.top_logprobs = lp
         sp.ignore_eos = bool(body.get("ignore_eos", False))
+        lb = body.get("logit_bias")
+        if isinstance(lb, dict):
+            try:
+                sp.logit_bias = {int(k): float(v) for k, v in lb.items()}
+            except (TypeError, ValueError):
+                pass
         return sp
 
 

@@ -56,7 +56,7 @@ from ..tokenizer.simple import SimpleTokenizer
 from ..utils import get_logger
 from .config import EngineConfig
 from .kv_cache import GARBAGE_PAGE, KVPageManager, SequencePages
-from .sampling import SamplingState, record_tokens, sample_tokens
+from .sampling import NUM_BIAS, SamplingState, record_tokens, sample_tokens
 
 logger = get_logger(__name__)
 
@@ -223,6 +223,9 @@ class InferenceEngine:
             # window mid-horizon. Host stop handling remains authoritative
             # (it also covers stop strings and >NUM_STOP_IDS lists).
             "stop_ids": jnp.full((B, NUM_STOP_IDS), -1, jnp.int32),
+            # OpenAI logit_bias, sparse per slot (-1 = empty entry).
+            "bias_ids": jnp.full((B, NUM_BIAS), -1, jnp.int32),
+            "bias_vals": jnp.zeros((B, NUM_BIAS), jnp.float32),
         }
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
 
@@ -264,7 +267,8 @@ class InferenceEngine:
 
         def sampling_state(d):
             return SamplingState(d["temp"], d["topk"], d["topp"], d["fp"],
-                                 d["pp"], d["rp"], d["counts"])
+                                 d["pp"], d["rp"], d["counts"],
+                                 d["bias_ids"], d["bias_vals"])
 
         @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
         def decode_multi(params, d, horizon):
@@ -336,11 +340,11 @@ class InferenceEngine:
 
             packed_in: ONE int32 upload (host↔device roundtrips are the
             dominant admission cost on remote-attached chips), laid out as
-            [tokens(S) | ints(P+4+NS) | floats_bits(6) | counts(V) | key(2)]
-            where ints = [page_row(P), slot, prefix_len, seq_len,
-            want_logprobs, stop_ids(NS)], floats (temperature, top_k,
-            top_p, freq, pres, rep) are f32 bit-cast to i32, and key is the
-            uint32 PRNG key.
+            [tokens(S) | ints(P+4+NS+NB) | floats_bits(6+NB) | counts(V) |
+            key(2)] where ints = [page_row(P), slot, prefix_len, seq_len,
+            want_logprobs, stop_ids(NS), bias_ids(NB)], floats
+            (temperature, top_k, top_p, freq, pres, rep, bias_vals(NB))
+            are f32 bit-cast to i32, and key is the uint32 PRNG key.
             mm: [1, M, D] visual embeddings (VL family; dummy otherwise).
 
             use_ring: trace the suffix self-attention as ring attention
@@ -353,13 +357,17 @@ class InferenceEngine:
                 from ..ops.attention import sequence_parallel_prefill
                 from ..parallel.mesh import AXIS_SEQ
 
-                NS = NUM_STOP_IDS
-                S = packed_in.shape[0] - (P + 4 + NS) - 6 - V - 2
+                NS, NB = NUM_STOP_IDS, NUM_BIAS
+                n_ints = P + 4 + NS + NB
+                n_floats = 6 + NB
+                S = packed_in.shape[0] - n_ints - n_floats - V - 2
                 tokens = packed_in[:S][None, :]
-                ints = packed_in[S:S + P + 4 + NS]
+                ints = packed_in[S:S + n_ints]
                 floats = jax.lax.bitcast_convert_type(
-                    packed_in[S + P + 4 + NS:S + P + 10 + NS], jnp.float32)
-                counts_row = packed_in[S + P + 10 + NS:S + P + 10 + NS + V]
+                    packed_in[S + n_ints:S + n_ints + n_floats],
+                    jnp.float32)
+                counts_row = packed_in[S + n_ints + n_floats:
+                                       S + n_ints + n_floats + V]
                 key = jax.lax.bitcast_convert_type(packed_in[-2:],
                                                    jnp.uint32)
                 page_row = ints[:P]
@@ -385,7 +393,9 @@ class InferenceEngine:
                 st = SamplingState(
                     floats[0:1], floats[1:2].astype(jnp.int32), floats[2:3],
                     floats[3:4], floats[4:5], floats[5:6],
-                    counts_row[None, :])
+                    counts_row[None, :],
+                    ints[P + 4 + NS:P + 4 + NS + NB][None, :],
+                    floats[6:6 + NB][None, :])
                 toks, logprobs = sample_tokens(
                     logits, st, key[None, :], (prefix_len + seq_len)[None])
                 chosen = jnp.take_along_axis(logprobs, toks[:, None],
@@ -407,6 +417,10 @@ class InferenceEngine:
                 d["want_lp"] = d["want_lp"].at[slot].set(ints[P + 3] > 0)
                 d["stop_ids"] = d["stop_ids"].at[slot].set(
                     ints[P + 4:P + 4 + NS])
+                d["bias_ids"] = d["bias_ids"].at[slot].set(
+                    ints[P + 4 + NS:P + 4 + NS + NB])
+                d["bias_vals"] = d["bias_vals"].at[slot].set(
+                    floats[6:6 + NB])
                 d["counts"] = d["counts"].at[slot].set(
                     counts_row.at[toks[0]].add(1))
                 packed = jnp.concatenate(
@@ -511,8 +525,10 @@ class InferenceEngine:
             scatter the transferred prompt KV into local pages + install the
             batch slot with the prefill-produced first token.
 
-            ints: [P + 4 + NUM_STOP_IDS] = [page_row(P), slot, prompt_len,
-                  first_token, want_logprobs, stop_ids(NUM_STOP_IDS)].
+            ints: [P + 4 + NUM_STOP_IDS + NUM_BIAS] = [page_row(P), slot,
+                  prompt_len, first_token, want_logprobs,
+                  stop_ids(NUM_STOP_IDS), bias_ids(NUM_BIAS)];
+            floats: [6 + NUM_BIAS] (controls + bias_vals).
             """
             page_row = ints[:P]
             slot = ints[P]
@@ -536,6 +552,10 @@ class InferenceEngine:
             d["want_lp"] = d["want_lp"].at[slot].set(ints[P + 3] > 0)
             d["stop_ids"] = d["stop_ids"].at[slot].set(
                 ints[P + 4:P + 4 + NUM_STOP_IDS])
+            d["bias_ids"] = d["bias_ids"].at[slot].set(
+                ints[P + 4 + NUM_STOP_IDS:
+                     P + 4 + NUM_STOP_IDS + NUM_BIAS])
+            d["bias_vals"] = d["bias_vals"].at[slot].set(floats[6:])
             d["counts"] = d["counts"].at[slot].set(counts_row)
             return d
 
@@ -721,6 +741,8 @@ class InferenceEngine:
         self._dstate["active"] = jnp.zeros((B,), jnp.bool_)
         self._dstate["clens"] = jnp.zeros((B,), jnp.int32)
         self._dstate["stop_ids"] = jnp.full((B, NUM_STOP_IDS), -1, jnp.int32)
+        self._dstate["bias_ids"] = jnp.full((B, NUM_BIAS), -1, jnp.int32)
+        self._dstate["bias_vals"] = jnp.zeros((B, NUM_BIAS), jnp.float32)
         for req in victims:
             try:
                 req.on_output(RequestOutput(
@@ -1112,17 +1134,22 @@ class InferenceEngine:
 
         P = cfg.pages_per_seq
         sp = req.sampling
-        ints = np.full((P + 4 + NUM_STOP_IDS,), GARBAGE_PAGE, np.int32)
+        NS, NB = NUM_STOP_IDS, NUM_BIAS
+        ints = np.full((P + 4 + NS + NB,), GARBAGE_PAGE, np.int32)
         ints[:len(own_pages)] = own_pages
         ints[P] = seq.slot
         ints[P + 1] = P0
         ints[P + 2] = first_token
         ints[P + 3] = 1 if sp.logprobs else 0
-        ints[P + 4:P + 4 + NUM_STOP_IDS] = self._device_stop_ids(sp)
-        floats = np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
-                             sp.frequency_penalty, sp.presence_penalty,
-                             sp.repetition_penalty if sp.repetition_penalty > 0
-                             else 1.0], np.float32)
+        ints[P + 4:P + 4 + NS] = self._device_stop_ids(sp)
+        bias_ids, bias_vals = self._device_bias(sp)
+        ints[P + 4 + NS:P + 4 + NS + NB] = bias_ids
+        floats = np.concatenate([
+            np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
+                        sp.frequency_penalty, sp.presence_penalty,
+                        sp.repetition_penalty if sp.repetition_penalty > 0
+                        else 1.0], np.float32),
+            bias_vals])
         counts_row = np.bincount(
             np.asarray(prompt + [first_token], np.int64),
             minlength=cfg.model.vocab_size)[:cfg.model.vocab_size] \
@@ -1190,6 +1217,18 @@ class InferenceEngine:
                 and suffix_len >= self.cfg.seq_parallel_min_tokens
                 and self._bucket_for(suffix_len) % self.seq_parallel == 0)
 
+    def _device_bias(self, sp: SamplingParams) -> tuple[np.ndarray, np.ndarray]:
+        """Sparse logit_bias rows for device-side application (-1 padded;
+        entries beyond NUM_BIAS are dropped)."""
+        ids = np.full((NUM_BIAS,), -1, np.int32)
+        vals = np.zeros((NUM_BIAS,), np.float32)
+        V = self.cfg.model.vocab_size
+        for i, (t, v) in enumerate(list(sp.logit_bias.items())[:NUM_BIAS]):
+            if 0 <= int(t) < V:
+                ids[i] = int(t)
+                vals[i] = float(v)
+        return ids, vals
+
     def _device_stop_ids(self, sp: SamplingParams) -> np.ndarray:
         """The first NUM_STOP_IDS stop tokens for device-side slot
         deactivation (-1 padded; see decode_multi)."""
@@ -1214,18 +1253,23 @@ class InferenceEngine:
         toks[0, :len(suffix)] = suffix
 
         sp = seq.req.sampling
-        ints = np.full((P + 4 + NUM_STOP_IDS,), GARBAGE_PAGE, np.int32)
+        NS, NB = NUM_STOP_IDS, NUM_BIAS
+        ints = np.full((P + 4 + NS + NB,), GARBAGE_PAGE, np.int32)
         all_pages = seq.pages.all_pages
         ints[:len(all_pages)] = all_pages
         ints[P] = seq.slot
         ints[P + 1] = matched
         ints[P + 2] = len(suffix)
         ints[P + 3] = 1 if sp.logprobs else 0
-        ints[P + 4:P + 4 + NUM_STOP_IDS] = self._device_stop_ids(sp)
-        floats = np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
-                             sp.frequency_penalty, sp.presence_penalty,
-                             sp.repetition_penalty if sp.repetition_penalty > 0
-                             else 1.0], np.float32)
+        ints[P + 4:P + 4 + NS] = self._device_stop_ids(sp)
+        bias_ids, bias_vals = self._device_bias(sp)
+        ints[P + 4 + NS:P + 4 + NS + NB] = bias_ids
+        floats = np.concatenate([
+            np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
+                        sp.frequency_penalty, sp.presence_penalty,
+                        sp.repetition_penalty if sp.repetition_penalty > 0
+                        else 1.0], np.float32),
+            bias_vals])
         counts_row = np.bincount(
             np.asarray(prompt, np.int64),
             minlength=cfg.model.vocab_size)[:cfg.model.vocab_size] \
@@ -1318,7 +1362,8 @@ class InferenceEngine:
             if (seq.finished or sp.temperature != 0.0 or sp.logprobs
                     or sp.frequency_penalty != 0.0
                     or sp.presence_penalty != 0.0
-                    or sp.repetition_penalty not in (0.0, 1.0)):
+                    or sp.repetition_penalty not in (0.0, 1.0)
+                    or sp.logit_bias):
                 return False
         return True
 

@@ -15,6 +15,11 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+# Per-slot sparse logit_bias capacity (OpenAI caps the map at 300 keys;
+# 32 covers practical use — extra keys are dropped oldest-last).
+NUM_BIAS = 32
+
+
 @dataclass
 class SamplingState:
     """Device-side per-slot sampling controls + penalty bookkeeping."""
@@ -26,6 +31,8 @@ class SamplingState:
     presence_penalty: jax.Array   # [B] f32
     repetition_penalty: jax.Array  # [B] f32; 1 => disabled
     token_counts: jax.Array       # [B, V] i32 — occurrences in prompt+output
+    bias_ids: jax.Array = None    # [B, NUM_BIAS] i32; -1 = empty
+    bias_vals: jax.Array = None   # [B, NUM_BIAS] f32
 
     @classmethod
     def init(cls, batch: int, vocab: int) -> "SamplingState":
@@ -37,11 +44,20 @@ class SamplingState:
             presence_penalty=jnp.zeros((batch,), jnp.float32),
             repetition_penalty=jnp.ones((batch,), jnp.float32),
             token_counts=jnp.zeros((batch, vocab), jnp.int32),
+            bias_ids=jnp.full((batch, NUM_BIAS), -1, jnp.int32),
+            bias_vals=jnp.zeros((batch, NUM_BIAS), jnp.float32),
         )
 
 
 def apply_penalties(logits: jax.Array, st: SamplingState) -> jax.Array:
-    """OpenAI-style frequency/presence + HF-style repetition penalties."""
+    """OpenAI-style logit_bias + frequency/presence + HF-style repetition
+    penalties."""
+    if st.bias_ids is not None:
+        B = logits.shape[0]
+        rows = jnp.arange(B)[:, None]
+        safe = jnp.where(st.bias_ids >= 0, st.bias_ids, 0)
+        vals = jnp.where(st.bias_ids >= 0, st.bias_vals, 0.0)
+        logits = logits.at[rows, safe].add(vals)
     counts = st.token_counts.astype(jnp.float32)
     seen = (counts > 0).astype(jnp.float32)
     logits = logits - counts * st.frequency_penalty[:, None]
