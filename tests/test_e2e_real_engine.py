@@ -285,3 +285,23 @@ class TestEmbeddings:
         r2 = requests.post(base + "/v1/embeddings", json={
             "model": "tiny-llama", "input": "hello world"}, timeout=120)
         assert r2.json()["data"][0]["embedding"] == v0
+
+
+class TestEcho:
+    def test_completions_echo(self, cluster):
+        master, agent = cluster
+        base = _base(master)
+        body = {"model": "tiny-llama", "prompt": "echo this prompt",
+                "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+                "echo": True}
+        r = requests.post(base + "/v1/completions", json=body, timeout=120)
+        assert r.status_code == 200, r.text
+        assert r.json()["choices"][0]["text"].startswith("echo this prompt")
+
+        r = requests.post(base + "/v1/completions",
+                          json={**body, "stream": True}, stream=True,
+                          timeout=120)
+        chunks = [json.loads(ln[6:]) for ln in r.iter_lines()
+                  if ln.startswith(b"data: ") and ln != b"data: [DONE]"]
+        texts = [c["choices"][0]["text"] for c in chunks if c["choices"]]
+        assert texts[0] == "echo this prompt"

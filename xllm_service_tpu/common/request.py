@@ -237,6 +237,8 @@ class Request:
     chat_template_kwargs: dict[str, Any] = field(default_factory=dict)
     token_ids: list[int] = field(default_factory=list)
     sampling: SamplingParams = field(default_factory=SamplingParams)
+    # Completions `echo`: prompt chunk already streamed back.
+    echo_emitted: bool = False
     # Routing decision + bound incarnations (stale-output suppression).
     routing: Routing = field(default_factory=Routing)
     prefill_incarnation: str = ""

@@ -184,6 +184,17 @@ class ResponseHandler:
                               created: Optional[int] = None) -> bool:
         """Reference `response_handler.cpp:355-435`."""
         created = created or int(time.time())
+        # OpenAI completions `echo`: the prompt text streams back as the
+        # first chunk before any generated text.
+        if request.sampling.echo and not request.echo_emitted and \
+                request.prompt:
+            request.echo_emitted = True
+            if not conn.write({
+                    "id": request.request_id, "object": "text_completion",
+                    "created": created, "model": request.model,
+                    "choices": [{"index": 0, "text": request.prompt,
+                                 "finish_reason": None}]}):
+                return False
         ok = True
         for seq in output.outputs:
             if not (seq.text or seq.finish_reason):
@@ -251,9 +262,10 @@ class ResponseHandler:
                                output: RequestOutput) -> bool:
         """Reference `response_handler.cpp:527-573`."""
         choices = []
+        echo_prefix = request.prompt if request.sampling.echo else ""
         for seq in output.outputs:
             choice: dict[str, Any] = {
-                "index": seq.index, "text": seq.text,
+                "index": seq.index, "text": (echo_prefix or "") + seq.text,
                 "finish_reason": seq.finish_reason or "stop",
             }
             if request.sampling.logprobs:
