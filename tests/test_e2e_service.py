@@ -102,13 +102,14 @@ class TestE2E:
         assert "time_to_first_token_latency_milliseconds" in text
 
     def test_embeddings_proxied_to_engine(self, cluster):
-        """/v1/embeddings proxies to the routed engine (real engines serve
-        it — test_e2e_real_engine; the fake engine has no such endpoint,
-        so the proxy surfaces an upstream error, not the old hard 501)."""
+        """/v1/embeddings proxies to the routed engine with its status
+        passed through (real engines serve it — test_e2e_real_engine; the
+        fake engine has no such endpoint, so its 404 surfaces as-is rather
+        than the old hard 501 or an opaque 502)."""
         master, _ = cluster
         r = requests.post(_base(master) + "/v1/embeddings",
                           json={"input": "x"}, timeout=10)
-        assert r.status_code == 502
+        assert r.status_code == 404
 
     def test_heartbeat_feeds_global_kvcache(self, cluster):
         master, engine = cluster

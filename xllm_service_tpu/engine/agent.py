@@ -494,6 +494,11 @@ class EngineAgent:
                     timeout_s)
         self._draining = True
         self.register()
+        # Grace window: requests the master routed just before the
+        # draining flag landed may still be in HTTP flight (not yet in
+        # engine stats) — an instant idle-stop would kill them.
+        time.sleep(min(timeout_s / 4,
+                       max(1.0, self.cfg.heartbeat_interval_s)))
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             stats = self.aggregate_stats()
@@ -700,13 +705,23 @@ class EngineAgent:
             return web.json_response(
                 {"error": "input must be a string or list of strings"},
                 status=400)
-        tok = self.engine.tokenizer
-        token_lists = [tok.encode(str(t)) or [0] for t in inputs]
-        try:
-            vecs = await asyncio.get_running_loop().run_in_executor(
-                None, self._pick_engine(token_lists[0]).embed, token_lists)
-        except NotImplementedError as e:
-            return web.json_response({"error": str(e)}, status=501)
+        if self.engine.family.embed_forward is None:
+            return web.json_response(
+                {"error": f"model family {self.engine.cfg.model_family} "
+                          "has no embedding forward"}, status=501)
+        max_len = self.engine.cfg.max_seq_len
+
+        def _encode_and_embed():
+            # Off the event loop: tokenizing a big batch (OpenAI allows
+            # thousands of inputs) must not stall in-flight SSE streams.
+            tok = self.engine.tokenizer
+            token_lists = [tok.encode(str(t))[:max_len] or [0]
+                           for t in inputs]
+            eng = self._pick_engine(token_lists[0])
+            return eng.embed(token_lists), token_lists
+
+        vecs, token_lists = await asyncio.get_running_loop() \
+            .run_in_executor(None, _encode_and_embed)
         n_tokens = sum(len(t) for t in token_lists)
         return web.json_response({
             "object": "list",

@@ -674,16 +674,30 @@ class InferenceEngine:
             self._embed_prog = jax.jit(
                 lambda p, t, sl: self.family.embed_forward(
                     p, self.cfg.model, t, sl))
-        out: list[np.ndarray] = []
-        for ids in token_id_lists:
-            ids = ids[:self.cfg.max_seq_len]
-            S = self._bucket_for(max(1, len(ids)))
-            toks = np.zeros((1, S), np.int32)
-            toks[0, :len(ids)] = ids
-            vec = self._embed_prog(self.params, jnp.asarray(toks),
-                                   jnp.asarray([len(ids)], jnp.int32))
-            out.append(np.asarray(vec)[0])
-        return np.stack(out)
+        # Batch same-length-bucket inputs into one program call (padded to
+        # a pow2 row count so batch sizes don't explode the compile
+        # cache): per-input dispatch would pay one device roundtrip each.
+        out: dict[int, np.ndarray] = {}
+        by_bucket: dict[int, list[int]] = {}
+        clipped = [ids[:self.cfg.max_seq_len] or [0]
+                   for ids in token_id_lists]
+        for i, ids in enumerate(clipped):
+            by_bucket.setdefault(self._bucket_for(len(ids)), []).append(i)
+        Bmax = self.cfg.max_batch_size
+        for S, idxs in by_bucket.items():
+            for start in range(0, len(idxs), Bmax):
+                group = idxs[start:start + Bmax]
+                nb = 1 << (len(group) - 1).bit_length()   # pow2 pad
+                toks = np.zeros((nb, S), np.int32)
+                lens = np.ones((nb,), np.int32)
+                for row, i in enumerate(group):
+                    toks[row, :len(clipped[i])] = clipped[i]
+                    lens[row] = len(clipped[i])
+                vecs = np.asarray(self._embed_prog(
+                    self.params, jnp.asarray(toks), jnp.asarray(lens)))
+                for row, i in enumerate(group):
+                    out[i] = vecs[row]
+        return np.stack([out[i] for i in range(len(clipped))])
 
     # ------------------------------------------------------------- the loop
     def _loop(self) -> None:

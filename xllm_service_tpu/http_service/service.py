@@ -351,10 +351,21 @@ class XllmHttpService:
         if ch is None:
             return _error_response(503, "instance channel unavailable",
                                    "service_unavailable")
-        ok, resp = await asyncio.get_running_loop().run_in_executor(
-            None, ch.forward, "/v1/embeddings", body)
-        if not ok:
-            return _error_response(502, f"engine error: {resp}")
+        forward = getattr(ch, "forward_status", None)
+        if forward is None:   # test doubles without the richer API
+            ok, resp = await asyncio.get_running_loop().run_in_executor(
+                None, ch.forward, "/v1/embeddings", body)
+            if not ok:
+                return _error_response(502, f"engine error: {resp}")
+            return web.json_response(resp)
+        status, resp = await asyncio.get_running_loop().run_in_executor(
+            None, forward, "/v1/embeddings", body)
+        if status != 200:
+            # Pass the engine's own status through (501 unsupported
+            # family, 400 bad input, ...) instead of masking as 502.
+            msg = resp.get("error") if isinstance(resp, dict) else resp
+            return _error_response(status if 400 <= status < 600 else 502,
+                                   str(msg))
         return web.json_response(resp)
 
     async def handle_metrics(self, request: web.Request) -> web.Response:

@@ -45,8 +45,6 @@ def _local_partial(q, k_pages, v_pages, page_table, context_lens,
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
 
-    #
-
     # Local gather: clamp global ids into the local shard; out-of-range
     # entries keep index 0 and are masked out of the softmax.
     local_idx = page_table - lo                         # [B, max_pages]
@@ -54,7 +52,7 @@ def _local_partial(q, k_pages, v_pages, page_table, context_lens,
     safe_idx = jnp.where(owned, local_idx, 0)
     g = k_pages[safe_idx]                               # [B, mp, n_kv, ps, hd]
     gv = v_pages[safe_idx]
-    Bq, mp = safe_idx.shape
+    mp = safe_idx.shape[1]
     k = g.transpose(0, 1, 3, 2, 4).reshape(B, mp * ps, n_kv, hd)
     v = gv.transpose(0, 1, 3, 2, 4).reshape(B, mp * ps, n_kv, hd)
     if n_rep > 1:

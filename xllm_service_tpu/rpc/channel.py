@@ -47,8 +47,8 @@ class EngineChannel:
                 if r.status_code == 200:
                     try:
                         return True, r.json()
-                    except json.JSONDecodeError:
-                        return True, r.text
+                    except ValueError:  # incl. requests' JSONDecodeError,
+                        return True, r.text   # else it'd retry as failure
                 err = f"HTTP {r.status_code}: {r.text[:200]}"
             except requests.RequestException as e:
                 err = str(e)
@@ -108,6 +108,21 @@ class EngineChannel:
     # ---- data plane (sync fallback; the frontend normally forwards async) --
     def forward(self, path: str, payload: dict[str, Any]) -> tuple[bool, Any]:
         return self._post(path, payload)
+
+    def forward_status(self, path: str,
+                       payload: dict[str, Any]) -> tuple[int, Any]:
+        """Single-shot POST preserving the engine's status code + body (for
+        proxied endpoints where 4xx/5xx must pass through to the client
+        instead of collapsing into a retry/False)."""
+        try:
+            r = self._session.post(self.base_url + path, json=payload,
+                                   timeout=self.timeout_s)
+        except requests.RequestException as e:
+            return 502, {"error": str(e)}
+        try:
+            return r.status_code, r.json()
+        except ValueError:   # covers requests' own JSONDecodeError too
+            return r.status_code, {"error": r.text[:300]}
 
     def close(self) -> None:
         self._session.close()

@@ -233,6 +233,11 @@ class Scheduler:
                               f"chat template error: {e}")
         if not request.token_ids and request.prompt:
             request.token_ids = self.tokenizer.encode(request.prompt)
+        elif request.sampling.echo and not request.prompt \
+                and request.token_ids:
+            # Completions `echo` with an array-of-token-ids prompt: OpenAI
+            # echoes the detokenized prompt text.
+            request.prompt = self.tokenizer.decode(request.token_ids)
         request.metrics.prompt_tokens = len(request.token_ids)
 
         routing = self.lb_policy.select_instances_pair(request)
