@@ -104,9 +104,9 @@ class Planner:
             d.reasons.append(
                 f"pressure={pressure:.2f} kv={kv_max:.2f}: scale out")
         elif pressure <= self.SCALE_IN_PRESSURE and waiting == 0 \
-                and n > self.MIN_FLEET and kv_max < 0.5:
+                and running == 0 and n > self.MIN_FLEET and kv_max < 0.5:
             d.scale_hint = -1
-            d.reasons.append(f"fleet idle (running={running}): scale in")
+            d.reasons.append("fleet idle: scale in")
         return self._finish(d)
 
     def _finish(self, d: PlanDecision) -> PlanDecision:
