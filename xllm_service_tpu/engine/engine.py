@@ -464,9 +464,11 @@ class InferenceEngine:
                     Kd + 1, dtype=jnp.int32)[None, :]
                 seq_lens = jnp.where(d["active"],
                                      jnp.minimum(room, Kd + 1), 0)
-                logits, kv = fam.verify_forward(
-                    params, mcfg, tokens, positions, d["kv"], d["pt"],
-                    prefix, seq_lens)
+                from ..ops.attention import mq_paged_verify
+                with mq_paged_verify():
+                    logits, kv = fam.verify_forward(
+                        params, mcfg, tokens, positions, d["kv"], d["pt"],
+                        prefix, seq_lens)
                 d = dict(d, kv=kv)
                 preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 match = (drafts == preds[:, :Kd]).astype(jnp.int32)

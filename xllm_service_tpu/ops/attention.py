@@ -44,6 +44,26 @@ def sequence_parallel_prefill(mesh, seq_axis: str = "seq"):
         _sp_ctx.cfg = prev
 
 
+# Speculative-verify context: the engine sets this while tracing its
+# verify program; `prefill_attention` may then route the short query
+# block through the multi-query paged Pallas kernel (pages-only read —
+# valid because the block KV is written before attention) instead of the
+# gather-based XLA path. Requires XLLM_MQ_PALLAS=1 + a TPU backend:
+# interpret-verified on CPU, Mosaic compile still to be validated on a
+# real chip.
+_mq_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def mq_paged_verify():
+    prev = getattr(_mq_ctx, "on", None)
+    _mq_ctx.on = True
+    try:
+        yield
+    finally:
+        _mq_ctx.on = prev
+
+
 # Context-parallel DECODE context: the engine activates this while tracing
 # its decode program when the KV pool is sharded over the seq axis;
 # `paged_attention` then routes through the flash-stats-merge CP op.
@@ -165,6 +185,19 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     n_rep = n_heads // n_kv
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
+
+    if getattr(_mq_ctx, "on", None) and k_pages is not None:
+        import os
+
+        if (os.environ.get("XLLM_MQ_PALLAS", "") == "1"
+                and jax.default_backend() != "cpu"
+                and scale == 1.0 / (hd ** 0.5)
+                and hd % 128 == 0 and n_heads % n_kv == 0):
+            from .pallas_mq_paged_attention import mq_paged_attention_pallas
+
+            return mq_paged_attention_pallas(q, k_pages, v_pages,
+                                             page_table, prefix_lens,
+                                             seq_lens)
 
     sp = getattr(_sp_ctx, "cfg", None)
     if sp is not None:

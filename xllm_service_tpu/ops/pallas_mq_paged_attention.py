@@ -1,0 +1,189 @@
+"""Pallas TPU multi-query paged attention (speculative-verify kernel).
+
+The spec-verify forward attends a SHORT query block (last accepted token
++ drafts, S_q <= ~32) per sequence against that sequence's paged KV. The
+XLA fallback gathers every sequence's full page span to dense tensors —
+memory-bound at large batch*context. This kernel walks only the occupied
+pages with the same double-buffered page-DMA structure as the decode
+kernel (`pallas_paged_attention.py`), adding a per-query causal offset:
+query s (at absolute position prefix + s) may attend key positions
+<= prefix + s.
+
+Assumes the block's own K/V have already been written into the pages
+(true in `prefill_from_embeddings`: `write_prefill_kv` runs before
+attention), so the pages hold the full context = prefix + block and the
+kernel never needs the separate suffix K/V tensors.
+
+Gated OFF by default (XLLM_MQ_PALLAS=1 to enable on TPU): correctness is
+interpret-verified on CPU; Mosaic compilation must be validated on a real
+chip before it becomes a default path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(page_table_ref, prefix_ref, block_ref,    # scalar prefetch
+            q_ref,                                    # [1, Sq, n_q, hd]
+            k_hbm, v_hbm,                             # pools in HBM/ANY
+            o_ref,                                    # [1, Sq, n_q, hd]
+            k_buf, v_buf, sems, m_scr, l_scr, acc_scr,
+            *, page_size: int, n_kv: int, group: int, scale: float,
+            max_pages: int, chunk: int, s_q: int):
+    b = pl.program_id(0)
+    prefix = prefix_ref[b]
+    blk = block_ref[b]                 # valid queries in this row's block
+    ctx = prefix + blk                 # total written context
+    n_pages = jnp.minimum(pl.cdiv(ctx, page_size), max_pages)
+    n_chunks = pl.cdiv(n_pages, chunk)
+
+    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def start_chunk(slot, c):
+        base = c * chunk
+        for j in range(chunk):
+            p = base + j
+
+            @pl.when(p < n_pages)
+            def _():
+                page = page_table_ref[b, p]
+                pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot, j],
+                                      sems.at[slot, 0]).start()
+                pltpu.make_async_copy(v_hbm.at[page], v_buf.at[slot, j],
+                                      sems.at[slot, 1]).start()
+
+    def wait_chunk(slot, c):
+        base = c * chunk
+        for j in range(chunk):
+            p = base + j
+
+            @pl.when(p < n_pages)
+            def _():
+                page = page_table_ref[b, p]
+                pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot, j],
+                                      sems.at[slot, 0]).wait()
+                pltpu.make_async_copy(v_hbm.at[page], v_buf.at[slot, j],
+                                      sems.at[slot, 1]).wait()
+
+    @pl.when(n_chunks > 0)
+    def _run():
+        start_chunk(0, 0)
+
+        def body(c, _):
+            slot = jax.lax.rem(c, 2)
+
+            @pl.when(c + 1 < n_chunks)
+            def _prefetch():
+                start_chunk(1 - slot, c + 1)
+
+            wait_chunk(slot, c)
+
+            span = chunk * page_size
+            start = c * span
+            # Query s sits at absolute position prefix + s; it may attend
+            # keys at positions <= prefix + s. Rows are (s, g) flattened.
+            key_pos = start + jax.lax.broadcasted_iota(
+                jnp.int32, (s_q * group, span), 1)
+            q_row_pos = prefix + jax.lax.broadcasted_iota(
+                jnp.int32, (s_q * group, span), 0) // group
+            mask = key_pos <= q_row_pos
+            for kv in range(n_kv):
+                # [Sq, G, hd] -> [Sq*G, hd] query rows for this KV head.
+                qh = q_ref[0, :, kv * group:(kv + 1) * group, :] \
+                    .astype(jnp.float32).reshape(s_q * group, -1) * scale
+                k = k_buf[slot, :, kv].astype(jnp.float32).reshape(span, -1)
+                v = v_buf[slot, :, kv].astype(jnp.float32).reshape(span, -1)
+                vmask = (start + jax.lax.broadcasted_iota(
+                    jnp.int32, (span, 1), 0)) < ctx
+                v = jnp.where(vmask, v, 0.0)
+                s = jax.lax.dot_general(
+                    qh, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)   # [Sq*G, span]
+                s = jnp.where(mask, s, _NEG_INF)
+                rows = slice(kv * s_q * group, (kv + 1) * s_q * group)
+                m_prev = m_scr[rows, :1]
+                l_prev = l_scr[rows, :1]
+                m_cur = jnp.max(s, axis=1, keepdims=True)
+                m_new = jnp.maximum(m_prev, m_cur)
+                alpha = jnp.exp(m_prev - m_new)
+                p_ = jnp.exp(s - m_new)
+                p_ = jnp.where(s <= _NEG_INF / 2, 0.0, p_)
+                l_new = l_prev * alpha + jnp.sum(p_, axis=1, keepdims=True)
+                acc_scr[rows, :] = acc_scr[rows, :] * alpha + \
+                    jax.lax.dot_general(p_, v, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+                m_scr[rows, :1] = m_new
+                l_scr[rows, :1] = l_new
+            return ()
+
+        jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
+
+    l = jnp.maximum(l_scr[:, :1], 1e-9)
+    out = acc_scr[...] / l                         # [n_kv*Sq*G, hd]
+    n_q = o_ref.shape[2]
+    hd = o_ref.shape[3]
+    # rows are (kv, s, g): reshape back to [Sq, n_q, hd].
+    out = out.reshape(n_kv, s_q, group, hd).transpose(1, 0, 2, 3) \
+        .reshape(s_q, n_q, hd)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def mq_paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
+                              v_pages: jax.Array, page_table: jax.Array,
+                              prefix_lens: jax.Array,
+                              block_lens: jax.Array,
+                              interpret: bool = False) -> jax.Array:
+    """q: [B, Sq, n_q, hd] (short block per sequence); k/v_pages:
+    [pages, n_kv, ps, hd] holding prefix AND block KV; page_table:
+    [B, max_pages]; prefix_lens/block_lens: [B]. Returns [B, Sq, n_q, hd]
+    — causal over absolute positions, identical to the XLA
+    prefill_attention reference (tested)."""
+    B, s_q, n_q, hd = q.shape
+    _, n_kv, page_size, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    group = n_q // n_kv
+    scale = 1.0 / (hd ** 0.5)
+
+    chunk = min(8, max_pages)
+    kernel = functools.partial(_kernel, page_size=page_size, n_kv=n_kv,
+                               group=group, scale=scale,
+                               max_pages=max_pages, chunk=chunk, s_q=s_q)
+    rows = n_kv * s_q * group
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, s_q, n_q, hd), lambda b, pt, pf, bl: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, s_q, n_q, hd),
+                               lambda b, pt, pf, bl: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, n_kv, page_size, hd), k_pages.dtype),
+            pltpu.VMEM((2, chunk, n_kv, page_size, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((rows, 128), jnp.float32),   # m
+            pltpu.VMEM((rows, 128), jnp.float32),   # l
+            pltpu.VMEM((rows, hd), jnp.float32),    # acc
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, s_q, n_q, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(page_table, prefix_lens, block_lens, q, k_pages, v_pages)
