@@ -234,3 +234,67 @@ class TestMLA:
                 lambda p, *a: fam.prefill_forward(p, cfg, *a))(sharded, *args)
         np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestMoeSpecAndEmbed:
+    def test_moe_speculative_greedy_identical(self):
+        """Speculative decoding over the MoE (MLA) family must equal its
+        normal greedy output."""
+        import threading
+
+        from xllm_service_tpu.common.request import SamplingParams
+        from xllm_service_tpu.engine.config import EngineConfig
+        from xllm_service_tpu.engine.engine import (
+            EngineRequest,
+            InferenceEngine,
+        )
+        from xllm_service_tpu.models.deepseek_moe import tiny_mla_config
+
+        def mk(spec):
+            return InferenceEngine(EngineConfig(
+                model_id="tiny-moe", model_family="deepseek_moe",
+                model=tiny_mla_config(dtype=jnp.float32,
+                                      max_context_len=256),
+                num_pages=64, page_size=16, hash_block_size=32,
+                max_batch_size=2, max_seq_len=256,
+                prefill_buckets=(32, 64, 256), speculate_k=spec))
+
+        def run(engine, prompt, n=16):
+            done = threading.Event()
+            toks = []
+
+            def cb(out):
+                toks.extend(t for s in out.outputs for t in s.token_ids)
+                if out.finished:
+                    done.set()
+
+            engine.submit(EngineRequest(
+                "m", token_ids=prompt,
+                sampling=SamplingParams(max_tokens=n, temperature=0.0,
+                                        ignore_eos=True), on_output=cb))
+            for _ in range(400):
+                if done.is_set():
+                    break
+                engine.step()
+            assert done.is_set()
+            return toks
+
+        prompt = [5, 6, 7, 8] * 8
+        assert run(mk(4), prompt) == run(mk(0), prompt)
+
+    def test_moe_embed_forward(self):
+        from xllm_service_tpu.models.base import get_model_family
+        from xllm_service_tpu.models.deepseek_moe import tiny_moe_config
+
+        cfg = tiny_moe_config(dtype=jnp.float32)
+        fam = get_model_family("deepseek_moe")
+        params = fam.init_params(cfg, jax.random.PRNGKey(0))
+        toks = jnp.asarray([[5, 6, 7, 0], [9, 10, 0, 0]], jnp.int32)
+        lens = jnp.asarray([3, 2], jnp.int32)
+        v = fam.embed_forward(params, cfg, toks, lens)
+        assert v.shape == (2, cfg.hidden_size)
+        # Padding must not affect the pooled vector.
+        toks2 = jnp.asarray([[5, 6, 7, 99], [9, 10, 42, 77]], jnp.int32)
+        v2 = fam.embed_forward(params, cfg, toks2, lens)
+        np.testing.assert_allclose(np.asarray(v), np.asarray(v2),
+                                   rtol=1e-5, atol=1e-6)

@@ -288,10 +288,48 @@ def decode_forward(params, cfg, tokens, positions, kv_pages, page_table,
     return _unembed(params, cfg, x), kv_pages
 
 
+def verify_forward(params, cfg, tokens, positions, kv_pages, page_table,
+                   prefix_lens, seq_lens):
+    """Speculative verify for the MoE family: the prefill body already
+    handles short multi-token blocks against the paged cache (MLA or GQA);
+    this returns per-position logits [B, S, V]."""
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    x, kv_pages = _run_layers(params, cfg, x, kv_pages, "prefill",
+                              page_table, prefix_lens, seq_lens, positions,
+                              None)
+    return _unembed(params, cfg, x), kv_pages
+
+
+def embed_forward(params, cfg, tokens, seq_lens):
+    """Text embeddings (mean-pooled final hidden states): dense causal
+    forward over a throwaway page pool (the pool is written and discarded
+    — embeddings need no cache)."""
+    B, S = tokens.shape
+    page_size = 16
+    pages_needed = B * (-(-S // page_size)) + 1
+    kv = jnp.zeros((cfg.num_layers, 2, pages_needed, cfg.num_kv_heads,
+                    page_size, cfg.head_dim), cfg.dtype)
+    pt = (jnp.arange(B * (-(-S // page_size)), dtype=jnp.int32)
+          .reshape(B, -1) + 1)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :],
+                                 (B, S))
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    x, _ = _run_layers(params, cfg, x, kv, "prefill", pt,
+                       jnp.zeros((B,), jnp.int32), seq_lens, positions,
+                       None)
+    from ..ops.attention import rms_norm as _rms
+    x = _rms(x, params["final_norm"]["scale"], cfg.rms_eps)
+    mask = (jnp.arange(S)[None, :] < seq_lens[:, None])[..., None]
+    summed = jnp.sum(jnp.where(mask, x.astype(jnp.float32), 0.0), axis=1)
+    return summed / jnp.maximum(seq_lens[:, None], 1)
+
+
 register_model_family(ModelFamily(
     name="deepseek_moe",
     init_params=init_params,
     prefill_forward=prefill_forward,
     decode_forward=decode_forward,
     sharding_rules=MOE_STACKED_RULES,
+    verify_forward=verify_forward,
+    embed_forward=embed_forward,
 ))
