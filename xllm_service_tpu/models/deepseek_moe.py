@@ -187,6 +187,8 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 def _mla_attention(lp, cfg, h, mode, k_pages, v_pages, page_table,
                    prefix_lens, seq_lens, positions, context_lens):
+    """mode: "prefill" | "decode" | "dense" (dense = no paged cache at
+    all — the embeddings path; nothing is written)."""
     """MLA (DeepSeek-V2): the cache stores one [kv_lora_rank ‖ rope] latent
     per token; per-head K up-projection is absorbed into the query and the
     V up-projection applied after attention — so the existing paged
@@ -215,7 +217,11 @@ def _mla_attention(lp, cfg, h, mode, k_pages, v_pages, page_table,
     # True scale is over the uncompressed per-head key width.
     scale = 1.0 / ((dn + dr) ** 0.5)
 
-    if mode == "prefill":
+    if mode == "dense":
+        attn = prefill_attention(q_lat, entry, entry, None, None, None,
+                                 jnp.zeros(h.shape[:1], jnp.int32),
+                                 seq_lens, scale=scale)
+    elif mode == "prefill":
         k_pages, v_pages = write_prefill_kv(k_pages, v_pages, entry, entry,
                                             page_table, prefix_lens, seq_lens)
         attn = prefill_attention(q_lat, entry, entry, k_pages, v_pages,
@@ -238,17 +244,22 @@ def _run_layers(params, cfg, x, kv_pages, mode, page_table, prefix_lens,
     """Unrolled layer loop with in-place KV writebacks (see
     models/llama.py for why not `lax.scan`)."""
     use_mla = cfg.kv_lora_rank > 0
+    dense = kv_pages is None            # embeddings: no cache at all
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
         h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
-        k_pages, v_pages = kv_pages[l, 0], kv_pages[l, 1]
+        k_pages, v_pages = (None, None) if dense else             (kv_pages[l, 0], kv_pages[l, 1])
         if use_mla:
             attn, k_pages, v_pages = _mla_attention(
-                lp, cfg, h, mode, k_pages, v_pages, page_table,
-                prefix_lens, seq_lens, positions, context_lens)
+                lp, cfg, h, "dense" if dense else mode, k_pages, v_pages,
+                page_table, prefix_lens, seq_lens, positions, context_lens)
         else:
             q, k, v = _project_qkv(lp, h, cfg, positions)
-            if mode == "prefill":
+            if dense:
+                attn = prefill_attention(
+                    q, k, v, None, None, None,
+                    jnp.zeros(x.shape[:1], jnp.int32), seq_lens)
+            elif mode == "prefill":
                 k_pages, v_pages = write_prefill_kv(
                     k_pages, v_pages, k, v, page_table, prefix_lens,
                     seq_lens)
@@ -263,8 +274,9 @@ def _run_layers(params, cfg, x, kv_pages, mode, page_table, prefix_lens,
         x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         x = x + _moe_mlp(lp, h2, cfg)
-        kv_pages = jax.lax.dynamic_update_index_in_dim(
-            kv_pages, jnp.stack([k_pages, v_pages]), l, 0)
+        if not dense:
+            kv_pages = jax.lax.dynamic_update_index_in_dim(
+                kv_pages, jnp.stack([k_pages, v_pages]), l, 0)
     return x, kv_pages
 
 
@@ -301,20 +313,13 @@ def verify_forward(params, cfg, tokens, positions, kv_pages, page_table,
 
 
 def embed_forward(params, cfg, tokens, seq_lens):
-    """Text embeddings (mean-pooled final hidden states): dense causal
-    forward over a throwaway page pool (the pool is written and discarded
-    — embeddings need no cache)."""
+    """Text embeddings (mean-pooled final hidden states): fully dense
+    causal forward — no page pool is allocated or written."""
     B, S = tokens.shape
-    page_size = 16
-    pages_needed = B * (-(-S // page_size)) + 1
-    kv = jnp.zeros((cfg.num_layers, 2, pages_needed, cfg.num_kv_heads,
-                    page_size, cfg.head_dim), cfg.dtype)
-    pt = (jnp.arange(B * (-(-S // page_size)), dtype=jnp.int32)
-          .reshape(B, -1) + 1)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :],
                                  (B, S))
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
-    x, _ = _run_layers(params, cfg, x, kv, "prefill", pt,
+    x, _ = _run_layers(params, cfg, x, None, "prefill", None,
                        jnp.zeros((B,), jnp.int32), seq_lens, positions,
                        None)
     from ..ops.attention import rms_norm as _rms

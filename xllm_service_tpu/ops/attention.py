@@ -183,21 +183,27 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     B, S, n_heads, hd = q.shape
     n_kv = k.shape[2]
     n_rep = n_heads // n_kv
-    if scale is None:
-        scale = 1.0 / (hd ** 0.5)
 
-    if getattr(_mq_ctx, "on", None) and k_pages is not None:
+    # MQ Pallas gate BEFORE the default-scale computation: a caller
+    # passing an EXPLICIT scale (the MLA latent path, whose cache layout
+    # this GQA kernel must never see) is excluded by `scale is None`
+    # rather than by float comparison against the default.
+    if getattr(_mq_ctx, "on", None) and k_pages is not None \
+            and scale is None:
         import os
 
         if (os.environ.get("XLLM_MQ_PALLAS", "") == "1"
                 and jax.default_backend() != "cpu"
-                and scale == 1.0 / (hd ** 0.5)
+                and q.dtype in (jnp.bfloat16, jnp.float32)
                 and hd % 128 == 0 and n_heads % n_kv == 0):
             from .pallas_mq_paged_attention import mq_paged_attention_pallas
 
             return mq_paged_attention_pallas(q, k_pages, v_pages,
                                              page_table, prefix_lens,
                                              seq_lens)
+
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
 
     sp = getattr(_sp_ctx, "cfg", None)
     if sp is not None:

@@ -1,0 +1,43 @@
+"""Shared chunked page-DMA scaffolding for the paged-attention Pallas
+kernels (decode + multi-query verify): a 2-slot VMEM ring of
+`chunk`-page blocks, one async copy per page (pages are non-contiguous
+in HBM), waits batched per chunk. Extracted so a fix to the DMA pattern
+lands in every kernel at once."""
+
+from __future__ import annotations
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def make_chunk_dma(page_table_ref, b, n_pages, chunk,
+                   k_hbm, v_hbm, k_buf, v_buf, sems):
+    """Returns (start_chunk(slot, c), wait_chunk(slot, c))."""
+
+    def start_chunk(slot, c):
+        base = c * chunk
+        for j in range(chunk):
+            p = base + j
+
+            @pl.when(p < n_pages)
+            def _():
+                page = page_table_ref[b, p]
+                pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot, j],
+                                      sems.at[slot, 0]).start()
+                pltpu.make_async_copy(v_hbm.at[page], v_buf.at[slot, j],
+                                      sems.at[slot, 1]).start()
+
+    def wait_chunk(slot, c):
+        base = c * chunk
+        for j in range(chunk):
+            p = base + j
+
+            @pl.when(p < n_pages)
+            def _():
+                page = page_table_ref[b, p]
+                pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot, j],
+                                      sems.at[slot, 0]).wait()
+                pltpu.make_async_copy(v_hbm.at[page], v_buf.at[slot, j],
+                                      sems.at[slot, 1]).wait()
+
+    return start_chunk, wait_chunk

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_page_dma import make_chunk_dma
+
 _NEG_INF = -1e30
 
 
@@ -51,33 +53,9 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
     l_scr[...] = jnp.zeros_like(l_scr)
     acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def start_chunk(slot, c):
-        # One DMA per page (pages are non-contiguous), all signaling the
-        # slot's semaphores; waits are batched per chunk.
-        base = c * chunk
-        for j in range(chunk):
-            p = base + j
-
-            @pl.when(p < n_pages)
-            def _():
-                page = page_table_ref[b, p]
-                pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot, j],
-                                      sems.at[slot, 0]).start()
-                pltpu.make_async_copy(v_hbm.at[page], v_buf.at[slot, j],
-                                      sems.at[slot, 1]).start()
-
-    def wait_chunk(slot, c):
-        base = c * chunk
-        for j in range(chunk):
-            p = base + j
-
-            @pl.when(p < n_pages)
-            def _():
-                page = page_table_ref[b, p]
-                pltpu.make_async_copy(k_hbm.at[page], k_buf.at[slot, j],
-                                      sems.at[slot, 0]).wait()
-                pltpu.make_async_copy(v_hbm.at[page], v_buf.at[slot, j],
-                                      sems.at[slot, 1]).wait()
+    start_chunk, wait_chunk = make_chunk_dma(
+        page_table_ref, b, n_pages, chunk, k_hbm, v_hbm, k_buf, v_buf,
+        sems)
 
     @pl.when(n_chunks > 0)
     def _run():
