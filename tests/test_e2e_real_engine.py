@@ -311,3 +311,54 @@ class TestEcho:
                   if ln.startswith(b"data: ") and ln != b"data: [DONE]"]
         texts = [c["choices"][0]["text"] for c in chunks if c["choices"]]
         assert texts[0] == "echo this prompt"
+
+
+class TestAnthropicMessages:
+    def test_messages_non_stream(self, cluster):
+        """Anthropic Messages API over the chat pipeline (the reference
+        only acknowledges anthropic.proto as an engine contract; here it
+        is a served endpoint)."""
+        master, agent = cluster
+        base = _base(master)
+        r = requests.post(base + "/v1/messages", json={
+            "model": "tiny-llama", "max_tokens": 6,
+            "system": "You are terse.",
+            "messages": [{"role": "user", "content": "hello"}],
+            "temperature": 0, "ignore_eos": True,
+        }, timeout=120)
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["type"] == "message"
+        assert body["role"] == "assistant"
+        assert body["id"].startswith("msg_")
+        assert body["content"][0]["type"] == "text"
+        assert body["content"][0]["text"]
+        assert body["stop_reason"] == "max_tokens"
+        assert body["usage"]["input_tokens"] > 0
+        assert body["usage"]["output_tokens"] == 6
+
+    def test_messages_missing_max_tokens(self, cluster):
+        master, _ = cluster
+        r = requests.post(_base(master) + "/v1/messages", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "x"}]}, timeout=30)
+        assert r.status_code == 400
+
+    def test_messages_streaming_event_sequence(self, cluster):
+        master, _ = cluster
+        r = requests.post(_base(master) + "/v1/messages", json={
+            "model": "tiny-llama", "max_tokens": 5, "stream": True,
+            "messages": [{"role": "user",
+                          "content": [{"type": "text", "text": "hi"}]}],
+            "temperature": 0, "ignore_eos": True,
+        }, stream=True, timeout=120)
+        assert r.status_code == 200
+        events = []
+        for ln in r.iter_lines():
+            if ln.startswith(b"event: "):
+                events.append(ln[7:].decode())
+        assert events[0] == "message_start"
+        assert events[1] == "content_block_start"
+        assert "content_block_delta" in events
+        assert events[-3:] == ["content_block_stop", "message_delta",
+                               "message_stop"]

@@ -20,6 +20,11 @@ class ClientConnection(abc.ABC):
     stream: bool = False
 
     @abc.abstractmethod
+    def write_event(self, event: str, obj: dict[str, Any]) -> bool:
+        """Named SSE event (`event: <name>` framing — the Anthropic
+        Messages stream shape). Default: plain data write."""
+        return self.write(obj)
+
     def write(self, obj: dict[str, Any]) -> bool:
         """Deliver one payload (SSE chunk when streaming). Returns False if
         the client is gone."""
@@ -53,6 +58,7 @@ class CollectingConnection(ClientConnection):
     def __init__(self, stream: bool = False):
         self.stream = stream
         self.payloads: list[dict[str, Any]] = []
+        self.events: list[tuple[str, dict[str, Any]]] = []
         self.finished = False
         self.error: Optional[tuple[int, str]] = None
         self.disconnected = False
@@ -60,6 +66,13 @@ class CollectingConnection(ClientConnection):
     def write(self, obj: dict[str, Any]) -> bool:
         if self.disconnected:
             return False
+        self.payloads.append(obj)
+        return True
+
+    def write_event(self, event: str, obj: dict[str, Any]) -> bool:
+        if self.disconnected:
+            return False
+        self.events.append((event, obj))
         self.payloads.append(obj)
         return True
 

@@ -38,6 +38,12 @@ class AioConnection(ClientConnection):
         self._put(("data", obj))
         return True
 
+    def write_event(self, event: str, obj: dict[str, Any]) -> bool:
+        if self._disconnected:
+            return False
+        self._put(("event", (event, obj)))
+        return True
+
     def finish(self) -> bool:
         self._put((_FINISH, None))
         return not self._disconnected

@@ -115,6 +115,7 @@ class XllmHttpService:
         app = web.Application(middlewares=[self._readiness_middleware])
         app.router.add_post("/v1/completions", self.handle_completions)
         app.router.add_post("/v1/chat/completions", self.handle_chat)
+        app.router.add_post("/v1/messages", self.handle_messages)
         app.router.add_post("/v1/embeddings", self.handle_embeddings)
         app.router.add_get("/v1/models", self.handle_models)
         app.router.add_get("/metrics", self.handle_metrics)
@@ -164,6 +165,92 @@ class XllmHttpService:
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_generate(request, kind="chat")
+
+    async def handle_messages(self, http_req: web.Request) -> web.StreamResponse:
+        """Anthropic-style Messages API (`/v1/messages`): the reference
+        family acknowledges this surface only as an engine proto
+        (`anthropic.proto` in `proto/CMakeLists.txt:18-37`) with no
+        service route; here it is a first-class endpoint mapped onto the
+        chat pipeline with Anthropic request/response/stream framing."""
+        SERVER_REQUEST_IN_TOTAL.inc()
+        try:
+            body = await http_req.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            return _error_response(400, "request body must be a JSON object")
+        if not isinstance(body.get("max_tokens"), int) \
+                or body["max_tokens"] < 1:
+            return _error_response(400, "max_tokens is required")
+        msgs = body.get("messages")
+        if not isinstance(msgs, list) or not msgs:
+            return _error_response(400, "messages must be a non-empty list")
+
+        sp = _parse_sampling(body)
+        stops = body.get("stop_sequences")
+        if isinstance(stops, list):
+            sp.stop = [str(s) for s in stops]
+        req = Request(
+            service_request_id=generate_service_request_id("messages"),
+            request_id="msg_" + short_uuid(),
+            model=body.get("model", self.opts.model_id or ""),
+            stream=bool(body.get("stream", False)),
+            sampling=sp,
+        )
+        # Anthropic carries the system prompt out-of-band; normalize
+        # content blocks to the chat-template message shape.
+        norm: list[dict[str, Any]] = []
+        system = body.get("system")
+        if isinstance(system, str) and system:
+            norm.append({"role": "system", "content": system})
+        for m in msgs:
+            if not isinstance(m, dict):
+                return _error_response(400, "invalid message entry")
+            content = m.get("content")
+            if isinstance(content, list):
+                content = "".join(p.get("text", "") for p in content
+                                  if isinstance(p, dict)
+                                  and p.get("type") == "text")
+            norm.append({"role": m.get("role", "user"),
+                         "content": str(content or "")})
+        req.messages = norm
+        if self.tracer.enabled:
+            req.trace_callback = self.tracer.log
+            self.tracer.log(req.service_request_id, {"request": body})
+
+        status = await asyncio.get_running_loop().run_in_executor(
+            None, self.scheduler.schedule, req)
+        if not status.ok():
+            return _error_response(
+                503 if status.code.name == "UNAVAILABLE" else 400,
+                status.message, "service_unavailable"
+                if status.code.name == "UNAVAILABLE" else "invalid_request_error")
+
+        conn = AioConnection(asyncio.get_running_loop(), req.stream)
+        self.scheduler.record_new_request(req, conn, "anthropic")
+        enriched = {
+            "model": req.model,
+            "service_request_id": req.service_request_id,
+            "source_service_addr": self.scheduler.self_addr,
+            "token_ids": req.token_ids,
+            "max_tokens": body["max_tokens"],
+            "temperature": body.get("temperature", 1.0),
+            "stream": req.stream,
+            "messages": norm,
+            "stop": sp.stop,
+            "routing": {"prefill_name": req.routing.prefill_name,
+                        "decode_name": req.routing.decode_name,
+                        "encode_name": req.routing.encode_name},
+        }
+        if body.get("top_p") is not None:
+            enriched["top_p"] = body["top_p"]
+        if body.get("top_k") is not None:
+            enriched["top_k"] = body["top_k"]
+        task = asyncio.create_task(self._forward_to_instance(
+            req, conn, "/v1/chat/completions", enriched))
+        self._forward_tasks.add(task)
+        task.add_done_callback(self._forward_tasks.discard)
+        return await self._respond(http_req, req, conn, emit_done=False)
 
     async def _handle_generate(self, http_req: web.Request,
                                kind: str) -> web.StreamResponse:
@@ -271,7 +358,8 @@ class XllmHttpService:
                     finished=True))
 
     async def _respond(self, http_req: web.Request, req: Request,
-                       conn: AioConnection) -> web.StreamResponse:
+                       conn: AioConnection,
+                       emit_done: bool = True) -> web.StreamResponse:
         timeout = self.opts.request_timeout_s
         if req.stream:
             resp = web.StreamResponse()
@@ -283,7 +371,8 @@ class XllmHttpService:
                 while True:
                     tag, item = await asyncio.wait_for(conn.queue.get(), timeout)
                     if AioConnection.is_finish(tag):
-                        await resp.write(b"data: [DONE]\n\n")
+                        if emit_done:   # OpenAI framing; Anthropic streams
+                            await resp.write(b"data: [DONE]\n\n")
                         break
                     if tag == "error":
                         code, msg = item
@@ -292,6 +381,13 @@ class XllmHttpService:
                                 {"error": {"message": msg, "code": code}}
                             ).encode() + b"\n\n")
                         break
+                    if tag == "event":
+                        name, obj = item
+                        await resp.write(
+                            f"event: {name}\n".encode() +
+                            b"data: " + json.dumps(
+                                obj, ensure_ascii=False).encode() + b"\n\n")
+                        continue
                     await resp.write(
                         b"data: " + json.dumps(item, ensure_ascii=False).encode()
                         + b"\n\n")

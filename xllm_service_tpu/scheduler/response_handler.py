@@ -70,6 +70,16 @@ def _usage_dict(output: RequestOutput) -> Optional[dict[str, Any]]:
     }
 
 
+class AnthropicStreamState:
+    """Per-request Anthropic Messages stream bookkeeping."""
+
+    __slots__ = ("started", "block_open")
+
+    def __init__(self):
+        self.started = False
+        self.block_open = False
+
+
 @dataclass
 class ChatStreamState:
     """Per-request streaming parse state (reference
@@ -97,6 +107,81 @@ class ResponseHandler:
         self._tags = resolve_family_tags(model_id, tool_call_parser,
                                          reasoning_parser)
         self._enable_parsing = enable_parsing
+
+    # ----------------------------------------------- Anthropic Messages
+    @staticmethod
+    def _anthropic_stop_reason(finish: str) -> str:
+        return "max_tokens" if finish == "length" else "end_turn"
+
+    def send_anthropic_delta(self, conn: ClientConnection,
+                             st: "AnthropicStreamState", request: Request,
+                             output: RequestOutput) -> bool:
+        """Anthropic Messages streaming: message_start →
+        content_block_start → content_block_delta* → content_block_stop →
+        message_delta → message_stop."""
+        if not st.started:
+            st.started = True
+            if not conn.write_event("message_start", {
+                    "type": "message_start",
+                    "message": {
+                        "id": request.request_id, "type": "message",
+                        "role": "assistant", "model": request.model,
+                        "content": [], "stop_reason": None,
+                        "usage": {"input_tokens":
+                                  request.metrics.prompt_tokens}}}):
+                return False
+        finish = ""
+        for seq in output.outputs:
+            if seq.finish_reason:
+                finish = seq.finish_reason
+            if not seq.text:
+                continue
+            if not st.block_open:
+                st.block_open = True
+                if not conn.write_event("content_block_start", {
+                        "type": "content_block_start", "index": 0,
+                        "content_block": {"type": "text", "text": ""}}):
+                    return False
+            if not conn.write_event("content_block_delta", {
+                    "type": "content_block_delta", "index": 0,
+                    "delta": {"type": "text_delta", "text": seq.text}}):
+                return False
+        if output.finished:
+            if st.block_open:
+                conn.write_event("content_block_stop",
+                                 {"type": "content_block_stop", "index": 0})
+            out_tokens = output.usage.num_generated_tokens \
+                if output.usage else request.num_generated_tokens
+            conn.write_event("message_delta", {
+                "type": "message_delta",
+                "delta": {"stop_reason":
+                          self._anthropic_stop_reason(finish),
+                          "stop_sequence": None},
+                "usage": {"output_tokens": out_tokens}})
+            conn.write_event("message_stop", {"type": "message_stop"})
+            return conn.finish()
+        return True
+
+    def send_anthropic_result(self, conn: ClientConnection,
+                              request: Request,
+                              output: RequestOutput) -> bool:
+        text = "".join(s.text for s in output.outputs)
+        finish = next((s.finish_reason for s in output.outputs
+                       if s.finish_reason), "")
+        usage = output.usage
+        return conn.write_and_finish({
+            "id": request.request_id, "type": "message",
+            "role": "assistant", "model": request.model,
+            "content": [{"type": "text", "text": text}],
+            "stop_reason": self._anthropic_stop_reason(finish),
+            "stop_sequence": None,
+            "usage": {
+                "input_tokens": usage.num_prompt_tokens if usage
+                else request.metrics.prompt_tokens,
+                "output_tokens": usage.num_generated_tokens if usage
+                else request.num_generated_tokens,
+            },
+        })
 
     def create_chat_stream_state(self, request: Request) -> ChatStreamState:
         return ChatStreamState(model=request.model,

@@ -263,6 +263,9 @@ class Scheduler:
         stream_state = None
         if kind == "chat" and request.stream:
             stream_state = self.response_handler.create_chat_stream_state(request)
+        elif kind == "anthropic" and request.stream:
+            from .response_handler import AnthropicStreamState
+            stream_state = AnthropicStreamState()
         st = _RequestState(request, conn, lane, kind, stream_state)
         with self._req_lock:
             self._requests[request.service_request_id] = st
@@ -374,6 +377,9 @@ class Scheduler:
             if st.kind == "chat":
                 ok = self.response_handler.send_chat_delta(
                     st.conn, st.stream_state, req, output)
+            elif st.kind == "anthropic":
+                ok = self.response_handler.send_anthropic_delta(
+                    st.conn, st.stream_state, req, output)
             else:
                 ok = self.response_handler.send_completion_delta(
                     st.conn, req, output)
@@ -383,6 +389,9 @@ class Scheduler:
                 final = self._final_output(st, output)
                 if st.kind == "chat":
                     ok = self.response_handler.send_chat_result(
+                        st.conn, req, final)
+                elif st.kind == "anthropic":
+                    ok = self.response_handler.send_anthropic_result(
                         st.conn, req, final)
                 else:
                     ok = self.response_handler.send_completion_result(
