@@ -47,6 +47,55 @@ class TestPallasPagedAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_fused_decode_step_parity(self):
+        """Fused append+attend kernel == scatter-then-attend: attention
+        output AND resulting pool contents must both match."""
+        from xllm_service_tpu.ops.pallas_fused_decode_attention import (
+            fused_decode_attention_pallas,
+        )
+
+        q, k_pages, v_pages, pt = _setup()
+        B, n_kv, hd = 4, 4, 128
+        for prev in ([10, 20, 30, 40],   # mid-page appends
+                     [0, 16, 31, 95],    # page starts/edges + pool-full row
+                     [0, 0, 0, 0]):      # empty contexts: first token ever
+            cl_prev = jnp.asarray(prev, jnp.int32)
+            k_new = jax.random.normal(jax.random.PRNGKey(9), (B, n_kv, hd))
+            v_new = jax.random.normal(jax.random.PRNGKey(10), (B, n_kv, hd))
+            kp_ref, vp_ref = write_decode_kv(k_pages, v_pages, k_new, v_new,
+                                             pt, cl_prev)
+            cl = cl_prev + 1
+            ref = paged_attention_xla(q, kp_ref, vp_ref, pt, cl)
+            got, kp_got, vp_got = fused_decode_attention_pallas(
+                q, k_new, v_new, k_pages, v_pages, pt, cl, interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+            np.testing.assert_array_equal(np.asarray(kp_got),
+                                          np.asarray(kp_ref))
+            np.testing.assert_array_equal(np.asarray(vp_got),
+                                          np.asarray(vp_ref))
+
+    def test_fused_decode_step_gqa(self):
+        from xllm_service_tpu.ops.pallas_fused_decode_attention import (
+            fused_decode_attention_pallas,
+        )
+
+        q, k_pages, v_pages, pt = _setup(n_q=16, n_kv=2)
+        B, n_kv, hd = 4, 2, 128
+        cl_prev = jnp.asarray([3, 40, 64, 95], jnp.int32)
+        k_new = jax.random.normal(jax.random.PRNGKey(4), (B, n_kv, hd))
+        v_new = jax.random.normal(jax.random.PRNGKey(5), (B, n_kv, hd))
+        kp_ref, vp_ref = write_decode_kv(k_pages, v_pages, k_new, v_new,
+                                         pt, cl_prev)
+        cl = cl_prev + 1
+        ref = paged_attention_xla(q, kp_ref, vp_ref, pt, cl)
+        got, kp_got, vp_got = fused_decode_attention_pallas(
+            q, k_new, v_new, k_pages, v_pages, pt, cl, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(kp_got), np.asarray(kp_ref))
+        np.testing.assert_array_equal(np.asarray(vp_got), np.asarray(vp_ref))
+
     def test_after_decode_write(self):
         """End-to-end shape: write one token then attend, both paths."""
         q, k_pages, v_pages, pt = _setup()

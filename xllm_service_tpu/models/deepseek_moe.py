@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import (
-    paged_attention,
+    decode_attention_step,
     prefill_attention,
     rms_norm,
     write_decode_kv,
@@ -266,10 +266,8 @@ def _run_layers(params, cfg, x, kv_pages, mode, page_table, prefix_lens,
                 attn = prefill_attention(q, k, v, k_pages, v_pages,
                                          page_table, prefix_lens, seq_lens)
             else:
-                k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
-                                                   page_table, positions)
-                attn = paged_attention(q, k_pages, v_pages, page_table,
-                                       context_lens)
+                attn, k_pages, v_pages = decode_attention_step(
+                    q, k, v, k_pages, v_pages, page_table, context_lens)
             attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)

@@ -15,10 +15,10 @@ import jax.numpy as jnp
 
 from ..ops.attention import (
     apply_rope,
+    decode_attention_step,
     paged_attention,
     prefill_attention,
     rms_norm,
-    write_decode_kv,
     write_prefill_kv,
 )
 from ..parallel.sharding import ShardingRules
@@ -228,14 +228,18 @@ def decode_forward(params: Params, cfg: ModelConfig,
     """One decode step. Returns (logits [B, V], updated kv_pages).
 
     Unrolled layer loop + in-place KV writebacks (see
-    prefill_from_embeddings for why not `lax.scan`). Set
-    XLLM_KV_WRITEBACK=scatter to write the token's K/V directly into the
-    full [L, 2, ...] pool instead of the per-layer slice/stack/update
-    pattern — numerically identical (parity-tested); which one XLA keeps
-    fully in-place differs per backend, so it is an env-flagged A/B for
-    TPU profiling (round-1 measured the slice/stack pattern fastest)."""
-    import os
-    scatter = os.environ.get("XLLM_KV_WRITEBACK", "") == "scatter"
+    prefill_from_embeddings for why not `lax.scan`). XLLM_KV_WRITEBACK
+    selects the write strategy — numerically identical (parity-tested),
+    perf A/B'd per backend:
+    - "" (default): per-layer slice/stack/update pattern (round-1
+      measured fastest on TPU among the XLA variants);
+    - "scatter": write the token's K/V directly into the full [L, 2, ...]
+      pool;
+    - "fused": single Pallas kernel doing append + paged attention
+      (ops/pallas_fused_decode_attention.py) — no separate scatter op,
+      the HBM append DMA overlaps the page walk."""
+    from ..ops.attention import kv_writeback_mode
+    scatter = kv_writeback_mode() == "scatter"
     page_size = kv_pages.shape[4]
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)   # [B, D]
 
@@ -252,12 +256,12 @@ def decode_forward(params: Params, cfg: ModelConfig,
             kv_pages = kv_pages.at[l, 1, page_idx, :, slot, :].set(
                 v, mode="drop")
             k_pages, v_pages = kv_pages[l, 0], kv_pages[l, 1]
+            attn = paged_attention(q, k_pages, v_pages, page_table,
+                                   context_lens)
         else:
-            k_pages, v_pages = kv_pages[l, 0], kv_pages[l, 1]
-            k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
-                                               page_table, positions)
-        attn = paged_attention(q, k_pages, v_pages, page_table,
-                               context_lens)
+            attn, k_pages, v_pages = decode_attention_step(
+                q, k, v, kv_pages[l, 0], kv_pages[l, 1],
+                page_table, context_lens)
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)

@@ -13,9 +13,10 @@ from .attention import (
     paged_attention_xla,
     write_prefill_kv,
     write_decode_kv,
+    decode_attention_step,
 )
 
 __all__ = [
     "rms_norm", "apply_rope", "prefill_attention", "paged_attention_xla",
-    "write_prefill_kv", "write_decode_kv",
+    "write_prefill_kv", "write_decode_kv", "decode_attention_step",
 ]

@@ -193,9 +193,7 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         import os
 
         if (os.environ.get("XLLM_MQ_PALLAS", "") == "1"
-                and jax.default_backend() != "cpu"
-                and q.dtype in (jnp.bfloat16, jnp.float32)
-                and hd % 128 == 0 and n_heads % n_kv == 0):
+                and _mosaic_kernel_ok(q, k_pages)):
             from .pallas_mq_paged_attention import mq_paged_attention_pallas
 
             return mq_paged_attention_pallas(q, k_pages, v_pages,
@@ -248,6 +246,83 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+_warned_writeback_modes: set[str] = set()
+
+
+def kv_writeback_mode() -> str:
+    """The single reader for the XLLM_KV_WRITEBACK decode A/B switch.
+
+    Valid values: "" (per-layer slice/stack/update), "scatter" (direct
+    write into the full stacked pool — handled at the model layer, which
+    owns the [L, 2, ...] array), "fused" (single Pallas append+attend
+    kernel, `decode_attention_step`). An unrecognized value falls back to
+    the default with a one-time warning instead of silently acting like
+    an unset flag."""
+    import logging
+    import os
+
+    mode = os.environ.get("XLLM_KV_WRITEBACK", "")
+    if mode not in ("", "scatter", "fused"):
+        if mode not in _warned_writeback_modes:
+            _warned_writeback_modes.add(mode)
+            logging.getLogger(__name__).warning(
+                "XLLM_KV_WRITEBACK=%r is not one of '', 'scatter', "
+                "'fused'; using the default writeback", mode)
+        return ""
+    return mode
+
+
+def _mosaic_kernel_ok(q: jax.Array, k_pages: jax.Array) -> bool:
+    """Shared eligibility gate for the hand-written attention kernels:
+    Mosaic tiling needs the head dim to be a lane-width multiple and GQA
+    an integer group size; the kill switch and CPU backend exclude all
+    Pallas paths at once."""
+    import os
+
+    n_heads, hd = q.shape[-2], q.shape[-1]
+    n_kv = k_pages.shape[1]
+    return (hd % 128 == 0 and n_heads % n_kv == 0
+            and q.dtype in (jnp.bfloat16, jnp.float32)
+            and jax.default_backend() != "cpu"
+            and os.environ.get("XLLM_DISABLE_PALLAS_ATTENTION", "")
+            in ("", "0"))
+
+
+def decode_attention_step(q: jax.Array, k: jax.Array, v: jax.Array,
+                          k_pages: jax.Array, v_pages: jax.Array,
+                          page_table: jax.Array, context_lens: jax.Array,
+                          ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Append one token's K/V and attend, as one step.
+
+    q: [B, n_heads, hd]; k/v: [B, n_kv, hd] — the new token, written at
+    position ``context_lens[b] - 1`` (context_lens INCLUDE it, matching
+    the engine decode path's ``positions = clens - 1``); attention covers
+    positions < ``context_lens[b]``. Returns (attn [B, n_heads, hd],
+    k_pages, v_pages).
+
+    Under ``XLLM_KV_WRITEBACK=fused`` on an accelerator this routes
+    through the single fused Pallas kernel (one HBM append DMA overlapped
+    with the page walk, no separate scatter); otherwise scatter-then-
+    attend with identical numerics (parity-tested). The CP-decode context
+    keeps the unfused path — the pool is sharded there and the write must
+    land on the owning shard via the XLA scatter.
+    """
+    if (kv_writeback_mode() == "fused"
+            and getattr(_cp_ctx, "cfg", None) is None
+            and _mosaic_kernel_ok(q, k_pages)):
+        from .pallas_fused_decode_attention import (
+            fused_decode_attention_pallas,
+        )
+
+        return fused_decode_attention_pallas(
+            q, k, v, k_pages, v_pages, page_table, context_lens)
+    positions = context_lens - 1
+    k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
+                                       page_table, positions)
+    attn = paged_attention(q, k_pages, v_pages, page_table, context_lens)
+    return attn, k_pages, v_pages
+
+
 # ------------------------------------------------------------ decode attn
 def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                         page_table: jax.Array,
@@ -286,8 +361,6 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     test meshes) and for shapes outside the kernel's tiling constraints.
     Selection happens at trace time — all paths are numerically
     equivalent (tested)."""
-    import os
-
     cp = getattr(_cp_ctx, "cfg", None)
     if cp is not None:
         from .cp_paged_attention import cp_paged_attention
@@ -296,14 +369,7 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         return cp_paged_attention(q, k_pages, v_pages, page_table,
                                   context_lens, mesh, seq_axis=seq_axis)
 
-    n_heads, hd = q.shape[-2], q.shape[-1]
-    n_kv = k_pages.shape[1]
-    # Mosaic tiling: the last dim of a VMEM page slice must be a multiple
-    # of 128 (lane width); GQA grouping needs n_heads % n_kv == 0.
-    kernel_ok = (hd % 128 == 0 and n_heads % n_kv == 0
-                 and q.dtype in (jnp.bfloat16, jnp.float32))
-    if kernel_ok and jax.default_backend() != "cpu" and \
-            os.environ.get("XLLM_DISABLE_PALLAS_ATTENTION", "") in ("", "0"):
+    if _mosaic_kernel_ok(q, k_pages):
         from .pallas_paged_attention import paged_attention_pallas
 
         return paged_attention_pallas(q, k_pages, v_pages, page_table,

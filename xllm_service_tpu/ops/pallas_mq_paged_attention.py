@@ -28,9 +28,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_page_dma import make_chunk_dma
-
-_NEG_INF = -1e30
+from .pallas_page_dma import (
+    NEG_INF as _NEG_INF,
+    flash_accumulate,
+    make_chunk_dma,
+    masked_kv_f32,
+)
 
 
 def _kernel(page_table_ref, prefix_ref, block_ref,    # scalar prefetch
@@ -81,29 +84,14 @@ def _kernel(page_table_ref, prefix_ref, block_ref,    # scalar prefetch
                 # [Sq, G, hd] -> [Sq*G, hd] query rows for this KV head.
                 qh = q_ref[0, :, kv * group:(kv + 1) * group, :] \
                     .astype(jnp.float32).reshape(s_q * group, -1) * scale
-                k = k_buf[slot, :, kv].astype(jnp.float32).reshape(span, -1)
-                v = v_buf[slot, :, kv].astype(jnp.float32).reshape(span, -1)
-                vmask = (start + jax.lax.broadcasted_iota(
-                    jnp.int32, (span, 1), 0)) < ctx
-                v = jnp.where(vmask, v, 0.0)
+                k, v = masked_kv_f32(k_buf, v_buf, slot, kv, start, ctx)
                 s = jax.lax.dot_general(
                     qh, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)   # [Sq*G, span]
                 s = jnp.where(mask, s, _NEG_INF)
-                rows = slice(kv * s_q * group, (kv + 1) * s_q * group)
-                m_prev = m_scr[rows, :1]
-                l_prev = l_scr[rows, :1]
-                m_cur = jnp.max(s, axis=1, keepdims=True)
-                m_new = jnp.maximum(m_prev, m_cur)
-                alpha = jnp.exp(m_prev - m_new)
-                p_ = jnp.exp(s - m_new)
-                p_ = jnp.where(s <= _NEG_INF / 2, 0.0, p_)
-                l_new = l_prev * alpha + jnp.sum(p_, axis=1, keepdims=True)
-                acc_scr[rows, :] = acc_scr[rows, :] * alpha + \
-                    jax.lax.dot_general(p_, v, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-                m_scr[rows, :1] = m_new
-                l_scr[rows, :1] = l_new
+                flash_accumulate(
+                    slice(kv * s_q * group, (kv + 1) * s_q * group),
+                    s, v, m_scr, l_scr, acc_scr)
             return ()
 
         jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)

@@ -1,13 +1,23 @@
-"""Shared chunked page-DMA scaffolding for the paged-attention Pallas
-kernels (decode + multi-query verify): a 2-slot VMEM ring of
-`chunk`-page blocks, one async copy per page (pages are non-contiguous
-in HBM), waits batched per chunk. Extracted so a fix to the DMA pattern
-lands in every kernel at once."""
+"""Shared scaffolding for the paged-attention Pallas kernels (decode,
+fused decode-append, multi-query verify):
+
+- `make_chunk_dma`: a 2-slot VMEM ring of `chunk`-page blocks, one async
+  copy per page (pages are non-contiguous in HBM), waits batched per
+  chunk;
+- `masked_kv_f32` / `flash_accumulate`: the per-head chunk read and the
+  online-softmax (flash) m/l/acc update.
+
+Extracted so a fix to the DMA pattern or the accumulate numerics lands
+in every kernel at once."""
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
 
 
 def make_chunk_dma(page_table_ref, b, n_pages, chunk,
@@ -41,3 +51,39 @@ def make_chunk_dma(page_table_ref, b, n_pages, chunk,
                                       sems.at[slot, 1]).wait()
 
     return start_chunk, wait_chunk
+
+
+def masked_kv_f32(k_buf, v_buf, slot, kv, start, bound):
+    """Read one KV head's chunk from the ring as f32 ``[span, hd]``,
+    zeroing V rows at positions >= ``bound``: their probabilities are 0,
+    but 0 x garbage from never-DMA'd (or concurrently written) sub-buffers
+    must not reach the accumulator (0 x NaN = NaN). Column-oriented iota
+    (Mosaic cannot transpose 1-bit vectors)."""
+    k = k_buf[slot, :, kv].astype(jnp.float32)
+    span = k.shape[0] * k.shape[1]
+    k = k.reshape(span, -1)
+    v = v_buf[slot, :, kv].astype(jnp.float32).reshape(span, -1)
+    vmask = (start + jax.lax.broadcasted_iota(
+        jnp.int32, (span, 1), 0)) < bound
+    return k, jnp.where(vmask, v, 0.0)
+
+
+def flash_accumulate(rows, s, v, m_scr, l_scr, acc_scr):
+    """Online-softmax update of the (m, l, acc) scratch rows with masked
+    scores ``s: [R, span]`` and values ``v: [span, hd]``. Fully-masked
+    rows are exact: p is re-zeroed where s is the mask sentinel, so a row
+    whose every key is masked in this chunk contributes nothing (without
+    the guard, exp(NEG_INF - NEG_INF) = 1 would pollute l/acc)."""
+    m_prev = m_scr[rows, :1]
+    l_prev = l_scr[rows, :1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p_ = jnp.exp(s - m_new)
+    p_ = jnp.where(s <= NEG_INF / 2, 0.0, p_)
+    l_new = l_prev * alpha + jnp.sum(p_, axis=1, keepdims=True)
+    acc_scr[rows, :] = acc_scr[rows, :] * alpha + \
+        jax.lax.dot_general(p_, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    m_scr[rows, :1] = m_new
+    l_scr[rows, :1] = l_new
