@@ -54,8 +54,10 @@ def run(L=4, pages=1024, n_kv=4, ps=16, hd=64, B=8, steps=30):
             pool, kk = fn(pool, k, kk)
         jax.block_until_ready(pool)
         dt = (time.perf_counter() - t0) / steps
-        print(f"{name}: {dt*1e3:.3f} ms/step "
-              f"(pool {pool.nbytes/1e6:.0f} MB)")
+        import json
+        print(json.dumps({"variant": name, "ms_per_step": round(dt * 1e3, 3),
+                          "pool_mb": round(pool.nbytes / 1e6),
+                          "backend": jax.default_backend()}))
 
 
 if __name__ == "__main__":

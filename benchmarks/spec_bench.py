@@ -78,7 +78,8 @@ def main() -> None:
 
     print(json.dumps({"metric": "speculative_speedup",
                       "value": round(results[4] / results[0], 3),
-                      "unit": "x"}))
+                      "unit": "x",
+                      "backend": jax.default_backend()}))
 
 
 if __name__ == "__main__":

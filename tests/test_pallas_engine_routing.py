@@ -1,0 +1,68 @@
+"""Engine-level Pallas routing, hermetic on CPU.
+
+XLLM_PALLAS_INTERPRET=1 makes the dispatch gates treat the CPU backend as
+kernel-capable and run every Pallas kernel in interpret mode, so these
+tests drive the REAL trace-time routing (fused decode writeback, Pallas
+chunked-prefill attention) end-to-end through the engine and compare
+greedy outputs against the default XLA paths. Tiny 1-layer config with
+head_dim=128 (the Mosaic lane-width requirement the gates check).
+"""
+
+import jax.numpy as jnp
+
+from xllm_service_tpu.common.request import SamplingParams
+from xllm_service_tpu.engine.config import EngineConfig
+from xllm_service_tpu.engine.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.models.base import tiny_config
+
+from test_engine import Collector, run_requests
+
+
+def _pallas_capable_engine(**kw) -> InferenceEngine:
+    cfg = EngineConfig(
+        model=tiny_config(dtype=jnp.float32, hidden_size=128,
+                          num_heads=2, num_kv_heads=1, head_dim=128,
+                          num_layers=1, ffn_size=128,
+                          max_context_len=128),
+        num_pages=40, page_size=16, hash_block_size=32,
+        max_batch_size=2, max_seq_len=128, prefill_buckets=(16, 32, 128),
+        decode_horizon=4, **kw)
+    return InferenceEngine(cfg)
+
+
+def _greedy(engine, prompt, n=6):
+    col = Collector()
+    req = EngineRequest(service_request_id="r0", token_ids=list(prompt),
+                        sampling=SamplingParams(max_tokens=n,
+                                                temperature=0.0),
+                        on_output=col)
+    run_requests(engine, [req])
+    return col.tokens
+
+
+PROMPT = [7, 11, 13, 17, 19, 23, 29, 31, 37, 41]
+
+
+class TestPallasEngineRouting:
+    def test_fused_decode_writeback_matches_default(self, monkeypatch):
+        baseline = _greedy(_pallas_capable_engine(), PROMPT)
+        assert len(baseline) == 6
+        monkeypatch.setenv("XLLM_PALLAS_INTERPRET", "1")
+        monkeypatch.setenv("XLLM_KV_WRITEBACK", "fused")
+        fused = _greedy(_pallas_capable_engine(), PROMPT)
+        assert fused == baseline
+
+    def test_pallas_prefill_matches_default(self, monkeypatch):
+        baseline = _greedy(_pallas_capable_engine(), PROMPT)
+        monkeypatch.setenv("XLLM_PALLAS_INTERPRET", "1")
+        monkeypatch.setenv("XLLM_PREFILL_PALLAS", "1")
+        routed = _greedy(_pallas_capable_engine(), PROMPT)
+        assert routed == baseline
+
+    def test_all_pallas_paths_together(self, monkeypatch):
+        baseline = _greedy(_pallas_capable_engine(), PROMPT)
+        monkeypatch.setenv("XLLM_PALLAS_INTERPRET", "1")
+        monkeypatch.setenv("XLLM_KV_WRITEBACK", "fused")
+        monkeypatch.setenv("XLLM_PREFILL_PALLAS", "1")
+        routed = _greedy(_pallas_capable_engine(), PROMPT)
+        assert routed == baseline

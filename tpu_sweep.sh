@@ -16,23 +16,32 @@ assert jax.default_backend() != "cpu"
 EOF
 }
 
-# run <name> <timeout_s> <cmd...>: run one step, then verify the relay is
-# still up. Returns nonzero (flake / step failure) — caller restarts.
+# run <name> <timeout_s> <cmd...>: run one step, then verify it really ran
+# on the TPU (every bench emits a "backend" field) and the relay is still
+# up. Returns nonzero (flake) — caller restarts the loop.
 FAILED_STEPS=""
 run_step() {
   local name="$1" to="$2"; shift 2
   timeout "$to" "$@" > "tpu_results/$name.json" 2> "tpu_results/$name.err"
   local rc=$?
   echo "$name rc=$rc $(head -c 200 "tpu_results/$name.json")"
+  if [ "$rc" -ne 0 ]; then
+    # The step itself failed (OOM, crash, timeout): record it and keep
+    # going — a retry would fail the same way. The final exit code
+    # reflects any such failure so 'sweep complete' can't mask it.
+    FAILED_STEPS="$FAILED_STEPS $name(rc=$rc)"
+    return 0
+  fi
+  # A step that started while the relay was down silently initializes the
+  # CPU backend even if the relay recovers mid-run: reject any artifact
+  # that doesn't claim the tpu backend (every bench emits "backend").
+  if ! grep -q '"backend": "tpu"' "tpu_results/$name.json"; then
+    echo "step $name did not run on TPU — restarting sweep loop"
+    return 1
+  fi
   if ! probe; then
     echo "relay died after step $name — restarting sweep loop"
     return 1
-  fi
-  # Relay is up but the step itself failed (OOM, crash, timeout): record
-  # it and keep going — a retry would fail the same way. The final exit
-  # code reflects any such failure so 'sweep complete' can't mask it.
-  if [ "$rc" -ne 0 ]; then
-    FAILED_STEPS="$FAILED_STEPS $name(rc=$rc)"
   fi
   return 0
 }
@@ -40,12 +49,9 @@ run_step() {
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
     echo "=== relay alive at $(date) ==="
+    FAILED_STEPS=""
     # 1. bench.py (the driver contract number)
     run_step bench 900 python bench.py || { sleep 60; continue; }
-    if ! grep -q '"backend": "tpu"' tpu_results/bench.json; then
-      echo "bench fell back to CPU; relay flaked mid-run — retrying loop"
-      sleep 60; continue
-    fi
     # 2. fused append+attend decode kernel (Mosaic validation + A/B vs 1.)
     run_step bench_fused 900 env XLLM_KV_WRITEBACK=fused python bench.py \
       || { sleep 60; continue; }

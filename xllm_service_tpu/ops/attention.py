@@ -184,21 +184,35 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     n_kv = k.shape[2]
     n_rep = n_heads // n_kv
 
-    # MQ Pallas gate BEFORE the default-scale computation: a caller
+    # Pallas MQ gate BEFORE the default-scale computation: a caller
     # passing an EXPLICIT scale (the MLA latent path, whose cache layout
     # this GQA kernel must never see) is excluded by `scale is None`
-    # rather than by float comparison against the default.
-    if getattr(_mq_ctx, "on", None) and k_pages is not None \
-            and scale is None:
+    # rather than by float comparison against the default. Two users:
+    # - the speculative-verify program (traced under `mq_paged_verify`,
+    #   XLLM_MQ_PALLAS=1);
+    # - chunked/prefix prefill (XLLM_PREFILL_PALLAS=1): the XLA fallback
+    #   gathers every row's full page span dense — [B, H, S, prefix+S]
+    #   scores in HBM, which at long contexts dwarfs the chunk itself.
+    # Both share the kernel's invariant (block KV already written to the
+    # pages — write_prefill_kv runs first in prefill_from_embeddings) and
+    # both are excluded under the ring-attention (sp) trace context. The
+    # rows cap keeps the kernel's [S*n_heads, hd] f32 accumulator and
+    # m/l scratch inside VMEM; bigger chunks fall back to XLA.
+    if k_pages is not None and scale is None \
+            and getattr(_sp_ctx, "cfg", None) is None:
         import os
 
-        if (os.environ.get("XLLM_MQ_PALLAS", "") == "1"
-                and _mosaic_kernel_ok(q, k_pages)):
+        mq_on = (getattr(_mq_ctx, "on", None)
+                 and os.environ.get("XLLM_MQ_PALLAS", "") == "1")
+        pf_on = (os.environ.get("XLLM_PREFILL_PALLAS", "") == "1"
+                 and S * n_heads <= 4096)
+        if (mq_on or pf_on) and _mosaic_kernel_ok(q, k_pages):
             from .pallas_mq_paged_attention import mq_paged_attention_pallas
 
             return mq_paged_attention_pallas(q, k_pages, v_pages,
                                              page_table, prefix_lens,
-                                             seq_lens)
+                                             seq_lens,
+                                             interpret=_pallas_interpret())
 
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
@@ -272,6 +286,16 @@ def kv_writeback_mode() -> str:
     return mode
 
 
+def _pallas_interpret() -> bool:
+    """XLLM_PALLAS_INTERPRET=1 runs the Pallas kernels in interpret mode
+    and lets the dispatch gates treat the CPU backend as kernel-capable —
+    so tests exercise the REAL kernel routing hermetically (slow; tiny
+    shapes only)."""
+    import os
+
+    return os.environ.get("XLLM_PALLAS_INTERPRET", "") == "1"
+
+
 def _mosaic_kernel_ok(q: jax.Array, k_pages: jax.Array) -> bool:
     """Shared eligibility gate for the hand-written attention kernels:
     Mosaic tiling needs the head dim to be a lane-width multiple and GQA
@@ -283,7 +307,7 @@ def _mosaic_kernel_ok(q: jax.Array, k_pages: jax.Array) -> bool:
     n_kv = k_pages.shape[1]
     return (hd % 128 == 0 and n_heads % n_kv == 0
             and q.dtype in (jnp.bfloat16, jnp.float32)
-            and jax.default_backend() != "cpu"
+            and (jax.default_backend() != "cpu" or _pallas_interpret())
             and os.environ.get("XLLM_DISABLE_PALLAS_ATTENTION", "")
             in ("", "0"))
 
@@ -315,7 +339,8 @@ def decode_attention_step(q: jax.Array, k: jax.Array, v: jax.Array,
         )
 
         return fused_decode_attention_pallas(
-            q, k, v, k_pages, v_pages, page_table, context_lens)
+            q, k, v, k_pages, v_pages, page_table, context_lens,
+            interpret=_pallas_interpret())
     positions = context_lens - 1
     k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
                                        page_table, positions)
@@ -373,5 +398,6 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         from .pallas_paged_attention import paged_attention_pallas
 
         return paged_attention_pallas(q, k_pages, v_pages, page_table,
-                                      context_lens)
+                                      context_lens,
+                                      interpret=_pallas_interpret())
     return paged_attention_xla(q, k_pages, v_pages, page_table, context_lens)
