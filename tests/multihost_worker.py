@@ -1,0 +1,76 @@
+"""Worker process for the multi-host lockstep drill (test_multihost.py).
+
+Usage: multihost_worker.py <process_id> <num_processes> <coordinator_port>
+
+Every process builds the SAME engine over a global model=2 mesh and runs
+the lockstep driver; process 0 submits two greedy requests, collects
+their tokens, shuts the group down, and prints `RESULT {json}`. With
+num_processes=1 this is the single-process baseline: identical program,
+identical partitioning — only the transport differs — so the 2-process
+primary must reproduce its tokens exactly.
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    import jax
+
+    if nprocs > 1:
+        from xllm_service_tpu.parallel.multihost import initialize
+
+        initialize(f"127.0.0.1:{port}", nprocs, pid)
+
+    import jax.numpy as jnp
+
+    from xllm_service_tpu.common.request import SamplingParams
+    from xllm_service_tpu.engine.config import EngineConfig
+    from xllm_service_tpu.engine.engine import EngineRequest, InferenceEngine
+    from xllm_service_tpu.engine.multihost_driver import MultihostEngineDriver
+    from xllm_service_tpu.models.base import tiny_config
+    from xllm_service_tpu.parallel.mesh import MeshConfig
+
+    assert jax.device_count() == 2, jax.devices()
+    cfg = EngineConfig(
+        model=tiny_config(dtype=jnp.float32),
+        mesh=MeshConfig(model=2),
+        num_pages=64, page_size=16, hash_block_size=32,
+        max_batch_size=2, max_seq_len=128,
+        prefill_buckets=(32, 64, 128), decode_horizon=4)
+    engine = InferenceEngine(cfg)
+    driver = MultihostEngineDriver(engine)
+
+    if jax.process_index() == 0:
+        outs: dict[str, list[int]] = {}
+        done: set[str] = set()
+
+        def collector(rid):
+            def cb(out):
+                for s in out.outputs:
+                    outs.setdefault(rid, []).extend(s.token_ids)
+                if out.finished:
+                    done.add(rid)
+            return cb
+
+        prompts = {"a": [5, 7, 9, 11, 13], "b": [17, 19, 23]}
+        for rid, toks in prompts.items():
+            driver.submit(EngineRequest(
+                service_request_id=rid, token_ids=toks,
+                sampling=SamplingParams(max_tokens=6, temperature=0.0),
+                on_output=collector(rid)))
+        ticks = 0
+        while len(done) < len(prompts) and ticks < 300:
+            driver.tick()
+            ticks += 1
+        driver.shutdown()
+        driver.tick()
+        assert len(done) == len(prompts), f"unfinished after {ticks} ticks"
+        print("RESULT " + json.dumps(outs), flush=True)
+    else:
+        driver.follower_loop()
+
+
+if __name__ == "__main__":
+    main()

@@ -26,9 +26,15 @@ run_step() {
   local rc=$?
   echo "$name rc=$rc $(head -c 200 "tpu_results/$name.json")"
   if [ "$rc" -ne 0 ]; then
-    # The step itself failed (OOM, crash, timeout): record it and keep
-    # going — a retry would fail the same way. The final exit code
-    # reflects any such failure so 'sweep complete' can't mask it.
+    # Probe FIRST: if the relay died, the step crashed because of the
+    # flake — retry the loop instead of recording a phantom failure.
+    if ! probe; then
+      echo "relay died during failed step $name — restarting sweep loop"
+      return 1
+    fi
+    # Relay is healthy: the step genuinely failed (OOM, crash, timeout);
+    # record it and keep going — a retry would fail the same way. The
+    # final exit code reflects it so 'sweep complete' can't mask it.
     FAILED_STEPS="$FAILED_STEPS $name(rc=$rc)"
     return 0
   fi

@@ -323,6 +323,20 @@ class EngineAgent:
                         engine_cfg, tokenizer=tokenizer,
                         params=jax.device_put(self.engines[0].params, dev))
             self.engines.append(eng)
+        # Multi-host lockstep (parallel/multihost.py): this agent runs on
+        # the primary host only; submit/cancel are mirrored to follower
+        # hosts and the engine steps collectively in the proxy's tick
+        # loop (engine/multihost_driver.py).
+        if jax.process_count() > 1:
+            from .multihost_driver import (
+                MultihostEngineDriver,
+                MultihostEngineProxy,
+            )
+
+            if dp != 1:
+                raise ValueError("multihost mode requires dp_size == 1")
+            self.engines = [MultihostEngineProxy(
+                MultihostEngineDriver(self.engines[0]))]  # type: ignore
         self.engine = self.engines[0]   # config/metadata accessor
         self._rr_replica = 0
         self.port = agent_cfg.port or pick_free_port(agent_cfg.host)
@@ -1197,7 +1211,17 @@ def main() -> None:
     p.add_argument("--max-seq-len", type=int, default=2048)
     p.add_argument("--dp-size", type=int, default=1,
                    help="model replicas behind this registration")
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel mesh size (0 = single device); "
+                        "spans hosts when a multi-host group is joined")
     args = p.parse_args()
+
+    # Multi-host: join the process group (XLLM_MH_COORDINATOR /
+    # XLLM_MH_NUM_HOSTS / XLLM_MH_HOST_ID) BEFORE touching devices so
+    # jax.devices() — and every mesh built below — is global.
+    from ..parallel import multihost
+
+    multihost.initialize_from_env()
 
     factory = {
         "tiny": model_base.tiny_config,
@@ -1219,6 +1243,10 @@ def main() -> None:
         # Pre-compile horizon variants on real chips so the first
         # short-budget request doesn't hit a mid-serving XLA compile.
         warmup_programs=jax.default_backend() != "cpu")
+    if args.tp and args.tp > 1:
+        from ..parallel.mesh import MeshConfig
+
+        ecfg.mesh = MeshConfig(model=args.tp)
     params = None
     if args.checkpoint_path:
         from pathlib import Path
@@ -1241,6 +1269,23 @@ def main() -> None:
         else:
             params = _loader.load_params(args.checkpoint_path, mcfg,
                                          mesh=mesh, rules=fam.sharding_rules)
+    # Follower hosts never expose HTTP/registration; they mirror the
+    # primary's engine events in the lockstep loop until a shutdown
+    # event arrives. Validate unsupported combos BEFORE the split so a
+    # primary-side config error can't strand followers in a collective.
+    if jax.process_count() > 1 and args.dp_size != 1:
+        p.error("multihost mode requires --dp-size 1")
+    if not multihost.is_primary():
+        from .multihost_driver import MultihostEngineDriver
+
+        # The engine must match the primary's EXACTLY — including the
+        # tokenizer (eos/stop-token ids feed the jitted decode state;
+        # a mismatch desynchronizes the lockstep batch composition).
+        tokenizer = TokenizerFactory.create_tokenizer(args.tokenizer_path)
+        engine = InferenceEngine(ecfg, tokenizer=tokenizer, params=params)
+        MultihostEngineDriver(engine).follower_loop()
+        return
+
     agent = EngineAgent(
         ecfg, AgentConfig(host=args.host, port=args.port,
                           coordination_addr=args.coordination_addr,

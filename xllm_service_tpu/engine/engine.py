@@ -695,7 +695,7 @@ class InferenceEngine:
                 for row, i in enumerate(group):
                     toks[row, :len(clipped[i])] = clipped[i]
                     lens[row] = len(clipped[i])
-                vecs = np.asarray(self._embed_prog(
+                vecs = self._fetch(self._embed_prog(
                     self.params, jnp.asarray(toks), jnp.asarray(lens)))
                 for row, i in enumerate(group):
                     out[i] = vecs[row]
@@ -769,6 +769,26 @@ class InferenceEngine:
                     finished=True))
             except Exception:  # noqa: BLE001
                 logger.exception("failure callback")
+
+    def _fetch(self, arr: jax.Array) -> np.ndarray:
+        """Device -> host download for program outputs.
+
+        On a single-process mesh this is a plain transfer. On a
+        MULTI-HOST mesh (parallel/multihost.py) an output whose GSPMD
+        sharding isn't fully replicated spans non-addressable devices
+        and cannot be fetched directly; gather it collectively instead.
+        Safe because every host runs the identical step sequence
+        (multihost_driver lockstep), so all hosts reach this
+        `process_allgather` together."""
+        if jax.process_count() > 1 and not arr.is_fully_replicated:
+            if not hasattr(self, "_replicate_prog"):
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._replicate_prog = jax.jit(
+                    lambda x: x,
+                    out_shardings=NamedSharding(self.mesh, PartitionSpec()))
+            return np.asarray(self._replicate_prog(arr))
+        return np.asarray(arr)
 
     def step(self) -> bool:
         """One engine iteration: process cancellations, admit (short
@@ -933,7 +953,7 @@ class InferenceEngine:
 
     def extract_kv_pages(self, pages: list[int]) -> np.ndarray:
         """Fetch a sequence's KV pages to host (PD handoff, DCN path)."""
-        return np.asarray(self.extract_kv_pages_device(pages))
+        return self._fetch(self.extract_kv_pages_device(pages))
 
     def _start_sequence(self, req: EngineRequest) -> bool:
         if req.injected_kv is not None:
@@ -1309,7 +1329,7 @@ class InferenceEngine:
                 else self._prefill_install)
         self._dstate, packed = prog(
             self.params, self._dstate, jnp.asarray(packed_in), mm_arr)
-        packed_np = np.asarray(packed)
+        packed_np = self._fetch(packed)
         K = self.cfg.max_top_logprobs
         token = int(packed_np[0])
         lp = self._make_logprob(token, float(packed_np[1]),
@@ -1339,7 +1359,7 @@ class InferenceEngine:
         t0 = time.monotonic()
         self._dstate, packed = self._decode_multi(
             self.params, self._dstate, horizon)
-        packed_np = np.asarray(packed)   # [H, B, 2+2K]
+        packed_np = self._fetch(packed)   # [H, B, 2+2K]
         elapsed = time.monotonic() - t0
         ms_per_tok = elapsed * 1000 / max(1, horizon)
         self.recent_max_tbt_ms = max(self.recent_max_tbt_ms, ms_per_tok)
@@ -1427,7 +1447,7 @@ class InferenceEngine:
         self._dstate, packed = self._spec_verify(
             self.params, self._dstate, jnp.asarray(drafts),
             jnp.asarray(room))
-        out = np.asarray(packed)                 # [B, 1 + K + 1]
+        out = self._fetch(packed)                 # [B, 1 + K + 1]
         elapsed = time.monotonic() - t0
 
         emitted = 0

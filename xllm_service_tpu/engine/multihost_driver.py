@@ -1,0 +1,215 @@
+"""Lockstep multi-host serving driver.
+
+A multi-host engine instance runs ONE `InferenceEngine` per host over a
+single GLOBAL mesh (`parallel/multihost.py`): every jitted program is a
+collective, so all hosts must execute the identical program sequence.
+The classic way to get there (reference analog: the engine-side NCCL
+group behind `k/v_cache_ids + device_ips`,
+`xllm_service/scheduler/managers/instance_mgr.cpp:1087-1113`) is a
+single-controller data plane; TPU-natively we instead mirror the
+*request event stream*:
+
+- the PRIMARY host owns the outward surface (agent registration,
+  Generations stream, HTTP) and queues every engine-visible event
+  (submit / cancel / shutdown);
+- every `tick()`, the queued events are broadcast (host control plane,
+  `broadcast_bytes`), applied on ALL hosts in identical order, and then
+  each host runs the same `engine.step()`. Scheduling inside the engine
+  is a pure function of (event order, step count) — no wall-clock
+  decisions — so every host admits/decodes/preempts identically and the
+  jitted calls line up. Device tensors never pass through this path; XLA
+  moves them over ICI/DCN inside the collectives.
+
+Followers drop `on_output` deltas (the primary streams them); output
+tensors are replicated across hosts by construction (decode outputs are
+mesh-replicated), so the primary reads them locally.
+
+Covers the generate/cancel serving core (including n>1 choice fan-out
+and online/offline priorities). PD handoff (prefill_only / injected_kv),
+multimodal embeddings, and /v1/embeddings over a multi-host mesh compose
+the same way device-side but their event mirroring is not wired yet —
+both the driver (`submit`) and the agent proxy (`__getattr__` on the
+device entry points) REJECT those rather than deadlocking the
+collective.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import jax
+import msgpack
+
+from ..common.request import RequestOutput, SamplingParams
+from ..parallel import multihost
+from .engine import EngineRequest, InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+
+class MultihostEngineDriver:
+    """Wraps an engine so submit/cancel become broadcast events and
+    `tick()` is the collective step every host runs in lockstep."""
+
+    def __init__(self, engine: InferenceEngine):
+        self.engine = engine
+        # submit()/cancel() run on agent threads while tick() drains on
+        # the lockstep thread: _pending and _callbacks share one lock so
+        # an event and its callback registration are atomic vs the drain.
+        self._lock = threading.Lock()
+        self._pending: list[dict] = []
+        self._callbacks: dict[int, object] = {}
+        self._cb_seq = 0
+        self._shutdown = False
+        #: whether the last tick's engine.step() did work — an identical,
+        #: replicated decision on every host, so all hosts may idle-sleep
+        #: on it without breaking lockstep.
+        self.last_worked = True
+
+    # ------------------------------------------------------- primary API
+    def submit(self, req: EngineRequest) -> None:
+        assert multihost.is_primary(), "followers never receive requests"
+        if (req.prefill_only or req.injected_kv is not None
+                or req.injected_first_token is not None
+                or req.mm_embeds is not None
+                or req.resume_output_ids):
+            raise NotImplementedError(
+                "multihost mode mirrors plain generate requests only; "
+                "PD handoff / multimodal / preemption-resume submits are "
+                "not wired to follower hosts yet")
+        with self._lock:
+            # Callback keyed by a driver-local id: service_request_id is
+            # NOT unique (n>1 choice fan-out submits one per choice).
+            self._cb_seq += 1
+            key = self._cb_seq
+            self._callbacks[key] = req.on_output
+            self._pending.append({
+                "op": "submit",
+                "cb": key,
+                "service_request_id": req.service_request_id,
+                "request_id": req.request_id,
+                "token_ids": list(req.token_ids),
+                "sampling": req.sampling.to_dict(),
+                "offline": req.offline,
+                "priority": req.priority,
+            })
+
+    def cancel(self, service_request_id: str) -> None:
+        assert multihost.is_primary()
+        with self._lock:
+            self._pending.append({"op": "cancel",
+                                  "id": service_request_id})
+
+    def shutdown(self) -> None:
+        assert multihost.is_primary()
+        with self._lock:
+            self._pending.append({"op": "shutdown"})
+
+    # ---------------------------------------------------------- lockstep
+    def tick(self) -> bool:
+        """One collective iteration on every host. Returns False once a
+        shutdown event has been applied (followers exit their loop)."""
+        payload: Optional[bytes] = None
+        if multihost.is_primary():
+            with self._lock:
+                drained, self._pending = self._pending, []
+            payload = msgpack.packb(drained)
+        raw = multihost.broadcast_bytes(payload)
+        events = msgpack.unpackb(raw) if raw else []
+        for ev in events:
+            self._apply(ev)
+        if self._shutdown:
+            return False
+        self.last_worked = self.engine.step()
+        return True
+
+    def follower_loop(self) -> None:
+        assert not multihost.is_primary()
+        logger.info("multihost follower %d/%d entering lockstep loop",
+                    jax.process_index(), multihost.process_count())
+        while self.tick():
+            if not self.last_worked:
+                # Identical on every host (see last_worked) — the primary
+                # sleeps the same amount, keeping collectives aligned
+                # while an idle instance stops hammering the coordinator.
+                time.sleep(0.002)
+        logger.info("multihost follower exiting (shutdown event)")
+
+    # ------------------------------------------------------------ events
+    def _apply(self, ev: dict) -> None:
+        op = ev.get("op")
+        if op == "submit":
+            if multihost.is_primary():
+                with self._lock:
+                    on_output = self._callbacks.pop(ev["cb"], _drop)
+            else:
+                on_output = _drop
+            self.engine.submit(EngineRequest(
+                service_request_id=ev["service_request_id"],
+                request_id=ev.get("request_id", ""),
+                token_ids=list(ev["token_ids"]),
+                sampling=SamplingParams.from_dict(ev["sampling"]),
+                on_output=on_output,
+                offline=bool(ev.get("offline", False)),
+                priority=int(ev.get("priority", 0))))
+        elif op == "cancel":
+            self.engine.cancel(ev["id"])
+        elif op == "shutdown":
+            self._shutdown = True
+        else:
+            logger.warning("unknown multihost event %r", op)
+
+
+def _drop(out: RequestOutput) -> None:
+    """Follower-side output sink."""
+
+
+class MultihostEngineProxy:
+    """Drop-in engine stand-in the agent uses on the PRIMARY host in
+    multi-host mode: submit/cancel become mirrored events, start()/stop()
+    own the collective tick loop, everything else (cfg, stats, kv_pages,
+    ...) delegates to the wrapped engine. Device-touching entry points
+    that are NOT mirrored to followers raise instead of deadlocking the
+    collective (their programs would run on one host only); unsupported
+    submit *fields* are rejected by the driver itself."""
+
+    _UNSAFE = ("extract_kv_pages", "extract_kv_pages_device",
+               "inject_kv_pages", "embed", "prefill_only")
+
+    def __init__(self, driver: MultihostEngineDriver):
+        self._driver = driver
+        self._engine = driver.engine
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, req: EngineRequest) -> None:
+        self._driver.submit(req)
+
+    def cancel(self, service_request_id: str) -> None:
+        self._driver.cancel(service_request_id)
+
+    def start(self):
+        def loop():
+            while self._driver.tick():
+                if not self._driver.last_worked:
+                    time.sleep(0.002)   # mirrors follower_loop's idle nap
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="multihost-tick")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._driver.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        self._engine.stop()
+
+    def __getattr__(self, name: str):
+        if name in MultihostEngineProxy._UNSAFE:
+            raise NotImplementedError(
+                f"{name} is not mirrored to follower hosts yet "
+                "(multihost mode covers the generate/cancel core)")
+        return getattr(self._engine, name)

@@ -202,9 +202,12 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             and getattr(_sp_ctx, "cfg", None) is None:
         import os
 
-        mq_on = (getattr(_mq_ctx, "on", None)
-                 and os.environ.get("XLLM_MQ_PALLAS", "") == "1")
-        pf_on = (os.environ.get("XLLM_PREFILL_PALLAS", "") == "1"
+        in_verify = bool(getattr(_mq_ctx, "on", None))
+        mq_on = in_verify and os.environ.get("XLLM_MQ_PALLAS", "") == "1"
+        # The prefill flag must not bypass the verify path's own opt-in:
+        # each has a separate Mosaic-validation gate.
+        pf_on = (not in_verify
+                 and os.environ.get("XLLM_PREFILL_PALLAS", "") == "1"
                  and S * n_heads <= 4096)
         if (mq_on or pf_on) and _mosaic_kernel_ok(q, k_pages):
             from .pallas_mq_paged_attention import mq_paged_attention_pallas

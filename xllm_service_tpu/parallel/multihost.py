@@ -1,0 +1,88 @@
+"""Multi-host (DCN) distributed backend.
+
+The reference scales across hosts with an engine-side NCCL/MPI
+communication backend (SURVEY.md §2.13; the service plane only carries
+the metadata — `xllm_rpc_service.proto` InstanceMetaInfo). The TPU-native
+equivalent needs no hand-written transport at all: `jax.distributed`
+wires the process group, after which `jax.devices()` is GLOBAL and every
+jitted program over a global `Mesh` executes collectively — XLA emits the
+cross-host collectives and routes them over ICI within a slice and DCN
+across slices. On CPU test meshes the same code path runs over Gloo, so
+multi-host drills are hermetic (tests/test_multihost.py).
+
+This module owns process-group bring-up plus the tiny host-side control
+plane (`broadcast_bytes`) the lockstep serving driver
+(`engine/multihost_driver.py`) uses to mirror request events from the
+primary host to followers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+_initialized = False
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int) -> None:
+    """Join the cross-host process group (idempotent). After this,
+    `jax.devices()` spans every host and `build_mesh` meshes are global."""
+    global _initialized
+    if _initialized:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def initialize_from_env() -> bool:
+    """Bring up multi-host from XLLM_MH_COORDINATOR / XLLM_MH_NUM_HOSTS /
+    XLLM_MH_HOST_ID (the agent calls this before touching devices).
+    Returns True when a multi-host group was (or already is) joined."""
+    coord = os.environ.get("XLLM_MH_COORDINATOR", "")
+    if not coord:
+        return _initialized or jax.process_count() > 1
+    initialize(coord,
+               int(os.environ.get("XLLM_MH_NUM_HOSTS", "1")),
+               int(os.environ.get("XLLM_MH_HOST_ID", "0")))
+    return True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """The primary host owns the request stream (HTTP service, agent
+    registration); followers mirror engine events (multihost_driver)."""
+    return jax.process_index() == 0
+
+
+def broadcast_bytes(payload: Optional[bytes]) -> bytes:
+    """Broadcast the primary's byte payload to every host.
+
+    COLLECTIVE: all hosts must call this the same number of times, in the
+    same program order. Two `broadcast_one_to_all` rounds — length first,
+    then the body padded to that length (the collective needs identical
+    shapes on every host; followers learn the shape from round one).
+    """
+    from jax.experimental import multihost_utils
+
+    if is_primary():
+        data = payload or b""
+        n_arr = np.asarray([len(data)], np.int32)
+    else:
+        data = b""
+        n_arr = np.zeros((1,), np.int32)
+    n = int(multihost_utils.broadcast_one_to_all(n_arr)[0])
+    if n == 0:
+        return b""
+    buf = (np.frombuffer(data, np.uint8) if is_primary()
+           else np.zeros((n,), np.uint8))
+    buf = multihost_utils.broadcast_one_to_all(buf)
+    return bytes(np.asarray(buf))
