@@ -81,6 +81,10 @@ def main() -> None:
 
     on_accel = backend not in ("cpu",)
     mcfg = bench_1b_config() if on_accel else tiny_config(dtype=jnp.float32)
+    if os.environ.get("XLLM_QUANT") == "int8":
+        import dataclasses
+
+        mcfg = dataclasses.replace(mcfg, quant="int8")
 
     B = 16 if on_accel else 8
     ctx = 512 if on_accel else 64
@@ -149,6 +153,8 @@ def main() -> None:
     }
     if tpu_note:
         result["note"] = tpu_note
+    if mcfg.quant:
+        result["quant"] = mcfg.quant
     print(json.dumps(result))
 
 

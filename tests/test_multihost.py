@@ -50,16 +50,21 @@ class TestMultihostAgentE2E:
         import time
         import urllib.request
 
+        import tempfile
+
         coord_port, http_port, rpc_port = (_free_port(), _free_port(),
                                            _free_port())
         mh_port = _free_port()
         procs = []
+        logdir = tempfile.mkdtemp(prefix="mh_e2e_")
         env1 = _env(local_devices=1)
 
         def spawn(cmd, env):
-            p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                 stderr=subprocess.STDOUT, text=True,
-                                 env=env)
+            # Log to files, not PIPE: four chatty children over ~4 min
+            # would fill an undrained pipe buffer and deadlock.
+            log = open(f"{logdir}/{len(procs)}.log", "w")
+            p = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                                 text=True, env=env)
             procs.append(p)
             return p
 
@@ -102,7 +107,15 @@ class TestMultihostAgentE2E:
                 except Exception as e:  # noqa: BLE001 — stack warming up
                     last_err = e
                     time.sleep(3)
-            raise AssertionError(f"stack never served: {last_err}")
+            tails = []
+            for i in range(len(procs)):
+                try:
+                    with open(f"{logdir}/{i}.log") as f:
+                        tails.append(f"--- proc {i}: {f.read()[-800:]}")
+                except OSError:
+                    pass
+            raise AssertionError(
+                f"stack never served: {last_err}\n" + "\n".join(tails))
         finally:
             for p in procs:
                 if p.poll() is None:

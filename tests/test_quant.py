@@ -1,0 +1,152 @@
+"""Weight-only int8 quantization (models/quant.py): algebra, accuracy
+bounds, engine serving, and tensor-parallel sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.models.quant import (
+    is_quantized,
+    quantize_kernel,
+    quantize_tree,
+    quantized_einsum,
+)
+
+
+class TestQuantKernel:
+    def test_scale_commutes_out_of_contraction(self):
+        """y = einsum(x, q8) * scale must equal einsum(x, dequantized W)
+        EXACTLY (same float ops, scale applied per output channel)."""
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+        q = quantize_kernel(w)
+        w_dq = q["q8"].astype(jnp.float32) * q["scale"][None, :]
+        ref = jnp.einsum("bd,df->bf", x, w_dq)
+        got = quantized_einsum("bd,df->bf", x, q)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_rounding_error_bound(self):
+        """Per-channel absmax int8: relative matmul error stays small."""
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.normal(size=(256, 128)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)
+        ref = x @ w
+        got = quantized_einsum("bd,df->bf", x, quantize_kernel(w))
+        rel = (jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+        assert float(rel) < 0.01, float(rel)
+
+    def test_stacked_layers_quantize_per_layer(self):
+        rng = np.random.default_rng(2)
+        w = jnp.asarray(rng.normal(size=(3, 16, 8)) *
+                        np.array([1, 10, 100])[:, None, None], jnp.float32)
+        q = quantize_kernel(w)
+        assert q["q8"].shape == (3, 16, 8) and q["scale"].shape == (3, 8)
+        # Each layer's scale reflects its own magnitude.
+        s = np.asarray(q["scale"])
+        assert s[1].mean() > 5 * s[0].mean()
+        assert s[2].mean() > 5 * s[1].mean()
+
+    def test_quantize_tree_targets_projections_only(self):
+        from xllm_service_tpu.models.base import tiny_config
+        from xllm_service_tpu.models import llama
+
+        cfg = tiny_config(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        qp = quantize_tree(params)
+        assert is_quantized(qp["layers"]["q_proj"]["kernel"])
+        assert is_quantized(qp["layers"]["down_proj"]["kernel"])
+        assert is_quantized(qp["lm_head"]["kernel"])
+        assert not is_quantized(qp["embed"]["embedding"])
+        assert qp["layers"]["input_norm"]["scale"].dtype == jnp.float32
+
+
+class TestQuantForward:
+    def _logits(self, quant):
+        from xllm_service_tpu.models.base import tiny_config
+        from xllm_service_tpu.models import llama
+
+        cfg = tiny_config(dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(3))
+        if quant:
+            params = quantize_tree(params)
+        B, S, L = 2, 12, cfg.num_layers
+        kv = jnp.zeros((L, 2, 16, cfg.num_kv_heads, 16, cfg.head_dim),
+                       jnp.float32)
+        pt = jnp.arange(1, 9, dtype=jnp.int32).reshape(2, 4) % 16
+        toks = jnp.asarray(
+            np.random.default_rng(5).integers(0, cfg.vocab_size, (B, S)),
+            jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        logits, _ = llama.prefill_forward(
+            params, cfg, toks, pos, kv, pt,
+            jnp.zeros((B,), jnp.int32), jnp.full((B,), S, jnp.int32))
+        return np.asarray(logits)
+
+    def test_full_forward_close_to_f32(self):
+        ref, got = self._logits(False), self._logits(True)
+        # Quantization noise must not reorder the distribution much.
+        cos = (ref * got).sum() / (np.linalg.norm(ref) *
+                                   np.linalg.norm(got))
+        assert cos > 0.995, cos
+        assert (ref.argmax(-1) == got.argmax(-1)).mean() > 0.9
+
+
+class TestQuantEngine:
+    def test_engine_serves_quantized(self):
+        from test_engine import Collector, run_requests
+        from xllm_service_tpu.common.request import SamplingParams
+        from xllm_service_tpu.engine.config import EngineConfig
+        from xllm_service_tpu.engine.engine import (
+            EngineRequest,
+            InferenceEngine,
+        )
+        from xllm_service_tpu.models.base import tiny_config
+
+        cfg = EngineConfig(
+            model=tiny_config(dtype=jnp.float32, quant="int8"),
+            num_pages=64, page_size=16, hash_block_size=32,
+            max_batch_size=2, max_seq_len=128,
+            prefill_buckets=(32, 64, 128), decode_horizon=4)
+        engine = InferenceEngine(cfg)
+        col = Collector()
+        req = EngineRequest(service_request_id="q0",
+                            token_ids=[5, 7, 9, 11, 13],
+                            sampling=SamplingParams(max_tokens=8,
+                                                    temperature=0.0),
+                            on_output=col)
+        run_requests(engine, [req])
+        assert len(col.tokens) == 8
+        assert col.finish_reason == "length"
+
+    def test_engine_tp_sharded_quant_matches_single_device(self):
+        """Greedy tokens on a model=2 mesh must equal single-device for
+        the SAME quantized weights (sharding must not change numerics
+        beyond reduction order)."""
+        from test_engine import Collector, run_requests
+        from xllm_service_tpu.common.request import SamplingParams
+        from xllm_service_tpu.engine.config import EngineConfig
+        from xllm_service_tpu.engine.engine import (
+            EngineRequest,
+            InferenceEngine,
+        )
+        from xllm_service_tpu.models.base import tiny_config
+        from xllm_service_tpu.parallel.mesh import MeshConfig
+
+        def run(mesh_cfg):
+            cfg = EngineConfig(
+                model=tiny_config(dtype=jnp.float32, quant="int8"),
+                mesh=mesh_cfg,
+                num_pages=64, page_size=16, hash_block_size=32,
+                max_batch_size=2, max_seq_len=128,
+                prefill_buckets=(32, 64, 128), decode_horizon=4)
+            engine = InferenceEngine(cfg)
+            col = Collector()
+            run_requests(engine, [EngineRequest(
+                service_request_id="q1", token_ids=[17, 19, 23, 29],
+                sampling=SamplingParams(max_tokens=6, temperature=0.0),
+                on_output=col)])
+            return col.tokens
+
+        assert run(None) == run(MeshConfig(model=2))

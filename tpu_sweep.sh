@@ -64,6 +64,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # 3. scatter-writeback A/B
     run_step bench_scatter 900 env XLLM_KV_WRITEBACK=scatter python bench.py \
       || { sleep 60; continue; }
+    # 3b. weight-only int8 (the HBM-bound decode lever)
+    run_step bench_int8 900 env XLLM_QUANT=int8 python bench.py \
+      || { sleep 60; continue; }
     # 4. speculative decoding
     run_step spec 1200 python benchmarks/spec_bench.py || { sleep 60; continue; }
     # 5. KV writeback micro (times both XLA variants internally)

@@ -1214,6 +1214,8 @@ def main() -> None:
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel mesh size (0 = single device); "
                         "spans hosts when a multi-host group is joined")
+    p.add_argument("--quant", default="", choices=["", "int8"],
+                   help="weight-only quantization (models/quant.py)")
     args = p.parse_args()
 
     # Multi-host: join the process group (XLLM_MH_COORDINATOR /
@@ -1230,6 +1232,10 @@ def main() -> None:
         "llama3_70b": model_base.llama3_70b_config,
     }[args.model_config]
     mcfg = factory()
+    if args.quant:
+        import dataclasses
+
+        mcfg = dataclasses.replace(mcfg, quant=args.quant)
     ecfg = EngineConfig(
         model_id=args.model_id, model=mcfg,
         num_pages=args.num_pages, page_size=args.page_size,

@@ -174,6 +174,15 @@ class InferenceEngine:
             # models/loader.py and are passed in pre-sharded.
             rng = jax.random.PRNGKey(cfg.seed)
             params = self.family.init_params(mcfg, rng)
+            if mcfg.quant:
+                params = self._quantize(params, mcfg)
+            if self.mesh is not None:
+                params = shard_params(params, self.mesh,
+                                      self.family.sharding_rules)
+        elif mcfg.quant:
+            # Loaded weights: quantize, then re-apply the sharding rules
+            # (the q8/scale leaves have their own specs).
+            params = self._quantize(params, mcfg)
             if self.mesh is not None:
                 params = shard_params(params, self.mesh,
                                       self.family.sharding_rules)
@@ -769,6 +778,18 @@ class InferenceEngine:
                     finished=True))
             except Exception:  # noqa: BLE001
                 logger.exception("failure callback")
+
+    def _quantize(self, params: dict, mcfg) -> dict:
+        if mcfg.quant != "int8":
+            raise ValueError(f"unknown quant mode {mcfg.quant!r}")
+        if self.cfg.model_family not in ("llama", "qwen2"):
+            # MoE expert stacks and the MLA latent path have their own
+            # einsums that are not quant-aware yet.
+            raise NotImplementedError(
+                f"int8 quant not wired for family {self.cfg.model_family}")
+        from ..models.quant import quantize_tree
+
+        return quantize_tree(params)
 
     def _fetch(self, arr: jax.Array) -> np.ndarray:
         """Device -> host download for program outputs.

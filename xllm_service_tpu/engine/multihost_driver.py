@@ -68,6 +68,7 @@ class MultihostEngineDriver:
         #: replicated decision on every host, so all hosts may idle-sleep
         #: on it without breaking lockstep.
         self.last_worked = True
+        self._idle_ticks = 0
 
     # ------------------------------------------------------- primary API
     def submit(self, req: EngineRequest) -> None:
@@ -123,19 +124,37 @@ class MultihostEngineDriver:
             self._apply(ev)
         if self._shutdown:
             return False
-        self.last_worked = self.engine.step()
+        try:
+            self.last_worked = self.engine.step()
+        except Exception as e:  # noqa: BLE001 — mirror engine._loop
+            # A step failure comes from an identical program on identical
+            # inputs, so every host raises here together; each fails its
+            # in-flight requests (followers have none) and KEEPS TICKING
+            # so the collective control plane stays aligned — a dead tick
+            # thread would strand the other hosts in broadcast_bytes.
+            logger.exception("lockstep engine step failed")
+            self.engine._fail_all(str(e))
+            self.last_worked = False
+        if self.last_worked:
+            self._idle_ticks = 0
+        else:
+            self._idle_ticks += 1
         return True
+
+    def idle_nap(self) -> None:
+        """Sleep after a no-work tick. Escalates deterministically with
+        consecutive idle ticks (2ms -> 64ms cap) — a pure function of the
+        replicated last_worked history, so every host naps identically
+        and an idle instance stops hammering the DCN control plane."""
+        if self._idle_ticks:
+            time.sleep(min(0.002 * (1 << min(self._idle_ticks, 5)), 0.064))
 
     def follower_loop(self) -> None:
         assert not multihost.is_primary()
         logger.info("multihost follower %d/%d entering lockstep loop",
                     jax.process_index(), multihost.process_count())
         while self.tick():
-            if not self.last_worked:
-                # Identical on every host (see last_worked) — the primary
-                # sleeps the same amount, keeping collectives aligned
-                # while an idle instance stops hammering the coordinator.
-                time.sleep(0.002)
+            self.idle_nap()
         logger.info("multihost follower exiting (shutdown event)")
 
     # ------------------------------------------------------------ events
@@ -193,8 +212,7 @@ class MultihostEngineProxy:
     def start(self):
         def loop():
             while self._driver.tick():
-                if not self._driver.last_worked:
-                    time.sleep(0.002)   # mirrors follower_loop's idle nap
+                self._driver.idle_nap()   # mirrors follower_loop exactly
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="multihost-tick")

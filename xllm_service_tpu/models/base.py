@@ -55,6 +55,9 @@ class ModelConfig:
     # Multimodal (qwen2_vl family).
     vision: Optional["VisionConfig"] = None
     image_token_id: int = 151655   # <|image_pad|> placeholder id
+    # Weight-only quantization ("" = off, "int8" = per-output-channel
+    # int8 projections, models/quant.py). llama/qwen2 families.
+    quant: str = ""
 
     @property
     def q_size(self) -> int:

@@ -25,13 +25,23 @@ from ..parallel.sharding import ShardingRules
 from jax.sharding import PartitionSpec as P
 from ..parallel.mesh import AXIS_MODEL
 from .base import ModelConfig, ModelFamily, register_model_family
+from .quant import quantized_einsum
 
 Params = dict
 
 
 # Stacked-layer sharding rules (leading L dim on every layer tensor).
+# int8-quant `/scale` leaves come FIRST (first match wins): a scale is
+# [L, out] — sharded with the kernel's output dim for column-parallel
+# weights, replicated for row-parallel ones (whose sharded dim is the
+# contraction the scale reduced over). The `q8` leaf has the kernel's own
+# shape and inherits its spec via the plain `/kernel` patterns.
 LLAMA_STACKED_RULES = ShardingRules(rules=[
     (r"embed/embedding", P(AXIS_MODEL, None)),
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel/scale",
+     P(None, AXIS_MODEL)),
+    (r"(o_proj|down_proj)/kernel/scale", P()),
+    (r"lm_head/kernel/scale", P(AXIS_MODEL)),
     (r"(q_proj|k_proj|v_proj)/kernel", P(None, None, AXIS_MODEL)),
     (r"(q_proj|k_proj|v_proj)/bias", P(None, AXIS_MODEL)),
     (r"o_proj/kernel", P(None, AXIS_MODEL, None)),
@@ -79,9 +89,9 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
 def _project_qkv(lp: Params, x: jax.Array, cfg: ModelConfig,
                  positions: jax.Array):
     """x: [B, S, D] (or [B, D] for decode with S folded) -> q,k,v heads."""
-    q = jnp.einsum("...d,df->...f", x, lp["q_proj"]["kernel"])
-    k = jnp.einsum("...d,df->...f", x, lp["k_proj"]["kernel"])
-    v = jnp.einsum("...d,df->...f", x, lp["v_proj"]["kernel"])
+    q = quantized_einsum("...d,df->...f", x, lp["q_proj"]["kernel"])
+    k = quantized_einsum("...d,df->...f", x, lp["k_proj"]["kernel"])
+    v = quantized_einsum("...d,df->...f", x, lp["v_proj"]["kernel"])
     if "bias" in lp["q_proj"]:
         q = q + lp["q_proj"]["bias"]
         k = k + lp["k_proj"]["bias"]
@@ -95,10 +105,10 @@ def _project_qkv(lp: Params, x: jax.Array, cfg: ModelConfig,
 
 
 def _mlp(lp: Params, x: jax.Array) -> jax.Array:
-    gate = jnp.einsum("...d,df->...f", x, lp["gate_proj"]["kernel"])
-    up = jnp.einsum("...d,df->...f", x, lp["up_proj"]["kernel"])
-    return jnp.einsum("...f,fd->...d", jax.nn.silu(gate) * up,
-                      lp["down_proj"]["kernel"])
+    gate = quantized_einsum("...d,df->...f", x, lp["gate_proj"]["kernel"])
+    up = quantized_einsum("...d,df->...f", x, lp["up_proj"]["kernel"])
+    return quantized_einsum("...f,fd->...d", jax.nn.silu(gate) * up,
+                            lp["down_proj"]["kernel"])
 
 
 def _unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
@@ -106,7 +116,8 @@ def _unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     if cfg.tie_embeddings:
         logits = jnp.einsum("...d,vd->...v", x, params["embed"]["embedding"])
     else:
-        logits = jnp.einsum("...d,dv->...v", x, params["lm_head"]["kernel"])
+        logits = quantized_einsum("...d,dv->...v", x,
+                                  params["lm_head"]["kernel"])
     return logits.astype(jnp.float32)
 
 
@@ -152,7 +163,7 @@ def prefill_from_embeddings(params: Params, cfg: ModelConfig,
         attn = prefill_attention(q, k, v, k_pages, v_pages,
                                  page_table, prefix_lens, seq_lens)
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
-        x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
+        x = x + quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         x = x + _mlp(lp, h2)
         return x, k_pages, v_pages
@@ -189,7 +200,7 @@ def embed_forward(params: Params, cfg: ModelConfig,
         attn = prefill_attention(q, k, v, None, None, None,
                                  jnp.zeros((B,), jnp.int32), seq_lens)
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
-        x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
+        x = x + quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         return x + _mlp(lp, h2)
 
@@ -263,7 +274,7 @@ def decode_forward(params: Params, cfg: ModelConfig,
                 q, k, v, kv_pages[l, 0], kv_pages[l, 1],
                 page_table, context_lens)
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
-        x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
+        x = x + quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         x = x + _mlp(lp, h2)
         if not scatter:

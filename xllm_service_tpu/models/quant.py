@@ -1,0 +1,79 @@
+"""Weight-only int8 quantization for serving.
+
+Decode on TPU is HBM-bandwidth-bound: every step streams the full weight
+set from HBM (SURVEY.md §6 — the matmul ceiling IS the weight stream at
+small batch). Storing projection weights as int8 + a per-output-channel
+f32 scale halves that stream. The forward never materializes a
+dequantized weight: the int8 tensor feeds the matmul directly (XLA fuses
+the s8->bf16 convert into the dot's tile reads) and the scale — constant
+per OUTPUT channel — is applied to the matmul *output*:
+
+    y = einsum(x, W)           ==  einsum(x, q8) * scale
+    W = q8 * scale[None, :]        (scale broadcast over the contraction)
+
+This is exact algebra (per-channel scale commutes out of the
+contraction), so the only error is int8 rounding (~0.4% relative,
+test-bounded). Under tensor parallelism the scale multiply composes with
+the GSPMD psum of row-parallel matmuls for the same reason.
+
+Quantized leaves replace `kernel` arrays with `{"q8", "scale"}` subtrees;
+sharding rules carry explicit `/scale` patterns (the `q8` tensor keeps
+the kernel's own spec). Embeddings (gather), norms, biases, and routers
+stay bf16/f32 — they are a rounding-error-sensitive sliver of the bytes.
+
+Enable with ``ModelConfig.quant = "int8"`` (llama/qwen2 families; the
+engine quantizes right after init/load, before sharding).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Projection matrices whose `kernel` gets quantized. The contraction dim
+# of every one of these is the kernel's -2 axis in the model einsums
+# (models/llama.py), so the per-output-channel scale reduces over -2.
+QUANT_KERNELS = ("q_proj", "k_proj", "v_proj", "o_proj",
+                 "gate_proj", "up_proj", "down_proj", "lm_head")
+
+
+def quantize_kernel(w: jax.Array) -> dict:
+    """[..., in, out] bf16/f32 -> {"q8": int8 same shape,
+    "scale": f32 [..., out]} with absmax-per-output-channel scaling."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=-2) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q8 = jnp.round(wf / scale[..., None, :]).astype(jnp.int8)
+    return {"q8": q8, "scale": scale}
+
+
+def is_quantized(kern) -> bool:
+    return isinstance(kern, dict) and "q8" in kern
+
+
+def quantized_einsum(spec: str, x: jax.Array, kern) -> jax.Array:
+    """Matmul against a plain or quantized kernel (same einsum spec)."""
+    if is_quantized(kern):
+        y = jnp.einsum(spec, x, kern["q8"].astype(x.dtype))
+        return y * kern["scale"].astype(y.dtype)
+    return jnp.einsum(spec, x, kern)
+
+
+def quantize_tree(params: dict) -> dict:
+    """Return params with every QUANT_KERNELS `kernel` leaf replaced by
+    its int8 form. Runs under jit per-leaf; safe on sharded params (the
+    q8/scale outputs inherit layouts via the sharding rules on reapply)."""
+
+    def walk(node, name=""):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if (k == "kernel" and name in QUANT_KERNELS
+                        and not isinstance(v, dict)):
+                    out[k] = quantize_kernel(v)
+                else:
+                    out[k] = walk(v, k)
+            return out
+        return node
+
+    return walk(params)

@@ -37,6 +37,11 @@ class ShardingRules:
 # psum), vocab-sharded embeddings.
 LLAMA_RULES = ShardingRules(rules=[
     (r"embed/embedding", P(AXIS_MODEL, None)),          # [vocab, d]
+    # int8-quant scales ([out]) first: output-sharded for column-parallel
+    # kernels, replicated for row-parallel (models/quant.py).
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj|lm_head)/kernel/scale",
+     P(AXIS_MODEL)),
+    (r"(o_proj|down_proj)/kernel/scale", P()),
     (r"(q_proj|k_proj|v_proj)/kernel", P(None, AXIS_MODEL)),   # [d, heads*hd]
     (r"(q_proj|k_proj|v_proj)/bias", P(AXIS_MODEL)),
     (r"o_proj/kernel", P(AXIS_MODEL, None)),            # [heads*hd, d]
