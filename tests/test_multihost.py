@@ -56,6 +56,7 @@ class TestMultihostAgentE2E:
                                            _free_port())
         mh_port = _free_port()
         procs = []
+        logs = []
         logdir = tempfile.mkdtemp(prefix="mh_e2e_")
         env1 = _env(local_devices=1)
 
@@ -63,6 +64,7 @@ class TestMultihostAgentE2E:
             # Log to files, not PIPE: four chatty children over ~4 min
             # would fill an undrained pipe buffer and deadlock.
             log = open(f"{logdir}/{len(procs)}.log", "w")
+            logs.append(log)
             p = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
                                  text=True, env=env)
             procs.append(p)
@@ -122,6 +124,8 @@ class TestMultihostAgentE2E:
                     p.kill()
             for p in procs:
                 p.wait(timeout=30)
+            for log in logs:
+                log.close()
 
 
 class TestMultihostLockstep:

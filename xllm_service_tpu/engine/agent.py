@@ -1225,11 +1225,35 @@ def main() -> None:
 
     multihost.initialize_from_env()
 
+    def _gemma_2b():
+        from ..models.gemma import gemma_2b_config
+
+        return gemma_2b_config()
+
+    def _gemma_tiny():
+        from ..models.gemma import gemma_tiny_config
+
+        return gemma_tiny_config()
+
+    def _mixtral_tiny():
+        from ..models.mixtral import mixtral_tiny_config
+
+        return mixtral_tiny_config()
+
+    def _mixtral_8x7b():
+        from ..models.mixtral import mixtral_8x7b_config
+
+        return mixtral_8x7b_config()
+
     factory = {
         "tiny": model_base.tiny_config,
         "bench_1b": model_base.bench_1b_config,
         "llama3_8b": model_base.llama3_8b_config,
         "llama3_70b": model_base.llama3_70b_config,
+        "gemma_2b": _gemma_2b,
+        "gemma_tiny": _gemma_tiny,
+        "mixtral_8x7b": _mixtral_8x7b,
+        "mixtral_tiny": _mixtral_tiny,
     }[args.model_config]
     mcfg = factory()
     if args.quant:
@@ -1238,6 +1262,7 @@ def main() -> None:
         mcfg = dataclasses.replace(mcfg, quant=args.quant)
     ecfg = EngineConfig(
         model_id=args.model_id, model=mcfg,
+        model_family=mcfg.name,
         num_pages=args.num_pages, page_size=args.page_size,
         max_batch_size=args.max_batch_size,
         max_seq_len=min(args.max_seq_len, mcfg.max_context_len),

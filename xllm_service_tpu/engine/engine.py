@@ -782,11 +782,11 @@ class InferenceEngine:
     def _quantize(self, params: dict, mcfg) -> dict:
         if mcfg.quant != "int8":
             raise ValueError(f"unknown quant mode {mcfg.quant!r}")
-        if self.cfg.model_family not in ("llama", "qwen2"):
-            # MoE expert stacks and the MLA latent path have their own
-            # einsums that are not quant-aware yet.
+        if not self.family.supports_int8:
             raise NotImplementedError(
-                f"int8 quant not wired for family {self.cfg.model_family}")
+                f"family {self.cfg.model_family} does not route its "
+                "matmuls through quantized_einsum (ModelFamily."
+                "supports_int8)")
         from ..models.quant import quantize_tree
 
         return quantize_tree(params)

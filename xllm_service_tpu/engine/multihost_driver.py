@@ -69,6 +69,11 @@ class MultihostEngineDriver:
         #: on it without breaking lockstep.
         self.last_worked = True
         self._idle_ticks = 0
+        # Cuts the primary's idle nap short the moment an event arrives
+        # (followers never see it set — they finish their nap and then
+        # block in the broadcast until the primary posts; naps need not
+        # be identical for correctness, the collective is the barrier).
+        self._wake = threading.Event()
 
     # ------------------------------------------------------- primary API
     def submit(self, req: EngineRequest) -> None:
@@ -97,17 +102,20 @@ class MultihostEngineDriver:
                 "offline": req.offline,
                 "priority": req.priority,
             })
+        self._wake.set()
 
     def cancel(self, service_request_id: str) -> None:
         assert multihost.is_primary()
         with self._lock:
             self._pending.append({"op": "cancel",
                                   "id": service_request_id})
+        self._wake.set()
 
     def shutdown(self) -> None:
         assert multihost.is_primary()
         with self._lock:
             self._pending.append({"op": "shutdown"})
+        self._wake.set()
 
     # ---------------------------------------------------------- lockstep
     def tick(self) -> bool:
@@ -142,12 +150,15 @@ class MultihostEngineDriver:
         return True
 
     def idle_nap(self) -> None:
-        """Sleep after a no-work tick. Escalates deterministically with
-        consecutive idle ticks (2ms -> 64ms cap) — a pure function of the
-        replicated last_worked history, so every host naps identically
-        and an idle instance stops hammering the DCN control plane."""
+        """Nap after a no-work tick (escalating 2 -> 64 ms) so an idle
+        instance stops hammering the DCN control plane. On the primary a
+        submit/cancel interrupts the nap immediately (no added TTFT); a
+        follower sleeps its full nap and then the broadcast barrier
+        aligns it with the woken primary."""
         if self._idle_ticks:
-            time.sleep(min(0.002 * (1 << min(self._idle_ticks, 5)), 0.064))
+            self._wake.wait(min(0.002 * (1 << min(self._idle_ticks, 5)),
+                                0.064))
+            self._wake.clear()
 
     def follower_loop(self) -> None:
         assert not multihost.is_primary()

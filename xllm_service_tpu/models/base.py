@@ -58,6 +58,13 @@ class ModelConfig:
     # Weight-only quantization ("" = off, "int8" = per-output-channel
     # int8 projections, models/quant.py). llama/qwen2 families.
     quant: str = ""
+    # Gemma-family switches (models/gemma.py): GeGLU activation, embed
+    # scaling by sqrt(hidden), RMSNorm computing (1 + w), final-logit
+    # tanh softcap (0 = off). Honored by the shared llama layer body.
+    act: str = "silu"
+    embed_scale: bool = False
+    rms_unit_offset: bool = False
+    final_logit_softcap: float = 0.0
 
     @property
     def q_size(self) -> int:
@@ -92,6 +99,10 @@ class ModelFamily:
     # Optional text-embedding forward ([B, S] tokens -> [B, D] pooled);
     # families without it 501 /v1/embeddings like the reference.
     embed_forward: Optional[Callable[..., Any]] = None
+    # Whether every matmul in the family's forwards goes through
+    # models/quant.quantized_einsum (weight-only int8). MoE expert stacks
+    # and the MLA latent path are not quant-aware yet.
+    supports_int8: bool = False
 
 
 _REGISTRY: dict[str, ModelFamily] = {}
@@ -112,6 +123,10 @@ def get_model_family(name: str) -> ModelFamily:
             from . import deepseek_moe  # noqa: F401
         elif name in ("qwen2_vl",):
             from . import qwen2_vl  # noqa: F401
+        elif name == "gemma":
+            from . import gemma  # noqa: F401
+        elif name == "mixtral":
+            from . import mixtral  # noqa: F401
     fam = _REGISTRY.get(name)
     if fam is None:
         raise ValueError(f"unknown model family: {name}")

@@ -104,7 +104,8 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
     D, L, E = cfg.hidden_size, cfg.num_layers, cfg.num_experts
     Hq, Hkv = cfg.q_size, cfg.kv_size
     Fe = cfg.moe_ffn_size
-    Fs = cfg.moe_ffn_size * max(1, cfg.num_shared_experts)
+    # num_shared_experts == 0 (mixtral): no shared branch at all.
+    Fs = cfg.moe_ffn_size * cfg.num_shared_experts
 
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32)
@@ -145,11 +146,11 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
                 "up_proj": {"kernel": dense(keys[7], (L, E, D, Fe), D)},
                 "down_proj": {"kernel": dense(keys[8], (L, E, Fe, D), Fe)},
             },
-            "shared": {
+            **({"shared": {
                 "gate_proj": {"kernel": dense(keys[9], (L, D, Fs), D)},
                 "up_proj": {"kernel": dense(keys[10], (L, D, Fs), D)},
                 "down_proj": {"kernel": dense(keys[11], (L, Fs, D), Fs)},
-            },
+            }} if cfg.num_shared_experts > 0 else {}),
         },
         "final_norm": {"scale": jnp.ones((D,), cfg.dtype)},
         "lm_head": {"kernel": dense(jax.random.fold_in(rng, 99),
@@ -178,11 +179,13 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     routed = jnp.einsum("etd,te->td", eo.astype(jnp.float32),
                         gates).astype(x.dtype)
 
-    sg = jnp.einsum("td,df->tf", x2, lp["shared"]["gate_proj"]["kernel"])
-    su = jnp.einsum("td,df->tf", x2, lp["shared"]["up_proj"]["kernel"])
-    shared = jnp.einsum("tf,fd->td", jax.nn.silu(sg) * su,
-                        lp["shared"]["down_proj"]["kernel"])
-    return (routed + shared).reshape(orig_shape)
+    if "shared" in lp:
+        sg = jnp.einsum("td,df->tf", x2, lp["shared"]["gate_proj"]["kernel"])
+        su = jnp.einsum("td,df->tf", x2, lp["shared"]["up_proj"]["kernel"])
+        routed = routed + jnp.einsum(
+            "tf,fd->td", jax.nn.silu(sg) * su,
+            lp["shared"]["down_proj"]["kernel"]).astype(routed.dtype)
+    return routed.reshape(orig_shape)
 
 
 def _mla_attention(lp, cfg, h, mode, k_pages, v_pages, page_table,

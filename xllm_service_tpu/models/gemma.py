@@ -1,0 +1,65 @@
+"""Gemma family (google/gemma, gemma-2).
+
+Same stacked-layer paged-KV machinery as the llama family — the
+architectural deltas are config switches the shared body honors
+(models/llama.py): GeGLU MLP (``act="gelu"``), embeddings scaled by
+sqrt(hidden) (``embed_scale``), RMSNorm computing ``(1 + w)``
+(``rms_unit_offset``), tied embeddings, rope theta 10000, wide heads
+(head_dim 256 — still a Pallas lane-width multiple), and a final-logit
+tanh softcap (``final_logit_softcap``). Registered exactly like qwen2:
+the body genuinely branches on these fields, so no forward is
+duplicated.
+
+Gemma-2 is NOT yet a servable config: beyond the switches above it
+alternates sliding-window/global attention, softcaps ATTENTION logits
+(50.0), and sandwiches the MLP between pre/post feed-forward norms —
+attention-kernel-level features this family does not implement. No
+gemma-2 factory is exposed until they exist.
+
+Reference parity note: the reference service routes any family by model
+id (`tokenizer/tokenizer_factory.cpp` decides by config); the engine
+plane is ours to define (SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+from .base import ModelConfig, ModelFamily, register_model_family
+from .llama import (
+    LLAMA_STACKED_RULES,
+    decode_forward,
+    embed_forward,
+    init_params,
+    prefill_forward,
+    verify_forward,
+)
+
+
+def gemma_tiny_config(**kw) -> ModelConfig:
+    """CPU-test scale with every gemma switch on."""
+    defaults = dict(name="gemma", vocab_size=512, hidden_size=128,
+                    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
+                    ffn_size=256, rope_theta=10000.0, tie_embeddings=True,
+                    act="gelu", embed_scale=True, rms_unit_offset=True,
+                    final_logit_softcap=30.0, max_context_len=512)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def gemma_2b_config() -> ModelConfig:
+    return ModelConfig(name="gemma", vocab_size=256128, hidden_size=2048,
+                       num_layers=18, num_heads=8, num_kv_heads=1,
+                       head_dim=256, ffn_size=16384, rope_theta=10000.0,
+                       tie_embeddings=True, act="gelu", embed_scale=True,
+                       rms_unit_offset=True, max_context_len=8192)
+
+
+register_model_family(ModelFamily(
+    name="gemma",
+    init_params=init_params,
+    prefill_forward=prefill_forward,
+    decode_forward=decode_forward,
+    sharding_rules=LLAMA_STACKED_RULES,
+    verify_forward=verify_forward,
+    embed_forward=embed_forward,
+    supports_int8=True,
+))

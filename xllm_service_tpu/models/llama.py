@@ -61,20 +61,25 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
         return (jax.random.normal(key, shape, jnp.float32)
                 * (fan_in ** -0.5)).astype(cfg.dtype)
 
+    def norm_init(shape):
+        # gemma stores w with the norm computing (1 + w): zeros == identity.
+        return (jnp.zeros if cfg.rms_unit_offset else jnp.ones)(
+            shape, cfg.dtype)
+
     params: Params = {
         "embed": {"embedding": dense(keys[0], (cfg.vocab_size, D), D)},
         "layers": {
-            "input_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
+            "input_norm": {"scale": norm_init((L, D))},
             "q_proj": {"kernel": dense(keys[1], (L, D, Hq), D)},
             "k_proj": {"kernel": dense(keys[2], (L, D, Hkv), D)},
             "v_proj": {"kernel": dense(keys[3], (L, D, Hkv), D)},
             "o_proj": {"kernel": dense(keys[4], (L, Hq, D), Hq)},
-            "post_attn_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
+            "post_attn_norm": {"scale": norm_init((L, D))},
             "gate_proj": {"kernel": dense(keys[5], (L, D, F), D)},
             "up_proj": {"kernel": dense(keys[6], (L, D, F), D)},
             "down_proj": {"kernel": dense(keys[7], (L, F, D), F)},
         },
-        "final_norm": {"scale": jnp.ones((D,), cfg.dtype)},
+        "final_norm": {"scale": norm_init((D,))},
     }
     if cfg.qkv_bias:
         params["layers"]["q_proj"]["bias"] = jnp.zeros((L, Hq), cfg.dtype)
@@ -104,21 +109,41 @@ def _project_qkv(lp: Params, x: jax.Array, cfg: ModelConfig,
     return q, k, v
 
 
-def _mlp(lp: Params, x: jax.Array) -> jax.Array:
+def _norm(x: jax.Array, scale: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """RMSNorm; the gemma family stores w with the norm computing
+    (1 + w) (rms_unit_offset)."""
+    if cfg.rms_unit_offset:
+        scale = 1.0 + scale.astype(jnp.float32)
+    return rms_norm(x, scale, cfg.rms_eps)
+
+
+def _embed(params: Params, cfg: ModelConfig, tokens: jax.Array) -> jax.Array:
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale:   # gemma scales embeddings by sqrt(hidden)
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
+    return x
+
+
+def _mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     gate = quantized_einsum("...d,df->...f", x, lp["gate_proj"]["kernel"])
     up = quantized_einsum("...d,df->...f", x, lp["up_proj"]["kernel"])
-    return quantized_einsum("...f,fd->...d", jax.nn.silu(gate) * up,
+    act = (jax.nn.gelu if cfg.act == "gelu" else jax.nn.silu)(gate)
+    return quantized_einsum("...f,fd->...d", act * up,
                             lp["down_proj"]["kernel"])
 
 
 def _unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps)
+    x = _norm(x, params["final_norm"]["scale"], cfg)
     if cfg.tie_embeddings:
         logits = jnp.einsum("...d,vd->...v", x, params["embed"]["embedding"])
     else:
         logits = quantized_einsum("...d,dv->...v", x,
                                   params["lm_head"]["kernel"])
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if cfg.final_logit_softcap > 0:   # gemma-2 style tanh capping
+        cap = cfg.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 def prefill_forward(params: Params, cfg: ModelConfig,
@@ -130,7 +155,7 @@ def prefill_forward(params: Params, cfg: ModelConfig,
                     seq_lens: jax.Array,      # [B] valid suffix lengths
                     ) -> tuple[jax.Array, jax.Array]:
     """Returns (last-token logits [B, V], updated kv_pages)."""
-    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    x = _embed(params, cfg, tokens)
     return prefill_from_embeddings(params, cfg, x, positions, kv_pages,
                                    page_table, prefix_lens, seq_lens)
 
@@ -156,7 +181,7 @@ def prefill_from_embeddings(params: Params, cfg: ModelConfig,
 
     def layer_body(l, x, k_pages, v_pages):
         lp = jax.tree.map(lambda a: a[l], params["layers"])
-        h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
+        h = _norm(x, lp["input_norm"]["scale"], cfg)
         q, k, v = _project_qkv(lp, h, cfg, positions)
         k_pages, v_pages = write_prefill_kv(k_pages, v_pages, k, v,
                                             page_table, prefix_lens, seq_lens)
@@ -164,8 +189,8 @@ def prefill_from_embeddings(params: Params, cfg: ModelConfig,
                                  page_table, prefix_lens, seq_lens)
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = x + quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
-        h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
-        x = x + _mlp(lp, h2)
+        h2 = _norm(x, lp["post_attn_norm"]["scale"], cfg)
+        x = x + _mlp(lp, h2, cfg)
         return x, k_pages, v_pages
 
     for l in range(cfg.num_layers):
@@ -191,22 +216,22 @@ def embed_forward(params: Params, cfg: ModelConfig,
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :],
                                  (B, S))
-    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    x = _embed(params, cfg, tokens)
 
     def layer_body(l, x):
         lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
-        h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
+        h = _norm(x, lp["input_norm"]["scale"], cfg)
         q, k, v = _project_qkv(lp, h, cfg, positions)
         attn = prefill_attention(q, k, v, None, None, None,
                                  jnp.zeros((B,), jnp.int32), seq_lens)
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = x + quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
-        h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
-        return x + _mlp(lp, h2)
+        h2 = _norm(x, lp["post_attn_norm"]["scale"], cfg)
+        return x + _mlp(lp, h2, cfg)
 
     for l in range(cfg.num_layers):
         x = layer_body(l, x)
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps)
+    x = _norm(x, params["final_norm"]["scale"], cfg)
     mask = (jnp.arange(S)[None, :] < seq_lens[:, None])[..., None]
     summed = jnp.sum(jnp.where(mask, x.astype(jnp.float32), 0.0), axis=1)
     return summed / jnp.maximum(seq_lens[:, None], 1)
@@ -223,7 +248,7 @@ def verify_forward(params: Params, cfg: ModelConfig,
     block per sequence (last accepted token + draft tokens), returning
     logits at EVERY block position [B, S, V] + updated KV. Structurally a
     batched mini-prefill against the paged cache."""
-    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    x = _embed(params, cfg, tokens)
     return prefill_from_embeddings(params, cfg, x, positions, kv_pages,
                                    page_table, prefix_lens, seq_lens,
                                    all_logits=True)
@@ -252,11 +277,11 @@ def decode_forward(params: Params, cfg: ModelConfig,
     from ..ops.attention import kv_writeback_mode
     scatter = kv_writeback_mode() == "scatter"
     page_size = kv_pages.shape[4]
-    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)   # [B, D]
+    x = _embed(params, cfg, tokens)                            # [B, D]
 
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
-        h = rms_norm(x, lp["input_norm"]["scale"], cfg.rms_eps)
+        h = _norm(x, lp["input_norm"]["scale"], cfg)
         q, k, v = _project_qkv(lp, h, cfg, positions)             # [B, H, hd]
         if scatter:
             page_idx = jnp.take_along_axis(
@@ -275,8 +300,8 @@ def decode_forward(params: Params, cfg: ModelConfig,
                 page_table, context_lens)
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = x + quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
-        h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
-        x = x + _mlp(lp, h2)
+        h2 = _norm(x, lp["post_attn_norm"]["scale"], cfg)
+        x = x + _mlp(lp, h2, cfg)
         if not scatter:
             kv_pages = jax.lax.dynamic_update_index_in_dim(
                 kv_pages, jnp.stack([k_pages, v_pages]), l, 0)
@@ -291,4 +316,5 @@ register_model_family(ModelFamily(
     sharding_rules=LLAMA_STACKED_RULES,
     verify_forward=verify_forward,
     embed_forward=embed_forward,
+    supports_int8=True,
 ))

@@ -41,4 +41,5 @@ register_model_family(ModelFamily(
     sharding_rules=LLAMA_STACKED_RULES,
     verify_forward=verify_forward,
     embed_forward=embed_forward,
+    supports_int8=True,
 ))
