@@ -87,6 +87,141 @@ class TestGemmaPagedCorrectness:
         assert float(jnp.max(jnp.abs(logits))) <= cfg.final_logit_softcap
 
 
+class TestGemma2:
+    """Gemma-2 extras: sliding-window/global alternation, attention-score
+    softcap, query scale, sandwich norms — all on the shared llama body."""
+
+    @pytest.fixture(scope="class")
+    def setup2(self):
+        from xllm_service_tpu.models.gemma import gemma2_tiny_config
+        cfg = gemma2_tiny_config(dtype=jnp.float32)
+        fam = get_model_family("gemma")
+        params = fam.init_params(cfg, jax.random.PRNGKey(0))
+        return cfg, fam, params
+
+    def test_config_layer_pattern(self, setup2):
+        cfg, _, _ = setup2
+        assert [cfg.layer_is_local(l) for l in range(4)] == \
+            [True, False, True, False]
+
+    def test_sandwich_params_exist(self, setup2):
+        cfg, _, params = setup2
+        assert "pre_ffw_norm" in params["layers"]
+        assert "post_ffw_norm" in params["layers"]
+
+    def test_decode_matches_full_prefill(self, setup2):
+        """Incremental decode == one-shot prefill, with T far past the
+        window so local layers genuinely mask (window=8, T=21)."""
+        cfg, fam, params = setup2
+        T = 21
+        toks = jax.random.randint(jax.random.PRNGKey(1), (1, T), 0,
+                                  cfg.vocab_size)
+        pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+        pos = jnp.arange(T)[None, :]
+        logits_full, _ = fam.prefill_forward(
+            params, cfg, toks, pos, alloc_pages(cfg, 8), pt,
+            jnp.zeros((1,), jnp.int32), jnp.array([T], jnp.int32))
+        kv2 = alloc_pages(cfg, 8)
+        _, kv2 = fam.prefill_forward(
+            params, cfg, toks[:, :T - 1], pos[:, :T - 1], kv2, pt,
+            jnp.zeros((1,), jnp.int32), jnp.array([T - 1], jnp.int32))
+        logits_dec, _ = fam.decode_forward(
+            params, cfg, toks[:, T - 1], jnp.array([T - 1], jnp.int32),
+            kv2, pt, jnp.array([T], jnp.int32))
+        np.testing.assert_allclose(np.asarray(logits_dec),
+                                   np.asarray(logits_full),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_chunked_prefill_matches(self, setup2):
+        """Prefix-cached continuation crosses the window boundary: the
+        second chunk's queries must see only the trailing window of the
+        cached prefix on local layers."""
+        cfg, fam, params = setup2
+        T, split = 20, 13
+        toks = jax.random.randint(jax.random.PRNGKey(4), (1, T), 0,
+                                  cfg.vocab_size)
+        pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+        pos = jnp.arange(T)[None, :]
+        logits_full, _ = fam.prefill_forward(
+            params, cfg, toks, pos, alloc_pages(cfg, 8), pt,
+            jnp.zeros((1,), jnp.int32), jnp.array([T], jnp.int32))
+        kv = alloc_pages(cfg, 8)
+        _, kv = fam.prefill_forward(
+            params, cfg, toks[:, :split], pos[:, :split], kv, pt,
+            jnp.zeros((1,), jnp.int32), jnp.array([split], jnp.int32))
+        logits_chunk, _ = fam.prefill_forward(
+            params, cfg, toks[:, split:], pos[:, split:], kv, pt,
+            jnp.array([split], jnp.int32), jnp.array([T - split], jnp.int32))
+        np.testing.assert_allclose(np.asarray(logits_chunk),
+                                   np.asarray(logits_full),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_sliding_window_changes_long_context(self, setup2):
+        """Windowing must alter logits once T > window but leave T <=
+        window untouched (vs the same config with the window off)."""
+        cfg, fam, params = setup2
+        nowin = gemma2_nowindow(cfg)
+
+        def run(c, T, key):
+            toks = jax.random.randint(jax.random.PRNGKey(key), (1, T), 0,
+                                      c.vocab_size)
+            logits, _ = fam.prefill_forward(
+                params, c, toks, jnp.arange(T)[None, :],
+                alloc_pages(c, 8), jnp.arange(8, dtype=jnp.int32)[None, :],
+                jnp.zeros((1,), jnp.int32), jnp.array([T], jnp.int32))
+            return np.asarray(logits)
+
+        # T=6 <= window=8: identical.
+        np.testing.assert_allclose(run(cfg, 6, 7), run(nowin, 6, 7),
+                                   rtol=1e-5, atol=1e-5)
+        # T=20 > window: the local layers mask, logits diverge.
+        assert np.abs(run(cfg, 20, 8) - run(nowin, 20, 8)).max() > 1e-4
+
+    def test_seq_parallel_mesh_refused(self, setup2):
+        from xllm_service_tpu.engine.config import EngineConfig
+        from xllm_service_tpu.engine.engine import InferenceEngine
+        from xllm_service_tpu.parallel.mesh import MeshConfig, build_mesh
+        cfg, _, _ = setup2
+        mesh = build_mesh(MeshConfig(seq=2), devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="seq-axis"):
+            InferenceEngine(EngineConfig(
+                model_family="gemma", model=cfg, num_pages=32, page_size=16,
+                hash_block_size=32, max_batch_size=2, max_seq_len=128,
+                prefill_buckets=(128,), decode_horizon=2), mesh=mesh)
+
+    def test_engine_serves_gemma2(self):
+        from test_engine import Collector, run_requests
+        from xllm_service_tpu.common.request import SamplingParams
+        from xllm_service_tpu.engine.config import EngineConfig
+        from xllm_service_tpu.engine.engine import (
+            EngineRequest,
+            InferenceEngine,
+        )
+        from xllm_service_tpu.models.gemma import gemma2_tiny_config
+
+        cfg = EngineConfig(
+            model_family="gemma",
+            model=gemma2_tiny_config(max_context_len=128),
+            num_pages=64, page_size=16, hash_block_size=32,
+            max_batch_size=2, max_seq_len=128,
+            prefill_buckets=(32, 64, 128), decode_horizon=4)
+        engine = InferenceEngine(cfg)
+        col = Collector()
+        run_requests(engine, [EngineRequest(
+            service_request_id="g2", token_ids=list(range(3, 40)),
+            sampling=SamplingParams(max_tokens=8, temperature=0.0),
+            on_output=col)])
+        assert len(col.tokens) == 8
+        assert col.finish_reason == "length"
+
+
+def gemma2_nowindow(cfg):
+    """Same gemma-2 config with the sliding window disabled."""
+    import dataclasses
+    return dataclasses.replace(cfg, sliding_window=0,
+                               sliding_window_pattern=0)
+
+
 class TestGemmaEngine:
     def test_engine_serves_gemma(self):
         from test_engine import Collector, run_requests

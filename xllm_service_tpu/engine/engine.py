@@ -191,6 +191,15 @@ class InferenceEngine:
         from ..parallel.mesh import AXIS_SEQ
         self.seq_parallel = (int(self.mesh.shape[AXIS_SEQ])
                              if self.mesh is not None else 1)
+        if self.seq_parallel > 1 and (mcfg.attn_logit_softcap > 0
+                                      or mcfg.sliding_window > 0):
+            # Ring prefill / CP decode don't implement gemma-2's score
+            # softcap or sliding window; fail loud rather than trace a
+            # program that silently drops them.
+            raise ValueError(
+                "seq-axis parallelism is not supported for models with "
+                "attn_logit_softcap/sliding_window (gemma-2); use a mesh "
+                "without a seq axis")
         self.page_mgr = KVPageManager(cfg.num_pages, cfg.page_size,
                                       cfg.hash_block_size)
 

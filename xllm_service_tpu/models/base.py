@@ -65,6 +65,27 @@ class ModelConfig:
     embed_scale: bool = False
     rms_unit_offset: bool = False
     final_logit_softcap: float = 0.0
+    # Gemma-2/3 attention extras (honored by the shared llama layer body;
+    # the engine falls back to the XLA attention paths for these — the
+    # Pallas/ring/CP kernels don't implement windowing or score capping):
+    # - attn_logit_softcap: tanh-cap attention SCORES (gemma-2: 50.0);
+    # - sliding_window + sliding_window_pattern N: layer l attends only to
+    #   the trailing `sliding_window` positions unless (l % N) == N-1,
+    #   which stays global (gemma-2: N=2 — even layers local, odd global);
+    # - query_pre_attn_scalar: q scale = qpas**-0.5 instead of hd**-0.5;
+    # - sandwich_norms: norm the attention/MLP OUTPUTS too (gemma-2's
+    #   post_attention/pre_ffw/post_ffw layernorm arrangement).
+    attn_logit_softcap: float = 0.0
+    sliding_window: int = 0
+    sliding_window_pattern: int = 0
+    query_pre_attn_scalar: float = 0.0
+    sandwich_norms: bool = False
+
+    def layer_is_local(self, layer: int) -> bool:
+        """True if `layer` uses sliding-window (local) attention."""
+        n = self.sliding_window_pattern
+        return (self.sliding_window > 0 and n > 0
+                and (layer % n) != n - 1)
 
     @property
     def q_size(self) -> int:

@@ -10,11 +10,15 @@ tanh softcap (``final_logit_softcap``). Registered exactly like qwen2:
 the body genuinely branches on these fields, so no forward is
 duplicated.
 
-Gemma-2 is NOT yet a servable config: beyond the switches above it
-alternates sliding-window/global attention, softcaps ATTENTION logits
-(50.0), and sandwiches the MLP between pre/post feed-forward norms —
-attention-kernel-level features this family does not implement. No
-gemma-2 factory is exposed until they exist.
+Gemma-2 adds (all config switches on the same shared body):
+alternating sliding-window/global attention (``sliding_window`` +
+``sliding_window_pattern=2`` — even layers local, odd global), ATTENTION
+logit softcapping (``attn_logit_softcap=50.0``), an explicit query scale
+(``query_pre_attn_scalar``), and sandwich norms (``sandwich_norms`` —
+post-attention/pre-ffw/post-ffw layernorms). These route through the XLA
+attention paths (ops/attention.py softcap/window kwargs); the
+Pallas/ring/CP kernels decline them and the engine refuses a seq-axis
+mesh for such configs.
 
 Reference parity note: the reference service routes any family by model
 id (`tokenizer/tokenizer_factory.cpp` decides by config); the engine
@@ -45,8 +49,35 @@ def gemma_tiny_config(**kw) -> ModelConfig:
     return ModelConfig(**defaults)
 
 
+def gemma2_tiny_config(**kw) -> ModelConfig:
+    """CPU-test scale with every gemma-2 switch on (window small enough
+    that tests exercise both the inside- and outside-window regimes)."""
+    defaults = dict(name="gemma", vocab_size=512, hidden_size=128,
+                    num_layers=4, num_heads=4, num_kv_heads=2, head_dim=32,
+                    ffn_size=256, rope_theta=10000.0, tie_embeddings=True,
+                    act="gelu", embed_scale=True, rms_unit_offset=True,
+                    final_logit_softcap=30.0, attn_logit_softcap=50.0,
+                    sliding_window=8, sliding_window_pattern=2,
+                    query_pre_attn_scalar=24.0, sandwich_norms=True,
+                    max_context_len=512)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def gemma2_9b_config() -> ModelConfig:
+    return ModelConfig(name="gemma", vocab_size=256128, hidden_size=3584,
+                       num_layers=42, num_heads=16, num_kv_heads=8,
+                       head_dim=256, ffn_size=14336, rope_theta=10000.0,
+                       tie_embeddings=True, act="gelu", embed_scale=True,
+                       rms_unit_offset=True, final_logit_softcap=30.0,
+                       attn_logit_softcap=50.0, sliding_window=4096,
+                       sliding_window_pattern=2,
+                       query_pre_attn_scalar=256.0, sandwich_norms=True,
+                       max_context_len=8192)
+
+
 def gemma_2b_config() -> ModelConfig:
-    return ModelConfig(name="gemma", vocab_size=256128, hidden_size=2048,
+    return ModelConfig(name="gemma", vocab_size=256000, hidden_size=2048,
                        num_layers=18, num_heads=8, num_kv_heads=1,
                        head_dim=256, ffn_size=16384, rope_theta=10000.0,
                        tie_embeddings=True, act="gelu", embed_scale=True,

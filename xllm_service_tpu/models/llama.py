@@ -85,6 +85,9 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
         params["layers"]["q_proj"]["bias"] = jnp.zeros((L, Hq), cfg.dtype)
         params["layers"]["k_proj"]["bias"] = jnp.zeros((L, Hkv), cfg.dtype)
         params["layers"]["v_proj"]["bias"] = jnp.zeros((L, Hkv), cfg.dtype)
+    if cfg.sandwich_norms:   # gemma-2: pre/post feed-forward norms
+        params["layers"]["pre_ffw_norm"] = {"scale": norm_init((L, D))}
+        params["layers"]["post_ffw_norm"] = {"scale": norm_init((L, D))}
     if not cfg.tie_embeddings:
         params["lm_head"] = {"kernel": dense(
             jax.random.fold_in(rng, 99), (D, cfg.vocab_size), D)}
@@ -109,6 +112,21 @@ def _project_qkv(lp: Params, x: jax.Array, cfg: ModelConfig,
     return q, k, v
 
 
+def _attn_opts(cfg: ModelConfig, layer: int) -> dict:
+    """Per-layer attention kwargs for the gemma-2 extras: explicit query
+    scale (query_pre_attn_scalar), score softcap, and the sliding window
+    on local layers. Empty for every other family — keeping `scale=None`
+    preserves the Pallas-kernel eligibility gates."""
+    opts: dict = {}
+    if cfg.query_pre_attn_scalar > 0:
+        opts["scale"] = cfg.query_pre_attn_scalar ** -0.5
+    if cfg.attn_logit_softcap > 0:
+        opts["softcap"] = cfg.attn_logit_softcap
+    if cfg.layer_is_local(layer):
+        opts["window"] = cfg.sliding_window
+    return opts
+
+
 def _norm(x: jax.Array, scale: jax.Array, cfg: ModelConfig) -> jax.Array:
     """RMSNorm; the gemma family stores w with the norm computing
     (1 + w) (rms_unit_offset)."""
@@ -130,6 +148,22 @@ def _mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     act = (jax.nn.gelu if cfg.act == "gelu" else jax.nn.silu)(gate)
     return quantized_einsum("...f,fd->...d", act * up,
                             lp["down_proj"]["kernel"])
+
+
+def _attn_mlp_residual(lp: Params, x: jax.Array, attn: jax.Array,
+                       cfg: ModelConfig) -> jax.Array:
+    """Fold the attention output and the MLP into the residual stream.
+    sandwich_norms (gemma-2) norms the attention/MLP OUTPUTS as well:
+    x += post_attn_norm(o_proj(attn)); x += post_ffw_norm(mlp(pre_ffw_norm(x)))."""
+    o = quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
+    if cfg.sandwich_norms:
+        x = x + _norm(o, lp["post_attn_norm"]["scale"], cfg)
+        h2 = _norm(x, lp["pre_ffw_norm"]["scale"], cfg)
+        return x + _norm(_mlp(lp, h2, cfg),
+                         lp["post_ffw_norm"]["scale"], cfg)
+    x = x + o
+    h2 = _norm(x, lp["post_attn_norm"]["scale"], cfg)
+    return x + _mlp(lp, h2, cfg)
 
 
 def _unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
@@ -186,11 +220,10 @@ def prefill_from_embeddings(params: Params, cfg: ModelConfig,
         k_pages, v_pages = write_prefill_kv(k_pages, v_pages, k, v,
                                             page_table, prefix_lens, seq_lens)
         attn = prefill_attention(q, k, v, k_pages, v_pages,
-                                 page_table, prefix_lens, seq_lens)
+                                 page_table, prefix_lens, seq_lens,
+                                 **_attn_opts(cfg, l))
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
-        x = x + quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
-        h2 = _norm(x, lp["post_attn_norm"]["scale"], cfg)
-        x = x + _mlp(lp, h2, cfg)
+        x = _attn_mlp_residual(lp, x, attn, cfg)
         return x, k_pages, v_pages
 
     for l in range(cfg.num_layers):
@@ -223,11 +256,10 @@ def embed_forward(params: Params, cfg: ModelConfig,
         h = _norm(x, lp["input_norm"]["scale"], cfg)
         q, k, v = _project_qkv(lp, h, cfg, positions)
         attn = prefill_attention(q, k, v, None, None, None,
-                                 jnp.zeros((B,), jnp.int32), seq_lens)
+                                 jnp.zeros((B,), jnp.int32), seq_lens,
+                                 **_attn_opts(cfg, l))
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
-        x = x + quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
-        h2 = _norm(x, lp["post_attn_norm"]["scale"], cfg)
-        return x + _mlp(lp, h2, cfg)
+        return _attn_mlp_residual(lp, x, attn, cfg)
 
     for l in range(cfg.num_layers):
         x = layer_body(l, x)
@@ -293,15 +325,13 @@ def decode_forward(params: Params, cfg: ModelConfig,
                 v, mode="drop")
             k_pages, v_pages = kv_pages[l, 0], kv_pages[l, 1]
             attn = paged_attention(q, k_pages, v_pages, page_table,
-                                   context_lens)
+                                   context_lens, **_attn_opts(cfg, l))
         else:
             attn, k_pages, v_pages = decode_attention_step(
                 q, k, v, kv_pages[l, 0], kv_pages[l, 1],
-                page_table, context_lens)
+                page_table, context_lens, **_attn_opts(cfg, l))
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
-        x = x + quantized_einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
-        h2 = _norm(x, lp["post_attn_norm"]["scale"], cfg)
-        x = x + _mlp(lp, h2, cfg)
+        x = _attn_mlp_residual(lp, x, attn, cfg)
         if not scatter:
             kv_pages = jax.lax.dynamic_update_index_in_dim(
                 kv_pages, jnp.stack([k_pages, v_pages]), l, 0)

@@ -38,6 +38,9 @@ _HF_LAYER_MAP = {
     "self_attn.k_proj.bias": ("k_proj/bias", False),
     "self_attn.v_proj.bias": ("v_proj/bias", False),
     "post_attention_layernorm.weight": ("post_attn_norm/scale", False),
+    # gemma-2 sandwich norms (absent from other families' checkpoints).
+    "pre_feedforward_layernorm.weight": ("pre_ffw_norm/scale", False),
+    "post_feedforward_layernorm.weight": ("post_ffw_norm/scale", False),
     "mlp.gate_proj.weight": ("gate_proj/kernel", True),
     "mlp.up_proj.weight": ("up_proj/kernel", True),
     "mlp.down_proj.weight": ("down_proj/kernel", True),

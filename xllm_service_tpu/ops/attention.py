@@ -172,13 +172,18 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       k_pages: jax.Array, v_pages: jax.Array,
                       page_table: jax.Array,
                       prefix_lens: jax.Array, seq_lens: jax.Array,
-                      scale: float | None = None) -> jax.Array:
+                      scale: float | None = None,
+                      softcap: float = 0.0, window: int = 0) -> jax.Array:
     """Causal attention for a (possibly prefix-cached) prefill chunk.
 
     q/k/v: [B, S, n(_kv), hd] for the *suffix* being prefilled; queries also
     attend to the cached prefix (first prefix_lens[b] tokens) read from the
     paged pool. seq_lens[b] = valid suffix length (padding masked out).
     Returns [B, S, n_heads, hd].
+
+    softcap > 0 tanh-caps the attention scores; window > 0 restricts each
+    query to the trailing `window` key positions (gemma-2 local layers).
+    Both take the XLA path — the Pallas/ring kernels don't implement them.
     """
     B, S, n_heads, hd = q.shape
     n_kv = k.shape[2]
@@ -199,6 +204,7 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # rows cap keeps the kernel's [S*n_heads, hd] f32 accumulator and
     # m/l scratch inside VMEM; bigger chunks fall back to XLA.
     if k_pages is not None and scale is None \
+            and softcap == 0.0 and window == 0 \
             and getattr(_sp_ctx, "cfg", None) is None:
         import os
 
@@ -221,6 +227,11 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         scale = 1.0 / (hd ** 0.5)
 
     sp = getattr(_sp_ctx, "cfg", None)
+    if sp is not None and (softcap != 0.0 or window != 0):
+        raise NotImplementedError(
+            "ring attention does not support attn softcap/sliding window; "
+            "the engine must not enable sequence-parallel prefill for "
+            "gemma-2-style models")
     if sp is not None:
         # Context-parallel path: ring attention over the seq mesh axis.
         # Queries past seq_lens are end-padding; causal masking keeps them
@@ -237,11 +248,19 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     vf = _repeat_kv(v, n_rep).astype(jnp.float32)
     qf = q.astype(jnp.float32) * scale
 
-    # Suffix-suffix scores, causal + padding mask.
-    ss = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    def cap(s):
+        return softcap * jnp.tanh(s / softcap) if softcap > 0 else s
+
+    # Suffix-suffix scores, causal + padding mask. Absolute positions:
+    # query row r sits at prefix_lens[b] + r, key col c at prefix_lens[b]
+    # + c — their distance is r - c, so the sliding-window mask here is
+    # prefix-independent.
+    ss = cap(jnp.einsum("bqhd,bkhd->bhqk", qf, kf))
     rows = jnp.arange(S)[None, :, None]
     cols = jnp.arange(S)[None, None, :]
     mask = (cols <= rows) & (cols < seq_lens[:, None, None])
+    if window > 0:
+        mask = mask & (rows - cols < window)
     ss = jnp.where(mask[:, None, :, :], ss, _NEG_INF)
 
     has_prefix = k_pages is not None
@@ -249,9 +268,18 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         pk = _repeat_kv(gather_pages(k_pages, page_table), n_rep).astype(jnp.float32)
         pv = _repeat_kv(gather_pages(v_pages, page_table), n_rep).astype(jnp.float32)
         T = pk.shape[1]
-        ps_scores = jnp.einsum("bqhd,bkhd->bhqk", qf, pk)
+        ps_scores = cap(jnp.einsum("bqhd,bkhd->bhqk", qf, pk))
         pmask = (jnp.arange(T)[None, :] < prefix_lens[:, None])  # [B, T]
-        ps_scores = jnp.where(pmask[:, None, None, :], ps_scores, _NEG_INF)
+        pmask = pmask[:, None, :]                                # [B, 1, T]
+        if window > 0:
+            # Query row r (abs pos prefix_lens + r) sees prefix key c
+            # (abs pos c) iff prefix_lens + r - c < window.
+            dist = (prefix_lens[:, None, None] + rows
+                    - jnp.arange(T)[None, None, :])   # [B, S, T]
+            pmask = pmask & (dist < window)
+        else:
+            pmask = jnp.broadcast_to(pmask, (B, S, T))
+        ps_scores = jnp.where(pmask[:, None, :, :], ps_scores, _NEG_INF)
         scores = jnp.concatenate([ps_scores, ss], axis=-1)
         values = jnp.concatenate([pv, vf], axis=1)
     else:
@@ -318,6 +346,8 @@ def _mosaic_kernel_ok(q: jax.Array, k_pages: jax.Array) -> bool:
 def decode_attention_step(q: jax.Array, k: jax.Array, v: jax.Array,
                           k_pages: jax.Array, v_pages: jax.Array,
                           page_table: jax.Array, context_lens: jax.Array,
+                          scale: float | None = None,
+                          softcap: float = 0.0, window: int = 0,
                           ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Append one token's K/V and attend, as one step.
 
@@ -335,6 +365,7 @@ def decode_attention_step(q: jax.Array, k: jax.Array, v: jax.Array,
     land on the owning shard via the XLA scatter.
     """
     if (kv_writeback_mode() == "fused"
+            and softcap == 0.0 and window == 0 and scale is None
             and getattr(_cp_ctx, "cfg", None) is None
             and _mosaic_kernel_ok(q, k_pages)):
         from .pallas_fused_decode_attention import (
@@ -347,7 +378,8 @@ def decode_attention_step(q: jax.Array, k: jax.Array, v: jax.Array,
     positions = context_lens - 1
     k_pages, v_pages = write_decode_kv(k_pages, v_pages, k, v,
                                        page_table, positions)
-    attn = paged_attention(q, k_pages, v_pages, page_table, context_lens)
+    attn = paged_attention(q, k_pages, v_pages, page_table, context_lens,
+                           scale=scale, softcap=softcap, window=window)
     return attn, k_pages, v_pages
 
 
@@ -355,12 +387,15 @@ def decode_attention_step(q: jax.Array, k: jax.Array, v: jax.Array,
 def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                         page_table: jax.Array,
                         context_lens: jax.Array,
-                        scale: float | None = None) -> jax.Array:
+                        scale: float | None = None,
+                        softcap: float = 0.0, window: int = 0) -> jax.Array:
     """One-token-per-sequence paged attention (XLA path).
 
     q: [B, n_heads, hd]; returns [B, n_heads, hd]. Assumes the new token's
     K/V are already written (attends to positions < context_lens[b] + 1 ...
-    callers pass context_lens *including* the new token).
+    callers pass context_lens *including* the new token). softcap/window:
+    gemma-2 score capping and sliding-window (the query sits at position
+    context_lens[b]-1, so the window keeps keys >= context_lens[b]-window).
     """
     B, n_heads, hd = q.shape
     n_kv = k_pages.shape[1]
@@ -373,7 +408,12 @@ def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     T = k.shape[1]
     qf = q.astype(jnp.float32) * scale
     scores = jnp.einsum("bhd,bkhd->bhk", qf, k.astype(jnp.float32))
+    if softcap > 0:
+        scores = softcap * jnp.tanh(scores / softcap)
     mask = jnp.arange(T)[None, :] < context_lens[:, None]
+    if window > 0:
+        mask = mask & (jnp.arange(T)[None, :]
+                       >= context_lens[:, None] - window)
     scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
@@ -382,25 +422,35 @@ def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array,
-                    context_lens: jax.Array) -> jax.Array:
+                    context_lens: jax.Array,
+                    scale: float | None = None,
+                    softcap: float = 0.0, window: int = 0) -> jax.Array:
     """Backend dispatcher: context-parallel op when the engine traced
     under `decode_context_parallel` (pool sharded over the seq axis),
     hand-written Pallas kernel on TPU, XLA gather fallback elsewhere (CPU
     test meshes) and for shapes outside the kernel's tiling constraints.
     Selection happens at trace time — all paths are numerically
-    equivalent (tested)."""
+    equivalent (tested). softcap/window (gemma-2) always take the XLA
+    path; the engine refuses CP meshes for such models."""
     cp = getattr(_cp_ctx, "cfg", None)
     if cp is not None:
+        if softcap != 0.0 or window != 0:
+            raise NotImplementedError(
+                "context-parallel decode does not support attn "
+                "softcap/sliding window")
         from .cp_paged_attention import cp_paged_attention
 
         mesh, seq_axis = cp
         return cp_paged_attention(q, k_pages, v_pages, page_table,
-                                  context_lens, mesh, seq_axis=seq_axis)
+                                  context_lens, mesh, seq_axis=seq_axis,
+                                  scale=scale)
 
-    if _mosaic_kernel_ok(q, k_pages):
+    if (softcap == 0.0 and window == 0 and scale is None
+            and _mosaic_kernel_ok(q, k_pages)):
         from .pallas_paged_attention import paged_attention_pallas
 
         return paged_attention_pallas(q, k_pages, v_pages, page_table,
                                       context_lens,
                                       interpret=_pallas_interpret())
-    return paged_attention_xla(q, k_pages, v_pages, page_table, context_lens)
+    return paged_attention_xla(q, k_pages, v_pages, page_table, context_lens,
+                               scale=scale, softcap=softcap, window=window)
