@@ -9,6 +9,15 @@ Prints one JSON line per framing and the ratio.
 
 from __future__ import annotations
 
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from xllm_service_tpu.utils import pin_cpu_platform_if_requested
+
+pin_cpu_platform_if_requested()
+
 import json
 import time
 import uuid

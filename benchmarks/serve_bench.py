@@ -20,6 +20,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from xllm_service_tpu.utils import pin_cpu_platform_if_requested
+
+pin_cpu_platform_if_requested()
+
 import numpy as np
 import requests
 
@@ -76,7 +80,11 @@ def main() -> None:
     ecfg = EngineConfig(
         model_id="bench", model=mcfg, num_pages=pages, page_size=16,
         max_batch_size=16, max_seq_len=max_seq, prefill_buckets=buckets,
-        decode_horizon=horizon)
+        decode_horizon=horizon,
+        # Pre-compile every horizon + prefill bucket at boot: on TPU a
+        # cold bucket otherwise lands a ~20s XLA compile on a live
+        # request's TTFT, which is boot cost, not serving latency.
+        warmup_programs=on_accel)
     agent = EngineAgent(
         ecfg, AgentConfig(host="127.0.0.1", model_id="bench",
                           generation_flush_ms=2.0),

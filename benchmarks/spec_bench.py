@@ -8,8 +8,15 @@ Prints one JSON line per mode plus the speedup.
 from __future__ import annotations
 
 import json
-
+import sys
 import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from xllm_service_tpu.utils import pin_cpu_platform_if_requested
+
+pin_cpu_platform_if_requested()
 
 
 def main() -> None:

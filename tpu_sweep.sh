@@ -41,7 +41,16 @@ run_step() {
   # A step that started while the relay was down silently initializes the
   # CPU backend even if the relay recovers mid-run: reject any artifact
   # that doesn't claim the tpu backend (every bench emits "backend").
+  # BUT an rc=0 artifact carrying an "error" field ran fine and failed
+  # INSIDE the bench (e.g. a Mosaic compile error) — if the relay is
+  # still alive that's a genuine failure, not a flake: restarting would
+  # loop forever re-hitting the same error. Record it and move on.
   if ! grep -q '"backend": "tpu"' "tpu_results/$name.json"; then
+    if grep -q '"error"' "tpu_results/$name.json" && probe; then
+      echo "step $name failed inside the bench (relay alive) — recorded"
+      FAILED_STEPS="$FAILED_STEPS $name(bench-error)"
+      return 0
+    fi
     echo "step $name did not run on TPU — restarting sweep loop"
     return 1
   fi

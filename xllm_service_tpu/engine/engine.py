@@ -615,16 +615,57 @@ class InferenceEngine:
         while h <= self.cfg.decode_horizon:
             self._dstate, packed = self._decode_multi(
                 self.params, self._dstate, h)
-            jax.block_until_ready(packed)
+            # Fetch, don't just block: the download path compiles its own
+            # tiny XLA ops per output shape, and over a relay-attached
+            # chip EVERY remote AOT compile costs seconds — measured 58s
+            # of first-request TTFT from exactly these (threefry_split,
+            # unstack, broadcast_in_dim) after program-only warmup.
+            self._fetch(packed)
             h <<= 1
         if self._spec_verify is not None:
             B, K = self.cfg.max_batch_size, self.cfg.speculate_k
             self._dstate, packed = self._spec_verify(
                 self.params, self._dstate,
                 jnp.full((B, K), -1, jnp.int32), jnp.ones((B,), jnp.int32))
-            jax.block_until_ready(packed)
-        logger.info("decode program warmup done in %.1fs",
-                    time.monotonic() - t0)
+            self._fetch(packed)              # see the decode-loop comment
+        # Prefill-install programs compile per bucket; a cold bucket costs
+        # a full XLA compile on a live request's TTFT (measured: 20s p90
+        # on the TPU serve bench before this). Warm each bucket against
+        # slot 0 with a zero-length suffix (every KV write redirects to
+        # the garbage page), then clear the slot.
+        mcfg = self.cfg.model
+        P = self.cfg.pages_per_seq
+        NS, NB = NUM_STOP_IDS, NUM_BIAS
+        mm = jnp.zeros((1, 1, mcfg.hidden_size), mcfg.dtype)
+        ints = np.full((P + 4 + NS + NB,), GARBAGE_PAGE, np.int32)
+        ints[P] = 0            # slot
+        ints[P + 1] = 0        # matched prefix
+        ints[P + 2] = 0        # suffix length
+        ints[P + 3] = 0        # want_logprobs
+        ints[P + 4:] = -1      # stop ids + bias ids: empty
+        floats = np.concatenate([
+            np.asarray([1.0, 0.0, 1.0, 0.0, 0.0, 1.0], np.float32),
+            np.zeros((NB,), np.float32)])
+        for S in self.cfg.prefill_buckets:
+            packed_in = jnp.asarray(np.concatenate([
+                np.zeros((S,), np.int32), ints, floats.view(np.int32),
+                np.zeros((mcfg.vocab_size,), np.int32),
+                np.zeros((2,), np.int32)]))
+            progs = [self._prefill_install]
+            if (self._prefill_install_sp is not None
+                    and S % self.seq_parallel == 0
+                    and S >= self.cfg.seq_parallel_min_tokens):
+                progs.append(self._prefill_install_sp)
+            for prog in progs:
+                self._dstate, packed = prog(self.params, self._dstate,
+                                            packed_in, mm)
+                self._fetch(packed)          # see the decode-loop comment
+                self._dstate = self._clear_slot(self._dstate, 0)
+        # The admission path's host-side RNG split is its own compile.
+        self._rng, _ = jax.random.split(self._rng)
+        logger.info("program warmup (%d horizons, %d prefill buckets) "
+                    "done in %.1fs", self.cfg.decode_horizon.bit_length(),
+                    len(self.cfg.prefill_buckets), time.monotonic() - t0)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceEngine":

@@ -65,7 +65,7 @@ def gemma2_tiny_config(**kw) -> ModelConfig:
 
 
 def gemma2_9b_config() -> ModelConfig:
-    return ModelConfig(name="gemma", vocab_size=256128, hidden_size=3584,
+    return ModelConfig(name="gemma", vocab_size=256000, hidden_size=3584,
                        num_layers=42, num_heads=16, num_kv_heads=8,
                        head_dim=256, ffn_size=14336, rope_theta=10000.0,
                        tie_embeddings=True, act="gelu", embed_scale=True,

@@ -9,17 +9,29 @@ the kernel's re-read) on the most bandwidth-bound program in the engine
 
 This kernel fuses the append:
 - the new token's K/V arrive as VMEM operands ``[B, n_kv, hd]``;
-- at grid-step start the kernel issues async DMA copies VMEM -> HBM into
-  the pool slot ``(page_table[b, pos // ps], :, pos % ps, :)`` where
-  ``pos = context_lens[b] - 1`` (context_lens INCLUDE the new token);
+- the append is a whole-page read-modify-write: at grid-step start the
+  kernel DMAs the tail page ``page_table[b, pos // ps]`` (where ``pos =
+  context_lens[b] - 1``; context_lens INCLUDE the new token) into VMEM —
+  Mosaic tiles HBM memrefs (8,128) over (ps, hd) too, so a single-slot
+  [n_kv, 1, hd] window can't be DMA'd directly, but page-granular slices
+  cut only the major dim and are always aligned. After the page walk the
+  new row is spliced in with a vector select and the page DMA'd back
+  (~2x 64KB per step vs the multi-MB walk — noise, and it replaces the
+  separate XLA scatter's own read-modify-write);
 - attention walks only the *previous* ``ctx - 1`` tokens from HBM pages
-  (the in-flight write can race the page read — the written slot is
-  masked out of the walk, so a torn read is never used);
+  (the write-back can race the walk's read of the same page — the
+  written slot is masked out of every read, so a torn read is never
+  used; the rest of the written page is bit-identical to what was read);
 - the new token's attention contribution is computed directly from the
   VMEM operands and merged into the online softmax at the end — exact,
   and it never waits on the HBM write;
-- the write DMAs are waited at the end of the grid step; the pools are
+- the write-back is waited at the end of the grid step; the pools are
   input/output-aliased so the append is in place.
+- The tail page is PRIVATE to the sequence (the engine allocates a fresh
+  page at each boundary and prefix-cache sharing only covers full hash
+  blocks), so the RMW never clobbers another sequence's data; inactive
+  rows RMW the garbage page 0, where torn whole-page writes are
+  harmless (nothing reads it).
 
 Per-sequence pages are disjoint (the engine owns the page allocator), so
 concurrent grid steps never write the same live slot; padded/finished
@@ -49,27 +61,26 @@ from .pallas_page_dma import (
 
 
 def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
-            q_ref, k_new_ref, v_new_ref,        # VMEM blocks [1, n_*, hd]
+            q_ref,                              # VMEM block [1, n_q, hd]
+            k_new_ref, v_new_ref,               # VMEM blocks [1, n_kv, hd]
             k_in, v_in,                         # full pools (HBM/ANY, aliased)
             o_ref,                              # VMEM block [1, n_q, hd]
             k_out, v_out,                       # same buffers as k_in/v_in
             k_buf, v_buf, sems, wsems,          # scratch
+            k_pg, v_pg,                         # tail-page RMW staging
             m_scr, l_scr, acc_scr,
             *, page_size: int, n_kv: int, group: int, scale: float,
             max_pages: int, chunk: int):
     b = pl.program_id(0)
     ctx = context_lens_ref[b]
     pos = jnp.maximum(ctx - 1, 0)               # the new token's position
-    # Kick the append DMAs first so they overlap the whole page walk.
+    # Kick the tail-page READ DMAs first so they overlap the page walk
+    # (see module docstring: whole-page RMW is the only tiling-aligned
+    # way to land one token's row in the (8,128)-tiled HBM pool).
     wpage = page_table_ref[b, jnp.minimum(pos // page_size, max_pages - 1)]
     slot = pos % page_size
-    for kv in range(n_kv):
-        pltpu.make_async_copy(k_new_ref.at[0, kv],
-                              k_out.at[wpage, kv, slot],
-                              wsems.at[0]).start()
-        pltpu.make_async_copy(v_new_ref.at[0, kv],
-                              v_out.at[wpage, kv, slot],
-                              wsems.at[1]).start()
+    pltpu.make_async_copy(k_in.at[wpage], k_pg, wsems.at[0, 0]).start()
+    pltpu.make_async_copy(v_in.at[wpage], v_pg, wsems.at[0, 1]).start()
 
     ctx_prev = pos                              # tokens already in the pool
     n_pages = jnp.minimum(pl.cdiv(ctx_prev, page_size), max_pages)
@@ -135,14 +146,18 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
     l = jnp.maximum(l_scr[:, :1], 1e-9)
     o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
 
+    # Splice the new row into the staged tail page and write it back.
+    pltpu.make_async_copy(k_in.at[wpage], k_pg, wsems.at[0, 0]).wait()
+    pltpu.make_async_copy(v_in.at[wpage], v_pg, wsems.at[0, 1]).wait()
+    sel = jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size, 1), 1) == slot
+    k_pg[...] = jnp.where(sel, k_new_ref[0][:, None, :], k_pg[...])
+    v_pg[...] = jnp.where(sel, v_new_ref[0][:, None, :], v_pg[...])
+    pltpu.make_async_copy(k_pg, k_out.at[wpage], wsems.at[1, 0]).start()
+    pltpu.make_async_copy(v_pg, v_out.at[wpage], wsems.at[1, 1]).start()
     # The aliased pools must hold the append when this grid step retires.
-    for kv in range(n_kv):
-        pltpu.make_async_copy(k_new_ref.at[0, kv],
-                              k_out.at[wpage, kv, slot],
-                              wsems.at[0]).wait()
-        pltpu.make_async_copy(v_new_ref.at[0, kv],
-                              v_out.at[wpage, kv, slot],
-                              wsems.at[1]).wait()
+    pltpu.make_async_copy(k_pg, k_out.at[wpage], wsems.at[1, 0]).wait()
+    pltpu.make_async_copy(v_pg, v_out.at[wpage], wsems.at[1, 1]).wait()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -187,7 +202,9 @@ def fused_decode_attention_pallas(
             pltpu.VMEM((2, chunk, n_kv, page_size, hd), k_pages.dtype),
             pltpu.VMEM((2, chunk, n_kv, page_size, hd), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.SemaphoreType.DMA((2,)),       # append-write sems (k, v)
+            pltpu.SemaphoreType.DMA((2, 2)),     # tail-page read/write (k,v)
+            pltpu.VMEM((n_kv, page_size, hd), k_pages.dtype),  # k_pg
+            pltpu.VMEM((n_kv, page_size, hd), v_pages.dtype),  # v_pg
             pltpu.VMEM((n_q, 128), jnp.float32),   # m
             pltpu.VMEM((n_q, 128), jnp.float32),   # l
             pltpu.VMEM((n_q, hd), jnp.float32),    # acc

@@ -63,3 +63,22 @@ def get_logger(name: str) -> logging.Logger:
         logger.addHandler(h)
         logger.setLevel(logging.INFO)
     return logger
+
+
+def pin_cpu_platform_if_requested() -> None:
+    """Honor JAX_PLATFORMS=cpu even under a TPU-attach sitecustomize hook.
+
+    Such a hook registers a remote-TPU plugin at interpreter start and
+    pins the platform in-process; with the relay down, backend init then
+    HANGS instead of falling back — the env var alone does not win, but a
+    jax.config override does (same trick as tests/conftest.py and
+    __graft_entry__._pin_cpu_platform). Call BEFORE the first jax backend
+    touch. No-op unless the env explicitly asks for cpu."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        return
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
