@@ -45,6 +45,9 @@ def make_hf_checkpoint(tmp_path, cfg, qkv_bias=False, lm_head=True, seed=0):
             tensors[p + "self_attn.q_proj.bias"] = t(Hq)
             tensors[p + "self_attn.k_proj.bias"] = t(Hkv)
             tensors[p + "self_attn.v_proj.bias"] = t(Hkv)
+        if cfg.sandwich_norms:  # gemma-2 checkpoint names
+            tensors[p + "pre_feedforward_layernorm.weight"] = t(D)
+            tensors[p + "post_feedforward_layernorm.weight"] = t(D)
     keys = sorted(tensors)
     half = len(keys) // 2
     save_file({k: tensors[k] for k in keys[:half]},
@@ -106,6 +109,36 @@ class TestHFLoader:
         shard_shape = params["layers"]["q_proj"]["kernel"] \
             .addressable_shards[0].data.shape
         assert shard_shape[-1] == cfg.q_size // 2   # split on model axis
+
+    def test_gemma2_checkpoint(self, tmp_path):
+        """Gemma-2's sandwich norms load by their HF names and the loaded
+        params serve a full prefill+decode (window/softcap path)."""
+        from xllm_service_tpu.models.gemma import gemma2_tiny_config
+
+        cfg = gemma2_tiny_config(dtype=jnp.float32)
+        hf = make_hf_checkpoint(tmp_path, cfg, lm_head=False)
+        params = load_hf_llama_safetensors(tmp_path, cfg)
+        assert params["layers"]["pre_ffw_norm"]["scale"].shape == \
+            (cfg.num_layers, cfg.hidden_size)
+        np.testing.assert_allclose(
+            np.asarray(params["layers"]["post_ffw_norm"]["scale"][2]),
+            hf["model.layers.2.post_feedforward_layernorm.weight"],
+            rtol=1e-6)
+        fam = get_model_family("gemma")
+        T = 12   # past the sliding window (8) so local layers mask
+        kv = jnp.zeros((cfg.num_layers, 2, 8, cfg.num_kv_heads, 16,
+                        cfg.head_dim), cfg.dtype)
+        pt = jnp.arange(4, dtype=jnp.int32)[None, :]
+        logits, kv = fam.prefill_forward(
+            params, cfg, jnp.ones((1, T), jnp.int32),
+            jnp.arange(T)[None, :], kv, pt, jnp.zeros((1,), jnp.int32),
+            jnp.asarray([T], jnp.int32))
+        assert bool(jnp.all(jnp.isfinite(logits)))
+        logits2, _ = fam.decode_forward(
+            params, cfg, jnp.asarray([5], jnp.int32),
+            jnp.asarray([T], jnp.int32), kv, pt,
+            jnp.asarray([T + 1], jnp.int32))
+        assert bool(jnp.all(jnp.isfinite(logits2)))
 
     def test_missing_layer_raises(self, tmp_path):
         from safetensors.numpy import save_file
