@@ -307,7 +307,8 @@ def decode_forward(params: Params, cfg: ModelConfig,
       (ops/pallas_fused_decode_attention.py) — no separate scatter op,
       the HBM append DMA overlaps the page walk."""
     from ..ops.attention import kv_writeback_mode
-    scatter = kv_writeback_mode() == "scatter"
+    wb = kv_writeback_mode()
+    scatter = wb == "scatter"
     page_size = kv_pages.shape[4]
     x = _embed(params, cfg, tokens)                            # [B, D]
 
@@ -332,7 +333,13 @@ def decode_forward(params: Params, cfg: ModelConfig,
                 page_table, context_lens, **_attn_opts(cfg, l))
         attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = _attn_mlp_residual(lp, x, attn, cfg)
-        if not scatter:
+        if wb == "slice":
+            # Two static index updates: no [2, P, n_kv, ps, hd] stack
+            # temp (l is a Python int — XLA sees static update-slices on
+            # the donated pool).
+            kv_pages = kv_pages.at[l, 0].set(k_pages)
+            kv_pages = kv_pages.at[l, 1].set(v_pages)
+        elif not scatter:
             kv_pages = jax.lax.dynamic_update_index_in_dim(
                 kv_pages, jnp.stack([k_pages, v_pages]), l, 0)
     return _unembed(params, cfg, x), kv_pages

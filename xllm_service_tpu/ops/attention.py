@@ -297,22 +297,24 @@ _warned_writeback_modes: set[str] = set()
 def kv_writeback_mode() -> str:
     """The single reader for the XLLM_KV_WRITEBACK decode A/B switch.
 
-    Valid values: "" (per-layer slice/stack/update), "scatter" (direct
-    write into the full stacked pool — handled at the model layer, which
-    owns the [L, 2, ...] array), "fused" (single Pallas append+attend
-    kernel, `decode_attention_step`). An unrecognized value falls back to
-    the default with a one-time warning instead of silently acting like
-    an unset flag."""
+    Valid values: "" (per-layer slice/stack/update), "slice" (two static
+    .at[l, 0/1].set updates — skips materializing the [2, P, n_kv, ps,
+    hd] stack temp), "scatter" (direct write into the full stacked pool —
+    handled at the model layer, which owns the [L, 2, ...] array),
+    "fused" (single Pallas append+attend kernel,
+    `decode_attention_step`). An unrecognized value falls back to the
+    default with a one-time warning instead of silently acting like an
+    unset flag."""
     import logging
     import os
 
     mode = os.environ.get("XLLM_KV_WRITEBACK", "")
-    if mode not in ("", "scatter", "fused"):
+    if mode not in ("", "slice", "scatter", "fused"):
         if mode not in _warned_writeback_modes:
             _warned_writeback_modes.add(mode)
             logging.getLogger(__name__).warning(
-                "XLLM_KV_WRITEBACK=%r is not one of '', 'scatter', "
-                "'fused'; using the default writeback", mode)
+                "XLLM_KV_WRITEBACK=%r is not one of '', 'slice', "
+                "'scatter', 'fused'; using the default writeback", mode)
         return ""
     return mode
 
