@@ -5,13 +5,23 @@ sampling) at full batch — on whatever accelerator is attached, and prints
 ONE JSON line:
 
     {"metric": "decode_tokens_per_sec_per_chip", "value": N,
-     "unit": "tok/s", "vs_baseline": R}
+     "unit": "tok/s", "vs_baseline": R, "pct_roofline": P, ...}
 
-Baseline: the reference publishes no numbers (BASELINE.md); its scheduler's
-default decode SLO is 50 ms TPOT (`global_gflags.cpp:128-132`), i.e.
-batch_size/0.05 tok/s/instance at the bench batch size. vs_baseline is
-measured throughput relative to that SLO-implied rate — >1.0 means every
-token beats the reference's default TPOT target at full batch.
+Baselines (the reference publishes no numbers — BASELINE.md):
+- ``vs_baseline``: measured throughput relative to the BEST PRIOR MEASURED
+  run at the same bench config (BEST_PRIOR below; round-2 driver sweep,
+  tpu_results/bench.json). >1.0 means this round got faster. Configs with
+  no prior measurement fall back to the reference scheduler's SLO-implied
+  rate (50 ms TPOT default, `global_gflags.cpp:128-132` → B/0.05 tok/s),
+  labeled via "baseline_kind".
+- ``pct_roofline``: decode at serving batch is HBM-bandwidth-bound, so the
+  honest ceiling is the weight+KV stream per token-step against the chip's
+  HBM bandwidth (v5e ≈ 819 GB/s). Reported as % of that ceiling, with
+  bytes-moved/step alongside, so "fast" is falsifiable (VERDICT r2 weak #3).
+
+Model selection: XLLM_BENCH_MODEL=1b (default) | 8b — 8b is Llama-3-8B
+shapes (BASELINE config 1) and forces weight-only int8 unless XLLM_QUANT
+is set explicitly (bf16 8B does not fit the 16 GB v5e with a KV pool).
 """
 
 from __future__ import annotations
@@ -23,12 +33,25 @@ import numpy as np
 
 METRIC = "decode_tokens_per_sec_per_chip"
 
+# Best prior MEASURED tok/s per (model, quant) bench config, on-chip.
+# Round-2 driver sweep: tpu_results/bench.json (1091.4), bench_int8.json
+# (1077.8). Update each round a config is re-measured faster.
+BEST_PRIOR = {
+    ("1b", ""): 1091.4,
+    ("1b", "int8"): 1077.8,
+}
 
-def _fail(err: str) -> None:
+HBM_GBPS = {"tpu": 819.0}   # v5e HBM bandwidth ceiling (public spec)
+
+
+def _fail(err: str, backend: str = "") -> None:
     """Emit the structured one-line JSON contract even on hard failure
     (dead TPU relay, backend init error) instead of dying rc!=0."""
-    print(json.dumps({"metric": METRIC, "value": 0.0, "unit": "tok/s",
-                      "vs_baseline": 0.0, "error": err[:500]}))
+    out = {"metric": METRIC, "value": 0.0, "unit": "tok/s",
+           "vs_baseline": 0.0, "error": err[:500]}
+    if backend:
+        out["backend"] = backend
+    print(json.dumps(out))
 
 
 def _accel_alive(timeout_s: float = 150.0) -> bool:
@@ -77,20 +100,28 @@ def main() -> None:
 
     from xllm_service_tpu.engine.config import EngineConfig
     from xllm_service_tpu.engine.engine import InferenceEngine
-    from xllm_service_tpu.models.base import bench_1b_config, tiny_config
+    from xllm_service_tpu.models.base import (bench_1b_config,
+                                              llama3_8b_config, tiny_config)
 
     on_accel = backend not in ("cpu",)
-    mcfg = bench_1b_config() if on_accel else tiny_config(dtype=jnp.float32)
-    if os.environ.get("XLLM_QUANT") == "int8":
+    model_key = os.environ.get("XLLM_BENCH_MODEL", "1b") if on_accel else "1b"
+    quant = os.environ.get("XLLM_QUANT", "int8" if model_key == "8b" else "")
+    if model_key == "8b":
+        mcfg = llama3_8b_config()
+    elif on_accel:
+        mcfg = bench_1b_config()
+    else:
+        mcfg = tiny_config(dtype=jnp.float32)
+    if quant:
         import dataclasses
 
-        mcfg = dataclasses.replace(mcfg, quant="int8")
+        mcfg = dataclasses.replace(mcfg, quant=quant)
 
     B = 16 if on_accel else 8
     ctx = 512 if on_accel else 64
     max_seq = 1024 if on_accel else 128
     cfg = EngineConfig(
-        model_id="bench-1b", model=mcfg,
+        model_id=f"bench-{model_key}", model=mcfg,
         num_pages=(B * max_seq) // 16 + 64, page_size=16,
         max_batch_size=B, max_seq_len=max_seq,
         prefill_buckets=(128, 512, max_seq) if on_accel else (64, 128),
@@ -124,7 +155,7 @@ def main() -> None:
             if not engine._waiting and engine._running:
                 break
             if time.perf_counter() > admit_deadline:
-                _fail("admission stalled")
+                _fail("admission stalled", backend)
                 return
 
         # Warmup decode steps (compile + cache).
@@ -139,18 +170,43 @@ def main() -> None:
         dt = time.perf_counter() - t0
         generated = counts["tokens"] - start
     except Exception as e:  # noqa: BLE001 — mid-run device/tunnel failure
-        _fail(f"bench run failed: {type(e).__name__}: {e}")
+        _fail(f"bench run failed: {type(e).__name__}: {e}", backend)
         return
 
     toks_per_s = generated / dt
-    baseline = B / 0.050   # reference default TPOT SLO: 50ms/token at batch B
+
+    # CPU fallback runs tiny_config — no prior-measured row applies there.
+    best_prior = (BEST_PRIOR.get((model_key, mcfg.quant))
+                  if on_accel else None)
+    if best_prior:
+        baseline, baseline_kind = best_prior, "best_prior_measured"
+    else:
+        # No prior on-chip measurement at this config: reference default
+        # TPOT SLO (50 ms/token at batch B).
+        baseline, baseline_kind = B / 0.050, "slo_implied"
+
+    # Roofline: HBM bytes per decode token-step = one full weight stream
+    # + per-sequence KV read at the mid-run context length.
+    mid_ctx = ctx + (generated // (2 * B)) if B else ctx
+    bytes_per_tok_step = (mcfg.decode_weight_stream_bytes()
+                          + B * mcfg.kv_bytes_per_token(mid_ctx))
+    tok_steps_per_s = toks_per_s / B   # B tokens per token-step
+    eff_gbps = bytes_per_tok_step * tok_steps_per_s / 1e9
+    hbm = HBM_GBPS.get(backend)
+
     result = {
         "metric": METRIC,
         "value": round(toks_per_s, 2),
         "unit": "tok/s",
         "vs_baseline": round(toks_per_s / baseline, 3),
+        "baseline_kind": baseline_kind,
         "backend": backend,
+        "model": model_key,
+        "bytes_per_step_mb": round(bytes_per_tok_step / 1e6, 1),
+        "effective_gbps": round(eff_gbps, 1),
     }
+    if hbm:
+        result["pct_roofline"] = round(100.0 * eff_gbps / hbm, 1)
     if tpu_note:
         result["note"] = tpu_note
     if mcfg.quant:

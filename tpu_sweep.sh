@@ -7,7 +7,7 @@
 set -u
 cd /root/repo
 mkdir -p tpu_results
-DEADLINE=$(( $(date +%s) + 14400 ))   # give up after 4h
+DEADLINE=$(( $(date +%s) + ${SWEEP_BUDGET_S:-14400} ))   # default: give up after 4h
 
 probe() {
   timeout 150 python - <<'EOF' >/dev/null 2>&1

@@ -95,6 +95,37 @@ class ModelConfig:
     def kv_size(self) -> int:
         return self.num_kv_heads * self.head_dim
 
+    # ---- roofline accounting (BENCH contract: pct_roofline) ---------------
+    def decode_weight_stream_bytes(self) -> int:
+        """Bytes of weights streamed from HBM per decode token-step.
+
+        Decode at serving batch sizes is weight-bandwidth-bound: every
+        step reads all layer projections + the lm_head once. The
+        embedding table is a gather (B rows, negligible) and is excluded.
+        Covers the dense llama/qwen2/gemma path and MoE (only the routed
+        experts' FFN weights stream per token).
+        """
+        h, L = self.hidden_size, self.num_layers
+        wb = 1 if self.quant == "int8" else 2          # int8 vs bf16
+        attn = h * self.q_size + 2 * h * self.kv_size + self.q_size * h
+        if self.num_experts:
+            n_moe = max(0, L - self.first_dense_layers)
+            n_dense = L - n_moe
+            active = self.num_experts_per_token + self.num_shared_experts
+            moe_mlp = 3 * h * (self.moe_ffn_size or self.ffn_size) * active
+            mlp_total = (n_dense * 3 * h * self.ffn_size + n_moe * moe_mlp)
+        else:
+            mlp_total = L * 3 * h * self.ffn_size
+        norms = L * 2 * h * 2 + h * 2                   # bf16 RMSNorm weights
+        head = 0 if self.tie_embeddings else self.vocab_size * h * wb
+        return (L * attn + mlp_total) * wb + norms + head
+
+    def kv_bytes_per_token(self, context_len: int) -> int:
+        """HBM bytes of KV cache READ per sequence per decode token-step
+        (K and V over the live context, every layer, bf16 pool)."""
+        per_layer = 2 * context_len * self.kv_size * 2
+        return self.num_layers * per_layer
+
 
 @dataclass(frozen=True)
 class VisionConfig:
