@@ -91,6 +91,31 @@ class TestChunkedMultimodal:
         assert spy["chunks"] >= 2, "prompt was not actually chunked"
         assert got == want
 
+    def test_warmup_covers_image_variant(self):
+        """VL warmup must pre-compile the image-carrying program variant
+        too (its mm operand is unit-padded, a different shape from the
+        no-image dummy), and a post-warmup image request must match a
+        cold engine's output (ADVICE r2: image variants stayed cold)."""
+        cold = make_vl_engine(0)
+        prompt, mm = make_prompt_and_mm(cold.cfg.model)
+        want = run_one(cold, prompt, mm)
+
+        import dataclasses
+        warm = InferenceEngine(dataclasses.replace(
+            make_vl_engine(0).cfg, warmup_programs=True))
+        unit = max(1, warm.cfg.model.vision.out_tokens * 4)
+        seen = set()
+        real = warm._prefill_install
+
+        def spy(params, dstate, packed, mm_arr):
+            seen.add(mm_arr.shape[1])
+            return real(params, dstate, packed, mm_arr)
+
+        warm._prefill_install = spy
+        warm._warmup_programs()
+        assert {1, unit} <= seen, f"warmup mm widths: {seen}"
+        assert run_one(warm, prompt, mm) == want
+
     def test_different_images_still_differ_when_chunked(self):
         engine = make_vl_engine(16)
         prompt, mm = make_prompt_and_mm(engine.cfg.model)

@@ -391,6 +391,23 @@ class TestKVPageManager:
         assert len(ev.removed) == 1
         assert mgr.cached_block_count() == 1
 
+    def test_tail_page_never_donated(self):
+        """The fused decode kernel's whole-page RMW append is safe only
+        because a partially-filled tail page stays PRIVATE to its
+        sequence (ops/pallas_fused_decode_attention.py). Donation must
+        stay full-hash-block granular: a prompt whose tail doesn't fill a
+        block leaves the tail page out of the donated set, and
+        page-misaligned block sizes are rejected at construction."""
+        mgr = KVPageManager(num_pages=17, page_size=16, hash_block_size=32)
+        toks = list(range(72))          # 2 full blocks + 8-token tail
+        pages = mgr.allocate(5)         # 4 full pages + 1 tail page
+        stored, donated = mgr.store_prefix(toks, pages)
+        assert len(stored) == 2
+        assert pages[4] not in donated          # the tail page is private
+        assert donated == set(pages[:4])
+        with pytest.raises(ValueError, match="whole number of pages"):
+            KVPageManager(num_pages=17, page_size=16, hash_block_size=40)
+
     def test_partial_match_after_divergence(self):
         mgr = KVPageManager(num_pages=17, page_size=16, hash_block_size=32)
         toks = list(range(64))

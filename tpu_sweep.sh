@@ -46,7 +46,15 @@ run_step() {
   # still alive that's a genuine failure, not a flake: restarting would
   # loop forever re-hitting the same error. Record it and move on.
   if ! grep -q '"backend": "tpu"' "tpu_results/$name.json"; then
-    if grep -q '"error"' "tpu_results/$name.json" && probe; then
+    # Error artifacts carry "backend" too (bench.py _fail): an error that
+    # happened ON the tpu backend is a genuine in-bench failure worth
+    # recording, but one claiming cpu (or claiming no backend at all)
+    # means the step silently initialized the CPU backend while the relay
+    # was down and failed BECAUSE of it — restart the sweep loop so it
+    # reruns on TPU instead of recording a phantom failure.
+    if grep -q '"error"' "tpu_results/$name.json" \
+        && ! grep -q '"backend": "cpu"' "tpu_results/$name.json" \
+        && grep -q '"backend"' "tpu_results/$name.json" && probe; then
       echo "step $name failed inside the bench (relay alive) — recorded"
       FAILED_STEPS="$FAILED_STEPS $name(bench-error)"
       return 0

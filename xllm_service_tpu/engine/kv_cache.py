@@ -38,6 +38,18 @@ class CachedBlock:
 
 class KVPageManager:
     def __init__(self, num_pages: int, page_size: int, hash_block_size: int):
+        # Donation granularity is FULL hash blocks of whole pages: a
+        # partially-filled (tail) page is never donated, so it stays
+        # private to its sequence. The fused decode kernel
+        # (ops/pallas_fused_decode_attention.py) relies on exactly this to
+        # make its whole-page read-modify-write append safe — if donation
+        # ever becomes page- or token-granular, that kernel would silently
+        # clobber shared KV. Fail loudly here instead.
+        if hash_block_size % page_size != 0:
+            raise ValueError(
+                "hash_block_size must be a whole number of pages: the "
+                "fused decode kernel's tail-page-privacy invariant "
+                "depends on full-page donation granularity")
         self.page_size = page_size
         self.hash_block_size = hash_block_size
         self.pages_per_block = hash_block_size // page_size

@@ -117,7 +117,9 @@ class ModelConfig:
         else:
             mlp_total = L * 3 * h * self.ffn_size
         norms = L * 2 * h * 2 + h * 2                   # bf16 RMSNorm weights
-        head = 0 if self.tie_embeddings else self.vocab_size * h * wb
+        # The logits matmul streams the full [vocab, h] matrix whether or
+        # not it aliases the embedding table (tied models stream it too).
+        head = self.vocab_size * h * wb
         return (L * attn + mlp_total) * wb + norms + head
 
     def kv_bytes_per_token(self, context_len: int) -> int:

@@ -68,6 +68,18 @@ def masked_kv_f32(k_buf, v_buf, slot, kv, start, bound):
     return k, jnp.where(vmask, v, 0.0)
 
 
+def masked_kv_f32_pos(k_buf, v_buf, slot, kv, pos_col, bound):
+    """`masked_kv_f32` for NON-contiguous chunk pages (the CP partial
+    kernel walks a compacted list of locally-owned pages, so row
+    positions come as an explicit column vector ``pos_col: [span, 1]``
+    instead of start+iota)."""
+    k = k_buf[slot, :, kv].astype(jnp.float32)
+    span = k.shape[0] * k.shape[1]
+    k = k.reshape(span, -1)
+    v = v_buf[slot, :, kv].astype(jnp.float32).reshape(span, -1)
+    return k, jnp.where(pos_col < bound, v, 0.0)
+
+
 def flash_accumulate(rows, s, v, m_scr, l_scr, acc_scr):
     """Online-softmax update of the (m, l, acc) scratch rows with masked
     scores ``s: [R, span]`` and values ``v: [span, hd]``. Fully-masked
