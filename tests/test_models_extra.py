@@ -77,7 +77,7 @@ class TestDeepSeekMoE:
         from xllm_service_tpu.models.deepseek_moe import _moe_mlp
 
         cfg, fam, params = self._setup()
-        lp = jax.tree.map(lambda a: a[0], params["layers"])
+        lp = jax.tree.map(lambda a: a[0], params["moe"])
         x = jax.random.normal(jax.random.PRNGKey(3), (5, cfg.hidden_size),
                               jnp.float32)
         logits = x @ lp["router"]["kernel"]

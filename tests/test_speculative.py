@@ -195,6 +195,28 @@ class TestSpeculativeDecoding:
             assert [t.token_id for t in s.top_logprobs] == \
                 [t.token_id for t in b.top_logprobs]
 
+    def test_chunked_prefill_history_feeds_drafts(self):
+        """A chunked long prompt must still feed the draft search: the
+        host repairs the device history row after install (chunk uploads
+        carry no slot), so prompt-lookup matches across the WHOLE prompt
+        — and greedy output stays identical to the unchunked engine."""
+        prompt = REPETITIVE * 3          # 120 tokens, chunks of 32
+        base = run_all(make_engine(4), [greedy_req("a", prompt, n=48)])
+        chunked = make_engine(4, prefill_chunk_tokens=32)
+        spy = {"cycles": 0, "emitted": 0}
+        real = chunked._spec_multi
+
+        def wrap(params, d, room, cycles):
+            spy["cycles"] += cycles
+            return real(params, d, room, cycles)
+
+        chunked._spec_multi = wrap
+        (col,) = run_all(chunked, [greedy_req("a", prompt, n=48)])
+        assert col.tokens == base[0].tokens
+        assert len(col.tokens) == 48
+        # Acceptance: strictly fewer verify cycles than emitted tokens.
+        assert 0 < spy["cycles"] < 48
+
     def test_budget_respected(self):
         """Spec can emit up to K+1 tokens per cycle; the budget cut must
         still be exact."""

@@ -1351,6 +1351,22 @@ class InferenceEngine:
                                  req.service_request_id)
             return True
 
+        if self._spec_multi is not None and prefix_written > cache_matched:
+            # Chunked prefills upload chunk tokens to a program that has
+            # no slot yet, so the in-program hist seeding only covered the
+            # final chunk — speculation would be blind to the rest of the
+            # prompt (its best hunting ground for long documents). One
+            # static-shape row overwrite repairs the whole history.
+            row = np.zeros((cfg.max_seq_len,), np.int32)
+            row[:len(prompt)] = prompt
+            row[len(prompt)] = first_token
+            self._dstate["hist"] = self._dstate["hist"].at[seq.slot].set(
+                jnp.asarray(row))
+            # The host knows the FULL prompt (including any cache-matched
+            # prefix), so the draft search window opens completely.
+            self._dstate["hist_lo"] = self._dstate["hist_lo"].at[
+                seq.slot].set(0)
+
         self._running[seq.slot] = seq
         self._emit_token(seq, first_token, lp)
         return True

@@ -131,12 +131,28 @@ class ModelConfig:
 
 @dataclass(frozen=True)
 class VisionConfig:
+    """Qwen2-VL-shaped vision tower (models/qwen2_vl.py, checkpoint
+    layout `visual.*` — HF Qwen2VisionTransformer): Conv3d-equivalent
+    patch embed with a temporal patch, 2D rotary position embedding over
+    the (h, w) patch grid, LayerNorm blocks with fused qkv, QuickGELU
+    MLP, and a spatial-merge PatchMerger projecting to the LM width.
+    `window_size`/`fullatt_block_indexes` add Qwen2.5-VL-style windowed
+    attention (local non-overlapping windows except the listed global
+    blocks); window_size=0 keeps every block global (Qwen2-VL)."""
+
+    # Defaults are mutually consistent with qwen2_vl.init_params'
+    # invariant: out_tokens == (image_size/patch_size/spatial_merge)².
     image_size: int = 224
     patch_size: int = 14
     hidden_size: int = 1024
     num_layers: int = 4
     num_heads: int = 16
     out_tokens: int = 64            # visual tokens emitted per image
+    temporal_patch_size: int = 2    # Qwen2-VL: 2 (image tiled over t)
+    spatial_merge_size: int = 2     # Qwen2-VL: 2 (2x2 patch merge)
+    rope_theta: float = 10000.0
+    window_size: int = 0            # patches per window side (2.5-VL: 8)
+    fullatt_block_indexes: tuple = ()
 
 
 @dataclass

@@ -14,10 +14,13 @@ compressed latent `[kv_lora_rank ‖ rope_dim]` per token, the per-head K
 up-projection is absorbed into the query, and the V up-projection is
 applied after attention — so the framework's paged-attention ops run
 unchanged over latents and the KV cache shrinks by the heads factor.
-GQA+RoPE remains available for non-MLA configs. First-k-dense-layers is
-approximated as all-MoE with a shared expert (`first_dense_layers=0`),
-which preserves the compute/communication shape EP benchmarking cares
-about.
+GQA+RoPE remains available for non-MLA configs. The first
+`first_dense_layers` layers run a plain dense MLP (DeepSeek-V2 layer 0 in
+real checkpoints, `modeling_deepseek.py` first_k_dense_replace); their
+weights live in a separate `dense_mlp` subtree stacked over those layers
+only, and the `moe` subtree stacks over the remaining layers — so real HF
+checkpoints map position-for-position (models/loader.py
+load_hf_deepseek_safetensors).
 """
 
 from __future__ import annotations
@@ -52,6 +55,8 @@ MOE_STACKED_RULES = ShardingRules(rules=[
      P(None, AXIS_EXPERT, AXIS_MODEL, None)),          # [L, E, F, D]
     (r"shared/(gate_proj|up_proj)/kernel", P(None, None, AXIS_MODEL)),
     (r"shared/down_proj/kernel", P(None, AXIS_MODEL, None)),
+    (r"dense_mlp/(gate_proj|up_proj)/kernel", P(None, None, AXIS_MODEL)),
+    (r"dense_mlp/down_proj/kernel", P(None, AXIS_MODEL, None)),
     (r"router/kernel", P()),
     (r"embed/embedding", P(AXIS_MODEL, None)),
     (r"(q_proj|k_proj|v_proj)/kernel", P(None, None, AXIS_MODEL)),
@@ -72,7 +77,7 @@ def deepseek_v2_lite_config() -> ModelConfig:
                        qk_rope_head_dim=64, v_head_dim=128,
                        num_experts=64, num_experts_per_token=6,
                        num_shared_experts=2, moe_ffn_size=1408,
-                       first_dense_layers=0)
+                       first_dense_layers=1)
 
 
 def tiny_moe_config(**kw) -> ModelConfig:
@@ -133,29 +138,42 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
             "o_proj": {"kernel": dense(keys[4], (L, Hq, D), Hq)},
         }
 
-    return {
+    Ld = cfg.first_dense_layers
+    Lm = L - Ld                      # MoE layers (stacked separately)
+    out = {
         "embed": {"embedding": dense(keys[0], (cfg.vocab_size, D), D)},
         "layers": {
             "input_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
             **attn,
             "post_attn_norm": {"scale": jnp.ones((L, D), cfg.dtype)},
-            "router": {"kernel": dense(keys[5], (L, D, E), D)
+        },
+        "moe": {
+            "router": {"kernel": dense(keys[5], (Lm, D, E), D)
                        .astype(jnp.float32)},
             "experts": {
-                "gate_proj": {"kernel": dense(keys[6], (L, E, D, Fe), D)},
-                "up_proj": {"kernel": dense(keys[7], (L, E, D, Fe), D)},
-                "down_proj": {"kernel": dense(keys[8], (L, E, Fe, D), Fe)},
+                "gate_proj": {"kernel": dense(keys[6], (Lm, E, D, Fe), D)},
+                "up_proj": {"kernel": dense(keys[7], (Lm, E, D, Fe), D)},
+                "down_proj": {"kernel": dense(keys[8], (Lm, E, Fe, D), Fe)},
             },
             **({"shared": {
-                "gate_proj": {"kernel": dense(keys[9], (L, D, Fs), D)},
-                "up_proj": {"kernel": dense(keys[10], (L, D, Fs), D)},
-                "down_proj": {"kernel": dense(keys[11], (L, Fs, D), Fs)},
+                "gate_proj": {"kernel": dense(keys[9], (Lm, D, Fs), D)},
+                "up_proj": {"kernel": dense(keys[10], (Lm, D, Fs), D)},
+                "down_proj": {"kernel": dense(keys[11], (Lm, Fs, D), Fs)},
             }} if cfg.num_shared_experts > 0 else {}),
         },
         "final_norm": {"scale": jnp.ones((D,), cfg.dtype)},
         "lm_head": {"kernel": dense(jax.random.fold_in(rng, 99),
                                     (D, cfg.vocab_size), D)},
     }
+    if Ld > 0:
+        F = cfg.ffn_size
+        k2 = jax.random.split(jax.random.fold_in(rng, 55), 3)
+        out["dense_mlp"] = {
+            "gate_proj": {"kernel": dense(k2[0], (Ld, D, F), D)},
+            "up_proj": {"kernel": dense(k2[1], (Ld, D, F), D)},
+            "down_proj": {"kernel": dense(k2[2], (Ld, F, D), F)},
+        }
+    return out
 
 
 def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
@@ -242,11 +260,19 @@ def _mla_attention(lp, cfg, h, mode, k_pages, v_pages, page_table,
     return out.reshape(*out.shape[:-2], H * dv), k_pages, v_pages
 
 
+def _dense_mlp(mp: Params, x: jax.Array) -> jax.Array:
+    g = jnp.einsum("...d,df->...f", x, mp["gate_proj"]["kernel"])
+    u = jnp.einsum("...d,df->...f", x, mp["up_proj"]["kernel"])
+    return jnp.einsum("...f,fd->...d", jax.nn.silu(g) * u,
+                      mp["down_proj"]["kernel"])
+
+
 def _run_layers(params, cfg, x, kv_pages, mode, page_table, prefix_lens,
                 seq_lens, positions, context_lens):
     """Unrolled layer loop with in-place KV writebacks (see
     models/llama.py for why not `lax.scan`)."""
     use_mla = cfg.kv_lora_rank > 0
+    Ld = cfg.first_dense_layers
     dense = kv_pages is None            # embeddings: no cache at all
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
@@ -274,7 +300,14 @@ def _run_layers(params, cfg, x, kv_pages, mode, page_table, prefix_lens,
             attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
         x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
-        x = x + _moe_mlp(lp, h2, cfg)
+        if l < Ld:
+            x = x + _dense_mlp(
+                jax.tree.map(lambda a, _l=l: a[_l], params["dense_mlp"]),
+                h2)
+        else:
+            x = x + _moe_mlp(
+                jax.tree.map(lambda a, _l=l - Ld: a[_l], params["moe"]),
+                h2, cfg)
         if not dense:
             kv_pages = jax.lax.dynamic_update_index_in_dim(
                 kv_pages, jnp.stack([k_pages, v_pages]), l, 0)

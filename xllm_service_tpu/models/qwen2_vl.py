@@ -23,7 +23,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import rms_norm
 from ..parallel.mesh import AXIS_MODEL
 from ..parallel.sharding import ShardingRules
 from .base import ModelConfig, ModelFamily, VisionConfig, register_model_family
@@ -46,7 +45,8 @@ def tiny_vl_config(**kw) -> ModelConfig:
         num_heads=4, num_kv_heads=2, head_dim=32, ffn_size=256,
         qkv_bias=True, max_context_len=512,
         vision=VisionConfig(image_size=28, patch_size=14, hidden_size=64,
-                            num_layers=2, num_heads=4, out_tokens=4))
+                            num_layers=2, num_heads=4, out_tokens=4,
+                            temporal_patch_size=1, spatial_merge_size=1))
     defaults.update(kw)
     return ModelConfig(**defaults)
 
@@ -55,79 +55,173 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
     params = _llama.init_params(cfg, rng)
     v = cfg.vision
     assert v is not None, "qwen2_vl requires a VisionConfig"
-    keys = jax.random.split(jax.random.fold_in(rng, 7), 8)
+    want = (v.image_size // v.patch_size // v.spatial_merge_size) ** 2
+    assert v.out_tokens == want, (
+        f"out_tokens={v.out_tokens} inconsistent with the patch grid / "
+        f"merge size (expected {want}) — the engine pads mm uploads in "
+        "out_tokens units")
+    keys = jax.random.split(jax.random.fold_in(rng, 7), 10)
     Dv, Lv = v.hidden_size, v.num_layers
-    patch_dim = 3 * v.patch_size * v.patch_size
+    patch_dim = 3 * v.temporal_patch_size * v.patch_size * v.patch_size
+    Dm = Dv * v.spatial_merge_size ** 2   # merger's merged width
 
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32)
                 * (fan_in ** -0.5)).astype(cfg.dtype)
 
-    n_patches = (v.image_size // v.patch_size) ** 2
     params["vision"] = {
-        "patch_embed": {"kernel": dense(keys[0], (patch_dim, Dv), patch_dim)},
-        "pos_embed": dense(keys[1], (n_patches, Dv), Dv),
+        # Conv3d(3, Dv, kernel=(tps, p, p)) == linear over the flattened
+        # (c, t, ph, pw) patch vector (loader reshapes the conv weight).
+        "patch_embed": {"kernel": dense(keys[0], (patch_dim, Dv),
+                                        patch_dim)},
         "layers": {
-            "norm1": {"scale": jnp.ones((Lv, Dv), cfg.dtype)},
-            "qkv": {"kernel": dense(keys[2], (Lv, Dv, 3 * Dv), Dv)},
-            "proj": {"kernel": dense(keys[3], (Lv, Dv, Dv), Dv)},
-            "norm2": {"scale": jnp.ones((Lv, Dv), cfg.dtype)},
-            "fc1": {"kernel": dense(keys[4], (Lv, Dv, 4 * Dv), Dv)},
-            "fc2": {"kernel": dense(keys[5], (Lv, 4 * Dv, Dv), 4 * Dv)},
+            "norm1": {"scale": jnp.ones((Lv, Dv), cfg.dtype),
+                      "bias": jnp.zeros((Lv, Dv), cfg.dtype)},
+            "qkv": {"kernel": dense(keys[2], (Lv, Dv, 3 * Dv), Dv),
+                    "bias": jnp.zeros((Lv, 3 * Dv), cfg.dtype)},
+            "proj": {"kernel": dense(keys[3], (Lv, Dv, Dv), Dv),
+                     "bias": jnp.zeros((Lv, Dv), cfg.dtype)},
+            "norm2": {"scale": jnp.ones((Lv, Dv), cfg.dtype),
+                      "bias": jnp.zeros((Lv, Dv), cfg.dtype)},
+            "fc1": {"kernel": dense(keys[4], (Lv, Dv, 4 * Dv), Dv),
+                    "bias": jnp.zeros((Lv, 4 * Dv), cfg.dtype)},
+            "fc2": {"kernel": dense(keys[5], (Lv, 4 * Dv, Dv), 4 * Dv),
+                    "bias": jnp.zeros((Lv, Dv), cfg.dtype)},
         },
-        "merger": {"kernel": dense(keys[6], (Dv, cfg.hidden_size), Dv)},
+        # PatchMerger: LayerNorm(Dv) -> [merge² · Dv] -> GELU MLP -> D_lm.
+        "merger": {
+            "ln_q": {"scale": jnp.ones((Dv,), cfg.dtype),
+                     "bias": jnp.zeros((Dv,), cfg.dtype)},
+            "fc1": {"kernel": dense(keys[6], (Dm, Dm), Dm),
+                    "bias": jnp.zeros((Dm,), cfg.dtype)},
+            "fc2": {"kernel": dense(keys[7], (Dm, cfg.hidden_size), Dm),
+                    "bias": jnp.zeros((cfg.hidden_size,), cfg.dtype)},
+        },
     }
     return params
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _vision_rope(grid: int, hd: int, theta: float) -> jax.Array:
+    """2D rotary angles for a grid×grid patch map: the first hd/4 freqs
+    rotate with the patch ROW, the next hd/4 with the COLUMN (HF
+    VisionRotaryEmbedding: per-axis freq tables concatenated, then the
+    pair duplicated to cover hd). Returns [T, hd] angles."""
+    dim = hd // 2
+    inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    pos = jnp.arange(grid, dtype=jnp.float32)
+    f = pos[:, None] * inv[None, :]                      # [grid, hd/4]
+    fh = jnp.repeat(f[:, None, :], grid, axis=1)         # rows
+    fw = jnp.repeat(f[None, :, :], grid, axis=0)         # cols
+    emb = jnp.concatenate([fh, fw], axis=-1).reshape(grid * grid, dim)
+    return jnp.concatenate([emb, emb], axis=-1)          # [T, hd]
+
+
+def _rotate_half(x):
+    h = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., h:], x[..., :h]], axis=-1)
+
+
+def _window_index(grid: int, win: int) -> jax.Array:
+    """Window id per patch for win×win non-overlapping windows (real
+    Qwen2.5-VL pads; inputs here are preprocessed to multiples)."""
+    assert grid % win == 0, (
+        f"window_size={win} must divide the {grid}-patch grid (window "
+        "ids would silently collide across windows otherwise)")
+    rows = jnp.arange(grid) // win
+    cols = jnp.arange(grid) // win
+    return (rows[:, None] * (grid // win) + cols[None, :]).reshape(-1)
 
 
 def encode_images(params: Params, cfg: ModelConfig,
                   pixels: jax.Array) -> jax.Array:
     """pixels: [N, H, W, 3] -> visual embeddings [N, out_tokens, D_lm].
 
-    The ENCODE stage: patchify → ViT (bidirectional) → average-pool groups
-    of patches down to `out_tokens` → project to the LM width.
-    """
+    The ENCODE stage, at Qwen2-VL checkpoint fidelity
+    (`Qwen2VisionTransformer`; reference ships only the proto surface,
+    `proto/CMakeLists.txt:18-37`): Conv3d-equivalent patch embed
+    (temporal tile for still images), blocks = LayerNorm → fused-qkv
+    attention with 2D rotary over the (row, col) patch grid → QuickGELU
+    MLP, then the spatial PatchMerger down to out_tokens per image.
+    `window_size > 0` masks attention to non-overlapping windows except
+    the blocks listed in fullatt_block_indexes (Qwen2.5-VL)."""
     v = cfg.vision
     N = pixels.shape[0]
     p = v.patch_size
     grid = v.image_size // p
-    # Patchify: [N, grid, p, grid, p, 3] -> [N, grid*grid, p*p*3].
+    # Patchify to (c, t, ph, pw)-ordered vectors matching the Conv3d
+    # weight flatten; still images tile over the temporal patch.
     x = pixels.reshape(N, grid, p, grid, p, 3)
-    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(N, grid * grid, p * p * 3)
+    x = x.transpose(0, 1, 3, 5, 2, 4).reshape(N, grid * grid, 3, 1, p, p)
+    x = jnp.broadcast_to(
+        x, (N, grid * grid, 3, v.temporal_patch_size, p, p)
+    ).reshape(N, grid * grid, -1)
     x = x.astype(cfg.dtype) @ params["vision"]["patch_embed"]["kernel"]
-    x = x + params["vision"]["pos_embed"][None, :, :]
 
     vp = params["vision"]["layers"]
     n_heads = v.num_heads
     hd = v.hidden_size // n_heads
+    rope = _vision_rope(grid, hd, v.rope_theta)          # [T, hd]
+    cos = jnp.cos(rope)[None, :, None, :]
+    sin = jnp.sin(rope)[None, :, None, :]
 
-    def layer(x, lp):
-        h = rms_norm(x, lp["norm1"]["scale"], 1e-6)
-        qkv = jnp.einsum("ntd,df->ntf", h, lp["qkv"]["kernel"])
+    win_mask = None
+    if v.window_size > 0:
+        wid = _window_index(grid, v.window_size)
+        win_mask = (wid[:, None] == wid[None, :])        # [T, T]
+
+    def layer(x, lp, local: bool):
+        h = _layer_norm(x, lp["norm1"]["scale"], lp["norm1"]["bias"])
+        qkv = jnp.einsum("ntd,df->ntf", h, lp["qkv"]["kernel"]) \
+            + lp["qkv"]["bias"]
         q, k, vv = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(*q.shape[:-1], n_heads, hd)
         k = k.reshape(*k.shape[:-1], n_heads, hd)
         vv = vv.reshape(*vv.shape[:-1], n_heads, hd)
+        q = q * cos + _rotate_half(q) * sin
+        k = k * cos + _rotate_half(k) * sin
         s = jnp.einsum("nqhd,nkhd->nhqk", q.astype(jnp.float32),
                        k.astype(jnp.float32)) / (hd ** 0.5)
+        if local and win_mask is not None:
+            s = jnp.where(win_mask[None, None], s, -1e30)
         a = jnp.einsum("nhqk,nkhd->nqhd", jax.nn.softmax(s, axis=-1),
                        vv.astype(jnp.float32)).astype(x.dtype)
         a = a.reshape(*a.shape[:-2], v.hidden_size)
-        x = x + jnp.einsum("ntd,df->ntf", a, lp["proj"]["kernel"])
-        h2 = rms_norm(x, lp["norm2"]["scale"], 1e-6)
-        m = jnp.einsum("ntd,df->ntf", h2, lp["fc1"]["kernel"])
-        x = x + jnp.einsum("ntf,fd->ntd", jax.nn.gelu(m),
-                           lp["fc2"]["kernel"])
-        return x, None
+        x = x + jnp.einsum("ntd,df->ntf", a, lp["proj"]["kernel"]) \
+            + lp["proj"]["bias"]
+        h2 = _layer_norm(x, lp["norm2"]["scale"], lp["norm2"]["bias"])
+        m = jnp.einsum("ntd,df->ntf", h2, lp["fc1"]["kernel"]) \
+            + lp["fc1"]["bias"]
+        m = m * jax.nn.sigmoid(1.702 * m)                # QuickGELU
+        x = x + jnp.einsum("ntf,fd->ntd", m, lp["fc2"]["kernel"]) \
+            + lp["fc2"]["bias"]
+        return x
 
-    x, _ = jax.lax.scan(layer, x, vp)
-    # Pool patches down to out_tokens visual tokens.
-    T = x.shape[1]
-    group = max(1, T // v.out_tokens)
-    pooled = x[:, :group * v.out_tokens].reshape(
-        N, v.out_tokens, group, v.hidden_size).mean(axis=2)
-    return jnp.einsum("ntd,df->ntf", pooled,
-                      params["vision"]["merger"]["kernel"])
+    for l in range(v.num_layers):
+        lp = jax.tree.map(lambda a, _l=l: a[_l], vp)
+        x = layer(x, lp, local=(v.window_size > 0
+                                and l not in v.fullatt_block_indexes))
+
+    # PatchMerger: ln_q per patch, group m×m spatial neighbors, 2-layer
+    # GELU MLP to the LM width.
+    mg = params["vision"]["merger"]
+    m_ = v.spatial_merge_size
+    x = _layer_norm(x, mg["ln_q"]["scale"], mg["ln_q"]["bias"])
+    g2 = grid // m_
+    x = x.reshape(N, g2, m_, g2, m_, v.hidden_size)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(N, g2 * g2, -1)
+    x = jnp.einsum("ntd,df->ntf", x, mg["fc1"]["kernel"]) + mg["fc1"]["bias"]
+    x = jax.nn.gelu(x)
+    return jnp.einsum("ntd,df->ntf", x, mg["fc2"]["kernel"]) \
+        + mg["fc2"]["bias"]
 
 
 def splice_mm_embeds(params: Params, cfg: ModelConfig, tokens: jax.Array,
