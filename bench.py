@@ -33,13 +33,111 @@ import numpy as np
 
 METRIC = "decode_tokens_per_sec_per_chip"
 
-# Best prior MEASURED tok/s per (model, quant) bench config, on-chip.
-# Round-2 driver sweep: tpu_results/bench.json (1091.4), bench_int8.json
-# (1077.8). Update each round a config is re-measured faster.
-BEST_PRIOR = {
+# Seed best-prior rows for artifacts that predate the self-maintained
+# history (round-2 driver sweep; those artifacts lacked "model"/"quant"
+# fields). Everything newer is discovered by _best_prior() scanning
+# BENCH_r*.json + tpu_results/ + tpu_results/history.jsonl, so this dict
+# never needs hand-maintenance again (VERDICT r3 weak #6).
+_SEED_PRIOR = {
     ("1b", ""): 1091.4,
     ("1b", "int8"): 1077.8,
 }
+
+HISTORY = "tpu_results/history.jsonl"
+
+
+def _candidate_records(obj):
+    """Pull bench-record dicts out of an artifact of any known shape:
+    a plain record, a driver wrapper ({"parsed": record, ...}), or a
+    history.jsonl line."""
+    if not isinstance(obj, dict):
+        return
+    if obj.get("metric") == METRIC:
+        yield obj
+    parsed = obj.get("parsed")
+    if isinstance(parsed, dict) and parsed.get("metric") == METRIC:
+        yield parsed
+
+
+def _iter_prior_records():
+    """Yield every prior on-chip bench record we can find on disk.
+
+    Covers BENCH_r*.json (driver wrapper objects, pretty-printed — parse
+    the whole file, read the nested "parsed" record), tpu_results/
+    bench*.json (one record per file), and tpu_results/history.jsonl
+    (one record per line, appended by _append_history)."""
+    import glob
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = (glob.glob(os.path.join(here, "BENCH_r*.json"))
+             + glob.glob(os.path.join(here, "tpu_results", "bench*.json"))
+             + [os.path.join(here, HISTORY)])
+    for p in paths:
+        try:
+            with open(p) as f:
+                text = f.read()
+        except OSError:
+            continue
+        try:
+            objs = [json.loads(text)]
+        except ValueError:
+            # jsonl (history) / partial artifact: scan per line.
+            objs = []
+            for ln in text.splitlines():
+                try:
+                    objs.append(json.loads(ln))
+                except ValueError:
+                    continue
+        for obj in objs:
+            for rec in _candidate_records(obj):
+                if (rec.get("backend") == "tpu"
+                        and not rec.get("error")
+                        and rec.get("value", 0) > 0):
+                    yield rec
+
+
+def _bench_variant() -> str:
+    """Non-default kernel/route knobs that change what bench.py measures.
+    Kept in the record (and matched by _best_prior) so A/B sweep arms
+    (fused/scatter writeback, pallas prefill) don't contaminate the
+    default config's best-prior baseline."""
+    import os
+    parts = []
+    wb = os.environ.get("XLLM_KV_WRITEBACK", "")
+    if wb:
+        parts.append(f"wb={wb}")
+    if os.environ.get("XLLM_PREFILL_PALLAS", ""):
+        parts.append("prefill_pallas")
+    if os.environ.get("XLLM_MQ_PALLAS", ""):
+        parts.append("mq_pallas")
+    return ",".join(parts)
+
+
+def _best_prior(model_key: str, quant: str, variant: str) -> float | None:
+    """Best prior MEASURED on-chip tok/s at this (model, quant, variant)
+    bench config, discovered from disk artifacts rather than a
+    hand-edited dict."""
+    best = _SEED_PRIOR.get((model_key, quant)) if not variant else None
+    for rec in _iter_prior_records():
+        if (rec.get("model", "1b") == model_key
+                and rec.get("quant", "") == quant
+                and rec.get("variant", "") == variant):
+            v = float(rec["value"])
+            if best is None or v > best:
+                best = v
+    return best
+
+
+def _append_history(result: dict) -> None:
+    """Record this run so future rounds' vs_baseline is self-maintaining."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        os.makedirs(os.path.join(here, "tpu_results"), exist_ok=True)
+        with open(os.path.join(here, HISTORY), "a") as f:
+            f.write(json.dumps(result) + "\n")
+    except OSError:
+        pass
 
 HBM_GBPS = {"tpu": 819.0}   # v5e HBM bandwidth ceiling (public spec)
 
@@ -176,7 +274,8 @@ def main() -> None:
     toks_per_s = generated / dt
 
     # CPU fallback runs tiny_config — no prior-measured row applies there.
-    best_prior = (BEST_PRIOR.get((model_key, mcfg.quant))
+    variant = _bench_variant()
+    best_prior = (_best_prior(model_key, mcfg.quant, variant)
                   if on_accel else None)
     if best_prior:
         baseline, baseline_kind = best_prior, "best_prior_measured"
@@ -211,6 +310,10 @@ def main() -> None:
         result["note"] = tpu_note
     if mcfg.quant:
         result["quant"] = mcfg.quant
+    if variant:
+        result["variant"] = variant
+    if on_accel:
+        _append_history(result)
     print(json.dumps(result))
 
 

@@ -1,13 +1,15 @@
 #!/bin/bash
-# TPU measurement sweep: retries until the flaky axon relay answers, then
-# runs the whole round-2 TPU queue (NOTES_ROUND2.md "TPU to-do").
-# Results land in tpu_results/. Each step re-checks the relay so a
-# mid-sweep flake restarts the loop instead of silently recording
-# CPU-fallback numbers.
+# TPU measurement sweep (round 4): retries until the flaky axon relay
+# answers, then runs the round-4 conversion queue (VERDICT.md r3 "Next
+# round" #1): 1b regression, first 8B-scale number, Pallas kernel
+# Mosaic-validation + A/Bs, speculative decoding, PD KV-handoff timing,
+# full-stack serve. Results land in tpu_results/. Each step re-checks the
+# relay so a mid-sweep flake restarts the loop instead of silently
+# recording CPU-fallback numbers.
 set -u
 cd /root/repo
 mkdir -p tpu_results
-DEADLINE=$(( $(date +%s) + ${SWEEP_BUDGET_S:-14400} ))   # default: give up after 4h
+DEADLINE=$(( $(date +%s) + ${SWEEP_BUDGET_S:-40000} ))   # default: ~11h
 
 probe() {
   timeout 150 python - <<'EOF' >/dev/null 2>&1
@@ -46,12 +48,6 @@ run_step() {
   # still alive that's a genuine failure, not a flake: restarting would
   # loop forever re-hitting the same error. Record it and move on.
   if ! grep -q '"backend": "tpu"' "tpu_results/$name.json"; then
-    # Error artifacts carry "backend" too (bench.py _fail): an error that
-    # happened ON the tpu backend is a genuine in-bench failure worth
-    # recording, but one claiming cpu (or claiming no backend at all)
-    # means the step silently initialized the CPU backend while the relay
-    # was down and failed BECAUSE of it — restart the sweep loop so it
-    # reruns on TPU instead of recording a phantom failure.
     if grep -q '"error"' "tpu_results/$name.json" \
         && ! grep -q '"backend": "cpu"' "tpu_results/$name.json" \
         && grep -q '"backend"' "tpu_results/$name.json" && probe; then
@@ -61,6 +57,13 @@ run_step() {
     fi
     echo "step $name did not run on TPU — restarting sweep loop"
     return 1
+  fi
+  # rc=0 AND backend=tpu, but the artifact still carries an "error" field:
+  # the bench caught an in-run failure (e.g. cp_bench records a Mosaic
+  # compile error and exits 0). Count it so 'sweep complete' can't mask it.
+  if grep -q '"error"' "tpu_results/$name.json"; then
+    echo "step $name recorded an in-bench error on TPU"
+    FAILED_STEPS="$FAILED_STEPS $name(bench-error)"
   fi
   if ! probe; then
     echo "relay died after step $name — restarting sweep loop"
@@ -73,27 +76,42 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
     echo "=== relay alive at $(date) ==="
     FAILED_STEPS=""
-    # 1. bench.py (the driver contract number)
+    # 1. bench.py 1b (the driver contract number; regression check vs 1091)
     run_step bench 900 python bench.py || { sleep 60; continue; }
-    # 2. fused append+attend decode kernel (Mosaic validation + A/B vs 1.)
-    run_step bench_fused 900 env XLLM_KV_WRITEBACK=fused python bench.py \
+    # 2. FIRST north-star-scale number: Llama-3-8B shapes, weight-only int8
+    run_step bench_8b 1800 env XLLM_BENCH_MODEL=8b python bench.py \
       || { sleep 60; continue; }
-    # 3. scatter-writeback A/B
-    run_step bench_scatter 900 env XLLM_KV_WRITEBACK=scatter python bench.py \
-      || { sleep 60; continue; }
-    # 3b. weight-only int8 (the HBM-bound decode lever)
+    # 3. 1b int8 A/B
     run_step bench_int8 900 env XLLM_QUANT=int8 python bench.py \
       || { sleep 60; continue; }
-    # 4. speculative decoding
-    run_step spec 1200 python benchmarks/spec_bench.py || { sleep 60; continue; }
-    # 5. KV writeback micro (times both XLA variants internally)
-    run_step kvwb 900 python benchmarks/kv_writeback_micro.py \
+    # 4. fused append+attend decode kernel (Mosaic validation + A/B vs 1.)
+    run_step bench_fused 900 env XLLM_KV_WRITEBACK=fused python bench.py \
       || { sleep 60; continue; }
-    # 6. MQ pallas verify kernel under Mosaic (validates + measures)
+    # 5. scatter-writeback A/B
+    run_step bench_scatter 900 env XLLM_KV_WRITEBACK=scatter python bench.py \
+      || { sleep 60; continue; }
+    # 6. Pallas prefill route under real Mosaic (admission exercises it)
+    run_step bench_prefill_pallas 900 \
+      env XLLM_PREFILL_PALLAS=1 python bench.py || { sleep 60; continue; }
+    # 7. speculative decoding (target >=1.3x on repetitive workload)
+    run_step spec 1200 python benchmarks/spec_bench.py || { sleep 60; continue; }
+    # 8. MQ pallas verify kernel under Mosaic (validates + measures)
     run_step spec_mq 1200 env XLLM_MQ_PALLAS=1 python benchmarks/spec_bench.py \
       || { sleep 60; continue; }
-    # 7. serve bench (full stack TTFT)
-    run_step serve 1200 python benchmarks/serve_bench.py \
+    # 9. KV writeback micro (times both XLA variants internally)
+    run_step kvwb 900 python benchmarks/kv_writeback_micro.py \
+      || { sleep 60; continue; }
+    # 10. CP paged-decode kernel vs XLA gather path under real Mosaic
+    run_step cp_kernel 1200 python benchmarks/cp_bench.py \
+      || { sleep 60; continue; }
+    # 11. PD KV handoff: device path vs host msgpack path at 2k/8k ctx
+    run_step pd_handoff 1200 python benchmarks/pd_handoff_bench.py \
+      || { sleep 60; continue; }
+    # 12. serve bench (full stack TTFT; measures the 24x-gap fixes)
+    run_step serve 1800 python benchmarks/serve_bench.py \
+      || { sleep 60; continue; }
+    # 13. serve bench, second boot (persistent-compile-cache warmup check)
+    run_step serve_warm 1800 python benchmarks/serve_bench.py \
       || { sleep 60; continue; }
     if [ -n "$FAILED_STEPS" ]; then
       echo "=== sweep finished at $(date) with FAILED steps:$FAILED_STEPS ==="
