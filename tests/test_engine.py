@@ -420,6 +420,35 @@ class TestKVPageManager:
         mgr.release_prefix(stored)
 
 
+class TestBurstAdmission:
+    def test_same_burst_identical_prompts_share_prefix_cache(self):
+        """Admission dispatches a burst of installs before completing any
+        (async pipeline) — but two identical prompts in ONE burst must
+        still dedupe through the prefix cache (the n>1 choice fan-out
+        relies on it), which requires completing the first before
+        matching the second."""
+        engine = make_engine()
+        prompt = list(range(10, 10 + 64))      # 2 hash blocks of 32
+        cols = [Collector(), Collector()]
+        for i, col in enumerate(cols):
+            engine.submit(EngineRequest(
+                f"burst-{i}", token_ids=list(prompt),
+                sampling=SamplingParams(max_tokens=4, temperature=0.0,
+                                        ignore_eos=True), on_output=col))
+        free_before = engine.page_mgr.num_free
+        engine.start()                          # both pop in one admit pass
+        for col in cols:
+            assert col.done.wait(30)
+        engine.stop()
+        # Same greedy continuation for both.
+        assert cols[0].tokens == cols[1].tokens
+        # The second sequence matched the first's donated prompt blocks:
+        # together they consumed fewer pages than two unshared prefills
+        # (prompt is 4 pages; +1 page of decode growth each).
+        used = free_before - engine.page_mgr.num_free
+        assert used <= 4 + 2 * 1 + 1, used
+
+
 class TestEngineResilience:
     def test_step_failure_fails_inflight_requests(self):
         """A step-level failure (e.g. kernel compile error on real hardware)
@@ -462,7 +491,7 @@ class TestEngineResilience:
         def explode(*a, **k):
             raise RuntimeError("prefill compile failure")
 
-        engine._run_prefill_install = explode
+        engine._dispatch_prefill_install = explode
         col = Collector()
         engine.submit(EngineRequest(
             "pboom", token_ids=list(range(16)),

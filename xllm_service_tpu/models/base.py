@@ -107,7 +107,19 @@ class ModelConfig:
         """
         h, L = self.hidden_size, self.num_layers
         wb = 1 if self.quant == "int8" else 2          # int8 vs bf16
-        attn = h * self.q_size + 2 * h * self.kv_size + self.q_size * h
+        if self.kv_lora_rank > 0:
+            # MLA (deepseek family): the streamed attention weights are
+            # q_proj + kv_down + k_rope + per-head k_up/v_up + o_proj,
+            # not the dense GQA projections.
+            dn, dr = self.qk_nope_head_dim, self.qk_rope_head_dim
+            dc, dv = self.kv_lora_rank, self.v_head_dim
+            attn = (h * self.num_heads * (dn + dr)      # q_proj
+                    + h * dc + h * dr                   # kv_down, k_rope
+                    + self.num_heads * dn * dc          # k_up
+                    + self.num_heads * dc * dv          # v_up
+                    + self.num_heads * dv * h)          # o_proj
+        else:
+            attn = h * self.q_size + 2 * h * self.kv_size + self.q_size * h
         if self.num_experts:
             n_moe = max(0, L - self.first_dense_layers)
             n_dense = L - n_moe

@@ -219,7 +219,8 @@ def encode_images(params: Params, cfg: ModelConfig,
     x = x.reshape(N, g2, m_, g2, m_, v.hidden_size)
     x = x.transpose(0, 1, 3, 2, 4, 5).reshape(N, g2 * g2, -1)
     x = jnp.einsum("ntd,df->ntf", x, mg["fc1"]["kernel"]) + mg["fc1"]["bias"]
-    x = jax.nn.gelu(x)
+    # HF's PatchMerger uses nn.GELU (exact erf), not the tanh approximation.
+    x = jax.nn.gelu(x, approximate=False)
     return jnp.einsum("ntd,df->ntf", x, mg["fc2"]["kernel"]) \
         + mg["fc2"]["bias"]
 

@@ -65,6 +65,36 @@ def get_logger(name: str) -> logging.Logger:
     return logger
 
 
+def enable_persistent_compile_cache(path: str = "") -> str | None:
+    """Point XLA's persistent compile cache at a disk directory so a
+    restarted process re-warms from cached executables instead of
+    recompiling (round-2 TPU serve boot paid a 136 s warmup — all XLA
+    compiles of the same programs every boot; the cache pattern is
+    proven by tests/conftest.py, which cut the suite 34% with it).
+
+    Resolution: XLLM_COMPILE_CACHE env > `path` arg > ~/.cache default.
+    "0" disables. Returns the directory used, or None when disabled.
+    Safe to call more than once (process-global jax.config update).
+    """
+    import os
+
+    path = os.environ.get("XLLM_COMPILE_CACHE", "") or path or os.path.join(
+        os.path.expanduser("~"), ".cache", "xllm_tpu_compile")
+    if path == "0":
+        return None
+    import jax
+
+    # Respect a cache the host process already configured (e.g. the test
+    # harness points one at the repo) — don't silently redirect it.
+    current = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if current:
+        return current
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return path
+
+
 def pin_cpu_platform_if_requested() -> None:
     """Honor JAX_PLATFORMS=cpu even under a TPU-attach sitecustomize hook.
 
