@@ -186,6 +186,7 @@ def run_multiproc(args, model_config: str, on_accel: bool) -> dict:
                         "--host", "127.0.0.1", "--port", str(agent_port),
                         "--model-id", "bench",
                         "--model-config", agent_model,
+                        "--generation-flush-ms", "2.0",
                         "--max-batch-size", "16", *eng_args])
 
         base = f"http://127.0.0.1:{http_port}"

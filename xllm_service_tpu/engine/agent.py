@@ -1255,6 +1255,8 @@ def main() -> None:
                    help="weight-only quantization (models/quant.py)")
     p.add_argument("--decode-horizon", type=int, default=0,
                    help="tokens per decode program call (0 = config default)")
+    p.add_argument("--generation-flush-ms", type=float, default=5.0,
+                   help="batching window for Generations delta pushes")
     p.add_argument("--speculate-k", type=int, default=0,
                    help="prompt-lookup speculation draft length (0 = off)")
     args = p.parse_args()
@@ -1378,6 +1380,7 @@ def main() -> None:
                           instance_type=InstanceType.parse(args.type),
                           model_id=args.model_id,
                           tokenizer_path=args.tokenizer_path,
+                          generation_flush_ms=args.generation_flush_ms,
                           dp_size=args.dp_size),
         params=params)
     agent.start()

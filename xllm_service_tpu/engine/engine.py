@@ -258,6 +258,10 @@ class InferenceEngine:
             # the earlier tokens were never uploaded to this engine.
             "hist": jnp.zeros((B, cfg.max_seq_len), jnp.int32),
             "hist_lo": jnp.zeros((B,), jnp.int32),
+            # M-RoPE decode offset per slot (qwen2_vl: image grids leave
+            # rope position ids ahead of/behind the sequence index by a
+            # constant once the prompt ends; 0 for text-only / non-VL).
+            "mrope_delta": jnp.zeros((B,), jnp.int32),
         }
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
 
@@ -309,6 +313,7 @@ class InferenceEngine:
         # tokens; without speculation those writes are skipped.
         spec_on = cfg.speculate_k > 0 and fam.verify_forward is not None
         LH = cfg.max_seq_len
+        is_vl = cfg.model_family == "qwen2_vl"
 
         def sampling_state(d):
             return SamplingState(d["temp"], d["topk"], d["topp"], d["fp"],
@@ -326,9 +331,18 @@ class InferenceEngine:
             def step(d, _):
                 positions = d["clens"] - 1
                 with cp_ctx:
-                    logits, kv = fam.decode_forward(
-                        params, mcfg, d["last"], positions, d["kv"],
-                        d["pt"], d["clens"])
+                    if is_vl:
+                        # M-RoPE: rope rotates at sequence index + the
+                        # per-slot delta left by image grids; KV paging
+                        # stays on the plain sequence index.
+                        logits, kv = fam.decode_forward(
+                            params, mcfg, d["last"], positions, d["kv"],
+                            d["pt"], d["clens"],
+                            rope_positions=positions + d["mrope_delta"])
+                    else:
+                        logits, kv = fam.decode_forward(
+                            params, mcfg, d["last"], positions, d["kv"],
+                            d["pt"], d["clens"])
                 d = dict(d, kv=kv)
                 toks, logprobs = sample_tokens(
                     logits, sampling_state(d), d["keys"], d["clens"],
@@ -384,8 +398,6 @@ class InferenceEngine:
 
         self._decode_multi = decode_multi
 
-        is_vl = cfg.model_family == "qwen2_vl"
-
         V = mcfg.vocab_size
 
         def make_prefill_install(use_ring: bool):
@@ -413,22 +425,36 @@ class InferenceEngine:
                 NS, NB = NUM_STOP_IDS, NUM_BIAS
                 n_ints = P + 4 + NS + NB
                 n_floats = 6 + NB
-                S = packed_in.shape[0] - n_ints - n_floats - V - 2
+                tail = n_ints + n_floats + V + 2
+                if is_vl:
+                    # VL layout adds [pos3(3S) | mrope_delta(1)] after the
+                    # tokens: M-RoPE position ids are host-computed (they
+                    # depend on image grid shapes the device can't see).
+                    S = (packed_in.shape[0] - tail - 1) // 4
+                    pos3 = packed_in[S:4 * S].reshape(S, 3)
+                    mdelta = packed_in[4 * S]
+                    base = 4 * S + 1
+                else:
+                    S = packed_in.shape[0] - tail
+                    base = S
                 tokens = packed_in[:S][None, :]
-                ints = packed_in[S:S + n_ints]
+                ints = packed_in[base:base + n_ints]
                 floats = jax.lax.bitcast_convert_type(
-                    packed_in[S + n_ints:S + n_ints + n_floats],
+                    packed_in[base + n_ints:base + n_ints + n_floats],
                     jnp.float32)
-                counts_row = packed_in[S + n_ints + n_floats:
-                                       S + n_ints + n_floats + V]
+                counts_row = packed_in[base + n_ints + n_floats:
+                                       base + n_ints + n_floats + V]
                 key = jax.lax.bitcast_convert_type(packed_in[-2:],
                                                    jnp.uint32)
                 page_row = ints[:P]
                 slot = ints[P]
                 prefix_len = ints[P + 1]
                 seq_len = ints[P + 2]
-                positions = prefix_len + jnp.arange(
-                    tokens.shape[1], dtype=jnp.int32)[None, :]
+                if is_vl:
+                    positions = pos3[None, :, :]           # [1, S, 3]
+                else:
+                    positions = prefix_len + jnp.arange(
+                        tokens.shape[1], dtype=jnp.int32)[None, :]
                 sp_ctx = (sequence_parallel_prefill(self.mesh, AXIS_SEQ)
                           if use_ring else contextlib.nullcontext())
                 with sp_ctx:
@@ -476,6 +502,8 @@ class InferenceEngine:
                     floats[6:6 + NB])
                 d["counts"] = d["counts"].at[slot].set(
                     counts_row.at[toks[0]].add(1))
+                if is_vl:
+                    d["mrope_delta"] = d["mrope_delta"].at[slot].set(mdelta)
                 if spec_on:
                     # Seed the device history with the uploaded suffix +
                     # the first sampled token; tokens before prefix_len
@@ -671,6 +699,7 @@ class InferenceEngine:
             d["pt"] = d["pt"].at[slot].set(GARBAGE_PAGE)
             d["active"] = d["active"].at[slot].set(False)
             d["clens"] = d["clens"].at[slot].set(0)
+            d["mrope_delta"] = d["mrope_delta"].at[slot].set(0)
             return d
 
         self._clear_slot = clear_slot
@@ -688,9 +717,10 @@ class InferenceEngine:
             scatter the transferred prompt KV into local pages + install the
             batch slot with the prefill-produced first token.
 
-            ints: [P + 4 + NUM_STOP_IDS + NUM_BIAS] = [page_row(P), slot,
-                  prompt_len, first_token, want_logprobs,
-                  stop_ids(NUM_STOP_IDS), bias_ids(NUM_BIAS)];
+            ints: [P + 4 + NUM_STOP_IDS + NUM_BIAS + 1] = [page_row(P),
+                  slot, prompt_len, first_token, want_logprobs,
+                  stop_ids(NUM_STOP_IDS), bias_ids(NUM_BIAS),
+                  mrope_delta];
             floats: [6 + NUM_BIAS] (controls + bias_vals).
             """
             page_row = ints[:P]
@@ -720,6 +750,8 @@ class InferenceEngine:
                      P + 4 + NUM_STOP_IDS + NUM_BIAS])
             d["bias_vals"] = d["bias_vals"].at[slot].set(floats[6:])
             d["counts"] = d["counts"].at[slot].set(counts_row)
+            d["mrope_delta"] = d["mrope_delta"].at[slot].set(
+                ints[P + 4 + NUM_STOP_IDS + NUM_BIAS])
             if spec_on:
                 # Only the prefill-produced first token is on this
                 # engine; the prompt stayed with the prefill instance, so
@@ -731,23 +763,26 @@ class InferenceEngine:
         self._inject_install = inject_install
 
         @partial(jax.jit, donate_argnums=(1,))
-        def prefill_chunk(params, d, tokens, ints, mm):
+        def prefill_chunk(params, d, tokens, ints, mm, pos3):
             """One non-final chunk of a chunked prefill: writes the
             chunk's KV (attending to the already-written prefix) and
             discards logits. ints: [P + 2] = [page_row(P), prefix_len,
             seq_len]. mm: this chunk's visual-embedding slice (VL; dummy
-            otherwise) — placeholders in the chunk consume it in order."""
+            otherwise) — placeholders in the chunk consume it in order.
+            pos3: [S, 3] host-computed M-RoPE position ids for the chunk
+            (VL family; unused dummy otherwise)."""
             page_row = ints[:P]
             prefix_len = ints[P]
             seq_len = ints[P + 1]
-            positions = prefix_len + jnp.arange(
-                tokens.shape[1], dtype=jnp.int32)[None, :]
             if is_vl:
+                positions = pos3[None, :, :]
                 _, kv = fam.prefill_forward(
                     params, mcfg, tokens, positions, d["kv"],
                     page_row[None, :], prefix_len[None], seq_len[None],
                     mm_embeds=mm)
             else:
+                positions = prefix_len + jnp.arange(
+                    tokens.shape[1], dtype=jnp.int32)[None, :]
                 _, kv = fam.prefill_forward(
                     params, mcfg, tokens, positions, d["kv"],
                     page_row[None, :], prefix_len[None], seq_len[None])
@@ -805,8 +840,12 @@ class InferenceEngine:
             np.asarray([1.0, 0.0, 1.0, 0.0, 0.0, 1.0], np.float32),
             np.zeros((NB,), np.float32)])
         for S in self.cfg.prefill_buckets:
+            head = [np.zeros((S,), np.int32)]
+            if self.cfg.model_family == "qwen2_vl":
+                # VL layout: [pos3(3S) | mrope_delta(1)] after the tokens.
+                head.append(np.zeros((3 * S + 1,), np.int32))
             packed_in = jnp.asarray(np.concatenate([
-                np.zeros((S,), np.int32), ints, floats.view(np.int32),
+                *head, ints, floats.view(np.int32),
                 np.zeros((mcfg.vocab_size,), np.int32),
                 np.zeros((2,), np.int32)]))
             progs = [self._prefill_install]
@@ -985,6 +1024,7 @@ class InferenceEngine:
         self._dstate["stop_ids"] = jnp.full((B, NUM_STOP_IDS), -1, jnp.int32)
         self._dstate["bias_ids"] = jnp.full((B, NUM_BIAS), -1, jnp.int32)
         self._dstate["bias_vals"] = jnp.zeros((B, NUM_BIAS), jnp.float32)
+        self._dstate["mrope_delta"] = jnp.zeros((B,), jnp.int32)
         for req in victims:
             try:
                 req.on_output(RequestOutput(
@@ -1154,7 +1194,18 @@ class InferenceEngine:
                 head = req.token_ids[:hb]
                 if batch and len(head) == hb and any(
                         e[2][:hb] == head for e in batch):
-                    _complete_batch()
+                    try:
+                        _complete_batch()
+                    except Exception:
+                        # Batch entries got their failure callbacks, but
+                        # THIS request (already popped, not yet started)
+                        # and the deferred ones would silently vanish —
+                        # requeue them for the post-_fail_all retry/error
+                        # path before propagating.
+                        with self._lock:
+                            self._waiting.appendleft(req)
+                        _requeue_deferred()
+                        raise
                 if not self._start_sequence(req, batch=batch):
                     # Not enough KV pages. An online request may preempt a
                     # running offline sequence to make room.
@@ -1330,10 +1381,15 @@ class InferenceEngine:
         ints[P + 1] = C
         mm_arr = self._mm_chunk_array(req, prompt, st["written"],
                                       st["written"] + C)
+        if self.cfg.model_family == "qwen2_vl":
+            pos3, _ = self._mrope_chunk(prompt, st["written"],
+                                        st["written"] + C, C)
+        else:
+            pos3 = np.zeros((C, 3), np.int32)
         try:
             self._dstate = self._prefill_chunk(
                 self.params, self._dstate, jnp.asarray(chunk),
-                jnp.asarray(ints), mm_arr)
+                jnp.asarray(ints), mm_arr, jnp.asarray(pos3))
         except Exception as e:  # noqa: BLE001
             self._fail_admission(seq, req, e)
             raise
@@ -1500,7 +1556,7 @@ class InferenceEngine:
         P = cfg.pages_per_seq
         sp = req.sampling
         NS, NB = NUM_STOP_IDS, NUM_BIAS
-        ints = np.full((P + 4 + NS + NB,), GARBAGE_PAGE, np.int32)
+        ints = np.full((P + 4 + NS + NB + 1,), GARBAGE_PAGE, np.int32)
         ints[:len(own_pages)] = own_pages
         ints[P] = seq.slot
         ints[P + 1] = P0
@@ -1509,6 +1565,14 @@ class InferenceEngine:
         ints[P + 4:P + 4 + NS] = self._device_stop_ids(sp)
         bias_ids, bias_vals = self._device_bias(sp)
         ints[P + 4 + NS:P + 4 + NS + NB] = bias_ids
+        # M-RoPE decode offset (qwen2_vl EPD decode side: the image grids
+        # live in the prompt token ids, so the delta is recomputable here).
+        if cfg.model_family == "qwen2_vl":
+            from ..models.qwen2_vl import mrope_positions
+            ints[P + 4 + NS + NB] = mrope_positions(
+                prompt, cfg.model.image_token_id)[1]
+        else:
+            ints[P + 4 + NS + NB] = 0
         floats = np.concatenate([
             np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
                         sp.frequency_penalty, sp.presence_penalty,
@@ -1547,6 +1611,19 @@ class InferenceEngine:
     def _count_placeholders(self, tokens: list[int]) -> int:
         tid = self.cfg.model.image_token_id
         return sum(1 for t in tokens if t == tid)
+
+    def _mrope_chunk(self, prompt: list[int], start: int, end: int,
+                     S: int) -> tuple[np.ndarray, int]:
+        """M-RoPE position rows for prompt[start:end], zero-padded to S
+        rows (padding is masked by seq_len), plus the decode delta
+        (models/qwen2_vl.py mrope_positions)."""
+        from ..models.qwen2_vl import mrope_positions
+
+        pos, delta = mrope_positions(prompt,
+                                     self.cfg.model.image_token_id)
+        out = np.zeros((S, 3), np.int32)
+        out[:end - start] = pos[start:end]
+        return out, delta
 
     def _mm_chunk_array(self, req: EngineRequest, prompt: list[int],
                         start: int, end: int) -> jnp.ndarray:
@@ -1656,8 +1733,13 @@ class InferenceEngine:
         # rows as there are placeholder tokens in the suffix.
         mm_arr = self._mm_chunk_array(seq.req, prompt, matched, len(prompt))
         # ONE packed upload per admission (see prefill_install's docstring).
+        head = [toks[0]]
+        if self.cfg.model_family == "qwen2_vl":
+            pos3, delta = self._mrope_chunk(prompt, matched,
+                                            matched + len(suffix), S)
+            head += [pos3.reshape(-1), np.asarray([delta], np.int32)]
         packed_in = np.concatenate([
-            toks[0], ints, floats.view(np.int32), counts_row,
+            *head, ints, floats.view(np.int32), counts_row,
             np.asarray(slot_key).view(np.int32).reshape(-1)[:2]])
         prog = (self._prefill_install_sp
                 if self._sp_applicable(len(suffix), matched, seq.req)

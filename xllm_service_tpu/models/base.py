@@ -55,6 +55,10 @@ class ModelConfig:
     # Multimodal (qwen2_vl family).
     vision: Optional["VisionConfig"] = None
     image_token_id: int = 151655   # <|image_pad|> placeholder id
+    # M-RoPE (qwen2_vl LM stack): per-axis (temporal, h, w) half-dim
+    # rope sections, summing to head_dim // 2 (HF
+    # `rope_scaling.mrope_section`). Empty = standard 1D rope.
+    mrope_section: tuple = ()
     # Weight-only quantization ("" = off, "int8" = per-output-channel
     # int8 projections, models/quant.py). llama/qwen2 families.
     quant: str = ""

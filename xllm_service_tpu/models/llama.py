@@ -107,8 +107,8 @@ def _project_qkv(lp: Params, x: jax.Array, cfg: ModelConfig,
     q = q.reshape(*q.shape[:-1], cfg.num_heads, cfg.head_dim)
     k = k.reshape(*k.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
     v = v.reshape(*v.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.mrope_section)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.mrope_section)
     return q, k, v
 
 
@@ -292,6 +292,7 @@ def decode_forward(params: Params, cfg: ModelConfig,
                    kv_pages: jax.Array,       # [L, 2, P, n_kv, ps, hd]
                    page_table: jax.Array,     # [B, max_pages]
                    context_lens: jax.Array,   # [B] lens INCLUDING new token
+                   rope_positions: jax.Array | None = None,
                    ) -> tuple[jax.Array, jax.Array]:
     """One decode step. Returns (logits [B, V], updated kv_pages).
 
@@ -311,11 +312,16 @@ def decode_forward(params: Params, cfg: ModelConfig,
     scatter = wb == "scatter"
     page_size = kv_pages.shape[4]
     x = _embed(params, cfg, tokens)                            # [B, D]
+    # M-RoPE (qwen2_vl): rope rotates by the multimodal position id
+    # (sequence index + per-slot delta after image grids), while KV
+    # writes/paging stay on the plain sequence index.
+    if rope_positions is None:
+        rope_positions = positions
 
     for l in range(cfg.num_layers):
         lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
         h = _norm(x, lp["input_norm"]["scale"], cfg)
-        q, k, v = _project_qkv(lp, h, cfg, positions)             # [B, H, hd]
+        q, k, v = _project_qkv(lp, h, cfg, rope_positions)        # [B, H, hd]
         if scatter:
             page_idx = jnp.take_along_axis(
                 page_table, (positions // page_size)[:, None], axis=1)[:, 0]

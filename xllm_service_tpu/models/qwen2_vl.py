@@ -21,6 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import AXIS_MODEL
@@ -44,9 +45,30 @@ def tiny_vl_config(**kw) -> ModelConfig:
         name="qwen2_vl", vocab_size=512, hidden_size=128, num_layers=2,
         num_heads=4, num_kv_heads=2, head_dim=32, ffn_size=256,
         qkv_bias=True, max_context_len=512,
+        mrope_section=(4, 6, 6),       # t/h/w half-dims (sum = hd/2)
         vision=VisionConfig(image_size=28, patch_size=14, hidden_size=64,
                             num_layers=2, num_heads=4, out_tokens=4,
                             temporal_patch_size=1, spatial_merge_size=1))
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def qwen2_vl_2b_config(**kw) -> ModelConfig:
+    """Qwen2-VL-2B-Instruct shapes (HF config.json: 1536 hidden / 28
+    layers / 12 heads / 2 kv / 8960 ffn, rope_theta 1e6,
+    rope_scaling.mrope_section [16, 24, 24]; visual tower 1280×32,
+    patch 14, 2×2 spatial merge, temporal patch 2). 224px inputs give
+    (224/14/2)² = 64 visual tokens per image."""
+    defaults = dict(
+        name="qwen2_vl", vocab_size=151936, hidden_size=1536,
+        num_layers=28, num_heads=12, num_kv_heads=2, head_dim=128,
+        ffn_size=8960, qkv_bias=True, rope_theta=1_000_000.0,
+        tie_embeddings=True, max_context_len=32768,
+        mrope_section=(16, 24, 24),
+        vision=VisionConfig(image_size=224, patch_size=14,
+                            hidden_size=1280, num_layers=32, num_heads=16,
+                            out_tokens=64, temporal_patch_size=2,
+                            spatial_merge_size=2))
     defaults.update(kw)
     return ModelConfig(**defaults)
 
@@ -243,6 +265,49 @@ def splice_mm_embeds(params: Params, cfg: ModelConfig, tokens: jax.Array,
     gathered = jnp.take_along_axis(
         mm_embeds.astype(cfg.dtype), order[..., None], axis=1)
     return jnp.where(is_img[..., None], gathered, x)
+
+
+def mrope_positions(tokens, image_token_id: int):
+    """Host-side M-RoPE position ids for a prompt (HF
+    `Qwen2VLForConditionalGeneration.get_rope_index` semantics for
+    single-frame images; reference parity target for BASELINE config 5).
+
+    Text runs advance all three axes (t/h/w) together from the running
+    offset. An image-placeholder run of n tokens is a (sqrt(n), sqrt(n))
+    merged grid: t stays at the offset, h/w sweep the grid rows/cols; the
+    offset then advances by the grid side (max position + 1). Returns
+    (pos [S, 3] int32, delta) where delta = next_position - len(tokens)
+    is the constant the decode path adds to the sequence index.
+    """
+    import math
+
+    toks = np.asarray(tokens)
+    S = len(toks)
+    pos = np.zeros((S, 3), np.int32)
+    st = 0
+    i = 0
+    is_img = toks == image_token_id
+    while i < S:
+        j = i
+        if is_img[i]:
+            while j < S and is_img[j]:
+                j += 1
+            n = j - i
+            g = max(1, int(round(math.sqrt(n))))   # grid width (square)
+            h = np.arange(n, dtype=np.int32) // g  # row-major sweep;
+            w = np.arange(n, dtype=np.int32) % g   # robust to ragged runs
+            pos[i:j, 0] = st
+            pos[i:j, 1] = st + h
+            pos[i:j, 2] = st + w
+            st += int(max(h[-1], w.max())) + 1
+        else:
+            while j < S and not is_img[j]:
+                j += 1
+            n = j - i
+            pos[i:j, :] = (st + np.arange(n, dtype=np.int32))[:, None]
+            st += n
+        i = j
+    return pos, int(st - S)
 
 
 def prefill_forward(params, cfg, tokens, positions, kv_pages, page_table,

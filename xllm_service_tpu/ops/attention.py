@@ -96,11 +96,33 @@ def rope_cos_sin(positions: jax.Array, head_dim: int,
     return jnp.cos(angles), jnp.sin(angles)
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """x: [..., n_heads, head_dim]; positions broadcastable to x.shape[:-2]."""
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
+               mrope_section: tuple[int, ...] = ()) -> jax.Array:
+    """x: [..., n_heads, head_dim]; positions broadcastable to x.shape[:-2].
+
+    With `mrope_section` (Qwen2-VL M-RoPE; half-dim units summing to
+    head_dim/2, reference `rope_scaling.mrope_section`), positions may
+    instead carry a trailing multimodal axis [..., 3] = (temporal, h, w):
+    each half-dim frequency then rotates by ITS section's position
+    stream. 1D positions (all axes equal — any text-only sequence, and
+    every decode step) take the standard path, which is numerically
+    identical for them.
+    """
     hd = x.shape[-1]
-    cos, sin = rope_cos_sin(positions, hd, theta)      # [..., hd/2]
-    cos = cos[..., None, :]                            # broadcast over heads
+    if (mrope_section and positions.ndim == x.ndim - 1
+            and positions.shape[-1] == len(mrope_section)):
+        cos3, sin3 = rope_cos_sin(positions, hd, theta)  # [..., 3, hd/2]
+        lo = 0
+        cos_parts, sin_parts = [], []
+        for k, n in enumerate(mrope_section):
+            cos_parts.append(cos3[..., k, lo:lo + n])
+            sin_parts.append(sin3[..., k, lo:lo + n])
+            lo += n
+        cos = jnp.concatenate(cos_parts, axis=-1)        # [..., hd/2]
+        sin = jnp.concatenate(sin_parts, axis=-1)
+    else:
+        cos, sin = rope_cos_sin(positions, hd, theta)    # [..., hd/2]
+    cos = cos[..., None, :]                              # broadcast over heads
     sin = sin[..., None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
