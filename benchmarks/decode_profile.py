@@ -1,0 +1,149 @@
+"""Decode-step component profile: names where the decode token-step time
+goes on the attached accelerator.
+
+Round-2 context: bench.py measured 1091 tok/s at bench-1b/B=16 — ~20% of
+the HBM roofline — and int8 (halving the weight stream) changed nothing,
+so the step is NOT weight-bandwidth-bound. This bench times the step's
+components in isolation at the same shapes so the sweep can attribute
+the other 80%:
+
+  - full_step: fam.decode_forward + sample (what bench.py times)
+  - forward_only: fam.decode_forward alone
+  - attention_only: the paged-attention op over the same pool
+  - matmuls_only: the layer matmuls with attention stubbed out
+  - sampling_only: sample_tokens on random logits
+
+Prints ONE JSON line. CPU runs validate mechanism only.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from xllm_service_tpu.utils import pin_cpu_platform_if_requested
+
+pin_cpu_platform_if_requested()
+
+
+def bench_fn(fn, *args, iters=30):
+    out = fn(*args)
+    jax_block(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax_block(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def jax_block(x):
+    import jax
+    jax.block_until_ready(x)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from xllm_service_tpu.engine.sampling import SamplingState, sample_tokens
+    from xllm_service_tpu.models import get_model_family
+    from xllm_service_tpu.models.base import bench_1b_config, tiny_config
+    from xllm_service_tpu.ops.attention import paged_attention
+
+    backend = jax.default_backend()
+    on_accel = backend != "cpu"
+    mcfg = bench_1b_config() if on_accel else tiny_config(
+        dtype=jnp.float32)
+    fam = get_model_family(mcfg.name)
+
+    B = 16 if on_accel else 4
+    ctx = 512 if on_accel else 64
+    ps = 16
+    pages_per_seq = -(-1024 // ps) if on_accel else -(-128 // ps)
+    num_pages = B * pages_per_seq + 64
+
+    rng = np.random.default_rng(0)
+    key = jax.random.PRNGKey(0)
+    params = fam.init_params(mcfg, key)
+
+    kv = jnp.zeros((mcfg.num_layers, 2, num_pages, mcfg.num_kv_heads, ps,
+                    mcfg.head_dim), mcfg.dtype)
+    pt = np.full((B, pages_per_seq), num_pages - 1, np.int32)
+    for b in range(B):
+        pt[b] = rng.permutation(np.arange(num_pages - 64))[:pages_per_seq]
+    page_table = jnp.asarray(pt)
+    clens = jnp.full((B,), ctx, jnp.int32)
+    tokens = jnp.asarray(rng.integers(10, mcfg.vocab_size - 10, B),
+                         jnp.int32)
+    positions = clens - 1
+
+    result = {"backend": backend, "B": B, "ctx": ctx,
+              "model": "1b" if on_accel else "tiny",
+              "metric": "decode_step_component_ms", "unit": "ms"}
+
+    # 1. forward_only (returns logits + new kv; donation off for timing).
+    fwd = jax.jit(lambda p, t, pos, k, tab, cl: fam.decode_forward(
+        p, mcfg, t, pos, k, tab, cl)[0])
+    result["forward_only_ms"] = round(bench_fn(
+        fwd, params, tokens, positions, kv, page_table, clens), 3)
+
+    # 2. full step: forward + greedy sample.
+    def full(p, t, pos, k, tab, cl, keys):
+        logits, _ = fam.decode_forward(p, mcfg, t, pos, k, tab, cl)
+        st = SamplingState(
+            jnp.zeros((B,)), jnp.zeros((B,), jnp.int32),
+            jnp.ones((B,)), jnp.zeros((B,)), jnp.zeros((B,)),
+            jnp.ones((B,)), jnp.zeros((B, mcfg.vocab_size), jnp.int32),
+            jnp.full((B, 8), -1, jnp.int32), jnp.zeros((B, 8)))
+        toks, _ = sample_tokens(logits.astype(jnp.float32), st, keys, cl)
+        return toks
+
+    keys = jax.random.split(key, B)
+    result["full_step_ms"] = round(bench_fn(
+        jax.jit(full), params, tokens, positions, kv, page_table, clens,
+        keys), 3)
+
+    # 3. attention_only over one layer's pool, scaled by n_layers.
+    q = jax.random.normal(key, (B, mcfg.num_heads, mcfg.head_dim),
+                          mcfg.dtype)
+    attn = jax.jit(lambda qq, kk, vv, tab, cl: paged_attention(
+        qq, kk, vv, tab, cl))
+    per_layer = bench_fn(attn, q, kv[0, 0], kv[0, 1], page_table, clens)
+    result["attention_only_ms"] = round(per_layer * mcfg.num_layers, 3)
+    result["attention_per_layer_ms"] = round(per_layer, 4)
+
+    # 4. sampling_only on random logits.
+    logits = jax.random.normal(key, (B, mcfg.vocab_size), jnp.float32)
+
+    def samp(lg, keys, cl):
+        st = SamplingState(
+            jnp.zeros((B,)), jnp.zeros((B,), jnp.int32),
+            jnp.ones((B,)), jnp.zeros((B,)), jnp.zeros((B,)),
+            jnp.ones((B,)), jnp.zeros((B, mcfg.vocab_size), jnp.int32),
+            jnp.full((B, 8), -1, jnp.int32), jnp.zeros((B, 8)))
+        return sample_tokens(lg, st, keys, cl)[0]
+
+    result["sampling_only_ms"] = round(bench_fn(
+        jax.jit(samp), logits, keys, clens), 3)
+
+    # Derived attribution.
+    result["matmul_and_rest_ms"] = round(
+        result["forward_only_ms"] - result["attention_only_ms"], 3)
+    result["sample_overhead_ms"] = round(
+        result["full_step_ms"] - result["forward_only_ms"], 3)
+    result["value"] = result["full_step_ms"]
+    # Roofline context: ideal weight-stream time at this config.
+    wbytes = mcfg.decode_weight_stream_bytes()
+    result["weight_stream_mb"] = round(wbytes / 1e6, 1)
+    if on_accel:
+        result["ideal_weight_stream_ms"] = round(wbytes / 819e9 * 1e3, 3)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -214,17 +214,23 @@ class TestEngineDecodeDelta:
         assert len(outs) == n_new
 
         # Ground truth: re-prefill prompt+generated each step with fresh
-        # 3D position ids (no paged state, no delta shortcut).
+        # 3D position ids (no paged state, no delta shortcut). Padded to
+        # ONE fixed bucket so all steps share a single compiled program.
         params = engine.params
         seq = list(prompt)
+        S_max = len(prompt) + n_new
         for step in range(n_new):
             pos3, _ = mrope_positions(seq, IMG)
             S = len(seq)
+            pos_pad = np.zeros((S_max, 3), np.int32)
+            pos_pad[:S] = pos3
+            padded = seq + [0] * (S_max - S)
             kv = jnp.zeros((cfg.num_layers, 2, 16, cfg.num_kv_heads, 16,
                             cfg.head_dim), jnp.float32)
             pt = jnp.asarray([list(range(8))], jnp.int32)
             logits, _ = prefill_forward(
-                params, cfg, jnp.asarray([seq]), jnp.asarray(pos3)[None],
+                params, cfg, jnp.asarray([padded]),
+                jnp.asarray(pos_pad)[None],
                 kv, pt, jnp.asarray([0]), jnp.asarray([S]),
                 mm_embeds=jnp.asarray(mm)[None])
             nxt = int(np.argmax(np.asarray(logits[0])))

@@ -101,6 +101,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # 9. KV writeback micro (times both XLA variants internally)
     run_step kvwb 900 python benchmarks/kv_writeback_micro.py \
       || { sleep 60; continue; }
+    # 9b. decode-step component profile (names the 80%-off-roofline cost)
+    run_step decode_profile 900 python benchmarks/decode_profile.py \
+      || { sleep 60; continue; }
     # 10. CP paged-decode kernel vs XLA gather path under real Mosaic
     run_step cp_kernel 1200 python benchmarks/cp_bench.py \
       || { sleep 60; continue; }
