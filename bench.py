@@ -110,6 +110,11 @@ def _bench_variant() -> str:
         parts.append("prefill_pallas")
     if os.environ.get("XLLM_MQ_PALLAS", ""):
         parts.append("mq_pallas")
+    pc = os.environ.get("XLLM_PAGE_CHUNK", "")
+    if pc:
+        parts.append(f"chunk={pc}")
+    if os.environ.get("XLLM_PAGE_PIPELINE", "") == "row":
+        parts.append("rowpipe")
     return ",".join(parts)
 
 
@@ -218,6 +223,18 @@ def main() -> None:
     B = 16 if on_accel else 8
     ctx = 512 if on_accel else 64
     max_seq = 1024 if on_accel else 128
+    ctx_variant = ""
+    if on_accel and os.environ.get("XLLM_BENCH_CTX", ""):
+        # Long-context decode variant: the page walk dominates here, so
+        # this is where the paged-kernel/DMA knobs actually show.
+        # Batch shrinks to keep the KV pool inside one chip's HBM.
+        ctx = min(int(os.environ["XLLM_BENCH_CTX"]),
+                  mcfg.max_context_len - 512)
+        B = 16 if ctx <= 512 else (8 if ctx <= 1024 else 4)
+        max_seq = ctx + 512
+        # Label with the EFFECTIVE ctx (the request may have been
+        # clamped) so baseline rows key to shapes actually measured.
+        ctx_variant = f"ctx={ctx}"
     cfg = EngineConfig(
         model_id=f"bench-{model_key}", model=mcfg,
         num_pages=(B * max_seq) // 16 + 64, page_size=16,
@@ -274,7 +291,7 @@ def main() -> None:
     toks_per_s = generated / dt
 
     # CPU fallback runs tiny_config — no prior-measured row applies there.
-    variant = _bench_variant()
+    variant = ",".join(p for p in (_bench_variant(), ctx_variant) if p)
     best_prior = (_best_prior(model_key, mcfg.quant, variant)
                   if on_accel else None)
     if best_prior:

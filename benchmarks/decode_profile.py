@@ -9,9 +9,12 @@ the other 80%:
 
   - full_step: fam.decode_forward + sample (what bench.py times)
   - forward_only: fam.decode_forward alone
-  - attention_only: the paged-attention op over the same pool
-  - matmuls_only: the layer matmuls with attention stubbed out
+  - attention_only: the paged-attention op over the same pool (isolated,
+    scaled by n_layers)
   - sampling_only: sample_tokens on random logits
+  - matmul_and_rest_ms (derived): forward_only - attention_only — the
+    layer matmuls PLUS norms/rope/KV-writeback/dispatch gaps
+  - sample_overhead_ms (derived): full_step - forward_only
 
 Prints ONE JSON line. CPU runs validate mechanism only.
 """
@@ -92,15 +95,19 @@ def main() -> None:
     result["forward_only_ms"] = round(bench_fn(
         fwd, params, tokens, positions, kv, page_table, clens), 3)
 
+    def greedy_state():
+        import dataclasses
+
+        # Greedy = temperature 0 (the common serving case bench.py runs).
+        return dataclasses.replace(
+            SamplingState.init(B, mcfg.vocab_size),
+            temperature=jnp.zeros((B,), jnp.float32))
+
     # 2. full step: forward + greedy sample.
     def full(p, t, pos, k, tab, cl, keys):
         logits, _ = fam.decode_forward(p, mcfg, t, pos, k, tab, cl)
-        st = SamplingState(
-            jnp.zeros((B,)), jnp.zeros((B,), jnp.int32),
-            jnp.ones((B,)), jnp.zeros((B,)), jnp.zeros((B,)),
-            jnp.ones((B,)), jnp.zeros((B, mcfg.vocab_size), jnp.int32),
-            jnp.full((B, 8), -1, jnp.int32), jnp.zeros((B, 8)))
-        toks, _ = sample_tokens(logits.astype(jnp.float32), st, keys, cl)
+        toks, _ = sample_tokens(logits.astype(jnp.float32),
+                                greedy_state(), keys, cl)
         return toks
 
     keys = jax.random.split(key, B)
@@ -121,12 +128,7 @@ def main() -> None:
     logits = jax.random.normal(key, (B, mcfg.vocab_size), jnp.float32)
 
     def samp(lg, keys, cl):
-        st = SamplingState(
-            jnp.zeros((B,)), jnp.zeros((B,), jnp.int32),
-            jnp.ones((B,)), jnp.zeros((B,)), jnp.zeros((B,)),
-            jnp.ones((B,)), jnp.zeros((B, mcfg.vocab_size), jnp.int32),
-            jnp.full((B, 8), -1, jnp.int32), jnp.zeros((B, 8)))
-        return sample_tokens(lg, st, keys, cl)[0]
+        return sample_tokens(lg, greedy_state(), keys, cl)[0]
 
     result["sampling_only_ms"] = round(bench_fn(
         jax.jit(samp), logits, keys, clens), 3)

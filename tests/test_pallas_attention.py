@@ -38,6 +38,31 @@ class TestPallasPagedAttention:
                                            np.asarray(ref[b]),
                                            rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("context_lens", [
+        [96, 96, 96, 96],          # full pages, even chunk counts
+        [1, 17, 33, 90],           # ragged: odd chunk counts -> pad chunk
+        [5, 96, 0, 50],            # empty row mid-batch: pipeline forward
+        [0, 0, 0, 7],              # leading empty rows
+        [64, 0, 0, 0],             # trailing empty rows
+    ])
+    def test_cross_row_pipeline_matches_xla(self, context_lens,
+                                            monkeypatch):
+        """XLLM_PAGE_PIPELINE=row: rows prefetch each other's first chunk
+        (see _kernel) — numerics must be identical across empty rows, odd
+        chunk counts, and row boundaries."""
+        monkeypatch.setenv("XLLM_PAGE_PIPELINE", "row")
+        monkeypatch.setenv("XLLM_PAGE_CHUNK", "1")   # maximize row turns
+        q, k_pages, v_pages, pt = _setup()
+        cl = jnp.asarray(context_lens, jnp.int32)
+        ref = paged_attention_xla(q, k_pages, v_pages, pt, cl)
+        got = paged_attention_pallas(q, k_pages, v_pages, pt, cl,
+                                     interpret=True)
+        for b, c in enumerate(context_lens):
+            if c > 0:
+                np.testing.assert_allclose(np.asarray(got[b]),
+                                           np.asarray(ref[b]),
+                                           rtol=2e-5, atol=2e-5)
+
     def test_gqa_grouping(self):
         q, k_pages, v_pages, pt = _setup(n_q=16, n_kv=2)
         cl = jnp.asarray([40, 96, 8, 64], jnp.int32)

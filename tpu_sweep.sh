@@ -84,6 +84,23 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # 3. 1b int8 A/B
     run_step bench_int8 900 env XLLM_QUANT=int8 python bench.py \
       || { sleep 60; continue; }
+    # 3b/3c. page-walk DMA chunk size A/B (decode is DMA-latency-bound at
+    # serving shapes; bigger chunks = fewer, larger DMAs)
+    run_step bench_chunk16 900 env XLLM_PAGE_CHUNK=16 python bench.py \
+      || { sleep 60; continue; }
+    run_step bench_chunk32 900 env XLLM_PAGE_CHUNK=32 python bench.py \
+      || { sleep 60; continue; }
+    # 3d. long-context decode (the page walk dominates; chunk16 together)
+    run_step bench_ctx2k 900 \
+      env XLLM_BENCH_CTX=2048 XLLM_PAGE_CHUNK=16 python bench.py \
+      || { sleep 60; continue; }
+    # 3e. cross-row DMA pipelining in the decode kernel
+    run_step bench_rowpipe 900 env XLLM_PAGE_PIPELINE=row python bench.py \
+      || { sleep 60; continue; }
+    # 3f. rowpipe + chunk16 combined
+    run_step bench_rowpipe16 900 \
+      env XLLM_PAGE_PIPELINE=row XLLM_PAGE_CHUNK=16 python bench.py \
+      || { sleep 60; continue; }
     # 4. fused append+attend decode kernel (Mosaic validation + A/B vs 1.)
     run_step bench_fused 900 env XLLM_KV_WRITEBACK=fused python bench.py \
       || { sleep 60; continue; }

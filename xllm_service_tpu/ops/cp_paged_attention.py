@@ -44,6 +44,7 @@ from .pallas_page_dma import (
     flash_accumulate,
     make_chunk_dma,
     masked_kv_f32_pos,
+    page_chunk_size,
 )
 
 _NEG_INF = NEG_INF
@@ -180,19 +181,29 @@ def _partial_kernel(local_pt_ref, starts_ref, n_local_ref, clens_ref,
     acc_out[0] = acc_scr[...]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("scale", "interpret"))
 def _paged_partial_pallas(q, k_pages, v_pages, local_pt, starts, n_local,
                           context_lens, scale: float,
                           interpret: bool = False):
     """Per-shard raw flash stats: returns (m [B, n_q, 128],
-    l [B, n_q, 128], acc [B, n_q, hd]) — only column 0 of m/l is live."""
+    l [B, n_q, 128], acc [B, n_q, hd]) — only column 0 of m/l is live.
+
+    XLLM_PAGE_CHUNK is resolved here, OUTSIDE jit, and passed static — a
+    shape-keyed cache would silently pin the first-traced chunk."""
+    return _paged_partial_impl(q, k_pages, v_pages, local_pt, starts,
+                               n_local, context_lens, scale=scale,
+                               chunk=page_chunk_size(local_pt.shape[1]),
+                               interpret=interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "chunk", "interpret"))
+def _paged_partial_impl(q, k_pages, v_pages, local_pt, starts, n_local,
+                        context_lens, *, scale: float, chunk: int,
+                        interpret: bool = False):
     B, n_q, hd = q.shape
     _, n_kv, page_size, _ = k_pages.shape
     max_pages = local_pt.shape[1]
     group = n_q // n_kv
-
-    chunk = min(8, max_pages)
     kernel = functools.partial(_partial_kernel, page_size=page_size,
                                n_kv=n_kv, group=group, scale=scale,
                                max_pages=max_pages, chunk=chunk)

@@ -57,6 +57,7 @@ from .pallas_page_dma import (
     flash_accumulate,
     make_chunk_dma,
     masked_kv_f32,
+    page_chunk_size,
 )
 
 
@@ -160,7 +161,6 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
     pltpu.make_async_copy(v_pg, v_out.at[wpage], wsems.at[1, 1]).wait()
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def fused_decode_attention_pallas(
         q: jax.Array,                    # [B, n_q, hd]
         k_new: jax.Array,                # [B, n_kv, hd]
@@ -172,14 +172,24 @@ def fused_decode_attention_pallas(
         interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (attn_out [B, n_q, hd], k_pages, v_pages) with the new
-    token's K/V appended in place (pools are donated via aliasing)."""
+    token's K/V appended in place (pools are donated via aliasing).
+
+    XLLM_PAGE_CHUNK is resolved here, OUTSIDE jit, and passed static — a
+    shape-keyed cache would silently pin the first-traced chunk."""
+    return _fused_impl(q, k_new, v_new, k_pages, v_pages, page_table,
+                       context_lens,
+                       chunk=page_chunk_size(page_table.shape[1]),
+                       interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
+def _fused_impl(q, k_new, v_new, k_pages, v_pages, page_table,
+                context_lens, *, chunk: int, interpret: bool = False):
     B, n_q, hd = q.shape
     _, n_kv, page_size, _ = k_pages.shape
     max_pages = page_table.shape[1]
     group = n_q // n_kv
     scale = 1.0 / (hd ** 0.5)
-
-    chunk = min(8, max_pages)
     kernel = functools.partial(_kernel, page_size=page_size, n_kv=n_kv,
                                group=group, scale=scale,
                                max_pages=max_pages, chunk=chunk)

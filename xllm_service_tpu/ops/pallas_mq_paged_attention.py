@@ -33,6 +33,7 @@ from .pallas_page_dma import (
     flash_accumulate,
     make_chunk_dma,
     masked_kv_f32,
+    page_chunk_size,
 )
 
 
@@ -106,7 +107,6 @@ def _kernel(page_table_ref, prefix_ref, block_ref,    # scalar prefetch
     o_ref[0] = out.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def mq_paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
                               v_pages: jax.Array, page_table: jax.Array,
                               prefix_lens: jax.Array,
@@ -116,14 +116,23 @@ def mq_paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
     [pages, n_kv, ps, hd] holding prefix AND block KV; page_table:
     [B, max_pages]; prefix_lens/block_lens: [B]. Returns [B, Sq, n_q, hd]
     — causal over absolute positions, identical to the XLA
-    prefill_attention reference (tested)."""
+    prefill_attention reference (tested).
+
+    XLLM_PAGE_CHUNK is resolved here, OUTSIDE jit, and passed static — a
+    shape-keyed cache would silently pin the first-traced chunk."""
+    return _mq_impl(q, k_pages, v_pages, page_table, prefix_lens,
+                    block_lens, chunk=page_chunk_size(page_table.shape[1]),
+                    interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
+def _mq_impl(q, k_pages, v_pages, page_table, prefix_lens, block_lens, *,
+             chunk: int, interpret: bool = False) -> jax.Array:
     B, s_q, n_q, hd = q.shape
     _, n_kv, page_size, _ = k_pages.shape
     max_pages = page_table.shape[1]
     group = n_q // n_kv
     scale = 1.0 / (hd ** 0.5)
-
-    chunk = min(8, max_pages)
     kernel = functools.partial(_kernel, page_size=page_size, n_kv=n_kv,
                                group=group, scale=scale,
                                max_pages=max_pages, chunk=chunk, s_q=s_q)

@@ -20,6 +20,22 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def page_chunk_size(max_pages: int, default: int = 8) -> int:
+    """Pages per double-buffered DMA chunk in the paged-attention
+    kernels. Bigger chunks mean fewer, larger DMAs — the decode walk is
+    DMA-latency-bound at serving shapes (B rows x ~pages/chunk waits per
+    layer), so this is a first-order knob. XLLM_PAGE_CHUNK overrides for
+    on-chip A/B; VMEM cost is 4 * chunk * n_kv * ps * hd bytes (two
+    k/v double buffers)."""
+    import os
+
+    try:
+        v = int(os.environ.get("XLLM_PAGE_CHUNK", "") or default)
+    except ValueError:
+        v = default
+    return max(1, min(v, max_pages))
+
+
 def make_chunk_dma(page_table_ref, b, n_pages, chunk,
                    k_hbm, v_hbm, k_buf, v_buf, sems):
     """Returns (start_chunk(slot, c), wait_chunk(slot, c))."""

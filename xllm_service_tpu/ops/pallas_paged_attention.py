@@ -36,6 +36,7 @@ from .pallas_page_dma import (
     flash_accumulate,
     make_chunk_dma,
     masked_kv_f32,
+    page_chunk_size,
 )
 
 
@@ -46,8 +47,9 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
             k_buf, v_buf, sems,                 # scratch: 2-slot chunk ring
             m_scr, l_scr, acc_scr,
             *, page_size: int, n_kv: int, group: int, scale: float,
-            max_pages: int, chunk: int):
+            max_pages: int, chunk: int, pipeline_rows: bool):
     b = pl.program_id(0)
+    nb = pl.num_programs(0)
     ctx = context_lens_ref[b]
     n_pages = jnp.minimum(pl.cdiv(ctx, page_size), max_pages)
     n_chunks = pl.cdiv(n_pages, chunk)
@@ -60,60 +62,130 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
         page_table_ref, b, n_pages, chunk, k_hbm, v_hbm, k_buf, v_buf,
         sems)
 
-    @pl.when(n_chunks > 0)
-    def _run():
-        start_chunk(0, 0)
+    def compute(c, slot):
+        span = chunk * page_size
+        start = c * span
+        token_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, span), 1)
+        mask = token_pos < ctx
+        q = q_ref[0].astype(jnp.float32) * scale           # [n_q, hd]
+        for kv in range(n_kv):
+            qh = q[kv * group:(kv + 1) * group, :]         # [G, hd]
+            k, v = masked_kv_f32(k_buf, v_buf, slot, kv, start, ctx)
+            s = jax.lax.dot_general(
+                qh, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [G, span]
+            s = jnp.where(mask, s, _NEG_INF)
+            flash_accumulate(slice(kv * group, (kv + 1) * group),
+                             s, v, m_scr, l_scr, acc_scr)
+
+    if not pipeline_rows:
+        @pl.when(n_chunks > 0)
+        def _run():
+            start_chunk(0, 0)
+
+            def body(c, _):
+                slot = jax.lax.rem(c, 2)
+
+                @pl.when(c + 1 < n_chunks)
+                def _prefetch():
+                    start_chunk(1 - slot, c + 1)
+
+                wait_chunk(slot, c)
+                compute(c, slot)
+                return ()
+
+            jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
+    else:
+        # Cross-row pipelining: rows cooperate so the NEXT row's first
+        # chunk is already in flight when its grid step begins — the
+        # per-row cold-start DMA stall (one per row per layer, the
+        # dominant latency term at serving batch) is hidden behind the
+        # previous row's last-chunk compute. Invariants:
+        #   - every non-empty row runs an EVEN number of chunks (one
+        #     masked pad chunk when odd), so rows always start in slot 0
+        #     and end in slot 1 -> slot 0 is free during the final chunk;
+        #   - the final chunk (or an empty row) prefetches row b+1's
+        #     chunk 0 into slot 0 with row b+1's own page-count guards;
+        #   - only row 0 cold-starts its own chunk 0.
+        b_next = jnp.minimum(b + 1, nb - 1)
+        ctx_n = context_lens_ref[b_next]
+        n_pages_n = jnp.minimum(pl.cdiv(ctx_n, page_size), max_pages)
+        start_next, _ = make_chunk_dma(
+            page_table_ref, b_next, n_pages_n, chunk, k_hbm, v_hbm,
+            k_buf, v_buf, sems)
+        n_chunks_e = n_chunks + jax.lax.rem(n_chunks, 2)   # pad to even
+
+        @pl.when(b == 0)
+        def _cold():
+            start_chunk(0, 0)
+
+        @pl.when((n_chunks_e == 0) & (b + 1 < nb))
+        def _forward_empty_row():
+            start_next(0, 0)
 
         def body(c, _):
             slot = jax.lax.rem(c, 2)
 
-            @pl.when(c + 1 < n_chunks)
+            @pl.when(c + 1 < n_chunks_e)
             def _prefetch():
                 start_chunk(1 - slot, c + 1)
 
-            wait_chunk(slot, c)
+            @pl.when((c + 1 == n_chunks_e) & (b + 1 < nb))
+            def _prefetch_next_row():
+                start_next(0, 0)
 
-            span = chunk * page_size
-            start = c * span
-            token_pos = start + jax.lax.broadcasted_iota(
-                jnp.int32, (1, span), 1)
-            mask = token_pos < ctx
-            q = q_ref[0].astype(jnp.float32) * scale       # [n_q, hd]
-            for kv in range(n_kv):
-                qh = q[kv * group:(kv + 1) * group, :]     # [G, hd]
-                k, v = masked_kv_f32(k_buf, v_buf, slot, kv, start, ctx)
-                s = jax.lax.dot_general(
-                    qh, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)    # [G, span]
-                s = jnp.where(mask, s, _NEG_INF)
-                flash_accumulate(slice(kv * group, (kv + 1) * group),
-                                 s, v, m_scr, l_scr, acc_scr)
+            wait_chunk(slot, c)
+            compute(c, slot)
             return ()
 
-        jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
+        jax.lax.fori_loop(0, n_chunks_e, body, (), unroll=False)
 
     l = jnp.maximum(l_scr[:, :1], 1e-9)
     o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, page_table: jax.Array,
                            context_lens: jax.Array,
                            interpret: bool = False) -> jax.Array:
     """q: [B, n_q, hd]; k/v_pages: [pages, n_kv, ps, hd];
     page_table: [B, max_pages] i32; context_lens: [B] i32 (incl. the new
-    token, whose K/V must already be written). Returns [B, n_q, hd]."""
+    token, whose K/V must already be written). Returns [B, n_q, hd].
+
+    Env knobs are resolved HERE (outside jit) and passed as static args —
+    a jit cache keyed only on shapes would silently pin the first-traced
+    variant for the whole process, defeating in-process A/Bs and tests.
+    """
+    import os
+
+    chunk = page_chunk_size(page_table.shape[1])
+    # Cross-row DMA pipelining (see _kernel): XLLM_PAGE_PIPELINE=row
+    # enables; default off until the on-chip A/B proves it.
+    pipeline_rows = os.environ.get("XLLM_PAGE_PIPELINE", "") == "row"
+    return _paged_attention_impl(q, k_pages, v_pages, page_table,
+                                 context_lens, chunk=chunk,
+                                 pipeline_rows=pipeline_rows,
+                                 interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "pipeline_rows",
+                                             "interpret"))
+def _paged_attention_impl(q: jax.Array, k_pages: jax.Array,
+                          v_pages: jax.Array, page_table: jax.Array,
+                          context_lens: jax.Array, *, chunk: int,
+                          pipeline_rows: bool,
+                          interpret: bool = False) -> jax.Array:
     B, n_q, hd = q.shape
     _, n_kv, page_size, _ = k_pages.shape
     max_pages = page_table.shape[1]
     group = n_q // n_kv
     scale = 1.0 / (hd ** 0.5)
 
-    chunk = min(8, max_pages)
     kernel = functools.partial(_kernel, page_size=page_size, n_kv=n_kv,
                                group=group, scale=scale,
-                               max_pages=max_pages, chunk=chunk)
+                               max_pages=max_pages, chunk=chunk,
+                               pipeline_rows=pipeline_rows)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
