@@ -1,0 +1,123 @@
+"""Summarize tpu_results/*.json sweep artifacts into a BASELINE.md-ready
+markdown table + a one-line verdict per A/B arm.
+
+    python benchmarks/summarize_sweep.py [tpu_results/]
+
+Reads every known artifact name the round-4 sweep writes (tpu_sweep.sh),
+tolerates missing/failed steps, and prints:
+  - the headline bench rows (tok/s, vs_baseline, pct_roofline) per arm,
+  - kernel A/B verdicts (chunk16/32, rowpipe, fused/scatter, int8, 8B),
+  - serve + span table, spec speedup, PD handoff, decode profile.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+
+def load(d: Path, name: str):
+    p = d / f"{name}.json"
+    if not p.exists():
+        return None
+    try:
+        text = p.read_text().strip()
+        return json.loads(text) if text else None
+    except ValueError:
+        # spec_bench prints multiple lines; take the last parseable one.
+        recs = []
+        for ln in text.splitlines():
+            try:
+                recs.append(json.loads(ln))
+            except ValueError:
+                continue
+        return recs[-1] if recs else None
+
+
+BENCH_ARMS = [
+    ("bench", "1b bf16 (default)"),
+    ("bench_8b", "8B int8 (north-star scale)"),
+    ("bench_int8", "1b int8"),
+    ("bench_chunk16", "1b chunk=16"),
+    ("bench_chunk32", "1b chunk=32"),
+    ("bench_rowpipe", "1b rowpipe"),
+    ("bench_rowpipe16", "1b rowpipe+chunk16"),
+    ("bench_ctx2k", "1b ctx=2048 chunk=16"),
+    ("bench_fused", "1b fused writeback"),
+    ("bench_scatter", "1b scatter writeback"),
+    ("bench_prefill_pallas", "1b pallas prefill route"),
+]
+
+
+def main() -> None:
+    d = Path(sys.argv[1] if len(sys.argv) > 1 else "tpu_results")
+    rows = []
+    baseline = None
+    for name, label in BENCH_ARMS:
+        r = load(d, name)
+        if not r:
+            continue
+        if r.get("error"):
+            rows.append((label, None, r["error"][:60], r.get("backend")))
+            continue
+        v = r.get("value")
+        if name == "bench":
+            baseline = v
+        rows.append((label, v, r, r.get("backend")))
+
+    print("## Sweep summary\n")
+    print("| Arm | tok/s | vs default | pct_roofline | backend |")
+    print("|---|---|---|---|---|")
+    for label, v, r, backend in rows:
+        if v is None:
+            print(f"| {label} | ERROR | {r} | | {backend} |")
+            continue
+        rel = (f"{v / baseline:.3f}x"
+               if baseline and label != "1b bf16 (default)" else "—")
+        roof = r.get("pct_roofline", "")
+        print(f"| {label} | {v} | {rel} | {roof} | {backend} |")
+
+    prof = load(d, "decode_profile")
+    if prof and not prof.get("error"):
+        print("\n### Decode step components (ms)\n")
+        for k in ("full_step_ms", "forward_only_ms", "attention_only_ms",
+                  "matmul_and_rest_ms", "sampling_only_ms",
+                  "sample_overhead_ms", "ideal_weight_stream_ms"):
+            if k in prof:
+                print(f"- {k}: {prof[k]}")
+
+    spec = load(d, "spec")
+    spec_mq = load(d, "spec_mq")
+    for tag, r in (("spec", spec), ("spec+mq-kernel", spec_mq)):
+        if r and isinstance(r, dict):
+            print(f"\n### {tag}: {json.dumps(r)[:300]}")
+
+    cp = load(d, "cp_kernel")
+    if cp:
+        print("\n### CP kernel:",
+              {k: cp.get(k) for k in ("cp_pallas_ms", "cp_xla_fallback_ms",
+                                      "pallas_vs_xla",
+                                      "single_device_kernel_ms", "error")})
+
+    pd = load(d, "pd_handoff")
+    if pd:
+        print("\n### PD handoff:",
+              {k: pd.get(k) for k in pd if k.startswith("ctx_")
+               or k == "error"})
+
+    for tag in ("serve", "serve_warm"):
+        sv = load(d, tag)
+        if sv:
+            print(f"\n### {tag}:",
+                  {k: sv.get(k) for k in ("req_per_s", "decode_tok_per_s",
+                                          "ttft_ms", "ttft_spans_p50_ms",
+                                          "errors")})
+
+    kv = load(d, "kvwb")
+    if kv:
+        print("\n### kv writeback micro:", json.dumps(kv)[:300])
+
+
+if __name__ == "__main__":
+    main()
