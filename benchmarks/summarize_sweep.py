@@ -71,7 +71,8 @@ def main() -> None:
     print("|---|---|---|---|---|")
     for label, v, r, backend in rows:
         if v is None:
-            print(f"| {label} | ERROR | {r} | | {backend} |")
+            note = r if isinstance(r, str) else "no value recorded"
+            print(f"| {label} | ERROR | {note} | | {backend} |")
             continue
         rel = (f"{v / baseline:.3f}x"
                if baseline and label != "1b bf16 (default)" else "—")

@@ -262,6 +262,10 @@ class InferenceEngine:
             # rope position ids ahead of/behind the sequence index by a
             # constant once the prompt ends; 0 for text-only / non-VL).
             "mrope_delta": jnp.zeros((B,), jnp.int32),
+            # Per-slot token budget (max_total_len; 0 = none): the decode
+            # program freezes a slot AT its budget, so the host never
+            # shrinks the batch horizon for one nearly-done sequence.
+            "budget": jnp.zeros((B,), jnp.int32),
         }
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
 
@@ -377,8 +381,12 @@ class InferenceEngine:
                 # tokens freezes (no clens growth, no further KV writes
                 # grow its window) for the rest of the horizon. The stop
                 # token itself is still emitted (host appends it and
-                # finishes the sequence).
+                # finishes the sequence). A slot at its token BUDGET
+                # (max_total_len) freezes the same way — so nearly-done
+                # sequences no longer clamp the whole batch's horizon
+                # (the host used to shrink it to the minimum remaining).
                 hit = jnp.any(toks[:, None] == d["stop_ids"], axis=-1)
+                hit |= (d["budget"] > 0) & (d["clens"] + 1 >= d["budget"])
                 advance = d["active"] & ~hit
                 d["last"] = jnp.where(advance, toks, d["last"])
                 d["clens"] = jnp.where(advance, d["clens"] + 1,
@@ -405,9 +413,9 @@ class InferenceEngine:
 
             packed_in: ONE int32 upload (host↔device roundtrips are the
             dominant admission cost on remote-attached chips), laid out as
-            [tokens(S) | ints(P+4+NS+NB) | floats_bits(6+NB) | counts(V) |
+            [tokens(S) | ints(P+5+NS+NB) | floats_bits(6+NB) | counts(V) |
             key(2)] where ints = [page_row(P), slot, prefix_len, seq_len,
-            want_logprobs, stop_ids(NS), bias_ids(NB)], floats
+            want_logprobs, stop_ids(NS), bias_ids(NB), budget], floats
             (temperature, top_k, top_p, freq, pres, rep, bias_vals(NB))
             are f32 bit-cast to i32, and key is the uint32 PRNG key.
             mm: [1, M, D] visual embeddings (VL family; dummy otherwise).
@@ -423,7 +431,7 @@ class InferenceEngine:
                 from ..parallel.mesh import AXIS_SEQ
 
                 NS, NB = NUM_STOP_IDS, NUM_BIAS
-                n_ints = P + 4 + NS + NB
+                n_ints = P + 4 + NS + NB + 1   # +1: token budget
                 n_floats = 6 + NB
                 tail = n_ints + n_floats + V + 2
                 if is_vl:
@@ -502,6 +510,8 @@ class InferenceEngine:
                     floats[6:6 + NB])
                 d["counts"] = d["counts"].at[slot].set(
                     counts_row.at[toks[0]].add(1))
+                d["budget"] = d["budget"].at[slot].set(
+                    ints[P + 4 + NS + NB])
                 if is_vl:
                     d["mrope_delta"] = d["mrope_delta"].at[slot].set(mdelta)
                 if spec_on:
@@ -700,6 +710,7 @@ class InferenceEngine:
             d["active"] = d["active"].at[slot].set(False)
             d["clens"] = d["clens"].at[slot].set(0)
             d["mrope_delta"] = d["mrope_delta"].at[slot].set(0)
+            d["budget"] = d["budget"].at[slot].set(0)
             return d
 
         self._clear_slot = clear_slot
@@ -717,10 +728,10 @@ class InferenceEngine:
             scatter the transferred prompt KV into local pages + install the
             batch slot with the prefill-produced first token.
 
-            ints: [P + 4 + NUM_STOP_IDS + NUM_BIAS + 1] = [page_row(P),
+            ints: [P + 4 + NUM_STOP_IDS + NUM_BIAS + 2] = [page_row(P),
                   slot, prompt_len, first_token, want_logprobs,
                   stop_ids(NUM_STOP_IDS), bias_ids(NUM_BIAS),
-                  mrope_delta];
+                  mrope_delta, budget];
             floats: [6 + NUM_BIAS] (controls + bias_vals).
             """
             page_row = ints[:P]
@@ -752,6 +763,8 @@ class InferenceEngine:
             d["counts"] = d["counts"].at[slot].set(counts_row)
             d["mrope_delta"] = d["mrope_delta"].at[slot].set(
                 ints[P + 4 + NUM_STOP_IDS + NUM_BIAS])
+            d["budget"] = d["budget"].at[slot].set(
+                ints[P + 4 + NUM_STOP_IDS + NUM_BIAS + 1])
             if spec_on:
                 # Only the prefill-produced first token is on this
                 # engine; the prompt stayed with the prefill instance, so
@@ -830,7 +843,7 @@ class InferenceEngine:
             unit = max(1, mcfg.vision.out_tokens * 4)
             mm_shapes.append(
                 jnp.zeros((1, unit, mcfg.hidden_size), mcfg.dtype))
-        ints = np.full((P + 4 + NS + NB,), GARBAGE_PAGE, np.int32)
+        ints = np.full((P + 4 + NS + NB + 1,), GARBAGE_PAGE, np.int32)
         ints[P] = 0            # slot
         ints[P + 1] = 0        # matched prefix
         ints[P + 2] = 0        # suffix length
@@ -1025,6 +1038,7 @@ class InferenceEngine:
         self._dstate["bias_ids"] = jnp.full((B, NUM_BIAS), -1, jnp.int32)
         self._dstate["bias_vals"] = jnp.zeros((B, NUM_BIAS), jnp.float32)
         self._dstate["mrope_delta"] = jnp.zeros((B,), jnp.int32)
+        self._dstate["budget"] = jnp.zeros((B,), jnp.int32)
         for req in victims:
             try:
                 req.on_output(RequestOutput(
@@ -1556,7 +1570,7 @@ class InferenceEngine:
         P = cfg.pages_per_seq
         sp = req.sampling
         NS, NB = NUM_STOP_IDS, NUM_BIAS
-        ints = np.full((P + 4 + NS + NB + 1,), GARBAGE_PAGE, np.int32)
+        ints = np.full((P + 4 + NS + NB + 2,), GARBAGE_PAGE, np.int32)
         ints[:len(own_pages)] = own_pages
         ints[P] = seq.slot
         ints[P + 1] = P0
@@ -1573,6 +1587,7 @@ class InferenceEngine:
                 prompt, cfg.model.image_token_id)[1]
         else:
             ints[P + 4 + NS + NB] = 0
+        ints[P + 4 + NS + NB + 1] = max_total   # device-side token budget
         floats = np.concatenate([
             np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
                         sp.frequency_penalty, sp.presence_penalty,
@@ -1702,7 +1717,7 @@ class InferenceEngine:
 
         sp = seq.req.sampling
         NS, NB = NUM_STOP_IDS, NUM_BIAS
-        ints = np.full((P + 4 + NS + NB,), GARBAGE_PAGE, np.int32)
+        ints = np.full((P + 4 + NS + NB + 1,), GARBAGE_PAGE, np.int32)
         all_pages = seq.pages.all_pages
         ints[:len(all_pages)] = all_pages
         ints[P] = seq.slot
@@ -1712,6 +1727,9 @@ class InferenceEngine:
         ints[P + 4:P + 4 + NS] = self._device_stop_ids(sp)
         bias_ids, bias_vals = self._device_bias(sp)
         ints[P + 4 + NS:P + 4 + NS + NB] = bias_ids
+        # Device-side token budget: the decode program freezes the slot
+        # at max_total_len (see decode_multi).
+        ints[P + 4 + NS + NB] = seq.max_total_len
         floats = np.concatenate([
             np.asarray([sp.temperature, float(sp.top_k), sp.top_p,
                         sp.frequency_penalty, sp.presence_penalty,
@@ -1772,20 +1790,22 @@ class InferenceEngine:
             # plain step.
             self._drain_pending_decode()
             return self._decode_speculative()
-        # Bound the horizon by the shortest remaining token budget among
-        # running sequences so we never burn a whole horizon of discarded
-        # tokens on a nearly-done sequence. Rounded DOWN to a power of two:
-        # never overshoots, and keeps the decode_multi compile cache to
-        # log2(decode_horizon) entries (horizon is a static argument).
-        # (With a step in flight, output_ids lags by its horizon; the
-        # overshoot this allows is bounded by one horizon and lands on
-        # the garbage page / is discarded by _emit_tokens.)
+        # Bound the horizon by the LONGEST remaining token budget among
+        # running sequences (pow2 ceiling, so the compile cache stays at
+        # log2(decode_horizon) variants). Per-sequence budgets are
+        # enforced ON DEVICE (a slot freezes at its budget exactly like a
+        # stop-token hit), so one nearly-done sequence no longer clamps
+        # the whole batch to a tiny horizon — only when EVERY running
+        # sequence is nearly done does the horizon shrink, avoiding
+        # whole-batch dead steps. (With a step in flight, output_ids lags
+        # by its horizon; overshoot is frozen out by the device budget.)
         horizon = self.cfg.decode_horizon
-        rem = min((s.max_total_len - s.prompt_len - len(s.output_ids)
+        rem = max((s.max_total_len - s.prompt_len - len(s.output_ids)
                    for s in self._running.values() if not s.finished),
                   default=horizon)
         if 0 < rem < horizon:
-            horizon = 1 << (rem.bit_length() - 1)
+            horizon = 1 << (rem - 1).bit_length()
+            horizon = min(horizon, self.cfg.decode_horizon)
         t0 = time.monotonic()
         self._dstate, packed = self._decode_multi(
             self.params, self._dstate, horizon)
