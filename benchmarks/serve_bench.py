@@ -243,11 +243,11 @@ def run_inproc(args, model_config: str, on_accel: bool) -> dict:
         mcfg = model_base.tiny_config(
             dtype=jnp.float32, max_context_len=1024)
         max_seq, pages, horizon = 512, 256, 4
-        buckets = (128, 512)
+        buckets = (128, 256, 512)
     else:
         mcfg = getattr(model_base, model_config + "_config")()
         max_seq, pages, horizon = 1024, 16 * 1024 // 16, 8
-        buckets = (128, 512, 1024)
+        buckets = (128, 256, 512, 1024)
 
     store = MemoryStore()
     opts = ServiceOptions(host="127.0.0.1", http_port=0, rpc_port=0,

@@ -1319,8 +1319,12 @@ def main() -> None:
         num_pages=args.num_pages, page_size=args.page_size,
         max_batch_size=args.max_batch_size,
         max_seq_len=min(args.max_seq_len, mcfg.max_context_len),
+        # Pow2 ladder: a prompt pads to the next bucket, so a sparse
+        # ladder doubles typical prefill compute (a 256-token prompt in a
+        # 512 bucket runs 2x the positions). Boot compiles amortize via
+        # the persistent compile cache.
         prefill_buckets=tuple(sorted(
-            {b for b in (128, 512, 2048)
+            {b for b in (128, 256, 512, 1024, 2048)
              if b < min(args.max_seq_len, mcfg.max_context_len)}
             | {min(args.max_seq_len, mcfg.max_context_len)})),
         role=InstanceType.parse(args.type),
