@@ -100,6 +100,38 @@ class TestPallasPagedAttention:
             np.testing.assert_array_equal(np.asarray(vp_got),
                                           np.asarray(vp_ref))
 
+    def test_fused_decode_step_parity_rowpipe(self, monkeypatch):
+        """Fused kernel with cross-row pipelining: same parity contract
+        as the default walk across empty contexts, page edges, and odd
+        chunk counts."""
+        from xllm_service_tpu.ops.pallas_fused_decode_attention import (
+            fused_decode_attention_pallas,
+        )
+
+        monkeypatch.setenv("XLLM_PAGE_PIPELINE", "row")
+        monkeypatch.setenv("XLLM_PAGE_CHUNK", "1")   # maximize row turns
+        q, k_pages, v_pages, pt = _setup()
+        B, n_kv, hd = 4, 4, 128
+        for prev in ([10, 20, 30, 40],
+                     [0, 16, 31, 95],
+                     [0, 0, 0, 0],
+                     [50, 0, 0, 12]):    # empty rows between active ones
+            cl_prev = jnp.asarray(prev, jnp.int32)
+            k_new = jax.random.normal(jax.random.PRNGKey(9), (B, n_kv, hd))
+            v_new = jax.random.normal(jax.random.PRNGKey(10), (B, n_kv, hd))
+            kp_ref, vp_ref = write_decode_kv(k_pages, v_pages, k_new, v_new,
+                                             pt, cl_prev)
+            cl = cl_prev + 1
+            ref = paged_attention_xla(q, kp_ref, vp_ref, pt, cl)
+            got, kp_got, vp_got = fused_decode_attention_pallas(
+                q, k_new, v_new, k_pages, v_pages, pt, cl, interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+            np.testing.assert_array_equal(np.asarray(kp_got),
+                                          np.asarray(kp_ref))
+            np.testing.assert_array_equal(np.asarray(vp_got),
+                                          np.asarray(vp_ref))
+
     def test_fused_decode_step_gqa(self):
         from xllm_service_tpu.ops.pallas_fused_decode_attention import (
             fused_decode_attention_pallas,

@@ -104,6 +104,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # 4. fused append+attend decode kernel (Mosaic validation + A/B vs 1.)
     run_step bench_fused 900 env XLLM_KV_WRITEBACK=fused python bench.py \
       || { sleep 60; continue; }
+    # 4b. fused + cross-row pipelining + chunk16
+    run_step bench_fused_rp16 900 env XLLM_KV_WRITEBACK=fused \
+      XLLM_PAGE_PIPELINE=row XLLM_PAGE_CHUNK=16 python bench.py \
+      || { sleep 60; continue; }
     # 5. scatter-writeback A/B
     run_step bench_scatter 900 env XLLM_KV_WRITEBACK=scatter python bench.py \
       || { sleep 60; continue; }

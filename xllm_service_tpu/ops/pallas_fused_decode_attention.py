@@ -54,8 +54,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_page_dma import (
     NEG_INF as _NEG_INF,
+    chunked_page_walk,
     flash_accumulate,
-    make_chunk_dma,
     masked_kv_f32,
     page_chunk_size,
 )
@@ -71,8 +71,9 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
             k_pg, v_pg,                         # tail-page RMW staging
             m_scr, l_scr, acc_scr,
             *, page_size: int, n_kv: int, group: int, scale: float,
-            max_pages: int, chunk: int):
+            max_pages: int, chunk: int, pipeline_rows: bool):
     b = pl.program_id(0)
+    nb = pl.num_programs(0)
     ctx = context_lens_ref[b]
     pos = jnp.maximum(ctx - 1, 0)               # the new token's position
     # Kick the tail-page READ DMAs first so they overlap the page walk
@@ -84,53 +85,45 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
     pltpu.make_async_copy(v_in.at[wpage], v_pg, wsems.at[0, 1]).start()
 
     ctx_prev = pos                              # tokens already in the pool
-    n_pages = jnp.minimum(pl.cdiv(ctx_prev, page_size), max_pages)
-    n_chunks = pl.cdiv(n_pages, chunk)
+
+    def n_pages_of(row):
+        # The walk covers only the PREVIOUS tokens (ctx - 1); the new
+        # token's contribution merges from VMEM below. Cross-row
+        # prefetch uses the same rule for row b+1, so its guard set
+        # matches the waits row b+1 will issue.
+        prev = jnp.maximum(context_lens_ref[row] - 1, 0)
+        return jnp.minimum(pl.cdiv(prev, page_size), max_pages)
 
     m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
     l_scr[...] = jnp.zeros_like(l_scr)
     acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    start_chunk, wait_chunk = make_chunk_dma(
-        page_table_ref, b, n_pages, chunk, k_in, v_in, k_buf, v_buf, sems)
-
     q = q_ref[0].astype(jnp.float32) * scale           # [n_q, hd]
 
-    @pl.when(n_chunks > 0)
-    def _run():
-        start_chunk(0, 0)
+    def compute(c, slot_):
+        span = chunk * page_size
+        start = c * span
+        token_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, span), 1)
+        # Bound the walk at ctx_prev: the new token's slot (possibly
+        # racing the in-flight append DMA) is masked out of every
+        # read, both in scores and in the V zeroing inside
+        # masked_kv_f32.
+        mask = token_pos < ctx_prev
+        for kv in range(n_kv):
+            qh = q[kv * group:(kv + 1) * group, :]     # [G, hd]
+            k, v = masked_kv_f32(k_buf, v_buf, slot_, kv, start,
+                                 ctx_prev)
+            s = jax.lax.dot_general(
+                qh, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [G, span]
+            s = jnp.where(mask, s, _NEG_INF)
+            flash_accumulate(slice(kv * group, (kv + 1) * group),
+                             s, v, m_scr, l_scr, acc_scr)
 
-        def body(c, _):
-            slot_ = jax.lax.rem(c, 2)
-
-            @pl.when(c + 1 < n_chunks)
-            def _prefetch():
-                start_chunk(1 - slot_, c + 1)
-
-            wait_chunk(slot_, c)
-
-            span = chunk * page_size
-            start = c * span
-            token_pos = start + jax.lax.broadcasted_iota(
-                jnp.int32, (1, span), 1)
-            # Bound the walk at ctx_prev: the new token's slot (possibly
-            # racing the in-flight append DMA) is masked out of every
-            # read, both in scores and in the V zeroing inside
-            # masked_kv_f32.
-            mask = token_pos < ctx_prev
-            for kv in range(n_kv):
-                qh = q[kv * group:(kv + 1) * group, :]     # [G, hd]
-                k, v = masked_kv_f32(k_buf, v_buf, slot_, kv, start,
-                                     ctx_prev)
-                s = jax.lax.dot_general(
-                    qh, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)    # [G, span]
-                s = jnp.where(mask, s, _NEG_INF)
-                flash_accumulate(slice(kv * group, (kv + 1) * group),
-                                 s, v, m_scr, l_scr, acc_scr)
-            return ()
-
-        jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
+    chunked_page_walk(page_table_ref, b, nb, n_pages_of(b), n_pages_of,
+                      chunk, k_in, v_in, k_buf, v_buf, sems, compute,
+                      pipeline_rows)
 
     # Merge the new token's contribution straight from VMEM (it is always
     # attended: position ctx-1 < ctx).
@@ -174,17 +167,24 @@ def fused_decode_attention_pallas(
     """Returns (attn_out [B, n_q, hd], k_pages, v_pages) with the new
     token's K/V appended in place (pools are donated via aliasing).
 
-    XLLM_PAGE_CHUNK is resolved here, OUTSIDE jit, and passed static — a
-    shape-keyed cache would silently pin the first-traced chunk."""
+    XLLM_PAGE_CHUNK / XLLM_PAGE_PIPELINE are resolved here, OUTSIDE jit,
+    and passed static — a shape-keyed cache would silently pin the
+    first-traced variant."""
+    import os
+
     return _fused_impl(q, k_new, v_new, k_pages, v_pages, page_table,
                        context_lens,
                        chunk=page_chunk_size(page_table.shape[1]),
+                       pipeline_rows=os.environ.get(
+                           "XLLM_PAGE_PIPELINE", "") == "row",
                        interpret=interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
+@functools.partial(jax.jit, static_argnames=("chunk", "pipeline_rows",
+                                             "interpret"))
 def _fused_impl(q, k_new, v_new, k_pages, v_pages, page_table,
-                context_lens, *, chunk: int, interpret: bool = False):
+                context_lens, *, chunk: int, pipeline_rows: bool = False,
+                interpret: bool = False):
     B, n_q, hd = q.shape
     _, n_kv, page_size, _ = k_pages.shape
     max_pages = page_table.shape[1]
@@ -192,7 +192,8 @@ def _fused_impl(q, k_new, v_new, k_pages, v_pages, page_table,
     scale = 1.0 / (hd ** 0.5)
     kernel = functools.partial(_kernel, page_size=page_size, n_kv=n_kv,
                                group=group, scale=scale,
-                               max_pages=max_pages, chunk=chunk)
+                               max_pages=max_pages, chunk=chunk,
+                               pipeline_rows=pipeline_rows)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),

@@ -69,6 +69,82 @@ def make_chunk_dma(page_table_ref, b, n_pages, chunk,
     return start_chunk, wait_chunk
 
 
+def chunked_page_walk(page_table_ref, b, nb, n_pages, n_pages_of, chunk,
+                      k_hbm, v_hbm, k_buf, v_buf, sems, compute,
+                      pipeline_rows: bool):
+    """Run the double-buffered page walk for grid row ``b``, calling
+    ``compute(c, slot)`` per chunk.
+
+    pipeline_rows=False: classic within-row prefetch (chunk c+1 loads
+    while chunk c computes; each row pays one cold-start DMA stall).
+
+    pipeline_rows=True: rows cooperate — the final chunk (or an empty
+    row) prefetches row b+1's chunk 0 into the free buffer slot, hiding
+    the per-row cold-start stall behind the previous row's compute.
+    Invariants: every non-empty row runs an EVEN chunk count (one masked
+    pad chunk when odd — its DMAs/waits are no-ops via the p < n_pages
+    guards and `compute` must mask it), so rows always start in slot 0
+    and end in slot 1; only row 0 cold-starts itself.
+
+    ``n_pages_of(row)`` must return the page count for any row with the
+    same semantics used for ``n_pages`` (= n_pages_of(b)).
+    """
+    n_chunks = pl.cdiv(n_pages, chunk)
+    start_chunk, wait_chunk = make_chunk_dma(
+        page_table_ref, b, n_pages, chunk, k_hbm, v_hbm, k_buf, v_buf,
+        sems)
+
+    if not pipeline_rows:
+        @pl.when(n_chunks > 0)
+        def _run():
+            start_chunk(0, 0)
+
+            def body(c, _):
+                slot = jax.lax.rem(c, 2)
+
+                @pl.when(c + 1 < n_chunks)
+                def _prefetch():
+                    start_chunk(1 - slot, c + 1)
+
+                wait_chunk(slot, c)
+                compute(c, slot)
+                return ()
+
+            jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
+        return
+
+    b_next = jnp.minimum(b + 1, nb - 1)
+    start_next, _ = make_chunk_dma(
+        page_table_ref, b_next, n_pages_of(b_next), chunk, k_hbm, v_hbm,
+        k_buf, v_buf, sems)
+    n_chunks_e = n_chunks + jax.lax.rem(n_chunks, 2)     # pad to even
+
+    @pl.when(b == 0)
+    def _cold():
+        start_chunk(0, 0)
+
+    @pl.when((n_chunks_e == 0) & (b + 1 < nb))
+    def _forward_empty_row():
+        start_next(0, 0)
+
+    def body(c, _):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < n_chunks_e)
+        def _prefetch():
+            start_chunk(1 - slot, c + 1)
+
+        @pl.when((c + 1 == n_chunks_e) & (b + 1 < nb))
+        def _prefetch_next_row():
+            start_next(0, 0)
+
+        wait_chunk(slot, c)
+        compute(c, slot)
+        return ()
+
+    jax.lax.fori_loop(0, n_chunks_e, body, (), unroll=False)
+
+
 def masked_kv_f32(k_buf, v_buf, slot, kv, start, bound):
     """Read one KV head's chunk from the ring as f32 ``[span, hd]``,
     zeroing V rows at positions >= ``bound``: their probabilities are 0,

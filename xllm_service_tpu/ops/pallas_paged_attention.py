@@ -33,8 +33,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_page_dma import (
     NEG_INF as _NEG_INF,
+    chunked_page_walk,
     flash_accumulate,
-    make_chunk_dma,
     masked_kv_f32,
     page_chunk_size,
 )
@@ -51,16 +51,14 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
     b = pl.program_id(0)
     nb = pl.num_programs(0)
     ctx = context_lens_ref[b]
-    n_pages = jnp.minimum(pl.cdiv(ctx, page_size), max_pages)
-    n_chunks = pl.cdiv(n_pages, chunk)
+
+    def n_pages_of(row):
+        return jnp.minimum(pl.cdiv(context_lens_ref[row], page_size),
+                           max_pages)
 
     m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
     l_scr[...] = jnp.zeros_like(l_scr)
     acc_scr[...] = jnp.zeros_like(acc_scr)
-
-    start_chunk, wait_chunk = make_chunk_dma(
-        page_table_ref, b, n_pages, chunk, k_hbm, v_hbm, k_buf, v_buf,
-        sems)
 
     def compute(c, slot):
         span = chunk * page_size
@@ -79,67 +77,9 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
             flash_accumulate(slice(kv * group, (kv + 1) * group),
                              s, v, m_scr, l_scr, acc_scr)
 
-    if not pipeline_rows:
-        @pl.when(n_chunks > 0)
-        def _run():
-            start_chunk(0, 0)
-
-            def body(c, _):
-                slot = jax.lax.rem(c, 2)
-
-                @pl.when(c + 1 < n_chunks)
-                def _prefetch():
-                    start_chunk(1 - slot, c + 1)
-
-                wait_chunk(slot, c)
-                compute(c, slot)
-                return ()
-
-            jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
-    else:
-        # Cross-row pipelining: rows cooperate so the NEXT row's first
-        # chunk is already in flight when its grid step begins — the
-        # per-row cold-start DMA stall (one per row per layer, the
-        # dominant latency term at serving batch) is hidden behind the
-        # previous row's last-chunk compute. Invariants:
-        #   - every non-empty row runs an EVEN number of chunks (one
-        #     masked pad chunk when odd), so rows always start in slot 0
-        #     and end in slot 1 -> slot 0 is free during the final chunk;
-        #   - the final chunk (or an empty row) prefetches row b+1's
-        #     chunk 0 into slot 0 with row b+1's own page-count guards;
-        #   - only row 0 cold-starts its own chunk 0.
-        b_next = jnp.minimum(b + 1, nb - 1)
-        ctx_n = context_lens_ref[b_next]
-        n_pages_n = jnp.minimum(pl.cdiv(ctx_n, page_size), max_pages)
-        start_next, _ = make_chunk_dma(
-            page_table_ref, b_next, n_pages_n, chunk, k_hbm, v_hbm,
-            k_buf, v_buf, sems)
-        n_chunks_e = n_chunks + jax.lax.rem(n_chunks, 2)   # pad to even
-
-        @pl.when(b == 0)
-        def _cold():
-            start_chunk(0, 0)
-
-        @pl.when((n_chunks_e == 0) & (b + 1 < nb))
-        def _forward_empty_row():
-            start_next(0, 0)
-
-        def body(c, _):
-            slot = jax.lax.rem(c, 2)
-
-            @pl.when(c + 1 < n_chunks_e)
-            def _prefetch():
-                start_chunk(1 - slot, c + 1)
-
-            @pl.when((c + 1 == n_chunks_e) & (b + 1 < nb))
-            def _prefetch_next_row():
-                start_next(0, 0)
-
-            wait_chunk(slot, c)
-            compute(c, slot)
-            return ()
-
-        jax.lax.fori_loop(0, n_chunks_e, body, (), unroll=False)
+    chunked_page_walk(page_table_ref, b, nb, n_pages_of(b), n_pages_of,
+                      chunk, k_hbm, v_hbm, k_buf, v_buf, sems, compute,
+                      pipeline_rows)
 
     l = jnp.maximum(l_scr[:, :1], 1e-9)
     o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
