@@ -178,8 +178,10 @@ def run_multiproc(args, model_config: str, on_accel: bool) -> dict:
                         "--decode-horizon", "4"]
         else:
             agent_model = model_config
+            # Full horizon 32 is safe for TTFT now: decode calls shrink
+            # to admission_horizon while requests are waiting.
             eng_args = ["--max-seq-len", "1024", "--num-pages", "1024",
-                        "--decode-horizon", "8"]
+                        "--decode-horizon", "32"]
         spawn("agent", [sys.executable, "-m",
                         "xllm_service_tpu.engine.agent",
                         "--coordination-addr", f"127.0.0.1:{coord_port}",
@@ -246,7 +248,7 @@ def run_inproc(args, model_config: str, on_accel: bool) -> dict:
         buckets = (128, 256, 512)
     else:
         mcfg = getattr(model_base, model_config + "_config")()
-        max_seq, pages, horizon = 1024, 16 * 1024 // 16, 8
+        max_seq, pages, horizon = 1024, 16 * 1024 // 16, 32
         buckets = (128, 256, 512, 1024)
 
     store = MemoryStore()

@@ -46,6 +46,14 @@ class EngineConfig:
     # still a win locally). Tokens past a stop condition within a horizon
     # are discarded on the host.
     decode_horizon: int = 1
+    # TTFT guard: while requests are WAITING (or a chunked prefill is in
+    # flight), decode calls shrink to this many tokens so admission isn't
+    # blocked behind a long lax.scan — at horizon 32 a full call is
+    # ~0.5 s of device time a new arrival would queue behind. With an
+    # empty queue the full decode_horizon runs (pure-throughput regime,
+    # e.g. bench.py after admission). 0 disables; pow2 (compile variants
+    # already exist).
+    admission_horizon: int = 8
     # Pre-compile every power-of-two decode horizon (and the spec-verify
     # program) at engine start. The budget-bounded horizon's first use of
     # each value otherwise compiles mid-serving (~tens of seconds on TPU —

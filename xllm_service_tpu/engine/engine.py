@@ -1800,12 +1800,17 @@ class InferenceEngine:
         # whole-batch dead steps. (With a step in flight, output_ids lags
         # by its horizon; overshoot is frozen out by the device budget.)
         horizon = self.cfg.decode_horizon
+        # TTFT guard: with arrivals waiting (or a chunked prefill mid
+        # flight), keep decode calls short so admission runs soon; the
+        # full horizon is a pure-throughput regime for an empty queue.
+        ah = self.cfg.admission_horizon
+        if ah > 0 and (self._waiting or self._prefillings):
+            horizon = min(horizon, ah)
         rem = max((s.max_total_len - s.prompt_len - len(s.output_ids)
                    for s in self._running.values() if not s.finished),
                   default=horizon)
         if 0 < rem < horizon:
-            horizon = 1 << (rem - 1).bit_length()
-            horizon = min(horizon, self.cfg.decode_horizon)
+            horizon = min(1 << (rem - 1).bit_length(), horizon)
         t0 = time.monotonic()
         self._dstate, packed = self._decode_multi(
             self.params, self._dstate, horizon)
@@ -1848,16 +1853,17 @@ class InferenceEngine:
             # still the live owner of the slot.
             if seq.finished or self._running.get(slot) is not seq:
                 continue
-            tokens: list[int] = []
-            lps: list[Optional[LogProb]] = []
-            for h in range(H):
-                tokens.append(int(packed_np[h, slot, 0]))
-                lps.append(self._make_logprob(
-                    int(packed_np[h, slot, 0]),
-                    float(packed_np[h, slot, 1]),
-                    packed_np[h, slot, 2:2 + K],
-                    packed_np[h, slot, 2 + K:].astype(np.int64),
-                    seq.req.sampling))
+            tokens = packed_np[:, slot, 0].astype(np.int64).tolist()
+            if seq.req.sampling.logprobs:
+                lps: list[Optional[LogProb]] = [
+                    self._make_logprob(
+                        tokens[h], float(packed_np[h, slot, 1]),
+                        packed_np[h, slot, 2:2 + K],
+                        packed_np[h, slot, 2 + K:].astype(np.int64),
+                        seq.req.sampling)
+                    for h in range(H)]
+            else:
+                lps = [None] * H
             seq.context_len += H
             # ONE delta per sequence per horizon (tokens past a stop are
             # discarded inside _emit_tokens).
