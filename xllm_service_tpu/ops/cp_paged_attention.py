@@ -41,8 +41,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .pallas_page_dma import (
     NEG_INF,
+    chunked_page_walk,
     flash_accumulate,
-    make_chunk_dma,
     masked_kv_f32_pos,
     page_chunk_size,
 )
@@ -109,72 +109,63 @@ def _partial_kernel(local_pt_ref, starts_ref, n_local_ref, clens_ref,
                     m_out, l_out, acc_out,
                     k_buf, v_buf, sems, m_scr, l_scr, acc_scr,
                     *, page_size: int, n_kv: int, group: int, scale: float,
-                    max_pages: int, chunk: int):
+                    max_pages: int, chunk: int, pipeline_rows: bool):
     """Flash partial stats over this shard's owned pages only.
 
     local_pt_ref: [B, mp] LOCAL page indices, owned entries compacted to
     the front (n_local_ref[b] of them); starts_ref: [B, mp] each entry's
     global token start (ctx for non-owned → fully masked)."""
     b = pl.program_id(0)
+    nb = pl.num_programs(0)
     ctx = clens_ref[b]
-    n_pages = jnp.minimum(n_local_ref[b], max_pages)
-    n_chunks = pl.cdiv(n_pages, chunk)
+
+    def n_pages_of(row):
+        return jnp.minimum(n_local_ref[row], max_pages)
+
+    n_pages = n_pages_of(b)
 
     m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
     l_scr[...] = jnp.zeros_like(l_scr)
     acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    start_chunk, wait_chunk = make_chunk_dma(
-        local_pt_ref, b, n_pages, chunk, k_hbm, v_hbm, k_buf, v_buf, sems)
+    def compute(c, slot):
+        # Per-row global token positions: compacted pages are not
+        # contiguous, so each page contributes start_j + iota(ps).
+        base = c * chunk
+        rows = []
+        for j in range(chunk):
+            # Chunk-padding entries (base+j >= n_pages) were never
+            # DMA'd — their buffer rows are stale. Position them at
+            # ctx so both masks reject them (clamping the table read
+            # instead would alias a REAL page's positions and let
+            # stale K/V through). (Covers the pipelined walk's whole
+            # pad chunk too: every entry sits past n_pages.)
+            st = jnp.where(
+                base + j < n_pages,
+                starts_ref[b, jnp.minimum(base + j, max_pages - 1)],
+                ctx)
+            rows.append(st + jax.lax.broadcasted_iota(
+                jnp.int32, (1, page_size), 1))
+        pos = jnp.concatenate(rows, axis=0)          # [chunk, ps]
+        span = chunk * page_size
+        pos_row = pos.reshape(1, span)
+        pos_col = pos.reshape(span, 1)
+        mask = pos_row < ctx
+        q = q_ref[0].astype(jnp.float32) * scale     # [n_q, hd]
+        for kv in range(n_kv):
+            qh = q[kv * group:(kv + 1) * group, :]   # [G, hd]
+            k, v = masked_kv_f32_pos(k_buf, v_buf, slot, kv,
+                                     pos_col, ctx)
+            s = jax.lax.dot_general(
+                qh, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [G, span]
+            s = jnp.where(mask, s, _NEG_INF)
+            flash_accumulate(slice(kv * group, (kv + 1) * group),
+                             s, v, m_scr, l_scr, acc_scr)
 
-    @pl.when(n_chunks > 0)
-    def _run():
-        start_chunk(0, 0)
-
-        def body(c, _):
-            slot = jax.lax.rem(c, 2)
-
-            @pl.when(c + 1 < n_chunks)
-            def _prefetch():
-                start_chunk(1 - slot, c + 1)
-
-            wait_chunk(slot, c)
-
-            # Per-row global token positions: compacted pages are not
-            # contiguous, so each page contributes start_j + iota(ps).
-            base = c * chunk
-            rows = []
-            for j in range(chunk):
-                # Chunk-padding entries (base+j >= n_pages) were never
-                # DMA'd — their buffer rows are stale. Position them at
-                # ctx so both masks reject them (clamping the table read
-                # instead would alias a REAL page's positions and let
-                # stale K/V through).
-                st = jnp.where(
-                    base + j < n_pages,
-                    starts_ref[b, jnp.minimum(base + j, max_pages - 1)],
-                    ctx)
-                rows.append(st + jax.lax.broadcasted_iota(
-                    jnp.int32, (1, page_size), 1))
-            pos = jnp.concatenate(rows, axis=0)          # [chunk, ps]
-            span = chunk * page_size
-            pos_row = pos.reshape(1, span)
-            pos_col = pos.reshape(span, 1)
-            mask = pos_row < ctx
-            q = q_ref[0].astype(jnp.float32) * scale     # [n_q, hd]
-            for kv in range(n_kv):
-                qh = q[kv * group:(kv + 1) * group, :]   # [G, hd]
-                k, v = masked_kv_f32_pos(k_buf, v_buf, slot, kv,
-                                         pos_col, ctx)
-                s = jax.lax.dot_general(
-                    qh, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)  # [G, span]
-                s = jnp.where(mask, s, _NEG_INF)
-                flash_accumulate(slice(kv * group, (kv + 1) * group),
-                                 s, v, m_scr, l_scr, acc_scr)
-            return ()
-
-        jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
+    chunked_page_walk(local_pt_ref, b, nb, n_pages, n_pages_of, chunk,
+                      k_hbm, v_hbm, k_buf, v_buf, sems, compute,
+                      pipeline_rows)
 
     m_out[0] = m_scr[...]
     l_out[0] = l_scr[...]
@@ -189,16 +180,22 @@ def _paged_partial_pallas(q, k_pages, v_pages, local_pt, starts, n_local,
 
     XLLM_PAGE_CHUNK is resolved here, OUTSIDE jit, and passed static — a
     shape-keyed cache would silently pin the first-traced chunk."""
+    import os
+
     return _paged_partial_impl(q, k_pages, v_pages, local_pt, starts,
                                n_local, context_lens, scale=scale,
                                chunk=page_chunk_size(local_pt.shape[1]),
+                               pipeline_rows=os.environ.get(
+                                   "XLLM_PAGE_PIPELINE", "") == "row",
                                interpret=interpret)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "chunk", "interpret"))
+                   static_argnames=("scale", "chunk", "pipeline_rows",
+                                    "interpret"))
 def _paged_partial_impl(q, k_pages, v_pages, local_pt, starts, n_local,
                         context_lens, *, scale: float, chunk: int,
+                        pipeline_rows: bool = False,
                         interpret: bool = False):
     B, n_q, hd = q.shape
     _, n_kv, page_size, _ = k_pages.shape
@@ -206,7 +203,8 @@ def _paged_partial_impl(q, k_pages, v_pages, local_pt, starts, n_local,
     group = n_q // n_kv
     kernel = functools.partial(_partial_kernel, page_size=page_size,
                                n_kv=n_kv, group=group, scale=scale,
-                               max_pages=max_pages, chunk=chunk)
+                               max_pages=max_pages, chunk=chunk,
+                               pipeline_rows=pipeline_rows)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B,),

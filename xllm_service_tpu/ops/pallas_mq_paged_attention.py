@@ -30,8 +30,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_page_dma import (
     NEG_INF as _NEG_INF,
+    chunked_page_walk,
     flash_accumulate,
-    make_chunk_dma,
     masked_kv_f32,
     page_chunk_size,
 )
@@ -43,59 +43,48 @@ def _kernel(page_table_ref, prefix_ref, block_ref,    # scalar prefetch
             o_ref,                                    # [1, Sq, n_q, hd]
             k_buf, v_buf, sems, m_scr, l_scr, acc_scr,
             *, page_size: int, n_kv: int, group: int, scale: float,
-            max_pages: int, chunk: int, s_q: int):
+            max_pages: int, chunk: int, s_q: int,
+            pipeline_rows: bool):
     b = pl.program_id(0)
+    nb = pl.num_programs(0)
     prefix = prefix_ref[b]
     blk = block_ref[b]                 # valid queries in this row's block
     ctx = prefix + blk                 # total written context
-    n_pages = jnp.minimum(pl.cdiv(ctx, page_size), max_pages)
-    n_chunks = pl.cdiv(n_pages, chunk)
+
+    def n_pages_of(row):
+        row_ctx = prefix_ref[row] + block_ref[row]
+        return jnp.minimum(pl.cdiv(row_ctx, page_size), max_pages)
 
     m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
     l_scr[...] = jnp.zeros_like(l_scr)
     acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    start_chunk, wait_chunk = make_chunk_dma(
-        page_table_ref, b, n_pages, chunk, k_hbm, v_hbm, k_buf, v_buf,
-        sems)
+    def compute(c, slot):
+        span = chunk * page_size
+        start = c * span
+        # Query s sits at absolute position prefix + s; it may attend
+        # keys at positions <= prefix + s. Rows are (s, g) flattened.
+        key_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (s_q * group, span), 1)
+        q_row_pos = prefix + jax.lax.broadcasted_iota(
+            jnp.int32, (s_q * group, span), 0) // group
+        mask = key_pos <= q_row_pos
+        for kv in range(n_kv):
+            # [Sq, G, hd] -> [Sq*G, hd] query rows for this KV head.
+            qh = q_ref[0, :, kv * group:(kv + 1) * group, :] \
+                .astype(jnp.float32).reshape(s_q * group, -1) * scale
+            k, v = masked_kv_f32(k_buf, v_buf, slot, kv, start, ctx)
+            s = jax.lax.dot_general(
+                qh, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)   # [Sq*G, span]
+            s = jnp.where(mask, s, _NEG_INF)
+            flash_accumulate(
+                slice(kv * s_q * group, (kv + 1) * s_q * group),
+                s, v, m_scr, l_scr, acc_scr)
 
-    @pl.when(n_chunks > 0)
-    def _run():
-        start_chunk(0, 0)
-
-        def body(c, _):
-            slot = jax.lax.rem(c, 2)
-
-            @pl.when(c + 1 < n_chunks)
-            def _prefetch():
-                start_chunk(1 - slot, c + 1)
-
-            wait_chunk(slot, c)
-
-            span = chunk * page_size
-            start = c * span
-            # Query s sits at absolute position prefix + s; it may attend
-            # keys at positions <= prefix + s. Rows are (s, g) flattened.
-            key_pos = start + jax.lax.broadcasted_iota(
-                jnp.int32, (s_q * group, span), 1)
-            q_row_pos = prefix + jax.lax.broadcasted_iota(
-                jnp.int32, (s_q * group, span), 0) // group
-            mask = key_pos <= q_row_pos
-            for kv in range(n_kv):
-                # [Sq, G, hd] -> [Sq*G, hd] query rows for this KV head.
-                qh = q_ref[0, :, kv * group:(kv + 1) * group, :] \
-                    .astype(jnp.float32).reshape(s_q * group, -1) * scale
-                k, v = masked_kv_f32(k_buf, v_buf, slot, kv, start, ctx)
-                s = jax.lax.dot_general(
-                    qh, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)   # [Sq*G, span]
-                s = jnp.where(mask, s, _NEG_INF)
-                flash_accumulate(
-                    slice(kv * s_q * group, (kv + 1) * s_q * group),
-                    s, v, m_scr, l_scr, acc_scr)
-            return ()
-
-        jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
+    chunked_page_walk(page_table_ref, b, nb, n_pages_of(b), n_pages_of,
+                      chunk, k_hbm, v_hbm, k_buf, v_buf, sems, compute,
+                      pipeline_rows)
 
     l = jnp.maximum(l_scr[:, :1], 1e-9)
     out = acc_scr[...] / l                         # [n_kv*Sq*G, hd]
@@ -118,16 +107,23 @@ def mq_paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
     — causal over absolute positions, identical to the XLA
     prefill_attention reference (tested).
 
-    XLLM_PAGE_CHUNK is resolved here, OUTSIDE jit, and passed static — a
-    shape-keyed cache would silently pin the first-traced chunk."""
+    XLLM_PAGE_CHUNK / XLLM_PAGE_PIPELINE are resolved here, OUTSIDE
+    jit, and passed static — a shape-keyed cache would silently pin the
+    first-traced variant."""
+    import os
+
     return _mq_impl(q, k_pages, v_pages, page_table, prefix_lens,
                     block_lens, chunk=page_chunk_size(page_table.shape[1]),
+                    pipeline_rows=os.environ.get(
+                        "XLLM_PAGE_PIPELINE", "") == "row",
                     interpret=interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
+@functools.partial(jax.jit, static_argnames=("chunk", "pipeline_rows",
+                                             "interpret"))
 def _mq_impl(q, k_pages, v_pages, page_table, prefix_lens, block_lens, *,
-             chunk: int, interpret: bool = False) -> jax.Array:
+             chunk: int, pipeline_rows: bool = False,
+             interpret: bool = False) -> jax.Array:
     B, s_q, n_q, hd = q.shape
     _, n_kv, page_size, _ = k_pages.shape
     max_pages = page_table.shape[1]
@@ -135,7 +131,8 @@ def _mq_impl(q, k_pages, v_pages, page_table, prefix_lens, block_lens, *,
     scale = 1.0 / (hd ** 0.5)
     kernel = functools.partial(_kernel, page_size=page_size, n_kv=n_kv,
                                group=group, scale=scale,
-                               max_pages=max_pages, chunk=chunk, s_q=s_q)
+                               max_pages=max_pages, chunk=chunk, s_q=s_q,
+                               pipeline_rows=pipeline_rows)
     rows = n_kv * s_q * group
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
