@@ -15,6 +15,8 @@ the other 80%:
   - matmul_and_rest_ms (derived): forward_only - attention_only — the
     layer matmuls PLUS norms/rope/KV-writeback/dispatch gaps
   - sample_overhead_ms (derived): full_step - forward_only
+  - dispatch_fetch_rtt_ms / upload_32kb_ms: the per-program-call floor
+    on this attachment (relay RTT on tunnel-attached chips)
 
 Prints ONE JSON line. CPU runs validate mechanism only.
 """
@@ -132,6 +134,19 @@ def main() -> None:
 
     result["sampling_only_ms"] = round(bench_fn(
         jax.jit(samp), logits, keys, clens), 3)
+
+    # 5. Per-call overhead floor on this attachment (tunnel-attached
+    # chips pay a relay RTT per dispatch+fetch; serving pays it per
+    # horizon call and ~3x per admission).
+    tiny = jnp.zeros((8,), jnp.float32)
+    bump = jax.jit(lambda x: x + 1)
+    result["dispatch_fetch_rtt_ms"] = round(bench_fn(bump, tiny), 3)
+    up = np.zeros((8192,), np.int32)   # ~an admission's packed upload
+
+    def upload(_):
+        return jax.device_put(up)
+
+    result["upload_32kb_ms"] = round(bench_fn(upload, None), 3)
 
     # Derived attribution.
     result["matmul_and_rest_ms"] = round(
