@@ -456,6 +456,37 @@ class TestKVPageManager:
         mgr.release_prefix(stored)
 
 
+class TestAdaptiveHorizon:
+    def test_short_calls_while_waiting_full_when_idle(self):
+        """With admission_horizon set, decode calls shrink while requests
+        queue (so admission isn't blocked behind a long lax.scan) and
+        recover to the full horizon once the queue drains."""
+        engine = make_engine(decode_horizon=8, admission_horizon=2,
+                             max_batch_size=1)   # one slot: forces a queue
+        horizons = []
+        real = engine._decode_multi
+
+        def spy(params, d, horizon):
+            horizons.append((horizon, len(engine._waiting)))
+            return real(params, d, horizon)
+
+        engine._decode_multi = spy
+        cols = [Collector(), Collector()]
+        reqs = [EngineRequest(
+            f"ah{i}", token_ids=list(range(10 + 30 * i, 26 + 30 * i)),
+            sampling=SamplingParams(max_tokens=24, temperature=0.0,
+                                    ignore_eos=True), on_output=c)
+            for i, c in enumerate(cols)]
+        run_requests(engine, reqs)
+        assert all(len(c.tokens) == 24 for c in cols)
+        # Calls made while the second request queued must be short; calls
+        # with an empty queue run the full horizon.
+        waiting_calls = [h for h, w in horizons if w > 0]
+        idle_calls = [h for h, w in horizons if w == 0]
+        assert waiting_calls and all(h <= 2 for h in waiting_calls)
+        assert any(h == 8 for h in idle_calls)
+
+
 class TestDeviceBudgetFreeze:
     def test_mixed_budgets_exact_outputs(self):
         """Per-slot budgets are enforced ON DEVICE (slot freezes at
