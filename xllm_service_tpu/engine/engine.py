@@ -178,9 +178,26 @@ class InferenceEngine:
             # Random init (benchmarks / tests); real weights come through
             # models/loader.py and are passed in pre-sharded.
             rng = jax.random.PRNGKey(cfg.seed)
-            params = self.family.init_params(mcfg, rng)
-            if mcfg.quant:
-                params = self._quantize(params, mcfg)
+            try:
+                cpu = (jax.devices("cpu")[0]
+                       if jax.default_backend() != "cpu" else None)
+            except RuntimeError:   # no host platform registered
+                cpu = None
+            if mcfg.quant and cpu is not None:
+                # Quantized init must not materialize the bf16 tree on
+                # the accelerator first — an 8B model is 16 GB bf16,
+                # i.e. the whole chip, and OOMs before quantize ever
+                # runs. Build + quantize on host, upload int8.
+                with jax.default_device(cpu):
+                    params = self.family.init_params(mcfg, rng)
+                    params = self._quantize(params, mcfg)
+                dev = jax.devices()[0]
+                params = jax.tree.map(
+                    lambda a: jax.device_put(a, dev), params)
+            else:
+                params = self.family.init_params(mcfg, rng)
+                if mcfg.quant:
+                    params = self._quantize(params, mcfg)
             if self.mesh is not None:
                 params = shard_params(params, self.mesh,
                                       self.family.sharding_rules)
