@@ -285,10 +285,18 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         mask = mask & (rows - cols < window)
     ss = jnp.where(mask[:, None, :, :], ss, _NEG_INF)
 
-    has_prefix = k_pages is not None
-    if has_prefix:
-        pk = _repeat_kv(gather_pages(k_pages, page_table), n_rep).astype(jnp.float32)
-        pv = _repeat_kv(gather_pages(v_pages, page_table), n_rep).astype(jnp.float32)
+    def _suffix_only(_):
+        probs = jax.nn.softmax(ss, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+
+    if k_pages is None:
+        return _suffix_only(None).astype(q.dtype)
+
+    def _with_prefix(_):
+        pk = _repeat_kv(gather_pages(k_pages, page_table),
+                        n_rep).astype(jnp.float32)
+        pv = _repeat_kv(gather_pages(v_pages, page_table),
+                        n_rep).astype(jnp.float32)
         T = pk.shape[1]
         ps_scores = cap(jnp.einsum("bqhd,bkhd->bhqk", qf, pk))
         pmask = (jnp.arange(T)[None, :] < prefix_lens[:, None])  # [B, T]
@@ -304,12 +312,16 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         ps_scores = jnp.where(pmask[:, None, :, :], ps_scores, _NEG_INF)
         scores = jnp.concatenate([ps_scores, ss], axis=-1)
         values = jnp.concatenate([pv, vf], axis=1)
-    else:
-        scores = ss
-        values = vf
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, values)
 
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, values)
+    # The prefix term gathers the row's whole page span and scores
+    # against it — real bandwidth and FLOPs that a no-cache-hit prefill
+    # (prefix 0, the common serving admission) would spend entirely on
+    # fully-masked keys. Runtime-branch it: XLA compiles both sides, the
+    # device executes only the live one.
+    out = jax.lax.cond(jnp.any(prefix_lens > 0), _with_prefix,
+                       _suffix_only, operand=None)
     return out.astype(q.dtype)
 
 
