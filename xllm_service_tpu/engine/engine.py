@@ -316,8 +316,10 @@ class InferenceEngine:
         # Async decode pipeline: the last dispatched decode whose results
         # have not been fetched yet — (packed, t_dispatch, horizon,
         # {slot: seq} snapshot). Host-side output processing of step N
-        # overlaps the device executing step N+1.
+        # overlaps the device executing step N+1. The speculative path
+        # keeps its own pending slot with the same discipline.
         self._pending_decode: Optional[tuple] = None
+        self._pending_spec: Optional[tuple] = None
 
     # ---------------------------------------------------------- properties
     @property
@@ -1019,6 +1021,7 @@ class InferenceEngine:
         # A pending pipelined decode holds buffers from the failed/donated
         # device state — drop it without fetching.
         self._pending_decode = None
+        self._pending_spec = None
         with self._lock:
             waiting = list(self._waiting)
             self._waiting.clear()
@@ -1798,15 +1801,16 @@ class InferenceEngine:
     # -------------------------------------------------------------- decode
     def _decode(self) -> bool:
         if not self._running:
-            # No live batch: flush the tail of the pipeline if one is
-            # still in flight.
-            return self._drain_pending_decode()
+            # No live batch: flush the tail of either pipeline.
+            drained = self._drain_pending_decode()
+            return self._drain_pending_spec() or drained
         if self._spec_multi is not None and self._spec_worthwhile():
-            # The speculative path reads accepted counts synchronously;
-            # keep it un-pipelined but never interleaved with a pending
-            # plain step.
+            # Switching paths costs one sync: a pending PLAIN step must
+            # drain before a spec round dispatches (and vice versa) so
+            # the two pipelines never interleave on stale state.
             self._drain_pending_decode()
             return self._decode_speculative()
+        self._drain_pending_spec()
         # Bound the horizon by the LONGEST remaining token budget among
         # running sequences (pow2 ceiling, so the compile cache stays at
         # log2(decode_horizon) variants). Per-sequence budgets are
@@ -1914,26 +1918,49 @@ class InferenceEngine:
         exactly one per cycle — the same rate as a decode horizon of
         speculate_cycles — so a mixed batch never pays for its
         neighbors' speculation."""
-        K = self.cfg.speculate_k
-        Klp = self.cfg.max_top_logprobs
         B = self.cfg.max_batch_size
         C = self.cfg.speculate_cycles
         room = np.zeros((B,), np.int32)
         for slot, seq in self._running.items():
             if seq.finished:
                 continue
+            # With a spec round in flight, output_ids lags one round —
+            # the overshoot this allows is discarded by _emit_tokens at
+            # the budget and its KV lands on the garbage page.
             room[slot] = max(
                 0, seq.max_total_len - seq.prompt_len - len(seq.output_ids))
         n_seqs = sum(1 for s in self._running.values() if not s.finished)
         t0 = time.monotonic()
         self._dstate, packed = self._spec_multi(
             self.params, self._dstate, jnp.asarray(room), C)
+        snapshot = {slot: seq for slot, seq in self._running.items()
+                    if not seq.finished}
+        prev, self._pending_spec = (self._pending_spec,
+                                    (packed, t0, C, snapshot, n_seqs))
+        if prev is not None:
+            self._drain_one_spec(prev)
+        return True
+
+    def _drain_pending_spec(self) -> bool:
+        pend, self._pending_spec = self._pending_spec, None
+        if pend is None:
+            return False
+        self._drain_one_spec(pend)
+        return True
+
+    def _drain_one_spec(self, pend: tuple) -> None:
+        packed, t0, C, snapshot, n_seqs = pend
+        K = self.cfg.speculate_k
+        Klp = self.cfg.max_top_logprobs
         out = self._fetch(packed)            # [C, B, 1 + (K+1) + 1 + 2Klp]
         elapsed = time.monotonic() - t0
 
         emitted = 0
-        for slot, seq in list(self._running.items()):
-            if seq.finished:
+        for slot, seq in snapshot.items():
+            # Same ownership discipline as the plain pipeline: the slot
+            # may have finished, been cancelled, or been reused since
+            # this round was dispatched.
+            if seq.finished or self._running.get(slot) is not seq:
                 continue
             for c in range(C):
                 if seq.finished:
@@ -1960,11 +1987,10 @@ class InferenceEngine:
         per_seq = emitted / max(1, n_seqs)
         ms_per_tok = elapsed * 1000 / max(1.0, per_seq)
         self.recent_max_tbt_ms = max(self.recent_max_tbt_ms, ms_per_tok)
-        live = [s for s in self._running.values() if not s.finished]
+        live = [s for s in snapshot.values() if not s.finished]
         if live:
             self.tpot_samples.append(
                 (len(live), sum(s.context_len for s in live), ms_per_tok))
-        return True
 
     # ----------------------------------------------------------- emission
     # Finalized-context window for the incremental diff: the tail is
