@@ -914,6 +914,12 @@ class InferenceEngine:
             self._lock.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        # Quiesce: an in-flight pipelined round whose sequences have all
+        # finished carries nothing deliverable (finished slots are
+        # skipped at drain); drop it so a stopped engine holds no device
+        # futures.
+        self._pending_decode = None
+        self._pending_spec = None
 
     # ---------------------------------------------------------------- API
     def submit(self, req: EngineRequest) -> None:
