@@ -313,6 +313,43 @@ class TestEcho:
         assert texts[0] == "echo this prompt"
 
 
+class TestNChoices:
+    def test_n_choices_end_to_end(self, cluster):
+        """n=2 fans out into two engine sequences on one replica (the
+        prefix cache dedupes the shared prompt through burst admission's
+        flush) and the response carries both choices, greedy-identical."""
+        master, agent = cluster
+        base = _base(master)
+        r = requests.post(base + "/v1/completions", json={
+            "model": "tiny-llama", "prompt": [11, 12, 13, 14, 15] * 8,
+            "max_tokens": 6, "temperature": 0, "ignore_eos": True,
+            "n": 2}, timeout=120)
+        assert r.status_code == 200, r.text
+        choices = r.json()["choices"]
+        assert len(choices) == 2
+        assert {c["index"] for c in choices} == {0, 1}
+        # Greedy: both choices decode the same continuation.
+        assert choices[0]["text"] == choices[1]["text"]
+        assert all(c["finish_reason"] == "length" for c in choices)
+        usage = r.json()["usage"]
+        assert usage["completion_tokens"] == 12   # 6 per choice
+
+    def test_n_choices_distinct_when_sampled(self, cluster):
+        master, agent = cluster
+        base = _base(master)
+        r = requests.post(base + "/v1/completions", json={
+            "model": "tiny-llama", "prompt": [21, 22, 23, 24] * 6,
+            "max_tokens": 8, "temperature": 1.3, "seed": 7,
+            "ignore_eos": True, "n": 2}, timeout=120)
+        assert r.status_code == 200, r.text
+        choices = r.json()["choices"]
+        assert len(choices) == 2
+        # Seeded sampling: per-choice seeds differ (seed, seed+1), so the
+        # streams are deterministic but (with high probability at this
+        # temperature and vocab) not identical.
+        assert choices[0]["text"] != choices[1]["text"]
+
+
 class TestAnthropicMessages:
     def test_messages_non_stream(self, cluster):
         """Anthropic Messages API over the chat pipeline (the reference
