@@ -137,6 +137,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # 13. serve bench, second boot (persistent-compile-cache warmup check)
     run_step serve_warm 1800 python benchmarks/serve_bench.py \
       || { sleep 60; continue; }
+    # Digest everything for BASELINE.md / the next round.
+    python benchmarks/summarize_sweep.py tpu_results \
+      > tpu_results/summary.md 2>/dev/null || true
     if [ -n "$FAILED_STEPS" ]; then
       echo "=== sweep finished at $(date) with FAILED steps:$FAILED_STEPS ==="
       exit 2

@@ -19,6 +19,14 @@ become Pallas/XLA"). Design points for XLA and for remote-attached chips:
 - **Prefix cache**: longest block-aligned cached prefix is reused (pages
   shared, suffix-only prefill); completed blocks are donated back and
   reported as KvCacheEvents (feeds cluster-wide cache-aware routing).
+- **Pipelined loop**: decode/spec round N+1 dispatches before round N's
+  results are fetched (host emit hides behind device compute; snapshot
+  ownership guards slot reuse), and a burst of arrivals dispatches every
+  prefill install into the device queue before fetching any result.
+- **Per-slot budgets on device**: a slot freezes at its max_total_len
+  like a stop-token hit, so the batch horizon follows the LONGEST
+  remaining budget; while requests wait, calls shrink to
+  admission_horizon (TTFT guard), full decode_horizon when idle.
 - Inactive batch slots write K/V to the reserved garbage page 0; a dead
   slot's device page-table row is cleared before its pages are recycled.
 """
