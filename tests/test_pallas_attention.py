@@ -63,6 +63,24 @@ class TestPallasPagedAttention:
                                            np.asarray(ref[b]),
                                            rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("opts", [
+        {"softcap": 30.0},                       # gemma-2 logit cap
+        {"window": 40},                          # sliding-window layer
+        {"scale": 0.0883883},                    # query_pre_attn_scalar
+        {"softcap": 50.0, "window": 33, "scale": 0.0625},
+    ])
+    def test_gemma2_options_match_xla(self, opts):
+        """softcap / sliding window / explicit query scale are static
+        kernel params now — gemma-2 decode must route through the kernel
+        with XLA-exact numerics."""
+        q, k_pages, v_pages, pt = _setup()
+        cl = jnp.asarray([96, 41, 8, 64], jnp.int32)
+        ref = paged_attention_xla(q, k_pages, v_pages, pt, cl, **opts)
+        got = paged_attention_pallas(q, k_pages, v_pages, pt, cl,
+                                     interpret=True, **opts)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_gqa_grouping(self):
         q, k_pages, v_pages, pt = _setup(n_q=16, n_kv=2)
         cl = jnp.asarray([40, 96, 8, 64], jnp.int32)

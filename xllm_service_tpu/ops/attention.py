@@ -481,12 +481,16 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                                   context_lens, mesh, seq_axis=seq_axis,
                                   scale=scale)
 
-    if (softcap == 0.0 and window == 0 and scale is None
-            and _mosaic_kernel_ok(q, k_pages)):
+    if _mosaic_kernel_ok(q, k_pages):
         from .pallas_paged_attention import paged_attention_pallas
 
+        # softcap/window/scale are static kernel params (gemma-2 decodes
+        # through the kernel too — the XLA fallback gathers every row's
+        # FULL page span dense per layer per step).
         return paged_attention_pallas(q, k_pages, v_pages, page_table,
                                       context_lens,
-                                      interpret=_pallas_interpret())
+                                      interpret=_pallas_interpret(),
+                                      scale=scale, softcap=softcap,
+                                      window=window)
     return paged_attention_xla(q, k_pages, v_pages, page_table, context_lens,
                                scale=scale, softcap=softcap, window=window)

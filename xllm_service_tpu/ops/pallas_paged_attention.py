@@ -47,7 +47,8 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
             k_buf, v_buf, sems,                 # scratch: 2-slot chunk ring
             m_scr, l_scr, acc_scr,
             *, page_size: int, n_kv: int, group: int, scale: float,
-            max_pages: int, chunk: int, pipeline_rows: bool):
+            max_pages: int, chunk: int, pipeline_rows: bool,
+            softcap: float, window: int):
     b = pl.program_id(0)
     nb = pl.num_programs(0)
     ctx = context_lens_ref[b]
@@ -66,6 +67,10 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
         token_pos = start + jax.lax.broadcasted_iota(
             jnp.int32, (1, span), 1)
         mask = token_pos < ctx
+        if window > 0:
+            # gemma-2 sliding window: the query sits at position ctx-1,
+            # so visible keys are >= ctx - window (matches the XLA path).
+            mask &= token_pos >= ctx - window
         q = q_ref[0].astype(jnp.float32) * scale           # [n_q, hd]
         for kv in range(n_kv):
             qh = q[kv * group:(kv + 1) * group, :]         # [G, hd]
@@ -73,6 +78,8 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
             s = jax.lax.dot_general(
                 qh, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)        # [G, span]
+            if softcap > 0.0:
+                s = softcap * jnp.tanh(s / softcap)
             s = jnp.where(mask, s, _NEG_INF)
             flash_accumulate(slice(kv * group, (kv + 1) * group),
                              s, v, m_scr, l_scr, acc_scr)
@@ -88,10 +95,17 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
 def paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, page_table: jax.Array,
                            context_lens: jax.Array,
-                           interpret: bool = False) -> jax.Array:
+                           interpret: bool = False,
+                           scale: float | None = None,
+                           softcap: float = 0.0,
+                           window: int = 0) -> jax.Array:
     """q: [B, n_q, hd]; k/v_pages: [pages, n_kv, ps, hd];
     page_table: [B, max_pages] i32; context_lens: [B] i32 (incl. the new
     token, whose K/V must already be written). Returns [B, n_q, hd].
+
+    scale/softcap/window cover the gemma-2 extras (explicit query scale,
+    score soft-capping, sliding window) so that family decodes through
+    this kernel instead of the full-span XLA gather.
 
     Env knobs are resolved HERE (outside jit) and passed as static args —
     a jit cache keyed only on shapes would silently pin the first-traced
@@ -106,26 +120,35 @@ def paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
     return _paged_attention_impl(q, k_pages, v_pages, page_table,
                                  context_lens, chunk=chunk,
                                  pipeline_rows=pipeline_rows,
+                                 scale=(float(scale)
+                                        if scale is not None else None),
+                                 softcap=float(softcap),
+                                 window=int(window),
                                  interpret=interpret)
 
 
 @functools.partial(jax.jit, static_argnames=("chunk", "pipeline_rows",
+                                             "scale", "softcap", "window",
                                              "interpret"))
 def _paged_attention_impl(q: jax.Array, k_pages: jax.Array,
                           v_pages: jax.Array, page_table: jax.Array,
                           context_lens: jax.Array, *, chunk: int,
                           pipeline_rows: bool,
+                          scale: float | None = None,
+                          softcap: float = 0.0, window: int = 0,
                           interpret: bool = False) -> jax.Array:
     B, n_q, hd = q.shape
     _, n_kv, page_size, _ = k_pages.shape
     max_pages = page_table.shape[1]
     group = n_q // n_kv
-    scale = 1.0 / (hd ** 0.5)
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
 
     kernel = functools.partial(_kernel, page_size=page_size, n_kv=n_kv,
                                group=group, scale=scale,
                                max_pages=max_pages, chunk=chunk,
-                               pipeline_rows=pipeline_rows)
+                               pipeline_rows=pipeline_rows,
+                               softcap=softcap, window=window)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
