@@ -456,6 +456,61 @@ class TestKVPageManager:
         mgr.release_prefix(stored)
 
 
+class TestPenalties:
+    def test_strong_frequency_penalty_never_repeats(self):
+        """With a huge frequency penalty every emitted (and prompt) token
+        gets a massive logit cut, so greedy decode must never repeat a
+        token — exercises the with-counts install variant + the device
+        count updates end-to-end."""
+        engine = make_engine()
+        prompt = [7, 8, 9, 7, 8, 9, 7, 8, 9]
+        col = Collector()
+        run_requests(engine, [EngineRequest(
+            "fp", token_ids=list(prompt),
+            sampling=SamplingParams(max_tokens=12, temperature=0.0,
+                                    frequency_penalty=100.0,
+                                    ignore_eos=True),
+            on_output=col)])
+        assert len(col.tokens) == 12
+        assert len(set(col.tokens)) == 12, col.tokens       # no repeats
+        assert not (set(col.tokens) & set(prompt))          # no prompt toks
+
+    def test_counts_variant_routing(self):
+        """Penalty-free requests use the no-counts install program (no
+        dense [V] histogram upload); penalty requests use the with-counts
+        one."""
+        engine = make_engine()
+        used = {"counts": 0, "nc": 0}
+        real_c, real_nc = engine._prefill_install, engine._prefill_install_nc
+
+        def spy_c(*a, **k):
+            used["counts"] += 1
+            return real_c(*a, **k)
+
+        def spy_nc(*a, **k):
+            used["nc"] += 1
+            return real_nc(*a, **k)
+
+        engine._prefill_install = spy_c
+        engine._prefill_install_nc = spy_nc
+        cols = [Collector(), Collector()]
+        run_requests(engine, [
+            EngineRequest("plain", token_ids=list(range(10, 20)),
+                          sampling=SamplingParams(max_tokens=2,
+                                                  temperature=0.0,
+                                                  ignore_eos=True),
+                          on_output=cols[0]),
+            EngineRequest("pen", token_ids=list(range(30, 40)),
+                          sampling=SamplingParams(max_tokens=2,
+                                                  temperature=0.0,
+                                                  presence_penalty=0.5,
+                                                  ignore_eos=True),
+                          on_output=cols[1]),
+        ])
+        assert used == {"counts": 1, "nc": 1}
+        assert all(len(c.tokens) == 2 for c in cols)
+
+
 class TestAdaptiveHorizon:
     def test_short_calls_while_waiting_full_when_idle(self):
         """With admission_horizon set, decode calls shrink while requests

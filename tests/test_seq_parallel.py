@@ -64,13 +64,19 @@ class TestSeqParallelPrefill:
         assert sp_engine.seq_parallel == 4
         assert sp_engine._prefill_install_sp is not None
         used = {"sp": 0}
-        real = sp_engine._prefill_install_sp
+        real, real_nc = (sp_engine._prefill_install_sp,
+                         sp_engine._prefill_install_sp_nc)
 
         def spy(*a, **k):
             used["sp"] += 1
             return real(*a, **k)
 
+        def spy_nc(*a, **k):
+            used["sp"] += 1
+            return real_nc(*a, **k)
+
         sp_engine._prefill_install_sp = spy
+        sp_engine._prefill_install_sp_nc = spy_nc
         got = run_one(sp_engine, prompt)
         assert used["sp"] == 1, "ring-attention program was not used"
         assert got == want
@@ -78,13 +84,19 @@ class TestSeqParallelPrefill:
     def test_short_prompt_uses_standard_path(self):
         sp_engine = InferenceEngine(make_cfg(mesh=MeshConfig(seq=4)))
         used = {"sp": 0}
-        real = sp_engine._prefill_install_sp
+        real, real_nc = (sp_engine._prefill_install_sp,
+                         sp_engine._prefill_install_sp_nc)
 
         def spy(*a, **k):
             used["sp"] += 1
             return real(*a, **k)
 
+        def spy_nc(*a, **k):
+            used["sp"] += 1
+            return real_nc(*a, **k)
+
         sp_engine._prefill_install_sp = spy
+        sp_engine._prefill_install_sp_nc = spy_nc
         single = InferenceEngine(make_cfg())
         prompt = list(range(20, 50))   # 30 tokens < sp_min
         assert run_one(sp_engine, prompt) == run_one(single, prompt)

@@ -435,21 +435,28 @@ class InferenceEngine:
 
         V = mcfg.vocab_size
 
-        def make_prefill_install(use_ring: bool):
+        def make_prefill_install(use_ring: bool, with_counts: bool):
             """Prefill one sequence + install it into batch slot `slot`.
 
             packed_in: ONE int32 upload (host↔device roundtrips are the
             dominant admission cost on remote-attached chips), laid out as
-            [tokens(S) | ints(P+5+NS+NB) | floats_bits(6+NB) | counts(V) |
-            key(2)] where ints = [page_row(P), slot, prefix_len, seq_len,
-            want_logprobs, stop_ids(NS), bias_ids(NB), budget], floats
-            (temperature, top_k, top_p, freq, pres, rep, bias_vals(NB))
-            are f32 bit-cast to i32, and key is the uint32 PRNG key.
+            [tokens(S) | ints(P+5+NS+NB) | floats_bits(6+NB) |
+            counts(V if with_counts else 0) | key(2)] where ints =
+            [page_row(P), slot, prefix_len, seq_len, want_logprobs,
+            stop_ids(NS), bias_ids(NB), budget], floats (temperature,
+            top_k, top_p, freq, pres, rep, bias_vals(NB)) are f32
+            bit-cast to i32, and key is the uint32 PRNG key.
             mm: [1, M, D] visual embeddings (VL family; dummy otherwise).
 
             use_ring: trace the suffix self-attention as ring attention
             over the mesh's seq axis (context parallelism; the caller only
             routes prefix-free long prompts here).
+
+            with_counts: the dense [V] prompt-token histogram feeds only
+            the frequency/presence/repetition penalties; requests without
+            them (the common case) use the variant that neither uploads
+            nor stores it — at 128k vocab the dense row is a ~0.5 MB
+            upload per admission, pure waste for greedy traffic.
             """
 
             @partial(jax.jit, donate_argnums=(1,))
@@ -460,7 +467,8 @@ class InferenceEngine:
                 NS, NB = NUM_STOP_IDS, NUM_BIAS
                 n_ints = P + 4 + NS + NB + 1   # +1: token budget
                 n_floats = 6 + NB
-                tail = n_ints + n_floats + V + 2
+                n_counts = V if with_counts else 0
+                tail = n_ints + n_floats + n_counts + 2
                 if is_vl:
                     # VL layout adds [pos3(3S) | mrope_delta(1)] after the
                     # tokens: M-RoPE position ids are host-computed (they
@@ -477,8 +485,13 @@ class InferenceEngine:
                 floats = jax.lax.bitcast_convert_type(
                     packed_in[base + n_ints:base + n_ints + n_floats],
                     jnp.float32)
-                counts_row = packed_in[base + n_ints + n_floats:
-                                       base + n_ints + n_floats + V]
+                if with_counts:
+                    counts_row = packed_in[base + n_ints + n_floats:
+                                           base + n_ints + n_floats + V]
+                else:
+                    # Penalties disabled for this request: the histogram
+                    # is never read by sampling, only stored.
+                    counts_row = jnp.zeros((V,), jnp.int32)
                 key = jax.lax.bitcast_convert_type(packed_in[-2:],
                                                    jnp.uint32)
                 page_row = ints[:P]
@@ -560,11 +573,16 @@ class InferenceEngine:
 
             return prefill_install
 
-        self._prefill_install = make_prefill_install(False)
+        self._prefill_install = make_prefill_install(False, True)
+        self._prefill_install_nc = make_prefill_install(False, False)
         # Ring-attention variant for long prefix-free prompts, only when
         # the mesh actually has a seq axis to shard over.
         self._prefill_install_sp = (
-            make_prefill_install(True) if self.seq_parallel > 1 else None)
+            make_prefill_install(True, True)
+            if self.seq_parallel > 1 else None)
+        self._prefill_install_sp_nc = (
+            make_prefill_install(True, False)
+            if self.seq_parallel > 1 else None)
 
         self._spec_multi = None
         spec_on = cfg.speculate_k > 0 and fam.verify_forward is not None
@@ -884,23 +902,30 @@ class InferenceEngine:
             if self.cfg.model_family == "qwen2_vl":
                 # VL layout: [pos3(3S) | mrope_delta(1)] after the tokens.
                 head.append(np.zeros((3 * S + 1,), np.int32))
-            packed_in = jnp.asarray(np.concatenate([
-                *head, ints, floats.view(np.int32),
-                np.zeros((mcfg.vocab_size,), np.int32),
-                np.zeros((2,), np.int32)]))
-            progs = [self._prefill_install]
+            packed_by_counts = {
+                True: jnp.asarray(np.concatenate([
+                    *head, ints, floats.view(np.int32),
+                    np.zeros((mcfg.vocab_size,), np.int32),
+                    np.zeros((2,), np.int32)])),
+                False: jnp.asarray(np.concatenate([
+                    *head, ints, floats.view(np.int32),
+                    np.zeros((2,), np.int32)])),
+            }
+            progs = [(self._prefill_install, True, True),
+                     (self._prefill_install_nc, False, True)]
             if (self._prefill_install_sp is not None
                     and S % self.seq_parallel == 0
                     and S >= self.cfg.seq_parallel_min_tokens):
-                progs.append(self._prefill_install_sp)
-            for prog in progs:
+                progs.append((self._prefill_install_sp, True, False))
+                progs.append((self._prefill_install_sp_nc, False, False))
+            for prog, with_counts, plain in progs:
                 # The SP route never carries images (_sp_applicable), so
-                # only the plain install program warms the image variant.
-                variants = (mm_shapes if prog is self._prefill_install
-                            else mm_shapes[:1])
+                # only the plain install programs warm the image variant.
+                variants = mm_shapes if plain else mm_shapes[:1]
                 for mm in variants:
-                    self._dstate, packed = prog(self.params, self._dstate,
-                                                packed_in, mm)
+                    self._dstate, packed = prog(
+                        self.params, self._dstate,
+                        packed_by_counts[with_counts], mm)
                     self._fetch(packed)      # see the decode-loop comment
                     self._dstate = self._clear_slot(self._dstate, 0)
         # The admission path's host-side RNG split is its own compile.
@@ -1770,10 +1795,20 @@ class InferenceEngine:
                         sp.repetition_penalty if sp.repetition_penalty > 0
                         else 1.0], np.float32),
             bias_vals])
-        counts_row = np.bincount(
-            np.asarray(prompt, np.int64),
-            minlength=cfg.model.vocab_size)[:cfg.model.vocab_size] \
-            .astype(np.int32)
+        # The dense [V] histogram feeds only the penalty terms; greedy /
+        # penalty-free traffic (the common case) skips both the host
+        # bincount and the ~V*4-byte upload via the no-counts program
+        # variant.
+        needs_counts = (sp.frequency_penalty != 0.0
+                        or sp.presence_penalty != 0.0
+                        or sp.repetition_penalty not in (0.0, 1.0))
+        if needs_counts:
+            counts_row = np.bincount(
+                np.asarray(prompt, np.int64),
+                minlength=cfg.model.vocab_size)[:cfg.model.vocab_size] \
+                .astype(np.int32)
+        else:
+            counts_row = np.zeros((0,), np.int32)
         self._rng, slot_key = jax.random.split(self._rng)
         if sp.seed is not None:
             slot_key = jax.random.PRNGKey(sp.seed)
@@ -1793,9 +1828,12 @@ class InferenceEngine:
         packed_in = np.concatenate([
             *head, ints, floats.view(np.int32), counts_row,
             np.asarray(slot_key).view(np.int32).reshape(-1)[:2]])
-        prog = (self._prefill_install_sp
-                if self._sp_applicable(len(suffix), matched, seq.req)
-                else self._prefill_install)
+        if self._sp_applicable(len(suffix), matched, seq.req):
+            prog = (self._prefill_install_sp if needs_counts
+                    else self._prefill_install_sp_nc)
+        else:
+            prog = (self._prefill_install if needs_counts
+                    else self._prefill_install_nc)
         self._dstate, packed = prog(
             self.params, self._dstate, jnp.asarray(packed_in), mm_arr)
         return packed
