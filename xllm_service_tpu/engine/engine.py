@@ -454,9 +454,11 @@ class InferenceEngine:
 
             with_counts: the dense [V] prompt-token histogram feeds only
             the frequency/presence/repetition penalties; requests without
-            them (the common case) use the variant that neither uploads
-            nor stores it — at 128k vocab the dense row is a ~0.5 MB
-            upload per admission, pure waste for greedy traffic.
+            them (the common case) use the variant that skips the upload
+            and installs a ZEROED row instead (the store is load-bearing:
+            it clears the previous slot occupant's counts) — at 128k
+            vocab the dense row is a ~0.5 MB upload per admission, pure
+            waste for greedy traffic.
             """
 
             @partial(jax.jit, donate_argnums=(1,))
@@ -805,7 +807,15 @@ class InferenceEngine:
                 ints[P + 4 + NUM_STOP_IDS:
                      P + 4 + NUM_STOP_IDS + NUM_BIAS])
             d["bias_vals"] = d["bias_vals"].at[slot].set(floats[6:])
-            d["counts"] = d["counts"].at[slot].set(counts_row)
+            # counts_row arrives length-V (penalty request) or length-0
+            # (penalty-free: jit specializes per shape, so this is a
+            # static branch); the zero-store clears the previous slot
+            # occupant's histogram either way.
+            if counts_row.shape[0]:
+                d["counts"] = d["counts"].at[slot].set(counts_row)
+            else:
+                d["counts"] = d["counts"].at[slot].set(
+                    jnp.zeros((d["counts"].shape[1],), jnp.int32))
             d["mrope_delta"] = d["mrope_delta"].at[slot].set(
                 ints[P + 4 + NUM_STOP_IDS + NUM_BIAS])
             d["budget"] = d["budget"].at[slot].set(
@@ -1653,10 +1663,18 @@ class InferenceEngine:
                         sp.repetition_penalty if sp.repetition_penalty > 0
                         else 1.0], np.float32),
             bias_vals])
-        counts_row = np.bincount(
-            np.asarray(prompt + [first_token], np.int64),
-            minlength=cfg.model.vocab_size)[:cfg.model.vocab_size] \
-            .astype(np.int32)
+        # Same penalty-free cut as the main admission path: the dense
+        # histogram is only read by the penalty terms. A length-0 row
+        # selects the jit shape-specialization that stores zeros.
+        if (sp.frequency_penalty != 0.0 or sp.presence_penalty != 0.0
+                or (sp.repetition_penalty > 0.0
+                    and sp.repetition_penalty != 1.0)):
+            counts_row = np.bincount(
+                np.asarray(prompt + [first_token], np.int64),
+                minlength=cfg.model.vocab_size)[:cfg.model.vocab_size] \
+                .astype(np.int32)
+        else:
+            counts_row = np.zeros((0,), np.int32)
         self._rng, slot_key = jax.random.split(self._rng)
         if sp.seed is not None:
             slot_key = jax.random.PRNGKey(sp.seed)
@@ -1799,9 +1817,12 @@ class InferenceEngine:
         # penalty-free traffic (the common case) skips both the host
         # bincount and the ~V*4-byte upload via the no-counts program
         # variant.
+        # rep is ACTIVE only when > 0 and != 1 — the float upload coerces
+        # rep <= 0 to 1.0 (disabled); keep the two rules identical.
         needs_counts = (sp.frequency_penalty != 0.0
                         or sp.presence_penalty != 0.0
-                        or sp.repetition_penalty not in (0.0, 1.0))
+                        or (sp.repetition_penalty > 0.0
+                            and sp.repetition_penalty != 1.0))
         if needs_counts:
             counts_row = np.bincount(
                 np.asarray(prompt, np.int64),
