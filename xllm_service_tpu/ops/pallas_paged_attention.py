@@ -84,9 +84,20 @@ def _kernel(page_table_ref, context_lens_ref,   # scalar prefetch (SMEM)
             flash_accumulate(slice(kv * group, (kv + 1) * group),
                              s, v, m_scr, l_scr, acc_scr)
 
+    if window > 0:
+        # Sliding window: pages wholly below ctx - window are never
+        # visible — start the walk at the first visible page's chunk.
+        def c_lo_of(row):
+            first = jnp.maximum(context_lens_ref[row] - window, 0)
+            return (first // page_size) // chunk
+
+        c_lo, c_lo_fn = c_lo_of(b), c_lo_of
+    else:
+        c_lo, c_lo_fn = None, None
+
     chunked_page_walk(page_table_ref, b, nb, n_pages_of(b), n_pages_of,
                       chunk, k_hbm, v_hbm, k_buf, v_buf, sems, compute,
-                      pipeline_rows)
+                      pipeline_rows, c_lo=c_lo, c_lo_of=c_lo_fn)
 
     l = jnp.maximum(l_scr[:, :1], 1e-9)
     o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
