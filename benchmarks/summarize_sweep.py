@@ -45,6 +45,7 @@ BENCH_ARMS = [
     ("bench_rowpipe16", "1b rowpipe+chunk16"),
     ("bench_ctx2k", "1b ctx=2048 chunk=16"),
     ("bench_fused", "1b fused writeback"),
+    ("bench_fused_rp16", "1b fused+rowpipe+chunk16"),
     ("bench_scatter", "1b scatter writeback"),
     ("bench_prefill_pallas", "1b pallas prefill route"),
 ]
