@@ -167,6 +167,64 @@ class TestMropeLogitsParity:
                                    rtol=2e-3, atol=2e-3)
 
 
+class TestMropePrefixCache:
+    def test_text_only_vl_prefix_cache_same_output(self):
+        """Text-only prompts on a VL engine use the prefix cache (only
+        image-bearing sequences are excluded); the cached-prefix install
+        uploads M-RoPE ids for the SUFFIX slice, which must compose with
+        the matched prefix to the same greedy stream."""
+        import threading
+
+        from xllm_service_tpu.common.request import SamplingParams
+        from xllm_service_tpu.engine.config import EngineConfig
+        from xllm_service_tpu.engine.engine import (EngineRequest,
+                                                    InferenceEngine)
+
+        cfg = tiny_vl_config(dtype=jnp.float32, max_context_len=256,
+                             image_token_id=IMG)
+        engine = InferenceEngine(EngineConfig(
+            model_id="tiny-vl", model_family="qwen2_vl", model=cfg,
+            num_pages=32, page_size=16, hash_block_size=32,
+            max_batch_size=2, max_seq_len=128, prefill_buckets=(64, 128)))
+        engine.start()
+        prompt = list(range(10, 75))   # 65 tokens: 2 hash blocks + tail
+
+        def run_one(tag):
+            outs, done = [], threading.Event()
+
+            def cb(out):
+                for s in out.outputs:
+                    outs.extend(s.token_ids)
+                if out.finished:
+                    done.set()
+
+            engine.submit(EngineRequest(
+                tag, token_ids=list(prompt),
+                sampling=SamplingParams(max_tokens=6, temperature=0.0,
+                                        ignore_eos=True), on_output=cb))
+            assert done.wait(60)
+            return outs
+
+        first = run_one("vlpc-1")
+        stats = engine.stats()
+        assert stats["cached_blocks"] > 0     # blocks donated
+        # The second run must actually HIT the cache (not just happen to
+        # produce the same stream through a full prefill).
+        real_match = engine.page_mgr.match_prefix
+        hits = []
+
+        def spy(tokens):
+            res = real_match(tokens)
+            hits.append(res[0])
+            return res
+
+        engine.page_mgr.match_prefix = spy
+        second = run_one("vlpc-2")            # matches the cached prefix
+        engine.stop()
+        assert hits and hits[0] > 0, "prefix cache was not hit"
+        assert first == second
+
+
 class TestEngineDecodeDelta:
     def test_engine_greedy_matches_full_recompute(self):
         """The engine decodes with 1D positions + the per-slot M-RoPE
