@@ -432,28 +432,55 @@ def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     callers pass context_lens *including* the new token). softcap/window:
     gemma-2 score capping and sliding-window (the query sits at position
     context_lens[b]-1, so the window keeps keys >= context_lens[b]-window).
+
+    The gather is span-bucketed: a static pow2 ladder of page-table
+    prefixes compiles once each and `lax.switch` picks the shortest one
+    covering the longest live context — families on this path (the MLA
+    latent cache, whose fused head dim doesn't fit the Pallas kernel's
+    tiling) no longer pay a FULL-table gather per layer per step when
+    the table is sized for contexts far beyond current occupancy.
     """
     B, n_heads, hd = q.shape
     n_kv = k_pages.shape[1]
     n_rep = n_heads // n_kv
+    page_size = k_pages.shape[2]
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
-
-    k = _repeat_kv(gather_pages(k_pages, page_table), n_rep)  # [B, T, H, hd]
-    v = _repeat_kv(gather_pages(v_pages, page_table), n_rep)
-    T = k.shape[1]
     qf = q.astype(jnp.float32) * scale
-    scores = jnp.einsum("bhd,bkhd->bhk", qf, k.astype(jnp.float32))
-    if softcap > 0:
-        scores = softcap * jnp.tanh(scores / softcap)
-    mask = jnp.arange(T)[None, :] < context_lens[:, None]
-    if window > 0:
-        mask = mask & (jnp.arange(T)[None, :]
-                       >= context_lens[:, None] - window)
-    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+
+    def attend(pt_prefix):
+        k = _repeat_kv(gather_pages(k_pages, pt_prefix), n_rep)
+        v = _repeat_kv(gather_pages(v_pages, pt_prefix), n_rep)
+        T = k.shape[1]
+        scores = jnp.einsum("bhd,bkhd->bhk", qf, k.astype(jnp.float32))
+        if softcap > 0:
+            scores = softcap * jnp.tanh(scores / softcap)
+        mask = jnp.arange(T)[None, :] < context_lens[:, None]
+        if window > 0:
+            mask = mask & (jnp.arange(T)[None, :]
+                           >= context_lens[:, None] - window)
+        scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    max_pages = page_table.shape[1]
+    # Pow2 span ladder, smallest-first (at most 4 variants; tiny tables
+    # keep the single full-span branch).
+    spans = []
+    s = max_pages
+    while s > 1 and len(spans) < 3:
+        spans.append(s)
+        s = -(-s // 2)
+    spans = sorted(set(spans + [max_pages]))
+    if len(spans) == 1:
+        return attend(page_table)
+
+    need = jnp.max(-(-context_lens // page_size))    # pages to cover
+    idx = sum((need > s).astype(jnp.int32) for s in spans[:-1])
+    branches = [lambda _, s_=s_: attend(page_table[:, :s_])
+                for s_ in spans]
+    return jax.lax.switch(idx, branches, operand=None)
 
 
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
