@@ -292,10 +292,10 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if k_pages is None:
         return _suffix_only(None).astype(q.dtype)
 
-    def _with_prefix(_):
-        pk = _repeat_kv(gather_pages(k_pages, page_table),
+    def _attend_prefix(pt_prefix):
+        pk = _repeat_kv(gather_pages(k_pages, pt_prefix),
                         n_rep).astype(jnp.float32)
-        pv = _repeat_kv(gather_pages(v_pages, page_table),
+        pv = _repeat_kv(gather_pages(v_pages, pt_prefix),
                         n_rep).astype(jnp.float32)
         T = pk.shape[1]
         ps_scores = cap(jnp.einsum("bqhd,bkhd->bhqk", qf, pk))
@@ -314,6 +314,27 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         values = jnp.concatenate([pv, vf], axis=1)
         probs = jax.nn.softmax(scores, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, values)
+
+    def _with_prefix(_):
+        # Span-bucketed prefix gather (same ladder as paged_attention_xla):
+        # the prefix term only needs pages covering positions < prefix_len,
+        # so a chunked long prefill stops re-gathering its table's FULL
+        # span on every chunk.
+        page_size = k_pages.shape[2]
+        max_pages = page_table.shape[1]
+        spans = []
+        s_ = max_pages
+        while s_ > 1 and len(spans) < 3:
+            spans.append(s_)
+            s_ = -(-s_ // 2)
+        spans = sorted(set(spans + [max_pages]))
+        if len(spans) == 1:
+            return _attend_prefix(page_table)
+        need = jnp.max(-(-prefix_lens // page_size))
+        idx = sum((need > sp).astype(jnp.int32) for sp in spans[:-1])
+        branches = [lambda _, sp=sp: _attend_prefix(page_table[:, :sp])
+                    for sp in spans]
+        return jax.lax.switch(idx, branches, operand=None)
 
     # The prefix term gathers the row's whole page span and scores
     # against it — real bandwidth and FLOPs that a no-cache-hit prefill
