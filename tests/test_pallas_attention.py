@@ -63,6 +63,24 @@ class TestPallasPagedAttention:
                                            np.asarray(ref[b]),
                                            rtol=2e-5, atol=2e-5)
 
+    def test_span_bucketed_xla_gather_parity(self, monkeypatch):
+        """XLLM_XLA_SPAN_BUCKETS=1 forces the pow2 span ladder the
+        accelerator backend uses (the CPU suite default keeps the single
+        full-span branch for compile time): every ladder rung must match
+        the full-span gather, including at occupancies that select the
+        shortest span."""
+        q, k_pages, v_pages, pt = _setup()
+        for cls in ([8, 12, 4, 16],              # shortest span
+                    [40, 41, 33, 50],            # middle rung
+                    [96, 96, 96, 96]):           # full span
+            cl = jnp.asarray(cls, jnp.int32)
+            monkeypatch.setenv("XLLM_XLA_SPAN_BUCKETS", "0")
+            ref = paged_attention_xla(q, k_pages, v_pages, pt, cl)
+            monkeypatch.setenv("XLLM_XLA_SPAN_BUCKETS", "1")
+            got = paged_attention_xla(q, k_pages, v_pages, pt, cl)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-6, atol=2e-6)
+
     @pytest.mark.parametrize("opts", [
         {"softcap": 30.0},                       # gemma-2 logit cap
         {"window": 40},                          # sliding-window layer

@@ -319,14 +319,16 @@ def prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # Span-bucketed prefix gather (same ladder as paged_attention_xla):
         # the prefix term only needs pages covering positions < prefix_len,
         # so a chunked long prefill stops re-gathering its table's FULL
-        # span on every chunk.
+        # span on every chunk. Accelerator-gated like the decode ladder —
+        # each span is a compiled variant, noise the CPU suite can't pay.
         page_size = k_pages.shape[2]
         max_pages = page_table.shape[1]
         spans = []
-        s_ = max_pages
-        while s_ > 1 and len(spans) < 3:
-            spans.append(s_)
-            s_ = -(-s_ // 2)
+        if _span_buckets_on():
+            s_ = max_pages
+            while s_ > 1 and len(spans) < 3:
+                spans.append(s_)
+                s_ = -(-s_ // 2)
         spans = sorted(set(spans + [max_pages]))
         if len(spans) == 1:
             return _attend_prefix(page_table)
@@ -441,6 +443,19 @@ def decode_attention_step(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 # ------------------------------------------------------------ decode attn
+def _span_buckets_on() -> bool:
+    """Span-bucketed gathers compile up to 4 variants of the attention
+    subgraph per program — worth it on accelerators (bandwidth saved
+    every step), pure compile-time cost on the CPU test backend (the
+    suite pays minutes). XLLM_XLA_SPAN_BUCKETS=1/0 overrides."""
+    import os
+
+    v = os.environ.get("XLLM_XLA_SPAN_BUCKETS", "")
+    if v in ("0", "1"):
+        return v == "1"
+    return jax.default_backend() != "cpu"
+
+
 def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                         page_table: jax.Array,
                         context_lens: jax.Array,
@@ -487,12 +502,13 @@ def paged_attention_xla(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 
     max_pages = page_table.shape[1]
     # Pow2 span ladder, smallest-first (at most 4 variants; tiny tables
-    # keep the single full-span branch).
+    # — and the CPU test backend — keep the single full-span branch).
     spans = []
-    s = max_pages
-    while s > 1 and len(spans) < 3:
-        spans.append(s)
-        s = -(-s // 2)
+    if _span_buckets_on():
+        s = max_pages
+        while s > 1 and len(spans) < 3:
+            spans.append(s)
+            s = -(-s // 2)
     spans = sorted(set(spans + [max_pages]))
     if len(spans) == 1:
         return attend(page_table)
