@@ -684,6 +684,16 @@ class EngineAgent:
             "# TYPE engine_dp_size gauge",
             f"engine_dp_size {len(self.engines)}",
         ]
+        spans = self._span_summary()
+        lines += [
+            "# TYPE engine_ttft_span_p50_milliseconds gauge",
+            'engine_ttft_span_p50_milliseconds{span="agent_total"} '
+            f"{spans['agent_accept_to_first_delta_ms']:.3f}",
+            'engine_ttft_span_p50_milliseconds{span="engine_queue"} '
+            f"{spans['engine_queue_ms']:.3f}",
+            'engine_ttft_span_p50_milliseconds{span="engine_prefill"} '
+            f"{spans['engine_prefill_ms']:.3f}",
+        ]
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
