@@ -59,7 +59,7 @@ def _candidate_records(obj):
         yield parsed
 
 
-def _iter_prior_records():
+def _iter_prior_records(root: str | None = None):
     """Yield every prior on-chip bench record we can find on disk.
 
     Covers BENCH_r*.json (driver wrapper objects, pretty-printed — parse
@@ -68,7 +68,7 @@ def _iter_prior_records():
     (one record per line, appended by _append_history)."""
     import glob
     import os
-    here = os.path.dirname(os.path.abspath(__file__))
+    here = root or os.path.dirname(os.path.abspath(__file__))
     paths = (glob.glob(os.path.join(here, "BENCH_r*.json"))
              + glob.glob(os.path.join(here, "tpu_results", "bench*.json"))
              + [os.path.join(here, HISTORY)])
@@ -118,12 +118,13 @@ def _bench_variant() -> str:
     return ",".join(parts)
 
 
-def _best_prior(model_key: str, quant: str, variant: str) -> float | None:
+def _best_prior(model_key: str, quant: str, variant: str,
+                root: str | None = None) -> float | None:
     """Best prior MEASURED on-chip tok/s at this (model, quant, variant)
     bench config, discovered from disk artifacts rather than a
     hand-edited dict."""
     best = _SEED_PRIOR.get((model_key, quant)) if not variant else None
-    for rec in _iter_prior_records():
+    for rec in _iter_prior_records(root):
         if (rec.get("model", "1b") == model_key
                 and rec.get("quant", "") == quant
                 and rec.get("variant", "") == variant):

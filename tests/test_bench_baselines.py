@@ -1,0 +1,76 @@
+"""The self-maintaining bench baseline is a driver-facing contract
+(vs_baseline in BENCH_r{N}.json): pin its discovery rules — artifact
+shapes, variant keying, error/CPU filtering — against regressions."""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench
+
+
+def _write(root: Path, rel: str, obj) -> None:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(obj, indent=2) if isinstance(obj, dict)
+                 else obj)
+
+
+def test_discovers_all_artifact_shapes(tmp_path):
+    # Driver wrapper (pretty-printed, record nested under "parsed").
+    _write(tmp_path, "BENCH_r07.json", {
+        "n": 7, "rc": 0,
+        "parsed": {"metric": bench.METRIC, "value": 2000.0,
+                   "backend": "tpu", "model": "1b"}})
+    # Sweep artifact: one record per file.
+    _write(tmp_path, "tpu_results/bench.json", json.dumps(
+        {"metric": bench.METRIC, "value": 1500.0, "backend": "tpu",
+         "model": "1b"}))
+    # Append-only history (jsonl).
+    _write(tmp_path, "tpu_results/history.jsonl", "\n".join([
+        json.dumps({"metric": bench.METRIC, "value": 1800.0,
+                    "backend": "tpu", "model": "1b"}),
+        json.dumps({"metric": bench.METRIC, "value": 900.0,
+                    "backend": "tpu", "model": "1b", "quant": "int8"}),
+    ]))
+    root = str(tmp_path)
+    assert bench._best_prior("1b", "", "", root) == 2000.0
+    assert bench._best_prior("1b", "int8", "", root) == 1077.8  # seed wins
+    assert bench._best_prior("8b", "int8", "", root) is None
+
+
+def test_variant_and_error_filtering(tmp_path):
+    recs = [
+        # A/B arm: must not contaminate the default-config baseline.
+        {"metric": bench.METRIC, "value": 9000.0, "backend": "tpu",
+         "model": "1b", "variant": "wb=fused"},
+        # CPU fallback: never a baseline.
+        {"metric": bench.METRIC, "value": 8000.0, "backend": "cpu",
+         "model": "1b"},
+        # Error artifact: ignored.
+        {"metric": bench.METRIC, "value": 7000.0, "backend": "tpu",
+         "model": "1b", "error": "boom"},
+        # Honest default-config row.
+        {"metric": bench.METRIC, "value": 1200.0, "backend": "tpu",
+         "model": "1b"},
+    ]
+    _write(tmp_path, "tpu_results/history.jsonl",
+           "\n".join(json.dumps(r) for r in recs))
+    root = str(tmp_path)
+    assert bench._best_prior("1b", "", "", root) == 1200.0
+    # The fused arm keys separately (and has no hand-seeded prior).
+    assert bench._best_prior("1b", "", "wb=fused", root) == 9000.0
+
+
+def test_bench_variant_keying(monkeypatch):
+    for var in ("XLLM_KV_WRITEBACK", "XLLM_PREFILL_PALLAS",
+                "XLLM_MQ_PALLAS", "XLLM_PAGE_CHUNK",
+                "XLLM_PAGE_PIPELINE"):
+        monkeypatch.delenv(var, raising=False)
+    assert bench._bench_variant() == ""
+    monkeypatch.setenv("XLLM_KV_WRITEBACK", "fused")
+    monkeypatch.setenv("XLLM_PAGE_CHUNK", "16")
+    monkeypatch.setenv("XLLM_PAGE_PIPELINE", "row")
+    assert bench._bench_variant() == "wb=fused,chunk=16,rowpipe"
