@@ -1,0 +1,58 @@
+"""The sweep writes its digest unattended (tpu_sweep.sh final step) — a
+crash there silently loses the round's summary, so pin the summarizer
+against every artifact shape the sweep can produce."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_summarizer_handles_all_artifact_shapes(tmp_path):
+    m = "decode_tokens_per_sec_per_chip"
+    (tmp_path / "bench.json").write_text(json.dumps(
+        {"metric": m, "value": 1200.0, "backend": "tpu",
+         "pct_roofline": 33.1}))
+    (tmp_path / "bench_chunk16.json").write_text(json.dumps(
+        {"metric": m, "value": 1500.0, "backend": "tpu",
+         "variant": "chunk=16"}))
+    # Mosaic failure recorded as an error artifact (rc=0).
+    (tmp_path / "bench_rowpipe.json").write_text(json.dumps(
+        {"metric": m, "value": 0.0, "backend": "tpu",
+         "error": "cp pallas kernel: Mosaic: oops"}))
+    # Crashed step: empty file.
+    (tmp_path / "bench_8b.json").write_text("")
+    # Partial JSON without a value.
+    (tmp_path / "bench_int8.json").write_text(json.dumps(
+        {"backend": "tpu", "metric": m}))
+    # Multi-line spec output (one JSON line per mode).
+    (tmp_path / "spec.json").write_text("\n".join([
+        json.dumps({"mode": "speculate_k=0", "tok_per_s": 900.0}),
+        json.dumps({"metric": "speculative_speedup", "value": 1.4,
+                    "backend": "tpu"})]))
+    (tmp_path / "serve.json").write_text(json.dumps(
+        {"backend": "tpu", "req_per_s": 3.0, "decode_tok_per_s": 700.0,
+         "ttft_ms": {"p50": 120.0},
+         "ttft_spans_p50_ms": {"client": 120.0}, "errors": 0}))
+    (tmp_path / "decode_profile.json").write_text(json.dumps(
+        {"backend": "tpu", "full_step_ms": 10.0, "forward_only_ms": 8.0,
+         "attention_only_ms": 5.0, "sampling_only_ms": 0.5}))
+    (tmp_path / "pd_handoff.json").write_text(json.dumps(
+        {"backend": "tpu",
+         "ctx_2048": {"device_ms": 5.0, "host_ms": 50.0}}))
+
+    r = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "summarize_sweep.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-500:]
+    out = r.stdout
+    assert "| 1b bf16 (default) | 1200.0 |" in out
+    assert "1.250x" in out                      # chunk16 vs default
+    assert "Mosaic" in out                      # error arm surfaced
+    assert "no value recorded" in out           # partial artifact
+    assert "full_step_ms: 10.0" in out
+    assert "ctx_2048" in out
+    assert "speculative_speedup" in out
