@@ -530,8 +530,10 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     hand-written Pallas kernel on TPU, XLA gather fallback elsewhere (CPU
     test meshes) and for shapes outside the kernel's tiling constraints.
     Selection happens at trace time — all paths are numerically
-    equivalent (tested). softcap/window (gemma-2) always take the XLA
-    path; the engine refuses CP meshes for such models."""
+    equivalent (tested). softcap/window (gemma-2) ride the Pallas
+    kernel as static params when the shape qualifies, falling back to
+    XLA otherwise; CP meshes refuse such models (the partial-stats
+    merge has no softcap/window support)."""
     cp = getattr(_cp_ctx, "cfg", None)
     if cp is not None:
         if softcap != 0.0 or window != 0:
