@@ -39,8 +39,12 @@ METRIC = "decode_tokens_per_sec_per_chip"
 # BENCH_r*.json + tpu_results/ + tpu_results/history.jsonl, so this dict
 # never needs hand-maintenance again (VERDICT r3 weak #6).
 _SEED_PRIOR = {
+    # Exact round-2 sweep values: the sweep's shell redirect truncates an
+    # arm's own artifact before bench.py starts, so a record stored ONLY
+    # in that file is invisible to that arm's re-run — the seed (and,
+    # for everything after round 4, history.jsonl) must carry it.
     ("1b", ""): 1091.4,
-    ("1b", "int8"): 1077.8,
+    ("1b", "int8"): 1077.83,
 }
 
 HISTORY = "tpu_results/history.jsonl"
@@ -118,20 +122,39 @@ def _bench_variant() -> str:
     return ",".join(parts)
 
 
-def _best_prior(model_key: str, quant: str, variant: str,
-                root: str | None = None) -> float | None:
-    """Best prior MEASURED on-chip tok/s at this (model, quant, variant)
+def _best_tpu(model_key: str, quant: str, variant: str,
+              root: str | None = None) -> dict | None:
+    """Best prior MEASURED on-chip record at this (model, quant, variant)
     bench config, discovered from disk artifacts rather than a
-    hand-edited dict."""
+    hand-edited dict. Returns {"value": tok/s, "ts": iso-date?} — the
+    high-water mark the bench baselines against, with the winning run's
+    timestamp when its record carries one (history rows do; the seed
+    and round-2 artifacts don't)."""
     best = _SEED_PRIOR.get((model_key, quant)) if not variant else None
+    ts = None
     for rec in _iter_prior_records(root):
         if (rec.get("model", "1b") == model_key
                 and rec.get("quant", "") == quant
                 and rec.get("variant", "") == variant):
             v = float(rec["value"])
             if best is None or v > best:
-                best = v
-    return best
+                best, ts = v, rec.get("ts")
+    if best is None:
+        return None
+    out = {"value": best, "model": model_key}
+    if quant:
+        out["quant"] = quant
+    if variant:
+        out["variant"] = variant
+    if ts:
+        out["ts"] = ts
+    return out
+
+
+def _best_prior(model_key: str, quant: str, variant: str,
+                root: str | None = None) -> float | None:
+    rec = _best_tpu(model_key, quant, variant, root)
+    return rec["value"] if rec else None
 
 
 def _append_history(result: dict) -> None:
@@ -140,10 +163,15 @@ def _append_history(result: dict) -> None:
     here = os.path.dirname(os.path.abspath(__file__))
     try:
         os.makedirs(os.path.join(here, "tpu_results"), exist_ok=True)
+        rec = dict(result)
+        rec.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()))
         with open(os.path.join(here, HISTORY), "a") as f:
-            f.write(json.dumps(result) + "\n")
+            f.write(json.dumps(rec) + "\n")
     except OSError:
         pass
+
+
 
 HBM_GBPS = {"tpu": 819.0}   # v5e HBM bandwidth ceiling (public spec)
 
@@ -229,8 +257,14 @@ def main() -> None:
         # Long-context decode variant: the page walk dominates here, so
         # this is where the paged-kernel/DMA knobs actually show.
         # Batch shrinks to keep the KV pool inside one chip's HBM.
-        ctx = min(int(os.environ["XLLM_BENCH_CTX"]),
-                  mcfg.max_context_len - 512)
+        try:
+            ctx_req = int(os.environ["XLLM_BENCH_CTX"])
+        except ValueError:
+            # The contract is one JSON line even on bad input.
+            _fail(f"bad XLLM_BENCH_CTX "
+                  f"{os.environ['XLLM_BENCH_CTX']!r}", backend)
+            return
+        ctx = min(ctx_req, mcfg.max_context_len - 512)
         B = 16 if ctx <= 512 else (8 if ctx <= 1024 else 4)
         max_seq = ctx + 512
         # Label with the EFFECTIVE ctx (the request may have been
@@ -325,7 +359,37 @@ def main() -> None:
     if hbm:
         result["pct_roofline"] = round(100.0 * eff_gbps / hbm, 1)
     if tpu_note:
+        # A fallback number drifts with host load (measured spread on this
+        # box: 4195-5559 tok/s across back-to-back runs) and with code
+        # shape (the loop is tuned for device-compute overlap that a
+        # 1-CPU box can't express). Mark it structural-only and carry the
+        # best real on-chip figure for the REQUESTED config so the
+        # deliverable metric is never silently replaced by noise.
         result["note"] = tpu_note
+        result["structural_only"] = True
+        req_model = os.environ.get("XLLM_BENCH_MODEL", "1b")
+        req_quant = os.environ.get(
+            "XLLM_QUANT", "int8" if req_model == "8b" else "")
+        # Key the lookup exactly the way an on-chip run of the REQUESTED
+        # config would have labeled itself: on this path ctx_variant was
+        # never computed (tiny_config was forced), so append the
+        # effective (clamp-adjusted) ctx of the requested model to the
+        # knob variant already in `variant`. A malformed ctx env must not
+        # break the emit-JSON-even-on-failure contract.
+        req_variant = variant
+        try:
+            req_ctx = int(os.environ.get("XLLM_BENCH_CTX", ""))
+        except ValueError:
+            req_ctx = 0
+        if req_ctx:
+            req_mcfg = (llama3_8b_config() if req_model == "8b"
+                        else bench_1b_config())
+            req_ctx = min(req_ctx, req_mcfg.max_context_len - 512)
+            req_variant = ",".join(
+                p for p in (req_variant, f"ctx={req_ctx}") if p)
+        best = _best_tpu(req_model, req_quant, req_variant)
+        if best:
+            result["best_tpu"] = best
     if mcfg.quant:
         result["quant"] = mcfg.quant
     if variant:

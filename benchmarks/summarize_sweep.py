@@ -55,6 +55,7 @@ def main() -> None:
     d = Path(sys.argv[1] if len(sys.argv) > 1 else "tpu_results")
     rows = []
     baseline = None
+    baseline_backend = None
     for name, label in BENCH_ARMS:
         r = load(d, name)
         if not r:
@@ -64,7 +65,7 @@ def main() -> None:
             continue
         v = r.get("value")
         if name == "bench":
-            baseline = v
+            baseline, baseline_backend = v, r.get("backend")
         rows.append((label, v, r, r.get("backend")))
 
     print("## Sweep summary\n")
@@ -75,10 +76,30 @@ def main() -> None:
             note = r if isinstance(r, str) else "no value recorded"
             print(f"| {label} | ERROR | {note} | | {backend} |")
             continue
-        rel = (f"{v / baseline:.3f}x"
-               if baseline and label != "1b bf16 (default)" else "—")
+        # A ratio across backends is meaningless (a CPU-fallback arm vs a
+        # TPU default, or vice versa) — refuse rather than mis-compare.
+        # Artifacts without a backend tag are unknown provenance: also
+        # refuse (None == None must not earn a confident ratio).
+        if baseline and label != "1b bf16 (default)":
+            if backend is None or baseline_backend is None:
+                rel = "n/a (backend unknown)"
+            elif backend == baseline_backend:
+                rel = f"{v / baseline:.3f}x"
+            else:
+                rel = "n/a (backend mismatch)"
+        else:
+            rel = "—"
         roof = r.get("pct_roofline", "")
-        print(f"| {label} | {v} | {rel} | {roof} | {backend} |")
+        suffix = ""
+        if r.get("structural_only"):
+            # Surface the carried on-chip figure right where maintainers
+            # read the table — the CPU number must never stand in for it.
+            best = r.get("best_tpu") or {}
+            chip = (f"; best on-chip {best['value']}"
+                    + (f" @ {best['ts']}" if best.get("ts") else "")
+                    if best.get("value") else "")
+            suffix = f" (structural only{chip})"
+        print(f"| {label} | {v}{suffix} | {rel} | {roof} | {backend} |")
 
     prof = load(d, "decode_profile")
     if prof and not prof.get("error"):

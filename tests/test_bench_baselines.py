@@ -37,7 +37,7 @@ def test_discovers_all_artifact_shapes(tmp_path):
     ]))
     root = str(tmp_path)
     assert bench._best_prior("1b", "", "", root) == 2000.0
-    assert bench._best_prior("1b", "int8", "", root) == 1077.8  # seed wins
+    assert bench._best_prior("1b", "int8", "", root) == 1077.83  # seed wins
     assert bench._best_prior("8b", "int8", "", root) is None
 
 
@@ -62,6 +62,35 @@ def test_variant_and_error_filtering(tmp_path):
     assert bench._best_prior("1b", "", "", root) == 1200.0
     # The fused arm keys separately (and has no hand-seeded prior).
     assert bench._best_prior("1b", "", "wb=fused", root) == 9000.0
+
+
+def test_best_tpu_carries_value_and_ts(tmp_path):
+    """CPU fallback artifacts embed the best prior on-chip figure
+    (VERDICT r4 next #5) so a relay-down capture stays self-describing.
+    Exercises the real disk-discovery path via the root parameter."""
+    recs = [
+        {"metric": bench.METRIC, "value": 1300.0, "backend": "tpu",
+         "model": "1b", "ts": "2026-07-30T10:00:00Z"},
+        {"metric": bench.METRIC, "value": 1100.0, "backend": "tpu",
+         "model": "1b"},
+    ]
+    _write(tmp_path, "tpu_results/history.jsonl",
+           "\n".join(json.dumps(r) for r in recs))
+    root = str(tmp_path)
+    out = bench._best_tpu("1b", "", "", root)
+    assert out["value"] == 1300.0
+    assert out["ts"] == "2026-07-30T10:00:00Z"
+    assert bench._best_tpu("8b", "int8", "", root) is None
+    # Variant rows key separately: a ctx2k prior never masquerades as
+    # the short-context figure and vice versa.
+    _write(tmp_path, "tpu_results/history.jsonl", "\n".join(
+        json.dumps(r) for r in recs + [
+            {"metric": bench.METRIC, "value": 400.0, "backend": "tpu",
+             "model": "1b", "variant": "chunk=16,ctx=2048",
+             "ts": "2026-07-30T11:00:00Z"}]))
+    ctx = bench._best_tpu("1b", "", "chunk=16,ctx=2048", root)
+    assert ctx["value"] == 400.0
+    assert bench._best_tpu("1b", "", "", root)["value"] == 1300.0
 
 
 def test_bench_variant_keying(monkeypatch):

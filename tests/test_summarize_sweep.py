@@ -56,3 +56,25 @@ def test_summarizer_handles_all_artifact_shapes(tmp_path):
     assert "full_step_ms: 10.0" in out
     assert "ctx_2048" in out
     assert "speculative_speedup" in out
+
+
+def test_summarizer_refuses_cross_backend_ratio(tmp_path):
+    """A CPU-fallback arm must never be ratioed against a TPU default
+    (VERDICT r4 weak #1): the comparison column says so explicitly."""
+    m = "decode_tokens_per_sec_per_chip"
+    (tmp_path / "bench.json").write_text(json.dumps(
+        {"metric": m, "value": 1091.4, "backend": "tpu"}))
+    (tmp_path / "bench_int8.json").write_text(json.dumps(
+        {"metric": m, "value": 4400.0, "backend": "cpu",
+         "structural_only": True,
+         "best_tpu": {"value": 1077.83, "model": "1b", "quant": "int8",
+                      "ts": "2026-07-29T14:26:00Z"},
+         "note": "accelerator unreachable; measured on CPU fallback"}))
+    r = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "summarize_sweep.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "n/a (backend mismatch)" in r.stdout
+    assert "4.032x" not in r.stdout              # no cross-backend ratio
+    assert "structural only; best on-chip 1077.83 @ 2026-07-29" in r.stdout
