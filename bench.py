@@ -230,6 +230,25 @@ def main() -> None:
         _fail(f"jax backend init failed: {type(e).__name__}: {e}")
         return
 
+    import sys
+    if "--compile-only" in sys.argv:
+        # Mosaic compile gate (VERDICT r4 next #6): AOT-compile every
+        # Pallas kernel arm and report per-arm verdicts without timing
+        # anything. Shares this function's backend setup so the CPU
+        # fallback/pinning behavior is identical to a timing run.
+        import importlib.util
+        gate_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "benchmarks", "compile_gate.py")
+        spec = importlib.util.spec_from_file_location("compile_gate",
+                                                      gate_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        result = mod.run_gate()
+        if tpu_note:
+            result["note"] = tpu_note
+        print(json.dumps(result))
+        return
+
     from xllm_service_tpu.engine.config import EngineConfig
     from xllm_service_tpu.engine.engine import InferenceEngine
     from xllm_service_tpu.models.base import (bench_1b_config,

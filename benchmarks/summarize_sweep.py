@@ -69,6 +69,22 @@ def main() -> None:
         rows.append((label, v, r, r.get("backend")))
 
     print("## Sweep summary\n")
+    gate = load(d, "compile_gate")
+    if gate and isinstance(gate.get("arms"), dict):
+        bad = [n for n, a in gate["arms"].items() if not a.get("ok")]
+        if bad:
+            errs = ", ".join(
+                f"`{n}` ({gate['arms'][n].get('error', '')[:80]})"
+                for n in bad)
+            print(f"**Mosaic compile gate: {len(bad)} arm(s) FAILED:** "
+                  f"{errs}\n")
+        else:
+            n = len(gate["arms"])
+            print(f"Mosaic compile gate: all {n} kernel arms compiled "
+                  f"({gate.get('backend')}"
+                  + (", interpret" if gate.get("interpret") else "")
+                  + ")\n")
+
     print("| Arm | tok/s | vs default | pct_roofline | backend |")
     print("|---|---|---|---|---|")
     for label, v, r, backend in rows:

@@ -1,0 +1,46 @@
+"""The Mosaic compile gate runs unattended as the sweep's step 0 — its
+job is to turn kernel-compile rejections into named verdicts. Pin the
+arm matrix, the verdict wiring, and one real end-to-end arm compile
+(full 11-arm runs belong to the sweep, not the suite's wall clock)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+spec = importlib.util.spec_from_file_location(
+    "compile_gate", REPO / "benchmarks" / "compile_gate.py")
+compile_gate = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(compile_gate)
+
+
+def test_arm_matrix_covers_every_sweep_ab():
+    names = [n for n, _ in compile_gate._arm_specs(interpret=True)]
+    # Every kernel knob the sweep A/Bs (tpu_sweep.sh) has a gate arm.
+    assert names == [
+        "paged_default", "paged_chunk16", "paged_chunk32",
+        "paged_rowpipe", "paged_rowpipe16", "paged_chunk16_ctx2k",
+        "gemma2_softcap", "window_start", "fused_writeback",
+        "fused_rowpipe16", "mq_verify_k4", "prefill_pallas_s128",
+        "cp_partial_stats"]
+
+
+def test_one_real_arm_compiles():
+    specs = dict(compile_gate._arm_specs(interpret=True))
+    specs["paged_default"]()          # raises on lowering failure
+
+
+def test_run_gate_records_failures_without_crashing(monkeypatch):
+    def fake_specs(interpret):
+        yield "good", lambda: None
+        yield "bad", lambda: (_ for _ in ()).throw(ValueError("Mosaic: no"))
+    monkeypatch.setattr(compile_gate, "_arm_specs", fake_specs)
+    out = compile_gate.run_gate()
+    assert out["metric"] == "mosaic_compile_gate"
+    assert out["arms"]["good"]["ok"] is True
+    assert out["arms"]["bad"]["ok"] is False
+    assert "Mosaic: no" in out["arms"]["bad"]["error"]
+    assert out["failed_arms"] == ["bad"]
+    assert "error" in out

@@ -42,6 +42,12 @@ def test_summarizer_handles_all_artifact_shapes(tmp_path):
     (tmp_path / "pd_handoff.json").write_text(json.dumps(
         {"backend": "tpu",
          "ctx_2048": {"device_ms": 5.0, "host_ms": 50.0}}))
+    (tmp_path / "compile_gate.json").write_text(json.dumps(
+        {"metric": "mosaic_compile_gate", "backend": "tpu",
+         "arms": {"paged_default": {"ok": True, "compile_s": 8.0},
+                  "fused_writeback": {"ok": False,
+                                      "error": "Mosaic: bad layout"}},
+         "failed_arms": ["fused_writeback"]}))
 
     r = subprocess.run(
         [sys.executable, str(REPO / "benchmarks" / "summarize_sweep.py"),
@@ -50,6 +56,8 @@ def test_summarizer_handles_all_artifact_shapes(tmp_path):
     assert r.returncode == 0, r.stderr[-500:]
     out = r.stdout
     assert "| 1b bf16 (default) | 1200.0 |" in out
+    assert "Mosaic compile gate: 1 arm(s) FAILED" in out
+    assert "`fused_writeback` (Mosaic: bad layout)" in out
     assert "1.250x" in out                      # chunk16 vs default
     assert "Mosaic" in out                      # error arm surfaced
     assert "no value recorded" in out           # partial artifact

@@ -76,6 +76,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
     echo "=== relay alive at $(date) ==="
     FAILED_STEPS=""
+    # 0. Mosaic compile gate: AOT-compile EVERY kernel arm first so a
+    # Mosaic rejection is a named per-arm verdict, not a mid-sweep crash.
+    run_step compile_gate 1800 python bench.py --compile-only \
+      || { sleep 60; continue; }
     # 1. bench.py 1b (the driver contract number; regression check vs 1091)
     run_step bench 900 python bench.py || { sleep 60; continue; }
     # 2. FIRST north-star-scale number: Llama-3-8B shapes, weight-only int8
