@@ -157,6 +157,10 @@ def main() -> None:
     if kv:
         print("\n### kv writeback micro:", json.dumps(kv)[:300])
 
+    rc = load(d, "real_ckpt")
+    if rc:
+        print("\n### real checkpoint parity:", json.dumps(rc)[:300])
+
 
 if __name__ == "__main__":
     main()

