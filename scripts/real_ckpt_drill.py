@@ -156,7 +156,7 @@ def run_drill(ckpt_dir: str, prompt: str = PROMPT, max_new: int = 32,
         max_seq = min(cfg.max_context_len,
                       max(256, b1 + -(-max_new // align) * align + align))
         ecfg = EngineConfig(
-            model_id=model_id, model=cfg,
+            model_id=model_id, model=cfg, model_family=cfg.name,
             num_pages=2 * max_seq // align + 32, page_size=align,
             hash_block_size=32, max_batch_size=2,
             max_seq_len=max_seq,

@@ -16,6 +16,11 @@ class EngineConfig:
     model_family: str = "llama"
     model: ModelConfig = field(default_factory=tiny_config)
     mesh: Optional[MeshConfig] = None      # None = all local devices on TP
+    # First device index for this engine's mesh: lets several instances
+    # on one host (or one virtual test topology) occupy DISJOINT device
+    # groups — e.g. a PD pair placed on separate sub-meshes of a pod
+    # slice, the reference's engines-pinned-to-GPU-sets analog.
+    mesh_device_offset: int = 0
     role: InstanceType = InstanceType.MIX
     # KV pool. Page 0 is reserved as the garbage page (inactive batch slots
     # write there), so usable pages = num_pages - 1.

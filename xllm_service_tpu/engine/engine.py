@@ -171,9 +171,17 @@ class InferenceEngine:
             self.mesh = mesh
         elif cfg.mesh:
             # Use exactly the devices the configured mesh asks for (a host
-            # may expose more, e.g. the virtual CPU test mesh).
-            self.mesh = build_mesh(
-                cfg.mesh, devices=jax.devices()[:cfg.mesh.num_devices()])
+            # may expose more, e.g. the virtual CPU test mesh), starting at
+            # mesh_device_offset so co-hosted instances can own disjoint
+            # device groups (multi-slice PD placement).
+            off = cfg.mesh_device_offset
+            need = cfg.mesh.num_devices()
+            avail = jax.devices()
+            if off < 0 or off + need > len(avail):
+                raise ValueError(
+                    f"mesh needs devices [{off}:{off + need}) but only "
+                    f"{len(avail)} are attached")
+            self.mesh = build_mesh(cfg.mesh, devices=avail[off:off + need])
         else:
             self.mesh = None
         self.tokenizer = tokenizer or SimpleTokenizer()

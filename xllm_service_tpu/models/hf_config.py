@@ -101,11 +101,27 @@ def model_config_from_hf(ckpt_dir: str | Path, *,
             first_dense_layers=hf.get("first_k_dense_replace", 1))
     elif mt == "qwen2_vl":
         from . import qwen2_vl  # noqa: F401 — registers the family
+        from .base import VisionConfig
         kw = _common(hf)
         sec = (hf.get("rope_scaling") or {}).get("mrope_section") or ()
-        kw.update(name="qwen2_vl", qkv_bias=True,
-                  mrope_section=tuple(sec),
-                  image_token_id=hf.get("image_token_id", 151655))
+        vc = hf.get("vision_config") or {}
+        merge = int(vc.get("spatial_merge_size", 2))
+        patch = int(vc.get("patch_size", 14))
+        # HF's vision_config carries no fixed image size (dynamic
+        # resolution); the tower here runs the canonical 224px grid.
+        image = 224
+        kw.update(
+            name="qwen2_vl", qkv_bias=True, mrope_section=tuple(sec),
+            image_token_id=hf.get("image_token_id", 151655),
+            vision=VisionConfig(
+                image_size=image, patch_size=patch,
+                hidden_size=int(vc.get("embed_dim",
+                                       vc.get("hidden_size", 1280))),
+                num_layers=int(vc.get("depth", vc.get("num_layers", 32))),
+                num_heads=int(vc.get("num_heads", 16)),
+                out_tokens=(image // patch // merge) ** 2,
+                temporal_patch_size=int(vc.get("temporal_patch_size", 2)),
+                spatial_merge_size=merge))
     else:
         raise ValueError(
             f"unsupported HF model_type {mt!r} under {ckpt_dir} — "
