@@ -77,10 +77,17 @@ def _arm_specs(interpret: bool):
     yield "paged_chunk32", paged(32, False)
     yield "paged_rowpipe", paged(default_chunk, True)
     yield "paged_rowpipe16", paged(16, True)
-    # bench_ctx2k's program is a DIFFERENT grid (B=4, 160-page tables —
-    # bench.py's long-context shape ladder), not a re-tile of chunk16.
+    # The long-context arms are DIFFERENT grids (bench.py's shape
+    # ladder: batch shrinks as the walk deepens), not re-tiles of
+    # chunk16 — gate each one the timing steps will actually run.
     yield "paged_chunk16_ctx2k", paged(
         16, False, b=4, mp=160, pool=4 * 160 + 64)
+    yield "paged_chunk16_ctx8k", paged(
+        16, False, b=2, mp=544, pool=2 * 544 + 64)
+    yield "paged_chunk16_ctx16k", paged(
+        16, False, b=2, mp=1056, pool=2 * 1056 + 64)
+    yield "paged_chunk16_ctx32k", paged(
+        16, False, b=1, mp=2080, pool=2080 + 64)
     # gemma-2 route: softcap + explicit scale, static kernel params.
     yield "gemma2_softcap", paged(default_chunk, False, softcap=30.0)
     # sliding-window walk start (gemma-2 local layers).

@@ -62,7 +62,10 @@ def main() -> None:
     # bench-1b attention shapes (models/base.py bench_1b_config).
     B, n_q, n_kv, hd, ps = (16, 16, 8, 128, 16) if on_accel \
         else (4, 4, 2, 32, 16)
-    ctx = 2048 if on_accel else 128
+    ctx = int(os.environ.get("XLLM_CP_CTX", "0")) or \
+        (2048 if on_accel else 128)
+    if ctx > 8192:
+        B = max(2, B // 4)   # keep the pool inside one chip's HBM
     pages_per_seq = ctx // ps
     num_pages = B * pages_per_seq + 64
     dtype = jnp.bfloat16 if on_accel else jnp.float32

@@ -123,14 +123,21 @@ def drive(base: str, stats_url: str, args, vocab: int) -> dict:
         "e2e_ms": {"p50": round(percentile(e2es, 50), 1),
                    "p99": round(percentile(e2es, 99), 1)},
     }
+    if getattr(args, "prefill_chunk", 0) > 0:
+        report["prefill_chunk"] = args.prefill_chunk
+        report["sarathi"] = os.environ.get("XLLM_SARATHI", "1") != "0"
 
     # TTFT span breakdown (VERDICT r3 weak #1: name where the time goes).
     # client TTFT = master+wire + agent span; agent span = engine queue +
     # prefill + streamer flush. Spans come from the agent's /stats so
     # this works across process boundaries.
     try:
-        spans = requests.get(stats_url, timeout=10).json().get(
-            "ttft_spans", {})
+        stats = requests.get(stats_url, timeout=10).json()
+        spans = stats.get("ttft_spans", {})
+        if getattr(args, "prefill_chunk", 0) > 0:
+            # Proof the Sarathi arm exercised the ride path (0 means the
+            # A/B silently measured the whole-install configuration).
+            report["sarathi_rides"] = stats.get("sarathi_rides", 0)
     except Exception:  # noqa: BLE001
         spans = {}
     if spans.get("n") and ttfts:
@@ -182,6 +189,8 @@ def run_multiproc(args, model_config: str, on_accel: bool) -> dict:
             # to admission_horizon while requests are waiting.
             eng_args = ["--max-seq-len", "1024", "--num-pages", "1024",
                         "--decode-horizon", "32"]
+        if args.prefill_chunk > 0:
+            eng_args += ["--prefill-chunk", str(args.prefill_chunk)]
         spawn("agent", [sys.executable, "-m",
                         "xllm_service_tpu.engine.agent",
                         "--coordination-addr", f"127.0.0.1:{coord_port}",
@@ -260,6 +269,7 @@ def run_inproc(args, model_config: str, on_accel: bool) -> dict:
         model_id="bench", model=mcfg, num_pages=pages, page_size=16,
         max_batch_size=16, max_seq_len=max_seq, prefill_buckets=buckets,
         decode_horizon=horizon,
+        prefill_chunk_tokens=max(0, args.prefill_chunk),
         # Pre-compile every horizon + prefill bucket at boot: on TPU a
         # cold bucket otherwise lands a ~20s XLA compile on a live
         # request's TTFT, which is boot cost, not serving latency.
@@ -294,6 +304,10 @@ def main() -> None:
                     choices=("multiproc", "inproc"),
                     help="multiproc (deployment-shaped; default) or the "
                          "old single-interpreter stack")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="engine chunked-prefill tokens (0 = whole-suffix "
+                         "installs); chunks ride decode steps unless "
+                         "XLLM_SARATHI=0")
     args = ap.parse_args()
 
     if args.stack == "multiproc":

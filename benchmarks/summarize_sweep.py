@@ -44,6 +44,9 @@ BENCH_ARMS = [
     ("bench_rowpipe", "1b rowpipe"),
     ("bench_rowpipe16", "1b rowpipe+chunk16"),
     ("bench_ctx2k", "1b ctx=2048 chunk=16"),
+    ("bench_ctx8k", "1b ctx=8192 chunk=16"),
+    ("bench_ctx16k", "1b ctx=16384 chunk=16"),
+    ("bench_ctx32k", "1b ctx=32768 chunk=16"),
     ("bench_fused", "1b fused writeback"),
     ("bench_fused_rp16", "1b fused+rowpipe+chunk16"),
     ("bench_scatter", "1b scatter writeback"),
@@ -145,12 +148,13 @@ def main() -> None:
               {k: pd.get(k) for k in pd if k.startswith("ctx_")
                or k == "error"})
 
-    for tag in ("serve", "serve_warm"):
+    for tag in ("serve", "serve_warm", "serve_long", "serve_sarathi"):
         sv = load(d, tag)
         if sv:
             print(f"\n### {tag}:",
                   {k: sv.get(k) for k in ("req_per_s", "decode_tok_per_s",
                                           "ttft_ms", "ttft_spans_p50_ms",
+                                          "prefill_chunk", "sarathi",
                                           "errors")})
 
     kv = load(d, "kvwb")

@@ -22,9 +22,10 @@ def test_arm_matrix_covers_every_sweep_ab():
     assert names == [
         "paged_default", "paged_chunk16", "paged_chunk32",
         "paged_rowpipe", "paged_rowpipe16", "paged_chunk16_ctx2k",
-        "gemma2_softcap", "window_start", "fused_writeback",
-        "fused_rowpipe16", "mq_verify_k4", "prefill_pallas_s128",
-        "cp_partial_stats"]
+        "paged_chunk16_ctx8k", "paged_chunk16_ctx16k",
+        "paged_chunk16_ctx32k", "gemma2_softcap", "window_start",
+        "fused_writeback", "fused_rowpipe16", "mq_verify_k4",
+        "prefill_pallas_s128", "cp_partial_stats"]
 
 
 def test_one_real_arm_compiles():

@@ -638,6 +638,8 @@ class EngineAgent:
                 "host_received": self.kv_host_received,
             },
             "ttft_spans": self._span_summary(),
+            "sarathi_rides": sum(getattr(e, "sarathi_rides", 0)
+                                 for e in self.engines),
         })
 
     def _span_summary(self) -> dict[str, float]:
@@ -1269,6 +1271,11 @@ def main() -> None:
                    help="batching window for Generations delta pushes")
     p.add_argument("--speculate-k", type=int, default=0,
                    help="prompt-lookup speculation draft length (0 = off)")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked-prefill tokens per engine iteration "
+                        "(0 = whole-suffix installs); with a chunk set, "
+                        "mid chunks ride decode steps (Sarathi mixed "
+                        "programs) unless XLLM_SARATHI=0")
     args = p.parse_args()
 
     # Multi-host: join the process group (XLLM_MH_COORDINATOR /
@@ -1343,6 +1350,8 @@ def main() -> None:
         warmup_programs=jax.default_backend() != "cpu")
     if args.decode_horizon > 0:
         ecfg.decode_horizon = args.decode_horizon
+    if args.prefill_chunk > 0:
+        ecfg.prefill_chunk_tokens = args.prefill_chunk
     if args.speculate_k > 0:
         ecfg.speculate_k = args.speculate_k
     if args.tp and args.tp > 1:

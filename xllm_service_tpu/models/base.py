@@ -185,6 +185,12 @@ class ModelFamily:
     # Optional text-embedding forward ([B, S] tokens -> [B, D] pooled);
     # families without it 501 /v1/embeddings like the reference.
     embed_forward: Optional[Callable[..., Any]] = None
+    # Optional Sarathi-style mixed step: one forward that decodes the
+    # running batch AND writes/attends a sub-chunk of ONE prefilling
+    # sequence, sharing every projection/MLP GEMM (decode rows ride the
+    # prefill's weight stream). Families without it interleave chunked
+    # prefill and decode as separate programs.
+    mixed_decode_chunk_forward: Optional[Callable[..., Any]] = None
     # Whether every matmul in the family's forwards goes through
     # models/quant.quantized_einsum (weight-only int8). MoE expert stacks
     # and the MLA latent path are not quant-aware yet.

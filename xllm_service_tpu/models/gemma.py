@@ -33,6 +33,7 @@ from .llama import (
     decode_forward,
     embed_forward,
     init_params,
+    mixed_decode_chunk_forward,
     prefill_forward,
     verify_forward,
 )
@@ -92,5 +93,6 @@ register_model_family(ModelFamily(
     sharding_rules=LLAMA_STACKED_RULES,
     verify_forward=verify_forward,
     embed_forward=embed_forward,
+    mixed_decode_chunk_forward=mixed_decode_chunk_forward,
     supports_int8=True,
 ))

@@ -351,6 +351,63 @@ def decode_forward(params: Params, cfg: ModelConfig,
     return _unembed(params, cfg, x), kv_pages
 
 
+def mixed_decode_chunk_forward(
+        params: Params, cfg: ModelConfig,
+        dec_tokens: jax.Array,      # [B] last sampled tokens
+        dec_positions: jax.Array,   # [B] their absolute positions
+        chunk_tokens: jax.Array,    # [c] prefill sub-chunk (one sequence)
+        chunk_positions: jax.Array,  # [c] absolute positions in its prompt
+        kv_pages: jax.Array,        # [L, 2, P, n_kv, ps, hd]
+        dec_pt: jax.Array,          # [B, max_pages]
+        chunk_pt: jax.Array,        # [1, max_pages] the chunk seq's table
+        dec_clens: jax.Array,       # [B] incl. the new token
+        chunk_start: jax.Array,     # [] tokens of the prompt written so far
+        chunk_valid: jax.Array,     # [] live tokens in this sub-chunk (<=c)
+) -> tuple[jax.Array, jax.Array]:
+    """Sarathi-style mixed step (SURVEY §7.3 hard-part 2; the reference's
+    continuous-batching north star, BASELINE.json): one forward that
+    decodes the running batch AND writes+attends a sub-chunk of one
+    prefilling sequence. Every projection / MLP / unembed GEMM runs over
+    the CONCATENATED token rows, so at serving batch sizes the decode
+    rows ride the prefill chunk's weight stream instead of paying their
+    own HBM pass — and decode never pauses while a long prompt installs.
+
+    Returns (decode-row logits [B, V], updated kv_pages). The chunk rows'
+    logits are discarded (mid-prompt positions; the FINAL chunk samples
+    the first token through the normal install program). Padding rows
+    (chunk_valid < c) write to the garbage page and attend nothing.
+    """
+    B = dec_tokens.shape[0]
+    c = chunk_tokens.shape[0]
+    x = jnp.concatenate([_embed(params, cfg, dec_tokens),
+                         _embed(params, cfg, chunk_tokens)])   # [B+c, D]
+    rope_pos = jnp.concatenate([dec_positions, chunk_positions])
+    chunk_prefix = chunk_start[None]                           # [1]
+    chunk_lens = chunk_valid[None]                             # [1]
+
+    for l in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
+        h = _norm(x, lp["input_norm"]["scale"], cfg)
+        q, k, v = _project_qkv(lp, h, cfg, rope_pos)          # [B+c, H, hd]
+        # Chunk KV lands in the pool FIRST (its own pages; decode rows
+        # belong to different sequences, so order is immaterial there).
+        k_pages, v_pages = write_prefill_kv(
+            kv_pages[l, 0], kv_pages[l, 1], k[None, B:], v[None, B:],
+            chunk_pt, chunk_prefix, chunk_lens)
+        attn_d, k_pages, v_pages = decode_attention_step(
+            q[:B], k[:B], v[:B], k_pages, v_pages, dec_pt, dec_clens,
+            **_attn_opts(cfg, l))
+        attn_c = prefill_attention(
+            q[None, B:], k[None, B:], v[None, B:], k_pages, v_pages,
+            chunk_pt, chunk_prefix, chunk_lens, **_attn_opts(cfg, l))
+        attn = jnp.concatenate([attn_d, attn_c[0]])
+        attn = attn.reshape(B + c, cfg.q_size)
+        x = _attn_mlp_residual(lp, x, attn, cfg)
+        kv_pages = kv_pages.at[l, 0].set(k_pages)
+        kv_pages = kv_pages.at[l, 1].set(v_pages)
+    return _unembed(params, cfg, x[:B]), kv_pages
+
+
 register_model_family(ModelFamily(
     name="llama",
     init_params=init_params,
@@ -359,5 +416,6 @@ register_model_family(ModelFamily(
     sharding_rules=LLAMA_STACKED_RULES,
     verify_forward=verify_forward,
     embed_forward=embed_forward,
+    mixed_decode_chunk_forward=mixed_decode_chunk_forward,
     supports_int8=True,
 ))

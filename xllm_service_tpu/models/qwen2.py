@@ -14,6 +14,7 @@ from .llama import (
     decode_forward,
     init_params,
     embed_forward,
+    mixed_decode_chunk_forward,
     prefill_forward,
     verify_forward,
 )
@@ -41,5 +42,6 @@ register_model_family(ModelFamily(
     sharding_rules=LLAMA_STACKED_RULES,
     verify_forward=verify_forward,
     embed_forward=embed_forward,
+    mixed_decode_chunk_forward=mixed_decode_chunk_forward,
     supports_int8=True,
 ))
