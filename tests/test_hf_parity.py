@@ -52,21 +52,61 @@ def write_tokenizer(d: Path) -> None:
     }))
 
 
-def make_model_dir(d: Path, model_type: str) -> Path:
-    """Synthetic checkpoint transformers AND our loader both accept."""
-    cfg = tiny_config(dtype=jnp.float32,
-                      qkv_bias=(model_type == "qwen2"))
-    tensors = make_hf_checkpoint(d, cfg, qkv_bias=cfg.qkv_bias)
-    # from_pretrained needs an index for sharded safetensors.
+def _write_index(d: Path, tensors: dict) -> None:
+    """from_pretrained needs an index for two-shard safetensors."""
+    half = set(sorted(tensors)[:len(tensors) // 2])
     (d / "model.safetensors.index.json").write_text(json.dumps({
         "metadata": {},
         "weight_map": {
-            k: ("model-00001-of-00002.safetensors"
-                if k in sorted(tensors)[:len(tensors) // 2]
+            k: ("model-00001-of-00002.safetensors" if k in half
                 else "model-00002-of-00002.safetensors")
             for k in tensors}}))
-    arch = {"llama": "LlamaForCausalLM",
-            "qwen2": "Qwen2ForCausalLM"}[model_type]
+
+
+def make_model_dir(d: Path, model_type: str) -> Path:
+    """Synthetic checkpoint transformers AND our loader both accept."""
+    base = dict(
+        rope_theta=500000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=512, torch_dtype="float32",
+        tie_word_embeddings=False)
+    if model_type in ("llama", "qwen2"):
+        cfg = tiny_config(dtype=jnp.float32,
+                          qkv_bias=(model_type == "qwen2"))
+        tensors = make_hf_checkpoint(d, cfg, qkv_bias=cfg.qkv_bias)
+        _write_index(d, tensors)
+        arch = {"llama": "LlamaForCausalLM",
+                "qwen2": "Qwen2ForCausalLM"}[model_type]
+        extra = {}
+    elif model_type == "gemma2":
+        from xllm_service_tpu.models.gemma import gemma2_tiny_config
+        cfg = gemma2_tiny_config(dtype=jnp.float32, max_context_len=512,
+                                 sliding_window=8)
+        tensors = make_hf_checkpoint(d, cfg, lm_head=False)
+        _write_index(d, tensors)
+        arch = "Gemma2ForCausalLM"
+        extra = {
+            "hidden_activation": "gelu_pytorch_tanh",
+            "query_pre_attn_scalar": cfg.query_pre_attn_scalar,
+            "attn_logit_softcapping": cfg.attn_logit_softcap,
+            "final_logit_softcapping": cfg.final_logit_softcap,
+            "sliding_window": cfg.sliding_window,
+        }
+        base["tie_word_embeddings"] = True
+        base["rope_theta"] = cfg.rope_theta
+    elif model_type == "mixtral":
+        from xllm_service_tpu.models.mixtral import mixtral_tiny_config
+        from test_loader import make_hf_mixtral_checkpoint
+        cfg = mixtral_tiny_config(dtype=jnp.float32)
+        make_hf_mixtral_checkpoint(d, cfg)   # single model.safetensors
+        arch = "MixtralForCausalLM"
+        extra = {
+            "num_local_experts": cfg.num_experts,
+            "num_experts_per_tok": cfg.num_experts_per_token,
+        }
+        base["rope_theta"] = cfg.rope_theta
+    else:
+        raise AssertionError(model_type)
+    ffn = cfg.moe_ffn_size if model_type == "mixtral" else cfg.ffn_size
     (d / "config.json").write_text(json.dumps({
         "model_type": model_type, "architectures": [arch],
         "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
@@ -74,11 +114,8 @@ def make_model_dir(d: Path, model_type: str) -> Path:
         "num_attention_heads": cfg.num_heads,
         "num_key_value_heads": cfg.num_kv_heads,
         "head_dim": cfg.head_dim,
-        "intermediate_size": cfg.ffn_size,
-        "rope_theta": cfg.rope_theta, "rms_norm_eps": cfg.rms_eps,
-        "max_position_embeddings": cfg.max_context_len,
-        "tie_word_embeddings": False,
-        "torch_dtype": "float32",
+        "intermediate_size": ffn,
+        **base, **extra,
     }))
     write_tokenizer(d)
     return d
@@ -98,14 +135,16 @@ def test_hf_config_mapping(tmp_path):
         model_config_from_hf(tmp_path)
 
 
-@pytest.mark.parametrize("model_type", ["llama", "qwen2"])
+@pytest.mark.parametrize("model_type", ["llama", "qwen2", "gemma2",
+                                        "mixtral"])
 def test_greedy_parity_full_stack(tmp_path, model_type):
     d = make_model_dir(tmp_path, model_type)
     out = drill.run_drill(str(d), prompt="the capital of france is",
                           max_new=12, max_context=256)
     assert out["ok"], out
     assert out["tokens_matched"] == out["tokens_total"] == 12
-    assert out["model_type"] == model_type
+    assert out["model_type"] == {"gemma2": "gemma"}.get(model_type,
+                                                        model_type)
 
 
 def test_resolve_checkpoint_reports_unavailable(monkeypatch, tmp_path):

@@ -78,9 +78,14 @@ def model_config_from_hf(ckpt_dir: str | Path, *,
                 hf.get("query_pre_attn_scalar") or 0.0))
     elif mt == "mixtral":
         kw = _common(hf)
+        # HF's intermediate_size is the PER-EXPERT width; the MoE
+        # forward reads moe_ffn_size (first_dense_layers=0: every
+        # mixtral layer is sparse).
         kw.update(name="mixtral",
                   num_experts=hf["num_local_experts"],
-                  num_experts_per_token=hf["num_experts_per_tok"])
+                  num_experts_per_token=hf["num_experts_per_tok"],
+                  moe_ffn_size=hf["intermediate_size"],
+                  num_shared_experts=0, first_dense_layers=0)
     elif mt in ("deepseek_v2", "deepseek_v3"):
         kw = _common(hf)
         # MLA: the paged cache stores one [kv_lora_rank + rope] latent
