@@ -66,9 +66,8 @@ def _write_index(d: Path, tensors: dict) -> None:
 def make_model_dir(d: Path, model_type: str) -> Path:
     """Synthetic checkpoint transformers AND our loader both accept."""
     base = dict(
-        rope_theta=500000.0, rms_norm_eps=1e-5,
-        max_position_embeddings=512, torch_dtype="float32",
-        tie_word_embeddings=False)
+        rms_norm_eps=1e-5, max_position_embeddings=512,
+        torch_dtype="float32", tie_word_embeddings=False)
     if model_type in ("llama", "qwen2"):
         cfg = tiny_config(dtype=jnp.float32,
                           qkv_bias=(model_type == "qwen2"))
@@ -92,7 +91,6 @@ def make_model_dir(d: Path, model_type: str) -> Path:
             "sliding_window": cfg.sliding_window,
         }
         base["tie_word_embeddings"] = True
-        base["rope_theta"] = cfg.rope_theta
     elif model_type == "mixtral":
         from xllm_service_tpu.models.mixtral import mixtral_tiny_config
         from test_loader import make_hf_mixtral_checkpoint
@@ -103,9 +101,31 @@ def make_model_dir(d: Path, model_type: str) -> Path:
             "num_local_experts": cfg.num_experts,
             "num_experts_per_tok": cfg.num_experts_per_token,
         }
-        base["rope_theta"] = cfg.rope_theta
+    elif model_type == "deepseek_v2":
+        from xllm_service_tpu.models.deepseek_moe import tiny_mla_config
+        from test_loader import make_hf_deepseek_checkpoint
+        cfg = tiny_mla_config(dtype=jnp.float32, first_dense_layers=1)
+        tensors = make_hf_deepseek_checkpoint(d, cfg)
+        _write_index(d, tensors)
+        arch = "DeepseekV2ForCausalLM"
+        extra = {
+            "q_lora_rank": None,         # plain q_proj (lite-style)
+            "kv_lora_rank": cfg.kv_lora_rank,
+            "qk_nope_head_dim": cfg.qk_nope_head_dim,
+            "qk_rope_head_dim": cfg.qk_rope_head_dim,
+            "v_head_dim": cfg.v_head_dim,
+            "n_routed_experts": cfg.num_experts,
+            "num_experts_per_tok": cfg.num_experts_per_token,
+            "n_shared_experts": cfg.num_shared_experts,
+            "moe_intermediate_size": cfg.moe_ffn_size,
+            "first_k_dense_replace": cfg.first_dense_layers,
+            "topk_method": "greedy", "norm_topk_prob": False,
+            "routed_scaling_factor": 1.0,
+            "moe_layer_freq": 1,
+        }
     else:
         raise AssertionError(model_type)
+    base["rope_theta"] = cfg.rope_theta   # always the weights' theta
     ffn = cfg.moe_ffn_size if model_type == "mixtral" else cfg.ffn_size
     (d / "config.json").write_text(json.dumps({
         "model_type": model_type, "architectures": [arch],
@@ -136,15 +156,16 @@ def test_hf_config_mapping(tmp_path):
 
 
 @pytest.mark.parametrize("model_type", ["llama", "qwen2", "gemma2",
-                                        "mixtral"])
+                                        "mixtral", "deepseek_v2"])
 def test_greedy_parity_full_stack(tmp_path, model_type):
     d = make_model_dir(tmp_path, model_type)
     out = drill.run_drill(str(d), prompt="the capital of france is",
                           max_new=12, max_context=256)
     assert out["ok"], out
     assert out["tokens_matched"] == out["tokens_total"] == 12
-    assert out["model_type"] == {"gemma2": "gemma"}.get(model_type,
-                                                        model_type)
+    assert out["model_type"] == {"gemma2": "gemma",
+                                 "deepseek_v2": "deepseek_moe"}.get(
+        model_type, model_type)
 
 
 def test_resolve_checkpoint_reports_unavailable(monkeypatch, tmp_path):
