@@ -66,18 +66,21 @@ def drive(base: str, stats_url: str, args, vocab: int) -> dict:
                                                 args.prompt_tokens)],
         "max_tokens": 4, "temperature": 0, "ignore_eos": True}, timeout=600)
 
-    ttfts, e2es, errors = [], [], [0]
+    ttfts, e2es, tbts, errors = [], [], [], [0]
     lock = threading.Lock()
     work = list(range(args.requests))
+    # np.random.Generator is not thread-safe: give each worker its own
+    # spawned child stream instead of racing one shared state.
+    child_rngs = rng.spawn(args.concurrency)
 
-    def worker():
+    def worker(wrng):
         while True:
             with lock:
                 if not work:
                     return
                 work.pop()
-            prompt = [int(t) for t in rng.integers(10, vocab - 10,
-                                                   args.prompt_tokens)]
+            prompt = [int(t) for t in wrng.integers(10, vocab - 10,
+                                                    args.prompt_tokens)]
             t0 = time.perf_counter()
             try:
                 r = requests.post(base + "/v1/completions", json={
@@ -86,20 +89,33 @@ def drive(base: str, stats_url: str, args, vocab: int) -> dict:
                     "ignore_eos": True, "stream": True}, stream=True,
                     timeout=600)
                 ttft = None
+                gaps = []
+                last = None
                 for line in r.iter_lines():
-                    if line.startswith(b"data: ") and ttft is None:
-                        ttft = time.perf_counter() - t0
+                    if not line.startswith(b"data: "):
+                        continue
+                    now = time.perf_counter()
+                    if ttft is None:
+                        ttft = now - t0
+                    elif line != b"data: [DONE]":
+                        # Inter-delta gap after the first content delta:
+                        # the user-perceived stall metric (a decode pause
+                        # behind a prefill install shows up HERE, not in
+                        # averaged throughput).
+                        gaps.append((now - last) * 1000)
+                    last = now
                 e2e = time.perf_counter() - t0
                 with lock:
                     ttfts.append(ttft * 1000)
                     e2es.append(e2e * 1000)
+                    tbts.extend(gaps)
             except Exception:  # noqa: BLE001
                 with lock:
                     errors[0] += 1
 
     t_start = time.perf_counter()
-    threads = [threading.Thread(target=worker)
-               for _ in range(args.concurrency)]
+    threads = [threading.Thread(target=worker, args=(child_rngs[i],))
+               for i in range(args.concurrency)]
     for t in threads:
         t.start()
     for t in threads:
@@ -122,6 +138,14 @@ def drive(base: str, stats_url: str, args, vocab: int) -> dict:
                     "mean": round(statistics.mean(ttfts), 1) if ttfts else 0},
         "e2e_ms": {"p50": round(percentile(e2es, 50), 1),
                    "p99": round(percentile(e2es, 99), 1)},
+        # Coalesced SSE events (several deltas in one TCP read) record
+        # near-0 gaps that would deflate the p50 — percentiles run over
+        # gaps >= 0.5 ms; max is valid either way.
+        "tbt_ms": {"p50": round(percentile(
+                       [g for g in tbts if g >= 0.5], 50), 1),
+                   "p99": round(percentile(
+                       [g for g in tbts if g >= 0.5], 99), 1),
+                   "max": round(max(tbts), 1) if tbts else 0},
     }
     if getattr(args, "prefill_chunk", 0) > 0:
         report["prefill_chunk"] = args.prefill_chunk

@@ -153,9 +153,10 @@ def main() -> None:
         if sv:
             print(f"\n### {tag}:",
                   {k: sv.get(k) for k in ("req_per_s", "decode_tok_per_s",
-                                          "ttft_ms", "ttft_spans_p50_ms",
+                                          "ttft_ms", "tbt_ms",
+                                          "ttft_spans_p50_ms",
                                           "prefill_chunk", "sarathi",
-                                          "errors")})
+                                          "sarathi_rides", "errors")})
 
     kv = load(d, "kvwb")
     if kv:
