@@ -38,15 +38,8 @@ class Term:
             self.done.set()
 
 
-def test_soak_random_workload():
-    rng = np.random.default_rng(42)
-    cfg = EngineConfig(
-        model=tiny_config(dtype=jnp.float32, max_context_len=256),
-        num_pages=48, page_size=16, hash_block_size=32,
-        max_batch_size=4, max_seq_len=128,
-        prefill_buckets=(32, 64, 128),
-        decode_horizon=4, admission_horizon=2,
-        speculate_k=3)                    # spec path on (llama family)
+def _soak(cfg: EngineConfig, seed: int, plen_hi: int = 60):
+    rng = np.random.default_rng(seed)
     engine = InferenceEngine(cfg)
     engine.start()
 
@@ -56,7 +49,7 @@ def test_soak_random_workload():
 
     def feeder():
         for i in range(N):
-            plen = int(rng.integers(4, 60))
+            plen = int(rng.integers(4, plen_hi))
             max_tokens = int(rng.integers(1, 24))
             sp = SamplingParams(max_tokens=max_tokens,
                                 temperature=0.0, ignore_eos=True)
@@ -104,3 +97,31 @@ def test_soak_random_workload():
     assert engine._pending_spec is None
     st = engine.stats()
     assert st["waiting"] == 0
+    return engine
+
+
+def test_soak_random_workload():
+    _soak(EngineConfig(
+        model=tiny_config(dtype=jnp.float32, max_context_len=256),
+        num_pages=48, page_size=16, hash_block_size=32,
+        max_batch_size=4, max_seq_len=128,
+        prefill_buckets=(32, 64, 128),
+        decode_horizon=4, admission_horizon=2,
+        speculate_k=3),                   # spec path on (llama family)
+        seed=42)
+
+
+def test_soak_with_sarathi_chunking():
+    """Same randomized invariants with chunked prefill + mixed
+    decode+chunk rides in the mix (spec stays on, so ride/spec path
+    switching, cancels mid-ride, and preemption all interleave)."""
+    engine = _soak(EngineConfig(
+        model=tiny_config(dtype=jnp.float32, max_context_len=256),
+        num_pages=48, page_size=16, hash_block_size=32,
+        max_batch_size=4, max_seq_len=128,
+        prefill_buckets=(32, 64, 128),
+        decode_horizon=4, admission_horizon=2,
+        speculate_k=3, prefill_chunk_tokens=32),
+        seed=1234, plen_hi=100)
+    assert engine.sarathi_rides > 0, \
+        "soak never exercised the mixed decode+chunk path"
