@@ -4,6 +4,7 @@ bounds, engine serving, and tensor-parallel sharding."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from xllm_service_tpu.models.quant import (
     is_quantized,
@@ -150,3 +151,106 @@ class TestQuantEngine:
             return col.tokens
 
         assert run(None) == run(MeshConfig(model=2))
+
+
+class TestQuantMoE:
+    """Weight-only int8 over the MoE/MLA families (BASELINE config 4):
+    expert stacks [L, E, in, out] and the MLA per-head up-projections
+    quantize with dim-aligned scales; routers stay full precision."""
+
+    def _logits(self, cfg, quant: bool):
+        from xllm_service_tpu.models import deepseek_moe
+        from xllm_service_tpu.models.base import get_model_family
+        from xllm_service_tpu.models.quant import quantize_tree
+
+        fam = get_model_family(cfg.name)
+        params = fam.init_params(cfg, jax.random.PRNGKey(3))
+        if quant:
+            params = quantize_tree(params)
+        B, S = 2, 16
+        pages, ps = 16, 16
+        kv = jnp.zeros((cfg.num_layers, 2, pages, cfg.num_kv_heads, ps,
+                        cfg.head_dim), cfg.dtype)
+        pt = jnp.arange(1, B * 4 + 1, dtype=jnp.int32).reshape(B, 4)
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(1, cfg.vocab_size, (B, S)),
+            jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        logits, _ = fam.prefill_forward(
+            params, cfg, toks, pos, kv, pt,
+            jnp.zeros((B,), jnp.int32), jnp.full((B,), S, jnp.int32))
+        del deepseek_moe
+        return np.asarray(logits)
+
+    @pytest.mark.parametrize("make", ["mla", "moe", "mixtral"])
+    def test_moe_forward_close_to_f32(self, make):
+        from xllm_service_tpu.models.deepseek_moe import (tiny_mla_config,
+                                                          tiny_moe_config)
+        from xllm_service_tpu.models.mixtral import mixtral_tiny_config
+
+        cfg = {"mla": tiny_mla_config, "moe": tiny_moe_config,
+               "mixtral": mixtral_tiny_config}[make](dtype=jnp.float32)
+        ref, got = self._logits(cfg, False), self._logits(cfg, True)
+        cos = (ref * got).sum() / (np.linalg.norm(ref) *
+                                   np.linalg.norm(got))
+        # The scale-broadcast algebra is exact (unit-verified per spec);
+        # the tolerance here is pure int8 rounding on random-init
+        # weights, which is coarser for mixtral's 64-wide experts with
+        # every layer sparse (measured cos ~0.991 there, ~0.997 MLA).
+        assert cos > 0.99, cos
+        assert (ref.argmax(-1) == got.argmax(-1)).mean() > 0.9
+
+    def test_expert_scale_shapes(self):
+        from xllm_service_tpu.models.base import get_model_family
+        from xllm_service_tpu.models.deepseek_moe import tiny_mla_config
+        from xllm_service_tpu.models.quant import quantize_tree
+
+        cfg = tiny_mla_config(dtype=jnp.float32)
+        params = quantize_tree(get_model_family(cfg.name).init_params(
+            cfg, jax.random.PRNGKey(0)))
+        Lm = cfg.num_layers - cfg.first_dense_layers
+        ex = params["moe"]["experts"]
+        assert ex["gate_proj"]["kernel"]["q8"].dtype == jnp.int8
+        assert ex["gate_proj"]["kernel"]["scale"].shape == \
+            (Lm, cfg.num_experts, cfg.moe_ffn_size)
+        assert ex["down_proj"]["kernel"]["scale"].shape == \
+            (Lm, cfg.num_experts, cfg.hidden_size)
+        mla = params["layers"]
+        H = cfg.num_heads
+        assert mla["k_up"]["kernel"]["scale"].shape == \
+            (cfg.num_layers, H, cfg.kv_lora_rank)
+        assert mla["v_up"]["kernel"]["scale"].shape == \
+            (cfg.num_layers, H, cfg.v_head_dim)
+        # Routers stay full precision.
+        assert not is_quantized(params["moe"]["router"]["kernel"])
+
+    def test_ep_sharded_quant_engine_matches_single_device(self):
+        """Greedy tokens on an expert=2 x model=2 mesh equal the
+        single-device run for the SAME quantized MoE weights."""
+        from test_engine import Collector, run_requests
+        from xllm_service_tpu.common.request import SamplingParams
+        from xllm_service_tpu.engine.config import EngineConfig
+        from xllm_service_tpu.engine.engine import (EngineRequest,
+                                                    InferenceEngine)
+        from xllm_service_tpu.models.deepseek_moe import tiny_mla_config
+        from xllm_service_tpu.parallel.mesh import MeshConfig
+
+        def run(mesh_cfg):
+            cfg = EngineConfig(
+                model=tiny_mla_config(dtype=jnp.float32, quant="int8"),
+                model_family="deepseek_moe", mesh=mesh_cfg,
+                num_pages=64, page_size=16, hash_block_size=32,
+                max_batch_size=2, max_seq_len=128,
+                prefill_buckets=(32, 64, 128), decode_horizon=4)
+            engine = InferenceEngine(cfg)
+            col = Collector()
+            run_requests(engine, [EngineRequest(
+                service_request_id="qm", token_ids=[17, 19, 23, 29],
+                sampling=SamplingParams(max_tokens=6, temperature=0.0),
+                on_output=col)])
+            return col.tokens
+
+        single = run(None)
+        sharded = run(MeshConfig(expert=2, model=2))
+        assert len(single) == 6
+        assert single == sharded

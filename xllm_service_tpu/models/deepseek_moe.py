@@ -39,11 +39,26 @@ from ..ops.attention import (
 from ..parallel.mesh import AXIS_EXPERT, AXIS_MODEL
 from ..parallel.sharding import ShardingRules
 from .base import ModelConfig, ModelFamily, register_model_family
+from .quant import quantized_einsum
 from .llama import _project_qkv, _unembed
 
 Params = dict
 
 MOE_STACKED_RULES = ShardingRules(rules=[
+    # int8-quant `/scale` leaves FIRST (first match wins; see
+    # LLAMA_STACKED_RULES): a scale has the kernel's dims minus the
+    # contraction (-2), sharded with the kernel's OUTPUT dim.
+    (r"(k_up|v_up)/kernel/scale", P(None, AXIS_MODEL, None)),
+    (r"(kv_down|k_rope)/kernel/scale", P()),
+    (r"experts/(gate_proj|up_proj)/kernel/scale",
+     P(None, AXIS_EXPERT, AXIS_MODEL)),                # [L, E, F]
+    (r"experts/down_proj/kernel/scale", P(None, AXIS_EXPERT, None)),
+    (r"(shared|dense_mlp)/(gate_proj|up_proj)/kernel/scale",
+     P(None, AXIS_MODEL)),
+    (r"(shared|dense_mlp)/down_proj/kernel/scale", P()),
+    (r"(q_proj|k_proj|v_proj)/kernel/scale", P(None, AXIS_MODEL)),
+    (r"o_proj/kernel/scale", P()),
+    (r"lm_head/kernel/scale", P(AXIS_MODEL)),
     # MLA tensors: heads on the model axis; shared latent projections
     # replicated.
     (r"(k_up|v_up)/kernel", P(None, AXIS_MODEL, None, None)),  # [L, H, ., .]
@@ -190,17 +205,22 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     gates = jnp.zeros_like(logits).at[
         jnp.arange(x2.shape[0])[:, None], topi].set(gates_k)
 
-    g = jnp.einsum("td,edf->etf", x2, lp["experts"]["gate_proj"]["kernel"])
-    u = jnp.einsum("td,edf->etf", x2, lp["experts"]["up_proj"]["kernel"])
+    g = quantized_einsum("td,edf->etf", x2,
+                         lp["experts"]["gate_proj"]["kernel"])
+    u = quantized_einsum("td,edf->etf", x2,
+                         lp["experts"]["up_proj"]["kernel"])
     h = jax.nn.silu(g) * u                                 # [E, T, Fe]
-    eo = jnp.einsum("etf,efd->etd", h, lp["experts"]["down_proj"]["kernel"])
+    eo = quantized_einsum("etf,efd->etd", h,
+                          lp["experts"]["down_proj"]["kernel"])
     routed = jnp.einsum("etd,te->td", eo.astype(jnp.float32),
                         gates).astype(x.dtype)
 
     if "shared" in lp:
-        sg = jnp.einsum("td,df->tf", x2, lp["shared"]["gate_proj"]["kernel"])
-        su = jnp.einsum("td,df->tf", x2, lp["shared"]["up_proj"]["kernel"])
-        routed = routed + jnp.einsum(
+        sg = quantized_einsum("td,df->tf", x2,
+                              lp["shared"]["gate_proj"]["kernel"])
+        su = quantized_einsum("td,df->tf", x2,
+                              lp["shared"]["up_proj"]["kernel"])
+        routed = routed + quantized_einsum(
             "tf,fd->td", jax.nn.silu(sg) * su,
             lp["shared"]["down_proj"]["kernel"]).astype(routed.dtype)
     return routed.reshape(orig_shape)
@@ -222,18 +242,19 @@ def _mla_attention(lp, cfg, h, mode, k_pages, v_pages, page_table,
     dr, dc, dv = cfg.qk_rope_head_dim, cfg.kv_lora_rank, cfg.v_head_dim
 
     # Latent + decoupled rope key (one shared "kv head").
-    c = jnp.einsum("...d,dc->...c", h, lp["kv_down"]["kernel"])
+    c = quantized_einsum("...d,dc->...c", h, lp["kv_down"]["kernel"])
     c = rms_norm(c, lp["kv_norm"]["scale"], cfg.rms_eps)
-    k_r = jnp.einsum("...d,dr->...r", h, lp["k_rope"]["kernel"])
+    k_r = quantized_einsum("...d,dr->...r", h, lp["k_rope"]["kernel"])
     k_r = apply_rope(k_r[..., None, :], positions, cfg.rope_theta)[..., 0, :]
     entry = jnp.concatenate([c, k_r], axis=-1)[..., None, :]  # [..., 1, dc+dr]
 
     # Queries: nope part absorbed through the K up-projection.
-    q = jnp.einsum("...d,df->...f", h, lp["q_proj"]["kernel"])
+    q = quantized_einsum("...d,df->...f", h, lp["q_proj"]["kernel"])
     q = q.reshape(*q.shape[:-1], H, dn + dr)
     q_nope, q_rope = q[..., :dn], q[..., dn:]
     q_rope = apply_rope(q_rope, positions, cfg.rope_theta)
-    q_c = jnp.einsum("...hd,hdc->...hc", q_nope, lp["k_up"]["kernel"])
+    q_c = quantized_einsum("...hd,hdc->...hc", q_nope,
+                           lp["k_up"]["kernel"])
     q_lat = jnp.concatenate([q_c, q_rope], axis=-1)   # [..., H, dc+dr]
     # True scale is over the uncompressed per-head key width.
     scale = 1.0 / ((dn + dr) ** 0.5)
@@ -256,15 +277,16 @@ def _mla_attention(lp, cfg, h, mode, k_pages, v_pages, page_table,
     # The weighted sum over [c ‖ k_rope] entries: keep the latent part,
     # apply the absorbed V up-projection per head.
     ctx = attn[..., :dc]                              # [..., H, dc]
-    out = jnp.einsum("...hc,hcv->...hv", ctx, lp["v_up"]["kernel"])
+    out = quantized_einsum("...hc,hcv->...hv", ctx,
+                           lp["v_up"]["kernel"])
     return out.reshape(*out.shape[:-2], H * dv), k_pages, v_pages
 
 
 def _dense_mlp(mp: Params, x: jax.Array) -> jax.Array:
-    g = jnp.einsum("...d,df->...f", x, mp["gate_proj"]["kernel"])
-    u = jnp.einsum("...d,df->...f", x, mp["up_proj"]["kernel"])
-    return jnp.einsum("...f,fd->...d", jax.nn.silu(g) * u,
-                      mp["down_proj"]["kernel"])
+    g = quantized_einsum("...d,df->...f", x, mp["gate_proj"]["kernel"])
+    u = quantized_einsum("...d,df->...f", x, mp["up_proj"]["kernel"])
+    return quantized_einsum("...f,fd->...d", jax.nn.silu(g) * u,
+                            mp["down_proj"]["kernel"])
 
 
 def _run_layers(params, cfg, x, kv_pages, mode, page_table, prefix_lens,
@@ -298,7 +320,8 @@ def _run_layers(params, cfg, x, kv_pages, mode, page_table, prefix_lens,
                 attn, k_pages, v_pages = decode_attention_step(
                     q, k, v, k_pages, v_pages, page_table, context_lens)
             attn = attn.reshape(*attn.shape[:-2], cfg.q_size)
-        x = x + jnp.einsum("...f,fd->...d", attn, lp["o_proj"]["kernel"])
+        x = x + quantized_einsum("...f,fd->...d", attn,
+                                 lp["o_proj"]["kernel"])
         h2 = rms_norm(x, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         if l < Ld:
             x = x + _dense_mlp(
@@ -371,4 +394,5 @@ register_model_family(ModelFamily(
     sharding_rules=MOE_STACKED_RULES,
     verify_forward=verify_forward,
     embed_forward=embed_forward,
+    supports_int8=True,
 ))

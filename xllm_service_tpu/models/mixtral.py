@@ -49,4 +49,5 @@ register_model_family(ModelFamily(
     sharding_rules=MOE_STACKED_RULES,
     verify_forward=verify_forward,
     embed_forward=embed_forward,
+    supports_int8=True,
 ))

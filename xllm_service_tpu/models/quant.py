@@ -21,8 +21,10 @@ sharding rules carry explicit `/scale` patterns (the `q8` tensor keeps
 the kernel's own spec). Embeddings (gather), norms, biases, and routers
 stay bf16/f32 — they are a rounding-error-sensitive sliver of the bytes.
 
-Enable with ``ModelConfig.quant = "int8"`` (llama/qwen2 families; the
-engine quantizes right after init/load, before sharding).
+Enable with ``ModelConfig.quant = "int8"`` (llama / qwen2 / gemma /
+deepseek-MoE incl. the MLA projections and the [L, E, in, out] expert
+stacks / mixtral; the engine quantizes right after init/load, before
+sharding — expert scales shard with their kernels' expert+output axes).
 """
 
 from __future__ import annotations
@@ -32,9 +34,13 @@ import jax.numpy as jnp
 
 # Projection matrices whose `kernel` gets quantized. The contraction dim
 # of every one of these is the kernel's -2 axis in the model einsums
-# (models/llama.py), so the per-output-channel scale reduces over -2.
+# (models/llama.py, models/deepseek_moe.py — incl. the [L, E, in, out]
+# expert stacks and the MLA per-head [L, H, in, out] up-projections), so
+# the per-output-channel scale reduces over -2. Routers and the MLA
+# kv_a layernorm stay full precision (rounding-sensitive slivers).
 QUANT_KERNELS = ("q_proj", "k_proj", "v_proj", "o_proj",
-                 "gate_proj", "up_proj", "down_proj", "lm_head")
+                 "gate_proj", "up_proj", "down_proj", "lm_head",
+                 "kv_down", "k_rope", "k_up", "v_up")
 
 
 def quantize_kernel(w: jax.Array) -> dict:
@@ -52,11 +58,29 @@ def is_quantized(kern) -> bool:
 
 
 def quantized_einsum(spec: str, x: jax.Array, kern) -> jax.Array:
-    """Matmul against a plain or quantized kernel (same einsum spec)."""
-    if is_quantized(kern):
-        y = jnp.einsum(spec, x, kern["q8"].astype(x.dtype))
-        return y * kern["scale"].astype(y.dtype)
-    return jnp.einsum(spec, x, kern)
+    """Matmul against a plain or quantized kernel (same einsum spec).
+
+    The scale has the kernel's dims MINUS the contraction (axis -2) and
+    is aligned to the output by einsum letter: for the llama specs
+    ("...d,df->...f") it is the trailing dim and multiplies directly;
+    for the MoE expert stacks ("td,edf->etf") the expert dim leads and a
+    middle token dim intervenes, so the scale is transposed/expanded to
+    the output's named dims before the multiply."""
+    if not is_quantized(kern):
+        return jnp.einsum(spec, x, kern)
+    y = jnp.einsum(spec, x, kern["q8"].astype(x.dtype))
+    ins, out = spec.split("->")
+    k_letters = ins.split(",")[1].replace("...", "")
+    scale_letters = k_letters[:-2] + k_letters[-1]
+    named_out = out.replace("...", "")     # y's trailing named dims
+    assert set(scale_letters) <= set(named_out), spec
+    present = [o for o in named_out if o in scale_letters]
+    s = jnp.transpose(kern["scale"],
+                      [scale_letters.index(o) for o in present])
+    s = s.reshape([s.shape[present.index(o)] if o in present else 1
+                   for o in named_out])
+    # len(named_out) trailing dims: broadcasts over y's batch dims.
+    return y * s.astype(y.dtype)
 
 
 def quantize_tree(params: dict) -> dict:
