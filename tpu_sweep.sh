@@ -12,7 +12,7 @@ mkdir -p tpu_results
 DEADLINE=$(( $(date +%s) + ${SWEEP_BUDGET_S:-40000} ))   # default: ~11h
 
 probe() {
-  timeout 150 python - <<'EOF' >/dev/null 2>&1
+  timeout -k 10 150 python - <<'EOF' >/dev/null 2>&1
 import jax
 assert jax.default_backend() != "cpu"
 EOF
@@ -24,7 +24,7 @@ EOF
 FAILED_STEPS=""
 run_step() {
   local name="$1" to="$2"; shift 2
-  timeout "$to" "$@" > "tpu_results/$name.json" 2> "tpu_results/$name.err"
+  timeout -k 15 "$to" "$@" > "tpu_results/$name.json" 2> "tpu_results/$name.err"
   local rc=$?
   echo "$name rc=$rc $(head -c 200 "tpu_results/$name.json")"
   if [ "$rc" -ne 0 ]; then
