@@ -38,6 +38,7 @@ def load(d: Path, name: str):
 BENCH_ARMS = [
     ("bench", "1b bf16 (default)"),
     ("bench_8b", "8B int8 (north-star scale)"),
+    ("bench_moe", "MLA+MoE int8 (config-4 datum)"),
     ("bench_int8", "1b int8"),
     ("bench_chunk16", "1b chunk=16"),
     ("bench_chunk32", "1b chunk=32"),

@@ -85,6 +85,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # 2. FIRST north-star-scale number: Llama-3-8B shapes, weight-only int8
     run_step bench_8b 1800 env XLLM_BENCH_MODEL=8b python bench.py \
       || { sleep 60; continue; }
+    # 2b. FIRST MoE on-chip number: MLA+MoE bench shape, int8 experts
+    # (BASELINE config 4's single-chip datum)
+    run_step bench_moe 1800 env XLLM_BENCH_MODEL=moe python bench.py \
+      || { sleep 60; continue; }
     # 3. 1b int8 A/B
     run_step bench_int8 900 env XLLM_QUANT=int8 python bench.py \
       || { sleep 60; continue; }

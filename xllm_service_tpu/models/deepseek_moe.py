@@ -95,6 +95,18 @@ def deepseek_v2_lite_config() -> ModelConfig:
                        first_dense_layers=1)
 
 
+def bench_moe_config() -> ModelConfig:
+    """~3.5B-total / ~0.9B-active MLA+MoE bench shape — V2-Lite's exact
+    layer geometry (dataclasses.replace keeps them locked together) cut
+    to 12 layers / 32 experts / 32k vocab so it fits one v5e chip
+    weight-only int8 with a latent KV pool: the single-chip datum for
+    BASELINE config 4 (expert-parallel decode measures relative to it)."""
+    import dataclasses
+    return dataclasses.replace(deepseek_v2_lite_config(),
+                               vocab_size=32768, num_layers=12,
+                               max_context_len=4096, num_experts=32)
+
+
 def tiny_moe_config(**kw) -> ModelConfig:
     defaults = dict(name="deepseek_moe", vocab_size=512, hidden_size=128,
                     num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
