@@ -107,6 +107,48 @@ def test_ride_respects_final_chunk_boundary():
     engine.stop()
 
 
+def test_gemma2_rides_with_softcap():
+    """The mixed program composes with the gemma-2 attention extras
+    (score softcap, sliding window, query scale as static params) —
+    token-exact vs the unchunked engine."""
+    from xllm_service_tpu.models.gemma import gemma2_tiny_config
+
+    def eng(chunk):
+        return InferenceEngine(EngineConfig(
+            model=gemma2_tiny_config(dtype=jnp.float32,
+                                     max_context_len=512),
+            model_family="gemma",
+            num_pages=96, page_size=16, hash_block_size=32,
+            max_batch_size=4, max_seq_len=512,
+            prefill_buckets=(32, 64, 512), prefill_chunk_tokens=chunk))
+
+    plain = eng(0)
+    want_short = naive_greedy(plain, list(range(11, 21)), 30)
+    want_long = naive_greedy(plain, list(range(5, 205)), 4)
+
+    engine = eng(32)
+    short, long_ = Collector(), Collector()
+    engine.submit(EngineRequest(
+        "short", token_ids=list(range(11, 21)),
+        sampling=SamplingParams(max_tokens=30, temperature=0.0,
+                                ignore_eos=True), on_output=short))
+    engine.step()
+    engine.submit(EngineRequest(
+        "long", token_ids=list(range(5, 205)),
+        sampling=SamplingParams(max_tokens=4, temperature=0.0,
+                                ignore_eos=True), on_output=long_))
+    rode = 0
+    for _ in range(300):
+        engine.step()
+        rode += bool(engine._rode_chunk)
+        if short.done.is_set() and long_.done.is_set():
+            break
+    engine.stop()
+    assert rode >= 1, "gemma-2 never took the mixed path"
+    assert short.tokens == want_short
+    assert long_.tokens == want_long
+
+
 def test_n_fanout_and_cancel_under_ride():
     """Cancellation of a riding prefill returns its pages/slot."""
     engine = make_engine(chunk=32)
