@@ -1263,6 +1263,11 @@ def main() -> None:
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel mesh size (0 = single device); "
                         "spans hosts when a multi-host group is joined")
+    p.add_argument("--device-offset", type=int, default=0,
+                   help="first device index for this instance's mesh: "
+                        "co-hosted instances (e.g. a PREFILL/DECODE "
+                        "pair on one pod slice) own DISJOINT device "
+                        "groups instead of stacking on device 0")
     p.add_argument("--quant", default="", choices=["", "int8"],
                    help="weight-only quantization (models/quant.py)")
     p.add_argument("--decode-horizon", type=int, default=0,
@@ -1358,6 +1363,12 @@ def main() -> None:
         from ..parallel.mesh import MeshConfig
 
         ecfg.mesh = MeshConfig(model=args.tp)
+    if args.device_offset:
+        if not (args.tp and args.tp > 1):
+            p.error("--device-offset requires --tp > 1 (a mesh to place)")
+        if args.device_offset < 0:
+            p.error("--device-offset must be >= 0")
+        ecfg.mesh_device_offset = args.device_offset
     params = None
     if args.checkpoint_path:
         from pathlib import Path
@@ -1366,11 +1377,15 @@ def main() -> None:
         from ..models import loader as _loader
         from ..parallel.mesh import build_mesh as _build_mesh
 
-        # Slice to exactly the devices the mesh asks for (matches
-        # InferenceEngine's own construction; hosts may expose more).
+        # Slice to exactly the devices the mesh asks for, starting at
+        # the instance's device offset (matches InferenceEngine's own
+        # construction — weights must shard onto the SAME device group
+        # the engine runs on, or a co-hosted pair's params collide on
+        # device 0's HBM).
+        off = ecfg.mesh_device_offset
         mesh = _build_mesh(
             ecfg.mesh,
-            devices=jax.devices()[:ecfg.mesh.num_devices()]) \
+            devices=jax.devices()[off:off + ecfg.mesh.num_devices()]) \
             if ecfg.mesh else None
         fam = _models.get_model_family(ecfg.model_family)
         if list(Path(args.checkpoint_path).glob("*.safetensors")):
