@@ -156,6 +156,7 @@ class TestAgentMetrics:
         assert r.status_code == 200
         assert "engine_generated_tokens_total" in r.text
         assert "engine_kv_usage_perc" in r.text
+        assert "engine_sarathi_rides_total" in r.text
 
 
 class TestLiveProfilingTables:

@@ -685,6 +685,9 @@ class EngineAgent:
             f"{max(e.recent_max_tbt_ms for e in self.engines):.3f}",
             "# TYPE engine_dp_size gauge",
             f"engine_dp_size {len(self.engines)}",
+            "# TYPE engine_sarathi_rides_total counter",
+            f"engine_sarathi_rides_total "
+            f"{sum(getattr(e, 'sarathi_rides', 0) for e in self.engines)}",
         ]
         spans = self._span_summary()
         lines += [
