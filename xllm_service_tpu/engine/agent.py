@@ -623,6 +623,8 @@ class EngineAgent:
             "cached_blocks": sum(s["cached_blocks"] for s in per),
             "total_generated": sum(s["total_generated"] for s in per),
             "dp_size": len(self.engines),
+            "sarathi_rides": sum(getattr(e, "sarathi_rides", 0)
+                                 for e in self.engines),
         }
 
     async def _h_health(self, req: web.Request) -> web.Response:
@@ -638,8 +640,6 @@ class EngineAgent:
                 "host_received": self.kv_host_received,
             },
             "ttft_spans": self._span_summary(),
-            "sarathi_rides": sum(getattr(e, "sarathi_rides", 0)
-                                 for e in self.engines),
         })
 
     def _span_summary(self) -> dict[str, float]:
@@ -686,8 +686,7 @@ class EngineAgent:
             "# TYPE engine_dp_size gauge",
             f"engine_dp_size {len(self.engines)}",
             "# TYPE engine_sarathi_rides_total counter",
-            f"engine_sarathi_rides_total "
-            f"{sum(getattr(e, 'sarathi_rides', 0) for e in self.engines)}",
+            f"engine_sarathi_rides_total {st['sarathi_rides']}",
         ]
         spans = self._span_summary()
         lines += [
