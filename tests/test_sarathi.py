@@ -107,6 +107,46 @@ def test_ride_respects_final_chunk_boundary():
     engine.stop()
 
 
+def test_pressure_rides_consume_four_chunk_spans():
+    """With arrivals waiting, a truly-long prefill rides 4C per decode
+    call (one fused step) instead of pacing one chunk at a time —
+    token-exact either way."""
+    plain = make_engine(chunk=0)
+    want = [naive_greedy(plain, list(range(7 + i, 207 + i)), 3)
+            for i in range(3)]
+
+    engine = make_engine(chunk=16)   # 200-token prompts >> 4*16
+    warm = Collector()
+    engine.submit(EngineRequest(
+        "warm", token_ids=list(range(2, 12)),
+        sampling=SamplingParams(max_tokens=60, temperature=0.0,
+                                ignore_eos=True), on_output=warm))
+    engine.step()
+    cols = [Collector() for _ in range(3)]
+    for i, c in enumerate(cols):
+        engine.submit(EngineRequest(
+            f"L{i}", token_ids=list(range(7 + i, 207 + i)),
+            sampling=SamplingParams(max_tokens=3, temperature=0.0,
+                                    ignore_eos=True), on_output=c))
+    big_rides = 0
+    for _ in range(400):
+        if engine._prefillings:
+            st = engine._prefillings[0]
+            before = st["written"]
+            engine.step()
+            if engine._rode_chunk and st["written"] - before > \
+                    engine.cfg.prefill_chunk_tokens:
+                big_rides += 1
+        else:
+            engine.step()
+        if all(c.done.is_set() for c in cols):
+            break
+    engine.stop()
+    assert big_rides >= 1, "pressure span never engaged"
+    for i, c in enumerate(cols):
+        assert c.tokens == want[i], i
+
+
 def test_gemma2_rides_with_softcap():
     """The mixed program composes with the gemma-2 attention extras
     (score softcap, sliding window, query scale as static params) —
