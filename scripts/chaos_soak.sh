@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Chaos soak: run the fault-plane drills in a loop with randomized seeds
+# and report the pass rate.
+#
+# The drills themselves are deterministic per seed (the fault plane draws
+# all randomness from one seeded RNG), so any failing iteration can be
+# replayed exactly with:   XLLM_CHAOS_SEED=<seed> pytest -m chaos
+#
+# Usage: scripts/chaos_soak.sh [iterations] [extra pytest args...]
+set -u
+
+ITERS="${1:-20}"
+shift 2>/dev/null || true
+cd "$(dirname "$0")/.."
+
+pass=0
+fail=0
+failed_seeds=()
+for i in $(seq 1 "$ITERS"); do
+    seed=$((RANDOM * 32768 + RANDOM))
+    echo "=== chaos iteration $i/$ITERS (seed=$seed) ==="
+    if JAX_PLATFORMS=cpu XLLM_CHAOS_SEED=$seed \
+        python -m pytest tests/test_chaos_failover.py -q -m chaos \
+        -p no:cacheprovider "$@"; then
+        pass=$((pass + 1))
+    else
+        fail=$((fail + 1))
+        failed_seeds+=("$seed")
+    fi
+done
+
+echo
+echo "chaos soak: $pass/$ITERS passed"
+if [ "$fail" -gt 0 ]; then
+    echo "failing seeds (replay with XLLM_CHAOS_SEED=<seed>): ${failed_seeds[*]}"
+    exit 1
+fi

@@ -1,0 +1,386 @@
+"""Chaos drills: deterministic fault injection driving transparent failover.
+
+The acceptance bar (ISSUE 1): with a fault killing the serving (decode
+stage) instance mid-stream, in-flight requests complete with byte-identical
+output to a no-fault run; with retry budget 0 the same drill returns a
+prompt 503 (no hang); stale-incarnation replays are dropped; per-instance
+load accounting returns to zero after every drill.
+
+All drills run against the seeded fault plane (`XLLM_CHAOS_SEED` selects
+the schedule; `scripts/chaos_soak.sh` sweeps seeds) and are fast enough
+for tier-1 (none is marked slow).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.faults import FAULTS, FaultInjected, FaultPlane
+from xllm_service_tpu.common.metrics import (
+    FAILOVER_SUCCESS_TOTAL,
+    REQUESTS_CANCELLED_ON_FAILURE_TOTAL,
+)
+from xllm_service_tpu.common.request import Request, RequestOutput, SequenceOutput
+from xllm_service_tpu.common.call_data import CollectingConnection
+from xllm_service_tpu.common.types import InstanceType
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.scheduler.scheduler import Scheduler
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import FakeChannel, make_meta, wait_until
+
+pytestmark = pytest.mark.chaos
+
+SEED = int(os.environ.get("XLLM_CHAOS_SEED", "0"))
+
+REPLY = "Resilience is the art of continuing exactly where you left off."
+
+
+@pytest.fixture(autouse=True)
+def _armed_fault_plane():
+    FAULTS.configure((), seed=SEED)
+    yield
+    FAULTS.clear()
+
+
+def _opts(**kw) -> ServiceOptions:
+    base = dict(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        lease_ttl_s=0.5, reconcile_interval_s=0.05,
+        heartbeat_silence_to_suspect_s=0.3,
+        detect_disconnected_instance_interval_s=0.3,
+        health_probe_attempts=1, health_probe_timeout_s=0.2,
+        sync_interval_s=0.2,
+        failover_backoff_base_s=0.05, failover_backoff_max_s=0.3,
+        rpc_backoff_base_s=0.02, rpc_backoff_max_s=0.1)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def _engine(store, **cfg_kw) -> FakeEngine:
+    cfg = FakeEngineConfig(reply_text=REPLY, chunk_size=4, delay_s=0.05,
+                           heartbeat_interval_s=0.1, lease_ttl_s=0.5,
+                           **cfg_kw)
+    return FakeEngine(InMemoryCoordination(store), cfg).start()
+
+
+def _base(master) -> str:
+    return f"http://127.0.0.1:{master.http_port}"
+
+
+def _loads_zero(master) -> bool:
+    mgr = master.scheduler.instance_mgr
+    with mgr._metrics_lock:
+        return all(
+            rl.num_prefill_requests == 0 and rl.num_prefill_tokens == 0
+            and rl.num_decode_requests == 0 and rl.num_decode_tokens == 0
+            for rl in mgr._request_loads.values())
+
+
+def _stream_completion(master, timeout=60) -> tuple[str, list[str]]:
+    """Returns (concatenated text, raw finish_reasons) of one streamed
+    completion; raises on an error payload."""
+    r = requests.post(_base(master) + "/v1/completions", json={
+        "model": "fake-model", "prompt": "chaos", "stream": True,
+        "max_tokens": 1000}, stream=True, timeout=timeout)
+    assert r.status_code == 200, r.text
+    text, finishes = "", []
+    for line in r.iter_lines():
+        if not line.startswith(b"data: "):
+            continue
+        data = line[len(b"data: "):]
+        if data == b"[DONE]":
+            break
+        obj = json.loads(data)
+        if "error" in obj:
+            raise RuntimeError(f"stream error: {obj['error']}")
+        for c in obj.get("choices", ()):
+            text += c.get("text", "")
+            if c.get("finish_reason"):
+                finishes.append(c["finish_reason"])
+    return text, finishes
+
+
+@pytest.fixture()
+def duo_cluster(store):
+    """Master + two MIX fake engines. RR collapses each request onto a
+    single MIX instance (it serves both stages), so killing it mid-stream
+    IS killing the request's decode-stage instance."""
+    master = Master(_opts(), coord=InMemoryCoordination(store))
+    master.start()
+    engines = [_engine(store), _engine(store)]
+    assert wait_until(
+        lambda: all(master.scheduler.instance_mgr.get_instance_meta(e.name)
+                    is not None for e in engines), timeout=5)
+    yield master, engines
+    for e in engines:
+        e.stop()
+    master.stop()
+
+
+class TestMidstreamCrashFailover:
+    def test_stream_survives_decode_crash_byte_identical(self, duo_cluster):
+        master, engines = duo_cluster
+        # No-fault reference run.
+        expected, _ = _stream_completion(master)
+        assert expected == REPLY
+
+        # Crash the serving instance right before its 5th delta (the
+        # request is decode-stage by then: tokens are already streaming).
+        FAULTS.configure([dict(point="engine.token", action="crash",
+                               after=4, max_fires=1)], seed=SEED)
+        success_before = FAILOVER_SUCCESS_TOTAL.value()
+        text, finishes = _stream_completion(master)
+        assert text == expected          # byte-identical, no gap, no dup
+        assert finishes == ["stop"]
+        assert FAILOVER_SUCCESS_TOTAL.value() == success_before + 1
+        # Exactly one engine died; the survivor finished the stream.
+        assert sum(1 for e in engines if not e._alive) == 1
+        # Load accounting drains back to zero on the survivor.
+        assert wait_until(lambda: _loads_zero(master), timeout=5)
+
+    def test_concurrent_inflight_requests_all_complete(self, duo_cluster):
+        """Acceptance: 100% of in-flight requests complete across an
+        instance death (those on the dead instance fail over; the rest are
+        untouched)."""
+        master, engines = duo_cluster
+        FAULTS.configure([dict(point="engine.token", action="crash",
+                               after=10, max_fires=1)], seed=SEED)
+        results: dict[int, str] = {}
+        errors: list[BaseException] = []
+
+        def run(i: int) -> None:
+            try:
+                results[i], _ = _stream_completion(master)
+            except BaseException as e:  # noqa: BLE001 — collected
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)   # spread arrivals across the RR ring
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(results) == 4
+        assert all(text == REPLY for text in results.values()), results
+        assert sum(1 for e in engines if not e._alive) == 1
+        assert wait_until(lambda: _loads_zero(master), timeout=5)
+
+
+class TestDispatchFailureFailover:
+    def test_engine_5xx_on_accept_fails_over(self, duo_cluster):
+        """The initial forward bounces off a 503ing engine: the request is
+        re-dispatched (MIX routing with empty decode_name must not be
+        mistaken for the dead instance) and completes."""
+        master, engines = duo_cluster
+        FAULTS.configure([dict(point="engine.accept", action="error",
+                               max_fires=1)], seed=SEED)
+        success_before = FAILOVER_SUCCESS_TOTAL.value()
+        text, finishes = _stream_completion(master)
+        assert text == REPLY
+        assert finishes == ["stop"]
+        assert FAILOVER_SUCCESS_TOTAL.value() == success_before + 1
+        assert all(e._alive for e in engines)   # nobody died; pure re-route
+        assert wait_until(lambda: _loads_zero(master), timeout=5)
+
+
+class TestRetryBudget:
+    def test_budget_zero_prompt_503_no_hang(self, store):
+        """failover_max_retries=0 restores reference cancel-and-surface:
+        the stream errors promptly (no hang until request timeout) and no
+        load accounting leaks."""
+        master = Master(_opts(failover_max_retries=0, request_timeout_s=60),
+                        coord=InMemoryCoordination(store))
+        master.start()
+        engine = _engine(store)
+        try:
+            assert wait_until(
+                lambda: master.scheduler.instance_mgr.get_instance_meta(
+                    engine.name) is not None, timeout=5)
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=4, max_fires=1)], seed=SEED)
+            cancelled_before = REQUESTS_CANCELLED_ON_FAILURE_TOTAL.value()
+            start = time.time()
+            with pytest.raises(RuntimeError, match="stream error"):
+                _stream_completion(master, timeout=30)
+            assert time.time() - start < 20   # prompt, not a timeout hang
+            assert REQUESTS_CANCELLED_ON_FAILURE_TOTAL.value() == \
+                cancelled_before + 1
+            assert wait_until(lambda: _loads_zero(master), timeout=5)
+        finally:
+            engine.stop()
+            master.stop()
+
+    def test_budget_exhausted_with_no_survivors_503(self, store):
+        """Budget > 0 but nowhere to go: retries burn out against an empty
+        fleet and the client still gets a prompt 503."""
+        master = Master(_opts(failover_max_retries=2, request_timeout_s=60),
+                        coord=InMemoryCoordination(store))
+        master.start()
+        engine = _engine(store)
+        try:
+            assert wait_until(
+                lambda: master.scheduler.instance_mgr.get_instance_meta(
+                    engine.name) is not None, timeout=5)
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=4, max_fires=1)], seed=SEED)
+            start = time.time()
+            with pytest.raises(RuntimeError, match="stream error"):
+                _stream_completion(master, timeout=30)
+            assert time.time() - start < 20
+            assert wait_until(lambda: _loads_zero(master), timeout=5)
+        finally:
+            engine.stop()
+            master.stop()
+
+
+class TestIdempotentReplay:
+    def test_stale_incarnation_delta_dropped(self, store):
+        """A delta stamped with an incarnation the request is no longer
+        bound to is dropped (and its sender told to stop)."""
+        FakeChannel.reset()
+        coord = InMemoryCoordination(store)
+        sched = Scheduler(ServiceOptions(reconcile_interval_s=0.05,
+                                         sync_interval_s=0.1,
+                                         lease_ttl_s=0.2),
+                          coord=coord, start_threads=False)
+        sched.instance_mgr._channel_factory = FakeChannel.factory
+        try:
+            sched.instance_mgr.register_instance(
+                make_meta("m1", InstanceType.MIX, incarnation_id="INC-NEW"),
+                link_peers=False)
+            req = Request(service_request_id="sid-1", request_id="r",
+                          model="m", stream=True, prompt="hello")
+            assert sched.schedule(req).ok()
+            conn = CollectingConnection(stream=True)
+            sched.record_new_request(req, conn, "completion")
+            assert req.prefill_incarnation == "INC-NEW"
+
+            # Replay from the dead incarnation: dropped, sender stopped.
+            stale = RequestOutput(
+                service_request_id="sid-1", instance="m1",
+                incarnation="INC-OLD",
+                outputs=[SequenceOutput(index=0, text="ZOMBIE",
+                                        token_ids=[9])])
+            assert not sched.handle_generation(stale)
+            # Current incarnation flows through.
+            fresh = RequestOutput(
+                service_request_id="sid-1", instance="m1",
+                incarnation="INC-NEW",
+                outputs=[SequenceOutput(index=0, text="ok", token_ids=[0])])
+            assert sched.handle_generation(fresh)
+            sched._output_executor.drain()
+            texts = [c["choices"][0]["text"] for c in conn.payloads
+                     if c.get("choices")]
+            assert texts == ["ok"]
+            assert sched.has_request("sid-1")
+        finally:
+            sched.stop()
+
+    def test_replay_token_prefix_is_tracked(self, store):
+        """The failover resume prefix is exactly the index-0 token ids the
+        client has been sent."""
+        FakeChannel.reset()
+        sched = Scheduler(ServiceOptions(reconcile_interval_s=0.05,
+                                         sync_interval_s=0.1,
+                                         lease_ttl_s=0.2),
+                          coord=InMemoryCoordination(store),
+                          start_threads=False)
+        sched.instance_mgr._channel_factory = FakeChannel.factory
+        try:
+            sched.instance_mgr.register_instance(
+                make_meta("m1", InstanceType.MIX), link_peers=False)
+            req = Request(service_request_id="sid-2", request_id="r",
+                          model="m", stream=True, prompt="hello")
+            assert sched.schedule(req).ok()
+            sched.record_new_request(req, CollectingConnection(stream=True),
+                                     "completion")
+            for seq, toks in enumerate(([1, 2], [3], [4, 5]), start=1):
+                sched.handle_generation(RequestOutput(
+                    service_request_id="sid-2", delta_seq=seq,
+                    outputs=[SequenceOutput(index=0, text="x",
+                                            token_ids=list(toks))]))
+            # Duplicate delivery must not extend the replay prefix.
+            sched.handle_generation(RequestOutput(
+                service_request_id="sid-2", delta_seq=3,
+                outputs=[SequenceOutput(index=0, text="x",
+                                        token_ids=[4, 5])]))
+            st = sched._requests["sid-2"]
+            assert st.replay_token_ids == [1, 2, 3, 4, 5]
+        finally:
+            sched.stop()
+
+
+class TestFaultPlaneDeterminism:
+    def test_same_seed_same_schedule(self):
+        def draw(seed):
+            plane = FaultPlane(seed=seed)
+            plane.configure([dict(point="rpc.post", action="error",
+                                  probability=0.5)])
+            return [plane.fire("rpc.post") is not None for _ in range(64)]
+
+        assert draw(1234) == draw(1234)
+        assert draw(1234) != draw(4321)   # astronomically unlikely to tie
+
+    def test_after_and_max_fires_counting(self):
+        plane = FaultPlane(seed=0)
+        rule = plane.add("engine.token", action="crash", after=2, max_fires=1)
+        fires = [plane.fire("engine.token") is not None for _ in range(5)]
+        assert fires == [False, False, True, False, False]
+        assert rule.hits == 5 and rule.fires == 1
+
+    def test_match_and_glob(self):
+        plane = FaultPlane(seed=0)
+        plane.add("rpc.*", action="error", match={"instance": "a:1"})
+        assert plane.fire("rpc.post", instance="b:2") is None
+        assert plane.fire("rpc.get", instance="a:1") is not None
+
+    def test_check_raises_and_delays(self):
+        plane = FaultPlane(seed=0)
+        plane.add("kv_transfer.offer", action="error", max_fires=1)
+        with pytest.raises(FaultInjected):
+            plane.check("kv_transfer.offer")
+        plane.check("kv_transfer.offer")   # max_fires spent: no-op
+
+
+class TestAdminFaultsEndpoint:
+    def test_configure_inspect_clear(self, store):
+        master = Master(_opts(), coord=InMemoryCoordination(store))
+        master.start()
+        try:
+            base = _base(master)
+            r = requests.post(base + "/admin/faults", json={
+                "seed": 77,
+                "rules": [{"point": "rpc.post", "action": "delay",
+                           "delay_s": 0.01}]}, timeout=5)
+            assert r.status_code == 200 and r.json()["seed"] == 77
+            got = requests.get(base + "/admin/faults", timeout=5).json()
+            assert got["rules"][0]["point"] == "rpc.post"
+            assert requests.post(base + "/admin/faults",
+                                 json={"rules": [{"point": "x",
+                                                  "action": "nope"}]},
+                                 timeout=5).status_code == 400
+            r = requests.post(base + "/admin/faults", json={"clear": True},
+                              timeout=5)
+            assert r.status_code == 200 and r.json()["rules"] == []
+        finally:
+            master.stop()
+
+    def test_failure_metrics_exported(self, store):
+        master = Master(_opts(), coord=InMemoryCoordination(store))
+        master.start()
+        try:
+            text = requests.get(_base(master) + "/metrics", timeout=5).text
+            for name in ("failover_attempts_total", "failover_success_total",
+                         "rpc_retries_total", "instance_evictions_total",
+                         "requests_cancelled_on_failure_total"):
+                assert name in text
+        finally:
+            master.stop()

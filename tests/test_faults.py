@@ -1,0 +1,183 @@
+"""Fault-plane wiring tests for the I/O layers: engine-channel retry
+backoff (idempotent vs non-idempotent), and coordination-client reconnect
+with list-then-watch resync after an injected connection blip."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from xllm_service_tpu.common.faults import FAULTS
+from xllm_service_tpu.common.metrics import RPC_RETRIES_TOTAL
+from xllm_service_tpu.coordination.base import WatchEventType
+from xllm_service_tpu.coordination.client import TcpCoordinationClient
+from xllm_service_tpu.coordination.server import CoordinationServer
+from xllm_service_tpu.rpc.channel import EngineChannel
+
+from fakes import wait_until
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+# --------------------------------------------------------------- channel
+class _CountingHandler(BaseHTTPRequestHandler):
+    posts: list[str] = []
+
+    def do_POST(self):  # noqa: N802 — stdlib API
+        _CountingHandler.posts.append(self.path)
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        body = json.dumps({"ok": True}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # silence
+        pass
+
+
+@pytest.fixture()
+def http_target():
+    _CountingHandler.posts = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _CountingHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+class TestChannelRetries:
+    def test_post_retries_with_backoff_until_success(self, http_target):
+        ch = EngineChannel(http_target, retries=3,
+                           backoff_base_s=0.01, backoff_max_s=0.05)
+        rule = FAULTS.add("rpc.post", action="drop", max_fires=2)
+        before = RPC_RETRIES_TOTAL.value()
+        start = time.monotonic()
+        assert ch.cancel("sid-x")       # 3rd attempt lands
+        elapsed = time.monotonic() - start
+        assert rule.fires == 2
+        assert _CountingHandler.posts == ["/rpc/cancel"]
+        assert RPC_RETRIES_TOTAL.value() == before + 2
+        assert elapsed >= 0.01          # backed off between attempts
+        ch.close()
+
+    def test_get_retries(self, http_target):
+        ch = EngineChannel(http_target, retries=2,
+                           backoff_base_s=0.01, backoff_max_s=0.02)
+        rule = FAULTS.add("rpc.get", action="error", max_fires=1)
+        ok, body = ch._get("/anything")   # server 501s GET → retried once,
+        assert rule.fires == 1            # then real HTTP error surfaces
+        assert not ok
+        ch.close()
+
+    def test_forward_is_single_shot(self, http_target):
+        """Non-idempotent generation forwards must NOT be retried by the
+        channel on ambiguous failures — replay belongs to the failover
+        layer."""
+        ch = EngineChannel(http_target, retries=3,
+                           backoff_base_s=0.01, backoff_max_s=0.02)
+        rule = FAULTS.add("rpc.post", action="error")
+        ok, err = ch.forward("/v1/completions", {"prompt": "x"})
+        assert not ok and "fault injected" in str(err)
+        assert rule.fires == 1          # exactly one attempt
+        assert _CountingHandler.posts == []
+        ch.close()
+
+    def test_health_single_probe(self, http_target):
+        """InstanceMgr owns probe retries; the channel must not multiply
+        them."""
+        ch = EngineChannel(http_target, retries=3)
+        rule = FAULTS.add("rpc.get", action="error")
+        assert not ch.health()
+        assert rule.fires == 1
+        ch.close()
+
+
+# ---------------------------------------------------------- coordination
+class _Sink:
+    def __init__(self):
+        self.events = []
+        self.cv = threading.Condition()
+
+    def __call__(self, events, prefix):
+        with self.cv:
+            self.events.extend(events)
+            self.cv.notify_all()
+
+    def keys(self, type_=None):
+        with self.cv:
+            return [e.key for e in self.events
+                    if type_ is None or e.type == type_]
+
+
+@pytest.fixture()
+def coord_server():
+    srv = CoordinationServer(host="127.0.0.1", port=0)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+class TestWatchResyncAfterBlip:
+    def test_blip_does_not_freeze_discovery(self, coord_server):
+        """Sever the watcher's connection (fault plane), hold reconnect
+        down for a few rounds, mutate the keyspace from another client in
+        the meantime, then let reconnect succeed: the resync must deliver
+        the missed PUT and DELETE."""
+        addr = f"127.0.0.1:{coord_server.port}"
+        watcher = TcpCoordinationClient(addr)
+        writer = TcpCoordinationClient(addr)
+        try:
+            sink = _Sink()
+            watcher.add_watch("INST:", sink)
+            assert writer.set("INST:a", "1")
+            assert wait_until(lambda: "INST:a" in sink.keys(), timeout=5)
+
+            # Blip: next call severs the socket; the first 3 reconnect
+            # attempts are refused — a deterministic outage window.
+            FAULTS.configure([
+                dict(point="coord.call", action="disconnect", max_fires=1),
+                dict(point="coord.connect", action="error", max_fires=3),
+            ])
+            watcher.get("INST:a")   # trips the disconnect
+            # Mutations the watcher cannot see while down:
+            assert writer.set("INST:b", "2")
+            assert writer.rm("INST:a")
+
+            assert wait_until(
+                lambda: "INST:b" in sink.keys(WatchEventType.PUT)
+                and "INST:a" in sink.keys(WatchEventType.DELETE),
+                timeout=10), sink.events
+            # And the connection is live again end-to-end.
+            assert watcher.get("INST:b") == "2"
+        finally:
+            watcher.close()
+            writer.close()
+
+    def test_plain_reconnect_resumes_watch_stream(self, coord_server):
+        """After a blip with no missed events, later watch pushes still
+        arrive (re-subscription works and resync is a no-op)."""
+        addr = f"127.0.0.1:{coord_server.port}"
+        watcher = TcpCoordinationClient(addr)
+        writer = TcpCoordinationClient(addr)
+        try:
+            sink = _Sink()
+            watcher.add_watch("K:", sink)
+            FAULTS.configure([
+                dict(point="coord.call", action="disconnect", max_fires=1)])
+            watcher.get("K:x")      # blip
+            FAULTS.clear()
+            assert wait_until(lambda: watcher.get("K:x") is None, timeout=5)
+            assert writer.set("K:x", "v")
+            assert wait_until(lambda: "K:x" in sink.keys(), timeout=5)
+        finally:
+            watcher.close()
+            writer.close()

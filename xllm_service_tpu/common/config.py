@@ -45,6 +45,19 @@ class ServiceOptions:
     # SLO targets, live-reloadable (`global_gflags.cpp:122-132`).
     target_ttft_ms: float = 1000.0
     target_tpot_ms: float = 50.0
+    # --- engine RPC channel (reference fixes 3 retries with no backoff,
+    #     `instance_mgr.cpp:480-498`; here both are knobs and retries back
+    #     off exponentially with jitter) ---
+    rpc_timeout_s: float = 5.0
+    rpc_retries: int = 3
+    rpc_backoff_base_s: float = 0.05
+    rpc_backoff_max_s: float = 1.0
+    # --- transparent failover (beyond the reference's cancel-and-surface:
+    #     in-flight requests on a dead instance are re-dispatched, decode
+    #     resumed by prompt extension; 0 disables = reference behavior) ---
+    failover_max_retries: int = 3
+    failover_backoff_base_s: float = 0.05
+    failover_backoff_max_s: float = 2.0
     # --- failure detection (`global_gflags.cpp:95-113`) ---
     heartbeat_interval_s: float = 3.0
     lease_ttl_s: float = 3.0

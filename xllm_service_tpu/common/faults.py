@@ -1,0 +1,182 @@
+"""Deterministic fault-injection plane.
+
+The reference has no fault-injection facility at all — failure behavior is
+only exercised by hand (kill a pod, watch the logs). This module is the
+seeded, scriptable counterpart used by the chaos drills in
+`tests/test_chaos_failover.py` and exposed operationally via the master's
+`/admin/faults` endpoint.
+
+Model: a registry of :class:`FaultRule`s evaluated at named **fault
+points** compiled into the I/O layers:
+
+===================  =========================================================
+point                where it is checked
+===================  =========================================================
+``rpc.post``         `rpc/channel.py` before every POST attempt
+``rpc.get``          `rpc/channel.py` before every GET attempt
+``coord.call``       `coordination/client.py` before each request
+``coord.connect``    `coordination/client.py` on every (re)connect
+``kv_transfer.offer``  `engine/kv_transfer.py` prefill-side offer
+``kv_transfer.pull``   `engine/kv_transfer.py` decode-side pull
+``engine.accept``    `testing/fake_engine.py` request admission
+``engine.token``     `testing/fake_engine.py` before each generated delta
+``engine.heartbeat`` `testing/fake_engine.py` heartbeat loop
+===================  =========================================================
+
+Actions are interpreted per call site: ``error``/``drop`` raise
+:class:`FaultInjected` from :meth:`FaultPlane.check` (drop = the request was
+never sent, an *unambiguous* failure; error = it may have been processed, an
+*ambiguous* one), ``delay`` sleeps, while ``crash``/``silence``/
+``disconnect`` are returned from :meth:`FaultPlane.fire` for the caller to
+enact (kill the engine, skip the heartbeat, sever the socket).
+
+Determinism: rule matching is pure counting (`after`, `max_fires`) and the
+only randomness — `probability` draws — comes from one seeded
+`random.Random`, so a drill with a fixed seed replays the identical fault
+schedule. `scripts/chaos_soak.sh` sweeps seeds via `XLLM_CHAOS_SEED`.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from random import Random
+from typing import Any, Iterable, Optional
+
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+#: Actions understood by at least one fault point.
+ACTIONS = ("error", "drop", "delay", "disconnect", "crash", "silence")
+
+
+class FaultInjected(RuntimeError):
+    """Raised at a fault point whose matched rule demands a failure."""
+
+    def __init__(self, point: str, rule: "FaultRule"):
+        super().__init__(f"fault injected at {point}: {rule.action}")
+        self.point = point
+        self.rule = rule
+
+
+@dataclass
+class FaultRule:
+    """One scripted fault. `point` may be a glob (``rpc.*``); `match`
+    narrows by call-site context (e.g. ``{"instance": "host:port"}``);
+    `after` skips the first N matching hits (crash-on-Nth-token);
+    `max_fires` bounds how often the rule triggers."""
+
+    point: str
+    action: str = "error"
+    probability: float = 1.0
+    delay_s: float = 0.0
+    after: int = 0
+    max_fires: Optional[int] = None
+    match: dict[str, Any] = field(default_factory=dict)
+    # Runtime counters (exported via /admin/faults for observability).
+    hits: int = 0
+    fires: int = 0
+
+    def __post_init__(self) -> None:
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r} "
+                             f"(expected one of {ACTIONS})")
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "FaultRule":
+        return cls(**{k: v for k, v in d.items()
+                      if k in cls.__dataclass_fields__})
+
+
+class FaultPlane:
+    """Thread-safe registry of fault rules with a seeded RNG."""
+
+    def __init__(self, seed: Optional[int] = None):
+        if seed is None:
+            seed = int(os.environ.get("XLLM_CHAOS_SEED", "0"))
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._rng = Random(seed)
+        self._rules: list[FaultRule] = []
+
+    # ------------------------------------------------------- configuration
+    def configure(self, rules: Iterable[Any] = (),
+                  seed: Optional[int] = None) -> None:
+        """Replace all rules (and optionally reseed). Accepts FaultRule
+        instances or plain dicts (the /admin/faults wire shape)."""
+        parsed = [r if isinstance(r, FaultRule) else FaultRule.from_dict(r)
+                  for r in rules]
+        with self._lock:
+            if seed is not None:
+                self.seed = seed
+            self._rng = Random(self.seed)
+            self._rules = parsed
+
+    def add(self, point: str, **kw: Any) -> FaultRule:
+        rule = FaultRule(point=point, **kw)
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules = []
+
+    def rules(self) -> list[FaultRule]:
+        with self._lock:
+            return list(self._rules)
+
+    # ------------------------------------------------------------- firing
+    def fire(self, point: str, **ctx: Any) -> Optional[FaultRule]:
+        """Return the first rule that triggers at `point` (counters
+        advanced), or None. Callers enact the returned rule's action."""
+        if not self._rules:   # fast path: the plane is almost always empty
+            return None
+        with self._lock:
+            for rule in self._rules:
+                if rule.point != point and \
+                        not fnmatch.fnmatchcase(point, rule.point):
+                    continue
+                if any(str(ctx.get(k)) != str(v)
+                       for k, v in rule.match.items()):
+                    continue
+                rule.hits += 1
+                if rule.hits <= rule.after:
+                    continue
+                if rule.max_fires is not None and \
+                        rule.fires >= rule.max_fires:
+                    continue
+                if rule.probability < 1.0 and \
+                        self._rng.random() >= rule.probability:
+                    continue
+                rule.fires += 1
+                logger.info("fault fired at %s: %s (ctx=%s)",
+                            point, rule.action, ctx)
+                return rule
+        return None
+
+    def check(self, point: str, **ctx: Any) -> None:
+        """Convenience for I/O call sites: sleep on `delay`, raise
+        :class:`FaultInjected` on `error`/`drop`, ignore actions the site
+        doesn't model."""
+        rule = self.fire(point, **ctx)
+        if rule is None:
+            return
+        if rule.action == "delay":
+            time.sleep(rule.delay_s)
+            return
+        if rule.action in ("error", "drop"):
+            raise FaultInjected(point, rule)
+
+
+#: Process-global plane. Components consult it directly; tests and the
+#: `/admin/faults` endpoint configure it. Default state is empty (zero
+#: overhead beyond one attribute read per fault point).
+FAULTS = FaultPlane()

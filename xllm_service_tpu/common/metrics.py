@@ -142,3 +142,21 @@ TTFT_MS = REGISTRY.histogram(
     "time_to_first_token_latency_milliseconds", "TTFT per request (ms)")
 ITL_MS = REGISTRY.histogram(
     "inter_token_latency_milliseconds", "Inter-token latency (ms)")
+
+# Failure-handling observability (beyond the reference, which exposes no
+# failure-path instruments at all): transparent-failover outcomes, channel
+# retry pressure, and fleet eviction churn.
+FAILOVER_ATTEMPTS_TOTAL = REGISTRY.counter(
+    "failover_attempts_total",
+    "Re-dispatch attempts for requests on failed instances")
+FAILOVER_SUCCESS_TOTAL = REGISTRY.counter(
+    "failover_success_total",
+    "Requests successfully re-dispatched after an instance failure")
+RPC_RETRIES_TOTAL = REGISTRY.counter(
+    "rpc_retries_total", "Engine-channel RPC attempts beyond the first")
+INSTANCE_EVICTIONS_TOTAL = REGISTRY.counter(
+    "instance_evictions_total", "Instances removed from the fleet")
+REQUESTS_CANCELLED_ON_FAILURE_TOTAL = REGISTRY.counter(
+    "requests_cancelled_on_failure_total",
+    "Requests surfaced as errors after instance failure "
+    "(failover disabled, budget exhausted, or no payload to replay)")

@@ -94,6 +94,11 @@ class RequestOutput:
     # failure; the service dedupes on this so a retry whose original was in
     # fact processed (response lost) cannot double-deliver deltas.
     delta_seq: Optional[int] = None
+    # Sender identity. After a transparent failover the request is bound to
+    # new incarnations; deltas still in flight from the dead incarnation
+    # must be dropped, which requires each delta to carry who produced it.
+    instance: str = ""
+    incarnation: str = ""
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {
@@ -126,6 +131,10 @@ class RequestOutput:
         }
         if self.delta_seq is not None:
             d["delta_seq"] = self.delta_seq
+        if self.instance:
+            d["instance"] = self.instance
+        if self.incarnation:
+            d["incarnation"] = self.incarnation
         if self.usage is not None:
             d["usage"] = {
                 "num_prompt_tokens": self.usage.num_prompt_tokens,
@@ -166,6 +175,8 @@ class RequestOutput:
             finished=bool(d.get("finished", False)),
             finished_on_prefill=bool(d.get("finished_on_prefill", False)),
             delta_seq=d.get("delta_seq"),
+            instance=d.get("instance", ""),
+            incarnation=d.get("incarnation", ""),
         )
 
 

@@ -16,6 +16,7 @@ import threading
 from typing import Optional
 
 from .base import CoordinationClient, KeyEvent, WatchCallback, WatchEventType
+from ..common.faults import FAULTS, FaultInjected
 from ..utils import get_logger
 
 logger = get_logger(__name__)
@@ -45,6 +46,11 @@ class TcpCoordinationClient(CoordinationClient):
         self._pending: dict[int, tuple[threading.Event, dict, int]] = {}
         self._plock = threading.Lock()
         self._watches: dict[int, tuple[str, WatchCallback]] = {}
+        # wid -> keys (namespace-stripped) last known to exist under the
+        # watch prefix; the reconnect resync diffs the server's current
+        # state against this to synthesize the PUT/DELETE events that were
+        # lost while the connection was down (list-then-watch).
+        self._watch_known: dict[int, set[str]] = {}
         # key -> (ttl, last_value, create_only) so a failed refresh can
         # re-create with the ORIGINAL semantics (an election key must never
         # be re-asserted with a plain put — that would overwrite a new
@@ -74,6 +80,7 @@ class TcpCoordinationClient(CoordinationClient):
             raise CoordinationError("coordination ping failed")
 
     def _connect(self) -> None:
+        FAULTS.check("coord.connect", addr=f"{self._addr[0]}:{self._addr[1]}")
         sock = socket.create_connection(self._addr, timeout=self._timeout_s)
         sock.settimeout(None)
         with self._wlock:
@@ -88,7 +95,7 @@ class TcpCoordinationClient(CoordinationClient):
         while not self._closed.is_set():
             try:
                 self._connect()
-            except OSError:
+            except (OSError, FaultInjected):
                 if self._closed.wait(backoff):
                     return False
                 backoff = min(backoff * 2, 2.0)
@@ -126,8 +133,63 @@ class TcpCoordinationClient(CoordinationClient):
                 self._send_raw({"op": "put", "id": next(self._ids),
                                 "key": key, "value": value, "ttl": ttl,
                                 "create_only": create_only})
+            # List-then-watch resync: deliver the events lost during the
+            # outage, so a coordination blip can't silently freeze instance
+            # discovery (a registration or eviction that happened while we
+            # were down would otherwise never reach the watchers).
+            self._resync_watches()
             return True
         return False
+
+    def _request_on_reader(self, req: dict) -> Optional[dict]:
+        """Synchronous exchange issued FROM the reader thread (reconnect
+        path — `_call` would deadlock waiting on ourselves). Watch pushes
+        interleaved on the wire are dispatched inline."""
+        rid = next(self._ids)
+        req["id"] = rid
+        if not self._send_raw(req):
+            return None
+        try:
+            for line in self._rfile:
+                msg = json.loads(line)
+                if msg.get("event") == "watch":
+                    self._dispatch_watch(msg)
+                    continue
+                if msg.get("id") == rid:
+                    return msg
+                # A concurrent _call (e.g. the keepalive refreshing a
+                # lease on the fresh connection) interleaved its response:
+                # complete its waiter instead of dropping it, or the call
+                # would stall for its full timeout.
+                with self._plock:
+                    waiter = self._pending.pop(msg.get("id"), None)
+                if waiter is not None:
+                    waiter[1].update(msg)
+                    waiter[0].set()
+        except (OSError, ValueError):
+            return None
+        return None
+
+    def _resync_watches(self) -> None:
+        for wid, (prefix, cb) in list(self._watches.items()):
+            resp = self._request_on_reader(
+                {"op": "get_prefix", "prefix": self._k(prefix)})
+            if not resp or not resp.get("ok"):
+                continue
+            current = {self._strip(k): v
+                       for k, v in resp.get("kvs", {}).items()}
+            known = self._watch_known.get(wid, set())
+            events = [KeyEvent(WatchEventType.DELETE, k, "")
+                      for k in sorted(known - set(current))]
+            events += [KeyEvent(WatchEventType.PUT, k, current[k])
+                       for k in sorted(current)]
+            self._watch_known[wid] = set(current)
+            if not events:
+                continue
+            try:
+                cb(events, prefix)
+            except Exception:  # noqa: BLE001
+                logger.exception("watch resync callback failed")
 
     def _send_raw(self, req: dict) -> bool:
         data = (json.dumps(req) + "\n").encode()
@@ -178,23 +240,32 @@ class TcpCoordinationClient(CoordinationClient):
                 resp["error"] = "connection closed"
                 ev.set()
 
+    def _dispatch_watch(self, msg: dict) -> None:
+        wid = msg["watch_id"]
+        entry = self._watches.get(wid)
+        if entry is None:
+            return
+        prefix, cb = entry
+        events = [KeyEvent(WatchEventType(e["type"]),
+                           self._strip(e["key"]), e.get("value", ""))
+                  for e in msg.get("events", ())]
+        known = self._watch_known.setdefault(wid, set())
+        for e in events:
+            if e.type == WatchEventType.PUT:
+                known.add(e.key)
+            else:
+                known.discard(e.key)
+        try:
+            cb(events, prefix)
+        except Exception:  # noqa: BLE001
+            logger.exception("watch callback failed")
+
     def _read_one_connection(self) -> None:
         try:
             for line in self._rfile:
                 msg = json.loads(line)
                 if msg.get("event") == "watch":
-                    wid = msg["watch_id"]
-                    entry = self._watches.get(wid)
-                    if entry is None:
-                        continue
-                    prefix, cb = entry
-                    events = [KeyEvent(WatchEventType(e["type"]),
-                                       self._strip(e["key"]), e.get("value", ""))
-                              for e in msg.get("events", ())]
-                    try:
-                        cb(events, prefix)
-                    except Exception:  # noqa: BLE001
-                        logger.exception("watch callback failed")
+                    self._dispatch_watch(msg)
                     continue
                 rid = msg.get("id")
                 with self._plock:
@@ -208,6 +279,20 @@ class TcpCoordinationClient(CoordinationClient):
     def _call(self, req: dict) -> dict:
         if self._closed.is_set():
             return {"ok": False, "error": "client closed"}
+        rule = FAULTS.fire("coord.call", op=req.get("op"))
+        if rule is not None:
+            if rule.action == "disconnect":
+                # Sever the connection (blip simulation): this call fails
+                # and the reader thread drives reconnect + watch resync.
+                try:
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            elif rule.action == "delay":
+                import time as _t
+                _t.sleep(rule.delay_s)
+            else:
+                return {"ok": False, "error": "fault injected"}
         rid = next(self._ids)
         req["id"] = rid
         ev, resp = threading.Event(), {}
@@ -311,6 +396,7 @@ class TcpCoordinationClient(CoordinationClient):
 
     def remove_watch(self, watch_id) -> None:
         self._watches.pop(watch_id, None)
+        self._watch_known.pop(watch_id, None)
         self._call({"op": "unwatch", "watch_id": watch_id})
 
     def close(self) -> None:

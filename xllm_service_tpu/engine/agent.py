@@ -185,6 +185,10 @@ class GenerationStreamer:
         self._flush_s = flush_ms / 1000.0
         self._seq_lock = threading.Lock()
         self._seqs: dict[str, int] = {}
+        # Sender identity stamped on every delta (set by the agent once its
+        # address/incarnation are known; empty = unstamped, accepted as-is).
+        self.instance_name = ""
+        self.incarnation = ""
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="gen-streamer")
         self._thread.start()
@@ -202,6 +206,8 @@ class GenerationStreamer:
             else:
                 self._seqs[sid] = seq
             output.delta_seq = seq
+            output.instance = self.instance_name
+            output.incarnation = self.incarnation
             self._q.put((dest_addr, output.to_dict()))
 
     def _loop(self) -> None:
@@ -347,6 +353,11 @@ class EngineAgent:
         # Pass the agent itself: cancel() fans out across replicas.
         self.streamer = GenerationStreamer(self,
                                            agent_cfg.generation_flush_ms)
+        # Stamp sender identity on every delta: after a transparent
+        # failover the service drops deltas from incarnations the request
+        # is no longer bound to.
+        self.streamer.instance_name = self.name
+        self.streamer.incarnation = self.incarnation_id
         # Agent-observed TTFT per request (ms, accept -> first delta);
         # serve_bench reads this to split client TTFT into agent-side vs
         # master/wire cost (span profiling, VERDICT r3 weak #1).

@@ -26,6 +26,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from ..common.faults import FAULTS
 from ..utils import get_logger
 
 logger = get_logger(__name__)
@@ -86,6 +87,9 @@ class KvTransferManager:
         """Schedule `blob` for a device-to-device pull; returns the wire
         descriptor for the control message."""
         uid = transfer_uuid(service_request_id, incarnation)
+        # Chaos hook: an injected error here lands in the agent's existing
+        # device-path try/except, exercising the host-msgpack fallback.
+        FAULTS.check("kv_transfer.offer", sid=service_request_id)
         self.gc()
         with self._lock:
             self._pending[uid] = ([blob], time.monotonic() + OFFER_TTL_S)
@@ -133,6 +137,10 @@ class KvTransferManager:
     def pull(self, desc: dict[str, Any]) -> jax.Array:
         """Pull the offered KV pages straight into this engine's device
         memory."""
+        # Chaos hook: decode-side pull failure (the receiving agent's
+        # handoff handler reports UNAVAILABLE back to the service, which
+        # is exactly the path a mid-transfer network fault takes).
+        FAULTS.check("kv_transfer.pull", uuid=desc.get("uuid"))
         addr = desc["addr"]
         with self._lock:
             conn = self._conns.get(addr)

@@ -36,13 +36,7 @@ import aiohttp
 from aiohttp import web
 
 from ..common.metrics import REGISTRY, SERVER_REQUEST_IN_TOTAL
-from ..common.request import (
-    Request,
-    RequestOutput,
-    SamplingParams,
-    Status,
-    StatusCode,
-)
+from ..common.request import Request, RequestOutput, SamplingParams
 from ..common.types import InstanceType
 from ..scheduler.scheduler import Scheduler
 from ..utils import generate_service_request_id, get_logger, short_uuid
@@ -124,6 +118,8 @@ class XllmHttpService:
         app.router.add_get("/admin/config", self.handle_get_config)
         app.router.add_post("/admin/config", self.handle_set_config)
         app.router.add_get("/admin/planner", self.handle_planner)
+        app.router.add_get("/admin/faults", self.handle_get_faults)
+        app.router.add_post("/admin/faults", self.handle_set_faults)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -227,7 +223,6 @@ class XllmHttpService:
                 if status.code.name == "UNAVAILABLE" else "invalid_request_error")
 
         conn = AioConnection(asyncio.get_running_loop(), req.stream)
-        self.scheduler.record_new_request(req, conn, "anthropic")
         enriched = {
             "model": req.model,
             "service_request_id": req.service_request_id,
@@ -246,6 +241,9 @@ class XllmHttpService:
             enriched["top_p"] = body["top_p"]
         if body.get("top_k") is not None:
             enriched["top_k"] = body["top_k"]
+        self.scheduler.record_new_request(
+            req, conn, "anthropic",
+            forward_path="/v1/chat/completions", forward_payload=enriched)
         task = asyncio.create_task(self._forward_to_instance(
             req, conn, "/v1/chat/completions", enriched))
         self._forward_tasks.add(task)
@@ -316,10 +314,11 @@ class XllmHttpService:
                 if status.code.name == "UNAVAILABLE" else "invalid_request_error")
 
         conn = AioConnection(asyncio.get_running_loop(), req.stream)
-        self.scheduler.record_new_request(req, conn, kind)
 
         # Enrich + forward to the prefill instance, fire-and-forget
-        # (reference `service.cpp:222-260,485-493`).
+        # (reference `service.cpp:222-260,485-493`). The enriched payload
+        # is also retained with the request registration so the failover
+        # layer can replay it on a surviving instance.
         enriched = dict(body)
         enriched["service_request_id"] = req.service_request_id
         enriched["source_service_addr"] = self.scheduler.self_addr
@@ -328,6 +327,9 @@ class XllmHttpService:
                                "decode_name": req.routing.decode_name,
                                "encode_name": req.routing.encode_name}
         path = "/v1/chat/completions" if kind == "chat" else "/v1/completions"
+        self.scheduler.record_new_request(req, conn, kind,
+                                          forward_path=path,
+                                          forward_payload=enriched)
         task = asyncio.create_task(
             self._forward_to_instance(req, conn, path, enriched))
         self._forward_tasks.add(task)
@@ -338,24 +340,27 @@ class XllmHttpService:
     async def _forward_to_instance(self, req: Request, conn: AioConnection,
                                    path: str, payload: dict[str, Any]) -> None:
         url = f"http://{req.routing.prefill_name}{path}"
+        retryable, code = True, 503
         try:
             assert self._client is not None
             async with self._client.post(url, json=payload) as resp:
                 if resp.status != 200:
                     text = await resp.text()
+                    # 4xx = the engine deliberately rejected the request
+                    # (client error): another instance would reject it the
+                    # same way — surface it as-is, don't failover.
+                    if 400 <= resp.status < 500:
+                        retryable, code = False, resp.status
                     raise RuntimeError(f"engine returned {resp.status}: {text[:200]}")
         except Exception as e:  # noqa: BLE001 — surface any forward failure
             logger.warning("forward of %s to %s failed: %s",
                            req.service_request_id, url, e)
-            # Mirror reference handle_first_send_request failure path. Off
-            # the event loop: handle_generation can issue blocking cancel
-            # RPCs to engines.
+            # Failover-or-surface (the reference handle_first_send_request
+            # path only surfaces). Off the event loop: the failover layer
+            # sleeps on backoff and issues blocking engine RPCs.
             await asyncio.get_running_loop().run_in_executor(
-                None, self.scheduler.handle_generation, RequestOutput(
-                    service_request_id=req.service_request_id,
-                    status=Status(StatusCode.UNAVAILABLE,
-                                  f"failed to reach prefill instance: {e}"),
-                    finished=True))
+                None, self.scheduler.handle_dispatch_failure, req,
+                f"failed to reach prefill instance: {e}", retryable, code)
 
     async def _respond(self, http_req: web.Request, req: Request,
                        conn: AioConnection,
@@ -496,6 +501,37 @@ class XllmHttpService:
         if d is None:
             return web.json_response({"decision": None})
         return web.json_response({"decision": dataclasses.asdict(d)})
+
+    async def handle_get_faults(self, request: web.Request) -> web.Response:
+        """Inspect the deterministic fault-injection plane (rules + hit/fire
+        counters)."""
+        from ..common.faults import FAULTS
+
+        return web.json_response({
+            "seed": FAULTS.seed,
+            "rules": [r.to_dict() for r in FAULTS.rules()]})
+
+    async def handle_set_faults(self, request: web.Request) -> web.Response:
+        """Configure the fault plane: `{"rules": [...], "seed": N}` replaces
+        the rule set (seeded → deterministic), `{"clear": true}` disarms."""
+        from ..common.faults import FAULTS
+
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON")
+        if not isinstance(body, dict):
+            return _error_response(400, "request body must be a JSON object")
+        if body.get("clear"):
+            FAULTS.clear()
+        if body.get("rules") is not None:
+            try:
+                FAULTS.configure(body["rules"], seed=body.get("seed"))
+            except (TypeError, ValueError) as e:
+                return _error_response(400, f"bad fault rule: {e}")
+        return web.json_response({
+            "ok": True, "seed": FAULTS.seed,
+            "rules": [r.to_dict() for r in FAULTS.rules()]})
 
     async def handle_set_config(self, request: web.Request) -> web.Response:
         try:

@@ -6,42 +6,80 @@ configurable timeouts (`instance_mgr.cpp:480-498`) and calls the engine's
 (LinkInstance/UnlinkInstance) stubs. Here the engine speaks HTTP+JSON; the
 channel wraps `requests` with retries. Used from manager threads; the
 asyncio HTTP frontend uses its own aiohttp session for hot-path forwarding.
+
+Beyond the reference: retries back off exponentially with jitter (the
+reference hammers immediately), both knobs come from `ServiceOptions`
+(`rpc_retries`/`rpc_timeout_s`/`rpc_backoff_*`), and non-idempotent
+generation forwards are NEVER retried here — an ambiguous failure (e.g.
+connection reset after the body was sent) may have started generation, so
+replay is owned exclusively by the scheduler's failover layer, which
+rebinds incarnations so a duplicate stream is dropped. Every attempt first
+consults the fault plane (`common/faults.py`, points `rpc.post`/`rpc.get`)
+so chaos drills can script drops, delays and errors deterministically.
 """
 
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Optional
 
 import requests
 
+from ..common.faults import FAULTS, FaultInjected
+from ..common.metrics import RPC_RETRIES_TOTAL
 from ..common.types import InstanceMetaInfo
-from ..utils import get_logger
+from ..utils import get_logger, jittered_backoff
 
 logger = get_logger(__name__)
 
 DEFAULT_TIMEOUT_S = 5.0
 DEFAULT_RETRIES = 3
+DEFAULT_BACKOFF_BASE_S = 0.05
+DEFAULT_BACKOFF_MAX_S = 1.0
 
 
 class EngineChannel:
     def __init__(self, name: str, base_url: Optional[str] = None,
                  timeout_s: float = DEFAULT_TIMEOUT_S,
-                 retries: int = DEFAULT_RETRIES):
+                 retries: int = DEFAULT_RETRIES,
+                 backoff_base_s: float = DEFAULT_BACKOFF_BASE_S,
+                 backoff_max_s: float = DEFAULT_BACKOFF_MAX_S):
         # `name` is the engine's HTTP address (reference: InstanceMetaInfo.name
         # doubles as the HTTP endpoint, `xllm_rpc_service.proto:31-46`).
         self.name = name
         self.base_url = base_url or (
             name if name.startswith("http") else f"http://{name}")
         self.timeout_s = timeout_s
-        self.retries = retries
+        self.retries = max(1, retries)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
         self._session = requests.Session()
 
+    @classmethod
+    def from_options(cls, name: str, options: Any) -> "EngineChannel":
+        """Build with the `rpc_*` knobs from a ServiceOptions."""
+        return cls(name,
+                   timeout_s=options.rpc_timeout_s,
+                   retries=options.rpc_retries,
+                   backoff_base_s=options.rpc_backoff_base_s,
+                   backoff_max_s=options.rpc_backoff_max_s)
+
+    def _sleep_backoff(self, prior_attempts: int) -> None:
+        time.sleep(jittered_backoff(self.backoff_base_s,
+                                    self.backoff_max_s, prior_attempts))
+
     def _post(self, path: str, payload: dict[str, Any],
-              timeout_s: Optional[float] = None) -> tuple[bool, Any]:
+              timeout_s: Optional[float] = None,
+              retries: Optional[int] = None) -> tuple[bool, Any]:
+        attempts = self.retries if retries is None else max(1, retries)
         err: Any = None
-        for _ in range(self.retries):
+        for attempt in range(attempts):
+            if attempt:
+                RPC_RETRIES_TOTAL.inc()
+                self._sleep_backoff(attempt - 1)
             try:
+                FAULTS.check("rpc.post", instance=self.name, path=path)
                 r = self._session.post(self.base_url + path, json=payload,
                                        timeout=timeout_s or self.timeout_s)
                 if r.status_code == 200:
@@ -50,27 +88,42 @@ class EngineChannel:
                     except ValueError:  # incl. requests' JSONDecodeError,
                         return True, r.text   # else it'd retry as failure
                 err = f"HTTP {r.status_code}: {r.text[:200]}"
+            except FaultInjected as e:
+                err = str(e)
             except requests.RequestException as e:
                 err = str(e)
         return False, err
 
-    def _get(self, path: str, timeout_s: Optional[float] = None) -> tuple[bool, Any]:
-        try:
-            r = self._session.get(self.base_url + path,
-                                  timeout=timeout_s or self.timeout_s)
-            if r.status_code == 200:
-                try:
-                    return True, r.json()
-                except json.JSONDecodeError:
-                    return True, r.text
-            return False, f"HTTP {r.status_code}"
-        except requests.RequestException as e:
-            return False, str(e)
+    def _get(self, path: str, timeout_s: Optional[float] = None,
+             retries: Optional[int] = None) -> tuple[bool, Any]:
+        attempts = self.retries if retries is None else max(1, retries)
+        err: Any = None
+        for attempt in range(attempts):
+            if attempt:
+                RPC_RETRIES_TOTAL.inc()
+                self._sleep_backoff(attempt - 1)
+            try:
+                FAULTS.check("rpc.get", instance=self.name, path=path)
+                r = self._session.get(self.base_url + path,
+                                      timeout=timeout_s or self.timeout_s)
+                if r.status_code == 200:
+                    try:
+                        return True, r.json()
+                    except json.JSONDecodeError:
+                        return True, r.text
+                err = f"HTTP {r.status_code}"
+            except FaultInjected as e:
+                err = str(e)
+            except requests.RequestException as e:
+                err = str(e)
+        return False, err
 
     # ---- control plane -----------------------------------------------------
     def health(self, timeout_s: float = 1.0) -> bool:
-        """Reference probes HTTP GET /health (`instance_mgr.cpp:500-539`)."""
-        ok, _ = self._get("/health", timeout_s=timeout_s)
+        """Reference probes HTTP GET /health (`instance_mgr.cpp:500-539`).
+        Single attempt: InstanceMgr owns the probe-retry policy
+        (`health_probe_attempts`)."""
+        ok, _ = self._get("/health", timeout_s=timeout_s, retries=1)
         return ok
 
     def link(self, peer: InstanceMetaInfo) -> bool:
@@ -107,7 +160,11 @@ class EngineChannel:
 
     # ---- data plane (sync fallback; the frontend normally forwards async) --
     def forward(self, path: str, payload: dict[str, Any]) -> tuple[bool, Any]:
-        return self._post(path, payload)
+        """Single-shot by design: a generation forward is NOT idempotent.
+        An ambiguous failure (reset after send) may already be generating;
+        blind retry would double-submit. The failover layer owns replay —
+        it rebinds incarnations first so any duplicate stream is dropped."""
+        return self._post(path, payload, retries=1)
 
     def forward_status(self, path: str,
                        payload: dict[str, Any]) -> tuple[int, Any]:
@@ -115,9 +172,10 @@ class EngineChannel:
         proxied endpoints where 4xx/5xx must pass through to the client
         instead of collapsing into a retry/False)."""
         try:
+            FAULTS.check("rpc.post", instance=self.name, path=path)
             r = self._session.post(self.base_url + path, json=payload,
                                    timeout=self.timeout_s)
-        except requests.RequestException as e:
+        except (requests.RequestException, FaultInjected) as e:
             return 502, {"error": str(e)}
         try:
             return r.status_code, r.json()

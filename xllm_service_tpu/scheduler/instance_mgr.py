@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..common.config import ServiceOptions
+from ..common.metrics import INSTANCE_EVICTIONS_TOTAL
 from ..common.time_predictor import TimePredictor
 from ..common.types import (
     InstanceLoadInfo,
@@ -104,7 +105,7 @@ class InstanceMgr:
         self._opts = options
         self._is_master = is_master
         self._channel_factory = channel_factory or (
-            lambda name, rpc_addr: EngineChannel(name))
+            lambda name, rpc_addr: EngineChannel.from_options(name, options))
         # L1: fleet membership + indices.
         self._cluster_lock = threading.RLock()
         self._instances: dict[str, _Entry] = {}
@@ -344,6 +345,10 @@ class InstanceMgr:
             self._request_loads.pop(name, None)
             self._removed_load_names.add(name)
             self._updated_load_names.discard(name)
+        if reason != "replaced":
+            # A re-registration with a new incarnation is planned churn
+            # (rolling restart), not an eviction — don't page anyone.
+            INSTANCE_EVICTIONS_TOTAL.inc()
         logger.info("deregistered instance %s (%s)", name, reason)
         if self.on_instance_failure is not None:
             self.on_instance_failure(name, incarnation, itype)

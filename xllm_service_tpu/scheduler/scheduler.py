@@ -14,20 +14,35 @@ Parity: reference `scheduler/scheduler.{h,cpp}` (733 LoC, SURVEY.md §2.4,
   ResponseHandler (streaming parse state per request).
 - `handle_generation()`: registry lookup, client-disconnect cancellation,
   TTFT/ITL metrics, callback dispatch on the pinned lane.
-- `clear_requests_on_failed_instance()`: cancel-and-surface for requests
-  bound to a dead (instance, incarnation, role).
+- `clear_requests_on_failed_instance()`: the reference cancel-and-surfaces
+  every request bound to a dead (instance, incarnation, role)
+  (`scheduler.cpp:443-482`). We go further: **transparent failover** —
+  in-flight requests are re-dispatched to a surviving pair, decode resumed
+  by extending the prompt with the tokens already streamed, under a
+  per-request retry budget with exponential backoff. Replay is idempotent:
+  the request is re-bound to the new incarnations first, and deltas from
+  incarnations it is no longer bound to are dropped in
+  `handle_generation()`. Cancel-and-surface remains the fallback
+  (`failover_max_retries=0`, no replay payload, or budget exhausted).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Optional
 
 from ..chat_template import JinjaChatTemplate
 from ..common.call_data import ClientConnection
 from ..common.config import ServiceOptions
-from ..common.metrics import ITL_MS, TTFT_MS
+from ..common.metrics import (
+    FAILOVER_ATTEMPTS_TOTAL,
+    FAILOVER_SUCCESS_TOTAL,
+    ITL_MS,
+    REQUESTS_CANCELLED_ON_FAILURE_TOTAL,
+    TTFT_MS,
+)
 from ..common.ordered_executor import OrderedExecutor
 from ..common.request import (
     Request,
@@ -53,7 +68,7 @@ from ..scheduler.instance_mgr import InstanceMgr
 from ..scheduler.policies import create_policy
 from ..scheduler.response_handler import ChatStreamState, ResponseHandler
 from ..tokenizer import TokenizerFactory
-from ..utils import get_logger
+from ..utils import get_logger, jittered_backoff
 
 logger = get_logger(__name__)
 
@@ -61,10 +76,14 @@ logger = get_logger(__name__)
 class _RequestState:
     __slots__ = ("request", "conn", "lane", "kind", "stream_state",
                  "accum", "first_token_ms", "last_token_ms", "finished",
-                 "exited", "last_delta_seq")
+                 "exited", "last_delta_seq", "forward_path",
+                 "forward_payload", "replay_token_ids", "failover_attempts",
+                 "failing", "in_failover")
 
     def __init__(self, request: Request, conn: ClientConnection, lane: int,
-                 kind: str, stream_state: Optional[ChatStreamState]):
+                 kind: str, stream_state: Optional[ChatStreamState],
+                 forward_path: Optional[str] = None,
+                 forward_payload: Optional[dict[str, Any]] = None):
         self.request = request
         self.conn = conn
         self.lane = lane
@@ -79,6 +98,24 @@ class _RequestState:
         self.exited = False
         # Highest engine delta_seq processed — dedup for retried deliveries.
         self.last_delta_seq = 0
+        # Replay material for transparent failover: the enriched engine
+        # payload the HTTP layer originally forwarded (None = this request
+        # cannot be replayed → cancel-and-surface), plus every index-0
+        # token id already delivered to the client (decode resumes by
+        # extending the prompt with exactly these).
+        self.forward_path = forward_path
+        self.forward_payload = forward_payload
+        self.replay_token_ids: list[int] = []
+        self.failover_attempts = 0
+        # True while the request is between instances (its old instance
+        # failed, re-dispatch pending): in-flight deltas from the old
+        # binding are void and must be dropped.
+        self.failing = False
+        # Serialization guard: the dispatch-failure executor thread and
+        # the instance-death failover thread can both reach this request;
+        # only one may run the failover loop (the other would double-burn
+        # the retry budget and double-dispatch).
+        self.in_failover = False
 
 
 class Scheduler:
@@ -256,9 +293,13 @@ class Scheduler:
 
     # ------------------------------------------------------ request registry
     def record_new_request(self, request: Request, conn: ClientConnection,
-                           kind: str) -> None:
+                           kind: str, forward_path: Optional[str] = None,
+                           forward_payload: Optional[dict[str, Any]] = None,
+                           ) -> None:
         """Register the in-flight request and build its output path
-        (reference `record_new_request` overloads, `scheduler.cpp:279-414`)."""
+        (reference `record_new_request` overloads, `scheduler.cpp:279-414`).
+        `forward_path`/`forward_payload` are the engine-facing dispatch the
+        HTTP layer is about to send — kept for failover replay."""
         lane = self._output_executor.lane_for(request.service_request_id)
         stream_state = None
         if kind == "chat" and request.stream:
@@ -266,7 +307,9 @@ class Scheduler:
         elif kind == "anthropic" and request.stream:
             from .response_handler import AnthropicStreamState
             stream_state = AnthropicStreamState()
-        st = _RequestState(request, conn, lane, kind, stream_state)
+        st = _RequestState(request, conn, lane, kind, stream_state,
+                           forward_path=forward_path,
+                           forward_payload=forward_payload)
         with self._req_lock:
             self._requests[request.service_request_id] = st
 
@@ -311,6 +354,17 @@ class Scheduler:
             if st is None or st.finished:
                 return False
             req = st.request
+            # Idempotent-replay guard: after a failover the request is
+            # bound to new incarnations; a delta still in flight from an
+            # old binding must not reach the client twice. Unstamped
+            # deltas (legacy engines, unit tests) skip the check.
+            if output.incarnation and output.incarnation not in (
+                    req.prefill_incarnation, req.decode_incarnation):
+                return False
+            if st.failing:
+                # Between instances (failure detected, re-dispatch
+                # pending): the old stream is void; tell it to stop.
+                return False
             req.touch()
             if output.delta_seq is not None:
                 if output.delta_seq <= st.last_delta_seq:
@@ -326,6 +380,12 @@ class Scheduler:
                 disconnected = True
             else:
                 self._update_token_metrics(st, output)
+                if output.status.ok():
+                    # Track the delivered index-0 token ids: failover
+                    # resumes decode by replaying exactly this prefix.
+                    for seq in output.outputs:
+                        if seq.index == 0 and seq.token_ids:
+                            st.replay_token_ids.extend(seq.token_ids)
                 if output.finished:
                     st.finished = True
         if disconnected:
@@ -347,7 +407,11 @@ class Scheduler:
         now = now_ms()
         if st.first_token_ms is None and n_new:
             st.first_token_ms = now
-            TTFT_MS.observe(now - req.created_time_ms)
+            if not req.metrics.prefill_finish_time_ms:
+                # Observe TTFT once per request: after a failover the
+                # prefill stage re-runs (accounting below must re-fire)
+                # but the client's TTFT already happened.
+                TTFT_MS.observe(now - req.created_time_ms)
             req.prefill_stage_finished = True
             req.metrics.prefill_finish_time_ms = now
             self.instance_mgr.update_request_metrics(
@@ -503,9 +567,11 @@ class Scheduler:
 
     def clear_requests_on_failed_instance(self, name: str, incarnation: str,
                                           itype: InstanceType) -> None:
-        """Cancel-and-surface (reference `scheduler.cpp:443-482`): every
-        in-flight request bound to the dead (instance, incarnation, role)
-        gets a CANCELLED status; no transparent re-dispatch."""
+        """Requests bound to a dead (instance, incarnation, role): the
+        reference cancel-and-surfaces them all (`scheduler.cpp:443-482`);
+        here they are transparently re-dispatched when a replay payload
+        exists and the retry budget allows, and surfaced as 503 only
+        otherwise."""
         victims: list[_RequestState] = []
         with self._req_lock:
             for sid, st in list(self._requests.items()):
@@ -520,20 +586,195 @@ class Scheduler:
                         r.routing.prefill_name == name
                         and (not incarnation or r.prefill_incarnation == incarnation))
                 )
-                if hit:
+                if hit and not st.finished and not st.exited:
+                    # Void the old stream immediately: deltas already in
+                    # flight from the dead binding must not interleave
+                    # with the replayed one.
+                    st.failing = True
                     victims.append(st)
+        if not victims:
+            return
+        failover: list[_RequestState] = []
         for st in victims:
-            # _remove_request reverses the surviving peer's accounting for
-            # this request (the dead instance's load entries are dropped
-            # with it); idempotent vs concurrent finish/GC.
-            if not self._remove_request(st):
-                continue
-            self._output_executor.submit_to_lane(
-                st.lane,
-                lambda s=st: s.conn.finish_with_error(
-                    503, f"instance {name} failed; request cancelled"))
-            logger.info("cancelled request %s on failed instance %s",
-                        st.request.service_request_id, name)
+            if (self._opts.failover_max_retries > 0 and st.forward_path
+                    and not st.conn.is_disconnected()):
+                failover.append(st)
+            else:
+                self._surface_failure(
+                    st, f"instance {name} failed; request cancelled")
+        if failover:
+            logger.info("failing over %d request(s) from dead instance %s",
+                        len(failover), name)
+            threading.Thread(
+                target=self._failover_batch, args=(failover, name),
+                name="request-failover", daemon=True).start()
+
+    def _failover_batch(self, victims: list[_RequestState],
+                        dead_name: str) -> None:
+        if len(victims) == 1:
+            self._failover_one(victims[0], dead_name)
+            return
+        # Fan out: each victim's backoff must not delay the others'
+        # recovery (a dead instance can carry hundreds of streams).
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(victims)),
+                                thread_name_prefix="failover") as pool:
+            for st in victims:
+                pool.submit(self._failover_one, st, dead_name)
+
+    def _failover_one(self, st: _RequestState, dead_name: str) -> None:
+        try:
+            self._failover_request(st, dead_name)
+        except Exception:  # noqa: BLE001 — one bad replay must not
+            logger.exception(                  # strand the rest
+                "failover of %s failed unexpectedly",
+                st.request.service_request_id)
+            self._surface_failure(st, "failover error")
+
+    def _failover_request(self, st: _RequestState,
+                          dead_name: str = "") -> None:
+        """Re-dispatch one in-flight request after its instance died:
+        re-run prefill on a surviving pair with the prompt extended by the
+        tokens already streamed, under the per-request retry budget with
+        exponential backoff. Runs off the event loop / watch threads."""
+        req = st.request
+        opts = self._opts
+        with self._req_lock:
+            if st.in_failover:
+                return   # another thread already owns this replay
+            st.in_failover = True
+        try:
+            self._failover_loop(st, req, opts, dead_name)
+        finally:
+            with self._req_lock:
+                st.in_failover = False
+
+    def _failover_loop(self, st: _RequestState, req: Request,
+                       opts: ServiceOptions, dead_name: str) -> None:
+        # Stop the old binding's surviving peer (the dead instance's
+        # channel is already gone): any stream it still drives is void,
+        # and in-flight deltas are dropped while st.failing holds.
+        self._cancel_on_engines(req)
+        while True:
+            with self._req_lock:
+                if st.exited or st.finished:
+                    return
+                if st.failover_attempts >= opts.failover_max_retries:
+                    break
+                st.failover_attempts += 1
+                attempt = st.failover_attempts
+            FAILOVER_ATTEMPTS_TOTAL.inc()
+            if st.conn.is_disconnected():
+                if self._remove_request(st):
+                    logger.info("client of %s gone during failover",
+                                req.service_request_id)
+                return
+            if attempt > 1:
+                time.sleep(jittered_backoff(opts.failover_backoff_base_s,
+                                            opts.failover_backoff_max_s,
+                                            attempt - 2))
+            routing = self.lb_policy.select_instances_pair(req)
+            if not routing.valid() or (
+                    dead_name and dead_name in (routing.prefill_name,
+                                                routing.decode_name)):
+                continue   # no usable capacity yet; burn one budgeted try
+            with self._req_lock:
+                if st.exited or st.finished:
+                    return
+                # Move the load accounting: reverse the old pair's credits
+                # (before resetting progress — the FINISH_DECODE reversal
+                # keys off prefill_stage_finished/num_generated_tokens),
+                # then re-run SCHEDULE against the new pair.
+                self._account_request_exit(req)
+                req.routing = routing
+                self.instance_mgr.bind_request_instance_incarnations(req)
+                req.prefill_stage_finished = False
+                req.num_generated_tokens = 0
+                st.first_token_ms = None
+                st.last_delta_seq = 0   # the new stream numbers from 1
+                resume = list(st.replay_token_ids)
+                req.touch()
+                self.instance_mgr.update_request_metrics(
+                    req, RequestAction.SCHEDULE)
+                st.failing = False
+            payload = dict(st.forward_payload or {})
+            payload["service_request_id"] = req.service_request_id
+            # Resume-by-prompt-extension: the engine prefills the original
+            # prompt plus every token already streamed and generates only
+            # the remainder (so the client-visible sequence is identical).
+            payload["token_ids"] = list(req.token_ids) + resume
+            payload["resume_generated_token_ids"] = resume
+            payload["routing"] = {"prefill_name": routing.prefill_name,
+                                  "decode_name": routing.decode_name,
+                                  "encode_name": routing.encode_name}
+            payload["failover_attempt"] = attempt
+            ch = self.instance_mgr.get_channel(routing.prefill_name)
+            if ch is None:
+                ok, err = False, "no channel"
+            else:
+                # Single-shot POST: replay is owned here, and the request
+                # was just re-bound, so a duplicate stream from an
+                # ambiguous failure is dropped by the incarnation guard.
+                ok, err = ch.forward(st.forward_path, payload)
+            if ok:
+                FAILOVER_SUCCESS_TOTAL.inc()
+                logger.info(
+                    "request %s failed over to %s (attempt %d, resuming "
+                    "after %d tokens)", req.service_request_id,
+                    routing.prefill_name, attempt, len(resume))
+                return
+            logger.warning("failover dispatch of %s to %s failed: %s",
+                           req.service_request_id, routing.prefill_name, err)
+            with self._req_lock:
+                if st.exited:
+                    return
+                st.failing = True
+                # The SCHEDULE credit against the failed target is NOT
+                # reversed here: every exit from this loop (next-attempt
+                # rebind, _surface_failure, disconnect) reverses exactly
+                # one outstanding credit via _account_request_exit, so the
+                # invariant is one credit held at all times.
+            # Ambiguous failure may have started generating: best-effort
+            # cancel before trying the next instance.
+            self._cancel_on_engines(req)
+        self._surface_failure(
+            st, f"instance failed; retry budget exhausted "
+                f"after {st.failover_attempts} attempt(s)")
+
+    def handle_dispatch_failure(self, req: Request, message: str = "",
+                                retryable: bool = True,
+                                code: int = 503) -> None:
+        """The initial (or replayed) engine forward failed. With failover
+        enabled this re-dispatches under the same budget as instance death;
+        a non-retryable failure (the engine rejected the request as a
+        client error — `code` carries its status through) or disabled
+        failover surfaces the error (reference handle_first_send_request
+        failure path)."""
+        with self._req_lock:
+            st = self._requests.get(req.service_request_id)
+            if st is None or st.exited or st.finished:
+                return
+            st.failing = True
+        if retryable and self._opts.failover_max_retries > 0 \
+                and st.forward_path:
+            self._failover_request(st)
+            return
+        self._surface_failure(
+            st, message or "failed to reach prefill instance", code=code)
+
+    def _surface_failure(self, st: _RequestState, message: str,
+                         code: int = 503) -> None:
+        """Cancel-and-surface terminal path (reference
+        `scheduler.cpp:443-482`): exit accounting + client error."""
+        if not self._remove_request(st):
+            return
+        REQUESTS_CANCELLED_ON_FAILURE_TOTAL.inc()
+        self._cancel_on_engines(st.request)
+        self._output_executor.submit_to_lane(
+            st.lane, lambda: st.conn.finish_with_error(code, message))
+        logger.info("cancelled request %s: %s",
+                    st.request.service_request_id, message)
 
     # ------------------------------------------------------------ readiness
     def has_available_instances(self) -> bool:

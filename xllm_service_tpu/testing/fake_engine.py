@@ -11,10 +11,22 @@ implementation of the engine side of the wire contract:
   /v1/chat/completions (fire-and-forget accept), /health, /rpc/link,
   /rpc/unlink, /rpc/cancel, /rpc/flip_role,
 - streams canned Generations back to `source_service_addr` in configurable
-  chunks with configurable delays.
+  chunks with configurable delays, each stamped with this engine's
+  instance/incarnation (the service's stale-incarnation guard keys on it),
+- resumes a failed-over request from `resume_generated_token_ids`: the
+  canned reply continues from the token after the replayed prefix, so a
+  chaos drill can assert the client-visible sequence is byte-identical.
 
 Failure drills: `pause()` (stop heartbeats + lease), `kill()` (drop
-everything, refuse health), `set_unhealthy()`.
+everything, refuse health), `set_unhealthy()`; plus scripted faults from
+the deterministic plane (`common/faults.py`):
+
+- ``engine.token`` action ``crash`` — hard-kill before emitting the Nth
+  delta (crash-on-Nth-token, `after=N`),
+- ``engine.heartbeat`` action ``silence`` — stop heartbeats AND let the
+  lease lapse (process-hang simulation),
+- ``engine.accept`` action ``error``/``drop`` — reject or swallow an
+  incoming generation request.
 """
 
 from __future__ import annotations
@@ -30,6 +42,7 @@ import requests as _requests
 from aiohttp import web
 import asyncio
 
+from ..common.faults import FAULTS
 from ..common.hashing import prefix_block_hash_hexes
 from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
 from ..coordination.base import CoordinationClient
@@ -176,6 +189,14 @@ class FakeEngine:
             time.sleep(self.cfg.heartbeat_interval_s)
             if self._paused or not self._alive:
                 continue
+            rule = FAULTS.fire("engine.heartbeat", instance=self.name)
+            if rule is not None and rule.action in ("silence", "drop"):
+                # Full silence (process-hang model): no heartbeat AND the
+                # lease stops being refreshed, so the master's three-state
+                # detector walks DELETE → probe → LEASE_LOST/SUSPECT.
+                self.coord.release(
+                    instance_key(self.instance_type.value, self.name))
+                continue
             self.register()  # refresh registration (lease keepalive path)
             master_addr = self.coord.get("XLLM:SERVICE:MASTER")
             if not master_addr:
@@ -240,10 +261,21 @@ class FakeEngine:
 
     async def _accept(self, req: web.Request, chat: bool) -> web.Response:
         body = await req.json()
+        rule = FAULTS.fire("engine.accept", instance=self.name,
+                           sid=body.get("service_request_id", ""))
+        if rule is not None and rule.action == "error":
+            return web.Response(status=503, text="fault injected")
         self.accepted_requests.append(body)
         sid = body.get("service_request_id", "")
+        # A (re)dispatch supersedes any earlier cancellation of the same
+        # request (failover replays may land after a best-effort cancel).
+        self.cancelled.discard(sid)
         source = body.get("source_service_addr", "")
         token_ids = body.get("token_ids", [])
+        if rule is not None and rule.action == "drop":
+            # Accept then swallow: the request hangs until the service
+            # times it out or fails it over.
+            return web.json_response({"ok": True})
         if self.cfg.emit_kv_events and token_ids:
             with self._kv_lock:
                 self._pending_kv_stored.extend(
@@ -261,23 +293,52 @@ class FakeEngine:
                   for i in range(0, len(text), self.cfg.chunk_size)]
         chunks = chunks[:max_tokens] or [""]
         n = len(chunks)
-        prompt_tokens = len(body.get("token_ids", []))
-        for i, chunk in enumerate(chunks):
+        # Failover resume: `resume_generated_token_ids` is the prefix the
+        # client already received (the service appended it to token_ids);
+        # continue the canned reply from the next token. Token ids stay
+        # position-stable across the resume so a chaos drill can assert
+        # the assembled sequence is byte-identical to a no-fault run.
+        resume = list(body.get("resume_generated_token_ids") or ())
+        start = min(len(resume), n)
+        prompt_tokens = len(body.get("token_ids", [])) - len(resume)
+        total_tokens = n
+        seq = 0
+        if start >= n:
+            # Everything was already delivered before the failover: emit
+            # just the terminal delta.
+            chunks = chunks + [""]
+            n += 1
+        for i in range(start, n):
+            chunk = chunks[i]
             if sid in self.cancelled or not self._alive:
                 return
+            rule = FAULTS.fire("engine.token", instance=self.name,
+                               sid=sid, n=i)
+            if rule is not None and rule.action == "crash":
+                logger.info("fault: engine %s crashing before token %d "
+                            "of %s", self.name, i, sid)
+                self.kill()
+                return
+            if rule is not None and rule.action == "delay":
+                time.sleep(rule.delay_s)
             last = i == n - 1
+            seq += 1
             gen: dict[str, Any] = {
                 "request_id": body.get("request_id", sid),
                 "service_request_id": sid,
                 "status": {"code": 0, "message": ""},
-                "outputs": [{"index": 0, "text": chunk, "token_ids": [i],
+                "outputs": [{"index": 0, "text": chunk,
+                             "token_ids": [i] if i < total_tokens else [],
                              "finish_reason": "stop" if last else "",
                              "logprobs": []}],
                 "finished": last,
+                "delta_seq": seq,
+                "instance": self.name,
+                "incarnation": self.incarnation_id,
             }
             if last:
                 gen["usage"] = {"num_prompt_tokens": prompt_tokens,
-                                "num_generated_tokens": n}
+                                "num_generated_tokens": total_tokens}
             try:
                 r = _requests.post(f"http://{source}/rpc/generations",
                                    json={"gens": [gen]}, timeout=5)

@@ -3,9 +3,19 @@
 from __future__ import annotations
 
 import logging
+import random
 import socket
 import threading
 import uuid as _uuid
+
+
+def jittered_backoff(base_s: float, max_s: float, attempt: int) -> float:
+    """Exponential backoff with full-range jitter: 0-based `attempt` k
+    yields a delay in (cap/2, cap] where cap = min(max_s, base_s * 2^k).
+    Shared by the engine channel's retry loop and the failover layer so
+    the two back off identically."""
+    delay = min(max_s, base_s * (2 ** attempt))
+    return delay * (0.5 + random.random() / 2)
 
 
 def short_uuid() -> str:
