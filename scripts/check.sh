@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Static-analysis gate: xlint (project concurrency invariants, always) +
+# ruff (generic lint, when installed). CI runs the same xlint pass via
+# tests/test_xlint.py::test_xlint_tree_clean.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== xlint (concurrency invariants) =="
+python -m xllm_service_tpu.devtools.xlint xllm_service_tpu
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check =="
+    ruff check xllm_service_tpu tests benchmarks scripts
+else
+    echo "== ruff check: skipped (ruff not installed; config lives in pyproject.toml) =="
+fi
+
+echo "check.sh: OK"

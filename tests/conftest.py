@@ -39,6 +39,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 from xllm_service_tpu.coordination.memory import MemoryStore  # noqa: E402
+from xllm_service_tpu.devtools import locks as _xlocks  # noqa: E402
 
 
 @pytest.fixture()
@@ -47,3 +48,19 @@ def store():
     st = MemoryStore(expiry_tick_s=0.02)
     yield st
     st.close()
+
+
+@pytest.fixture(autouse=True)
+def _instrumented_lock_guard():
+    """Under XLLM_LOCK_DEBUG=1 every test doubles as a race/deadlock
+    detector: any lock-order inversion or lock-held-across-I/O recorded by
+    the instrumented locks (devtools/locks.py) during the test fails it —
+    so the existing chaos drills moonlight as a race detector."""
+    if not _xlocks.debug_enabled():
+        yield
+        return
+    _xlocks.reset_violations()
+    yield
+    vs = _xlocks.violations()
+    assert not vs, ("instrumented-lock violations:\n"
+                    + "\n".join(str(v) for v in vs))

@@ -1,0 +1,46 @@
+"""Fixture: no-blocking-under-lock rule — deliberate violations + clean
+and hatched variants. Never imported; only parsed by xlint."""
+
+import threading
+import time
+
+import requests
+
+
+class Chatty:
+    def __init__(self):
+        self._lock = threading.Lock()   # lock-order: 1
+
+    def sleeps_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)             # VIOLATION
+
+    def http_under_lock(self):
+        with self._lock:
+            requests.post("http://example", json={})   # VIOLATION
+
+    def coord_under_lock(self):
+        with self._lock:
+            self._coord.set("k", "v")   # VIOLATION (coordination call)
+
+    def channel_rpc_under_lock(self, ch):
+        with self._lock:
+            ch.forward("/v1/completions", {})   # VIOLATION (channel RPC)
+
+    def fine_outside(self):
+        with self._lock:
+            x = 1
+        time.sleep(0)                   # ok: after the lock is released
+        return x
+
+    def closure_defined_under_lock(self):
+        # ok: the nested def RUNS later, not under the lock.
+        with self._lock:
+            def later():
+                time.sleep(1)
+            return later
+
+    def excused(self):
+        with self._lock:
+            # xlint: allow-blocking-under-lock(fixture demonstrates the escape hatch)
+            time.sleep(0)

@@ -1,0 +1,6 @@
+"""Fixture registry for the fault-point rule. Never imported."""
+
+FAULT_POINTS = {
+    "demo.used": "referenced from fault_sites.py",
+    "demo.dead": "VIOLATION: registered but never referenced",
+}

@@ -1,0 +1,45 @@
+"""Fixture: lock-discipline rule — missing annotation, declaration outside
+__init__, bare acquire/release. Never imported; only parsed by xlint."""
+
+import threading
+
+
+class Sloppy:
+    def __init__(self):
+        self.ok_lock = threading.Lock()            # lock-order: 10
+        self.unannotated_lock = threading.Lock()   # VIOLATION: no order
+
+    def lazy_init(self):
+        self.late_lock = threading.Lock()   # lock-order: 11  (VIOLATION: outside __init__)
+
+    def manual_acquire(self):
+        self.ok_lock.acquire()    # VIOLATION: with-only
+        try:
+            pass
+        finally:
+            self.ok_lock.release()   # VIOLATION: with-only
+
+    def excused_acquire(self):
+        got = self.ok_lock.acquire(False)  # xlint: allow-bare-acquire(fixture demonstrates the escape hatch)
+        if got:
+            self.ok_lock.release()  # xlint: allow-bare-acquire(fixture demonstrates the escape hatch)
+
+
+def makes_local_lock():
+    tmp_lock = threading.Lock()   # VIOLATION: function-local lock
+    with tmp_lock:
+        return 1
+
+
+def excused_local_lock():
+    scratch = threading.Lock()  # xlint: allow-local-lock(fixture demonstrates the escape hatch)
+    with scratch:
+        return 2
+
+
+class Conflicted:
+    def __init__(self, flag):
+        if flag:
+            self.mode_lock = threading.Lock()   # lock-order: 20
+        else:
+            self.mode_lock = threading.Lock()   # lock-order: 21  (VIOLATION: conflicting re-declaration)

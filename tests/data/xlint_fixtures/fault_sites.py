@@ -1,0 +1,18 @@
+"""Fixture: fault-point rule call sites. Never imported."""
+
+
+class _Plane:
+    def check(self, point, **ctx):
+        pass
+
+    def fire(self, point, **ctx):
+        pass
+
+
+FAULTS = _Plane()
+
+
+def exercise(dynamic_point):
+    FAULTS.check("demo.used")           # ok: registered
+    FAULTS.check("demo.unregistered")   # VIOLATION: unknown point
+    FAULTS.fire(dynamic_point)          # VIOLATION: non-literal point

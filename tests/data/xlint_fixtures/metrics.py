@@ -1,0 +1,15 @@
+"""Fixture instrument registry for the metrics rule. Never imported."""
+
+
+class _Reg:
+    def counter(self, name, help_=""):
+        return object()
+
+
+REGISTRY = _Reg()
+
+USED_TOTAL = REGISTRY.counter("used_total")
+DEAD_TOTAL = REGISTRY.counter("dead_total")      # VIOLATION: never used
+IMPORT_ONLY_TOTAL = REGISTRY.counter("import_only_total")   # VIOLATION: imported, never referenced
+DUP_A = REGISTRY.counter("duplicated_name")
+DUP_B = REGISTRY.counter("duplicated_name")      # VIOLATION: duplicate name

@@ -1,0 +1,10 @@
+"""Fixture: metrics-registry rule call sites. Never imported."""
+
+from .metrics import IMPORT_ONLY_TOTAL, NOT_DECLARED, REGISTRY, USED_TOTAL  # noqa: F401
+# NOT_DECLARED import above is a VIOLATION (not declared in metrics.py).
+
+ROGUE_TOTAL = REGISTRY.counter("rogue_total")   # VIOLATION: ad-hoc creation
+
+
+def touch():
+    USED_TOTAL.inc()

@@ -1,0 +1,34 @@
+"""Fixture: lock-order rule — inversion via nested with, inversion via a
+project-resolvable call, and a two-lock cycle. Never imported."""
+
+import threading
+
+
+class Orderly:
+    def __init__(self):
+        self.lock_a = threading.Lock()   # lock-order: 1
+        self.lock_b = threading.Lock()   # lock-order: 2
+
+    def respects_order(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+
+    def inverts_order(self):
+        with self.lock_b:
+            with self.lock_a:            # VIOLATION: 2 -> 1 (also a cycle
+                pass                     # together with respects_order)
+
+
+class Interproc:
+    def __init__(self):
+        self.outer_lock = threading.Lock()   # lock-order: 5
+        self.inner_lock = threading.Lock()   # lock-order: 4
+
+    def grab_inner_interproc(self):
+        with self.inner_lock:
+            pass
+
+    def outer_then_call(self):
+        with self.outer_lock:
+            self.grab_inner_interproc()      # VIOLATION: 5 -> 4 via call

@@ -1,0 +1,41 @@
+"""Fixture: broad-except rule in a scoped (scheduler) path. Never
+imported; only parsed by xlint."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def silent_swallow():
+    try:
+        pass
+    except Exception:     # VIOLATION: neither logs nor re-raises
+        pass
+
+
+def bare_handler():
+    try:
+        pass
+    except:               # noqa: E722  VIOLATION: bare except
+        pass
+
+
+def logs_it():
+    try:
+        pass
+    except Exception:     # ok: logs
+        logger.exception("boom")
+
+
+def reraises():
+    try:
+        pass
+    except Exception:     # ok: re-raises
+        raise
+
+
+def excused():
+    try:
+        pass
+    except Exception:  # xlint: allow-broad-except(fixture demonstrates the escape hatch)
+        pass

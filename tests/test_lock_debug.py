@@ -1,0 +1,178 @@
+"""Instrumented-lock race detector (`XLLM_LOCK_DEBUG=1` mode of
+devtools/locks.py): deliberate lock-order inversions and
+blocking-calls-under-lock must be detected, and a real chaos-failover
+drill must run clean with every orchestration lock instrumented."""
+
+import threading
+
+import pytest
+import requests
+
+from xllm_service_tpu.common.faults import FAULTS
+from xllm_service_tpu.common.types import InstanceType
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.devtools import locks
+from xllm_service_tpu.devtools.locks import InstrumentedLock, make_lock
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture()
+def debug_locks():
+    """Instrumentation on for locks created inside the test; violation
+    list drained at exit so the conftest guard (armed when the whole
+    suite runs under XLLM_LOCK_DEBUG=1) doesn't see our deliberate
+    inversions."""
+    prev = locks.debug_enabled()
+    locks.set_debug(True)
+    locks.reset_violations()
+    yield
+    locks.reset_violations()
+    locks.set_debug(prev)
+
+
+class TestFactoryModes:
+    def test_passthrough_by_default(self):
+        prev = locks.debug_enabled()
+        locks.set_debug(False)
+        try:
+            lk = make_lock("t.passthrough", order=1)
+            rl = make_lock("t.passthrough_r", order=2, reentrant=True)
+            assert not isinstance(lk, InstrumentedLock)
+            assert not isinstance(rl, InstrumentedLock)
+            assert isinstance(lk, type(threading.Lock()))
+        finally:
+            locks.set_debug(prev)
+
+    def test_instrumented_under_debug(self, debug_locks):
+        lk = make_lock("t.instr", order=1)
+        assert isinstance(lk, InstrumentedLock)
+        with lk:
+            assert "t.instr" in locks.held_locks()
+        assert "t.instr" not in locks.held_locks()
+
+
+class TestOrderInversion:
+    def test_inversion_detected(self, debug_locks):
+        a = make_lock("t.a", order=1)
+        b = make_lock("t.b", order=2)
+        with b:
+            with a:
+                pass
+        vs = [v for v in locks.violations() if v.kind == "lock-order"]
+        assert vs, "inversion b(2) -> a(1) not detected"
+        assert "t.a" in vs[0].message and "t.b" in vs[0].message
+        assert vs[0].stack   # acquisition stack recorded
+
+    def test_correct_order_clean(self, debug_locks):
+        a = make_lock("t.a2", order=1)
+        b = make_lock("t.b2", order=2)
+        with a:
+            with b:
+                pass
+        assert not locks.violations()
+
+    def test_reentrant_reacquisition_clean(self, debug_locks):
+        r = make_lock("t.r", order=3, reentrant=True)
+        with r:
+            with r:
+                pass
+        assert not locks.violations()
+
+    def test_equal_order_different_locks_flagged(self, debug_locks):
+        x = make_lock("t.x", order=7)
+        y = make_lock("t.y", order=7)
+        with x:
+            with y:
+                pass
+        assert any(v.kind == "lock-order" for v in locks.violations())
+
+
+class TestHeldAcrossYield:
+    def test_blocking_call_under_lock_detected(self, debug_locks):
+        """A fault point (= modeled blocking I/O) crossed while holding an
+        instrumented lock is the runtime blocking-under-lock signal."""
+        lk = make_lock("t.io", order=1)
+        with lk:
+            FAULTS.check("rpc.post", instance="t", path="/x")
+        vs = [v for v in locks.violations() if v.kind == "held-across-yield"]
+        assert vs
+        assert "t.io" in vs[0].message and "rpc.post" in vs[0].message
+
+    def test_reentrant_hold_reported_once(self, debug_locks):
+        """An RLock held at depth 2 across a yield point is ONE violation
+        (and one held_locks entry), not one per acquisition."""
+        r = make_lock("t.rdepth", order=1, reentrant=True)
+        with r:
+            with r:
+                assert locks.held_locks().count("t.rdepth") == 1
+                FAULTS.check("rpc.post", instance="t", path="/x")
+            # Inner release must not drop the entry while still held.
+            assert "t.rdepth" in locks.held_locks()
+        assert "t.rdepth" not in locks.held_locks()
+        vs = [v for v in locks.violations() if v.kind == "held-across-yield"]
+        assert len(vs) == 1
+
+    def test_fault_point_outside_lock_clean(self, debug_locks):
+        lk = make_lock("t.io2", order=1)
+        with lk:
+            pass
+        FAULTS.check("rpc.post", instance="t", path="/x")
+        assert not locks.violations()
+
+
+class TestChaosDrillInstrumented:
+    def test_failover_drill_clean_under_instrumented_locks(self, store,
+                                                           debug_locks):
+        """The PR-1 chaos drill (kill the serving instance mid-stream,
+        stream fails over byte-identically) with every orchestration lock
+        instrumented: the drill must pass AND record zero lock
+        violations — the suite doubling as a race detector."""
+        from xllm_service_tpu.common.config import ServiceOptions
+        from xllm_service_tpu.master import Master
+        from xllm_service_tpu.testing.fake_engine import (
+            FakeEngine,
+            FakeEngineConfig,
+        )
+        from fakes import wait_until
+
+        FAULTS.configure((), seed=0)
+        opts = ServiceOptions(
+            host="127.0.0.1", http_port=0, rpc_port=0,
+            lease_ttl_s=0.5, reconcile_interval_s=0.05,
+            heartbeat_silence_to_suspect_s=0.3,
+            detect_disconnected_instance_interval_s=0.3,
+            health_probe_attempts=1, health_probe_timeout_s=0.2,
+            sync_interval_s=0.2,
+            failover_backoff_base_s=0.05, failover_backoff_max_s=0.3,
+            rpc_backoff_base_s=0.02, rpc_backoff_max_s=0.1)
+        reply = "Instrumented locks must not change failover behavior."
+        master = Master(opts, coord=InMemoryCoordination(store))
+        master.start()
+        cfg = FakeEngineConfig(reply_text=reply, chunk_size=4, delay_s=0.05,
+                               heartbeat_interval_s=0.1, lease_ttl_s=0.5,
+                               instance_type=InstanceType.MIX)
+        engines = [FakeEngine(InMemoryCoordination(store), cfg).start()
+                   for _ in range(2)]
+        try:
+            assert wait_until(
+                lambda: all(master.scheduler.instance_mgr.get_instance_meta(
+                    e.name) is not None for e in engines), timeout=5)
+            # Crash the serving instance right before its 3rd delta.
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=2, max_fires=1)], seed=0)
+            r = requests.post(
+                f"http://127.0.0.1:{master.http_port}/v1/completions",
+                json={"model": "fake-model", "prompt": "chaos",
+                      "max_tokens": 1000}, timeout=60)
+            assert r.status_code == 200, r.text
+            assert r.json()["choices"][0]["text"] == reply
+            assert sum(1 for e in engines if not e._alive) == 1
+        finally:
+            FAULTS.clear()
+            for e in engines:
+                e.stop()
+            master.stop()
+        vs = locks.violations()
+        assert not vs, ("chaos drill produced lock violations:\n"
+                        + "\n".join(str(v) for v in vs))

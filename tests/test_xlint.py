@@ -1,0 +1,211 @@
+"""xlint rule tests: every rule must fire on its violation fixture, stay
+quiet on the clean/hatched variants, and the real tree must lint clean
+(the tier-1 CI gate)."""
+
+import os
+from pathlib import Path
+
+import pytest
+
+from xllm_service_tpu.devtools import xlint
+
+FIXTURES = Path(__file__).parent / "data" / "xlint_fixtures"
+PACKAGE = Path(__file__).parent.parent / "xllm_service_tpu"
+
+
+@pytest.fixture(scope="module")
+def fixture_violations():
+    return xlint.run([str(FIXTURES)])
+
+
+def hits(violations, rule, path_part="", msg_part=""):
+    return [v for v in violations
+            if v.rule == rule and path_part in v.path and msg_part in v.message]
+
+
+# ------------------------------------------------------ no-blocking-under-lock
+class TestBlockingUnderLock:
+    def test_sleep_under_lock_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "no-blocking-under-lock",
+                    "blocking.py", "sleep")
+
+    def test_http_under_lock_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "no-blocking-under-lock",
+                    "blocking.py", "HTTP I/O")
+
+    def test_coordination_call_under_lock_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "no-blocking-under-lock",
+                    "blocking.py", "coordination call")
+
+    def test_channel_rpc_under_lock_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "no-blocking-under-lock",
+                    "blocking.py", "engine-channel RPC")
+
+    def test_exact_violation_count(self, fixture_violations):
+        # fine_outside / closure_defined_under_lock / excused must NOT
+        # fire: exactly the four deliberate violations above.
+        assert len(hits(fixture_violations,
+                        "no-blocking-under-lock", "blocking.py")) == 4
+
+
+# ------------------------------------------------------------- lock-discipline
+class TestLockDiscipline:
+    def test_missing_annotation_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "lock-discipline", "discipline.py",
+                    "unannotated_lock")
+
+    def test_declaration_outside_init_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "lock-discipline", "discipline.py",
+                    "late_lock")
+
+    def test_bare_acquire_and_release_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "lock-discipline", "discipline.py",
+                    "acquire")
+        assert hits(fixture_violations, "lock-discipline", "discipline.py",
+                    "release")
+
+    def test_function_local_lock_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "lock-discipline", "discipline.py",
+                    "local 'tmp_lock'")
+
+    def test_hatched_local_lock_not_flagged(self, fixture_violations):
+        assert not hits(fixture_violations, "lock-discipline",
+                        "discipline.py", "scratch")
+
+    def test_conflicting_redeclaration_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "lock-discipline", "discipline.py",
+                    "re-declared with order 21")
+
+    def test_hatched_acquire_not_flagged(self, fixture_violations):
+        # excused_acquire carries allow-bare-acquire hatches: exactly one
+        # acquire + one release violation remain (manual_acquire's).
+        bare = [v for v in hits(fixture_violations, "lock-discipline",
+                                "discipline.py") if "bare" in v.message]
+        assert len(bare) == 2
+
+
+# ------------------------------------------------------------------ lock-order
+class TestLockOrder:
+    def test_nested_with_inversion_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "lock-order", "ordering.py",
+                    "Orderly.lock_b (order 2) -> Orderly.lock_a (order 1)")
+
+    def test_interprocedural_inversion_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "lock-order", "ordering.py",
+                    "via call to Interproc.grab_inner_interproc()")
+
+    def test_cycle_reported(self, fixture_violations):
+        assert hits(fixture_violations, "lock-order", "ordering.py",
+                    "cycle")
+
+    def test_respecting_order_not_flagged(self, fixture_violations):
+        assert not hits(fixture_violations, "lock-order", "ordering.py",
+                        "Orderly.lock_a (order 1) -> Orderly.lock_b")
+
+
+# ----------------------------------------------------------------- fault-point
+class TestFaultPoints:
+    def test_unregistered_point_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "fault-point", "fault_sites.py",
+                    "demo.unregistered")
+
+    def test_non_literal_point_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "fault-point", "fault_sites.py",
+                    "string literal")
+
+    def test_dead_point_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "fault-point", "faults.py",
+                    "demo.dead")
+
+    def test_registered_used_point_not_flagged(self, fixture_violations):
+        assert not hits(fixture_violations, "fault-point", "", "demo.used")
+
+
+# ------------------------------------------------------------- metrics-registry
+class TestMetricsRegistry:
+    def test_ad_hoc_instrument_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "metrics-registry",
+                    "metrics_sites.py", "ad-hoc")
+
+    def test_undeclared_import_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "metrics-registry",
+                    "metrics_sites.py", "NOT_DECLARED")
+
+    def test_dead_instrument_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "metrics-registry", "metrics.py",
+                    "DEAD_TOTAL")
+
+    def test_import_alone_is_not_a_use(self, fixture_violations):
+        # IMPORT_ONLY_TOTAL is imported by metrics_sites.py but never
+        # referenced — still dead.
+        assert hits(fixture_violations, "metrics-registry", "metrics.py",
+                    "IMPORT_ONLY_TOTAL")
+
+    def test_duplicate_name_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "metrics-registry", "metrics.py",
+                    "duplicated_name")
+
+    def test_used_instrument_not_flagged(self, fixture_violations):
+        assert not hits(fixture_violations, "metrics-registry", "",
+                        "USED_TOTAL")
+
+
+# ---------------------------------------------------------------- broad-except
+class TestBroadExcept:
+    def test_silent_swallow_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "broad-except", "broad_except.py",
+                    "neither logs nor re-raises")
+
+    def test_bare_except_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "broad-except", "broad_except.py",
+                    "bare")
+
+    def test_logging_reraising_and_hatched_not_flagged(self,
+                                                       fixture_violations):
+        # logs_it / reraises / excused are clean: exactly the two
+        # deliberate violations above fire in the fixture.
+        assert len(hits(fixture_violations, "broad-except",
+                        "broad_except.py")) == 2
+
+    def test_single_file_invocation_keeps_dir_scope(self):
+        # Linting just the file must still apply the scheduler-path scope
+        # (scope keys on the absolute path, not the display-relative one).
+        vs = xlint.run([str(FIXTURES / "scheduler" / "broad_except.py")])
+        assert [v for v in vs if v.rule == "broad-except"
+                and "neither logs nor re-raises" in v.message]
+
+
+# ------------------------------------------------------------------- CLI + CI
+class TestDriver:
+    def test_cli_reports_and_exits_nonzero_on_fixtures(self, capsys):
+        rc = xlint.main([str(FIXTURES)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "no-blocking-under-lock" in out
+
+    def test_unparseable_file_reported(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def oops(:\n")
+        vs = xlint.run([str(bad)])
+        assert vs and vs[0].rule == "parse"
+
+
+def test_xlint_tree_clean():
+    """Tier-1 gate: the analyzer over the real package must be clean."""
+    violations = xlint.run([str(PACKAGE)])
+    assert not violations, (
+        "xlint violations in the tree:\n"
+        + "\n".join(str(v) for v in violations)
+        + "\n\nrun: python -m xllm_service_tpu.devtools.xlint "
+          "xllm_service_tpu")
+
+
+def test_cli_clean_on_tree():
+    assert xlint.main([str(PACKAGE), "-q"]) == 0
+
+
+def test_fixture_files_never_imported():
+    """The fixtures must stay import-dead (they contain deliberate
+    anti-patterns): no __init__.py anywhere under the fixture root."""
+    assert not list(FIXTURES.rglob("__init__.py"))
+    assert os.path.isdir(FIXTURES)

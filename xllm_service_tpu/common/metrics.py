@@ -28,7 +28,7 @@ class Counter(_Metric):
     def __init__(self, name: str, help_: str = ""):
         super().__init__(name, help_)
         self._v = 0.0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # lock-order: 810
 
     def inc(self, v: float = 1.0) -> None:
         with self._lock:
@@ -70,7 +70,7 @@ class Histogram(_Metric):
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._n = 0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # lock-order: 812
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -101,7 +101,7 @@ class Histogram(_Metric):
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # lock-order: 814
 
     def counter(self, name: str, help_: str = "") -> Counter:
         return self._get_or_create(name, lambda: Counter(name, help_), Counter)

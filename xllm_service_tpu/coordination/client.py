@@ -17,6 +17,7 @@ from typing import Optional
 
 from .base import CoordinationClient, KeyEvent, WatchCallback, WatchEventType
 from ..common.faults import FAULTS, FaultInjected
+from ..devtools.locks import make_lock
 from ..utils import get_logger
 
 logger = get_logger(__name__)
@@ -39,12 +40,12 @@ class TcpCoordinationClient(CoordinationClient):
         host, _, port = addr.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._auth = (username, password) if username else None
-        self._wlock = threading.Lock()
+        self._wlock = make_lock("coord_client.write", order=30)  # lock-order: 30
         self._ns = namespace.strip("/")
         self._ids = itertools.count(1)
         # rid -> (event, response, connection generation it was sent on).
         self._pending: dict[int, tuple[threading.Event, dict, int]] = {}
-        self._plock = threading.Lock()
+        self._plock = make_lock("coord_client.pending", order=32)  # lock-order: 32
         self._watches: dict[int, tuple[str, WatchCallback]] = {}
         # wid -> keys (namespace-stripped) last known to exist under the
         # watch prefix; the reconnect resync diffs the server's current
@@ -56,7 +57,7 @@ class TcpCoordinationClient(CoordinationClient):
         # be re-asserted with a plain put — that would overwrite a new
         # winner and split-brain).
         self._keepalives: dict[str, tuple[float, str, bool]] = {}
-        self._ka_lock = threading.Lock()
+        self._ka_lock = make_lock("coord_client.keepalives", order=34)  # lock-order: 34
         self._closed = threading.Event()
         self._timeout_s = timeout_s
         # Connection generation, bumped under _wlock with each (re)connect;
@@ -195,6 +196,7 @@ class TcpCoordinationClient(CoordinationClient):
         data = (json.dumps(req) + "\n").encode()
         try:
             with self._wlock:
+                # xlint: allow-blocking-under-lock(single-writer frame serialization; the socket is the resource this lock guards)
                 self._sock.sendall(data)
             return True
         except OSError:
@@ -301,6 +303,7 @@ class TcpCoordinationClient(CoordinationClient):
             with self._wlock:
                 with self._plock:
                     self._pending[rid] = (ev, resp, self._gen)
+                # xlint: allow-blocking-under-lock(single-writer frame serialization; registration + send must be atomic vs reconnect)
                 self._sock.sendall(data)
         except OSError as e:
             with self._plock:

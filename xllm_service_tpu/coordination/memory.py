@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Mapping, Optional
 
 from .base import CoordinationClient, KeyEvent, WatchCallback, WatchEventType
+from ..devtools.locks import make_lock
 
 
 @dataclass
@@ -41,7 +42,7 @@ class MemoryStore:
     dispatch thread (never under the store lock)."""
 
     _shared: dict[str, "MemoryStore"] = {}
-    _shared_lock = threading.Lock()
+    _shared_lock = make_lock("memory_store.shared", order=40)  # lock-order: 40
 
     @classmethod
     def shared(cls, name: str = "default") -> "MemoryStore":
@@ -63,7 +64,7 @@ class MemoryStore:
         self._data: dict[str, _Entry] = {}
         self._watches: list[_Watch] = []
         self._next_watch_id = 1
-        self._lock = threading.Lock()
+        self._lock = make_lock("memory_store.data", order=44)  # lock-order: 44
         self._events: "queue.Queue[Optional[tuple[list[KeyEvent], str, WatchCallback]]]" = queue.Queue()
         self._closed = False
         self._dispatcher = threading.Thread(target=self._dispatch_loop,
@@ -204,7 +205,7 @@ class InMemoryCoordination(CoordinationClient):
         self._ns = namespace.strip("/")
         # key -> ttl for keys this client keeps alive.
         self._keepalives: dict[str, float] = {}
-        self._ka_lock = threading.Lock()
+        self._ka_lock = make_lock("memory_coord.keepalives", order=42)  # lock-order: 42
         self._watch_ids: list[int] = []
         self._closed = threading.Event()
         self._ka_thread = threading.Thread(target=self._keepalive_loop,

@@ -30,6 +30,7 @@ from typing import Optional
 
 from .base import KeyEvent
 from .memory import MemoryStore
+from ..devtools.locks import make_lock
 from ..utils import get_logger
 
 logger = get_logger(__name__)
@@ -39,7 +40,7 @@ class _Conn(socketserver.BaseRequestHandler):
     """One client connection: request/response + watch pushes."""
 
     def setup(self) -> None:
-        self.wlock = threading.Lock()
+        self.wlock = make_lock("coord_server.conn_write", order=36)  # lock-order: 36
         self.watch_ids: dict[int, int] = {}   # client watch id -> store watch id
         self.authed = not self.server.auth    # type: ignore[attr-defined]
         self.rfile = self.request.makefile("rb")
@@ -48,6 +49,7 @@ class _Conn(socketserver.BaseRequestHandler):
         data = (json.dumps(obj) + "\n").encode()
         with self.wlock:
             try:
+                # xlint: allow-blocking-under-lock(single-writer frame serialization; the socket is the resource this lock guards)
                 self.request.sendall(data)
             except OSError:
                 pass
@@ -76,7 +78,7 @@ class _Conn(socketserver.BaseRequestHandler):
                     self._send({"id": rid, "ok": False, "error": "unauthenticated"})
                     continue
                 self._send({"id": rid, **self._dispatch(store, op, req)})
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001  # xlint: allow-broad-except(error is surfaced to the client as a protocol-level error frame)
                 self._send({"id": rid, "ok": False, "error": str(e)})
 
     def _dispatch(self, store: MemoryStore, op: str, req: dict) -> dict:

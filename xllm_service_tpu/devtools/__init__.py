@@ -1,0 +1,21 @@
+"""Developer tooling: concurrency-invariant linting + instrumented locks.
+
+Two halves, one contract:
+
+- :mod:`.xlint` — an AST static-analysis pass enforcing the orchestration
+  plane's concurrency and fault-plane invariants (lock discipline, lock
+  ordering, no blocking I/O under locks, fault-point and metric registry
+  hygiene, broad-except hygiene). Run with
+  ``python -m xllm_service_tpu.devtools.xlint xllm_service_tpu``.
+- :mod:`.locks` — a ``make_lock()`` factory the orchestration modules use
+  instead of bare ``threading.Lock()``. Zero-overhead passthrough normally;
+  under ``XLLM_LOCK_DEBUG=1`` every lock is instrumented so the existing
+  test suite doubles as a race/deadlock detector (per-thread acquisition
+  stacks, lock-order inversion detection against the statically declared
+  order, held-lock detection across fault-injection yield points).
+
+The declared lock order the two halves share lives in the source as
+``# lock-order: N`` annotations on each lock declaration; xlint verifies
+the static acquisition graph against it and ``locks`` verifies the dynamic
+one.
+"""

@@ -1,0 +1,158 @@
+"""xlint — project-specific concurrency-invariant static analysis.
+
+An AST pass over the whole tree enforcing the invariants the orchestration
+plane otherwise maintains only by convention:
+
+=========================  ==================================================
+rule id                    invariant
+=========================  ==================================================
+``no-blocking-under-lock`` no ``time.sleep``, RPC/channel calls, ``requests``
+                           / socket I/O, or coordination-client calls
+                           lexically inside a ``with <lock>`` block
+``lock-discipline``        locks acquired only via ``with`` (no bare
+                           ``.acquire()``); every lock attribute declared at
+                           ``__init__`` (or class/module scope) with a
+                           ``# lock-order: N`` annotation
+``lock-order``             the static lock-acquisition graph (nested ``with``
+                           blocks + one level of project-resolvable calls)
+                           respects the declared order and is acyclic
+``fault-point``            every ``FAULTS.check("p")``/``FAULTS.fire("p")``
+                           names a point registered in ``common/faults.py``'s
+                           ``FAULT_POINTS``, and no registered point is dead
+``metrics-registry``       metric instruments are created only in
+                           ``common/metrics.py`` and none is dead
+``broad-except``           no bare ``except:`` anywhere; in scheduler/rpc/
+                           coordination/engine paths every ``except
+                           Exception`` handler logs or re-raises
+=========================  ==================================================
+
+Escape hatches are inline comments with a mandatory reason::
+
+    # xlint: allow-broad-except(error is surfaced as a client status)
+    # xlint: allow-blocking-under-lock(single-writer frame serialization)
+    # xlint: allow-lock-order(reason)
+    # xlint: allow-bare-acquire(reason)
+    # xlint: allow-lock-annotation(reason)
+
+Run: ``python -m xllm_service_tpu.devtools.xlint xllm_service_tpu``
+(exit 0 = clean, 1 = violations, 2 = usage/parse error).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+_SUPPRESS_RE = re.compile(r"#\s*xlint:\s*allow-([a-z-]+)\(([^)]*)\)")
+
+#: Rule tokens accepted in suppression comments.
+SUPPRESSIBLE = {
+    "broad-except", "blocking-under-lock", "lock-order", "bare-acquire",
+    "lock-annotation", "local-lock",
+}
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    path: Path
+    rel: str                      # path relative to the scan root's parent
+    tree: ast.Module
+    lines: list[str]
+    # line number -> set of rule tokens allowed on that line.
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+
+    def allowed(self, token: str, *linenos: int) -> bool:
+        # A hatch comment may trail the offending line or sit on its own
+        # line directly above it.
+        return any(token in self.suppressions.get(ln, ())
+                   or token in self.suppressions.get(ln - 1, ())
+                   for ln in linenos)
+
+    def line_comment_order(self, lineno: int) -> int | None:
+        """Parse a trailing ``# lock-order: N`` annotation."""
+        if 1 <= lineno <= len(self.lines):
+            m = re.search(r"#\s*lock-order:\s*(-?\d+)", self.lines[lineno - 1])
+            if m:
+                return int(m.group(1))
+        return None
+
+
+def _parse_suppressions(lines: list[str]) -> dict[int, set[str]]:
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(lines, 1):
+        for m in _SUPPRESS_RE.finditer(line):
+            token, reason = m.group(1), m.group(2).strip()
+            if token in SUPPRESSIBLE and reason:
+                out.setdefault(i, set()).add(token)
+    return out
+
+
+def load_files(roots: list[str]) -> tuple[list[SourceFile], list[Violation]]:
+    """Parse every .py under the given roots. Unparseable files are
+    reported as violations (a linter that skips broken files lies)."""
+    files: list[SourceFile] = []
+    errors: list[Violation] = []
+    seen: set[Path] = set()
+    for root in roots:
+        rp = Path(root)
+        paths = sorted(rp.rglob("*.py")) if rp.is_dir() else [rp]
+        base = rp.parent
+        for p in paths:
+            p = p.resolve()
+            if p in seen:
+                continue
+            seen.add(p)
+            try:
+                rel = str(p.relative_to(base.resolve()))
+            except ValueError:
+                rel = str(p)
+            try:
+                src = p.read_text()
+                tree = ast.parse(src, filename=str(p))
+            except (OSError, SyntaxError) as e:
+                errors.append(Violation("parse", rel, getattr(e, "lineno", 0)
+                                        or 0, f"cannot parse: {e}"))
+                continue
+            lines = src.splitlines()
+            files.append(SourceFile(path=p, rel=rel, tree=tree, lines=lines,
+                                    suppressions=_parse_suppressions(lines)))
+    return files, errors
+
+
+def run(roots: list[str]) -> list[Violation]:
+    from . import rules
+
+    files, violations = load_files(roots)
+    project = rules.Project(files)
+    for rule_fn in rules.ALL_RULES:
+        violations.extend(rule_fn(project))
+    return sorted(set(violations), key=lambda v: (v.path, v.line, v.rule))
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    quiet = "-q" in argv
+    roots = [a for a in argv if not a.startswith("-")]
+    if not roots:
+        pkg = Path(__file__).resolve().parents[2]
+        roots = [str(pkg)]
+    violations = run(roots)
+    for v in violations:
+        print(v)
+    if not violations and not quiet:
+        print(f"xlint: clean ({len(roots)} root(s))")
+    return 1 if violations else 0

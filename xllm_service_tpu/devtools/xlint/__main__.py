@@ -1,0 +1,8 @@
+"""CLI: ``python -m xllm_service_tpu.devtools.xlint [paths...]``."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

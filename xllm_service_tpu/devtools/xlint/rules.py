@@ -1,0 +1,712 @@
+"""The xlint rule implementations (each a small pass over the whole tree)."""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Optional
+
+from . import SourceFile, Violation
+
+# Scopes where a lock attribute may legitimately be declared. `setup` is
+# the socketserver handler analog of __init__.
+DECL_METHODS = {"__init__", "setup", "__post_init__"}
+
+# threading factories that produce a lock-like object.
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# EngineChannel control-plane methods: calling any of these is an RPC.
+CHANNEL_METHODS = {"forward", "forward_status", "health", "link", "unlink",
+                   "flip_role", "models"}
+
+# Method names too generic to resolve to one project class for the
+# call-graph half of the lock-order rule (dict/queue/socket/file methods
+# would alias them and fabricate edges).
+GENERIC_NAMES = {
+    "get", "put", "pop", "set", "add", "clear", "close", "release", "start",
+    "stop", "join", "wait", "send", "read", "write", "open", "run", "update",
+    "append", "remove", "submit", "flush", "encode", "decode", "render",
+    "value", "count", "mean", "stats", "meta", "register", "push", "cancel",
+    "step", "fire", "check", "items", "keys", "values", "acquire", "copy",
+    "extend", "discard", "setdefault", "sleep", "log",
+}
+
+LOG_METHODS = {"exception", "warning", "error", "info", "debug", "critical",
+               "log"}
+
+#: Dirs (path segments) where the broad-except rule is enforced.
+EXCEPT_SCOPED_DIRS = {"scheduler", "rpc", "coordination", "engine"}
+
+
+# --------------------------------------------------------------- AST helpers
+def _expr_text(node: ast.AST) -> str:
+    """Dotted-path text for Name/Attribute/Call chains ('' if unprintable)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _expr_text(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        base = _expr_text(node.func)
+        return f"{base}()" if base else ""
+    return ""
+
+
+def _lock_factory_kind(node: ast.AST) -> Optional[str]:
+    """'threading' | 'make_lock' when `node` constructs a lock; else None.
+    Handles `A if cond else B` declarations (either arm a lock call)."""
+    if isinstance(node, ast.IfExp):
+        return _lock_factory_kind(node.body) or _lock_factory_kind(node.orelse)
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in LOCK_FACTORIES \
+            and _expr_text(f.value) == "threading":
+        return "threading"
+    if isinstance(f, ast.Name) and f.id in LOCK_FACTORIES:
+        return "threading"
+    if (isinstance(f, ast.Name) and f.id == "make_lock") or \
+            (isinstance(f, ast.Attribute) and f.attr == "make_lock"):
+        return "make_lock"
+    return None
+
+
+def _make_lock_order_kwarg(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.IfExp):
+        return _make_lock_order_kwarg(node.body) \
+            if _lock_factory_kind(node.body) == "make_lock" \
+            else _make_lock_order_kwarg(node.orelse)
+    if isinstance(node, ast.Call):
+        for kw in node.keywords:
+            if kw.arg == "order" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, int):
+                return kw.value.value
+    return None
+
+
+def _first_str_arg(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+# ------------------------------------------------------------- project model
+@dataclass
+class LockDecl:
+    key: tuple[str, str]          # (class name or "<module>", attr name)
+    file: SourceFile
+    line: int
+    kind: str                     # "threading" | "make_lock"
+    order: Optional[int]          # from the # lock-order comment
+    kwarg_order: Optional[int]    # from make_lock(order=N)
+    in_decl_scope: bool
+
+
+class Project:
+    """Cross-file indices shared by the rules."""
+
+    def __init__(self, files: list[SourceFile]):
+        self.files = files
+        # (class, method) -> (FunctionDef, SourceFile, ClassDef)
+        self.methods: dict[tuple[str, str], tuple[ast.AST, SourceFile]] = {}
+        # method name -> set of class names defining it
+        self.method_classes: dict[str, set[str]] = {}
+        self.lock_decls: dict[tuple[str, str], LockDecl] = {}
+        self.stray_lock_decls: list[LockDecl] = []   # declared outside scope
+        # Same (class, attr) declared twice with a different order — the
+        # graph would silently use the wrong order, so it is a violation.
+        self.conflicting_lock_decls: list[tuple[LockDecl, LockDecl]] = []
+        # Locks bound to function locals: invisible to the annotation /
+        # ordering machinery, so their creation is itself a violation.
+        self.local_lock_decls: list[LockDecl] = []
+        for f in files:
+            self._index_file(f)
+            self._index_local_locks(f)
+
+    def _index_file(self, f: SourceFile) -> None:
+        for node in f.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._index_class(f, node)
+            elif isinstance(node, ast.Assign):
+                self._maybe_lock_decl(f, "<module>", node, targets_self=False,
+                                      in_scope=True)
+
+    def _index_class(self, f: SourceFile, cls: ast.ClassDef) -> None:
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[(cls.name, node.name)] = (node, f)
+                self.method_classes.setdefault(node.name, set()).add(cls.name)
+                in_scope = node.name in DECL_METHODS
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        self._maybe_lock_decl(f, cls.name, sub,
+                                              targets_self=True,
+                                              in_scope=in_scope)
+            elif isinstance(node, ast.Assign):
+                self._maybe_lock_decl(f, cls.name, node, targets_self=False,
+                                      in_scope=True)
+
+    def _maybe_lock_decl(self, f: SourceFile, owner: str, node: ast.Assign,
+                         targets_self: bool, in_scope: bool) -> None:
+        kind = _lock_factory_kind(node.value)
+        if kind is None:
+            return
+        for tgt in node.targets:
+            if targets_self:
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in ("self", "cls")):
+                    continue
+                attr = tgt.attr
+            else:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                attr = tgt.id
+            decl = LockDecl(
+                key=(owner, attr), file=f, line=node.lineno, kind=kind,
+                order=f.line_comment_order(node.lineno),
+                kwarg_order=_make_lock_order_kwarg(node.value),
+                in_decl_scope=in_scope)
+            if in_scope:
+                prior = self.lock_decls.get(decl.key)
+                if prior is None:
+                    self.lock_decls[decl.key] = decl
+                elif prior.line != decl.line and prior.order != decl.order:
+                    self.conflicting_lock_decls.append((prior, decl))
+            else:
+                self.stray_lock_decls.append(decl)
+
+    def _index_local_locks(self, f: SourceFile) -> None:
+        """Lock factories assigned to plain names inside any function:
+        a short-lived local lock protects nothing across threads unless it
+        escapes, and escapes untracked — both are bugs."""
+        for node in ast.walk(f.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) \
+                        and _lock_factory_kind(sub.value) is not None:
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.local_lock_decls.append(LockDecl(
+                                key=(node.name, tgt.id), file=f,
+                                line=sub.lineno,
+                                kind=_lock_factory_kind(sub.value),
+                                order=f.line_comment_order(sub.lineno),
+                                kwarg_order=None, in_decl_scope=False))
+
+    # Resolution for the call-graph half of the lock-order rule.
+    def resolve_call(self, cls_name: Optional[str],
+                     is_self_call: bool, name: str
+                     ) -> Optional[tuple[str, str]]:
+        if is_self_call and cls_name and (cls_name, name) in self.methods:
+            return (cls_name, name)
+        if name in GENERIC_NAMES:
+            return None
+        owners = self.method_classes.get(name, set())
+        if len(owners) == 1:
+            return (next(iter(owners)), name)
+        return None
+
+    def class_lock_attrs(self, cls_name: str) -> set[str]:
+        return {attr for (owner, attr) in self.lock_decls if owner == cls_name}
+
+
+# ---------------------------------------------------------- lock discipline
+def rule_lock_discipline(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for decl in list(project.lock_decls.values()):
+        f = decl.file
+        if decl.order is None:
+            if not f.allowed("lock-annotation", decl.line):
+                out.append(Violation(
+                    "lock-discipline", f.rel, decl.line,
+                    f"lock {decl.key[0]}.{decl.key[1]} has no "
+                    f"'# lock-order: N' annotation"))
+        elif decl.kwarg_order is not None and decl.kwarg_order != decl.order:
+            out.append(Violation(
+                "lock-discipline", f.rel, decl.line,
+                f"lock {decl.key[0]}.{decl.key[1]}: make_lock(order="
+                f"{decl.kwarg_order}) disagrees with '# lock-order: "
+                f"{decl.order}' annotation"))
+    for decl in project.stray_lock_decls:
+        out.append(Violation(
+            "lock-discipline", decl.file.rel, decl.line,
+            f"lock {decl.key[0]}.{decl.key[1]} created outside "
+            f"__init__/setup/class scope (locks must be declared once, "
+            f"at construction)"))
+    for decl in project.local_lock_decls:
+        if not decl.file.allowed("local-lock", decl.line):
+            out.append(Violation(
+                "lock-discipline", decl.file.rel, decl.line,
+                f"lock bound to local {decl.key[1]!r} in {decl.key[0]}() — "
+                f"locks must be long-lived attributes declared at "
+                f"construction (annotation + ordering cannot track a "
+                f"function local)"))
+    for prior, dup in project.conflicting_lock_decls:
+        out.append(Violation(
+            "lock-discipline", dup.file.rel, dup.line,
+            f"lock {dup.key[0]}.{dup.key[1]} re-declared with order "
+            f"{dup.order} but {prior.file.rel}:{prior.line} declares "
+            f"order {prior.order} (the graph check uses the first)"))
+
+    # Bare .acquire()/.release() — locks are `with`-only.
+    for f in project.files:
+        for cls_name, fn in _iter_functions(f):
+            lock_attrs = project.class_lock_attrs(cls_name) if cls_name else set()
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                recv = _expr_text(node.func.value)
+                recv_is_lock = (
+                    "lock" in recv.lower()
+                    or (recv.startswith("self.")
+                        and recv[len("self."):] in lock_attrs))
+                if not recv_is_lock:
+                    continue
+                if node.func.attr == "acquire" or (
+                        node.func.attr == "release" and not node.args):
+                    if not f.allowed("bare-acquire", node.lineno):
+                        out.append(Violation(
+                            "lock-discipline", f.rel, node.lineno,
+                            f"bare {recv}.{node.func.attr}() — acquire "
+                            f"locks via 'with' only"))
+    return out
+
+
+def _iter_functions(f: SourceFile):
+    """Yields (enclosing class name or None, function node) for every
+    top-level function and every method."""
+    for node in f.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield node.name, sub
+
+
+# ------------------------------------------------------ blocking under lock
+def _with_lock_items(with_node: ast.With, cls_name: Optional[str],
+                     lock_attrs: set[str]) -> list[str]:
+    """Names of lock-like context managers entered by this With."""
+    hits = []
+    for item in with_node.items:
+        text = _expr_text(item.context_expr)
+        last = text.rsplit(".", 1)[-1]
+        if "lock" in last.lower():
+            hits.append(text)
+        elif text.startswith(("self.", "cls.")) \
+                and text.split(".", 1)[1] in lock_attrs:
+            hits.append(text)
+    return hits
+
+
+def _is_blocking_call(node: ast.Call) -> Optional[str]:
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    recv = _expr_text(node.func.value)
+    rlow = recv.lower()
+    if attr == "sleep":
+        return f"{recv}.sleep() sleeps"
+    if recv in ("requests", "_requests") and attr in (
+            "get", "post", "put", "delete", "head", "request"):
+        return f"requests.{attr}() performs HTTP I/O"
+    if "session" in rlow and attr in ("get", "post", "put", "delete",
+                                      "request", "head"):
+        return f"{recv}.{attr}() performs HTTP I/O"
+    if attr in ("sendall", "recv", "recv_into"):
+        return f"{recv}.{attr}() performs socket I/O"
+    if attr == "create_connection" and rlow.endswith("socket"):
+        return "socket.create_connection() performs socket I/O"
+    if recv == "FAULTS" and attr in ("check", "fire"):
+        return f"FAULTS.{attr}() marks a blocking-I/O fault point"
+    if rlow.endswith("coord") or "._coord" in rlow or rlow == "coord":
+        return f"coordination call {recv}.{attr}()"
+    if attr in CHANNEL_METHODS:
+        return f"engine-channel RPC {recv}.{attr}()"
+    if attr == "cancel" and (rlow in ("ch", "chan", "channel")
+                             or rlow.endswith(".channel")):
+        return f"engine-channel RPC {recv}.cancel()"
+    return None
+
+
+def rule_no_blocking_under_lock(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+
+    def visit(node: ast.AST, f: SourceFile, cls_name: Optional[str],
+              lock_attrs: set[str], stack: list[tuple[str, int]]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and stack:
+            # A def nested inside a with-block runs later, not under the
+            # lock — its body starts a fresh lexical context.
+            for child in ast.iter_child_nodes(node):
+                visit(child, f, cls_name, lock_attrs, [])
+            return
+        entered = 0
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for name in _with_lock_items(node, cls_name, lock_attrs):
+                stack.append((name, node.lineno))
+                entered += 1
+        elif isinstance(node, ast.Call) and stack:
+            why = _is_blocking_call(node)
+            if why is not None:
+                with_lines = [ln for _, ln in stack]
+                if not f.allowed("blocking-under-lock", node.lineno,
+                                 *with_lines):
+                    out.append(Violation(
+                        "no-blocking-under-lock", f.rel, node.lineno,
+                        f"{why} while holding {stack[-1][0]} "
+                        f"(acquired line {stack[-1][1]})"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, f, cls_name, lock_attrs, stack)
+        for _ in range(entered):
+            stack.pop()
+
+    for f in project.files:
+        for cls_name, fn in _iter_functions(f):
+            attrs = project.class_lock_attrs(cls_name) if cls_name else set()
+            visit(fn, f, cls_name, attrs, [])
+    return out
+
+
+# ------------------------------------------------------------- lock ordering
+def _with_decl_locks(with_node, cls_name: Optional[str],
+                     project: Project) -> list[tuple[str, str]]:
+    """Declared-lock keys entered by this With (only declared locks
+    participate in the acquisition graph)."""
+    keys = []
+    if cls_name is None:
+        return keys
+    for item in with_node.items:
+        text = _expr_text(item.context_expr)
+        if text.startswith(("self.", "cls.")):
+            attr = text.split(".", 1)[1]
+            if (cls_name, attr) in project.lock_decls:
+                keys.append((cls_name, attr))
+    return keys
+
+
+@dataclass
+class _Edge:
+    src: tuple[str, str]
+    dst: tuple[str, str]
+    file: SourceFile
+    line: int
+    via: str
+
+
+def rule_lock_order(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+
+    # Pass 1: per-method direct acquisitions + call refs (anywhere in the
+    # method, nested defs included — conservative for summaries).
+    direct: dict[tuple[str, str], set[tuple[str, str]]] = {}
+    calls: dict[tuple[str, str], set[tuple[bool, str]]] = {}
+    for (cls_name, meth), (fn, _f) in project.methods.items():
+        acq: set[tuple[str, str]] = set()
+        refs: set[tuple[bool, str]] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acq.update(_with_decl_locks(node, cls_name, project))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                recv = _expr_text(node.func.value)
+                refs.add((recv == "self", node.func.attr))
+        direct[(cls_name, meth)] = acq
+        calls[(cls_name, meth)] = refs
+
+    # Pass 2: transitive summaries (which locks can a method acquire).
+    summary = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, refs in calls.items():
+            cur = summary[key]
+            for is_self, name in refs:
+                target = project.resolve_call(key[0], is_self, name)
+                if target is not None and target in summary:
+                    before = len(cur)
+                    cur |= summary[target]
+                    if len(cur) != before:
+                        changed = True
+
+    # Pass 3: edges from with-block bodies (direct nesting + one level of
+    # resolvable calls through the summaries).
+    edges: list[_Edge] = []
+
+    def visit(node, f: SourceFile, cls_name: str,
+              stack: list[tuple[tuple[str, str], int]]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and stack:
+            for child in ast.iter_child_nodes(node):
+                visit(child, f, cls_name, [])
+            return
+        entered = 0
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for key in _with_decl_locks(node, cls_name, project):
+                if stack:
+                    src = stack[-1][0]
+                    if src != key:
+                        edges.append(_Edge(src, key, f, node.lineno,
+                                           via="nested with"))
+                stack.append((key, node.lineno))
+                entered += 1
+        elif isinstance(node, ast.Call) and stack \
+                and isinstance(node.func, ast.Attribute):
+            target = project.resolve_call(
+                cls_name, _expr_text(node.func.value) == "self",
+                node.func.attr)
+            if target is not None:
+                src = stack[-1][0]
+                for dst in summary.get(target, ()):
+                    if dst != src:
+                        edges.append(_Edge(
+                            src, dst, f, node.lineno,
+                            via=f"call to {target[0]}.{target[1]}()"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, f, cls_name, stack)
+        for _ in range(entered):
+            stack.pop()
+
+    for f in project.files:
+        for cls_name, fn in _iter_functions(f):
+            if cls_name is not None:
+                visit(fn, f, cls_name, [])
+
+    # Check declared order along every edge.
+    seen: set[tuple] = set()
+    adj: dict[tuple[str, str], set[tuple[str, str]]] = {}
+    for e in edges:
+        adj.setdefault(e.src, set()).add(e.dst)
+        so = project.lock_decls[e.src].order
+        do = project.lock_decls[e.dst].order
+        if so is None or do is None:
+            continue   # annotation violation already reported
+        if so >= do:
+            sig = (e.src, e.dst, e.file.rel, e.line)
+            if sig in seen:
+                continue
+            seen.add(sig)
+            if not e.file.allowed("lock-order", e.line):
+                out.append(Violation(
+                    "lock-order", e.file.rel, e.line,
+                    f"{'.'.join(e.src)} (order {so}) -> "
+                    f"{'.'.join(e.dst)} (order {do}) via {e.via} "
+                    f"violates the declared lock order"))
+
+    # Cycle detection over the acquisition graph.
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {k: WHITE for k in adj}
+
+    def dfs(k, path):
+        color[k] = GREY
+        for nxt in adj.get(k, ()):
+            if color.get(nxt, WHITE) == GREY:
+                cyc = path[path.index(nxt):] + [nxt] if nxt in path else [k, nxt]
+                names = " -> ".join(".".join(c) for c in cyc)
+                decl = project.lock_decls[nxt]
+                out.append(Violation(
+                    "lock-order", decl.file.rel, decl.line,
+                    f"lock-acquisition cycle: {names}"))
+            elif color.get(nxt, WHITE) == WHITE and nxt in adj:
+                dfs(nxt, path + [nxt])
+        color[k] = BLACK
+
+    for k in list(adj):
+        if color[k] == WHITE:
+            dfs(k, [k])
+    return out
+
+
+# -------------------------------------------------------------- fault points
+def rule_fault_point(project: Project) -> list[Violation]:
+    registry: dict[str, int] = {}
+    reg_file: Optional[SourceFile] = None
+    for f in project.files:
+        if f.path.name != "faults.py":
+            continue
+        for node in f.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
+                    for t in node.targets) \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        registry[k.value] = k.lineno
+                reg_file = f
+    if reg_file is None:
+        return []   # partial tree (e.g. fixture subset without a registry)
+
+    out: list[Violation] = []
+    used: set[str] = set()
+    for f in project.files:
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("check", "fire")
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "FAULTS"):
+                continue
+            point = _first_str_arg(node)
+            if point is None:
+                out.append(Violation(
+                    "fault-point", f.rel, node.lineno,
+                    "fault point must be a string literal"))
+            elif point not in registry:
+                out.append(Violation(
+                    "fault-point", f.rel, node.lineno,
+                    f"fault point {point!r} is not registered in "
+                    f"common/faults.py FAULT_POINTS"))
+            else:
+                used.add(point)
+    for point, line in sorted(registry.items()):
+        if point not in used:
+            out.append(Violation(
+                "fault-point", reg_file.rel, line,
+                f"registered fault point {point!r} has no call site "
+                f"(dead fault point)"))
+    return out
+
+
+# ------------------------------------------------------------------- metrics
+def rule_metrics_registry(project: Project) -> list[Violation]:
+    decl_file: Optional[SourceFile] = None
+    instruments: dict[str, tuple[str, int]] = {}   # identifier -> (name, line)
+    top_names: set[str] = set()
+    for f in project.files:
+        if f.path.name != "metrics.py":
+            continue
+        names, found = set(), {}
+        for node in f.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                if isinstance(node.value, ast.Call) \
+                        and isinstance(node.value.func, ast.Attribute) \
+                        and node.value.func.attr in ("counter", "gauge",
+                                                     "histogram"):
+                    mname = _first_str_arg(node.value)
+                    tgt = node.targets[0]
+                    if mname and isinstance(tgt, ast.Name):
+                        found[tgt.id] = (mname, node.lineno)
+        if found:
+            decl_file, instruments, top_names = f, found, names
+    if decl_file is None:
+        return []
+
+    out: list[Violation] = []
+    dupes: dict[str, str] = {}
+    for ident, (mname, line) in instruments.items():
+        if mname in dupes:
+            out.append(Violation(
+                "metrics-registry", decl_file.rel, line,
+                f"metric name {mname!r} declared twice "
+                f"({dupes[mname]} and {ident})"))
+        dupes[mname] = ident
+
+    used: set[str] = set()
+    for f in project.files:
+        if f is decl_file:
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("counter", "gauge", "histogram") \
+                    and _expr_text(node.func.value).endswith("REGISTRY"):
+                out.append(Violation(
+                    "metrics-registry", f.rel, node.lineno,
+                    f"ad-hoc metric creation (REGISTRY.{node.func.attr}) — "
+                    f"declare instruments in common/metrics.py"))
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.rsplit(".", 1)[-1] == "metrics":
+                for alias in node.names:
+                    if alias.name not in top_names and alias.name != "*":
+                        out.append(Violation(
+                            "metrics-registry", f.rel, node.lineno,
+                            f"import of {alias.name!r} not declared in "
+                            f"common/metrics.py"))
+                    # An import alone is NOT a use: only Name/Attribute
+                    # references keep an instrument off the dead list.
+            elif isinstance(node, ast.Name) and node.id in instruments:
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute) and node.attr in instruments:
+                used.add(node.attr)
+    for ident, (mname, line) in sorted(instruments.items()):
+        if ident not in used:
+            out.append(Violation(
+                "metrics-registry", decl_file.rel, line,
+                f"instrument {ident} ({mname!r}) is never used "
+                f"(dead metric)"))
+    return out
+
+
+# -------------------------------------------------------------- broad except
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handler_logs_or_raises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in LOG_METHODS \
+                and "log" in _expr_text(node.func.value).lower():
+            return True
+    return False
+
+
+def rule_broad_except(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for f in project.files:
+        # Scope on the absolute path, not the display-relative one: a
+        # single-file invocation strips the parent dirs from f.rel and
+        # would silently drop the scheduler/rpc/coordination/engine scope.
+        scoped = bool(EXCEPT_SCOPED_DIRS & set(f.path.parts))
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                if not f.allowed("broad-except", node.lineno):
+                    out.append(Violation(
+                        "broad-except", f.rel, node.lineno,
+                        "bare 'except:' — name the exception type"))
+                continue
+            if scoped and _handler_is_broad(node) \
+                    and not _handler_logs_or_raises(node) \
+                    and not f.allowed("broad-except", node.lineno):
+                out.append(Violation(
+                    "broad-except", f.rel, node.lineno,
+                    "broad 'except Exception' neither logs nor re-raises "
+                    "(add logging, re-raise, or "
+                    "'# xlint: allow-broad-except(reason)')"))
+    return out
+
+
+def _rel_parts(rel: str) -> list[str]:
+    return rel.replace("\\", "/").split("/")
+
+
+ALL_RULES = (
+    rule_lock_discipline,
+    rule_no_blocking_under_lock,
+    rule_lock_order,
+    rule_fault_point,
+    rule_metrics_registry,
+    rule_broad_except,
+)

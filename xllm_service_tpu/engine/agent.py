@@ -34,6 +34,7 @@ import jax
 
 from ..common.request import LogProb, RequestOutput, SamplingParams, Status, StatusCode
 from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
+from ..devtools.locks import make_lock
 from ..coordination import CoordinationClient, connect
 from ..rpc import MASTER_KEY, instance_key
 from ..chat_template import MM_PLACEHOLDER, JinjaChatTemplate
@@ -131,7 +132,7 @@ class _ChoiceAggregator:
         self._push = push
         self._prompt_tokens = 0
         self._generated = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("agent.choice_aggregator", order=60)  # lock-order: 60
 
     def callback_for(self, index: int):
         def cb(out: RequestOutput) -> None:
@@ -183,7 +184,7 @@ class GenerationStreamer:
         self._engine = engine
         self._q: "queue.Queue[Optional[tuple[str, dict]]]" = queue.Queue()
         self._flush_s = flush_ms / 1000.0
-        self._seq_lock = threading.Lock()
+        self._seq_lock = make_lock("agent.streamer_seq", order=62)  # lock-order: 62
         self._seqs: dict[str, int] = {}
         # Sender identity stamped on every delta (set by the agent once its
         # address/incarnation are known; empty = unstamped, accepted as-is).
@@ -819,7 +820,7 @@ class EngineAgent:
                 pixels = self._extract_images(body.get("messages") or [])
             except ValueError as e:
                 return web.json_response({"error": str(e)}, status=400)
-            except Exception as e:  # noqa: BLE001 — bad base64/PIL data
+            except Exception as e:  # noqa: BLE001  # xlint: allow-broad-except(bad base64/PIL data is surfaced as a 400 to the client)
                 return web.json_response(
                     {"error": f"invalid image payload: {e}"}, status=400)
             if pixels is not None:
@@ -829,7 +830,7 @@ class EngineAgent:
                     mm_embeds = await asyncio.get_running_loop() \
                         .run_in_executor(None, self._encode_pixels, pixels,
                                          encode_name)
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001  # xlint: allow-broad-except(encode failure is surfaced as a 502 to the client)
                     return web.json_response(
                         {"error": f"vision encode failed: {e}"}, status=502)
                 token_ids = self._build_mm_token_ids(
@@ -1037,7 +1038,7 @@ class EngineAgent:
         data = await req.read()
         try:
             obj = unpack_handoff(data)
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001  # xlint: allow-broad-except(malformed handoff is surfaced as a 400 to the peer)
             return web.json_response({"error": f"bad handoff: {e}"},
                                      status=400)
         # Enforce the P-D link on the transfer itself (the link-time

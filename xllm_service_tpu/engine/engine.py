@@ -309,7 +309,7 @@ class InferenceEngine:
         # one chunk advances per step, round-robin; decode interleaves).
         self._prefillings: deque[dict[str, Any]] = deque()
         self._free_slots = list(range(B - 1, -1, -1))
-        self._lock = threading.Condition()
+        self._lock = threading.Condition()  # lock-order: 50
         self._cancelled: set[str] = set()
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None

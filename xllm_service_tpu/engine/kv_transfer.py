@@ -19,7 +19,6 @@ the same `PrefillHandoff` contract.
 from __future__ import annotations
 
 import hashlib
-import threading
 import time
 from typing import Any, Optional
 
@@ -27,9 +26,23 @@ import jax
 import jax.numpy as jnp
 
 from ..common.faults import FAULTS
+from ..devtools.locks import make_lock
 from ..utils import get_logger
 
+# `jax.experimental.transfer` only exists in jax builds with transfer-server
+# support; absent (e.g. CPU-only containers) every caller falls back to the
+# host-msgpack path and tests gate on `device_transfer_available()`.
+try:
+    from jax.experimental import transfer as _xfer
+except ImportError:
+    _xfer = None
+
 logger = get_logger(__name__)
+
+
+def device_transfer_available() -> bool:
+    """Whether this runtime can move KV pages device-to-device."""
+    return _xfer is not None
 
 # An offer the decode peer never pulled (transfer failed mid-flight) is
 # dropped after this long so the KV buffers can be freed.
@@ -54,14 +67,15 @@ class KvTransferManager:
 
     def __init__(self, device: jax.Device, listen_ip: str = "127.0.0.1",
                  mesh=None):
-        from jax.experimental import transfer as _xfer
-
+        if _xfer is None:
+            raise RuntimeError(
+                "jax.experimental.transfer is unavailable in this runtime")
         self._device = device
         self._mesh = mesh
         self._server = _xfer.start_transfer_server(
             device.client, f"{listen_ip}:0", [f"{listen_ip}:0"])
         self._conns: dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("kv_transfer.pending", order=56)  # lock-order: 56
         # uuid -> (arrays, deadline): keeps offered buffers alive until the
         # peer confirms the pull (release()) or the TTL lapses.
         self._pending: dict[int, tuple[Any, float]] = {}

@@ -44,6 +44,7 @@ import jax
 import msgpack
 
 from ..common.request import RequestOutput, SamplingParams
+from ..devtools.locks import make_lock
 from ..parallel import multihost
 from .engine import EngineRequest, InferenceEngine
 
@@ -59,7 +60,7 @@ class MultihostEngineDriver:
         # submit()/cancel() run on agent threads while tick() drains on
         # the lockstep thread: _pending and _callbacks share one lock so
         # an event and its callback registration are atomic vs the drain.
-        self._lock = threading.Lock()
+        self._lock = make_lock("multihost_driver.pending", order=52)  # lock-order: 52
         self._pending: list[dict] = []
         self._callbacks: dict[int, object] = {}
         self._cb_seq = 0

@@ -9,16 +9,17 @@ Parity: reference `http_service/request_tracer.{h,cpp}` — appends
 from __future__ import annotations
 
 import json
-import threading
 import time
 from pathlib import Path
 from typing import Any
+
+from ..devtools.locks import make_lock
 
 
 class RequestTracer:
     def __init__(self, trace_dir: str = "trace", enabled: bool = False):
         self._enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = make_lock("request_tracer.file", order=70)  # lock-order: 70
         self._path = Path(trace_dir) / "trace.json"
         if enabled:
             self._path.parent.mkdir(parents=True, exist_ok=True)

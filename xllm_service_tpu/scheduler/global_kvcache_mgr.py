@@ -11,12 +11,12 @@ via watch.
 from __future__ import annotations
 
 import json
-import threading
 from typing import Optional, Sequence
 
 from ..common.hashing import prefix_block_hash_hexes
 from ..common.types import CacheLocations, KvCacheEvent, OverlapScores
 from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..devtools.locks import make_lock
 from ..rpc import CACHE_KEY_PREFIX, MASTER_KEY
 from ..utils import get_logger
 
@@ -36,7 +36,7 @@ class GlobalKVCacheMgr:
         self._coord = coord
         self._block_size = block_size
         self._is_master = is_master
-        self._lock = threading.Lock()
+        self._lock = make_lock("global_kvcache_mgr.cache", order=26)  # lock-order: 26
         self._cache: dict[str, CacheLocations] = {}
         # Master-side pending delta for the upload loop
         # (`global_kvcache_mgr.cpp:227-247`).

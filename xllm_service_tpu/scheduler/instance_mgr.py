@@ -49,6 +49,7 @@ from ..common.types import (
 )
 from ..common.request import Request
 from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..devtools.locks import make_lock
 from ..rpc import (
     INSTANCE_KEY_PREFIX,
     LOADMETRICS_KEY_PREFIX,
@@ -107,7 +108,7 @@ class InstanceMgr:
         self._channel_factory = channel_factory or (
             lambda name, rpc_addr: EngineChannel.from_options(name, options))
         # L1: fleet membership + indices.
-        self._cluster_lock = threading.RLock()
+        self._cluster_lock = make_lock("instance_mgr.cluster", order=20, reentrant=True)  # lock-order: 20
         self._instances: dict[str, _Entry] = {}
         self._prefill_index: list[str] = []
         self._decode_index: list[str] = []
@@ -116,10 +117,10 @@ class InstanceMgr:
         self._rr_decode = 0
         self._rr_encode = 0
         # Pending async role flips (performed by the reconcile thread).
-        self._flip_lock = threading.Lock()
+        self._flip_lock = make_lock("instance_mgr.flip", order=22)  # lock-order: 22
         self._pending_flips: dict[str, InstanceType] = {}
         # L2: metrics.
-        self._metrics_lock = threading.Lock()
+        self._metrics_lock = make_lock("instance_mgr.metrics", order=24)  # lock-order: 24
         self._load_metrics: dict[str, LoadMetrics] = {}
         self._latency_metrics: dict[str, LatencyMetrics] = {}
         self._request_loads: dict[str, _RequestLoad] = {}

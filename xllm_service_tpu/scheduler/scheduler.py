@@ -62,6 +62,7 @@ from ..common.types import (
 )
 from ..coordination import CoordinationClient, connect
 from ..coordination.base import KeyEvent, WatchEventType
+from ..devtools.locks import make_lock
 from ..rpc import MASTER_KEY, SERVICE_KEY_PREFIX
 from ..scheduler.global_kvcache_mgr import GlobalKVCacheMgr
 from ..scheduler.instance_mgr import InstanceMgr
@@ -161,7 +162,7 @@ class Scheduler:
         # holding it so a concurrent first-token delta can't interleave a
         # FINISH_PREFILL after a CANCEL (which would leak decode load).
         self._requests: dict[str, _RequestState] = {}
-        self._req_lock = threading.RLock()
+        self._req_lock = make_lock("scheduler.requests", order=10, reentrant=True)  # lock-order: 10
         self._output_executor = OrderedExecutor(options.num_output_threads)
 
         self._stopped = threading.Event()
@@ -265,7 +266,7 @@ class Scheduler:
                 request.prompt = self.chat_template.apply(
                     request.messages, request.tools,
                     request.chat_template_kwargs)
-            except Exception as e:  # noqa: BLE001 — template errors are client errors
+            except Exception as e:  # noqa: BLE001  # xlint: allow-broad-except(template errors surface to the client as INVALID_ARGUMENT)
                 return Status(StatusCode.INVALID_ARGUMENT,
                               f"chat template error: {e}")
         if not request.token_ids and request.prompt:

@@ -45,6 +45,7 @@ import asyncio
 from ..common.faults import FAULTS
 from ..common.hashing import prefix_block_hash_hexes
 from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
+from ..devtools.locks import make_lock
 from ..coordination.base import CoordinationClient
 from ..rpc import instance_key
 from ..utils import get_logger, pick_free_port
@@ -89,7 +90,7 @@ class FakeEngine:
         self._started = threading.Event()
         self._stored_hashes: list[str] = []
         self._pending_kv_stored: list[str] = []
-        self._kv_lock = threading.Lock()
+        self._kv_lock = make_lock("fake_engine.kv_events", order=64)  # lock-order: 64
 
     # ------------------------------------------------------------ lifecycle
     def start(self, register: bool = True) -> "FakeEngine":
