@@ -2,7 +2,7 @@
 
 
 class _Reg:
-    def counter(self, name, help_=""):
+    def counter(self, name, help_="", labelnames=()):
         return object()
 
 
@@ -13,3 +13,5 @@ DEAD_TOTAL = REGISTRY.counter("dead_total")      # VIOLATION: never used
 IMPORT_ONLY_TOTAL = REGISTRY.counter("import_only_total")   # VIOLATION: imported, never referenced
 DUP_A = REGISTRY.counter("duplicated_name")
 DUP_B = REGISTRY.counter("duplicated_name")      # VIOLATION: duplicate name
+LABELED_TOTAL = REGISTRY.counter("labeled_total",
+                                 labelnames=("instance", "phase"))

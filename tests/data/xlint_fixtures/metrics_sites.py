@@ -1,6 +1,13 @@
 """Fixture: metrics-registry rule call sites. Never imported."""
 
-from .metrics import IMPORT_ONLY_TOTAL, NOT_DECLARED, REGISTRY, USED_TOTAL  # noqa: F401
+from . import metrics as m  # noqa: F401
+from .metrics import (  # noqa: F401
+    IMPORT_ONLY_TOTAL,
+    LABELED_TOTAL,
+    NOT_DECLARED,
+    REGISTRY,
+    USED_TOTAL,
+)
 # NOT_DECLARED import above is a VIOLATION (not declared in metrics.py).
 
 ROGUE_TOTAL = REGISTRY.counter("rogue_total")   # VIOLATION: ad-hoc creation
@@ -8,3 +15,8 @@ ROGUE_TOTAL = REGISTRY.counter("rogue_total")   # VIOLATION: ad-hoc creation
 
 def touch():
     USED_TOTAL.inc()
+    LABELED_TOTAL.labels(instance="a", phase="prefill").inc()   # clean
+    LABELED_TOTAL.labels(shard="x").inc()     # VIOLATION: wrong label names
+    LABELED_TOTAL.inc()                       # VIOLATION: write without .labels()
+    USED_TOTAL.labels(instance="a")           # VIOLATION: no labelnames declared
+    m.LABELED_TOTAL.inc()                     # VIOLATION: module-qualified write without .labels()

@@ -1,0 +1,12 @@
+"""Fixture: span-point rule call sites. Never imported."""
+
+from .tracing import TRACER
+
+
+def touch(dynamic_point):
+    TRACER.span("demo.span_used")
+    TRACER.start_span("demo.span_unregistered")   # VIOLATION: unregistered
+    TRACER.span(dynamic_point)                    # VIOLATION: non-literal
+    TRACER.start_span(dynamic_point)  # xlint: allow-span-point(helper forwards literal points)
+    not_a_tracer = object()
+    not_a_tracer.span("whatever")                 # not checked: not TRACER

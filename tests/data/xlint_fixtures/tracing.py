@@ -1,0 +1,16 @@
+"""Fixture span-point registry for the span-point rule. Never imported."""
+
+SPAN_POINTS = {
+    "demo.span_used": "referenced from span_sites.py",
+    "demo.span_dead": "VIOLATION: no call site",
+}
+
+
+class _Tracer:
+    def span(self, point, **kw):
+        return object()
+
+    start_span = span
+
+
+TRACER = _Tracer()

@@ -231,7 +231,7 @@ class TestAdminAndTracing:
                 json={"model": "fake-model", "prompt": "trace me",
                       "max_tokens": 16}, timeout=10)
             assert r.status_code == 200
-            trace = (tmp_path / "trace.json").read_text().splitlines()
+            trace = (tmp_path / "trace.jsonl").read_text().splitlines()
             assert len(trace) >= 2   # request record + output deltas
             first = json.loads(trace[0])
             assert first["service_request_id"].startswith("completion-")
@@ -240,7 +240,7 @@ class TestAdminAndTracing:
             # Span breakdown is emitted at request exit on the output
             # lane — it may land just after the HTTP response returns.
             def _spans():
-                lines = (tmp_path / "trace.json").read_text().splitlines()
+                lines = (tmp_path / "trace.jsonl").read_text().splitlines()
                 return [json.loads(ln)["data"] for ln in lines
                         if json.loads(ln)["data"].get("type") == "spans"]
 

@@ -1,0 +1,529 @@
+"""Tier-1 tests for the tracing plane (ISSUE 3): labeled Prometheus
+rendering, span-store semantics, trace-context propagation through the
+fake engine, and `/admin/trace` returning a complete two-incarnation span
+tree for a chaos-failover request."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.faults import FAULTS
+from xllm_service_tpu.common.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from xllm_service_tpu.common.tracing import (
+    TRACER,
+    SPAN_POINTS,
+    SpanStore,
+    TraceContext,
+    Tracer,
+    span_tree,
+)
+from xllm_service_tpu.common import tracing as tracing_mod
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.http_service.request_tracer import RequestTracer
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.rpc.channel import EngineChannel
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import wait_until
+
+SEED = int(os.environ.get("XLLM_CHAOS_SEED", "0"))
+REPLY = "Observability is the art of explaining exactly what happened."
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    FAULTS.configure((), seed=SEED)
+    TRACER.configure(enabled=True, mirror=None)
+    TRACER.store.clear()
+    yield
+    FAULTS.clear()
+    TRACER.configure(enabled=True, mirror=None)
+
+
+# ------------------------------------------------------------ labeled metrics
+class TestLabeledMetrics:
+    def test_labeled_counter_rendering_and_escaping(self):
+        reg = MetricsRegistry()
+        c = reg.counter("req_total", "total", labelnames=("instance", "kind"))
+        c.labels(instance="10.0.0.1:80", kind="chat").inc(2)
+        c.labels(instance='we"ird\\host\n', kind="completion").inc()
+        text = reg.render_prometheus()
+        assert "# TYPE req_total counter" in text
+        # Declared label order, not alphabetical or call order.
+        assert 'req_total{instance="10.0.0.1:80",kind="chat"} 2.0' in text
+        # Escaping: backslash, quote, newline.
+        assert 'instance="we\\"ird\\\\host\\n"' in text
+        assert c.value() == 3.0
+
+    def test_labeled_histogram_bucket_cumulativity(self):
+        h = Histogram("lat_ms", buckets=(10, 100), labelnames=("instance",))
+        child = h.labels(instance="a")
+        for v in (5, 50, 500):
+            child.observe(v)
+        text = h.render()
+        assert 'lat_ms_bucket{le="10",instance="a"} 1' in text
+        assert 'lat_ms_bucket{le="100",instance="a"} 2' in text
+        assert 'lat_ms_bucket{le="+Inf",instance="a"} 3' in text
+        assert 'lat_ms_sum{instance="a"} 555.0' in text
+        assert 'lat_ms_count{instance="a"} 3' in text
+        assert h.count() == 3 and h.mean() == 185.0
+
+    def test_label_validation(self):
+        c = Counter("c_total", labelnames=("instance",))
+        with pytest.raises(ValueError):
+            c.labels(wrong="x")
+        with pytest.raises(ValueError):
+            c.labels(instance="a", extra="b")
+        with pytest.raises(ValueError):
+            c.inc()          # labeled family: writes go through .labels()
+        plain = Counter("p_total")
+        with pytest.raises(ValueError):
+            plain.labels(instance="a")
+        g = Gauge("g", labelnames=("instance",))
+        with pytest.raises(ValueError):
+            g.set(1)
+
+    def test_same_labels_same_child_and_remove(self):
+        g = Gauge("inflight", labelnames=("instance", "phase"))
+        a = g.labels(instance="i1", phase="prefill")
+        assert g.labels(phase="prefill", instance="i1") is a
+        a.set(7)
+        assert g.value() == 7.0
+        g.remove(instance="i1", phase="prefill")
+        assert g.value() == 0.0 and g.render() == ""
+
+    def test_registry_label_mismatch_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", labelnames=("a",))
+        with pytest.raises(TypeError):
+            reg.counter("x_total", labelnames=("b",))
+
+    def test_service_metrics_render_labeled_series(self, store):
+        """/metrics carries labeled TTFT/ITL + per-instance gauges in
+        valid Prometheus text after traffic flows."""
+        master = _master(store)
+        engine = _engine(store)
+        try:
+            _await_fleet(master, [engine])
+            assert _stream(master)[0] == REPLY
+            text = requests.get(_base(master) + "/metrics", timeout=5).text
+            assert ("time_to_first_token_latency_milliseconds_bucket"
+                    '{le="1",instance="' + engine.name + '",policy="RR"}'
+                    in text)
+            assert ("time_to_first_token_latency_milliseconds_count"
+                    '{instance="' + engine.name + '",policy="RR"}' in text)
+            assert ('server_request_in_total{kind="completion"}' in text)
+            assert ('instance_inflight_requests{instance="' + engine.name
+                    + '",phase="decode"} 0.0' in text)
+
+            def queue_gauge_present():
+                t = requests.get(_base(master) + "/metrics", timeout=5).text
+                return ('instance_queue_depth{instance="' + engine.name
+                        + '"}' in t)
+
+            assert wait_until(queue_gauge_present, timeout=5)
+        finally:
+            engine.stop()
+            master.stop()
+
+
+# ------------------------------------------------------------ span primitives
+class TestSpanStore:
+    def test_parenting_and_tree_assembly(self):
+        tr = Tracer(capacity=64)
+        root = tr.start_span("frontend.request", request_id="r1")
+        with tr.span("scheduler.schedule", ctx=root.context(),
+                     request_id="r1"):
+            pass
+        child2 = tr.start_span("engine.prefill", ctx=root.context(),
+                               request_id="r1")
+        child2.end()
+        root.end()
+        spans = tr.store.trace(root.trace_id)
+        assert len(spans) == 3
+        tree = span_tree(spans)
+        assert len(tree) == 1 and tree[0]["point"] == "frontend.request"
+        kids = [c["point"] for c in tree[0]["children"]]
+        assert kids == ["scheduler.schedule", "engine.prefill"]
+
+    def test_ring_eviction_is_bounded(self):
+        store = SpanStore(capacity=4)
+        tr = Tracer(capacity=4)
+        tr.store = store
+        ids = []
+        for i in range(6):
+            sp = tr.start_span("frontend.request", request_id=f"r{i}")
+            sp.end()
+            ids.append(sp.trace_id)
+        assert sum(len(store.trace(t)) for t in ids) == 4
+        assert not store.trace(ids[0])       # oldest evicted
+        assert store.trace(ids[-1])
+
+    def test_request_id_lookup_and_recent(self):
+        tr = Tracer(capacity=16)
+        slow = tr.start_span("frontend.request", request_id="slow")
+        time.sleep(0.03)
+        slow.end()
+        fast = tr.start_span("frontend.request", request_id="fast")
+        fast.end()
+        assert tr.store.trace_id_for_request("slow") == slow.trace_id
+        recent = tr.query_recent(limit=5)["traces"]
+        assert recent[0]["request_id"] == "fast"
+        slowest = tr.query_recent(limit=5, sort="slowest")["traces"]
+        assert slowest[0]["request_id"] == "slow"
+
+    def test_disabled_tracer_is_noop(self):
+        tr = Tracer(capacity=8)
+        tr.configure(enabled=False)
+        sp = tr.start_span("frontend.request", request_id="x")
+        assert not sp and sp.context() is None
+        with tr.span("scheduler.schedule") as inner:
+            assert tracing_mod.current_span() is None
+            inner.event("ignored")
+        sp.end()
+        assert tr.query_recent()["traces"] == []
+
+    def test_fault_event_stamps_active_span(self):
+        FAULTS.configure([dict(point="rpc.post", action="delay",
+                               delay_s=0.0)], seed=SEED)
+        with TRACER.span("scheduler.schedule", request_id="rf") as sp:
+            FAULTS.check("rpc.post", instance="i1")
+        assert [e for e in sp.events if e["name"] == "fault"
+                and e["point"] == "rpc.post" and e["action"] == "delay"]
+
+    def test_context_wire_roundtrip(self):
+        ctx = TraceContext(trace_id="t" * 32, span_id="s" * 16)
+        assert TraceContext.from_dict(ctx.to_dict()) == ctx
+        assert TraceContext.from_headers(ctx.to_headers()) == ctx
+        assert TraceContext.from_dict(None) is None
+        assert TraceContext.from_dict({"trace_id": ""}) is None
+
+    def test_span_points_registry_documented(self):
+        assert SPAN_POINTS        # non-empty, every value a description
+        assert all(isinstance(v, str) and v for v in SPAN_POINTS.values())
+
+
+# ------------------------------------------------------- request tracer file
+class TestRequestTracerFile:
+    def test_persistent_handle_writes_jsonl(self, tmp_path):
+        tracer = RequestTracer(str(tmp_path), enabled=True)
+        for i in range(3):
+            tracer.log(f"sid-{i}", {"i": i})
+        path = tmp_path / "trace.jsonl"
+        lines = path.read_text().splitlines()
+        assert len(lines) == 3
+        assert json.loads(lines[2])["data"] == {"i": 2}
+        tracer.close()
+        tracer.log("sid-after-close", {"late": True})   # lazily reopens
+        tracer.close()
+        assert len(path.read_text().splitlines()) == 4
+
+    def test_legacy_trace_json_dir_keeps_appending(self, tmp_path):
+        (tmp_path / "trace.json").write_text('{"old": 1}\n')
+        tracer = RequestTracer(str(tmp_path), enabled=True)
+        tracer.log("sid", {"new": 2})
+        tracer.close()
+        lines = (tmp_path / "trace.json").read_text().splitlines()
+        assert len(lines) == 2
+        assert not (tmp_path / "trace.jsonl").exists()
+
+    def test_disabled_writes_nothing(self, tmp_path):
+        tracer = RequestTracer(str(tmp_path / "sub"), enabled=False)
+        tracer.log("sid", {"x": 1})
+        tracer.close()
+        assert not (tmp_path / "sub").exists()
+
+
+# --------------------------------------------------------------- e2e helpers
+def _opts(**kw) -> ServiceOptions:
+    base = dict(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        lease_ttl_s=0.5, reconcile_interval_s=0.05,
+        heartbeat_silence_to_suspect_s=0.3,
+        detect_disconnected_instance_interval_s=0.3,
+        health_probe_attempts=1, health_probe_timeout_s=0.2,
+        sync_interval_s=0.2,
+        failover_backoff_base_s=0.05, failover_backoff_max_s=0.3,
+        rpc_backoff_base_s=0.02, rpc_backoff_max_s=0.1)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def _master(store, **kw) -> Master:
+    master = Master(_opts(**kw), coord=InMemoryCoordination(store))
+    master.start()
+    return master
+
+
+def _engine(store, **cfg_kw) -> FakeEngine:
+    cfg = FakeEngineConfig(reply_text=REPLY, chunk_size=4, delay_s=0.05,
+                           heartbeat_interval_s=0.1, lease_ttl_s=0.5,
+                           **cfg_kw)
+    return FakeEngine(InMemoryCoordination(store), cfg).start()
+
+
+def _await_fleet(master, engines) -> None:
+    assert wait_until(
+        lambda: all(master.scheduler.instance_mgr.get_instance_meta(e.name)
+                    is not None for e in engines), timeout=5)
+
+
+def _base(master) -> str:
+    return f"http://127.0.0.1:{master.http_port}"
+
+
+def _stream(master, timeout=60):
+    r = requests.post(_base(master) + "/v1/completions", json={
+        "model": "fake-model", "prompt": "trace", "stream": True,
+        "max_tokens": 1000}, stream=True, timeout=timeout)
+    assert r.status_code == 200, r.text
+    text, sid = "", ""
+    for line in r.iter_lines():
+        if not line.startswith(b"data: "):
+            continue
+        data = line[len(b"data: "):]
+        if data == b"[DONE]":
+            break
+        obj = json.loads(data)
+        if "error" in obj:
+            raise RuntimeError(f"stream error: {obj['error']}")
+        for c in obj.get("choices", ()):
+            text += c.get("text", "")
+    return text, sid
+
+
+def _get_trace(master, **params):
+    return requests.get(_base(master) + "/admin/trace", params=params,
+                        timeout=5)
+
+
+# ------------------------------------------------------------- e2e propagation
+class TestTracePropagation:
+    def test_single_request_full_span_tree(self, store):
+        master = _master(store)
+        engine = _engine(store)
+        try:
+            _await_fleet(master, [engine])
+            text, _ = _stream(master)
+            assert text == REPLY
+            recent = requests.get(
+                _base(master) + "/admin/trace/recent", timeout=5).json()
+            assert recent["traces"], "no traces recorded"
+            entry = recent["traces"][0]
+            sid = entry["request_id"]
+            assert sid.startswith("completion-")
+
+            # Root span lands at request exit on the output lane.
+            def complete():
+                got = _get_trace(master, request_id=sid).json()
+                pts = {s["point"] for s in got.get("spans", ())}
+                return "frontend.request" in pts and got
+            assert wait_until(lambda: bool(complete()), timeout=5)
+            got = _get_trace(master, request_id=sid).json()
+            points = {s["point"] for s in got["spans"]}
+            assert {"frontend.request", "scheduler.schedule",
+                    "engine.prefill", "kv_transfer.offer",
+                    "engine.decode"} <= points
+            assert len({s["trace_id"] for s in got["spans"]}) == 1
+            # Parenting: one root; every engine span carries the instance.
+            tree = got["tree"]
+            assert len(tree) == 1
+            assert tree[0]["point"] == "frontend.request"
+            kids = {c["point"] for c in tree[0]["children"]}
+            assert "scheduler.schedule" in kids
+            for s in got["spans"]:
+                if s["point"].startswith("engine."):
+                    assert s["instance"] == engine.name
+                    assert s["attrs"] or s["point"] == "engine.decode"
+            # Query by trace_id is equivalent.
+            by_tid = _get_trace(master, trace_id=got["trace_id"]).json()
+            assert by_tid["num_spans"] == got["num_spans"]
+        finally:
+            engine.stop()
+            master.stop()
+
+    def test_unknown_request_404(self, store):
+        master = _master(store)
+        try:
+            assert _get_trace(master, request_id="nope").status_code == 404
+            assert _get_trace(master).status_code == 404
+        finally:
+            master.stop()
+
+    def test_channel_stamps_trace_headers(self, store):
+        engine = _engine(store)
+        try:
+            ch = EngineChannel(engine.name)
+            with TRACER.span("scheduler.failover", request_id="sid-h") as sp:
+                ok, _ = ch.forward("/v1/completions", {
+                    "service_request_id": "sid-h",
+                    "source_service_addr": "127.0.0.1:1",
+                    "token_ids": [1], "max_tokens": 1})
+                expect = sp.context().to_headers()
+            assert ok
+            assert wait_until(lambda: engine.accepted_trace_headers,
+                              timeout=5)
+            seen = engine.accepted_trace_headers[0]
+            assert seen == expect
+            ch.close()
+        finally:
+            engine.stop()
+
+    def test_tracing_disabled_no_spans_no_errors(self, store):
+        master = _master(store, enable_tracing=False)
+        engine = _engine(store)
+        try:
+            _await_fleet(master, [engine])
+            text, _ = _stream(master)
+            assert text == REPLY
+            recent = requests.get(
+                _base(master) + "/admin/trace/recent", timeout=5).json()
+            assert recent["traces"] == []
+        finally:
+            engine.stop()
+            master.stop()
+            TRACER.configure(enabled=True)
+
+    def test_live_tracing_toggle_via_admin_config(self, store):
+        master = _master(store)
+        try:
+            r = requests.post(_base(master) + "/admin/config",
+                              json={"enable_tracing": False}, timeout=5)
+            assert r.status_code == 200
+            assert TRACER.enabled is False
+            r = requests.post(_base(master) + "/admin/config",
+                              json={"enable_tracing": True}, timeout=5)
+            assert r.status_code == 200
+            assert TRACER.enabled is True
+        finally:
+            master.stop()
+
+    def test_spans_mirrored_to_request_trace_jsonl(self, store, tmp_path):
+        master = _master(store, enable_request_trace=True,
+                         trace_dir=str(tmp_path))
+        engine = _engine(store)
+        try:
+            _await_fleet(master, [engine])
+            text, _ = _stream(master)
+            assert text == REPLY
+
+            def span_records():
+                p = tmp_path / "trace.jsonl"
+                if not p.exists():
+                    return []
+                return [json.loads(ln) for ln in
+                        p.read_text().splitlines()
+                        if json.loads(ln)["data"].get("type") == "span"]
+            assert wait_until(lambda: any(
+                r["data"]["span"]["point"] == "frontend.request"
+                for r in span_records()), timeout=5)
+        finally:
+            engine.stop()
+            master.stop()
+
+
+# --------------------------------------------------------- chaos-failover e2e
+class TestChaosFailoverTrace:
+    pytestmark = pytest.mark.chaos
+
+    def test_two_incarnation_trace_assembled(self, store):
+        """Acceptance drill: a request that survives a mid-stream instance
+        kill yields ONE trace containing frontend, scheduler-dispatch,
+        prefill, decode, KV-transfer and failover-retry spans across both
+        incarnations, ordered and parented correctly."""
+        master = _master(store)
+        engines = [_engine(store), _engine(store)]
+        try:
+            _await_fleet(master, engines)
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=4, max_fires=1)], seed=SEED)
+            text, _ = _stream(master)
+            assert text == REPLY            # failover happened, stream intact
+
+            recent = requests.get(
+                _base(master) + "/admin/trace/recent?sort=slowest",
+                timeout=5).json()["traces"]
+            sid = next(r["request_id"] for r in recent
+                       if r["request_id"].startswith("completion-"))
+
+            def full():
+                got = _get_trace(master, request_id=sid).json()
+                return {s["point"] for s in got.get("spans", ())} >= {
+                    "frontend.request", "scheduler.failover"} and got
+            assert wait_until(lambda: bool(full()), timeout=5)
+            got = _get_trace(master, request_id=sid).json()
+            spans = got["spans"]
+            assert len({s["trace_id"] for s in spans}) == 1
+            points = {s["point"] for s in spans}
+            assert {"frontend.request", "scheduler.schedule",
+                    "engine.prefill", "engine.decode", "kv_transfer.offer",
+                    "scheduler.failover"} <= points
+
+            # Both incarnations are present, correlated by one trace_id.
+            incs = {s["attrs"].get("incarnation") or s["instance"]
+                    for s in spans if s["point"] == "engine.prefill"}
+            prefills = [s for s in spans if s["point"] == "engine.prefill"]
+            assert len(prefills) == 2
+            assert len({s["instance"] for s in prefills}) == 2
+            decodes = [s for s in spans if s["point"] == "engine.decode"]
+            assert sorted(d["status"] for d in decodes) == ["CRASHED", "OK"]
+            del incs
+
+            # Parenting: incarnation-2 engine spans hang under the
+            # failover span; incarnation-1's under the root.
+            fo = next(s for s in spans if s["point"] == "scheduler.failover")
+            assert fo["attrs"]["ok"] is True
+            retried = [s for s in spans
+                       if s["parent_span_id"] == fo["span_id"]]
+            assert {s["point"] for s in retried} >= {"engine.prefill",
+                                                     "engine.decode"}
+            root = next(s for s in spans if s["point"] == "frontend.request")
+            assert fo["parent_span_id"] == root["span_id"]
+            crashed = next(d for d in decodes if d["status"] == "CRASHED")
+            assert root["attrs"]["failover_attempts"] == 1
+            # The fault plane stamped the injection onto the dying span.
+            assert [e for e in crashed["events"] if e["name"] == "fault"
+                    and e["action"] == "crash"]
+            # Ordering: children sorted by start time everywhere.
+            def assert_ordered(node):
+                starts = [c["start_ms"] for c in node["children"]]
+                assert starts == sorted(starts)
+                for c in node["children"]:
+                    assert_ordered(c)
+            for r in got["tree"]:
+                assert_ordered(r)
+        finally:
+            for e in engines:
+                e.stop()
+            master.stop()
+
+    def test_failover_metrics_labeled_by_instance(self, store):
+        master = _master(store)
+        engines = [_engine(store), _engine(store)]
+        try:
+            _await_fleet(master, engines)
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=4, max_fires=1)], seed=SEED)
+            text, _ = _stream(master)
+            assert text == REPLY
+            dead = next(e for e in engines if not e._alive)
+            survivor = next(e for e in engines if e._alive)
+            text = requests.get(_base(master) + "/metrics", timeout=5).text
+            assert ('failover_attempts_total{instance="' + dead.name + '"}'
+                    in text)
+            assert ('failover_success_total{instance="' + survivor.name
+                    + '"}' in text)
+        finally:
+            for e in engines:
+                e.stop()
+            master.stop()

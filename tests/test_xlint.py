@@ -121,6 +121,31 @@ class TestFaultPoints:
         assert not hits(fixture_violations, "fault-point", "", "demo.used")
 
 
+# ----------------------------------------------------------------- span-point
+class TestSpanPoints:
+    def test_unregistered_point_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "span-point", "span_sites.py",
+                    "demo.span_unregistered")
+
+    def test_non_literal_point_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "span-point", "span_sites.py",
+                    "string literal")
+
+    def test_dead_point_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "span-point", "tracing.py",
+                    "demo.span_dead")
+
+    def test_registered_used_point_not_flagged(self, fixture_violations):
+        assert not hits(fixture_violations, "span-point", "",
+                        "demo.span_used")
+
+    def test_hatched_forwarder_not_flagged(self, fixture_violations):
+        # Exactly two span-site violations: the hatched forwarder and the
+        # non-TRACER receiver stay quiet.
+        assert len(hits(fixture_violations, "span-point",
+                        "span_sites.py")) == 2
+
+
 # ------------------------------------------------------------- metrics-registry
 class TestMetricsRegistry:
     def test_ad_hoc_instrument_flagged(self, fixture_violations):
@@ -146,8 +171,36 @@ class TestMetricsRegistry:
                     "duplicated_name")
 
     def test_used_instrument_not_flagged(self, fixture_violations):
-        assert not hits(fixture_violations, "metrics-registry", "",
-                        "USED_TOTAL")
+        assert not [v for v in hits(fixture_violations, "metrics-registry",
+                                    "", "dead metric")
+                    if "USED_TOTAL" in v.message
+                    or "LABELED_TOTAL" in v.message]
+
+    def test_wrong_label_names_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "metrics-registry",
+                    "metrics_sites.py", "declares labelnames")
+
+    def test_write_without_labels_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "metrics-registry",
+                    "metrics_sites.py", "write through .labels")
+
+    def test_labels_on_unlabeled_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "metrics-registry",
+                    "metrics_sites.py", "declares no labelnames")
+
+    def test_module_qualified_write_flagged(self, fixture_violations):
+        # m.LABELED_TOTAL.inc(): the attribute-receiver form is checked
+        # just like the bare-name form.
+        assert [v for v in hits(fixture_violations, "metrics-registry",
+                                "metrics_sites.py", "write through")
+                ] and len(hits(fixture_violations, "metrics-registry",
+                               "metrics_sites.py", "write through")) == 2
+
+    def test_correct_labeled_write_not_flagged(self, fixture_violations):
+        # The clean .labels(instance=..., phase=...).inc() site: exactly
+        # the six deliberate metrics_sites violations fire.
+        assert len(hits(fixture_violations, "metrics-registry",
+                        "metrics_sites.py")) == 6
 
 
 # ---------------------------------------------------------------- broad-except

@@ -74,6 +74,12 @@ class ServiceOptions:
     # --- tracing / debug ---
     enable_request_trace: bool = False
     trace_dir: str = "trace"
+    # Hop-propagated span tracing (common/tracing.py): in-memory ring of
+    # spans behind /admin/trace. Off = every span call is a no-op attribute
+    # check (<2%, benchmarks/bench_tracing_overhead.py). Spans are also
+    # mirrored to the RequestTracer JSONL when enable_request_trace is on.
+    enable_tracing: bool = True
+    trace_span_capacity: int = 2048
     debug_log: bool = field(
         default_factory=lambda: os.environ.get("ENABLE_XLLM_DEBUG_LOG", "") not in ("", "0", "false"))
     # --- request registry ---

@@ -172,6 +172,7 @@ class FaultPlane:
             hook(point)
         if not self._rules:   # fast path: the plane is almost always empty
             return None
+        fired: Optional[FaultRule] = None
         with self._lock:
             for rule in self._rules:
                 if rule.point != point and \
@@ -190,10 +191,20 @@ class FaultPlane:
                         self._rng.random() >= rule.probability:
                     continue
                 rule.fires += 1
-                logger.info("fault fired at %s: %s (ctx=%s)",
-                            point, rule.action, ctx)
-                return rule
-        return None
+                fired = rule
+                break
+        if fired is not None:
+            logger.info("fault fired at %s: %s (ctx=%s)",
+                        point, fired.action, ctx)
+            # Self-explaining chaos drills: the injection lands as an event
+            # on whatever span the calling thread is inside. Lazy import:
+            # under XLLM_LOCK_DEBUG the lock factory imports this module at
+            # its own import time, so a top-level tracing import would cycle
+            # (faults -> tracing -> devtools.locks -> faults).
+            from . import tracing
+
+            tracing.add_event("fault", point=point, action=fired.action)
+        return fired
 
     def check(self, point: str, **ctx: Any) -> None:
         """Convenience for I/O call sites: sleep on `delay`, raise

@@ -7,55 +7,164 @@ defined instruments (`metrics.h:108-111`): `server_request_in_total`,
 (`http_service/service.cpp:526-532`); we implement it properly
 (SURVEY.md §5.5 "New framework: same shape, Prometheus-format /metrics done
 properly").
+
+Labels: instruments may declare `labelnames`; call sites then obtain a
+child series via `.labels(instance=..., policy=...)` (all declared labels,
+keyword-only) and the family renders every child with escaped, declared-
+order label pairs (`_bucket` lines put `le` first). Reads (`value()`,
+`render()`) take the same lock the writers take — a torn read of a float
+is impossible in CPython, but consistent multi-field reads (histogram
+bucket/sum/count, family child sets) are not, so everything reads locked.
 """
 
 from __future__ import annotations
 
 import threading
 from bisect import bisect_right
-from typing import Iterable
+from typing import Any, Iterable, Optional
+
+
+def _escape_label_value(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _render_labels(pairs: Iterable[tuple[str, str]]) -> str:
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
+    return "{" + inner + "}" if inner else ""
 
 
 class _Metric:
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str, labelnames: Iterable[str] = ()):
         self.name = name
         self.help = help_
+        self.labelnames = tuple(labelnames)
+        # Set on children created by labels(); () on families/plain series.
+        self._labelvalues: tuple[str, ...] = ()
+
+    def _label_suffix(self,
+                      extra: Optional[tuple[str, str]] = None) -> str:
+        pairs: list[tuple[str, str]] = [extra] if extra else []
+        pairs += list(zip(self.labelnames, self._labelvalues))
+        return _render_labels(pairs)
+
+    def _child_key(self, kw: dict[str, Any]) -> tuple[str, ...]:
+        if not self.labelnames:
+            raise ValueError(
+                f"metric {self.name} declares no labels; call inc()/set()/"
+                f"observe() directly")
+        if set(kw) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} requires exactly labels "
+                f"{self.labelnames}, got {tuple(sorted(kw))}")
+        return tuple(str(kw[k]) for k in self.labelnames)
 
 
 class Counter(_Metric):
     kind = "counter"
 
-    def __init__(self, name: str, help_: str = ""):
-        super().__init__(name, help_)
+    def __init__(self, name: str, help_: str = "",
+                 labelnames: Iterable[str] = ()):
+        super().__init__(name, help_, labelnames)
         self._v = 0.0
         self._lock = threading.Lock()   # lock-order: 810
+        self._children: dict[tuple[str, ...], Counter] = {}
+
+    def labels(self, **kw: Any) -> "Counter":
+        key = self._child_key(kw)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Counter(self.name, self.help)
+                child.labelnames = self.labelnames
+                child._labelvalues = key
+                self._children[key] = child
+            return child
+
+    def remove(self, **kw: Any) -> None:
+        """Drop one child series (e.g. an evicted instance) so /metrics
+        stops exporting a stale label set."""
+        key = self._child_key(kw)
+        with self._lock:
+            self._children.pop(key, None)
 
     def inc(self, v: float = 1.0) -> None:
+        if self.labelnames and not self._labelvalues:
+            raise ValueError(f"metric {self.name} is labeled; use "
+                             f".labels(...).inc()")
         with self._lock:
             self._v += v
 
     def value(self) -> float:
-        return self._v
+        """Plain series: its value. Labeled family: the sum over children
+        (the series-agnostic total callers assert on)."""
+        with self._lock:
+            v = self._v
+            children = list(self._children.values())
+        return v + sum(c.value() for c in children)
 
     def render(self) -> str:
-        return f"{self.name} {self._v}\n"
+        with self._lock:
+            v = self._v
+            children = sorted(self._children.items())
+        if self.labelnames and not self._labelvalues:
+            return "".join(c.render() for _, c in children)
+        return f"{self.name}{self._label_suffix()} {v}\n"
 
 
 class Gauge(_Metric):
     kind = "gauge"
 
-    def __init__(self, name: str, help_: str = ""):
-        super().__init__(name, help_)
+    def __init__(self, name: str, help_: str = "",
+                 labelnames: Iterable[str] = ()):
+        super().__init__(name, help_, labelnames)
         self._v = 0.0
+        self._lock = threading.Lock()   # lock-order: 811
+        self._children: dict[tuple[str, ...], Gauge] = {}
+
+    def labels(self, **kw: Any) -> "Gauge":
+        key = self._child_key(kw)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Gauge(self.name, self.help)
+                child.labelnames = self.labelnames
+                child._labelvalues = key
+                self._children[key] = child
+            return child
+
+    def remove(self, **kw: Any) -> None:
+        key = self._child_key(kw)
+        with self._lock:
+            self._children.pop(key, None)
 
     def set(self, v: float) -> None:
-        self._v = float(v)
+        if self.labelnames and not self._labelvalues:
+            raise ValueError(f"metric {self.name} is labeled; use "
+                             f".labels(...).set()")
+        with self._lock:
+            self._v = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        if self.labelnames and not self._labelvalues:
+            raise ValueError(f"metric {self.name} is labeled; use "
+                             f".labels(...).inc()")
+        with self._lock:
+            self._v += v
 
     def value(self) -> float:
-        return self._v
+        with self._lock:
+            v = self._v
+            children = list(self._children.values())
+        return v + sum(c.value() for c in children)
 
     def render(self) -> str:
-        return f"{self.name} {self._v}\n"
+        with self._lock:
+            v = self._v
+            children = sorted(self._children.items())
+        if self.labelnames and not self._labelvalues:
+            return "".join(c.render() for _, c in children)
+        return f"{self.name}{self._label_suffix()} {v}\n"
 
 
 _DEFAULT_BUCKETS = (1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000, 30000)
@@ -64,37 +173,76 @@ _DEFAULT_BUCKETS = (1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000,
 class Histogram(_Metric):
     kind = "histogram"
 
-    def __init__(self, name: str, help_: str = "", buckets: Iterable[float] = _DEFAULT_BUCKETS):
-        super().__init__(name, help_)
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Iterable[float] = _DEFAULT_BUCKETS,
+                 labelnames: Iterable[str] = ()):
+        super().__init__(name, help_, labelnames)
         self.buckets = sorted(buckets)
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._n = 0
         self._lock = threading.Lock()   # lock-order: 812
+        self._children: dict[tuple[str, ...], Histogram] = {}
+
+    def labels(self, **kw: Any) -> "Histogram":
+        key = self._child_key(kw)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Histogram(self.name, self.help, self.buckets)
+                child.labelnames = self.labelnames
+                child._labelvalues = key
+                self._children[key] = child
+            return child
+
+    def remove(self, **kw: Any) -> None:
+        key = self._child_key(kw)
+        with self._lock:
+            self._children.pop(key, None)
 
     def observe(self, v: float) -> None:
+        if self.labelnames and not self._labelvalues:
+            raise ValueError(f"metric {self.name} is labeled; use "
+                             f".labels(...).observe()")
         with self._lock:
             self._counts[bisect_right(self.buckets, v)] += 1
             self._sum += v
             self._n += 1
 
     def count(self) -> int:
-        return self._n
+        with self._lock:
+            n = self._n
+            children = list(self._children.values())
+        return n + sum(c.count() for c in children)
 
     def mean(self) -> float:
-        return self._sum / self._n if self._n else 0.0
+        with self._lock:
+            s, n = self._sum, self._n
+            children = list(self._children.values())
+        for c in children:
+            with c._lock:
+                s += c._sum
+                n += c._n
+        return s / n if n else 0.0
 
     def render(self) -> str:
+        with self._lock:
+            counts = list(self._counts)
+            total_sum, total_n = self._sum, self._n
+            children = sorted(self._children.items())
+        if self.labelnames and not self._labelvalues:
+            return "".join(c.render() for _, c in children)
         out = []
         cum = 0
-        with self._lock:
-            for b, c in zip(self.buckets, self._counts):
-                cum += c
-                out.append(f'{self.name}_bucket{{le="{b}"}} {cum}\n')
-            cum += self._counts[-1]
-            out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}\n')
-            out.append(f"{self.name}_sum {self._sum}\n")
-            out.append(f"{self.name}_count {self._n}\n")
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out.append(f"{self.name}_bucket"
+                       f"{self._label_suffix(('le', str(b)))} {cum}\n")
+        cum += counts[-1]
+        out.append(f"{self.name}_bucket"
+                   f"{self._label_suffix(('le', '+Inf'))} {cum}\n")
+        out.append(f"{self.name}_sum{self._label_suffix()} {total_sum}\n")
+        out.append(f"{self.name}_count{self._label_suffix()} {total_n}\n")
         return "".join(out)
 
 
@@ -103,16 +251,24 @@ class MetricsRegistry:
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()   # lock-order: 814
 
-    def counter(self, name: str, help_: str = "") -> Counter:
-        return self._get_or_create(name, lambda: Counter(name, help_), Counter)
+    def counter(self, name: str, help_: str = "",
+                labelnames: Iterable[str] = ()) -> Counter:
+        return self._get_or_create(
+            name, lambda: Counter(name, help_, labelnames), Counter,
+            labelnames)
 
-    def gauge(self, name: str, help_: str = "") -> Gauge:
-        return self._get_or_create(name, lambda: Gauge(name, help_), Gauge)
+    def gauge(self, name: str, help_: str = "",
+              labelnames: Iterable[str] = ()) -> Gauge:
+        return self._get_or_create(
+            name, lambda: Gauge(name, help_, labelnames), Gauge, labelnames)
 
-    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
-        return self._get_or_create(name, lambda: Histogram(name, help_, buckets), Histogram)
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS,
+                  labelnames: Iterable[str] = ()) -> Histogram:
+        return self._get_or_create(
+            name, lambda: Histogram(name, help_, buckets, labelnames),
+            Histogram, labelnames)
 
-    def _get_or_create(self, name, factory, cls):
+    def _get_or_create(self, name, factory, cls, labelnames=()):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
@@ -120,6 +276,10 @@ class MetricsRegistry:
                 self._metrics[name] = m
             elif not isinstance(m, cls):
                 raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+            elif m.labelnames != tuple(labelnames):
+                raise TypeError(
+                    f"metric {name} already registered with labels "
+                    f"{m.labelnames}, not {tuple(labelnames)}")
             return m
 
     def render_prometheus(self) -> str:
@@ -135,27 +295,49 @@ class MetricsRegistry:
 
 
 # Global registry + the reference's instruments (`metrics.h:108-111`).
+# TTFT/ITL carry {instance, policy} so tail latency can be attributed to a
+# routing decision; the frontend counter carries the API kind.
 REGISTRY = MetricsRegistry()
 SERVER_REQUEST_IN_TOTAL = REGISTRY.counter(
-    "server_request_in_total", "Total requests accepted by the HTTP frontend")
+    "server_request_in_total", "Total requests accepted by the HTTP frontend",
+    labelnames=("kind",))
 TTFT_MS = REGISTRY.histogram(
-    "time_to_first_token_latency_milliseconds", "TTFT per request (ms)")
+    "time_to_first_token_latency_milliseconds", "TTFT per request (ms)",
+    labelnames=("instance", "policy"))
 ITL_MS = REGISTRY.histogram(
-    "inter_token_latency_milliseconds", "Inter-token latency (ms)")
+    "inter_token_latency_milliseconds", "Inter-token latency (ms)",
+    labelnames=("instance", "policy"))
+
+# Per-instance live-load gauges (service-side view of the fleet; phase is
+# prefill|decode) + engine-reported queue depth from heartbeats.
+INSTANCE_INFLIGHT_REQUESTS = REGISTRY.gauge(
+    "instance_inflight_requests",
+    "In-flight requests the scheduler has accounted to an instance",
+    labelnames=("instance", "phase"))
+INSTANCE_QUEUE_DEPTH = REGISTRY.gauge(
+    "instance_queue_depth",
+    "Engine-reported waiting queue depth (from heartbeats)",
+    labelnames=("instance",))
 
 # Failure-handling observability (beyond the reference, which exposes no
 # failure-path instruments at all): transparent-failover outcomes, channel
 # retry pressure, and fleet eviction churn.
 FAILOVER_ATTEMPTS_TOTAL = REGISTRY.counter(
     "failover_attempts_total",
-    "Re-dispatch attempts for requests on failed instances")
+    "Re-dispatch attempts for requests on failed instances "
+    "(instance = the failed one)",
+    labelnames=("instance",))
 FAILOVER_SUCCESS_TOTAL = REGISTRY.counter(
     "failover_success_total",
-    "Requests successfully re-dispatched after an instance failure")
+    "Requests successfully re-dispatched after an instance failure "
+    "(instance = the surviving target)",
+    labelnames=("instance",))
 RPC_RETRIES_TOTAL = REGISTRY.counter(
-    "rpc_retries_total", "Engine-channel RPC attempts beyond the first")
+    "rpc_retries_total", "Engine-channel RPC attempts beyond the first",
+    labelnames=("instance",))
 INSTANCE_EVICTIONS_TOTAL = REGISTRY.counter(
-    "instance_evictions_total", "Instances removed from the fleet")
+    "instance_evictions_total", "Instances removed from the fleet",
+    labelnames=("instance",))
 REQUESTS_CANCELLED_ON_FAILURE_TOTAL = REGISTRY.counter(
     "requests_cancelled_on_failure_total",
     "Requests surfaced as errors after instance failure "

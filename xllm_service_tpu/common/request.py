@@ -263,6 +263,12 @@ class Request:
     # Callbacks (installed by the HTTP layer / tests).
     output_callback: Optional[OutputCallback] = None
     trace_callback: Optional[Callable[[str, Any], None]] = None
+    # Tracing (common/tracing.py): the root span owned by this request
+    # (ended at exit accounting) and its portable context, carried in the
+    # enriched engine payload / RPC headers so downstream hops parent
+    # their spans correctly. `Any` to keep this module import-light.
+    span: Optional[Any] = None
+    trace: Optional[Any] = None
 
     def touch(self) -> None:
         self.latest_generate_time_ms = now_ms()

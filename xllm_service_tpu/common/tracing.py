@@ -1,0 +1,464 @@
+"""End-to-end request tracing: hop-propagated spans with a live query surface.
+
+The reference ships no cross-hop correlation at all — its request tracer is
+a raw I/O JSONL (`http_service/request_tracer.cpp:38-61`) keyed only by
+service_request_id, so multi-hop behavior (PD-disaggregated routing,
+transparent failover, KV handoffs) is invisible. This module is the
+Dapper-style counterpart: an explicit trace context (`trace_id`, `span_id`,
+`parent_span_id`) is created in the HTTP frontend, carried in the enriched
+engine payload (`trace_context` key) and in RPC channel headers
+(`x-xllm-trace-id` / `x-xllm-parent-span-id`), and every hop records a
+:class:`Span` into a bounded in-memory ring buffer (:class:`SpanStore`).
+
+Query surface (served by the master's HTTP app and the engine agent):
+
+- ``GET /admin/trace?request_id=...`` (or ``trace_id=...``) — the assembled
+  span tree for one request, including failover re-dispatch attempts
+  correlated by trace_id across instance incarnations.
+- ``GET /admin/trace/recent[?sort=slowest&limit=N]`` — most-recent or
+  slowest traces.
+
+Fault-plane integration: :func:`add_event` stamps an event onto the calling
+thread's *active* span (entered via ``with TRACER.span(...)``);
+`common/faults.py` calls it on every fired rule, so chaos drills produce
+self-explaining traces.
+
+Overhead: with tracing disabled every ``span()``/``start_span()`` call is
+one attribute read + a shared no-op singleton return (measured <2% on the
+fake-engine request path, `benchmarks/bench_tracing_overhead.py`); enabled,
+spans cost one dict append into the ring under a leaf lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ..devtools.locks import make_lock
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+#: Registry of every span point compiled into the request path. xlint's
+#: span-point rule enforces the bidirectional contract: every
+#: ``TRACER.span("name")``/``TRACER.start_span("name")`` call site must name
+#: a point registered here, and every registered point must have at least
+#: one live call site (no dead span points).
+SPAN_POINTS: dict[str, str] = {
+    "frontend.request": "http_service/service.py root span per API request "
+                        "(fallback-created in scheduler.schedule for "
+                        "direct-scheduler callers)",
+    "scheduler.schedule": "scheduler dispatch: template + tokenize + route "
+                          "+ incarnation bind",
+    "scheduler.failover": "one transparent-failover re-dispatch attempt "
+                          "(PR 1); children are the replayed engine spans",
+    "engine.prefill": "engine-side prefill stage (accept -> first delta)",
+    "engine.decode": "engine-side decode stage (first delta -> finish)",
+    "kv_transfer.offer": "prefill-side KV offer/handoff to the decode peer",
+    "kv_transfer.pull": "decode-side device KV pull",
+}
+
+#: Wire header names (RPC channel hop).
+TRACE_ID_HEADER = "x-xllm-trace-id"
+PARENT_SPAN_HEADER = "x-xllm-parent-span-id"
+
+
+def _now_ms() -> float:
+    return time.time() * 1000.0
+
+
+def _new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def _new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The portable part of a span: what a downstream hop needs to parent
+    its own spans correctly. `span_id` is the sender's span — it becomes
+    the receiver's `parent_span_id`."""
+
+    trace_id: str
+    span_id: str
+
+    def to_dict(self) -> dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_dict(cls, d: Any) -> Optional["TraceContext"]:
+        """Tolerant parse of the `trace_context` wire key (None/malformed
+        payloads from old senders simply disable parenting)."""
+        if not isinstance(d, dict):
+            return None
+        tid, sid = d.get("trace_id"), d.get("span_id")
+        if not tid or not sid:
+            return None
+        return cls(trace_id=str(tid), span_id=str(sid))
+
+    def to_headers(self) -> dict[str, str]:
+        return {TRACE_ID_HEADER: self.trace_id,
+                PARENT_SPAN_HEADER: self.span_id}
+
+    @classmethod
+    def from_headers(cls, headers: Any) -> Optional["TraceContext"]:
+        try:
+            tid = headers.get(TRACE_ID_HEADER)
+            sid = headers.get(PARENT_SPAN_HEADER)
+        except AttributeError:
+            return None
+        if not tid or not sid:
+            return None
+        return cls(trace_id=str(tid), span_id=str(sid))
+
+
+# Thread-local stack of entered spans (innermost last). `add_event` and
+# `current_context` read the top; `with span:` pushes/pops.
+_tls = threading.local()
+
+
+def _active_stack() -> list["Span"]:
+    stack = getattr(_tls, "spans", None)
+    if stack is None:
+        stack = _tls.spans = []
+    return stack
+
+
+def current_span() -> Optional["Span"]:
+    stack = _active_stack()
+    return stack[-1] if stack else None
+
+
+def current_context() -> Optional[TraceContext]:
+    sp = current_span()
+    return sp.context() if sp is not None else None
+
+
+def current_headers() -> dict[str, str]:
+    """Propagation headers for the calling thread's active span ({} when
+    none — the RPC channel stamps these on every outbound request)."""
+    ctx = current_context()
+    return ctx.to_headers() if ctx is not None else {}
+
+
+def add_event(name: str, **attrs: Any) -> None:
+    """Stamp an event onto the calling thread's active span (no-op without
+    one). The fault plane calls this on every fired rule."""
+    sp = current_span()
+    if sp is not None:
+        sp.event(name, **attrs)
+
+
+class Span:
+    """One timed hop of a request. Context-manager entry makes it the
+    thread's active span (fault events land on it, RPC headers carry its
+    context); exit ends it. `end()` is idempotent — the first call records
+    the span into the store."""
+
+    __slots__ = ("point", "trace_id", "span_id", "parent_span_id",
+                 "request_id", "instance", "start_ms", "end_ms", "status",
+                 "attrs", "events", "_tracer")
+
+    def __init__(self, tracer: "Tracer", point: str,
+                 ctx: Optional[TraceContext], request_id: str,
+                 instance: str, attrs: dict[str, Any]):
+        self._tracer = tracer
+        self.point = point
+        self.trace_id = ctx.trace_id if ctx else _new_trace_id()
+        self.span_id = _new_span_id()
+        self.parent_span_id = ctx.span_id if ctx else ""
+        self.request_id = request_id
+        self.instance = instance
+        self.start_ms = _now_ms()
+        self.end_ms: Optional[float] = None
+        self.status = "OK"
+        self.attrs = attrs
+        self.events: list[dict[str, Any]] = []
+
+    def context(self) -> TraceContext:
+        """Context for children of THIS span (downstream hops, headers)."""
+        return TraceContext(trace_id=self.trace_id, span_id=self.span_id)
+
+    def set(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def event(self, name: str, **attrs: Any) -> None:
+        ev: dict[str, Any] = {"ts_ms": _now_ms(), "name": name}
+        if attrs:
+            ev.update(attrs)
+        self.events.append(ev)
+
+    def end(self, status: Optional[str] = None) -> None:
+        if self.end_ms is not None:
+            return
+        if status is not None:
+            self.status = status
+        self.end_ms = _now_ms()
+        self._tracer._record(self)
+
+    def duration_ms(self) -> float:
+        return (self.end_ms if self.end_ms is not None
+                else _now_ms()) - self.start_ms
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "point": self.point,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "request_id": self.request_id,
+            "instance": self.instance,
+            "start_ms": self.start_ms,
+            "end_ms": self.end_ms,
+            "duration_ms": round(self.duration_ms(), 3),
+            "status": self.status,
+            "attrs": dict(self.attrs),
+            "events": list(self.events),
+        }
+
+    def __enter__(self) -> "Span":
+        _active_stack().append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> None:
+        stack = _active_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:        # unbalanced exit (shouldn't happen)
+            stack.remove(self)
+        self.end("ERROR: " + repr(exc) if exc is not None else None)
+
+
+class _NoopSpan:
+    """Shared do-nothing span returned while tracing is disabled: one
+    attribute check + this singleton is the whole disabled-path cost."""
+
+    __slots__ = ()
+
+    def context(self) -> None:
+        return None
+
+    def set(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+    def event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def end(self, status: Optional[str] = None) -> None:
+        pass
+
+    def duration_ms(self) -> float:
+        return 0.0
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    def __bool__(self) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class SpanStore:
+    """Bounded per-process ring of finished spans, indexed by trace_id and
+    request_id. Eviction is strictly FIFO over spans; a trace disappears
+    from the index once its last span is evicted."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = max(1, int(capacity))
+        self._lock = make_lock("tracing.span_store", order=820)  # lock-order: 820
+        self._ring: deque[Span] = deque()
+        self._by_trace: dict[str, list[Span]] = {}
+        # request_id -> trace_id, insertion-ordered for bounded eviction.
+        self._req_index: OrderedDict[str, str] = OrderedDict()
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            self._ring.append(span)
+            self._by_trace.setdefault(span.trace_id, []).append(span)
+            if span.request_id:
+                self._req_index[span.request_id] = span.trace_id
+                self._req_index.move_to_end(span.request_id)
+                while len(self._req_index) > 4 * self.capacity:
+                    self._req_index.popitem(last=False)
+            while len(self._ring) > self.capacity:
+                old = self._ring.popleft()
+                spans = self._by_trace.get(old.trace_id)
+                if spans is not None:
+                    try:
+                        spans.remove(old)
+                    except ValueError:
+                        pass
+                    if not spans:
+                        self._by_trace.pop(old.trace_id, None)
+
+    def trace(self, trace_id: str) -> list[dict[str, Any]]:
+        with self._lock:
+            spans = list(self._by_trace.get(trace_id, ()))
+        return [s.to_dict() for s in sorted(spans, key=lambda s: s.start_ms)]
+
+    def trace_id_for_request(self, request_id: str) -> Optional[str]:
+        with self._lock:
+            return self._req_index.get(request_id)
+
+    def summaries(self, limit: int = 20,
+                  sort: str = "recent") -> list[dict[str, Any]]:
+        """Per-trace one-liners. `sort`: "recent" (latest start first) or
+        "slowest" (longest total duration first)."""
+        with self._lock:
+            traces = {tid: list(spans)
+                      for tid, spans in self._by_trace.items()}
+        rows = []
+        for tid, spans in traces.items():
+            start = min(s.start_ms for s in spans)
+            end = max(s.end_ms if s.end_ms is not None else s.start_ms
+                      for s in spans)
+            root = next((s for s in spans if not s.parent_span_id), None)
+            rid = next((s.request_id for s in spans if s.request_id), "")
+            rows.append({
+                "trace_id": tid,
+                "request_id": rid,
+                "root_point": root.point if root else "",
+                "start_ms": start,
+                "duration_ms": round(end - start, 3),
+                "num_spans": len(spans),
+                "status": (root.status if root else "OK"),
+            })
+        key = "duration_ms" if sort == "slowest" else "start_ms"
+        rows.sort(key=lambda r: r[key], reverse=True)
+        return rows[:max(0, int(limit))]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._by_trace.clear()
+            self._req_index.clear()
+
+
+def span_tree(spans: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Assemble flat span dicts into a parent/children forest, children
+    ordered by start time. Spans whose parent was evicted from the ring
+    become roots (the forest is still complete and ordered)."""
+    by_id = {s["span_id"]: dict(s, children=[]) for s in spans}
+    roots: list[dict[str, Any]] = []
+    for s in sorted(by_id.values(), key=lambda s: s["start_ms"]):
+        parent = by_id.get(s["parent_span_id"])
+        if parent is not None and parent is not s:
+            parent["children"].append(s)
+        else:
+            roots.append(s)
+    return roots
+
+
+class Tracer:
+    """Process-global tracer façade. `enabled=False` turns every span call
+    into a no-op; `mirror` (optional callable taking the span dict) lets
+    the HTTP layer tee finished spans into the RequestTracer JSONL."""
+
+    def __init__(self, capacity: int = 2048):
+        self.enabled = True
+        self.store = SpanStore(capacity)
+        self._mirror: Optional[Callable[[dict[str, Any]], None]] = None
+
+    def configure(self, enabled: Optional[bool] = None,
+                  capacity: Optional[int] = None,
+                  mirror: Any = "__unset__") -> None:
+        if enabled is not None:
+            self.enabled = enabled
+        if capacity is not None and capacity != self.store.capacity:
+            self.store = SpanStore(capacity)
+        if mirror != "__unset__":
+            self._mirror = mirror
+
+    def start_span(self, point: str, ctx: Optional[TraceContext] = None,
+                   request_id: str = "", instance: str = "",
+                   require_ctx: bool = False, **attrs: Any):
+        """New span (recorded on `end()`). Without `ctx` it roots a fresh
+        trace — unless `require_ctx` is set, which returns the no-op span
+        instead (for hops that must not root orphan single-span traces
+        when the request carried no context). Enter it (`with`) to make
+        it the thread's active span."""
+        if not self.enabled or (require_ctx and ctx is None):
+            return NOOP_SPAN
+        return Span(self, point, ctx, request_id, instance, attrs)
+
+    # Alias kept distinct in name for call sites that always use the span
+    # as a context manager; same registry (xlint checks both).
+    span = start_span
+
+    def _record(self, span: Span) -> None:
+        self.store.add(span)
+        mirror = self._mirror
+        if mirror is not None:
+            try:
+                mirror(span.to_dict())
+            except Exception:  # noqa: BLE001 — tracing must never break the request path
+                logger.exception("span mirror failed")
+
+    # ---------------------------------------------------------- query API
+    def query_trace(self, request_id: str = "",
+                    trace_id: str = "") -> tuple[int, dict[str, Any]]:
+        """Shared backend for the /admin/trace endpoints (master + engine
+        agent): returns (http_status, payload)."""
+        tid = trace_id
+        if not tid and request_id:
+            tid = self.store.trace_id_for_request(request_id) or ""
+        if not tid:
+            return 404, {"error": "unknown request_id (pass request_id= or "
+                                  "trace_id=)"}
+        spans = self.store.trace(tid)
+        if not spans:
+            return 404, {"error": f"no spans recorded for trace {tid}"}
+        return 200, {"trace_id": tid,
+                     "request_id": request_id
+                     or next((s["request_id"] for s in spans
+                              if s["request_id"]), ""),
+                     "num_spans": len(spans),
+                     "spans": spans,
+                     "tree": span_tree(spans)}
+
+    def query_recent(self, limit: int = 20,
+                     sort: str = "recent") -> dict[str, Any]:
+        if sort not in ("recent", "slowest"):
+            sort = "recent"
+        return {"sort": sort, "traces": self.store.summaries(limit, sort)}
+
+
+#: Process-global tracer. The service/agent configure it from options;
+#: default is enabled with a modest ring (cheap: spans are small dicts).
+TRACER = Tracer()
+
+
+# Shared aiohttp handlers for the /admin/trace query surface — the master
+# HTTP app, the engine agent and the fake engine all register these (each
+# process serves its own SpanStore's view of a trace).
+async def handle_admin_trace(request):
+    from aiohttp import web
+
+    status, payload = TRACER.query_trace(
+        request_id=request.query.get("request_id", ""),
+        trace_id=request.query.get("trace_id", ""))
+    return web.json_response(payload, status=status)
+
+
+async def handle_admin_trace_recent(request):
+    from aiohttp import web
+
+    try:
+        limit = int(request.query.get("limit", 20))
+    except ValueError:
+        return web.json_response({"error": "limit must be an integer"},
+                                 status=400)
+    return web.json_response(TRACER.query_recent(
+        limit=limit, sort=request.query.get("sort", "recent")))

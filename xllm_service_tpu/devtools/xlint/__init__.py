@@ -19,8 +19,13 @@ rule id                    invariant
 ``fault-point``            every ``FAULTS.check("p")``/``FAULTS.fire("p")``
                            names a point registered in ``common/faults.py``'s
                            ``FAULT_POINTS``, and no registered point is dead
+``span-point``             every ``TRACER.span("p")``/``TRACER.start_span``
+                           names a point registered in ``common/tracing.py``'s
+                           ``SPAN_POINTS``, and no registered point is dead
 ``metrics-registry``       metric instruments are created only in
-                           ``common/metrics.py`` and none is dead
+                           ``common/metrics.py`` and none is dead; labeled
+                           instruments are written only via ``.labels(...)``
+                           with exactly the declared labelnames
 ``broad-except``           no bare ``except:`` anywhere; in scheduler/rpc/
                            coordination/engine paths every ``except
                            Exception`` handler logs or re-raises
@@ -33,6 +38,7 @@ Escape hatches are inline comments with a mandatory reason::
     # xlint: allow-lock-order(reason)
     # xlint: allow-bare-acquire(reason)
     # xlint: allow-lock-annotation(reason)
+    # xlint: allow-span-point(reason)
 
 Run: ``python -m xllm_service_tpu.devtools.xlint xllm_service_tpu``
 (exit 0 = clean, 1 = violations, 2 = usage/parse error).
@@ -51,7 +57,7 @@ _SUPPRESS_RE = re.compile(r"#\s*xlint:\s*allow-([a-z-]+)\(([^)]*)\)")
 #: Rule tokens accepted in suppression comments.
 SUPPRESSIBLE = {
     "broad-except", "blocking-under-lock", "lock-order", "bare-acquire",
-    "lock-annotation", "local-lock",
+    "lock-annotation", "local-lock", "span-point",
 }
 
 

@@ -573,9 +573,30 @@ def rule_fault_point(project: Project) -> list[Violation]:
 
 
 # ------------------------------------------------------------------- metrics
+#: Write methods that must go through .labels() on a labeled instrument.
+_METRIC_WRITERS = {"inc", "set", "observe"}
+
+
+def _decl_labelnames(call: ast.Call) -> Optional[tuple[str, ...]]:
+    """The labelnames=(...) tuple of an instrument declaration (None when
+    absent, () when explicitly empty)."""
+    for kw in call.keywords:
+        if kw.arg != "labelnames":
+            continue
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            names = []
+            for e in kw.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.append(e.value)
+            return tuple(names)
+        return ()
+    return None
+
+
 def rule_metrics_registry(project: Project) -> list[Violation]:
     decl_file: Optional[SourceFile] = None
-    instruments: dict[str, tuple[str, int]] = {}   # identifier -> (name, line)
+    # identifier -> (metric name, line, declared labelnames or None)
+    instruments: dict[str, tuple[str, int, Optional[tuple[str, ...]]]] = {}
     top_names: set[str] = set()
     for f in project.files:
         if f.path.name != "metrics.py":
@@ -596,7 +617,8 @@ def rule_metrics_registry(project: Project) -> list[Violation]:
                     mname = _first_str_arg(node.value)
                     tgt = node.targets[0]
                     if mname and isinstance(tgt, ast.Name):
-                        found[tgt.id] = (mname, node.lineno)
+                        found[tgt.id] = (mname, node.lineno,
+                                         _decl_labelnames(node.value))
         if found:
             decl_file, instruments, top_names = f, found, names
     if decl_file is None:
@@ -604,13 +626,56 @@ def rule_metrics_registry(project: Project) -> list[Violation]:
 
     out: list[Violation] = []
     dupes: dict[str, str] = {}
-    for ident, (mname, line) in instruments.items():
+    for ident, (mname, line, _labels) in instruments.items():
         if mname in dupes:
             out.append(Violation(
                 "metrics-registry", decl_file.rel, line,
                 f"metric name {mname!r} declared twice "
                 f"({dupes[mname]} and {ident})"))
         dupes[mname] = ident
+
+    def check_instrument_call(f: SourceFile, node: ast.Call) -> None:
+        """Labeled-instrument hygiene at a call site whose receiver is a
+        declared instrument identifier: .labels() must pass exactly the
+        declared labelnames (keyword-only), and writes on a labeled family
+        must go through .labels()."""
+        recv = node.func.value
+        # Bare (TTFT_MS.observe) and module-qualified (metrics.TTFT_MS
+        # .observe) receivers — same access styles the liveness scan
+        # accepts as a use.
+        if isinstance(recv, ast.Name):
+            ident = recv.id
+        elif isinstance(recv, ast.Attribute):
+            ident = recv.attr
+        else:
+            return
+        if ident not in instruments:
+            return
+        _mname, _line, labelnames = instruments[ident]
+        if node.func.attr == "labels":
+            if not labelnames:
+                out.append(Violation(
+                    "metrics-registry", f.rel, node.lineno,
+                    f"labels() on {ident}, which declares no labelnames"))
+                return
+            if node.args:
+                out.append(Violation(
+                    "metrics-registry", f.rel, node.lineno,
+                    f"{ident}.labels() takes keyword arguments only"))
+                return
+            got = {kw.arg for kw in node.keywords if kw.arg}
+            if any(kw.arg is None for kw in node.keywords):
+                return   # **kwargs: not statically checkable
+            if got != set(labelnames):
+                out.append(Violation(
+                    "metrics-registry", f.rel, node.lineno,
+                    f"{ident}.labels() passes {tuple(sorted(got))} but "
+                    f"the instrument declares labelnames {labelnames}"))
+        elif node.func.attr in _METRIC_WRITERS and labelnames:
+            out.append(Violation(
+                "metrics-registry", f.rel, node.lineno,
+                f"{ident}.{node.func.attr}() on a labeled instrument — "
+                f"write through .labels(...).{node.func.attr}()"))
 
     used: set[str] = set()
     for f in project.files:
@@ -625,6 +690,9 @@ def rule_metrics_registry(project: Project) -> list[Violation]:
                     "metrics-registry", f.rel, node.lineno,
                     f"ad-hoc metric creation (REGISTRY.{node.func.attr}) — "
                     f"declare instruments in common/metrics.py"))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                check_instrument_call(f, node)
             elif isinstance(node, ast.ImportFrom) and node.module \
                     and node.module.rsplit(".", 1)[-1] == "metrics":
                 for alias in node.names:
@@ -639,12 +707,71 @@ def rule_metrics_registry(project: Project) -> list[Violation]:
                 used.add(node.id)
             elif isinstance(node, ast.Attribute) and node.attr in instruments:
                 used.add(node.attr)
-    for ident, (mname, line) in sorted(instruments.items()):
+    for ident, (mname, line, _labels) in sorted(instruments.items()):
         if ident not in used:
             out.append(Violation(
                 "metrics-registry", decl_file.rel, line,
                 f"instrument {ident} ({mname!r}) is never used "
                 f"(dead metric)"))
+    return out
+
+
+# --------------------------------------------------------------- span points
+def rule_span_point(project: Project) -> list[Violation]:
+    """Bidirectional span-point registry check (mirrors the fault-point
+    rule): every ``TRACER.span("p")``/``TRACER.start_span("p")`` call site
+    must name a point registered in ``common/tracing.py``'s ``SPAN_POINTS``,
+    and every registered point must have at least one live call site."""
+    registry: dict[str, int] = {}
+    reg_file: Optional[SourceFile] = None
+    for f in project.files:
+        if f.path.name != "tracing.py":
+            continue
+        for node in f.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "SPAN_POINTS"
+                    for t in node.targets) \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        registry[k.value] = k.lineno
+                reg_file = f
+    if reg_file is None:
+        return []   # partial tree (e.g. fixture subset without a registry)
+
+    out: list[Violation] = []
+    used: set[str] = set()
+    for f in project.files:
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("span", "start_span")):
+                continue
+            recv = _expr_text(node.func.value)
+            if not (recv == "tracing" or recv.split(".")[-1] == "TRACER"):
+                continue
+            if f.allowed("span-point", node.lineno):
+                # Hatched sites (e.g. a helper forwarding literal points)
+                # are exempt from the literal/registered checks.
+                continue
+            point = _first_str_arg(node)
+            if point is None:
+                out.append(Violation(
+                    "span-point", f.rel, node.lineno,
+                    "span point must be a string literal"))
+            elif point not in registry:
+                out.append(Violation(
+                    "span-point", f.rel, node.lineno,
+                    f"span point {point!r} is not registered in "
+                    f"common/tracing.py SPAN_POINTS"))
+            else:
+                used.add(point)
+    for point, line in sorted(registry.items()):
+        if point not in used:
+            out.append(Violation(
+                "span-point", reg_file.rel, line,
+                f"registered span point {point!r} has no call site "
+                f"(dead span point)"))
     return out
 
 
@@ -707,6 +834,7 @@ ALL_RULES = (
     rule_no_blocking_under_lock,
     rule_lock_order,
     rule_fault_point,
+    rule_span_point,
     rule_metrics_registry,
     rule_broad_except,
 )

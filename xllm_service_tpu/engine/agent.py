@@ -32,7 +32,9 @@ from aiohttp import web
 
 import jax
 
+from ..common import tracing
 from ..common.request import LogProb, RequestOutput, SamplingParams, Status, StatusCode
+from ..common.tracing import NOOP_SPAN, TRACER, TraceContext
 from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
 from ..devtools.locks import make_lock
 from ..coordination import CoordinationClient, connect
@@ -48,7 +50,8 @@ logger = get_logger(__name__)
 
 def pack_handoff(h: PrefillHandoff, source_service_addr: str,
                  kv_ref: Optional[dict] = None,
-                 source_instance: str = "") -> bytes:
+                 source_instance: str = "",
+                 trace_context: Optional[dict] = None) -> bytes:
     """Serialize a PD handoff control message. With `kv_ref` (device
     transfer path) the KV stays on device and only the pull descriptor is
     sent; otherwise the blob is downloaded and carried inline (DCN host
@@ -70,6 +73,8 @@ def pack_handoff(h: PrefillHandoff, source_service_addr: str,
                     for t in lp.top_logprobs]},
         "sampling": h.sampling.to_dict(),
     }
+    if trace_context is not None:
+        msg["trace_context"] = trace_context
     if kv_ref is not None:
         msg["kv_ref"] = kv_ref
     else:
@@ -561,6 +566,12 @@ class EngineAgent:
         app.router.add_get("/health", self._h_health)
         app.router.add_get("/stats", self._h_stats)
         app.router.add_get("/metrics", self._h_metrics)
+        # This agent process's view of a trace (engine-side spans; span
+        # stores are per-process — the master serves the orchestration
+        # legs under the same trace_id).
+        app.router.add_get("/admin/trace", tracing.handle_admin_trace)
+        app.router.add_get("/admin/trace/recent",
+                           tracing.handle_admin_trace_recent)
         app.router.add_post("/rpc/link", self._h_link)
         app.router.add_post("/rpc/unlink", self._h_unlink)
         app.router.add_post("/rpc/cancel", self._h_cancel)
@@ -713,6 +724,15 @@ class EngineAgent:
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
+    def _stage_span(self, point: str, ctx: Optional[TraceContext],
+                    sid: str, **attrs: Any):
+        """Engine-side stage span, parented under the orchestrator's
+        carried context. Standalone requests (no context) are not traced —
+        there is no tree to hang them on."""
+        return TRACER.start_span(point, ctx=ctx, request_id=sid,  # xlint: allow-span-point(forwards literal point names from its call sites)
+                                 require_ctx=True, instance=self.name,
+                                 incarnation=self.incarnation_id, **attrs)
+
     async def _h_models(self, req: web.Request) -> web.Response:
         return web.json_response({"object": "list", "data": [
             {"id": self.cfg.model_id, "object": "model"}]})
@@ -851,14 +871,31 @@ class EngineAgent:
 
         dest = source
         first_delta = [True]
+        # Trace propagation: stage spans parent under the orchestrator's
+        # context carried in the enriched body. The prefill span opens at
+        # accept and closes at the first delta (or the PD handoff); decode
+        # runs from there to the terminal delta.
+        ctx = TraceContext.from_dict(body.get("trace_context")) \
+            or TraceContext.from_headers(req.headers)
+        stage = {"span": self._stage_span("engine.prefill", ctx, sid,
+                                          prompt_tokens=len(token_ids))}
 
         def on_output(out: RequestOutput) -> None:
             # Agent-side TTFT span: HTTP accept -> first delta pushed to
             # the streamer. Client TTFT minus this is master+wire cost.
+            err = None if out.status.ok() else \
+                f"ERROR: {out.status.message or out.status.code.name}"
             if first_delta[0]:
                 first_delta[0] = False
                 self.ttft_spans.append(
                     (time.monotonic() - t_recv) * 1000)
+                stage["span"].end(err)
+                # A failed prefill (error surfaced before any token) has
+                # no decode stage — don't fabricate one.
+                stage["span"] = NOOP_SPAN if err else \
+                    self._stage_span("engine.decode", ctx, sid)
+            if out.finished:
+                stage["span"].end(err)
             self.streamer.push(dest, out)
 
         # PD disaggregation: a PREFILL-role instance with a routed decode
@@ -871,9 +908,10 @@ class EngineAgent:
             def on_prefill_done(h: PrefillHandoff,
                                 _peer: str = decode_name,
                                 _dest: str = dest) -> None:
+                stage["span"].end()
                 threading.Thread(
                     target=self._transfer_to_peer, daemon=True,
-                    args=(h, _peer, _dest),
+                    args=(h, _peer, _dest, ctx),
                     name=f"kv-transfer-{h.service_request_id}").start()
 
             self._pick_engine(token_ids).submit(EngineRequest(
@@ -903,7 +941,9 @@ class EngineAgent:
             return web.json_response({"ok": True, "service_request_id": sid})
 
         # All n choices go to ONE replica so its prefix cache dedupes the
-        # shared prompt prefill.
+        # shared prompt prefill. Stage spans don't model the n-way fan-out;
+        # close the prefill span here so the trace still records admission.
+        stage["span"].set(n=n).end()
         agg = _ChoiceAggregator(n, lambda out: self.streamer.push(dest, out))
         for k in range(n):
             sub_sampling = sampling
@@ -920,12 +960,13 @@ class EngineAgent:
                 priority=int(body.get("priority") or 0)))
         return web.json_response({"ok": True, "service_request_id": sid})
 
-    def _transfer_to_peer(self, h: PrefillHandoff, peer: str,
-                          dest: str) -> None:
+    def _transfer_to_peer(self, h: PrefillHandoff, peer: str, dest: str,
+                          ctx: Optional[TraceContext] = None) -> None:
         """Ship a prefilled sequence to its decode peer. Device path first
         (KV pulled device-to-device via the peer's transfer connection —
         ICI within a slice, DCN fabric across), host-msgpack fallback
         behind the same PrefillHandoff contract."""
+        trace_dict = ctx.to_dict() if ctx is not None else None
         peer_meta = self.linked_peers.get(peer)
         if (self.kv_transfer is not None and peer_meta is not None
                 and peer_meta.topology.kv_transfer_addr
@@ -933,9 +974,11 @@ class EngineAgent:
             desc = None
             try:
                 desc = self.kv_transfer.offer(
-                    h.service_request_id, h.kv_blob, self.incarnation_id)
+                    h.service_request_id, h.kv_blob, self.incarnation_id,
+                    ctx=ctx)
                 self._post_handoff(peer, pack_handoff(
-                    h, dest, kv_ref=desc, source_instance=self.name))
+                    h, dest, kv_ref=desc, source_instance=self.name,
+                    trace_context=trace_dict))
                 self.kv_transfer.release(desc["uuid"])
                 self.kv_device_sent += 1
                 return
@@ -946,8 +989,12 @@ class EngineAgent:
                     "device KV transfer of %s to %s failed (%s); falling "
                     "back to host path", h.service_request_id, peer, e)
         try:
-            self._post_handoff(peer, pack_handoff(
-                h, dest, source_instance=self.name))
+            with TRACER.span("kv_transfer.offer", ctx=ctx, require_ctx=True,
+                             request_id=h.service_request_id,
+                             instance=self.name, path="host"):
+                self._post_handoff(peer, pack_handoff(
+                    h, dest, source_instance=self.name,
+                    trace_context=trace_dict))
             self.kv_host_sent += 1
         except Exception as e:  # noqa: BLE001
             logger.warning("KV transfer of %s to %s failed: %s",
@@ -1051,6 +1098,7 @@ class EngineAgent:
                 {"error": f"instance {src or '<unknown>'} is not a linked "
                           "peer; rejecting KV handoff"}, status=403)
         sid = obj.get("service_request_id", "")
+        ctx = TraceContext.from_dict(obj.get("trace_context"))
         now = time.monotonic()
         for k, ts in list(self._handoffs_seen.items()):
             if now - ts > 600:
@@ -1069,7 +1117,8 @@ class EngineAgent:
             try:
                 # Off the event loop: the pull blocks on the device fabric.
                 obj["kv_blob"] = await asyncio.get_running_loop() \
-                    .run_in_executor(None, self.kv_transfer.pull, ref)
+                    .run_in_executor(
+                        None, lambda: self.kv_transfer.pull(ref, ctx=ctx))
                 self.kv_device_received += 1
             except Exception as e:  # noqa: BLE001
                 # Unmark: the prefill side will retry via the host path,
@@ -1092,7 +1141,11 @@ class EngineAgent:
                          top_logprobs=[LogProbData(t[0], t[1], t[2])
                                        for t in lp_d.get("top", ())])
 
+        dspan = self._stage_span("engine.decode", ctx, sid, injected=True)
+
         def on_output(out: RequestOutput) -> None:
+            if out.finished:
+                dspan.end()
             self.streamer.push(dest, out)
 
         self._pick_engine(list(obj["token_ids"])).submit(EngineRequest(

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..common.faults import FAULTS
+from ..common.tracing import TRACER, TraceContext
 from ..devtools.locks import make_lock
 from ..utils import get_logger
 
@@ -97,17 +98,23 @@ class KvTransferManager:
 
     # ------------------------------------------------------------ prefill
     def offer(self, service_request_id: str, blob: jax.Array,
-              incarnation: str = "") -> dict[str, Any]:
+              incarnation: str = "",
+              ctx: Optional[TraceContext] = None) -> dict[str, Any]:
         """Schedule `blob` for a device-to-device pull; returns the wire
-        descriptor for the control message."""
+        descriptor for the control message. `ctx` parents the offer span
+        under the request's carried trace context."""
         uid = transfer_uuid(service_request_id, incarnation)
-        # Chaos hook: an injected error here lands in the agent's existing
-        # device-path try/except, exercising the host-msgpack fallback.
-        FAULTS.check("kv_transfer.offer", sid=service_request_id)
-        self.gc()
-        with self._lock:
-            self._pending[uid] = ([blob], time.monotonic() + OFFER_TTL_S)
-        self._server.await_pull(uid, [blob])
+        with TRACER.span("kv_transfer.offer", ctx=ctx, require_ctx=True,
+                         request_id=service_request_id, path="device",
+                         shape=list(blob.shape)):
+            # Chaos hook: an injected error here lands in the agent's
+            # existing device-path try/except, exercising the host-msgpack
+            # fallback (and stamps a fault event on the offer span).
+            FAULTS.check("kv_transfer.offer", sid=service_request_id)
+            self.gc()
+            with self._lock:
+                self._pending[uid] = ([blob], time.monotonic() + OFFER_TTL_S)
+            self._server.await_pull(uid, [blob])
         desc = {
             "addr": self.address,
             "uuid": uid,
@@ -148,31 +155,36 @@ class KvTransferManager:
         self._server = None
 
     # ------------------------------------------------------------- decode
-    def pull(self, desc: dict[str, Any]) -> jax.Array:
+    def pull(self, desc: dict[str, Any],
+             ctx: Optional[TraceContext] = None) -> jax.Array:
         """Pull the offered KV pages straight into this engine's device
-        memory."""
-        # Chaos hook: decode-side pull failure (the receiving agent's
-        # handoff handler reports UNAVAILABLE back to the service, which
-        # is exactly the path a mid-transfer network fault takes).
-        FAULTS.check("kv_transfer.pull", uuid=desc.get("uuid"))
-        addr = desc["addr"]
-        with self._lock:
-            conn = self._conns.get(addr)
-        if conn is None:
-            conn = self._server.connect(addr)
+        memory. `ctx` parents the pull span under the request's carried
+        trace context."""
+        with TRACER.span("kv_transfer.pull", ctx=ctx, require_ctx=True,
+                         path="device", shape=list(desc.get("shape", ()))):
+            # Chaos hook: decode-side pull failure (the receiving agent's
+            # handoff handler reports UNAVAILABLE back to the service,
+            # which is exactly the path a mid-transfer network fault
+            # takes).
+            FAULTS.check("kv_transfer.pull", uuid=desc.get("uuid"))
+            addr = desc["addr"]
             with self._lock:
-                self._conns[addr] = conn
-        pspec = desc.get("spec")
-        if pspec is not None and self._mesh is not None:
-            sharding = jax.sharding.NamedSharding(
-                self._mesh,
-                jax.sharding.PartitionSpec(
-                    *[tuple(p) if isinstance(p, list) else p
-                      for p in pspec]))
-        else:
-            sharding = jax.sharding.SingleDeviceSharding(self._device)
-        spec = jax.ShapeDtypeStruct(
-            tuple(desc["shape"]), jnp.dtype(desc["dtype"]),
-            sharding=sharding)
-        out = conn.pull(int(desc["uuid"]), [spec])
-        return out[0]
+                conn = self._conns.get(addr)
+            if conn is None:
+                conn = self._server.connect(addr)
+                with self._lock:
+                    self._conns[addr] = conn
+            pspec = desc.get("spec")
+            if pspec is not None and self._mesh is not None:
+                sharding = jax.sharding.NamedSharding(
+                    self._mesh,
+                    jax.sharding.PartitionSpec(
+                        *[tuple(p) if isinstance(p, list) else p
+                          for p in pspec]))
+            else:
+                sharding = jax.sharding.SingleDeviceSharding(self._device)
+            spec = jax.ShapeDtypeStruct(
+                tuple(desc["shape"]), jnp.dtype(desc["dtype"]),
+                sharding=sharding)
+            out = conn.pull(int(desc["uuid"]), [spec])
+            return out[0]

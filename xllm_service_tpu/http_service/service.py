@@ -37,6 +37,8 @@ from aiohttp import web
 
 from ..common.metrics import REGISTRY, SERVER_REQUEST_IN_TOTAL
 from ..common.request import Request, RequestOutput, SamplingParams
+from ..common import tracing
+from ..common.tracing import TRACER
 from ..common.types import InstanceType
 from ..scheduler.scheduler import Scheduler
 from ..utils import generate_service_request_id, get_logger, short_uuid
@@ -84,6 +86,19 @@ def _parse_sampling(body: dict[str, Any]) -> SamplingParams:
     return sp
 
 
+def _cast_bool(v: Any) -> bool:
+    """Admin-config boolean caster: JSON true/false or the string forms —
+    bool("false") is True, which would silently invert an operator's
+    intent."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)) and v in (0, 1):
+        return bool(v)
+    if isinstance(v, str) and v.lower() in ("true", "false", "1", "0"):
+        return v.lower() in ("true", "1")
+    raise ValueError(f"not a boolean: {v!r}")
+
+
 def _error_response(code: int, message: str, etype: str = "invalid_request_error") -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": etype, "code": code}},
@@ -98,6 +113,12 @@ class XllmHttpService:
         self.opts = scheduler._opts
         self.tracer = tracer or RequestTracer(self.opts.trace_dir,
                                               self.opts.enable_request_trace)
+        # Span tracing: ring buffer per options; finished spans mirrored
+        # into the RequestTracer JSONL when request tracing is on.
+        TRACER.configure(
+            enabled=self.opts.enable_tracing,
+            capacity=self.opts.trace_span_capacity,
+            mirror=self._mirror_span if self.tracer.enabled else None)
         self._client: Optional[aiohttp.ClientSession] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         # The event loop keeps only weak refs to tasks; hold forward tasks
@@ -120,6 +141,13 @@ class XllmHttpService:
         app.router.add_get("/admin/planner", self.handle_planner)
         app.router.add_get("/admin/faults", self.handle_get_faults)
         app.router.add_post("/admin/faults", self.handle_set_faults)
+        # Span-trace query surface (shared handlers; each process serves
+        # its own SpanStore — this is the orchestration plane's view,
+        # including failover re-dispatch attempts correlated by trace_id
+        # across instance incarnations).
+        app.router.add_get("/admin/trace", tracing.handle_admin_trace)
+        app.router.add_get("/admin/trace/recent",
+                           tracing.handle_admin_trace_recent)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -143,6 +171,11 @@ class XllmHttpService:
     async def _on_cleanup(self, app: web.Application) -> None:
         if self._client is not None:
             await self._client.close()
+        self.tracer.close()
+
+    def _mirror_span(self, span: dict[str, Any]) -> None:
+        self.tracer.log(span.get("request_id", ""),
+                        {"type": "span", "span": span})
 
     @web.middleware
     async def _readiness_middleware(self, request: web.Request, handler):
@@ -168,7 +201,7 @@ class XllmHttpService:
         (`anthropic.proto` in `proto/CMakeLists.txt:18-37`) with no
         service route; here it is a first-class endpoint mapped onto the
         chat pipeline with Anthropic request/response/stream framing."""
-        SERVER_REQUEST_IN_TOTAL.inc()
+        SERVER_REQUEST_IN_TOTAL.labels(kind="anthropic").inc()
         try:
             body = await http_req.json()
         except json.JSONDecodeError:
@@ -213,17 +246,20 @@ class XllmHttpService:
         if self.tracer.enabled:
             req.trace_callback = self.tracer.log
             self.tracer.log(req.service_request_id, {"request": body})
+        self._start_root_span(req, "anthropic")
 
         status = await asyncio.get_running_loop().run_in_executor(
             None, self.scheduler.schedule, req)
         if not status.ok():
+            if req.span:
+                req.span.end(f"ERROR: {status.code.name}")
             return _error_response(
                 503 if status.code.name == "UNAVAILABLE" else 400,
                 status.message, "service_unavailable"
                 if status.code.name == "UNAVAILABLE" else "invalid_request_error")
 
         conn = AioConnection(asyncio.get_running_loop(), req.stream)
-        enriched = {
+        enriched: dict[str, Any] = {
             "model": req.model,
             "service_request_id": req.service_request_id,
             "source_service_addr": self.scheduler.self_addr,
@@ -241,6 +277,8 @@ class XllmHttpService:
             enriched["top_p"] = body["top_p"]
         if body.get("top_k") is not None:
             enriched["top_k"] = body["top_k"]
+        if req.trace is not None:
+            enriched["trace_context"] = req.trace.to_dict()
         self.scheduler.record_new_request(
             req, conn, "anthropic",
             forward_path="/v1/chat/completions", forward_payload=enriched)
@@ -250,9 +288,20 @@ class XllmHttpService:
         task.add_done_callback(self._forward_tasks.discard)
         return await self._respond(http_req, req, conn, emit_done=False)
 
+    def _start_root_span(self, req: Request, kind: str) -> None:
+        """Root the request's trace in the frontend (no-op when tracing is
+        off): every downstream hop parents its spans under this context."""
+        root = TRACER.start_span("frontend.request",
+                                 request_id=req.service_request_id,
+                                 kind=kind, model=req.model,
+                                 stream=req.stream)
+        if root:
+            req.span = root
+            req.trace = root.context()
+
     async def _handle_generate(self, http_req: web.Request,
                                kind: str) -> web.StreamResponse:
-        SERVER_REQUEST_IN_TOTAL.inc()
+        SERVER_REQUEST_IN_TOTAL.labels(kind=kind).inc()
         try:
             body = await http_req.json()
         except json.JSONDecodeError:
@@ -303,11 +352,14 @@ class XllmHttpService:
         if self.tracer.enabled:
             req.trace_callback = self.tracer.log
             self.tracer.log(req.service_request_id, {"request": body})
+        self._start_root_span(req, kind)
 
         # Schedule (tokenize + route) off the event loop — CPU-bound.
         status = await asyncio.get_running_loop().run_in_executor(
             None, self.scheduler.schedule, req)
         if not status.ok():
+            if req.span:
+                req.span.end(f"ERROR: {status.code.name}")
             return _error_response(
                 503 if status.code.name == "UNAVAILABLE" else 400,
                 status.message, "service_unavailable"
@@ -326,6 +378,8 @@ class XllmHttpService:
         enriched["routing"] = {"prefill_name": req.routing.prefill_name,
                                "decode_name": req.routing.decode_name,
                                "encode_name": req.routing.encode_name}
+        if req.trace is not None:
+            enriched["trace_context"] = req.trace.to_dict()
         path = "/v1/chat/completions" if kind == "chat" else "/v1/completions"
         self.scheduler.record_new_request(req, conn, kind,
                                           forward_path=path,
@@ -481,7 +535,8 @@ class XllmHttpService:
     # brpc-reloadable flags with validation, `global_gflags.cpp:122-132`).
     _RELOADABLE = {"target_ttft_ms": float, "target_tpot_ms": float,
                    "max_waiting_requests": int, "request_timeout_s": float,
-                   "enable_request_trace": bool}
+                   "enable_request_trace": _cast_bool,
+                   "enable_tracing": _cast_bool}
 
     async def handle_get_config(self, request: web.Request) -> web.Response:
         import dataclasses
@@ -553,6 +608,10 @@ class XllmHttpService:
                 return _error_response(400, f"{key} must be positive")
             setattr(self.opts, key, cast_value)
             applied[key] = cast_value
+        if "enable_tracing" in applied:
+            # Live span-tracing toggle (e.g. shed the overhead under a
+            # traffic spike without a restart).
+            TRACER.configure(enabled=self.opts.enable_tracing)
         return web.json_response({"ok": True, "applied": applied})
 
     # ----------------------------------------------------------- RPC routes

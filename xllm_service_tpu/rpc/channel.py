@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 import requests
 
+from ..common import tracing
 from ..common.faults import FAULTS, FaultInjected
 from ..common.metrics import RPC_RETRIES_TOTAL
 from ..common.types import InstanceMetaInfo
@@ -74,13 +75,17 @@ class EngineChannel:
               retries: Optional[int] = None) -> tuple[bool, Any]:
         attempts = self.retries if retries is None else max(1, retries)
         err: Any = None
+        # Trace propagation: the calling thread's active span rides the
+        # wire as headers ({} almost always — one thread-local read).
+        headers = tracing.current_headers() or None
         for attempt in range(attempts):
             if attempt:
-                RPC_RETRIES_TOTAL.inc()
+                RPC_RETRIES_TOTAL.labels(instance=self.name).inc()
                 self._sleep_backoff(attempt - 1)
             try:
                 FAULTS.check("rpc.post", instance=self.name, path=path)
                 r = self._session.post(self.base_url + path, json=payload,
+                                       headers=headers,
                                        timeout=timeout_s or self.timeout_s)
                 if r.status_code == 200:
                     try:
@@ -98,13 +103,14 @@ class EngineChannel:
              retries: Optional[int] = None) -> tuple[bool, Any]:
         attempts = self.retries if retries is None else max(1, retries)
         err: Any = None
+        headers = tracing.current_headers() or None
         for attempt in range(attempts):
             if attempt:
-                RPC_RETRIES_TOTAL.inc()
+                RPC_RETRIES_TOTAL.labels(instance=self.name).inc()
                 self._sleep_backoff(attempt - 1)
             try:
                 FAULTS.check("rpc.get", instance=self.name, path=path)
-                r = self._session.get(self.base_url + path,
+                r = self._session.get(self.base_url + path, headers=headers,
                                       timeout=timeout_s or self.timeout_s)
                 if r.status_code == 200:
                     try:
@@ -174,6 +180,7 @@ class EngineChannel:
         try:
             FAULTS.check("rpc.post", instance=self.name, path=path)
             r = self._session.post(self.base_url + path, json=payload,
+                                   headers=tracing.current_headers() or None,
                                    timeout=self.timeout_s)
         except (requests.RequestException, FaultInjected) as e:
             return 502, {"error": str(e)}

@@ -34,7 +34,14 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..common.config import ServiceOptions
-from ..common.metrics import INSTANCE_EVICTIONS_TOTAL
+from ..common.metrics import (
+    INSTANCE_EVICTIONS_TOTAL,
+    INSTANCE_INFLIGHT_REQUESTS,
+    INSTANCE_QUEUE_DEPTH,
+    ITL_MS,
+    RPC_RETRIES_TOTAL,
+    TTFT_MS,
+)
 from ..common.time_predictor import TimePredictor
 from ..common.types import (
     InstanceLoadInfo,
@@ -346,10 +353,27 @@ class InstanceMgr:
             self._request_loads.pop(name, None)
             self._removed_load_names.add(name)
             self._updated_load_names.discard(name)
+            # Drop the dead instance's gauge series so /metrics stops
+            # exporting stale labels. Inside _metrics_lock: the gauge
+            # writers gate on _load_metrics membership under the same
+            # lock, so a racing write can't resurrect a removed series.
+            INSTANCE_QUEUE_DEPTH.remove(instance=name)
+            for phase in ("prefill", "decode"):
+                INSTANCE_INFLIGHT_REQUESTS.remove(instance=name, phase=phase)
+        # High-cardinality per-instance latency/retry series go too (a
+        # histogram is 17 lines per child; fleet churn with ephemeral
+        # ports would grow /metrics without bound). FAILOVER_* and
+        # eviction counters are kept: they are the failure history, and
+        # grow one small child per eviction event, not per instance
+        # lifetime of traffic.
+        policy = self._opts.load_balance_policy
+        TTFT_MS.remove(instance=name, policy=policy)
+        ITL_MS.remove(instance=name, policy=policy)
+        RPC_RETRIES_TOTAL.remove(instance=name)
         if reason != "replaced":
             # A re-registration with a new incarnation is planned churn
             # (rolling restart), not an eviction — don't page anyone.
-            INSTANCE_EVICTIONS_TOTAL.inc()
+            INSTANCE_EVICTIONS_TOTAL.labels(instance=name).inc()
         logger.info("deregistered instance %s (%s)", name, reason)
         if self.on_instance_failure is not None:
             self.on_instance_failure(name, incarnation, itype)
@@ -392,6 +416,13 @@ class InstanceMgr:
         if load is not None or latency is not None:
             with self._metrics_lock:
                 if load is not None:
+                    # Gauge write gated on membership BEFORE the store:
+                    # a heartbeat that raced a deregister (instance check
+                    # passed, then the instance was dropped) must not
+                    # resurrect the removed gauge series.
+                    if name in self._load_metrics:
+                        INSTANCE_QUEUE_DEPTH.labels(instance=name).set(
+                            load.waiting_requests_num)
                     self._load_metrics[name] = load
                 if latency is not None:
                     self._latency_metrics[name] = latency
@@ -581,6 +612,21 @@ class InstanceMgr:
                 # Pre-first-token exit: only the SCHEDULE increments exist.
                 pl.num_prefill_requests = max(0, pl.num_prefill_requests - 1)
                 pl.num_prefill_tokens = max(0, pl.num_prefill_tokens - ntok)
+            # Gauge writes stay under _metrics_lock (leaf metric locks nest
+            # below it) so concurrent exits can't publish stale snapshots
+            # out of order. A DECODE_STEP changes neither request count —
+            # skip the churn. Gate on _load_metrics membership: exit
+            # accounting for a just-deregistered instance must not
+            # resurrect the gauge series deregister_instance removed.
+            if action != RequestAction.DECODE_STEP:
+                if pname in self._load_metrics:
+                    INSTANCE_INFLIGHT_REQUESTS.labels(
+                        instance=pname, phase="prefill").set(
+                        pl.num_prefill_requests)
+                if dname in self._load_metrics:
+                    INSTANCE_INFLIGHT_REQUESTS.labels(
+                        instance=dname, phase="decode").set(
+                        dl.num_decode_requests)
 
     def select_instance_pair_on_slo(self, req: Request) -> Routing:
         """SLO-aware pair selection with dynamic PD flipping (reference

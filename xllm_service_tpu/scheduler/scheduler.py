@@ -52,6 +52,7 @@ from ..common.request import (
     StatusCode,
     Usage,
 )
+from ..common.tracing import TRACER
 from ..common.types import (
     InstanceType,
     KvCacheEvent,
@@ -249,7 +250,7 @@ class Scheduler:
             stale = [st for st in self._requests.values()
                      if st.request.latest_generate_time_ms < deadline]
         for st in stale:
-            if not self._remove_request(st):
+            if not self._remove_request(st, error=(504, "request timed out")):
                 continue   # a concurrent path finished it first
             logger.warning("request %s timed out; cancelling",
                            st.request.service_request_id)
@@ -261,6 +262,36 @@ class Scheduler:
     # ------------------------------------------------------------- schedule
     def schedule(self, request: Request) -> Status:
         """Reference `scheduler.cpp:107-153`."""
+        own_root = False
+        if request.span is None:
+            # Direct-scheduler callers (tests, embedded use) get a root
+            # span here; the HTTP frontend normally created it already.
+            root = TRACER.start_span("frontend.request",
+                                     request_id=request.service_request_id,
+                                     origin="scheduler")
+            if root:
+                request.span = root
+                request.trace = root.context()
+                own_root = True
+        with TRACER.span("scheduler.schedule", ctx=request.trace,
+                         request_id=request.service_request_id,
+                         policy=self._opts.load_balance_policy) as sp:
+            status = self._schedule(request)
+            if status.ok():
+                sp.set(prefill=request.routing.prefill_name,
+                       decode=request.routing.decode_name,
+                       prompt_tokens=request.metrics.prompt_tokens)
+            else:
+                sp.set(error=status.message)
+        if not status.ok() and own_root:
+            # A failed schedule is never registered, so exit accounting
+            # will not end the root we created — end it here or the trace
+            # loses its frontend.request root. (The HTTP frontend does the
+            # same for roots it owns.)
+            request.span.end(f"ERROR: {status.code.name}")
+        return status
+
+    def _schedule(self, request: Request) -> Status:
         if request.messages and not request.prompt:
             try:
                 request.prompt = self.chat_template.apply(
@@ -406,20 +437,26 @@ class Scheduler:
         n_new = sum(len(s.token_ids) or (1 if s.text else 0)
                     for s in output.outputs)
         now = now_ms()
+        policy = self._opts.load_balance_policy
         if st.first_token_ms is None and n_new:
             st.first_token_ms = now
             if not req.metrics.prefill_finish_time_ms:
                 # Observe TTFT once per request: after a failover the
                 # prefill stage re-runs (accounting below must re-fire)
                 # but the client's TTFT already happened.
-                TTFT_MS.observe(now - req.created_time_ms)
+                TTFT_MS.labels(instance=req.routing.prefill_name or "none",
+                               policy=policy).observe(
+                    now - req.created_time_ms)
             req.prefill_stage_finished = True
             req.metrics.prefill_finish_time_ms = now
             self.instance_mgr.update_request_metrics(
                 req, RequestAction.FINISH_PREFILL, n_new=n_new)
         elif n_new:
             if st.last_token_ms is not None:
-                ITL_MS.observe(now - st.last_token_ms)
+                ITL_MS.labels(
+                    instance=(req.routing.decode_name
+                              or req.routing.prefill_name or "none"),
+                    policy=policy).observe(now - st.last_token_ms)
             self.instance_mgr.update_request_metrics(
                 req, RequestAction.DECODE_STEP, n_new=n_new)
         if n_new:
@@ -494,10 +531,14 @@ class Scheduler:
             finished_on_prefill=last.finished_on_prefill)
 
     def _remove_request(self, st: _RequestState,
-                        output: Optional[RequestOutput] = None) -> bool:
+                        output: Optional[RequestOutput] = None,
+                        error: Optional[tuple[int, str]] = None) -> bool:
         """Reference `finish_request` (`scheduler.cpp:416-441`). Idempotent:
         returns True only for the call that actually performed the exit
-        (callers gate their error/cancel side effects on it)."""
+        (callers gate their error/cancel side effects on it). `error`
+        stamps (code, message) onto the root span — inside the winning
+        exit's lock hold, so a failure path that loses the race against a
+        normal completion cannot relabel an already-recorded span."""
         with self._req_lock:
             self._requests.pop(st.request.service_request_id, None)
             if st.exited:
@@ -505,19 +546,23 @@ class Scheduler:
             st.exited = True
             st.finished = True
             st.request.metrics.finish_time_ms = now_ms()
+            if error is not None and st.request.span:
+                st.request.span.set(error=error[1], error_code=error[0])
+                st.request.span.status = f"ERROR: {error[0]}"
             self._account_request_exit(st.request)
         self._trace_spans(st)
         return True
 
     def _trace_spans(self, st: _RequestState) -> None:
-        """Per-request latency span breakdown, appended to the request
-        trace at exit (extends the reference's raw I/O JSONL with timing
-        the SLO predictor can be audited against)."""
+        """Close out the request's real root span (common/tracing.py) with
+        the per-stage latency breakdown and mirror the summary to the
+        request-trace JSONL (the reference's raw I/O JSONL gains timing the
+        SLO predictor can be audited against, now keyed by trace_id)."""
         r = st.request
-        if r.trace_callback is None:
-            return
+        if r.span is None and r.trace_callback is None:
+            return   # no trace consumer: skip building the summary
         m = r.metrics
-        spans = {
+        summary = {
             "type": "spans",
             "created_ms": r.created_time_ms,
             "schedule_delay_ms": (m.schedule_time_ms - r.created_time_ms)
@@ -532,9 +577,16 @@ class Scheduler:
             "generated_tokens": r.num_generated_tokens,
             "prefill_instance": r.routing.prefill_name,
             "decode_instance": r.routing.decode_name,
+            "failover_attempts": st.failover_attempts,
         }
+        if r.span:
+            summary["trace_id"] = r.span.trace_id
+            r.span.set(**{k: v for k, v in summary.items() if k != "type"})
+            r.span.end()
+        if r.trace_callback is None:
+            return
         try:
-            r.trace_callback(r.service_request_id, spans)
+            r.trace_callback(r.service_request_id, summary)
         except Exception:  # noqa: BLE001 — tracing must never break exit
             logger.exception("span trace emit failed")
 
@@ -665,7 +717,8 @@ class Scheduler:
                     break
                 st.failover_attempts += 1
                 attempt = st.failover_attempts
-            FAILOVER_ATTEMPTS_TOTAL.inc()
+            FAILOVER_ATTEMPTS_TOTAL.labels(
+                instance=dead_name or "dispatch-failure").inc()
             if st.conn.is_disconnected():
                 if self._remove_request(st):
                     logger.info("client of %s gone during failover",
@@ -710,16 +763,30 @@ class Scheduler:
                                   "decode_name": routing.decode_name,
                                   "encode_name": routing.encode_name}
             payload["failover_attempt"] = attempt
-            ch = self.instance_mgr.get_channel(routing.prefill_name)
-            if ch is None:
-                ok, err = False, "no channel"
-            else:
-                # Single-shot POST: replay is owned here, and the request
-                # was just re-bound, so a duplicate stream from an
-                # ambiguous failure is dropped by the incarnation guard.
-                ok, err = ch.forward(st.forward_path, payload)
+            # The re-dispatch rides under a failover span (same trace_id as
+            # the original incarnation): the replayed engine's spans parent
+            # here, so /admin/trace shows both incarnations in one tree.
+            with TRACER.span("scheduler.failover", ctx=req.trace,
+                             request_id=req.service_request_id,
+                             attempt=attempt, dead_instance=dead_name,
+                             target=routing.prefill_name,
+                             resumed_tokens=len(resume)) as fo:
+                fo_ctx = fo.context()
+                if fo_ctx is not None:
+                    payload["trace_context"] = fo_ctx.to_dict()
+                ch = self.instance_mgr.get_channel(routing.prefill_name)
+                if ch is None:
+                    ok, err = False, "no channel"
+                else:
+                    # Single-shot POST: replay is owned here, and the
+                    # request was just re-bound, so a duplicate stream from
+                    # an ambiguous failure is dropped by the incarnation
+                    # guard.
+                    ok, err = ch.forward(st.forward_path, payload)
+                fo.set(ok=ok)
             if ok:
-                FAILOVER_SUCCESS_TOTAL.inc()
+                FAILOVER_SUCCESS_TOTAL.labels(
+                    instance=routing.prefill_name).inc()
                 logger.info(
                     "request %s failed over to %s (attempt %d, resuming "
                     "after %d tokens)", req.service_request_id,
@@ -768,7 +835,7 @@ class Scheduler:
                          code: int = 503) -> None:
         """Cancel-and-surface terminal path (reference
         `scheduler.cpp:443-482`): exit accounting + client error."""
-        if not self._remove_request(st):
+        if not self._remove_request(st, error=(code, message)):
             return
         REQUESTS_CANCELLED_ON_FAILURE_TOTAL.inc()
         self._cancel_on_engines(st.request)

@@ -44,6 +44,8 @@ import asyncio
 
 from ..common.faults import FAULTS
 from ..common.hashing import prefix_block_hash_hexes
+from ..common import tracing as _tracing
+from ..common.tracing import TRACER, TraceContext
 from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
 from ..devtools.locks import make_lock
 from ..coordination.base import CoordinationClient
@@ -80,6 +82,9 @@ class FakeEngine:
         self.unlinks: list[str] = []
         self.cancelled: set[str] = set()
         self.accepted_requests: list[dict[str, Any]] = []
+        # Trace-propagation headers (x-xllm-*) seen on accepted requests —
+        # lets tests assert the RPC channel stamps them on the wire.
+        self.accepted_trace_headers: list[dict[str, str]] = []
         self.healthy = True
         self._alive = True
         self._paused = False
@@ -138,6 +143,12 @@ class FakeEngine:
         app.router.add_post("/rpc/unlink", self._h_unlink)
         app.router.add_post("/rpc/cancel", self._h_cancel)
         app.router.add_post("/rpc/flip_role", self._h_flip)
+        # Same per-process trace surface the real agent serves — useful
+        # when the fake engine runs out-of-process
+        # (examples/run_fake_engine.py).
+        app.router.add_get("/admin/trace", _tracing.handle_admin_trace)
+        app.router.add_get("/admin/trace/recent",
+                           _tracing.handle_admin_trace_recent)
 
         async def _start():
             self._runner = web.AppRunner(app)
@@ -254,6 +265,7 @@ class FakeEngine:
         self.instance_type = InstanceType.parse(body.get("type"))
         return web.json_response({"ok": True})
 
+
     async def _h_completion(self, req: web.Request) -> web.Response:
         return await self._accept(req, chat=False)
 
@@ -262,6 +274,16 @@ class FakeEngine:
 
     async def _accept(self, req: web.Request, chat: bool) -> web.Response:
         body = await req.json()
+        self.accepted_trace_headers.append(
+            {k.lower(): v for k, v in req.headers.items()
+             if k.lower().startswith("x-xllm-")})
+        # Header fallback: a control-plane forward (EngineChannel) carries
+        # the sender's active span as x-xllm-* headers; the enriched body
+        # key wins when both are present.
+        if "trace_context" not in body:
+            hctx = TraceContext.from_headers(req.headers)
+            if hctx is not None:
+                body["trace_context"] = hctx.to_dict()
         rule = FAULTS.fire("engine.accept", instance=self.name,
                            sid=body.get("service_request_id", ""))
         if rule is not None and rule.action == "error":
@@ -309,45 +331,68 @@ class FakeEngine:
             # just the terminal delta.
             chunks = chunks + [""]
             n += 1
-        for i in range(start, n):
-            chunk = chunks[i]
-            if sid in self.cancelled or not self._alive:
-                return
-            rule = FAULTS.fire("engine.token", instance=self.name,
-                               sid=sid, n=i)
-            if rule is not None and rule.action == "crash":
-                logger.info("fault: engine %s crashing before token %d "
-                            "of %s", self.name, i, sid)
-                self.kill()
-                return
-            if rule is not None and rule.action == "delay":
-                time.sleep(rule.delay_s)
-            last = i == n - 1
-            seq += 1
-            gen: dict[str, Any] = {
-                "request_id": body.get("request_id", sid),
-                "service_request_id": sid,
-                "status": {"code": 0, "message": ""},
-                "outputs": [{"index": 0, "text": chunk,
-                             "token_ids": [i] if i < total_tokens else [],
-                             "finish_reason": "stop" if last else "",
-                             "logprobs": []}],
-                "finished": last,
-                "delta_seq": seq,
-                "instance": self.name,
-                "incarnation": self.incarnation_id,
-            }
-            if last:
-                gen["usage"] = {"num_prompt_tokens": prompt_tokens,
-                                "num_generated_tokens": total_tokens}
-            try:
-                r = _requests.post(f"http://{source}/rpc/generations",
-                                   json={"gens": [gen]}, timeout=5)
-                alive = r.json().get("alive", {}).get(sid, True)
-                if not alive:
-                    return  # service told us to stop
-            except (_requests.RequestException, ValueError) as e:
-                logger.warning("fake engine: generations push failed: %s", e)
-                return
-            if self.cfg.delay_s and not last:
-                time.sleep(self.cfg.delay_s)
+        # Trace propagation: parent this engine's stage spans under the
+        # carried context (the frontend root, or the scheduler's failover
+        # span on a replayed dispatch). The MIX fake engine serves both
+        # stages in one process, so the PD KV-handoff hop is modeled as a
+        # zero-work span to keep traces shaped like the real pipeline.
+        ctx = TraceContext.from_dict(body.get("trace_context"))
+        # require_ctx: direct engine hits (no carried context) must not
+        # root orphan single-span traces.
+        span_kw: dict[str, Any] = dict(
+            ctx=ctx, require_ctx=True, request_id=sid, instance=self.name,
+            incarnation=self.incarnation_id)
+        with TRACER.span("engine.prefill", prompt_tokens=prompt_tokens,
+                         resumed_tokens=len(resume), **span_kw):
+            pass
+        with TRACER.span("kv_transfer.offer", simulated=True, **span_kw):
+            pass
+        with TRACER.span("engine.decode", **span_kw) as dsp:
+            for i in range(start, n):
+                chunk = chunks[i]
+                if sid in self.cancelled or not self._alive:
+                    dsp.end("CANCELLED")
+                    return
+                rule = FAULTS.fire("engine.token", instance=self.name,
+                                   sid=sid, n=i)
+                if rule is not None and rule.action == "crash":
+                    logger.info("fault: engine %s crashing before token %d "
+                                "of %s", self.name, i, sid)
+                    dsp.end("CRASHED")
+                    self.kill()
+                    return
+                if rule is not None and rule.action == "delay":
+                    time.sleep(rule.delay_s)
+                last = i == n - 1
+                seq += 1
+                gen: dict[str, Any] = {
+                    "request_id": body.get("request_id", sid),
+                    "service_request_id": sid,
+                    "status": {"code": 0, "message": ""},
+                    "outputs": [{"index": 0, "text": chunk,
+                                 "token_ids": [i] if i < total_tokens else [],
+                                 "finish_reason": "stop" if last else "",
+                                 "logprobs": []}],
+                    "finished": last,
+                    "delta_seq": seq,
+                    "instance": self.name,
+                    "incarnation": self.incarnation_id,
+                }
+                if last:
+                    gen["usage"] = {"num_prompt_tokens": prompt_tokens,
+                                    "num_generated_tokens": total_tokens}
+                try:
+                    r = _requests.post(f"http://{source}/rpc/generations",
+                                       json={"gens": [gen]}, timeout=5)
+                    alive = r.json().get("alive", {}).get(sid, True)
+                    if not alive:
+                        dsp.end("STOPPED")
+                        return  # service told us to stop
+                except (_requests.RequestException, ValueError) as e:
+                    logger.warning("fake engine: generations push failed: %s",
+                                   e)
+                    dsp.end("PUSH_FAILED")
+                    return
+                if self.cfg.delay_s and not last:
+                    time.sleep(self.cfg.delay_s)
+            dsp.set(generated_tokens=total_tokens - start)
