@@ -1,0 +1,258 @@
+"""Master hot-path micro-benchmark: isolates the master+wire span.
+
+BASELINE round 5 measured the master+wire TTFT span at 69-80 ms flat
+across load — 35-40% of the north-star p50 TTFT < 200 ms budget — but
+serve_bench can only derive it by subtraction (client TTFT minus the
+agent's accept→first-delta span), with real engine compute adding noise.
+This bench removes the engine entirely: a deployment-shaped multiproc
+stack (coordination server, master, fake engine — each its own OS
+process) where the fake engine replies instantly, so client TTFT ~=
+frontend parse + schedule (template/tokenize/route/bind) + dispatch wire
++ token-return wire + SSE emit. That IS the master+wire span, measured
+directly per stage.
+
+Per-stage attribution comes from the master's ``GET /admin/hotpath``
+(schedule / enrich / forward / first_delta p50s, recorded by the service
+with two perf_counter reads per stage — always on, no tracing needed).
+On trees without the endpoint (pre-PR-4) the bench still reports client
+percentiles, so before/after comparisons run the same driver.
+
+    python benchmarks/master_hotpath_bench.py --requests 256 --concurrency 8
+
+The tier-1 budget test (tests/test_master_hotpath_budget.py) runs
+``run_bench`` with a small workload and a generous ceiling to catch
+order-of-magnitude regressions without flaking on CI noise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import statistics
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import requests
+
+
+def percentile(xs, p):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    k = min(len(xs) - 1, int(round((p / 100) * (len(xs) - 1))))
+    return xs[k]
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ~1 KiB prompt -> 1024 token ids through the byte-level SimpleTokenizer:
+# the enriched dispatch payload carries a multi-thousand-byte token_ids
+# list, which is exactly the wire cost this bench exists to attribute.
+_PROMPT_WORD = "hotpath "
+
+
+def _make_prompt(n_chars: int) -> str:
+    return (_PROMPT_WORD * (n_chars // len(_PROMPT_WORD) + 1))[:n_chars]
+
+
+def drive(base: str, args) -> dict:
+    """Fire the streaming workload at the master and collect client-side
+    TTFT/E2E percentiles plus the master's per-stage span table."""
+    prompt = _make_prompt(args.prompt_chars)
+
+    # Warmup: prime connection pools, lazy imports, the schedule executor.
+    for _ in range(4):
+        requests.post(base + "/v1/completions", json={
+            "model": "fake-model", "prompt": prompt, "max_tokens": 4,
+            "stream": True}, timeout=30).close()
+
+    ttfts, e2es, errors = [], [], [0]
+    lock = threading.Lock()
+    work = list(range(args.requests))
+    rps = getattr(args, "rps", 0.0) or 0.0
+    pace_start = time.perf_counter() + 0.05
+
+    def worker():
+        session = requests.Session()
+        while True:
+            with lock:
+                if not work:
+                    return
+                k = work.pop()
+            if rps > 0:
+                # Paced (open-loop) mode: request k is DUE at a fixed wall
+                # slot, and latency is measured from the slot, not from
+                # the actual send — a tree that can't keep up accrues the
+                # queueing delay instead of hiding it (coordinated
+                # omission). k counts down; slots are order-insensitive.
+                due = pace_start + (args.requests - 1 - k) / rps
+                now = time.perf_counter()
+                if due > now:
+                    time.sleep(due - now)
+                t0 = due
+            else:
+                t0 = time.perf_counter()
+            try:
+                r = session.post(base + "/v1/completions", json={
+                    "model": "fake-model", "prompt": prompt,
+                    "max_tokens": args.max_tokens, "stream": True},
+                    stream=True, timeout=60)
+                ttft = None
+                for line in r.iter_lines():
+                    if not line.startswith(b"data: "):
+                        continue
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+                    if line == b"data: [DONE]":
+                        break
+                e2e = time.perf_counter() - t0
+                if ttft is None:
+                    raise RuntimeError("stream produced no deltas")
+                with lock:
+                    ttfts.append(ttft * 1000)
+                    e2es.append(e2e * 1000)
+            except Exception:  # noqa: BLE001 — counted, not fatal
+                with lock:
+                    errors[0] += 1
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker)
+               for _ in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+
+    report = {
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "prompt_chars": args.prompt_chars,
+        "max_tokens": args.max_tokens,
+        "offered_rps": rps or None,
+        "errors": errors[0],
+        "req_per_s": round(len(e2es) / wall, 1) if wall else 0.0,
+        "master_wire_ttft_ms": {
+            "p50": round(percentile(ttfts, 50), 2),
+            "p90": round(percentile(ttfts, 90), 2),
+            "p99": round(percentile(ttfts, 99), 2),
+            "mean": round(statistics.mean(ttfts), 2) if ttfts else 0.0,
+        },
+        "e2e_ms": {"p50": round(percentile(e2es, 50), 2),
+                   "p99": round(percentile(e2es, 99), 2)},
+    }
+    # Per-stage master span table (absent on pre-PR-4 trees: the client
+    # percentiles above still make the before/after comparison).
+    try:
+        r = requests.get(base + "/admin/hotpath", timeout=5)
+        if r.status_code == 200:
+            report["master_stages_ms"] = r.json().get("stages", {})
+    except requests.RequestException:
+        pass
+    return report
+
+
+def run_bench(requests_n: int = 256, concurrency: int = 8,
+              prompt_chars: int = 1024, max_tokens: int = 16,
+              reply_chars: int = 64, rps: float = 0.0) -> dict:
+    """Spawn the multiproc stack, drive it, tear it down. Importable for
+    the tier-1 budget test."""
+    args = argparse.Namespace(
+        requests=requests_n, concurrency=concurrency,
+        prompt_chars=prompt_chars, max_tokens=max_tokens, rps=rps)
+    coord_port, http_port, rpc_port = free_port(), free_port(), free_port()
+    procs: list[subprocess.Popen] = []
+    logdir = Path(os.environ.get("XLLM_BENCH_LOGDIR", "/tmp"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def spawn(name, cmd):
+        log = open(logdir / f"hotpath_bench_{name}.log", "w")
+        p = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                             cwd=str(REPO), env=env)
+        procs.append(p)
+        return p
+
+    try:
+        spawn("coord", [sys.executable, "-m",
+                        "xllm_service_tpu.coordination.server",
+                        "--port", str(coord_port)])
+        time.sleep(0.3)
+        spawn("master", [sys.executable, "-m", "xllm_service_tpu.master",
+                         "--coordination-addr", f"127.0.0.1:{coord_port}",
+                         "--host", "127.0.0.1",
+                         "--http-port", str(http_port),
+                         "--rpc-port", str(rpc_port)])
+        spawn("engine", [sys.executable,
+                         str(REPO / "examples" / "run_fake_engine.py"),
+                         "--coordination-addr", f"127.0.0.1:{coord_port}",
+                         "--reply", "x" * reply_chars,
+                         "--chunk-size", "4", "--delay", "0"])
+
+        base = f"http://127.0.0.1:{http_port}"
+        names = ("coord", "master", "engine")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            for name, p in zip(names, procs):
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"{name} process died rc={p.returncode} — see "
+                        f"{logdir}/hotpath_bench_{name}.log")
+            try:
+                r = requests.post(base + "/v1/completions", json={
+                    "model": "fake-model", "prompt": "ready?",
+                    "max_tokens": 2}, timeout=10)
+                if r.status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.25)
+        else:
+            raise RuntimeError("fake-engine cluster never became ready")
+        return drive(base, args)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=256)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--prompt-chars", type=int, default=1024,
+                    help="prompt length in bytes (byte-level tokenizer: "
+                         "== token_ids length on the dispatch wire)")
+    ap.add_argument("--max-tokens", type=int, default=16)
+    ap.add_argument("--reply-chars", type=int, default=64)
+    ap.add_argument("--rps", type=float, default=0.0,
+                    help="paced open-loop request rate (0 = closed-loop); "
+                         "paced TTFT is measured from the request's due "
+                         "slot, so queueing delay is counted, not hidden")
+    args = ap.parse_args()
+    report = run_bench(args.requests, args.concurrency, args.prompt_chars,
+                       args.max_tokens, args.reply_chars, args.rps)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -174,6 +174,18 @@ def drive(base: str, stats_url: str, args, vocab: int) -> dict:
             "engine_queue": spans["engine_queue_ms"],
             "engine_prefill": spans["engine_prefill_ms"],
         }
+    # Per-stage master span table (GET /admin/hotpath, always-on recorder):
+    # attributes the master+wire leg to schedule / enrich / forward /
+    # first_delta so future rounds can localize a regression without
+    # re-instrumenting.
+    try:
+        r = requests.get(base + "/admin/hotpath", timeout=10)
+        if r.status_code == 200:
+            stages = r.json().get("stages", {})
+            report["master_stages_ms"] = {
+                stage: row for stage, row in stages.items() if row.get("n")}
+    except requests.RequestException:
+        pass
     return report
 
 

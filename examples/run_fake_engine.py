@@ -29,12 +29,20 @@ def main() -> None:
                    choices=[t.value for t in InstanceType])
     p.add_argument("--reply", default="Hello from the fake engine!")
     p.add_argument("--model", default="fake-model")
+    p.add_argument("--chunk-size", type=int, default=4,
+                   help="characters per Generations delta")
+    p.add_argument("--delay", type=float, default=0.0,
+                   help="inter-delta delay in seconds (0 = instant; the "
+                        "hot-path bench uses 0 so client TTFT isolates "
+                        "the master+wire span)")
     args = p.parse_args()
 
     coord = connect(args.coordination_addr)
     engine = FakeEngine(coord, FakeEngineConfig(
         instance_type=InstanceType.parse(args.type),
-        models=[args.model], reply_text=args.reply)).start()
+        models=[args.model], reply_text=args.reply,
+        chunk_size=max(1, args.chunk_size), delay_s=max(0.0, args.delay))
+    ).start()
     print(f"fake engine {engine.name} ({args.type}) registered; Ctrl-C to stop",
           flush=True)
     stop = threading.Event()

@@ -1,0 +1,31 @@
+"""hot-json rule fixtures: hand-rolled JSON inside registered hot-path
+dispatch functions (registry in the sibling wire.py)."""
+
+import json
+
+import requests
+
+
+class HotDispatcher:
+    def forward_hot(self, url, payload):
+        body = json.dumps(payload)                    # violation: dumps ref
+        requests.post(url, json=payload, timeout=1)   # violation: json= kwarg
+        return body
+
+    def forward_hatched(self, url, payload):
+        dumps = json.dumps  # xlint: allow-hot-json(protocol JSON frames, not the dispatch wire)
+        return dumps(payload)
+
+    def unregistered_sibling(self, payload):
+        # Not in the registry: hand-rolled JSON is fine here.
+        return json.dumps(payload)
+
+
+def push_hot(url, payload):
+    dumps = json.dumps            # violation: alias laundering the encode
+    return dumps(payload)
+
+
+def bystander(payload):
+    # Module-level function not in the registry: quiet.
+    return json.dumps(payload)

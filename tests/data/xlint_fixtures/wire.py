@@ -1,0 +1,9 @@
+"""hot-json fixture registry (stands in for rpc/wire.py's
+HOT_PATH_FUNCTIONS — the rule keys on the file name)."""
+
+HOT_PATH_FUNCTIONS = {
+    "HotDispatcher.forward_hot": "dispatch wire with hand-rolled JSON",
+    "HotDispatcher.forward_hatched": "dispatch wire with a hatched encode",
+    "push_hot": "module-level hot function with a dumps alias",
+    "Ghost.never_defined": "stale registry entry (no such function)",
+}

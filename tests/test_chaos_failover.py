@@ -318,6 +318,43 @@ class TestIdempotentReplay:
             sched.stop()
 
 
+class TestMsgpackDispatchWire:
+    def test_failover_replay_byte_equivalent_on_binary_wire(self,
+                                                            duo_cluster):
+        """The dispatch wire is msgpack (both engines advertise it), and a
+        failover replay of the retained payload is byte-equivalent to the
+        first dispatch: decoding both wires and re-packing them minus the
+        failover-volatile keys (routing / trace_context / attempt /
+        resume prefix) yields identical bytes — deterministic encoding of
+        an identical retained payload."""
+        from xllm_service_tpu.rpc import wire
+
+        master, engines = duo_cluster
+        # Reject the first accept AFTER the body is read: the initial
+        # dispatch bounces off engine A and the failover layer replays
+        # the retained payload onto the survivor.
+        FAULTS.configure([dict(point="engine.accept", action="error",
+                               max_fires=1)], seed=SEED)
+        text, finishes = _stream_completion(master)
+        assert text == REPLY and finishes == ["stop"]
+
+        wires = [w for e in engines for w in e.accepted_wire]
+        assert len(wires) == 2
+        assert all(ctype == wire.MSGPACK_CONTENT_TYPE
+                   for ctype, _ in wires)
+        first, replay = (wire.unpack_dispatch(raw) for _, raw in wires)
+        assert replay["failover_attempt"] == 1
+        assert replay["resume_generated_token_ids"] == []
+        assert replay["token_ids"] == first["token_ids"]
+        volatile = ("routing", "trace_context", "failover_attempt",
+                    "resume_generated_token_ids")
+        core_first = {k: v for k, v in first.items() if k not in volatile}
+        core_replay = {k: v for k, v in replay.items() if k not in volatile}
+        assert wire.pack_dispatch(core_first) == \
+            wire.pack_dispatch(core_replay)
+        assert wait_until(lambda: _loads_zero(master), timeout=5)
+
+
 class TestFaultPlaneDeterminism:
     def test_same_seed_same_schedule(self):
         def draw(seed):

@@ -1,0 +1,121 @@
+"""Dispatch-wire unit tests (rpc/wire.py + channel negotiation).
+
+The master → engine hot wire is msgpack when the target advertises it
+(`InstanceMetaInfo.wire_formats`), JSON otherwise, with a 415-triggered
+demotion for engines behind a stale registration. Determinism of the
+binary encoding is load-bearing: the failover layer replays a retained
+payload, and the chaos drill asserts byte-equivalence with the first
+dispatch.
+"""
+
+import json
+
+import pytest
+
+from xllm_service_tpu.rpc import wire
+from xllm_service_tpu.rpc.channel import EngineChannel
+
+
+PAYLOAD = {
+    "model": "m",
+    "service_request_id": "sid-1",
+    "token_ids": list(range(2048)),
+    "sampling": {"max_tokens": 16, "temperature": 0.0},
+    "routing": {"prefill_name": "a:1", "decode_name": "b:2",
+                "encode_name": ""},
+}
+
+
+class TestWireCodec:
+    def test_msgpack_roundtrip(self):
+        data, ctype = wire.encode_dispatch(PAYLOAD, wire.WIRE_MSGPACK)
+        assert ctype == wire.MSGPACK_CONTENT_TYPE
+        assert wire.decode_body(ctype, data) == PAYLOAD
+
+    def test_json_roundtrip_compact(self):
+        data, ctype = wire.encode_dispatch(PAYLOAD, wire.WIRE_JSON)
+        assert ctype == wire.JSON_CONTENT_TYPE
+        assert b": " not in data.split(b'"token_ids"')[0]  # compact seps
+        assert wire.decode_body(ctype, data) == PAYLOAD
+        # Default format is JSON (legacy engines).
+        assert wire.encode_dispatch(PAYLOAD)[1] == wire.JSON_CONTENT_TYPE
+
+    def test_msgpack_encoding_deterministic(self):
+        a = wire.pack_dispatch(PAYLOAD)
+        b = wire.pack_dispatch(json.loads(json.dumps(PAYLOAD)))
+        c = wire.pack_dispatch(wire.unpack_dispatch(a))
+        assert a == b == c
+
+    def test_malformed_bodies_raise_valueerror(self):
+        with pytest.raises(ValueError):
+            wire.decode_body(wire.MSGPACK_CONTENT_TYPE, b"\xc1broken")
+        with pytest.raises(ValueError):
+            wire.decode_body(wire.JSON_CONTENT_TYPE, b"{nope")
+
+    def test_negotiate(self):
+        assert wire.negotiate(["msgpack", "json"]) == wire.WIRE_MSGPACK
+        assert wire.negotiate(["json"]) == wire.WIRE_JSON
+        assert wire.negotiate([]) == wire.WIRE_JSON
+        assert wire.negotiate(None) == wire.WIRE_JSON
+        assert wire.negotiate(123) == wire.WIRE_JSON   # garbage metadata
+
+
+class _Resp:
+    def __init__(self, status_code, body=b"{}"):
+        self.status_code = status_code
+        self.text = body.decode()
+
+    def json(self):
+        return json.loads(self.text)
+
+
+class _StubSession:
+    """Records (content-type, body) per POST; scripted status codes."""
+
+    def __init__(self, statuses):
+        self.statuses = list(statuses)
+        self.posts = []
+
+    def post(self, url, data=None, headers=None, timeout=None):
+        self.posts.append(((headers or {}).get("Content-Type"), data))
+        return _Resp(self.statuses.pop(0),
+                     b'{"ok": true}' if self.statuses or True else b"")
+
+    def close(self):
+        pass
+
+
+class TestChannelNegotiation:
+    def test_forward_demotes_on_415_and_resends_json(self):
+        ch = EngineChannel("e:1", retries=1)
+        ch._session = _StubSession([415, 200])
+        ch.wire_format = wire.WIRE_MSGPACK
+        ok, resp = ch.forward("/v1/completions", PAYLOAD)
+        assert ok
+        assert ch.wire_format == wire.WIRE_JSON
+        ctypes = [c for c, _ in ch._session.posts]
+        assert ctypes == [wire.MSGPACK_CONTENT_TYPE,
+                          wire.JSON_CONTENT_TYPE]
+        # Demotion sticks: the next forward goes straight to JSON.
+        ch._session.statuses = [200]
+        ch.forward("/v1/completions", PAYLOAD)
+        assert ch._session.posts[-1][0] == wire.JSON_CONTENT_TYPE
+
+    def test_forward_msgpack_when_negotiated(self):
+        ch = EngineChannel("e:1", retries=1)
+        ch._session = _StubSession([200])
+        ch.wire_format = wire.WIRE_MSGPACK
+        ok, _ = ch.forward("/v1/completions", PAYLOAD)
+        assert ok
+        ctype, data = ch._session.posts[0]
+        assert ctype == wire.MSGPACK_CONTENT_TYPE
+        assert wire.unpack_dispatch(data) == PAYLOAD
+
+    def test_non_415_failure_does_not_demote(self):
+        ch = EngineChannel("e:1", retries=1)
+        ch._session = _StubSession([503])
+        ch.wire_format = wire.WIRE_MSGPACK
+        ok, _ = ch.forward("/v1/completions", PAYLOAD)
+        assert not ok
+        assert ch.wire_format == wire.WIRE_MSGPACK
+        assert len(ch._session.posts) == 1   # single-shot, no blind retry

@@ -1,0 +1,44 @@
+"""Tier-1 master hot-path budget gate.
+
+Runs the fake-engine multiproc hot-path bench (coordination server,
+master, fake engine — real OS processes, zero model compute) with a small
+workload and a DELIBERATELY generous ceiling: the point is to catch an
+order-of-magnitude regression on the master+wire span (a blocking call
+sneaking onto the schedule path, a lost executor, a per-delta connect)
+without flaking on CI-box noise. Current p50 on a loaded 2-core container
+is ~15-40 ms; the ceiling is 10x that.
+"""
+
+import pytest
+
+from benchmarks.master_hotpath_bench import run_bench
+
+# Generous CI ceilings (ms): order-of-magnitude guards, not perf targets.
+TTFT_P50_CEILING_MS = 400.0
+STAGE_P50_CEILING_MS = 250.0
+
+
+@pytest.fixture(scope="module")
+def report():
+    return run_bench(requests_n=24, concurrency=2, prompt_chars=512,
+                     max_tokens=8, reply_chars=32)
+
+
+def test_master_hotpath_budget(report):
+    assert report["errors"] == 0, report
+    p50 = report["master_wire_ttft_ms"]["p50"]
+    assert p50 < TTFT_P50_CEILING_MS, (
+        f"master+wire TTFT p50 {p50:.1f} ms blew the CI budget "
+        f"({TTFT_P50_CEILING_MS} ms) — a blocking call or lost executor "
+        f"on the hot path? Run benchmarks/master_hotpath_bench.py and "
+        f"read the per-stage table.")
+
+
+def test_master_hotpath_stage_table(report):
+    stages = report.get("master_stages_ms")
+    assert stages, "master /admin/hotpath served no stage table"
+    for stage in ("schedule", "enrich", "forward", "first_delta"):
+        row = stages.get(stage)
+        assert row and row["n"] > 0, f"stage {stage} recorded no samples"
+        assert row["p50"] < STAGE_P50_CEILING_MS, (
+            f"stage {stage} p50 {row['p50']:.1f} ms blew the CI budget")

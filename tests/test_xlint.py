@@ -203,6 +203,31 @@ class TestMetricsRegistry:
                         "metrics_sites.py")) == 6
 
 
+# -------------------------------------------------------------------- hot-json
+class TestHotJson:
+    def test_dumps_reference_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "hot-json", "hot_sites.py",
+                    "json.dumps")
+
+    def test_json_kwarg_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "hot-json", "hot_sites.py",
+                    "json= kwarg")
+
+    def test_alias_laundering_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "hot-json", "hot_sites.py",
+                    "push_hot")
+
+    def test_stale_registry_entry_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "hot-json", "wire.py",
+                    "Ghost.never_defined")
+
+    def test_hatched_and_unregistered_quiet(self, fixture_violations):
+        # forward_hatched (hatch) + unregistered_sibling + bystander stay
+        # quiet: exactly the three deliberate site violations fire.
+        assert len(hits(fixture_violations, "hot-json",
+                        "hot_sites.py")) == 3
+
+
 # ---------------------------------------------------------------- broad-except
 class TestBroadExcept:
     def test_silent_swallow_flagged(self, fixture_violations):

@@ -85,6 +85,10 @@ class ServiceOptions:
     # --- request registry ---
     num_output_threads: int = 16      # per-request output-ordering lanes
     request_timeout_s: float = 600.0
+    # Dedicated bounded pool for Scheduler.schedule (template/tokenize/
+    # route/bind): isolates admission from the default executor, where it
+    # would queue behind generations ingest and failover backoff sleeps.
+    num_schedule_threads: int = 8
 
     def with_overrides(self, **kw) -> "ServiceOptions":
         return dataclasses.replace(self, **kw)

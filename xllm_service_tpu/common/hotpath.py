@@ -1,0 +1,75 @@
+"""Always-on per-stage latency recorder for the master hot path.
+
+The span-tracing plane (common/tracing.py) attributes individual requests
+but costs a ring insert per span and can be disabled; capacity planning
+needs a cheap aggregate that is ALWAYS there. This recorder is two
+``perf_counter`` reads and a bounded-deque append per stage (appends on
+``collections.deque`` are atomic under the GIL — no lock on the write
+path), served by ``GET /admin/hotpath`` on the master and read by
+serve_bench / master_hotpath_bench for their per-stage tables.
+
+Stages (the four legs of the client-observed master+wire TTFT span):
+
+========== ==========================================================
+stage      measures
+========== ==========================================================
+schedule   executor hop + Scheduler.schedule (template/tokenize/route/
+           bind) — sub-attributed by tracing spans when enabled
+enrich     dispatch payload build + wire serialization
+forward    engine dispatch POST (accept round trip)
+first_delta engine accept -> first Generations delta ingested
+========== ==========================================================
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any
+
+#: Stage names in hot-path order (the admin endpoint and the bench tables
+#: render in this order).
+STAGES = ("schedule", "enrich", "forward", "first_delta")
+
+_WINDOW = 2048   # per-stage sample window (bounded memory, recent view)
+
+
+class HotpathRecorder:
+    """Bounded per-stage sample windows with percentile summaries."""
+
+    def __init__(self, window: int = _WINDOW):
+        self._samples: dict[str, deque] = {
+            s: deque(maxlen=window) for s in STAGES}
+
+    def record(self, stage: str, ms: float) -> None:
+        q = self._samples.get(stage)
+        if q is not None:
+            q.append(ms)
+
+    @staticmethod
+    def _pct(xs: list, p: float) -> float:
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        k = min(len(xs) - 1, int(round((p / 100) * (len(xs) - 1))))
+        return xs[k]
+
+    def summary(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for stage in STAGES:
+            xs = list(self._samples[stage])
+            out[stage] = {
+                "n": len(xs),
+                "p50": round(self._pct(xs, 50), 3),
+                "p90": round(self._pct(xs, 90), 3),
+                "p99": round(self._pct(xs, 99), 3),
+            }
+        return out
+
+    def clear(self) -> None:
+        for q in self._samples.values():
+            q.clear()
+
+
+#: Process-global recorder (the master is one process; the engine agent
+#: has its own ttft_spans surface).
+HOTPATH = HotpathRecorder()

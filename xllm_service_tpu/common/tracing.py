@@ -54,6 +54,12 @@ SPAN_POINTS: dict[str, str] = {
                         "direct-scheduler callers)",
     "scheduler.schedule": "scheduler dispatch: template + tokenize + route "
                           "+ incarnation bind",
+    "scheduler.template": "chat-template apply sub-stage of schedule",
+    "scheduler.tokenize": "prompt tokenization sub-stage of schedule",
+    "scheduler.route": "LB-policy pair selection sub-stage of schedule "
+                       "(lock-free routing-snapshot read)",
+    "scheduler.bind": "incarnation bind + RCU re-validation sub-stage of "
+                      "schedule",
     "scheduler.failover": "one transparent-failover re-dispatch attempt "
                           "(PR 1); children are the replayed engine spans",
     "engine.prefill": "engine-side prefill stage (accept -> first delta)",

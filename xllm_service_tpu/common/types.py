@@ -141,6 +141,11 @@ class InstanceMetaInfo:
     # fitted by TimePredictor at registration.
     ttft_profiling_data: list[list[float]] = field(default_factory=list)
     tpot_profiling_data: list[list[float]] = field(default_factory=list)
+    # Dispatch-wire formats this engine accepts, preference-ordered
+    # (rpc/wire.py). Current builds advertise ["msgpack", "json"]; legacy
+    # metadata without the field defaults to JSON-only, so the master
+    # never sends binary to an engine that can't parse it.
+    wire_formats: list[str] = field(default_factory=lambda: ["json"])
     # Graceful shutdown: a draining instance stays registered (in-flight
     # streams finish) but is excluded from scheduling.
     draining: bool = False

@@ -26,6 +26,11 @@ rule id                    invariant
                            ``common/metrics.py`` and none is dead; labeled
                            instruments are written only via ``.labels(...)``
                            with exactly the declared labelnames
+``hot-json``               functions registered in ``rpc/wire.py``'s
+                           ``HOT_PATH_FUNCTIONS`` contain no hand-rolled
+                           ``json.dumps``/``json=`` encoding — dispatch
+                           bytes come from ``rpc.wire``; stale registry
+                           entries are violations too
 ``broad-except``           no bare ``except:`` anywhere; in scheduler/rpc/
                            coordination/engine paths every ``except
                            Exception`` handler logs or re-raises
@@ -39,6 +44,7 @@ Escape hatches are inline comments with a mandatory reason::
     # xlint: allow-bare-acquire(reason)
     # xlint: allow-lock-annotation(reason)
     # xlint: allow-span-point(reason)
+    # xlint: allow-hot-json(reason)
 
 Run: ``python -m xllm_service_tpu.devtools.xlint xllm_service_tpu``
 (exit 0 = clean, 1 = violations, 2 = usage/parse error).
@@ -57,7 +63,7 @@ _SUPPRESS_RE = re.compile(r"#\s*xlint:\s*allow-([a-z-]+)\(([^)]*)\)")
 #: Rule tokens accepted in suppression comments.
 SUPPRESSIBLE = {
     "broad-except", "blocking-under-lock", "lock-order", "bare-acquire",
-    "lock-annotation", "local-lock", "span-point",
+    "lock-annotation", "local-lock", "span-point", "hot-json",
 }
 
 

@@ -91,6 +91,31 @@ def _first_str_arg(call: ast.Call) -> Optional[str]:
     return None
 
 
+def _registry_dict(f: SourceFile, name: str) -> dict[str, int]:
+    """Top-level ``NAME = {...}`` / ``NAME: dict[...] = {...}`` string-key
+    registry → {key: lineno}. Handling AnnAssign matters: the real
+    registries are type-annotated, and an Assign-only parse silently turns
+    the whole rule into a no-op (which is exactly what happened to the
+    fault/span rules between their landing and this helper)."""
+    out: dict[str, int] = {}
+    for node in f.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if not isinstance(value, ast.Dict) or not any(
+                isinstance(t, ast.Name) and t.id == name for t in targets):
+            continue
+        for k in value.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out[k.value] = k.lineno
+    return out
+
+
 # ------------------------------------------------------------- project model
 @dataclass
 class LockDecl:
@@ -529,15 +554,9 @@ def rule_fault_point(project: Project) -> list[Violation]:
     for f in project.files:
         if f.path.name != "faults.py":
             continue
-        for node in f.tree.body:
-            if isinstance(node, ast.Assign) and any(
-                    isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
-                    for t in node.targets) \
-                    and isinstance(node.value, ast.Dict):
-                for k in node.value.keys:
-                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
-                        registry[k.value] = k.lineno
-                reg_file = f
+        found = _registry_dict(f, "FAULT_POINTS")
+        if found:
+            registry, reg_file = found, f
     if reg_file is None:
         return []   # partial tree (e.g. fixture subset without a registry)
 
@@ -727,15 +746,9 @@ def rule_span_point(project: Project) -> list[Violation]:
     for f in project.files:
         if f.path.name != "tracing.py":
             continue
-        for node in f.tree.body:
-            if isinstance(node, ast.Assign) and any(
-                    isinstance(t, ast.Name) and t.id == "SPAN_POINTS"
-                    for t in node.targets) \
-                    and isinstance(node.value, ast.Dict):
-                for k in node.value.keys:
-                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
-                        registry[k.value] = k.lineno
-                reg_file = f
+        found = _registry_dict(f, "SPAN_POINTS")
+        if found:
+            registry, reg_file = found, f
     if reg_file is None:
         return []   # partial tree (e.g. fixture subset without a registry)
 
@@ -772,6 +785,63 @@ def rule_span_point(project: Project) -> list[Violation]:
                 "span-point", reg_file.rel, line,
                 f"registered span point {point!r} has no call site "
                 f"(dead span point)"))
+    return out
+
+
+# ----------------------------------------------------------------- hot json
+def rule_hot_json(project: Project) -> list[Violation]:
+    """Hot-path dispatch sites must not hand-roll JSON. ``rpc/wire.py``
+    registers the hot functions (``HOT_PATH_FUNCTIONS``: "Class.method" or
+    a bare module-level function name); inside each, ``json.dumps(...)``
+    calls and ``json=`` kwargs (requests/aiohttp implicit JSON encoding)
+    are violations — encode through ``rpc.wire`` so the wire format stays
+    negotiated in one place (hatch: ``# xlint: allow-hot-json(reason)``).
+    Bidirectional: a registered name with no matching function is a
+    violation too (stale registry)."""
+    registry: dict[str, int] = {}
+    reg_file: Optional[SourceFile] = None
+    for f in project.files:
+        if f.path.name != "wire.py":
+            continue
+        found = _registry_dict(f, "HOT_PATH_FUNCTIONS")
+        if found:
+            registry, reg_file = found, f
+    if reg_file is None:
+        return []   # partial tree (e.g. fixture subset without a registry)
+
+    out: list[Violation] = []
+    found: set[str] = set()
+    for f in project.files:
+        for cls_name, fn in _iter_functions(f):
+            qual = f"{cls_name}.{fn.name}" if cls_name else fn.name
+            if qual not in registry:
+                continue
+            found.add(qual)
+            for node in ast.walk(fn):
+                why = None
+                # Any json.dumps REFERENCE (call or alias like
+                # `dumps = json.dumps`) — an alias would otherwise launder
+                # the encode past a call-only check.
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "dumps" \
+                        and _expr_text(node.value) \
+                        .rsplit(".", 1)[-1] == "json":
+                    why = "json.dumps"
+                elif isinstance(node, ast.Call) \
+                        and any(kw.arg == "json" for kw in node.keywords):
+                    why = "json= kwarg (implicit JSON encode)"
+                if why is not None and not f.allowed("hot-json", node.lineno):
+                    out.append(Violation(
+                        "hot-json", f.rel, node.lineno,
+                        f"{qual}: {why} on a registered hot dispatch path "
+                        f"— encode via rpc.wire (or hatch with "
+                        f"'# xlint: allow-hot-json(reason)')"))
+    for qual, line in sorted(registry.items()):
+        if qual not in found:
+            out.append(Violation(
+                "hot-json", reg_file.rel, line,
+                f"registered hot-path function {qual!r} has no matching "
+                f"function in the tree (stale registry entry)"))
     return out
 
 
@@ -836,5 +906,6 @@ ALL_RULES = (
     rule_fault_point,
     rule_span_point,
     rule_metrics_registry,
+    rule_hot_json,
     rule_broad_except,
 )

@@ -39,6 +39,7 @@ from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
 from ..devtools.locks import make_lock
 from ..coordination import CoordinationClient, connect
 from ..rpc import MASTER_KEY, instance_key
+from ..rpc import wire as dispatch_wire
 from ..chat_template import MM_PLACEHOLDER, JinjaChatTemplate
 from ..tokenizer import TokenizerFactory
 from ..utils import get_local_ip, get_logger, pick_free_port
@@ -487,6 +488,11 @@ class EngineAgent:
             incarnation_id=self.incarnation_id,
             register_ts_ms=int(time.time() * 1000),
             models=[self.cfg.model_id],
+            # Dispatch-wire negotiation: this build parses msgpack on the
+            # enriched accept endpoints, making the hot wire symmetric
+            # with the (already-msgpack) Generations return wire.
+            wire_formats=[dispatch_wire.WIRE_MSGPACK,
+                          dispatch_wire.WIRE_JSON],
             # Latency tables for the SLO predictor, fit from this engine's
             # own measured traffic (conservative defaults until warm) —
             # refreshed on every heartbeat re-registration so the
@@ -824,9 +830,16 @@ class EngineAgent:
     async def _accept(self, req: web.Request, chat: bool) -> web.Response:
         t_recv = time.monotonic()
         try:
-            body = await req.json()
-        except json.JSONDecodeError:
-            return web.json_response({"error": "invalid JSON"}, status=400)
+            # Negotiated dispatch wire: msgpack (current masters) or JSON
+            # (legacy masters, direct curl) by Content-Type.
+            body = dispatch_wire.decode_body(req.content_type,
+                                             await req.read())
+        except ValueError:
+            return web.json_response({"error": "invalid request body"},
+                                     status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be an object"},
+                                     status=400)
         sid = body.get("service_request_id") or f"local-{uuid.uuid4().hex[:8]}"
         source = body.get("source_service_addr", "")
         token_ids = list(body.get("token_ids") or ())

@@ -30,22 +30,33 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import time
 from typing import Any, Optional
 
 import aiohttp
 from aiohttp import web
 
+from ..common.hotpath import HOTPATH
 from ..common.metrics import REGISTRY, SERVER_REQUEST_IN_TOTAL
 from ..common.request import Request, RequestOutput, SamplingParams
 from ..common import tracing
 from ..common.tracing import TRACER
 from ..common.types import InstanceType
+from ..rpc import wire
 from ..scheduler.scheduler import Scheduler
 from ..utils import generate_service_request_id, get_logger, short_uuid
 from .connection import AioConnection
 from .request_tracer import RequestTracer
 
 logger = get_logger(__name__)
+
+# Preserialized SSE frame pieces: the emit loop is per-delta hot, so the
+# constant bytes are built once, and delta JSON uses compact separators
+# (identical parse, fewer bytes, faster dumps).
+_DATA_PREFIX = b"data: "
+_FRAME_SEP = b"\n\n"
+_DONE_FRAME = b"data: [DONE]\n\n"
+_COMPACT = (",", ":")
 
 
 def _num(body: dict[str, Any], key: str, default, cast):
@@ -139,6 +150,7 @@ class XllmHttpService:
         app.router.add_get("/admin/config", self.handle_get_config)
         app.router.add_post("/admin/config", self.handle_set_config)
         app.router.add_get("/admin/planner", self.handle_planner)
+        app.router.add_get("/admin/hotpath", self.handle_hotpath)
         app.router.add_get("/admin/faults", self.handle_get_faults)
         app.router.add_post("/admin/faults", self.handle_set_faults)
         # Span-trace query surface (shared handlers; each process serves
@@ -248,8 +260,10 @@ class XllmHttpService:
             self.tracer.log(req.service_request_id, {"request": body})
         self._start_root_span(req, "anthropic")
 
+        t0 = time.perf_counter()
         status = await asyncio.get_running_loop().run_in_executor(
-            None, self.scheduler.schedule, req)
+            self.scheduler.schedule_executor, self.scheduler.schedule, req)
+        HOTPATH.record("schedule", (time.perf_counter() - t0) * 1000)
         if not status.ok():
             if req.span:
                 req.span.end(f"ERROR: {status.code.name}")
@@ -354,9 +368,13 @@ class XllmHttpService:
             self.tracer.log(req.service_request_id, {"request": body})
         self._start_root_span(req, kind)
 
-        # Schedule (tokenize + route) off the event loop — CPU-bound.
+        # Schedule (tokenize + route) off the event loop — CPU-bound, on
+        # the dedicated bounded pool so admission never queues behind
+        # generations ingest or failover backoff sleeps.
+        t0 = time.perf_counter()
         status = await asyncio.get_running_loop().run_in_executor(
-            None, self.scheduler.schedule, req)
+            self.scheduler.schedule_executor, self.scheduler.schedule, req)
+        HOTPATH.record("schedule", (time.perf_counter() - t0) * 1000)
         if not status.ok():
             if req.span:
                 req.span.end(f"ERROR: {status.code.name}")
@@ -370,7 +388,11 @@ class XllmHttpService:
         # Enrich + forward to the prefill instance, fire-and-forget
         # (reference `service.cpp:222-260,485-493`). The enriched payload
         # is also retained with the request registration so the failover
-        # layer can replay it on a surviving instance.
+        # layer can replay it on a surviving instance; the wire bytes are
+        # preserialized HERE, once, in the instance's negotiated format
+        # (msgpack for current engines — token_ids is a multi-thousand-int
+        # list; JSON-encoding it per request was a measured hot-path cost).
+        t1 = time.perf_counter()
         enriched = dict(body)
         enriched["service_request_id"] = req.service_request_id
         enriched["source_service_addr"] = self.scheduler.self_addr
@@ -381,24 +403,54 @@ class XllmHttpService:
         if req.trace is not None:
             enriched["trace_context"] = req.trace.to_dict()
         path = "/v1/chat/completions" if kind == "chat" else "/v1/completions"
+        wire_body, wire_ctype = wire.encode_dispatch(
+            enriched, self.scheduler.dispatch_wire(req.routing.prefill_name))
+        HOTPATH.record("enrich", (time.perf_counter() - t1) * 1000)
         self.scheduler.record_new_request(req, conn, kind,
                                           forward_path=path,
                                           forward_payload=enriched)
         task = asyncio.create_task(
-            self._forward_to_instance(req, conn, path, enriched))
+            self._forward_to_instance(req, conn, path, enriched,
+                                      wire_body, wire_ctype))
         self._forward_tasks.add(task)
         task.add_done_callback(self._forward_tasks.discard)
 
         return await self._respond(http_req, req, conn)
 
     async def _forward_to_instance(self, req: Request, conn: AioConnection,
-                                   path: str, payload: dict[str, Any]) -> None:
+                                   path: str, payload: dict[str, Any],
+                                   body: Optional[bytes] = None,
+                                   ctype: str = wire.JSON_CONTENT_TYPE) -> None:
         url = f"http://{req.routing.prefill_name}{path}"
+        if body is None:
+            body, ctype = wire.encode_dispatch(payload)
         retryable, code = True, 503
         try:
             assert self._client is not None
-            async with self._client.post(url, json=payload) as resp:
-                if resp.status != 200:
+            t0 = time.perf_counter()
+            async with self._client.post(
+                    url, data=body,
+                    headers={"Content-Type": ctype}) as resp:
+                if resp.status == 415 \
+                        and ctype == wire.MSGPACK_CONTENT_TYPE:
+                    # Legacy engine behind a stale registration: negotiate
+                    # down to JSON for this instance and re-send. A 415
+                    # rejection cannot have started generation, so the
+                    # re-send is safe on this non-idempotent wire.
+                    self.scheduler.instance_mgr.demote_wire(
+                        req.routing.prefill_name)
+                    body, ctype = wire.encode_dispatch(payload)
+                    async with self._client.post(
+                            url, data=body,
+                            headers={"Content-Type": ctype}) as retry:
+                        if retry.status != 200:
+                            text = await retry.text()
+                            if 400 <= retry.status < 500:
+                                retryable, code = False, retry.status
+                            raise RuntimeError(
+                                f"engine returned {retry.status}: "
+                                f"{text[:200]}")
+                elif resp.status != 200:
                     text = await resp.text()
                     # 4xx = the engine deliberately rejected the request
                     # (client error): another instance would reject it the
@@ -406,6 +458,8 @@ class XllmHttpService:
                     if 400 <= resp.status < 500:
                         retryable, code = False, resp.status
                     raise RuntimeError(f"engine returned {resp.status}: {text[:200]}")
+            HOTPATH.record("forward", (time.perf_counter() - t0) * 1000)
+            self.scheduler.mark_dispatch_complete(req)
         except Exception as e:  # noqa: BLE001 — surface any forward failure
             logger.warning("forward of %s to %s failed: %s",
                            req.service_request_id, url, e)
@@ -426,30 +480,48 @@ class XllmHttpService:
             resp.headers["Cache-Control"] = "no-cache"
             resp.headers["Connection"] = "keep-alive"
             await resp.prepare(http_req)
+            # Coalesced emit: one blocking queue get, then drain whatever
+            # else is already queued and flush ALL frames in one write()
+            # — an engine delta batch (several tokens per Generations
+            # POST) costs one event-loop write instead of one per chunk.
+            dumps = json.dumps  # xlint: allow-hot-json(SSE frames are client-protocol JSON, not the negotiated dispatch wire)
+            buf = bytearray()
+            done = False
             try:
-                while True:
-                    tag, item = await asyncio.wait_for(conn.queue.get(), timeout)
-                    if AioConnection.is_finish(tag):
-                        if emit_done:   # OpenAI framing; Anthropic streams
-                            await resp.write(b"data: [DONE]\n\n")
-                        break
-                    if tag == "error":
-                        code, msg = item
-                        await resp.write(
-                            b"data: " + json.dumps(
-                                {"error": {"message": msg, "code": code}}
-                            ).encode() + b"\n\n")
-                        break
-                    if tag == "event":
-                        name, obj = item
-                        await resp.write(
-                            f"event: {name}\n".encode() +
-                            b"data: " + json.dumps(
-                                obj, ensure_ascii=False).encode() + b"\n\n")
-                        continue
-                    await resp.write(
-                        b"data: " + json.dumps(item, ensure_ascii=False).encode()
-                        + b"\n\n")
+                while not done:
+                    tag, item = await asyncio.wait_for(conn.queue.get(),
+                                                       timeout)
+                    while True:
+                        if AioConnection.is_finish(tag):
+                            if emit_done:  # OpenAI framing; Anthropic streams
+                                buf += _DONE_FRAME
+                            done = True
+                        elif tag == "error":
+                            code, msg = item
+                            buf += _DATA_PREFIX + dumps(
+                                {"error": {"message": msg, "code": code}},
+                                separators=_COMPACT).encode() + _FRAME_SEP
+                            done = True
+                        elif tag == "event":
+                            name, obj = item
+                            buf += (f"event: {name}\n".encode()
+                                    + _DATA_PREFIX
+                                    + dumps(obj, ensure_ascii=False,
+                                            separators=_COMPACT).encode()
+                                    + _FRAME_SEP)
+                        else:
+                            buf += _DATA_PREFIX + dumps(
+                                item, ensure_ascii=False,
+                                separators=_COMPACT).encode() + _FRAME_SEP
+                        if done:
+                            break
+                        try:
+                            tag, item = conn.queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                    if buf:
+                        await resp.write(bytes(buf))
+                        buf.clear()
             except (asyncio.TimeoutError, ConnectionResetError, OSError):
                 conn.mark_disconnected()
             except asyncio.CancelledError:
@@ -557,6 +629,13 @@ class XllmHttpService:
             return web.json_response({"decision": None})
         return web.json_response({"decision": dataclasses.asdict(d)})
 
+    async def handle_hotpath(self, request: web.Request) -> web.Response:
+        """Per-stage master hot-path latency table (always-on recorder,
+        common/hotpath.py): schedule / enrich / forward / first_delta
+        percentiles over the recent sample window. serve_bench and
+        master_hotpath_bench read this for their attribution tables."""
+        return web.json_response({"stages": HOTPATH.summary()})
+
     async def handle_get_faults(self, request: web.Request) -> web.Response:
         """Inspect the deterministic fault-injection plane (rules + hit/fire
         counters)."""
@@ -637,13 +716,10 @@ class XllmHttpService:
         """
         body = await request.read()
         try:
-            if request.content_type == "application/msgpack":
-                import msgpack
-
-                payload = msgpack.unpackb(body, raw=False)
-            else:
-                payload = json.loads(body)
-        except Exception:  # noqa: BLE001 — malformed body
+            payload = wire.decode_body(request.content_type, body)
+        except ValueError:
+            return _error_response(400, "invalid payload")
+        if not isinstance(payload, dict):
             return _error_response(400, "invalid payload")
 
         def ingest_batch() -> dict[str, bool]:
@@ -654,8 +730,18 @@ class XllmHttpService:
                     self.scheduler.handle_generation(out)
             return results
 
-        results = await asyncio.get_running_loop().run_in_executor(
-            None, ingest_batch)
+        gens = payload.get("gens", ())
+        if len(gens) <= 32:
+            # Small batch: ingest inline. handle_generation is dict work
+            # under a short lock hold (formatting/SSE rides the output
+            # lanes, not this handler) — an executor hop per batch costs
+            # a thread wake on the first-token path for no protection.
+            results = ingest_batch()
+        else:
+            # Big batch (engine catch-up after a stall): keep the loop
+            # responsive, take the one executor hop.
+            results = await asyncio.get_running_loop().run_in_executor(
+                None, ingest_batch)
         return web.json_response({"ok": True, "alive": results})
 
     async def handle_instance_info(self, request: web.Request) -> web.Response:

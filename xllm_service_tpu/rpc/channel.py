@@ -21,16 +21,19 @@ so chaos drills can script drops, delays and errors deterministically.
 from __future__ import annotations
 
 import json
+import socket
 import time
 from typing import Any, Optional
 
 import requests
+from requests.adapters import HTTPAdapter
 
 from ..common import tracing
 from ..common.faults import FAULTS, FaultInjected
 from ..common.metrics import RPC_RETRIES_TOTAL
 from ..common.types import InstanceMetaInfo
 from ..utils import get_logger, jittered_backoff
+from . import wire
 
 logger = get_logger(__name__)
 
@@ -38,6 +41,33 @@ DEFAULT_TIMEOUT_S = 5.0
 DEFAULT_RETRIES = 3
 DEFAULT_BACKOFF_BASE_S = 0.05
 DEFAULT_BACKOFF_MAX_S = 1.0
+
+
+class _KeepaliveAdapter(HTTPAdapter):
+    """Transport adapter enabling TCP keepalive on pooled connections: a
+    channel idles between control-plane calls (heartbeat gaps, quiet
+    fleets), and a silently dropped NAT/conntrack mapping otherwise
+    surfaces as a full connect+retry on the NEXT call — paid by a live
+    request (failover replay, cancellation)."""
+
+    _SOCKET_OPTIONS = [(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)]
+    # Aggressive-but-sane probe timings where the platform exposes them.
+    if hasattr(socket, "TCP_KEEPIDLE"):
+        _SOCKET_OPTIONS += [
+            (socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, 30),
+            (socket.IPPROTO_TCP, socket.TCP_KEEPINTVL, 10),
+            (socket.IPPROTO_TCP, socket.TCP_KEEPCNT, 3),
+        ]
+
+    def init_poolmanager(self, *args, **kwargs):
+        from urllib3.connection import HTTPConnection
+
+        # EXTEND the urllib3 defaults — replacing them would silently
+        # drop TCP_NODELAY and re-enable Nagle on every channel RPC.
+        kwargs["socket_options"] = (
+            list(HTTPConnection.default_socket_options)
+            + list(self._SOCKET_OPTIONS))
+        return super().init_poolmanager(*args, **kwargs)
 
 
 class EngineChannel:
@@ -55,7 +85,12 @@ class EngineChannel:
         self.retries = max(1, retries)
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
+        # Negotiated dispatch-wire format for `forward` (InstanceMgr sets
+        # this from the instance's advertised wire_formats at
+        # registration; 415 responses demote it back to JSON).
+        self.wire_format = wire.WIRE_JSON
         self._session = requests.Session()
+        self._session.mount("http://", _KeepaliveAdapter())
 
     @classmethod
     def from_options(cls, name: str, options: Any) -> "EngineChannel":
@@ -72,19 +107,22 @@ class EngineChannel:
 
     def _post(self, path: str, payload: dict[str, Any],
               timeout_s: Optional[float] = None,
-              retries: Optional[int] = None) -> tuple[bool, Any]:
+              retries: Optional[int] = None,
+              fmt: str = wire.WIRE_JSON) -> tuple[bool, Any]:
         attempts = self.retries if retries is None else max(1, retries)
         err: Any = None
+        data, ctype = wire.encode_dispatch(payload, fmt)
         # Trace propagation: the calling thread's active span rides the
         # wire as headers ({} almost always — one thread-local read).
-        headers = tracing.current_headers() or None
+        headers = dict(tracing.current_headers())
+        headers["Content-Type"] = ctype
         for attempt in range(attempts):
             if attempt:
                 RPC_RETRIES_TOTAL.labels(instance=self.name).inc()
                 self._sleep_backoff(attempt - 1)
             try:
                 FAULTS.check("rpc.post", instance=self.name, path=path)
-                r = self._session.post(self.base_url + path, json=payload,
+                r = self._session.post(self.base_url + path, data=data,
                                        headers=headers,
                                        timeout=timeout_s or self.timeout_s)
                 if r.status_code == 200:
@@ -115,14 +153,21 @@ class EngineChannel:
                 if r.status_code == 200:
                     try:
                         return True, r.json()
-                    except json.JSONDecodeError:
-                        return True, r.text
+                    except ValueError:  # same contract as _post: a non-JSON
+                        return True, r.text   # 200 body is a success payload
                 err = f"HTTP {r.status_code}"
             except FaultInjected as e:
                 err = str(e)
             except requests.RequestException as e:
                 err = str(e)
         return False, err
+
+    def warm_up(self, timeout_s: float = 2.0) -> bool:
+        """Prime the connection pool (TCP + keepalive handshake) so the
+        FIRST real call on this channel doesn't pay connection setup.
+        Best-effort: registration proceeds either way."""
+        ok, _ = self._get("/health", timeout_s=timeout_s, retries=1)
+        return ok
 
     # ---- control plane -----------------------------------------------------
     def health(self, timeout_s: float = 1.0) -> bool:
@@ -169,8 +214,21 @@ class EngineChannel:
         """Single-shot by design: a generation forward is NOT idempotent.
         An ambiguous failure (reset after send) may already be generating;
         blind retry would double-submit. The failover layer owns replay —
-        it rebinds incarnations first so any duplicate stream is dropped."""
-        return self._post(path, payload, retries=1)
+        it rebinds incarnations first so any duplicate stream is dropped.
+
+        Rides the negotiated dispatch wire (msgpack for current engines).
+        A 415 demotes the channel to JSON and re-sends once — a 415
+        rejection cannot have started generation, so this is the one safe
+        retry on this wire."""
+        ok, resp = self._post(path, payload, retries=1,
+                              fmt=self.wire_format)
+        if not ok and self.wire_format != wire.WIRE_JSON \
+                and isinstance(resp, str) and resp.startswith("HTTP 415"):
+            logger.warning("engine %s rejected msgpack dispatch; demoting "
+                           "channel to JSON wire", self.name)
+            self.wire_format = wire.WIRE_JSON
+            ok, resp = self._post(path, payload, retries=1)
+        return ok, resp
 
     def forward_status(self, path: str,
                        payload: dict[str, Any]) -> tuple[int, Any]:

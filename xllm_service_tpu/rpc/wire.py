@@ -1,0 +1,109 @@
+"""Symmetric binary dispatch wire (master → engine hot path).
+
+The token-return wire (engine → master ``/rpc/generations``) has been
+msgpack since the tracing round — binary beats JSON both to encode and to
+parse, and the reference ships batched protobuf on the same hop for the
+same reason. The dispatch wire (master → engine enriched
+completions/chat payload) stayed JSON: ``token_ids`` is a
+multi-thousand-int list JSON-encoded per request. This module makes the
+hot wire symmetric:
+
+- ``encode_dispatch(payload, fmt)`` — one blessed encoder for every
+  dispatch site (msgpack when the target advertises it, compact JSON
+  otherwise). msgpack encoding of a given dict is deterministic
+  (insertion-ordered maps), so a retained failover payload re-encodes
+  byte-identically — the chaos drill asserts this.
+- ``decode_body(content_type, data)`` — the engine-side inverse,
+  content-type negotiated.
+
+Negotiation is per instance: engines advertise ``wire_formats`` in their
+registration metadata (``InstanceMetaInfo.wire_formats``); the master
+dispatches msgpack iff the target advertises it, and demotes an instance
+to JSON on an HTTP 415 (legacy engine running an older build — a 415
+rejection cannot have started generation, so the JSON re-send is safe
+even on this non-idempotent wire).
+
+``HOT_PATH_FUNCTIONS`` is the registry behind xlint's ``hot-json`` rule:
+inside these functions, hand-rolled ``json.dumps``/``json=`` encoding is
+a lint violation (hatch: ``# xlint: allow-hot-json(reason)``) — dispatch
+bytes must come from this module so the wire stays symmetric and the
+negotiation stays in one place.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import msgpack
+
+#: Wire format names (the values carried in InstanceMetaInfo.wire_formats).
+WIRE_MSGPACK = "msgpack"
+WIRE_JSON = "json"
+
+MSGPACK_CONTENT_TYPE = "application/msgpack"
+JSON_CONTENT_TYPE = "application/json"
+
+#: Registered hot-path dispatch call sites ("Class.method" or a bare
+#: module-level function name → why it is hot). xlint's hot-json rule is
+#: bidirectional over this registry: each entry must resolve to a live
+#: function in the tree, and inside each, ``json.dumps(...)`` calls and
+#: ``json=`` kwargs are violations unless hatched with
+#: ``# xlint: allow-hot-json(reason)``.
+HOT_PATH_FUNCTIONS: dict[str, str] = {
+    "XllmHttpService._forward_to_instance":
+        "initial engine dispatch from the HTTP frontend",
+    "XllmHttpService.handle_generations":
+        "token-return ingest (hottest service endpoint)",
+    "XllmHttpService._respond":
+        "SSE emit loop (client-facing frames are protocol JSON)",
+    "Scheduler._failover_loop":
+        "failover replay dispatch",
+    "EngineChannel.forward":
+        "sync dispatch fallback / failover wire",
+    "GenerationStreamer._send":
+        "engine agent batched Generations push",
+    "FakeEngine._generate":
+        "fake-engine Generations push (wire-contract reference impl)",
+}
+
+
+def pack_dispatch(payload: dict[str, Any]) -> bytes:
+    """Deterministic msgpack encoding of a dispatch payload (same dict →
+    same bytes; maps keep insertion order)."""
+    return msgpack.packb(payload, use_bin_type=True)
+
+
+def unpack_dispatch(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False)
+
+
+def encode_dispatch(payload: dict[str, Any],
+                    fmt: str = WIRE_JSON) -> tuple[bytes, str]:
+    """Serialize an enriched dispatch payload for the wire. Returns
+    (body bytes, content type)."""
+    if fmt == WIRE_MSGPACK:
+        return pack_dispatch(payload), MSGPACK_CONTENT_TYPE
+    return (json.dumps(payload, separators=(",", ":")).encode(),
+            JSON_CONTENT_TYPE)
+
+
+def decode_body(content_type: str, data: bytes) -> Any:
+    """Engine-side inverse of :func:`encode_dispatch`. Raises ValueError
+    on a malformed body (callers surface it as a 400)."""
+    if content_type and MSGPACK_CONTENT_TYPE in content_type:
+        try:
+            return unpack_dispatch(data)
+        except Exception as e:  # msgpack raises library-specific errors
+            raise ValueError(f"malformed msgpack body: {e}") from None
+    return json.loads(data)
+
+
+def negotiate(wire_formats: Any) -> str:
+    """The dispatch format for an instance advertising `wire_formats`
+    (missing/empty/legacy metadata → JSON)."""
+    try:
+        return WIRE_MSGPACK if WIRE_MSGPACK in (wire_formats or ()) \
+            else WIRE_JSON
+    except TypeError:
+        return WIRE_JSON

@@ -20,13 +20,25 @@ reference's largest component; SURVEY.md §2.5, §3.4). Responsibilities:
   mirror via watch.
 
 Lock discipline (reference documents a two-lock order,
-`instance_mgr.h:156-162`): `_cluster_lock` guards fleet membership/indices;
+`instance_mgr.h:156-162`): `_cluster_lock` guards fleet membership;
 `_metrics_lock` guards load/latency/request accounting. Never take
 `_cluster_lock` while holding `_metrics_lock`; RPCs are issued outside locks.
+
+Scheduling reads are LOCK-FREE (RCU): every membership/state writer
+rebuilds an immutable :class:`RoutingSnapshot` under `_cluster_lock` and
+publishes it with one atomic reference assignment; `get_next_instance_pair`
+/ `select_instance_pair_on_slo` / `bind_request_instance_incarnations` /
+`has_available_instances` / `get_channel` read the current snapshot without
+taking any instance_mgr lock — a heartbeat or eviction storm can no longer
+stall the request hot path on `_cluster_lock`. A reader that routed from a
+just-superseded snapshot is caught at bind time: the bind re-reads the
+CURRENT snapshot and fails if its target is gone or re-incarnated, and the
+scheduler re-selects.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -65,6 +77,7 @@ from ..rpc import (
     parse_instance_key,
 )
 from ..rpc.channel import EngineChannel
+from ..rpc.wire import WIRE_JSON, negotiate
 from ..utils import get_logger
 
 logger = get_logger(__name__)
@@ -104,6 +117,58 @@ class _Entry:
             and not self.meta.draining
 
 
+class RoutingSnapshot:
+    """Immutable view of the fleet for the scheduling hot path (RCU).
+
+    Built by writers under `_cluster_lock`, published with one atomic
+    reference assignment, read lock-free. Role membership is captured at
+    build time over schedulable() instances only, so an evicted/SUSPECT/
+    draining instance disappears from routing the moment its eviction
+    publishes — readers never consult mutable entry state. `entries` keeps
+    references to the (shared) `_Entry` objects for the SLO policy's
+    predictor reads; those are coefficient-reference reads, safe without
+    the lock."""
+
+    __slots__ = ("prefill", "decode", "encode", "schedulable", "entries",
+                 "incarnations", "channels", "wire", "has_available")
+
+    def __init__(self, instances: dict[str, _Entry]):
+        prefill: list[str] = []
+        decode: list[str] = []
+        encode: list[str] = []
+        self.entries: dict[str, _Entry] = dict(instances)
+        self.incarnations = {n: e.meta.incarnation_id
+                             for n, e in instances.items()}
+        self.channels = {n: e.channel for n, e in instances.items()}
+        self.wire = {n: negotiate(e.meta.wire_formats)
+                     for n, e in instances.items()}
+        has_default = has_prefill = has_decode = False
+        for name, e in instances.items():
+            if not e.schedulable():
+                continue
+            t = e.meta.type
+            if t in _PREFILL_TYPES:
+                prefill.append(name)
+            if t in _DECODE_TYPES:
+                decode.append(name)
+            if t == InstanceType.ENCODE:
+                encode.append(name)
+            if t in (InstanceType.DEFAULT, InstanceType.MIX):
+                has_default = True
+            elif t == InstanceType.PREFILL:
+                has_prefill = True
+            elif t == InstanceType.DECODE:
+                has_decode = True
+        self.prefill = tuple(prefill)
+        self.decode = tuple(decode)
+        self.encode = tuple(encode)
+        self.schedulable = frozenset(prefill).union(decode, encode)
+        # Readiness (reference `instance_mgr.cpp:1430-1472`): a schedulable
+        # DEFAULT/MIX serves both roles; otherwise both a PREFILL and a
+        # DECODE must exist — a prefill-only fleet must NOT report ready.
+        self.has_available = has_default or (has_prefill and has_decode)
+
+
 class InstanceMgr:
     def __init__(self, coord: CoordinationClient, options: ServiceOptions,
                  is_master: bool = True,
@@ -114,15 +179,17 @@ class InstanceMgr:
         self._is_master = is_master
         self._channel_factory = channel_factory or (
             lambda name, rpc_addr: EngineChannel.from_options(name, options))
-        # L1: fleet membership + indices.
+        # L1: fleet membership (writers). Scheduling reads go through the
+        # published RoutingSnapshot, not this lock.
         self._cluster_lock = make_lock("instance_mgr.cluster", order=20, reentrant=True)  # lock-order: 20
         self._instances: dict[str, _Entry] = {}
-        self._prefill_index: list[str] = []
-        self._decode_index: list[str] = []
-        self._encode_index: list[str] = []
-        self._rr_prefill = 0
-        self._rr_decode = 0
-        self._rr_encode = 0
+        self._snapshot = RoutingSnapshot({})
+        # RR cursors: shared monotonic counters (next() on itertools.count
+        # is atomic under the GIL) — no lock, stable fairness across
+        # snapshot republishes.
+        self._rr_prefill = itertools.count()
+        self._rr_decode = itertools.count()
+        self._rr_encode = itertools.count()
         # Pending async role flips (performed by the reconcile thread).
         self._flip_lock = make_lock("instance_mgr.flip", order=22)  # lock-order: 22
         self._pending_flips: dict[str, InstanceType] = {}
@@ -155,6 +222,42 @@ class InstanceMgr:
             self._reconciler = threading.Thread(
                 target=self._reconcile_loop, name="instance-reconcile", daemon=True)
             self._reconciler.start()
+
+    # ------------------------------------------------------------- snapshot
+    def _publish_snapshot(self) -> None:
+        """Rebuild + atomically publish the routing snapshot. Called by
+        every membership/state writer; `_cluster_lock` is reentrant, so
+        writers already holding it republish in place."""
+        with self._cluster_lock:
+            self._snapshot = RoutingSnapshot(self._instances)
+
+    def routing_snapshot(self) -> RoutingSnapshot:
+        """The current immutable routing view (lock-free read)."""
+        return self._snapshot
+
+    def dispatch_wire(self, name: str) -> str:
+        """Negotiated dispatch-wire format for an instance (lock-free)."""
+        return self._snapshot.wire.get(name, WIRE_JSON)
+
+    def demote_wire(self, name: str) -> None:
+        """Fall back to JSON dispatch for an instance that rejected
+        msgpack with a 415 (legacy build behind a stale registration).
+        Updates BOTH negotiation sites — the snapshot (async frontend
+        dispatch) and the channel flag (sync failover dispatch) — so a
+        demotion learned on one path isn't re-discovered at 415 cost on
+        the other."""
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            if entry is None:
+                return
+            if entry.channel is not None:
+                entry.channel.wire_format = WIRE_JSON
+            if WIRE_JSON == negotiate(entry.meta.wire_formats):
+                return
+            entry.meta.wire_formats = [WIRE_JSON]
+            self._publish_snapshot()
+        logger.warning("instance %s rejected msgpack dispatch; demoted to "
+                       "JSON wire", name)
 
     # ------------------------------------------------------------------ boot
     def _load_existing(self) -> None:
@@ -206,12 +309,20 @@ class InstanceMgr:
                          meta.tpot_profiling_data !=
                          cur.meta.tpot_profiling_data)
                 cur.meta = meta
+                if cur.channel is not None:
+                    # Keep the sync-dispatch flag coherent with the
+                    # refreshed advertisement (one negotiation truth).
+                    cur.channel.wire_format = negotiate(meta.wire_formats)
                 if refit:
                     if meta.ttft_profiling_data:
                         cur.predictor.fit_ttft(meta.ttft_profiling_data)
                     if meta.tpot_profiling_data:
                         cur.predictor.fit_tpot(meta.tpot_profiling_data)
                 self._set_state(cur, InstanceRuntimeState.ACTIVE)
+                # Meta replacement can change schedulability (draining
+                # flag) or the wire format even when the state didn't
+                # flip — republish unconditionally.
+                self._publish_snapshot()
             return
         # New incarnation: instance replacement (reference
         # `instance_mgr.cpp:588-601`).
@@ -269,6 +380,18 @@ class InstanceMgr:
                           link_peers: bool = True) -> bool:
         """Reference `instance_mgr.cpp:1155-1210,1289-1396`."""
         channel = self._channel_factory(meta.name, meta.rpc_address)
+        # Negotiate the dispatch wire from the advertised formats, and
+        # prime the connection pool (TCP keepalive handshake) so the first
+        # real call doesn't pay connection setup. Warm-up runs on a
+        # background thread: registration executes on the coordination
+        # watch thread, and an unreachable instance's connect timeout must
+        # not stall eviction/heartbeat event processing behind it. Both
+        # tolerate test doubles without the richer channel API.
+        channel.wire_format = negotiate(meta.wire_formats)
+        warm = getattr(channel, "warm_up", None)
+        if warm is not None:
+            threading.Thread(target=warm, daemon=True,
+                             name=f"chan-warmup-{meta.name}").start()
         entry = _Entry(meta=meta, channel=channel)
         if meta.ttft_profiling_data:
             entry.predictor.fit_ttft(meta.ttft_profiling_data)
@@ -307,7 +430,7 @@ class InstanceMgr:
             if old is not None and old.channel is not None and old.channel is not channel:
                 old.channel.close()
             self._instances[meta.name] = entry
-            self._index_insert(meta.name, meta.type)
+            self._publish_snapshot()
         with self._metrics_lock:
             self._load_metrics.setdefault(meta.name, LoadMetrics())
             self._request_loads.setdefault(meta.name, _RequestLoad())
@@ -344,7 +467,11 @@ class InstanceMgr:
             entry = self._instances.pop(name, None)
             if entry is None:
                 return
-            self._index_remove(name)
+            # Publish BEFORE closing the channel: a hot-path reader holding
+            # the superseded snapshot may still grab the channel reference,
+            # and a closed session surfaces as a dispatch failure (handled
+            # by failover), not a crash.
+            self._publish_snapshot()
             if entry.channel is not None:
                 entry.channel.close()
         with self._metrics_lock:
@@ -377,25 +504,6 @@ class InstanceMgr:
         logger.info("deregistered instance %s (%s)", name, reason)
         if self.on_instance_failure is not None:
             self.on_instance_failure(name, incarnation, itype)
-
-    # ------------------------------------------------------------- indices
-    def _index_insert(self, name: str, itype: InstanceType) -> None:
-        self._index_remove(name)
-        if itype in _PREFILL_TYPES and name not in self._prefill_index:
-            self._prefill_index.append(name)
-        if itype in _DECODE_TYPES and name not in self._decode_index:
-            self._decode_index.append(name)
-        if itype == InstanceType.ENCODE and name not in self._encode_index:
-            self._encode_index.append(name)
-
-    def _index_remove(self, name: str) -> None:
-        # O(1) swap-remove (reference `instance_mgr.cpp:1398-1428`).
-        for index in (self._prefill_index, self._decode_index,
-                      self._encode_index):
-            if name in index:
-                i = index.index(name)
-                index[i] = index[-1]
-                index.pop()
 
     # ----------------------------------------------------------- heartbeats
     def record_instance_heartbeat(self, name: str, incarnation_id: str,
@@ -430,9 +538,12 @@ class InstanceMgr:
         return True
 
     def _set_state(self, entry: _Entry, state: InstanceRuntimeState) -> None:
+        """State transition + snapshot republish (all call sites hold
+        `_cluster_lock`; the publish re-enter is reentrant)."""
         if entry.state != state:
             entry.state = state
             entry.state_since_ms = now_ms()
+            self._publish_snapshot()
 
     # ------------------------------------------------------------ reconcile
     def _reconcile_loop(self) -> None:
@@ -464,85 +575,69 @@ class InstanceMgr:
         self.drain_pending_flips()
 
     # ------------------------------------------------------ scheduling reads
+    # All lock-free: one read of the published snapshot reference.
     def get_next_instance_pair(self) -> Routing:
-        """RR with SUSPECT skip; DEFAULT/MIX-only fallback when no decode
-        fleet exists (reference `instance_mgr.cpp:203-254`)."""
-        with self._cluster_lock:
-            prefill = self._rr_pick(self._prefill_index, "prefill")
-            if prefill is None:
-                return Routing()
-            if not self._decode_index:
-                return Routing(prefill_name=prefill)
-            decode = self._rr_pick(self._decode_index, "decode")
-            if decode is None:
-                return Routing(prefill_name=prefill)
-            if decode == prefill:
-                # A MIX instance picked for both roles serves both stages.
-                return Routing(prefill_name=prefill)
-            return Routing(prefill_name=prefill, decode_name=decode)
-
-    def _rr_pick(self, index: list[str], which: str) -> Optional[str]:
-        if not index:
-            return None
-        cursor = self._rr_prefill if which == "prefill" else self._rr_decode
-        n = len(index)
-        for i in range(n):
-            name = index[(cursor + i) % n]
-            entry = self._instances.get(name)
-            if entry is not None and entry.schedulable():
-                new_cursor = (cursor + i + 1) % n
-                if which == "prefill":
-                    self._rr_prefill = new_cursor
-                else:
-                    self._rr_decode = new_cursor
-                return name
-        return None
+        """RR over the snapshot's schedulable role lists; DEFAULT/MIX-only
+        fallback when no decode fleet exists (reference
+        `instance_mgr.cpp:203-254`)."""
+        snap = self._snapshot
+        if not snap.prefill:
+            return Routing()
+        prefill = snap.prefill[next(self._rr_prefill) % len(snap.prefill)]
+        if not snap.decode:
+            return Routing(prefill_name=prefill)
+        decode = snap.decode[next(self._rr_decode) % len(snap.decode)]
+        if decode == prefill:
+            # A MIX instance picked for both roles serves both stages.
+            return Routing(prefill_name=prefill)
+        return Routing(prefill_name=prefill, decode_name=decode)
 
     def get_next_encode_instance(self) -> str:
         """RR over ENCODE-role instances (EPD three-stage routing; the
         reference only claims EPD — README.md:47 — the mechanism is ours)."""
-        with self._cluster_lock:
-            if not self._encode_index:
-                return ""
-            n = len(self._encode_index)
-            for i in range(n):
-                name = self._encode_index[(self._rr_encode + i) % n]
-                entry = self._instances.get(name)
-                if entry is not None and entry.schedulable():
-                    self._rr_encode = (self._rr_encode + i + 1) % n
-                    return name
+        snap = self._snapshot
+        if not snap.encode:
             return ""
+        return snap.encode[next(self._rr_encode) % len(snap.encode)]
 
     def get_load_infos(self) -> dict[str, InstanceLoadInfo]:
         """Snapshot for CAR scoring (reference `get_load_metrics`,
-        `instance_mgr.cpp:287-359`)."""
-        with self._cluster_lock:
-            base = {name: (e.meta.type, e.schedulable())
-                    for name, e in self._instances.items()}
+        `instance_mgr.cpp:287-359`). Membership/types come from the
+        routing snapshot (lock-free); only the load/latency maps take
+        `_metrics_lock`."""
+        snap = self._snapshot
         out: dict[str, InstanceLoadInfo] = {}
         with self._metrics_lock:
-            for name, (itype, sched) in base.items():
+            for name, entry in snap.entries.items():
                 out[name] = InstanceLoadInfo(
-                    name=name, type=itype,
+                    name=name, type=entry.meta.type,
                     load=self._load_metrics.get(name, LoadMetrics()),
                     latency=self._latency_metrics.get(name, LatencyMetrics()),
-                    schedulable=sched)
+                    schedulable=name in snap.schedulable)
         return out
 
-    def bind_request_instance_incarnations(self, req: Request) -> None:
+    def bind_request_instance_incarnations(self, req: Request) -> bool:
         """Reference `instance_mgr.cpp:408-449`: record the incarnations the
         request is bound to, for stale-output suppression and targeted
-        cancellation."""
-        with self._cluster_lock:
-            p = self._instances.get(req.routing.prefill_name)
-            d = self._instances.get(req.routing.decode_name)
-            req.prefill_incarnation = p.meta.incarnation_id if p else ""
-            req.decode_incarnation = d.meta.incarnation_id if d else ""
+        cancellation.
+
+        RCU validation step: incarnations come from the CURRENT snapshot,
+        which may be newer than the one routing selected from. Returns
+        False when the routed pair is no longer schedulable there (evicted
+        / replaced / drained between select and bind) — the caller must
+        re-select instead of dispatching into a dead binding."""
+        snap = self._snapshot
+        req.prefill_incarnation = \
+            snap.incarnations.get(req.routing.prefill_name, "")
+        req.decode_incarnation = \
+            snap.incarnations.get(req.routing.decode_name, "")
+        if req.routing.prefill_name not in snap.schedulable:
+            return False
+        return (not req.routing.decode_name
+                or req.routing.decode_name in snap.schedulable)
 
     def get_channel(self, name: str) -> Optional[EngineChannel]:
-        with self._cluster_lock:
-            entry = self._instances.get(name)
-            return entry.channel if entry else None
+        return self._snapshot.channels.get(name)
 
     def get_instance_meta(self, name: str) -> Optional[InstanceMetaInfo]:
         with self._cluster_lock:
@@ -560,26 +655,11 @@ class InstanceMgr:
                     if itype is None or e.meta.type == itype]
 
     def has_available_instances(self) -> bool:
-        """Readiness gate (reference `instance_mgr.cpp:1430-1472`): ready
-        iff a schedulable DEFAULT or MIX exists (serves both roles), or a
-        schedulable PREFILL *and* a schedulable DECODE both exist. A
-        prefill-only fleet must report NOT ready — it would accept traffic
-        that can never reach a decode peer."""
-        with self._cluster_lock:
-            has_default = has_prefill = has_decode = False
-            for e in self._instances.values():
-                if not e.schedulable():
-                    continue
-                t = e.meta.type
-                if t in (InstanceType.DEFAULT, InstanceType.MIX):
-                    has_default = True
-                elif t == InstanceType.PREFILL:
-                    has_prefill = True
-                elif t == InstanceType.DECODE:
-                    has_decode = True
-                if has_default or (has_prefill and has_decode):
-                    return True
-            return False
+        """Readiness gate (reference `instance_mgr.cpp:1430-1472`),
+        precomputed at snapshot build — the per-request readiness
+        middleware reads one bool instead of walking the fleet under
+        `_cluster_lock`."""
+        return self._snapshot.has_available
 
     # ------------------------------------------------- SLO core + role flips
     def update_request_metrics(self, req: Request, action: RequestAction,
@@ -641,11 +721,9 @@ class InstanceMgr:
            idle decode) flip one DECODE → PREFILL.
         """
         prompt_len = len(req.token_ids)
-        with self._cluster_lock:
-            prefills = [(n, self._instances[n]) for n in self._prefill_index
-                        if n in self._instances and self._instances[n].schedulable()]
-            decodes = [(n, self._instances[n]) for n in self._decode_index
-                       if n in self._instances and self._instances[n].schedulable()]
+        snap = self._snapshot
+        prefills = [(n, snap.entries[n]) for n in snap.prefill]
+        decodes = [(n, snap.entries[n]) for n in snap.decode]
         if not prefills:
             return Routing()
 
@@ -687,11 +765,10 @@ class InstanceMgr:
             # the client's TTFT. This request falls back least-loaded; the
             # flipped capacity serves the ones after it.
             idle_prefill = next(
-                (n for n, _ in prefills
+                (n for n, e in prefills
                  if n != best_prefill_name
                  and loads[n].num_prefill_requests == 0
-                 and self.get_instance_meta(n) is not None
-                 and self.get_instance_meta(n).type == InstanceType.PREFILL),
+                 and e.meta.type == InstanceType.PREFILL),
                 None)
             if idle_prefill is not None and len(prefills) > 1:
                 self.request_flip(idle_prefill, InstanceType.DECODE)
@@ -755,7 +832,7 @@ class InstanceMgr:
             if entry is None:
                 return False
             entry.meta.type = new_type
-            self._index_insert(name, new_type)
+            self._publish_snapshot()
             meta_json = entry.meta.to_json()
             meta = entry.meta
             chan = entry.channel

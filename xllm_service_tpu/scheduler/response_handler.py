@@ -268,7 +268,10 @@ class ResponseHandler:
                               output: RequestOutput,
                               created: Optional[int] = None) -> bool:
         """Reference `response_handler.cpp:355-435`."""
-        created = created or int(time.time())
+        # Per-request constant (OpenAI semantics: `created` is the request
+        # creation time) — also drops a time() syscall per delta.
+        created = created or (request.created_time_ms // 1000) \
+            or int(time.time())
         # OpenAI completions `echo`: the prompt text streams back as the
         # first chunk before any generated text.
         if request.sampling.echo and not request.echo_emitted and \

@@ -31,11 +31,14 @@ from __future__ import annotations
 import json
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
 from ..chat_template import JinjaChatTemplate
+from ..common import tracing
 from ..common.call_data import ClientConnection
 from ..common.config import ServiceOptions
+from ..common.hotpath import HOTPATH
 from ..common.metrics import (
     FAILOVER_ATTEMPTS_TOTAL,
     FAILOVER_SUCCESS_TOTAL,
@@ -80,7 +83,7 @@ class _RequestState:
                  "accum", "first_token_ms", "last_token_ms", "finished",
                  "exited", "last_delta_seq", "forward_path",
                  "forward_payload", "replay_token_ids", "failover_attempts",
-                 "failing", "in_failover")
+                 "failing", "in_failover", "dispatch_done_pc")
 
     def __init__(self, request: Request, conn: ClientConnection, lane: int,
                  kind: str, stream_state: Optional[ChatStreamState],
@@ -118,6 +121,10 @@ class _RequestState:
         # only one may run the failover loop (the other would double-burn
         # the retry budget and double-dispatch).
         self.in_failover = False
+        # perf_counter at engine-accept of the initial dispatch; the
+        # first-token path turns it into the hot-path "first_delta" stage
+        # sample (engine accept -> first Generations delta ingested).
+        self.dispatch_done_pc: Optional[float] = None
 
 
 class Scheduler:
@@ -165,6 +172,13 @@ class Scheduler:
         self._requests: dict[str, _RequestState] = {}
         self._req_lock = make_lock("scheduler.requests", order=10, reentrant=True)  # lock-order: 10
         self._output_executor = OrderedExecutor(options.num_output_threads)
+        # Dedicated bounded pool for schedule() (template/tokenize/route/
+        # bind): on the default event-loop executor a schedule queues
+        # behind generations-ingest batches and heartbeat handling, and a
+        # failover sleeping on backoff could starve admission entirely.
+        self.schedule_executor = ThreadPoolExecutor(
+            max_workers=max(1, options.num_schedule_threads),
+            thread_name_prefix="schedule")
 
         self._stopped = threading.Event()
         self._master_watch_id: Optional[int] = None
@@ -292,16 +306,25 @@ class Scheduler:
         return status
 
     def _schedule(self, request: Request) -> Status:
+        # Per-stage sub-spans under the scheduler.schedule span (the
+        # thread-active context): attribution for the master hot-path
+        # budget. All four are no-ops when tracing is off.
+        ctx = tracing.current_context()
+        sid = request.service_request_id
         if request.messages and not request.prompt:
-            try:
-                request.prompt = self.chat_template.apply(
-                    request.messages, request.tools,
-                    request.chat_template_kwargs)
-            except Exception as e:  # noqa: BLE001  # xlint: allow-broad-except(template errors surface to the client as INVALID_ARGUMENT)
-                return Status(StatusCode.INVALID_ARGUMENT,
-                              f"chat template error: {e}")
+            with TRACER.span("scheduler.template", ctx=ctx, request_id=sid):
+                try:
+                    request.prompt = self.chat_template.apply(
+                        request.messages, request.tools,
+                        request.chat_template_kwargs)
+                except Exception as e:  # noqa: BLE001  # xlint: allow-broad-except(template errors surface to the client as INVALID_ARGUMENT)
+                    return Status(StatusCode.INVALID_ARGUMENT,
+                                  f"chat template error: {e}")
         if not request.token_ids and request.prompt:
-            request.token_ids = self.tokenizer.encode(request.prompt)
+            with TRACER.span("scheduler.tokenize", ctx=ctx,
+                             request_id=sid) as sp:
+                request.token_ids = self.tokenizer.encode(request.prompt)
+                sp.set(prompt_tokens=len(request.token_ids))
         elif request.sampling.echo and not request.prompt \
                 and request.token_ids:
             # Completions `echo` with an array-of-token-ids prompt: OpenAI
@@ -309,16 +332,36 @@ class Scheduler:
             request.prompt = self.tokenizer.decode(request.token_ids)
         request.metrics.prompt_tokens = len(request.token_ids)
 
-        routing = self.lb_policy.select_instances_pair(request)
-        if not routing.valid():
-            return Status(StatusCode.UNAVAILABLE, "no available instances")
-        if request.has_images:
-            # EPD: pin the vision-encode stage to a dedicated ENCODE
-            # instance when the fleet has one (BASELINE config 5).
-            routing.encode_name = \
-                self.instance_mgr.get_next_encode_instance()
-        request.routing = routing
-        self.instance_mgr.bind_request_instance_incarnations(request)
+        # Route + bind, RCU-validated: routing reads a lock-free snapshot,
+        # so the selected pair may be superseded (evicted/replaced) before
+        # the bind — bind re-checks against the CURRENT snapshot and a
+        # failed bind re-selects (bounded; each retry reads a fresher
+        # snapshot, so livelock requires perpetual fleet churn).
+        for _ in range(3):
+            with TRACER.span("scheduler.route", ctx=ctx,
+                             request_id=sid) as sp:
+                routing = self.lb_policy.select_instances_pair(request)
+                sp.set(prefill=routing.prefill_name,
+                       decode=routing.decode_name)
+            if not routing.valid():
+                return Status(StatusCode.UNAVAILABLE,
+                              "no available instances")
+            if request.has_images:
+                # EPD: pin the vision-encode stage to a dedicated ENCODE
+                # instance when the fleet has one (BASELINE config 5).
+                routing.encode_name = \
+                    self.instance_mgr.get_next_encode_instance()
+            request.routing = routing
+            with TRACER.span("scheduler.bind", ctx=ctx,
+                             request_id=sid) as sp:
+                bound = self.instance_mgr \
+                    .bind_request_instance_incarnations(request)
+                sp.set(ok=bound)
+            if bound:
+                break
+        else:
+            return Status(StatusCode.UNAVAILABLE,
+                          "no available instances (fleet churning)")
         request.metrics.schedule_time_ms = now_ms()
         self.instance_mgr.update_request_metrics(request, RequestAction.SCHEDULE)
         return Status(StatusCode.OK)
@@ -344,6 +387,18 @@ class Scheduler:
                            forward_payload=forward_payload)
         with self._req_lock:
             self._requests[request.service_request_id] = st
+
+    def mark_dispatch_complete(self, request: Request) -> None:
+        """Engine accepted the initial dispatch: stamp the perf_counter the
+        first-token path diffs into the hot-path `first_delta` stage."""
+        with self._req_lock:
+            st = self._requests.get(request.service_request_id)
+            if st is not None and st.dispatch_done_pc is None:
+                st.dispatch_done_pc = time.perf_counter()
+
+    def dispatch_wire(self, name: str) -> str:
+        """Negotiated dispatch-wire format for an instance (lock-free)."""
+        return self.instance_mgr.dispatch_wire(name)
 
     def has_request(self, service_request_id: str) -> bool:
         with self._req_lock:
@@ -440,6 +495,11 @@ class Scheduler:
         policy = self._opts.load_balance_policy
         if st.first_token_ms is None and n_new:
             st.first_token_ms = now
+            if st.dispatch_done_pc is not None:
+                HOTPATH.record(
+                    "first_delta",
+                    (time.perf_counter() - st.dispatch_done_pc) * 1000)
+                st.dispatch_done_pc = None
             if not req.metrics.prefill_finish_time_ms:
                 # Observe TTFT once per request: after a failover the
                 # prefill stage re-runs (accounting below must re-fire)
@@ -742,7 +802,14 @@ class Scheduler:
                 # then re-run SCHEDULE against the new pair.
                 self._account_request_exit(req)
                 req.routing = routing
-                self.instance_mgr.bind_request_instance_incarnations(req)
+                # RCU window: the selected survivor may vanish between
+                # select and bind — bind re-validates against the current
+                # snapshot. Progress reset + SCHEDULE credit run either
+                # way (the next attempt's _account_request_exit reverses
+                # exactly this credit via CANCEL); a failed bind just
+                # skips the dispatch and burns this budgeted try.
+                bound = self.instance_mgr \
+                    .bind_request_instance_incarnations(req)
                 req.prefill_stage_finished = False
                 req.num_generated_tokens = 0
                 st.first_token_ms = None
@@ -751,7 +818,9 @@ class Scheduler:
                 req.touch()
                 self.instance_mgr.update_request_metrics(
                     req, RequestAction.SCHEDULE)
-                st.failing = False
+                st.failing = not bound
+            if not bound:
+                continue
             payload = dict(st.forward_payload or {})
             payload["service_request_id"] = req.service_request_id
             # Resume-by-prompt-extension: the engine prefills the original
@@ -856,6 +925,7 @@ class Scheduler:
         self.instance_mgr.stop()
         self.kvcache_mgr.stop()
         self._output_executor.shutdown()
+        self.schedule_executor.shutdown(wait=False)
         self._coord.release(SERVICE_KEY_PREFIX + self.self_addr)
         if self.is_master:
             self._coord.release(MASTER_KEY)

@@ -50,6 +50,7 @@ from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
 from ..devtools.locks import make_lock
 from ..coordination.base import CoordinationClient
 from ..rpc import instance_key
+from ..rpc import wire
 from ..utils import get_logger, pick_free_port
 
 logger = get_logger(__name__)
@@ -70,6 +71,10 @@ class FakeEngineConfig:
 
 
 class FakeEngine:
+    #: Max deltas coalesced into one Generations POST (mirrors the real
+    #: agent's flush-window batching).
+    _PUSH_BATCH = 8
+
     def __init__(self, coord: CoordinationClient,
                  config: Optional[FakeEngineConfig] = None):
         self.coord = coord
@@ -82,6 +87,10 @@ class FakeEngine:
         self.unlinks: list[str] = []
         self.cancelled: set[str] = set()
         self.accepted_requests: list[dict[str, Any]] = []
+        # Raw dispatch wire as received: (content_type, body bytes) per
+        # accepted request — the msgpack-failover chaos drill asserts the
+        # replayed binary payload is byte-equivalent to first dispatch.
+        self.accepted_wire: list[tuple[str, bytes]] = []
         # Trace-propagation headers (x-xllm-*) seen on accepted requests —
         # lets tests assert the RPC channel stamps them on the wire.
         self.accepted_trace_headers: list[dict[str, str]] = []
@@ -96,6 +105,13 @@ class FakeEngine:
         self._stored_hashes: list[str] = []
         self._pending_kv_stored: list[str] = []
         self._kv_lock = make_lock("fake_engine.kv_events", order=64)  # lock-order: 64
+        # Shared pooled session for Generations pushes (the real agent's
+        # streamer keeps one too): a fresh TCP connect per delta would
+        # charge connection setup to the master+wire span in every bench.
+        # urllib3's pool is thread-safe; we use no session-level state.
+        self._push_session = _requests.Session()
+        adapter = _requests.adapters.HTTPAdapter(pool_maxsize=32)
+        self._push_session.mount("http://", adapter)
 
     # ------------------------------------------------------------ lifecycle
     def start(self, register: bool = True) -> "FakeEngine":
@@ -125,6 +141,9 @@ class FakeEngine:
             ttft_profiling_data=[[128, 10.0], [512, 30.0], [2048, 100.0]],
             tpot_profiling_data=[[1, 100, 5.0], [8, 1000, 10.0],
                                  [32, 8000, 20.0]],
+            # Wire-contract reference impl: accepts the binary dispatch
+            # wire, like the real agent.
+            wire_formats=[wire.WIRE_MSGPACK, wire.WIRE_JSON],
         )
 
     def register(self) -> None:
@@ -194,6 +213,7 @@ class FakeEngine:
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self._push_session.close()
 
     # ---------------------------------------------------------- heartbeats
     def _heartbeat_loop(self) -> None:
@@ -273,7 +293,13 @@ class FakeEngine:
         return await self._accept(req, chat=True)
 
     async def _accept(self, req: web.Request, chat: bool) -> web.Response:
-        body = await req.json()
+        raw = await req.read()
+        try:
+            body = wire.decode_body(req.content_type, raw)
+        except ValueError:
+            return web.json_response({"error": "invalid request body"},
+                                     status=400)
+        self.accepted_wire.append((req.content_type or "", raw))
         self.accepted_trace_headers.append(
             {k.lower(): v for k, v in req.headers.items()
              if k.lower().startswith("x-xllm-")})
@@ -310,6 +336,7 @@ class FakeEngine:
 
     # ----------------------------------------------------------- generation
     def _generate(self, sid: str, source: str, body: dict[str, Any]) -> None:
+        session = self._push_session
         text = self.cfg.reply_text
         max_tokens = int(body.get("max_tokens", 1 << 30))
         chunks = [text[i:i + self.cfg.chunk_size]
@@ -347,6 +374,32 @@ class FakeEngine:
             pass
         with TRACER.span("kv_transfer.offer", simulated=True, **span_kw):
             pass
+        # Deltas are BATCHED per push like the real agent's streamer
+        # (GenerationStreamer flush window): the first delta flushes
+        # immediately (TTFT-critical), later ones coalesce up to
+        # _PUSH_BATCH per POST. Fault semantics are preserved — tokens
+        # emitted before a crash point are flushed BEFORE the kill, so
+        # crash-after-N drills still deliver exactly N tokens.
+        pending: list[dict[str, Any]] = []
+
+        def flush() -> Optional[bool]:
+            """POST pending deltas; True = delivered & request alive,
+            False = service said stop, None = push failed."""
+            if not pending:
+                return True
+            data, ctype = wire.encode_dispatch(
+                {"gens": list(pending)}, wire.WIRE_MSGPACK)
+            pending.clear()
+            try:
+                r = session.post(f"http://{source}/rpc/generations",
+                                 data=data,
+                                 headers={"Content-Type": ctype},
+                                 timeout=5)
+                return bool(r.json().get("alive", {}).get(sid, True))
+            except (_requests.RequestException, ValueError) as e:
+                logger.warning("fake engine: generations push failed: %s", e)
+                return None
+
         with TRACER.span("engine.decode", **span_kw) as dsp:
             for i in range(start, n):
                 chunk = chunks[i]
@@ -358,10 +411,18 @@ class FakeEngine:
                 if rule is not None and rule.action == "crash":
                     logger.info("fault: engine %s crashing before token %d "
                                 "of %s", self.name, i, sid)
+                    flush()   # tokens before the crash point were emitted
                     dsp.end("CRASHED")
                     self.kill()
                     return
                 if rule is not None and rule.action == "delay":
+                    alive = flush()
+                    if alive is False:
+                        dsp.end("STOPPED")
+                        return
+                    if alive is None:
+                        dsp.end("PUSH_FAILED")
+                        return
                     time.sleep(rule.delay_s)
                 last = i == n - 1
                 seq += 1
@@ -381,18 +442,19 @@ class FakeEngine:
                 if last:
                     gen["usage"] = {"num_prompt_tokens": prompt_tokens,
                                     "num_generated_tokens": total_tokens}
-                try:
-                    r = _requests.post(f"http://{source}/rpc/generations",
-                                       json={"gens": [gen]}, timeout=5)
-                    alive = r.json().get("alive", {}).get(sid, True)
-                    if not alive:
+                pending.append(gen)
+                # First delta (TTFT) and terminal delta flush immediately;
+                # a configured inter-delta delay means per-delta pushes
+                # (timing-sensitive drills); otherwise coalesce.
+                if last or i == start or self.cfg.delay_s \
+                        or len(pending) >= self._PUSH_BATCH:
+                    alive = flush()
+                    if alive is False:
                         dsp.end("STOPPED")
                         return  # service told us to stop
-                except (_requests.RequestException, ValueError) as e:
-                    logger.warning("fake engine: generations push failed: %s",
-                                   e)
-                    dsp.end("PUSH_FAILED")
-                    return
+                    if alive is None:
+                        dsp.end("PUSH_FAILED")
+                        return
                 if self.cfg.delay_s and not last:
                     time.sleep(self.cfg.delay_s)
             dsp.set(generated_tokens=total_tokens - start)
