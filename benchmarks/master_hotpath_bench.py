@@ -169,14 +169,19 @@ def drive(base: str, args) -> dict:
 
 def run_bench(requests_n: int = 256, concurrency: int = 8,
               prompt_chars: int = 1024, max_tokens: int = 16,
-              reply_chars: int = 64, rps: float = 0.0) -> dict:
+              reply_chars: int = 64, rps: float = 0.0,
+              policy: str = "RR", n_engines: int = 1) -> dict:
     """Spawn the multiproc stack, drive it, tear it down. Importable for
-    the tier-1 budget test."""
+    the tier-1 budget test. ``policy`` selects the master's load-balance
+    policy (RR | CAR | SLO_AWARE) — the kvcache routing bench drives the
+    same harness under RR and CAR to price cache-aware routing on the
+    schedule path; ``n_engines`` > 1 gives the policy a real choice."""
     args = argparse.Namespace(
         requests=requests_n, concurrency=concurrency,
         prompt_chars=prompt_chars, max_tokens=max_tokens, rps=rps)
     coord_port, http_port, rpc_port = free_port(), free_port(), free_port()
     procs: list[subprocess.Popen] = []
+    names: list[str] = []
     logdir = Path(os.environ.get("XLLM_BENCH_LOGDIR", "/tmp"))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
 
@@ -185,6 +190,7 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
         p = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
                              cwd=str(REPO), env=env)
         procs.append(p)
+        names.append(name)
         return p
 
     try:
@@ -196,15 +202,17 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
                          "--coordination-addr", f"127.0.0.1:{coord_port}",
                          "--host", "127.0.0.1",
                          "--http-port", str(http_port),
-                         "--rpc-port", str(rpc_port)])
-        spawn("engine", [sys.executable,
-                         str(REPO / "examples" / "run_fake_engine.py"),
-                         "--coordination-addr", f"127.0.0.1:{coord_port}",
-                         "--reply", "x" * reply_chars,
-                         "--chunk-size", "4", "--delay", "0"])
+                         "--rpc-port", str(rpc_port),
+                         "--load-balance-policy", policy])
+        for i in range(max(1, n_engines)):
+            spawn(f"engine{i}", [sys.executable,
+                                 str(REPO / "examples" / "run_fake_engine.py"),
+                                 "--coordination-addr",
+                                 f"127.0.0.1:{coord_port}",
+                                 "--reply", "x" * reply_chars,
+                                 "--chunk-size", "4", "--delay", "0"])
 
         base = f"http://127.0.0.1:{http_port}"
-        names = ("coord", "master", "engine")
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
             for name, p in zip(names, procs):
@@ -223,7 +231,10 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
             time.sleep(0.25)
         else:
             raise RuntimeError("fake-engine cluster never became ready")
-        return drive(base, args)
+        report = drive(base, args)
+        report["policy"] = policy
+        report["n_engines"] = max(1, n_engines)
+        return report
     finally:
         for p in procs:
             if p.poll() is None:
@@ -248,9 +259,14 @@ def main() -> None:
                     help="paced open-loop request rate (0 = closed-loop); "
                          "paced TTFT is measured from the request's due "
                          "slot, so queueing delay is counted, not hidden")
+    ap.add_argument("--policy", default="RR",
+                    help="master load-balance policy (RR | CAR | SLO_AWARE)")
+    ap.add_argument("--engines", type=int, default=1,
+                    help="fake engine instances (give CAR a real choice)")
     args = ap.parse_args()
     report = run_bench(args.requests, args.concurrency, args.prompt_chars,
-                       args.max_tokens, args.reply_chars, args.rps)
+                       args.max_tokens, args.reply_chars, args.rps,
+                       policy=args.policy, n_engines=args.engines)
     print(json.dumps(report, indent=2))
 
 

@@ -58,6 +58,65 @@ class TestHashing:
         assert hash_block(b"", blk) != hash_block(b"\x00" * 16, blk)
         assert prefix_block_hash_hexes(blk)[0] == hash_block(b"", blk).hex()
 
+    def test_hashlib_construction_equivalence(self):
+        """The batched path (native C or memoryview fast path) must be
+        byte-identical to the definitional per-block construction — every
+        party in the cluster keys the same prefix to the same 16 bytes."""
+        import hashlib
+
+        rng = np.random.default_rng(7)
+        for n in (1, 127, 128, 129, 512, 4096, 5000):
+            toks = rng.integers(0, 2**31 - 1, size=n).tolist()
+            got = prefix_block_hashes(toks, 128)
+            arr = np.asarray(toks, dtype=np.int32)
+            prev, ref = b"", []
+            for i in range(len(arr) // 128):
+                key = prev if prev else b"xllm-service-tpu"
+                prev = hashlib.blake2b(
+                    arr[i * 128:(i + 1) * 128].tobytes(),
+                    digest_size=16, key=key).digest()
+                ref.append(prev)
+            assert got == ref
+            # ndarray input takes the buffer path; must agree too.
+            assert prefix_block_hashes(arr, 128) == ref
+
+    def test_native_matches_python_fallback(self, monkeypatch):
+        from xllm_service_tpu.common import hashing as H
+
+        if not H.native_available():
+            pytest.skip("libblockhash.so not built")
+        toks = list(range(1000))
+        native = H.prefix_block_hashes(toks, 64)
+        # Force the PURE fallback (the path every non-built deployment
+        # runs): both native entry points disabled.
+        monkeypatch.setattr(H, "_NATIVE", None)
+        monkeypatch.setattr(H, "_NATIVE_LIST", None)
+        assert H.prefix_block_hashes(toks, 64) == native
+        assert H.prefix_block_hashes(np.asarray(toks, dtype=np.int32),
+                                     64) == native
+
+    def test_extend_prefix_block_hashes(self):
+        from xllm_service_tpu.common.hashing import extend_prefix_block_hashes
+
+        toks = list(range(DEFAULT_BLOCK_SIZE * 4 + 17))
+        full = prefix_block_hashes(toks)
+        for k in (0, 1, 2, 4):
+            assert extend_prefix_block_hashes(full[:k], toks) == full
+        # Longer memo than prompt covers (truncation): prefix returned.
+        assert extend_prefix_block_hashes(full, toks[:DEFAULT_BLOCK_SIZE * 2]) \
+            == full[:2]
+
+    def test_as_key_normalization(self):
+        from xllm_service_tpu.common.hashing import as_key
+
+        raw = bytes(range(16))
+        assert as_key(raw) == raw
+        assert as_key(raw.hex()) == raw
+        assert as_key("zz") is None
+        assert as_key("aa") is None          # wrong length
+        assert as_key(b"short") is None
+        assert as_key(12) is None
+
 
 class TestTypes:
     def test_instance_meta_roundtrip(self):
@@ -85,6 +144,22 @@ class TestTypes:
         assert back == loc
         back.remove_instance("i1")
         assert back.hbm == {"i2"}
+        row = loc.to_row()
+        assert CacheLocations.from_row(row) == loc
+
+    def test_kv_event_wire_forms(self):
+        """Hex (JSON wire) and raw-bytes (msgpack wire) forms carry the
+        same keys; either form round-trips through from_dict."""
+        raw = [bytes([i]) * 16 for i in range(3)]
+        ev = KvCacheEvent(stored=raw[:2], removed=[raw[2]])
+        jd = ev.to_dict()
+        assert jd["stored"] == [k.hex() for k in raw[:2]]
+        wd = ev.to_wire_dict()
+        assert wd["stored"] == raw[:2] and wd["removed"] == [raw[2]]
+        # Hex-built event produces identical wire bytes.
+        hex_ev = KvCacheEvent.from_dict(jd)
+        assert hex_ev.to_wire_dict() == wd
+        assert hex_ev.to_dict() == jd
 
     def test_load_metrics_roundtrip(self):
         lm = LoadMetrics(waiting_requests_num=3, hbm_cache_usage_perc=0.5)

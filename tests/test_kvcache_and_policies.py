@@ -1,5 +1,7 @@
 """GlobalKVCacheMgr + LB policy tests."""
 
+import time
+
 import pytest
 
 from xllm_service_tpu.common.config import ServiceOptions
@@ -152,3 +154,324 @@ class TestPolicies:
     def test_unknown_policy_raises(self, coord):
         with pytest.raises(ValueError):
             create_policy("NOPE", None, None, _opts())
+
+    def test_car_decode_collision_takes_second_best_decode(self, coord):
+        """Regression: when the best decode IS the chosen prefill (a MIX
+        node with the hottest cache), the decode leg must move to the
+        second-best decode instead of being silently dropped on a fleet
+        that has dedicated decode capacity."""
+        mgr = InstanceMgr(coord, _opts(), channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        mgr.register_instance(make_meta("mix1", InstanceType.MIX),
+                              link_peers=False)
+        for n in ("d1", "d2"):
+            mgr.register_instance(make_meta(n, InstanceType.DECODE),
+                                  link_peers=False)
+        kv = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        policy = create_policy("CAR", mgr, kv, _opts())
+        toks = list(range(BLOCK * 3))
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        # mix1 wins both roles on cache; d1 beats d2 on cache.
+        kv.record_updated_kvcaches("mix1", KvCacheEvent(stored=hashes))
+        kv.record_updated_kvcaches("d1", KvCacheEvent(stored=hashes[:1]))
+        r = policy.select_instances_pair(Request(token_ids=toks))
+        assert r.prefill_name == "mix1"
+        assert r.decode_name == "d1"   # second-best decode, not dropped
+        mgr.stop()
+
+    def test_car_decode_collision_lone_mix_serves_both(self, coord):
+        mgr = InstanceMgr(coord, _opts(), channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        mgr.register_instance(make_meta("mix1", InstanceType.MIX),
+                              link_peers=False)
+        kv = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        policy = create_policy("CAR", mgr, kv, _opts())
+        toks = list(range(BLOCK))
+        kv.record_updated_kvcaches(
+            "mix1", KvCacheEvent(stored=prefix_block_hash_hexes(toks, BLOCK)))
+        r = policy.select_instances_pair(Request(token_ids=toks))
+        assert r.prefill_name == "mix1"
+        assert r.decode_name == ""     # single instance serves both stages
+        mgr.stop()
+
+    def test_car_read_path_is_lock_free(self, coord):
+        """Acceptance: neither match() nor CAR select_instances_pair may
+        acquire a make_lock on the read path. Poison every lock they could
+        reach — a single acquisition fails the test."""
+
+        class _Poison:
+            def __enter__(self):
+                raise AssertionError("lock acquired on the lock-free path")
+
+            def __exit__(self, *exc):
+                return False
+
+            def acquire(self, *a, **kw):
+                raise AssertionError("lock acquired on the lock-free path")
+
+        mgr = self._fleet(coord)
+        kv = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        toks = list(range(BLOCK * 2))
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        kv.record_updated_kvcaches("p1", KvCacheEvent(stored=hashes))
+        policy = create_policy("CAR", mgr, kv, _opts())
+        req = Request(token_ids=toks)
+        req.prefix_hashes(BLOCK)   # memoize before poisoning
+        kv._lock = _Poison()
+        mgr._cluster_lock = _Poison()
+        mgr._metrics_lock = _Poison()
+        ov = kv.match(toks)
+        assert ov.scores["p1"] == pytest.approx(2.0)
+        assert ov.matched_blocks == 2
+        r = policy.select_instances_pair(req)
+        assert r.prefill_name == "p1"
+        # Sanity: the poison actually bites on a writer path.
+        with pytest.raises(AssertionError):
+            kv.record_updated_kvcaches("p2", KvCacheEvent(stored=hashes))
+
+
+class TestPrefixIndexDataPlane:
+    """PR 5 cache-plane behaviors: binary frame sync, reverse index,
+    flip coherence, wire byte-equivalence."""
+
+    def _toks(self, n_blocks):
+        return list(range(BLOCK * n_blocks))
+
+    def test_wire_byte_equivalence_json_vs_msgpack(self, coord):
+        """The same delta ingested as hex keys (legacy JSON heartbeat) and
+        as raw 16-byte keys (msgpack heartbeat) must produce an identical
+        index."""
+        import msgpack
+
+        from xllm_service_tpu.common.types import KvCacheEvent as KVE
+        from xllm_service_tpu.rpc import wire
+
+        toks = self._toks(3)
+        raw = __import__("xllm_service_tpu.common.hashing",
+                         fromlist=["prefix_block_hashes"]) \
+            .prefix_block_hashes(toks, BLOCK)
+        ev = KVE(stored=raw[:2], offloaded=[raw[2]])
+        # Round-trip both wire encodings like the heartbeat endpoint does.
+        msg_body, msg_ct = wire.encode_dispatch(
+            {"kv_cache_event": ev.to_wire_dict()}, wire.WIRE_MSGPACK)
+        json_body, json_ct = wire.encode_dispatch(
+            {"kv_cache_event": ev.to_dict()}, wire.WIRE_JSON)
+        ev_msg = KVE.from_dict(wire.decode_body(msg_ct, msg_body)["kv_cache_event"])
+        ev_json = KVE.from_dict(wire.decode_body(json_ct, json_body)["kv_cache_event"])
+        assert [k for k in ev_msg.stored] == raw[:2]          # raw bytes e2e
+        assert ev_json.stored == [k.hex() for k in raw[:2]]   # hex e2e
+        a = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        b = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        a.record_updated_kvcaches("i1", ev_msg)
+        b.record_updated_kvcaches("i1", ev_json)
+        ova, ovb = a.match(toks), b.match(toks)
+        assert ova.scores == ovb.scores
+        assert ova.matched_blocks == ovb.matched_blocks == 3
+        # And the frames they would upload are byte-identical.
+        pa = sorted((h, tuple(map(tuple, a._snapshot.blocks[h].to_row())))
+                    for h in a._snapshot.blocks)
+        pb = sorted((h, tuple(map(tuple, b._snapshot.blocks[h].to_row())))
+                    for h in b._snapshot.blocks)
+        assert pa == pb
+        assert msgpack is not None
+
+    def test_matched_depth_and_tier_weights_configurable(self, coord):
+        from xllm_service_tpu.common.config import ServiceOptions
+
+        opts = ServiceOptions(tier_weight_hbm=2.0, tier_weight_dram=1.0,
+                              tier_weight_ssd=0.5)
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK, options=opts)
+        toks = self._toks(4)
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(stored=hashes[:2]))
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(offloaded=[hashes[1]]))
+        ov = mgr.match(toks)
+        assert ov.matched_blocks == 2
+        assert ov.max_block_num == 4
+        assert ov.scores["i1"] == pytest.approx(2.0 + 1.0)  # HBM + DRAM
+
+    def test_reverse_index_remove_touches_only_owned(self, coord):
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        t1, t2 = self._toks(2), [t + 7_000_000 for t in self._toks(2)]
+        h1 = prefix_block_hash_hexes(t1, BLOCK)
+        h2 = prefix_block_hash_hexes(t2, BLOCK)
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(stored=h1))
+        mgr.record_updated_kvcaches("i2", KvCacheEvent(stored=h2))
+        mgr.record_updated_kvcaches("i2", KvCacheEvent(stored=h1[:1]))
+        assert {k.hex() for k in mgr._by_instance["i1"]} == set(h1)
+        mgr.remove_instance("i1")
+        assert "i1" not in mgr._by_instance
+        assert mgr.match(t2).scores == {"i2": pytest.approx(2.0)}
+        # Shared block survives under i2; i1-only block is gone.
+        ov = mgr.match(t1)
+        assert ov.scores == {"i2": pytest.approx(1.0)}
+        assert ov.matched_blocks == 1
+
+    def test_frame_sync_and_replica_mirror(self, coord, store):
+        master = GlobalKVCacheMgr(coord, block_size=BLOCK, is_master=True)
+        toks = self._toks(2)
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        master.record_updated_kvcaches("i1", KvCacheEvent(stored=hashes))
+        master.upload_kvcache()
+        # The sync wrote ONE frame key, not one key per block.
+        from xllm_service_tpu.rpc import CACHE_FRAME_KEY_PREFIX, CACHE_KEY_PREFIX
+        keys = list(coord.get_prefix(CACHE_KEY_PREFIX))
+        assert len(keys) == 1 and keys[0].startswith(CACHE_FRAME_KEY_PREFIX)
+        rc = InMemoryCoordination(store)
+        replica = GlobalKVCacheMgr(rc, block_size=BLOCK, is_master=False)
+        assert replica.match(toks).scores.get("i1") == pytest.approx(2.0)
+        # Watch-delta path: removal rides the next frame.
+        master.record_updated_kvcaches("i1", KvCacheEvent(removed=hashes))
+        master.upload_kvcache()
+        assert wait_until(lambda: replica.match(toks).scores == {})
+        # Reverse index mirrored too (replica may be promoted later).
+        assert "i1" not in replica._by_instance
+        master.stop(); replica.stop(); rc.close()
+
+    def test_replica_bootstrap_corrupt_value_skips_only_that_key(
+            self, coord, store):
+        """Corrupt legacy JSON value AND corrupt frame: each skips only
+        itself; every healthy key still loads."""
+        from xllm_service_tpu.rpc import CACHE_FRAME_KEY_PREFIX, CACHE_KEY_PREFIX
+
+        toks = self._toks(2)
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        good = '{"hbm": ["i1"], "dram": [], "ssd": []}'
+        coord.bulk_set({
+            CACHE_KEY_PREFIX + hashes[0]: good,
+            CACHE_KEY_PREFIX + hashes[1]: "{not json",
+            CACHE_FRAME_KEY_PREFIX + "%020d" % 0: "!!!not-a-frame!!!",
+        })
+        replica = GlobalKVCacheMgr(coord, block_size=BLOCK, is_master=False)
+        ov = replica.match(toks)
+        assert ov.scores == {"i1": pytest.approx(1.0)}
+        assert ov.matched_blocks == 1
+        replica.stop()
+
+    def test_upload_never_resurrects_key_removed_mid_upload(
+            self, coord, store):
+        """dirty/removed race: remove_instance lands while upload_kvcache
+        is mid-bulk_set. The ordered frame log must converge every
+        consumer to 'key absent' after the next sync tick."""
+        master = GlobalKVCacheMgr(coord, block_size=BLOCK, is_master=True)
+        toks = self._toks(1)
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        master.record_updated_kvcaches("i1", KvCacheEvent(stored=hashes))
+
+        real_bulk_set = coord.bulk_set
+        fired = []
+
+        def racing_bulk_set(kvs):
+            ok = real_bulk_set(kvs)
+            if not fired:
+                fired.append(1)
+                master.remove_instance("i1")   # races the in-flight sync
+            return ok
+
+        coord.bulk_set = racing_bulk_set
+        try:
+            master.upload_kvcache()            # frame 0: upsert (stale)
+            master.upload_kvcache()            # frame 1: removal
+        finally:
+            coord.bulk_set = real_bulk_set
+        # Master's own index never resurrected the key.
+        assert master.match(toks).scores == {}
+        rc = InMemoryCoordination(store)
+        replica = GlobalKVCacheMgr(rc, block_size=BLOCK, is_master=False)
+        assert replica.match(toks).scores == {}
+        master.stop(); replica.stop(); rc.close()
+
+    def test_flip_coherent_through_concurrent_ingest_and_watch(
+            self, coord, store):
+        """set_as_master/set_as_replica churn while a master keeps
+        syncing and heartbeats keep ingesting: the flipped node must end
+        byte-coherent with the live master's view (and upload_kvcache
+        must never resurrect keys removed during the churn)."""
+        import threading
+
+        master = GlobalKVCacheMgr(coord, block_size=BLOCK, is_master=True)
+        rc = InMemoryCoordination(store)
+        node = GlobalKVCacheMgr(rc, block_size=BLOCK, is_master=False)
+
+        stop = threading.Event()
+        prompts = [[t + 1_000_000 * i for t in self._toks(2)]
+                   for i in range(8)]
+        chains = [prefix_block_hash_hexes(p, BLOCK) for p in prompts]
+
+        def churn_master():
+            i = 0
+            while not stop.is_set():
+                inst = f"e{i % 3}"
+                master.record_updated_kvcaches(
+                    inst, KvCacheEvent(stored=chains[i % len(chains)]))
+                if i % 5 == 4:
+                    master.remove_instance(inst)
+                master.upload_kvcache()
+                i += 1
+                time.sleep(0.001)   # don't starve the watch dispatcher
+
+        def churn_flip():
+            while not stop.is_set():
+                node.set_as_master()
+                node.set_as_replica()
+                time.sleep(0.002)
+
+        ts = [threading.Thread(target=churn_master),
+              threading.Thread(target=churn_flip)]
+        for t in ts:
+            t.start()
+        time.sleep(0.8)
+        stop.set()
+        for t in ts:
+            t.join()
+        # Settle: node as replica, master pushes one final full log pass.
+        node.set_as_replica()
+        master.upload_kvcache()
+
+        def rows(mgr):
+            while True:
+                try:
+                    blocks = mgr._snapshot.blocks
+                    return {h: blocks[h].to_row() for h in list(blocks)
+                            if h in blocks}
+                except RuntimeError:
+                    continue   # raced a delta apply; re-read
+
+        def coherent():
+            return rows(master) == rows(node)
+
+        assert wait_until(coherent, timeout=8.0), (
+            f"index diverged: master={master.num_blocks()} "
+            f"node={node.num_blocks()}")
+        master.stop(); node.stop(); rc.close()
+
+    def test_compaction_prune_does_not_drop_legacy_blocks_on_replicas(
+            self, coord, store):
+        """Mixed-version transition: the index was synced as legacy
+        per-block JSON keys; a new-build master compacts to a full frame
+        and prunes the legacy keys. A watching replica must end with the
+        full frame's blocks — the prune DELETEs must not land after the
+        frame install (ordering regression)."""
+        from xllm_service_tpu.rpc import CACHE_KEY_PREFIX
+
+        toks = self._toks(2)
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        coord.bulk_set({
+            CACHE_KEY_PREFIX + h: '{"hbm": ["i1"], "dram": [], "ssd": []}'
+            for h in hashes})
+        rc = InMemoryCoordination(store)
+        replica = GlobalKVCacheMgr(rc, block_size=BLOCK, is_master=False)
+        assert replica.match(toks).scores.get("i1") == pytest.approx(2.0)
+        # New-build master bootstraps from the legacy keys, is promoted,
+        # and compacts (promotion forces the next upload to be full).
+        master = GlobalKVCacheMgr(coord, block_size=BLOCK, is_master=False)
+        master.set_as_master()
+        master.upload_kvcache()
+        assert wait_until(
+            lambda: not any(k for k in rc.get_prefix(CACHE_KEY_PREFIX)
+                            if "FRAME:" not in k))   # legacy keys pruned
+        # The replica must still serve the blocks (from the full frame).
+        assert wait_until(
+            lambda: replica.match(toks).scores.get("i1") == 2.0), \
+            f"replica lost blocks after compaction: {replica.match(toks)}"
+        master.stop(); replica.stop(); rc.close()

@@ -1,0 +1,73 @@
+"""Tier-1 cache-aware-routing data-plane budget gate.
+
+Runs the kvcache routing bench (in-process, no subprocesses) with a small
+workload and DELIBERATELY generous ceilings — like the master hot-path
+budget test, the point is to catch an order-of-magnitude regression (a
+lock sneaking back onto the match path, per-match re-hashing, an O(index)
+eviction), not to assert the full-scale numbers. Those live in
+BENCH_kvcache_r07.json (8 instances x 100k blocks: 17.7x/27.6x match
+speedup, 3.85x hashing).
+"""
+
+import pytest
+
+from benchmarks.kvcache_routing_bench import run_hashing_bench, run_index_bench
+from xllm_service_tpu.common.hashing import native_available
+
+# Generous CI ceilings: order-of-magnitude guards, not perf targets.
+MATCH_P50_CEILING_MS = 2.0          # measured ~0.01-0.02 ms
+MIN_MATCH_SPEEDUP = 2.0             # measured 10-28x
+MIN_INGEST_KEYS_PER_S = 5_000       # measured ~130-150k/s
+MIN_NATIVE_HASH_SPEEDUP = 1.5       # measured 3.1-3.9x with the C ext
+
+
+@pytest.fixture(scope="module")
+def report():
+    return run_index_bench(n_instances=4, blocks_per_instance=5_000,
+                           n_prompts=64, chain_len=16, threads=4, rounds=2)
+
+
+def test_match_latency_budget(report):
+    p50 = report["match_new"]["p50_ms"]
+    assert p50 < MATCH_P50_CEILING_MS, (
+        f"lock-free match p50 {p50:.3f} ms blew the CI budget "
+        f"({MATCH_P50_CEILING_MS} ms) — did a lock or per-match hashing "
+        f"sneak back onto the read path? Run "
+        f"benchmarks/kvcache_routing_bench.py for the full table.")
+
+
+def test_match_speedup_over_legacy(report):
+    s1 = report["match_speedup_1t"]
+    assert s1 >= MIN_MATCH_SPEEDUP, (
+        f"match speedup over the pre-PR locked flat dict fell to {s1}x "
+        f"(floor {MIN_MATCH_SPEEDUP}x)")
+
+
+def test_ingest_throughput_budget(report):
+    keys_s = report["ingest_new_keys_per_s"]
+    assert keys_s >= MIN_INGEST_KEYS_PER_S, (
+        f"heartbeat ingest throughput {keys_s}/s below floor "
+        f"({MIN_INGEST_KEYS_PER_S}/s)")
+
+
+def test_eviction_is_not_full_scan(report):
+    # O(blocks owned): with 4 equal instances the new removal must not
+    # cost more than a legacy full-index walk (it touches 1/4 the keys;
+    # allow 1.5x for constant-factor noise on a loaded CI box).
+    new_ms = report["remove_instance_new_ms"]
+    legacy_ms = report["remove_instance_legacy_ms"]
+    assert new_ms < legacy_ms * 1.5, (
+        f"remove_instance {new_ms} ms vs legacy full-scan {legacy_ms} ms "
+        f"— reverse index not engaged?")
+
+
+def test_hashing_speedup():
+    r = run_hashing_bench(iters=100, rounds=3)
+    if native_available():
+        assert r["speedup"] >= MIN_NATIVE_HASH_SPEEDUP, (
+            f"native chained hashing speedup fell to {r['speedup']}x "
+            f"(floor {MIN_NATIVE_HASH_SPEEDUP}x): {r}")
+    else:
+        # Pure-Python fallback: batched conversion must at least not
+        # regress materially vs the old per-slice loop.
+        assert r["speedup"] >= 0.7, r

@@ -213,8 +213,8 @@ class TestMropePrefixCache:
         real_match = engine.page_mgr.match_prefix
         hits = []
 
-        def spy(tokens):
-            res = real_match(tokens)
+        def spy(tokens, **kw):
+            res = real_match(tokens, **kw)
             hits.append(res[0])
             return res
 

@@ -42,6 +42,16 @@ class ServiceOptions:
     load_balance_policy: str = "RR"   # RR | CAR | SLO_AWARE
     block_size: int = 128             # prefix-hash block (`global_gflags.cpp:114-116`)
     max_waiting_requests: int = 1024  # CAR normalization denominator
+    # CAR tier weights: what one matched block is worth per residence tier
+    # (HBM hits reuse directly; DRAM/SSD hits pay an onload first). Fed to
+    # GlobalKVCacheMgr, which bakes them into the per-block score tuples.
+    tier_weight_hbm: float = 1.0
+    tier_weight_dram: float = 0.6
+    tier_weight_ssd: float = 0.3
+    # Master→coordination KV-index sync: delta frames per full-state
+    # compaction (scheduler/global_kvcache_mgr.py). Lower = replicas
+    # bootstrap faster; higher = less periodic full-upload work.
+    kvcache_frame_compact_every: int = 64
     # SLO targets, live-reloadable (`global_gflags.cpp:122-132`).
     target_ttft_ms: float = 1000.0
     target_tpot_ms: float = 50.0

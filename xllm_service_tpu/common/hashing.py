@@ -9,14 +9,26 @@ schedulers, replicas) computes identical keys for identical token prefixes.
 
 We use BLAKE2b-128 keyed with the previous block hash via Python's hashlib
 (C-speed, battle-tested, dependency-free). An optional C extension
-(`csrc/blockhash.c`) implements the same construction for the native
-orchestration components; both produce identical digests.
+(`csrc/blockhash.c`, built as ``libblockhash.so`` and loaded via ctypes)
+implements the same construction for the native orchestration components;
+both produce identical digests (tests/test_common.py asserts equivalence).
+
+The hot entry point is :func:`prefix_block_hashes`: the token list is
+converted ONCE (one ``np.asarray`` + one ``tobytes``), and per-block work
+is either a single zero-copy ``memoryview`` slice into a one-shot keyed
+``blake2b`` call, or — when the extension is present — one FFI call that
+runs the whole chain in C. :func:`extend_prefix_block_hashes` continues a
+chain incrementally, so callers that memoize hashes (``Request``) pay only
+for blocks appended since the last call.
 """
 
 from __future__ import annotations
 
+import ctypes
 import hashlib
-from typing import Iterable, Sequence
+import os
+from pathlib import Path
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -26,12 +38,88 @@ HASH_NBYTES = 16
 _SEED = b"xllm-service-tpu"
 
 
+def _load_native():
+    """Optional csrc/libblockhash.so (``make -C csrc libblockhash.so``).
+    Returns (buffer_fn, list_fn) — either may be None; ``list_fn`` ingests
+    a Python token sequence directly (the list→int32 conversion dominates
+    the hashlib path, so it runs in C too, GIL held via PyDLL).
+    ``XLLM_NO_NATIVE_HASH=1`` forces the pure-Python path (the equivalence
+    tests use it)."""
+    if os.environ.get("XLLM_NO_NATIVE_HASH", "") not in ("", "0"):
+        return None, None
+    so = Path(__file__).resolve().parents[2] / "csrc" / "libblockhash.so"
+    try:
+        lib = ctypes.CDLL(str(so))
+    except OSError:
+        return None, None
+    try:
+        buf_fn = lib.chained_block_hashes
+    except AttributeError:
+        return None, None
+    buf_fn.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+                       ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+    buf_fn.restype = None
+    list_fn = None
+    try:
+        # PyDLL: keeps the GIL held across the call — the entry point uses
+        # CPython APIs to read the token sequence.
+        list_fn = ctypes.PyDLL(str(so)).chained_block_hashes_list
+        list_fn.argtypes = [ctypes.py_object, ctypes.c_ssize_t,
+                            ctypes.py_object]
+        list_fn.restype = ctypes.py_object
+    except (OSError, AttributeError):
+        list_fn = None
+    return buf_fn, list_fn
+
+
+_NATIVE, _NATIVE_LIST = _load_native()
+
+
+def native_available() -> bool:
+    return _NATIVE is not None
+
+
 def hash_block(prev: bytes, token_ids: Sequence[int]) -> bytes:
     """Hash one token block chained onto ``prev`` (b"" for the first block)."""
     key = prev if prev else _SEED
-    h = hashlib.blake2b(digest_size=HASH_NBYTES, key=key)
-    h.update(np.asarray(token_ids, dtype=np.int32).tobytes())
-    return h.digest()
+    data = np.asarray(token_ids, dtype=np.int32).tobytes()
+    return hashlib.blake2b(data, digest_size=HASH_NBYTES, key=key).digest()
+
+
+def _chain(buf: bytes, n_blocks: int, block_bytes: int,
+           seed: bytes) -> list[bytes]:
+    """Chained keyed BLAKE2b-128 over ``n_blocks`` slices of ``buf``."""
+    if _NATIVE is not None:
+        out = ctypes.create_string_buffer(n_blocks * HASH_NBYTES)
+        _NATIVE(buf, n_blocks, block_bytes, seed, len(seed), out)
+        raw = out.raw
+        return [raw[i * HASH_NBYTES:(i + 1) * HASH_NBYTES]
+                for i in range(n_blocks)]
+    blake2b = hashlib.blake2b
+    mv = memoryview(buf)
+    prev = seed
+    hashes: list[bytes] = []
+    for i in range(n_blocks):
+        prev = blake2b(mv[i * block_bytes:(i + 1) * block_bytes],
+                       digest_size=HASH_NBYTES, key=prev).digest()
+        hashes.append(prev)
+    return hashes
+
+
+def _hash_tokens(token_seq: Sequence[int], block_size: int,
+                 seed: bytes) -> list[bytes]:
+    if _NATIVE_LIST is not None and not isinstance(token_seq, np.ndarray):
+        # List fast path: the element-by-element int32 conversion runs in
+        # C (it costs ~25x the hash chain itself when done via np.asarray).
+        raw = _NATIVE_LIST(token_seq, block_size, seed)
+        return [raw[i:i + HASH_NBYTES]
+                for i in range(0, len(raw), HASH_NBYTES)]
+    arr = np.asarray(token_seq, dtype=np.int32)
+    n_blocks = len(arr) // block_size
+    if n_blocks == 0:
+        return []
+    buf = arr[:n_blocks * block_size].tobytes()
+    return _chain(buf, n_blocks, block_size * 4, seed)
 
 
 def prefix_block_hashes(
@@ -44,14 +132,27 @@ def prefix_block_hashes(
     """
     if block_size <= 0:
         raise ValueError(f"block_size must be positive, got {block_size}")
-    arr = np.asarray(token_ids, dtype=np.int32)
-    n_blocks = len(arr) // block_size
-    out: list[bytes] = []
-    prev = b""
-    for i in range(n_blocks):
-        prev = hash_block(prev, arr[i * block_size : (i + 1) * block_size])
-        out.append(prev)
-    return out
+    return _hash_tokens(token_ids, block_size, _SEED)
+
+
+def extend_prefix_block_hashes(
+    prev_hashes: Sequence[bytes], token_ids: Sequence[int],
+    block_size: int = DEFAULT_BLOCK_SIZE,
+) -> list[bytes]:
+    """Continue a memoized chain: ``prev_hashes`` are the hashes of the
+    first ``len(prev_hashes)`` blocks of ``token_ids`` (the caller
+    guarantees that prefix is unchanged — true for append-only growth like
+    failover prompt extension); only the blocks beyond them are hashed.
+    """
+    if block_size <= 0:
+        raise ValueError(f"block_size must be positive, got {block_size}")
+    done = len(prev_hashes)
+    n_blocks = len(token_ids) // block_size
+    if done >= n_blocks:
+        return list(prev_hashes[:n_blocks])
+    seed = bytes(prev_hashes[-1]) if done else _SEED
+    tail = token_ids[done * block_size:n_blocks * block_size]
+    return list(prev_hashes) + _hash_tokens(tail, block_size, seed)
 
 
 def prefix_block_hash_hexes(
@@ -66,3 +167,17 @@ def to_hex(h: bytes) -> str:
 
 def from_hex(s: str) -> bytes:
     return bytes.fromhex(s)
+
+
+def as_key(h: "bytes | str") -> Optional[bytes]:
+    """Normalize a wire-carried block key — raw 16 bytes (msgpack path) or
+    a hex string (legacy JSON path) — to the canonical bytes form. Returns
+    None for garbage (callers skip the key rather than poison the index).
+    """
+    if isinstance(h, bytes):
+        return h if len(h) == HASH_NBYTES else None
+    try:
+        b = bytes.fromhex(h)
+    except (ValueError, TypeError):
+        return None
+    return b if len(b) == HASH_NBYTES else None

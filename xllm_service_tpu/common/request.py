@@ -10,6 +10,7 @@ import enum
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from .hashing import extend_prefix_block_hashes, prefix_block_hashes
 from .types import Routing, RequestMetrics, now_ms
 
 
@@ -269,6 +270,33 @@ class Request:
     # their spans correctly. `Any` to keep this module import-light.
     span: Optional[Any] = None
     trace: Optional[Any] = None
+    # Memoized chained block hashes of token_ids (common/hashing.py):
+    # computed once (scheduler.tokenize stage warms it for CAR) and reused
+    # by the CAR match, failover re-selection and any writeback path —
+    # token_ids only ever GROWS for a live request (failover prompt
+    # extension), so the chain extends incrementally.
+    _block_hashes: Optional[list] = field(default=None, init=False,
+                                          repr=False)
+    _hash_block_size: int = field(default=0, init=False, repr=False)
+
+    def prefix_hashes(self, block_size: int) -> list:
+        """Chained block hashes of ``token_ids``, memoized on the request.
+        Safe because a request's token prefix is append-only; a different
+        ``block_size`` (config reload between calls) recomputes."""
+        n_blocks = len(self.token_ids) // block_size if block_size > 0 else 0
+        cached = self._block_hashes
+        if cached is not None and self._hash_block_size == block_size:
+            if len(cached) == n_blocks:
+                return cached
+            if len(cached) < n_blocks:
+                cached = extend_prefix_block_hashes(
+                    cached, self.token_ids, block_size)
+                self._block_hashes = cached
+                return cached
+        cached = prefix_block_hashes(self.token_ids, block_size)
+        self._hash_block_size = block_size
+        self._block_hashes = cached
+        return cached
 
     def touch(self) -> None:
         self.latest_generate_time_ms = now_ms()

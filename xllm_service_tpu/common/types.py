@@ -20,7 +20,7 @@ import enum
 import json
 import time
 from dataclasses import dataclass, field, asdict
-from typing import Any
+from typing import Any, Sequence
 
 
 def now_ms() -> int:
@@ -211,16 +211,30 @@ class KvCacheEvent:
     """Delta of the instance's prefix-cache content, carried in heartbeats.
 
     Reference: `xllm_rpc_service.proto:48-53` KvCacheEvent {stored/removed/
-    offload_cache blobs}. Hashes are hex strings of the 16-byte chained block
-    hash (common/hashing.py).
+    offload_cache blobs}. Keys are the 16-byte chained block hash
+    (common/hashing.py): raw ``bytes`` on the msgpack heartbeat wire
+    (half the bytes, no hex codec on either end), hex ``str`` on the
+    legacy JSON wire. Each list is homogeneous; consumers normalize via
+    ``hashing.as_key``. ``to_dict`` renders hex (JSON-safe),
+    ``to_wire_dict`` renders raw bytes (msgpack-only).
     """
 
-    stored: list[str] = field(default_factory=list)
-    removed: list[str] = field(default_factory=list)
-    offloaded: list[str] = field(default_factory=list)
+    stored: list = field(default_factory=list)
+    removed: list = field(default_factory=list)
+    offloaded: list = field(default_factory=list)
 
     def empty(self) -> bool:
         return not (self.stored or self.removed or self.offloaded)
+
+    @staticmethod
+    def _hexes(keys: list) -> list[str]:
+        return [k.hex() if isinstance(k, (bytes, bytearray)) else k
+                for k in keys]
+
+    @staticmethod
+    def _raws(keys: list) -> list[bytes]:
+        return [bytes(k) if isinstance(k, (bytes, bytearray))
+                else bytes.fromhex(k) for k in keys]
 
     def merge(self, other: "KvCacheEvent") -> None:
         """Union of two replicas' deltas (dp_size>1: the instance-level
@@ -237,11 +251,21 @@ class KvCacheEvent:
                            if h not in set(self.offloaded)]
 
     def to_dict(self) -> dict[str, Any]:
-        return asdict(self)
+        """JSON-safe form: hex-string keys (legacy heartbeat wire)."""
+        return {"stored": self._hexes(self.stored),
+                "removed": self._hexes(self.removed),
+                "offloaded": self._hexes(self.offloaded)}
+
+    def to_wire_dict(self) -> dict[str, Any]:
+        """msgpack form: raw 16-byte keys (binary heartbeat wire)."""
+        return {"stored": self._raws(self.stored),
+                "removed": self._raws(self.removed),
+                "offloaded": self._raws(self.offloaded)}
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "KvCacheEvent":
-        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+        return cls(**{k: list(v) for k, v in d.items()
+                      if k in cls.__dataclass_fields__ and v is not None})
 
 
 class CacheTier(str, enum.Enum):
@@ -277,6 +301,15 @@ class CacheLocations:
     def from_dict(cls, d: dict[str, Any]) -> "CacheLocations":
         return cls(hbm=set(d.get("hbm", ())), dram=set(d.get("dram", ())), ssd=set(d.get("ssd", ())))
 
+    def to_row(self) -> list[list[str]]:
+        """Compact positional [hbm, dram, ssd] form for binary KV frames
+        (rpc/wire.py encode_kv_frame) — no per-entry field names."""
+        return [sorted(self.hbm), sorted(self.dram), sorted(self.ssd)]
+
+    @classmethod
+    def from_row(cls, row: Sequence[Any]) -> "CacheLocations":
+        return cls(hbm=set(row[0]), dram=set(row[1]), ssd=set(row[2]))
+
 
 @dataclass
 class OverlapScores:
@@ -286,6 +319,9 @@ class OverlapScores:
     # instance name -> number of matched KV blocks (per tier-weighted score).
     scores: dict[str, float] = field(default_factory=dict)
     max_block_num: int = 0
+    # Depth of the matched prefix: how many leading full blocks were found
+    # in the global index before the first miss (the radix-walk depth).
+    matched_blocks: int = 0
 
 
 @dataclass

@@ -13,6 +13,12 @@ Capability parity with the reference's `EtcdClient`
 - `add_watch(prefix, cb)` recursive prefix watch with cancel
   (`etcd_client.cpp:221-259`).
 - `create_if_absent` — master-election primitive (`scheduler.cpp:72-76`).
+
+Values are opaque strings on every backend (memory, native C++ server,
+etcd) and must survive JSON framing, so binary payloads are ASCII-wrapped
+by the producer — the KV-index sync frames are base64(msgpack)
+(`rpc/wire.py encode_kv_frame`); one frame key per master sync tick
+replaces the per-block JSON values the index used to write.
 """
 
 from __future__ import annotations

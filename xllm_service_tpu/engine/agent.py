@@ -357,6 +357,13 @@ class EngineAgent:
         self.name = f"{agent_cfg.host}:{self.port}"
         self.incarnation_id = uuid.uuid4().hex[:12]
         self.instance_type = agent_cfg.instance_type
+        # Heartbeat wire format: msgpack (KV-event keys ride as raw 16
+        # bytes) until a legacy master rejects it, then JSON for the rest
+        # of THAT master's life — a new master (failover/re-election) may
+        # be a newer build, so the demotion resets when the master
+        # address changes.
+        self._hb_wire = dispatch_wire.WIRE_MSGPACK
+        self._hb_master = ""
         # Pass the agent itself: cancel() fans out across replicas.
         self.streamer = GenerationStreamer(self,
                                            agent_cfg.generation_flush_ms)
@@ -630,13 +637,42 @@ class EngineAgent:
                         "recent_max_tbt": max(
                             e.recent_max_tbt_ms for e in self.engines),
                     },
-                    "kv_cache_event": ev.to_dict(),
                 }
                 for eng in self.engines:
                     eng.recent_max_ttft_ms = 0.0
                     eng.recent_max_tbt_ms = 0.0
-                _requests.post(f"http://{master}/rpc/heartbeat",
-                               json=payload, timeout=3)
+                # Binary heartbeat wire: KV-event block keys ride as raw
+                # 16-byte msgpack bins (half the bytes of hex, no codec on
+                # either end). A legacy master can't parse it and answers
+                # 400/415 — demote to the JSON form (hex keys) and re-send
+                # this delta so it isn't lost (heartbeat replay is
+                # idempotent: the index applies absolute tier moves).
+                if master != self._hb_master:
+                    # New master (election/failover): re-probe msgpack.
+                    self._hb_master = master
+                    self._hb_wire = dispatch_wire.WIRE_MSGPACK
+                fmt = self._hb_wire
+                payload["kv_cache_event"] = (
+                    ev.to_wire_dict() if fmt == dispatch_wire.WIRE_MSGPACK
+                    else ev.to_dict())
+                body, ctype = dispatch_wire.encode_dispatch(payload, fmt)
+                r = _requests.post(f"http://{master}/rpc/heartbeat",
+                                   data=body,
+                                   headers={"Content-Type": ctype},
+                                   timeout=3)
+                if r.status_code in (400, 415) \
+                        and fmt == dispatch_wire.WIRE_MSGPACK:
+                    logger.warning(
+                        "master rejected msgpack heartbeat (%d); demoting "
+                        "to JSON wire", r.status_code)
+                    self._hb_wire = dispatch_wire.WIRE_JSON
+                    payload["kv_cache_event"] = ev.to_dict()
+                    body, ctype = dispatch_wire.encode_dispatch(
+                        payload, dispatch_wire.WIRE_JSON)
+                    _requests.post(f"http://{master}/rpc/heartbeat",
+                                   data=body,
+                                   headers={"Content-Type": ctype},
+                                   timeout=3)
             except Exception:  # noqa: BLE001
                 logger.exception("heartbeat failed")
 

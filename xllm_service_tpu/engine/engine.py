@@ -56,6 +56,7 @@ from ..common.request import (
     StatusCode,
     Usage,
 )
+from ..common.hashing import prefix_block_hashes
 from ..common.types import KvCacheEvent
 from ..models.base import get_model_family
 from ..parallel.mesh import build_mesh
@@ -1489,9 +1490,13 @@ class InferenceEngine:
         # silently reused across different images.
         if req.mm_embeds is not None:
             matched, cached_pages, cached_hashes = 0, [], []
+            prompt_hashes = None
         else:
+            # Hash the prompt chain ONCE; the match here and the
+            # post-prefill store_prefix writeback share it.
+            prompt_hashes = prefix_block_hashes(prompt, cfg.hash_block_size)
             matched, cached_pages, cached_hashes = \
-                self.page_mgr.match_prefix(prompt)
+                self.page_mgr.match_prefix(prompt, block_hashes=prompt_hashes)
         if matched >= P0:
             drop = (matched - P0) // cfg.hash_block_size + 1
             self.page_mgr.release_prefix(cached_hashes[-drop:])
@@ -1510,7 +1515,8 @@ class InferenceEngine:
             req=req,
             pages=SequencePages(cached_hashes=cached_hashes,
                                 cached_pages=cached_pages,
-                                own_pages=own_pages),
+                                own_pages=own_pages,
+                                block_hashes=prompt_hashes),
             prompt_len=P0, context_len=len(prompt), max_total_len=max_total,
             output_ids=list(req.resume_output_ids),
             emitted_chars=req.resume_emitted_chars,
@@ -1712,7 +1718,8 @@ class InferenceEngine:
         if req.mm_embeds is None:
             stored, donated = self.page_mgr.store_prefix(
                 prompt, seq.pages.all_pages,
-                skip_blocks=cache_matched // cfg.hash_block_size)
+                skip_blocks=cache_matched // cfg.hash_block_size,
+                block_hashes=seq.pages.block_hashes)
             seq.pages.donated_hashes = stored
             seq.pages.donated_pages = donated
 

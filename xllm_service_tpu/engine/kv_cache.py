@@ -100,10 +100,16 @@ class KVPageManager:
         return False
 
     # ---------------------------------------------------------- prefix cache
-    def match_prefix(self, token_ids: Sequence[int]) -> tuple[int, list[int], list[str]]:
+    def match_prefix(self, token_ids: Sequence[int],
+                     block_hashes: Optional[Sequence[bytes]] = None,
+                     ) -> tuple[int, list[int], list[str]]:
         """Longest cached prefix: returns (num_tokens_matched, page_ids,
-        block_hashes) and takes a reference on each matched block."""
-        hashes = prefix_block_hashes(token_ids, self.hash_block_size)
+        block_hashes) and takes a reference on each matched block.
+        Callers that already hashed the prompt pass ``block_hashes``
+        (engine admission computes the chain once and reuses it here and
+        in the post-prefill ``store_prefix`` writeback)."""
+        hashes = (block_hashes if block_hashes is not None
+                  else prefix_block_hashes(token_ids, self.hash_block_size))
         pages: list[int] = []
         matched_hashes: list[str] = []
         with self._lock:
@@ -127,16 +133,21 @@ class KVPageManager:
 
     def store_prefix(self, token_ids: Sequence[int],
                      seq_pages: Sequence[int],
-                     skip_blocks: int = 0) -> tuple[list[str], set[int]]:
+                     skip_blocks: int = 0,
+                     block_hashes: Optional[Sequence[bytes]] = None,
+                     ) -> tuple[list[str], set[int]]:
         """After prefill, donate the sequence's full blocks to the cache.
 
         `seq_pages` are ALL of the sequence's pages in order (shared prefix
         pages first, then private); blocks already matched from cache
-        (skip_blocks) are not re-stored. Returns (stored_hashes,
-        donated_page_ids): donated pages now belong to the cache — the
-        sequence keeps using them under a reference and must not free them.
+        (skip_blocks) are not re-stored. ``block_hashes`` skips re-hashing
+        when the admission path already chained the prompt. Returns
+        (stored_hashes, donated_page_ids): donated pages now belong to the
+        cache — the sequence keeps using them under a reference and must
+        not free them.
         """
-        hashes = prefix_block_hashes(token_ids, self.hash_block_size)
+        hashes = (block_hashes if block_hashes is not None
+                  else prefix_block_hashes(token_ids, self.hash_block_size))
         stored: list[str] = []
         donated: set[int] = set()
         with self._lock:
@@ -181,6 +192,9 @@ class SequencePages:
     own_pages: list[int] = field(default_factory=list)
     donated_hashes: list[str] = field(default_factory=list)
     donated_pages: set[int] = field(default_factory=set)
+    # Full chained hash list of the prompt, computed once at admission and
+    # reused by the post-prefill store_prefix writeback (no re-hash).
+    block_hashes: Optional[list] = None
 
     @property
     def all_pages(self) -> list[int]:

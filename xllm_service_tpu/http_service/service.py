@@ -695,10 +695,19 @@ class XllmHttpService:
 
     # ----------------------------------------------------------- RPC routes
     async def handle_heartbeat(self, request: web.Request) -> web.Response:
+        """Per-instance heartbeat (load/latency metrics + KV-cache event
+        delta). Wire is msgpack by default — KV-event block keys ride as
+        raw 16 bytes instead of hex JSON strings — with the JSON path kept
+        for legacy agents (agents demote themselves when a legacy master
+        rejects their binary heartbeat; see EngineAgent._heartbeat_loop).
+        """
+        body = await request.read()
         try:
-            payload = await request.json()
-        except json.JSONDecodeError:
-            return _error_response(400, "invalid JSON")
+            payload = wire.decode_body(request.content_type, body)
+        except ValueError:
+            return _error_response(400, "invalid payload")
+        if not isinstance(payload, dict):
+            return _error_response(400, "invalid payload")
         known = await asyncio.get_running_loop().run_in_executor(
             None, self.scheduler.handle_instance_heartbeat, payload)
         return web.json_response({"ok": True, "known": known})

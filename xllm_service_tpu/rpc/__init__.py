@@ -18,7 +18,12 @@ __all__ = ["EngineChannel"]
 INSTANCE_KEY_PREFIX = "XLLM:INSTANCE:"       # + "<TYPE>:<name>"
 SERVICE_KEY_PREFIX = "XLLM:SERVICE:"         # + "<ip:rpc_port>"
 MASTER_KEY = "XLLM:SERVICE:MASTER"
-CACHE_KEY_PREFIX = "XLLM:CACHE:"             # + block-hash hex
+CACHE_KEY_PREFIX = "XLLM:CACHE:"             # + block-hash hex (legacy)
+# Binary KV-index delta frames (rpc/wire.py encode_kv_frame): one key per
+# master sync tick, zero-padded monotonic seq so lexicographic order ==
+# apply order. Lives under CACHE_KEY_PREFIX so one watch covers frames
+# AND legacy per-block keys ("FRAME:" cannot collide with hex).
+CACHE_FRAME_KEY_PREFIX = CACHE_KEY_PREFIX + "FRAME:"  # + %020d seq
 LOADMETRICS_KEY_PREFIX = "XLLM:LOADMETRICS:"  # + instance name
 
 

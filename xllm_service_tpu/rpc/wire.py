@@ -32,6 +32,7 @@ negotiation stays in one place.
 
 from __future__ import annotations
 
+import base64
 import json
 from typing import Any
 
@@ -65,6 +66,12 @@ HOT_PATH_FUNCTIONS: dict[str, str] = {
         "engine agent batched Generations push",
     "FakeEngine._generate":
         "fake-engine Generations push (wire-contract reference impl)",
+    "EngineAgent._heartbeat_loop":
+        "heartbeat push (KV-cache event deltas, raw 16-byte keys)",
+    "FakeEngine._heartbeat_loop":
+        "fake-engine heartbeat push (wire-contract reference impl)",
+    "GlobalKVCacheMgr.upload_kvcache":
+        "master→coordination KV-index sync (binary delta frames)",
 }
 
 
@@ -97,6 +104,46 @@ def decode_body(content_type: str, data: bytes) -> Any:
         except Exception as e:  # msgpack raises library-specific errors
             raise ValueError(f"malformed msgpack body: {e}") from None
     return json.loads(data)
+
+
+# --------------------------------------------------------------- KV frames
+#
+# Master→coordination KV-index sync rides ONE coordination key per sync
+# tick (`XLLM:CACHE:FRAME:<seq>`) whose value is a msgpack-encoded delta
+# batch with raw 16-byte block keys — instead of one JSON-valued key per
+# block. Coordination values are strings, so the binary frame is base64-
+# wrapped (pure ASCII: survives every backend, including the native C++
+# coordination server's JSON framing). Replicas decode one blob per tick
+# and batch-apply; the legacy per-block JSON keys remain readable for
+# mixed-version clusters (global_kvcache_mgr.py).
+
+def encode_kv_frame(upserts: dict[bytes, Any], removals: "list[bytes]",
+                    full: bool = False) -> str:
+    """One sync tick's delta: ``upserts`` maps raw block key → positional
+    [hbm, dram, ssd] instance-name row (CacheLocations.to_row); ``full``
+    marks a compaction frame carrying the entire index (replicas rebuild
+    from it instead of merging)."""
+    frame = {"u": upserts, "r": list(removals)}
+    if full:
+        frame["full"] = True
+    return base64.b64encode(
+        msgpack.packb(frame, use_bin_type=True)).decode("ascii")
+
+
+def decode_kv_frame(value: str) -> "tuple[dict[bytes, Any], list[bytes], bool]":
+    """Inverse of :func:`encode_kv_frame` → (upserts, removals, full).
+    Raises ValueError on a malformed frame (callers skip it)."""
+    try:
+        frame = msgpack.unpackb(base64.b64decode(value), raw=False)
+        if not isinstance(frame, dict):
+            raise TypeError("frame is not a map")
+        upserts = frame.get("u") or {}
+        removals = list(frame.get("r") or ())
+        if not isinstance(upserts, dict):
+            raise TypeError("frame upserts is not a map")
+    except Exception as e:  # base64/msgpack raise library-specific errors
+        raise ValueError(f"malformed kv frame: {e}") from None
+    return upserts, removals, bool(frame.get("full"))
 
 
 def negotiate(wire_formats: Any) -> str:

@@ -200,6 +200,11 @@ class InstanceMgr:
         self._request_loads: dict[str, _RequestLoad] = {}
         self._updated_load_names: set[str] = set()
         self._removed_load_names: set[str] = set()
+        # Published load-info view (RCU, like the routing snapshot):
+        # rebuilt under `_metrics_lock` by every load/latency/membership
+        # writer, read lock-free by CAR / planner / admin. Treat as
+        # immutable.
+        self._load_infos: dict[str, InstanceLoadInfo] = {}
         # Hook for request cancellation on instance death (reference keeps a
         # Scheduler back-pointer, `instance_mgr.h:196-198`).
         self.on_instance_failure: Optional[Callable[[str, str, InstanceType], None]] = None
@@ -227,9 +232,51 @@ class InstanceMgr:
     def _publish_snapshot(self) -> None:
         """Rebuild + atomically publish the routing snapshot. Called by
         every membership/state writer; `_cluster_lock` is reentrant, so
-        writers already holding it republish in place."""
+        writers already holding it republish in place. The load-info view
+        derives from the snapshot (membership/type/schedulable), so it is
+        republished in the same step (nested `_metrics_lock` is fine:
+        lock order 20 → 24, and no path nests them the other way)."""
         with self._cluster_lock:
             self._snapshot = RoutingSnapshot(self._instances)
+            with self._metrics_lock:
+                self._rebuild_load_infos_locked()
+
+    def _rebuild_load_infos_locked(self) -> None:
+        """Rebuild + publish the lock-free load-info view (callers hold
+        `_metrics_lock`; membership comes from the current routing
+        snapshot). Full rebuild — membership writers only; per-heartbeat
+        updates go through :meth:`_update_load_info_locked` (copy-on-write
+        of ONE entry, so a large fleet's heartbeat stream doesn't rebuild
+        O(fleet) objects per beat)."""
+        snap = self._snapshot
+        self._load_infos = {
+            name: self._make_load_info_locked(name, entry, snap)
+            for name, entry in snap.entries.items()}
+
+    def _make_load_info_locked(self, name: str, entry: _Entry,
+                               snap: RoutingSnapshot) -> InstanceLoadInfo:
+        return InstanceLoadInfo(
+            name=name, type=entry.meta.type,
+            load=self._load_metrics.get(name, LoadMetrics()),
+            latency=self._latency_metrics.get(name, LatencyMetrics()),
+            schedulable=name in snap.schedulable)
+
+    def _update_load_info_locked(self, name: str) -> None:
+        """Copy-on-write republish of one instance's load-info entry
+        (callers hold `_metrics_lock`). Unknown names (metrics for an
+        instance the snapshot dropped) are ignored — the membership
+        writer's full rebuild is authoritative."""
+        snap = self._snapshot
+        entry = snap.entries.get(name)
+        if entry is None:
+            if name in self._load_infos:
+                nxt = dict(self._load_infos)
+                nxt.pop(name, None)
+                self._load_infos = nxt
+            return
+        nxt = dict(self._load_infos)
+        nxt[name] = self._make_load_info_locked(name, entry, snap)
+        self._load_infos = nxt
 
     def routing_snapshot(self) -> RoutingSnapshot:
         """The current immutable routing view (lock-free read)."""
@@ -374,6 +421,7 @@ class InstanceMgr:
                 else:
                     self._load_metrics.pop(name, None)
                     self._latency_metrics.pop(name, None)
+                self._update_load_info_locked(name)
 
     # --------------------------------------------------------- registration
     def register_instance(self, meta: InstanceMetaInfo,
@@ -535,6 +583,7 @@ class InstanceMgr:
                 if latency is not None:
                     self._latency_metrics[name] = latency
                 self._updated_load_names.add(name)
+                self._update_load_info_locked(name)
         return True
 
     def _set_state(self, entry: _Entry, state: InstanceRuntimeState) -> None:
@@ -601,20 +650,11 @@ class InstanceMgr:
         return snap.encode[next(self._rr_encode) % len(snap.encode)]
 
     def get_load_infos(self) -> dict[str, InstanceLoadInfo]:
-        """Snapshot for CAR scoring (reference `get_load_metrics`,
-        `instance_mgr.cpp:287-359`). Membership/types come from the
-        routing snapshot (lock-free); only the load/latency maps take
-        `_metrics_lock`."""
-        snap = self._snapshot
-        out: dict[str, InstanceLoadInfo] = {}
-        with self._metrics_lock:
-            for name, entry in snap.entries.items():
-                out[name] = InstanceLoadInfo(
-                    name=name, type=entry.meta.type,
-                    load=self._load_metrics.get(name, LoadMetrics()),
-                    latency=self._latency_metrics.get(name, LatencyMetrics()),
-                    schedulable=name in snap.schedulable)
-        return out
+        """Per-instance view for CAR scoring (reference `get_load_metrics`,
+        `instance_mgr.cpp:287-359`). LOCK-FREE: returns the published
+        view (rebuilt by load/latency/membership writers) — callers must
+        treat it as immutable."""
+        return self._load_infos
 
     def bind_request_instance_incarnations(self, req: Request) -> bool:
         """Reference `instance_mgr.cpp:408-449`: record the incarnations the

@@ -4,6 +4,12 @@ Parity: reference `cache_aware_routing.cpp:22-85` —
 ``score = matched_blocks / max_block_num − hbm_cache_usage_perc −
 waiting / max_waiting`` per candidate, argmax per role; prefix match comes
 from the GlobalKVCacheMgr.
+
+Hot-path contract: this runs on every schedule when CAR is the configured
+policy, so the whole selection is LOCK-FREE — ``match()`` walks the
+RCU-published prefix index with the request's memoized block hashes
+(``Request.prefix_hashes``: hashed once, in the tokenize stage), and
+``get_load_infos()`` reads the instance manager's published load snapshot.
 """
 
 from __future__ import annotations
@@ -25,7 +31,9 @@ class CacheAwareRoutingPolicy(LoadBalancePolicy):
     def select_instances_pair(self, request: Request) -> Routing:
         if not request.token_ids:
             return self._mgr.get_next_instance_pair()
-        overlap = self._kv.match(request.token_ids)
+        overlap = self._kv.match(
+            request.token_ids,
+            block_hashes=request.prefix_hashes(self._opts.block_size))
         infos = self._mgr.get_load_infos()
         max_blocks = max(overlap.max_block_num, 1)
         max_waiting = max(self._opts.max_waiting_requests, 1)
@@ -47,5 +55,14 @@ class CacheAwareRoutingPolicy(LoadBalancePolicy):
             return Routing(prefill_name=best_p.name)
         best_d = max(decodes, key=score)
         if best_d.name == best_p.name:
-            return Routing(prefill_name=best_p.name)
+            # Collision: the top decode candidate is the instance already
+            # chosen for prefill (only a MIX node can appear in both
+            # lists). On a PD-disaggregated fleet, collapsing both stages
+            # onto it would silently drop the decode leg — take the
+            # second-best decode instead, and serve single-instance only
+            # when no other decode exists.
+            others = [i for i in decodes if i.name != best_p.name]
+            if not others:
+                return Routing(prefill_name=best_p.name)
+            best_d = max(others, key=score)
         return Routing(prefill_name=best_p.name, decode_name=best_d.name)

@@ -154,7 +154,8 @@ class Scheduler:
                                         is_master=self.is_master,
                                         start_threads=start_threads)
         self.kvcache_mgr = GlobalKVCacheMgr(self._coord, options.block_size,
-                                            is_master=self.is_master)
+                                            is_master=self.is_master,
+                                            options=options)
         self.instance_mgr.on_instance_failure = self._on_instance_failure
         self.lb_policy = create_policy(options.load_balance_policy,
                                        self.instance_mgr, self.kvcache_mgr,
@@ -324,6 +325,12 @@ class Scheduler:
             with TRACER.span("scheduler.tokenize", ctx=ctx,
                              request_id=sid) as sp:
                 request.token_ids = self.tokenizer.encode(request.prompt)
+                if self._opts.load_balance_policy == "CAR":
+                    # Warm the memoized block hashes here so the cost is
+                    # attributed to the tokenize stage, paid exactly once;
+                    # the CAR match, failover re-selects and replays all
+                    # reuse the cached chain.
+                    request.prefix_hashes(self._opts.block_size)
                 sp.set(prompt_tokens=len(request.token_ids))
         elif request.sampling.echo and not request.prompt \
                 and request.token_ids:

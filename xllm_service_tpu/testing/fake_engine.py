@@ -46,7 +46,8 @@ from ..common.faults import FAULTS
 from ..common.hashing import prefix_block_hash_hexes
 from ..common import tracing as _tracing
 from ..common.tracing import TRACER, TraceContext
-from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
+from ..common.types import (InstanceMetaInfo, InstanceType, KvCacheEvent,
+                            TpuTopology)
 from ..devtools.locks import make_lock
 from ..coordination.base import CoordinationClient
 from ..rpc import instance_key
@@ -105,6 +106,10 @@ class FakeEngine:
         self._stored_hashes: list[str] = []
         self._pending_kv_stored: list[str] = []
         self._kv_lock = make_lock("fake_engine.kv_events", order=64)  # lock-order: 64
+        # Heartbeat wire: msgpack w/ raw KV keys; demoted on legacy
+        # master, re-probed when the master address changes.
+        self._hb_wire = wire.WIRE_MSGPACK
+        self._hb_master = ""
         # Shared pooled session for Generations pushes (the real agent's
         # streamer keeps one too): a fresh TCP connect per delta would
         # charge connection setup to the master+wire span in every bench.
@@ -236,6 +241,10 @@ class FakeEngine:
             with self._kv_lock:
                 stored = self._pending_kv_stored
                 self._pending_kv_stored = []
+            # Wire-contract reference: heartbeats ride msgpack with raw
+            # 16-byte KV-event keys (mirror of EngineAgent._heartbeat_loop,
+            # including the legacy-master JSON demotion).
+            ev = KvCacheEvent(stored=stored)
             payload = {
                 "name": self.name,
                 "incarnation_id": self.incarnation_id,
@@ -246,12 +255,30 @@ class FakeEngine:
                 },
                 "latency_metrics": {"recent_max_ttft": 12.0,
                                     "recent_max_tbt": 4.0},
-                "kv_cache_event": {"stored": stored, "removed": [],
-                                   "offloaded": []},
             }
             try:
-                _requests.post(f"http://{master_addr}/rpc/heartbeat",
-                               json=payload, timeout=2)
+                if master_addr != self._hb_master:
+                    self._hb_master = master_addr
+                    self._hb_wire = wire.WIRE_MSGPACK
+                fmt = self._hb_wire
+                payload["kv_cache_event"] = (
+                    ev.to_wire_dict() if fmt == wire.WIRE_MSGPACK
+                    else ev.to_dict())
+                body, ctype = wire.encode_dispatch(payload, fmt)
+                r = _requests.post(f"http://{master_addr}/rpc/heartbeat",
+                                   data=body,
+                                   headers={"Content-Type": ctype},
+                                   timeout=2)
+                if r.status_code in (400, 415) \
+                        and fmt == wire.WIRE_MSGPACK:
+                    self._hb_wire = wire.WIRE_JSON
+                    payload["kv_cache_event"] = ev.to_dict()
+                    body, ctype = wire.encode_dispatch(payload,
+                                                       wire.WIRE_JSON)
+                    _requests.post(f"http://{master_addr}/rpc/heartbeat",
+                                   data=body,
+                                   headers={"Content-Type": ctype},
+                                   timeout=2)
             except _requests.RequestException:
                 pass
 
