@@ -19,6 +19,15 @@ percentiles, so before/after comparisons run the same driver.
 
     python benchmarks/master_hotpath_bench.py --requests 256 --concurrency 8
 
+``--masters N`` spawns an active-active multi-master plane (every process
+an active frontend, the first holds the write lease; multimaster/) and
+spreads the driver's workers across the frontends — the multi-master
+rps-scaling run. The report then carries per-frontend ownership/mining
+stats and per-process CPU attribution over the drive window (on a small
+box aggregate rps saturates on total CPU, so the scaling evidence is
+each of N masters doing ~1/N of the frontend work at a constant
+master-CPU-ms-per-request).
+
 The tier-1 budget test (tests/test_master_hotpath_budget.py) runs
 ``run_bench`` with a small workload and a generous ceiling to catch
 order-of-magnitude regressions without flaking on CI noise.
@@ -60,6 +69,16 @@ def free_port() -> int:
     return port
 
 
+def _proc_cpu_s(pid: int) -> float:
+    """utime+stime of one process in seconds (0.0 if unreadable)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().split()
+        return (int(parts[13]) + int(parts[14])) / os.sysconf("SC_CLK_TCK")
+    except (OSError, ValueError, IndexError):
+        return 0.0
+
+
 # ~1 KiB prompt -> 1024 token ids through the byte-level SimpleTokenizer:
 # the enriched dispatch payload carries a multi-thousand-byte token_ids
 # list, which is exactly the wire cost this bench exists to attribute.
@@ -70,16 +89,33 @@ def _make_prompt(n_chars: int) -> str:
     return (_PROMPT_WORD * (n_chars // len(_PROMPT_WORD) + 1))[:n_chars]
 
 
-def drive(base: str, args) -> dict:
-    """Fire the streaming workload at the master and collect client-side
-    TTFT/E2E percentiles plus the master's per-stage span table."""
+def drive(base, args) -> dict:
+    """Fire the streaming workload at the master(s) and collect
+    client-side TTFT/E2E percentiles plus the per-stage span table.
+    `base` may be one URL or a list (multi-master: workers spread
+    round-robin across the active frontends)."""
+    bases = [base] if isinstance(base, str) else list(base)
     prompt = _make_prompt(args.prompt_chars)
+    # Heterogeneous mix (the CAR-default soak): every request gets a
+    # UNIQUE prompt (index prefix changes block 0, so the whole hash
+    # chain differs -> zero prefix overlap, CAR's worst case) at one of
+    # three lengths. Identical-prompt mode (default) is the cache-hot
+    # best case.
+    distinct = bool(getattr(args, "distinct_prompts", False))
+
+    def prompt_for(k: int) -> str:
+        if not distinct:
+            return prompt
+        n = (args.prompt_chars // 2, args.prompt_chars,
+             args.prompt_chars * 2)[k % 3]
+        return f"{k:08d}" + _make_prompt(n - 8)
 
     # Warmup: prime connection pools, lazy imports, the schedule executor.
-    for _ in range(4):
-        requests.post(base + "/v1/completions", json={
-            "model": "fake-model", "prompt": prompt, "max_tokens": 4,
-            "stream": True}, timeout=30).close()
+    for b in bases:
+        for _ in range(4):
+            requests.post(b + "/v1/completions", json={
+                "model": "fake-model", "prompt": prompt, "max_tokens": 4,
+                "stream": True}, timeout=30).close()
 
     ttfts, e2es, errors = [], [], [0]
     lock = threading.Lock()
@@ -87,8 +123,9 @@ def drive(base: str, args) -> dict:
     rps = getattr(args, "rps", 0.0) or 0.0
     pace_start = time.perf_counter() + 0.05
 
-    def worker():
+    def worker(wbase):
         session = requests.Session()
+        base = wbase
         while True:
             with lock:
                 if not work:
@@ -109,7 +146,7 @@ def drive(base: str, args) -> dict:
                 t0 = time.perf_counter()
             try:
                 r = session.post(base + "/v1/completions", json={
-                    "model": "fake-model", "prompt": prompt,
+                    "model": "fake-model", "prompt": prompt_for(k),
                     "max_tokens": args.max_tokens, "stream": True},
                     stream=True, timeout=60)
                 ttft = None
@@ -131,8 +168,8 @@ def drive(base: str, args) -> dict:
                     errors[0] += 1
 
     t_start = time.perf_counter()
-    threads = [threading.Thread(target=worker)
-               for _ in range(args.concurrency)]
+    threads = [threading.Thread(target=worker, args=(bases[i % len(bases)],))
+               for i in range(args.concurrency)]
     for t in threads:
         t.start()
     for t in threads:
@@ -141,6 +178,7 @@ def drive(base: str, args) -> dict:
 
     report = {
         "requests": args.requests,
+        "masters": len(bases),
         "concurrency": args.concurrency,
         "prompt_chars": args.prompt_chars,
         "max_tokens": args.max_tokens,
@@ -157,29 +195,57 @@ def drive(base: str, args) -> dict:
                    "p99": round(percentile(e2es, 99), 2)},
     }
     # Per-stage master span table (absent on pre-PR-4 trees: the client
-    # percentiles above still make the before/after comparison).
+    # percentiles above still make the before/after comparison). Multi-
+    # master: the first frontend's table is representative (workers are
+    # spread evenly); ownership stats show mining hit rate per master.
     try:
-        r = requests.get(base + "/admin/hotpath", timeout=5)
+        r = requests.get(bases[0] + "/admin/hotpath", timeout=5)
         if r.status_code == 200:
-            report["master_stages_ms"] = r.json().get("stages", {})
+            payload = r.json()
+            report["master_stages_ms"] = payload.get("stages", {})
+            if payload.get("ownership"):
+                report["ownership"] = payload["ownership"]
     except requests.RequestException:
         pass
+    if len(bases) > 1:
+        # Per-frontend ownership/mining stats: the acceptance story needs
+        # the handoff rate (mined-to-self accepts pay no forward hop).
+        per_master = []
+        for b in bases:
+            try:
+                r = requests.get(b + "/admin/hotpath", timeout=5)
+                per_master.append(r.json().get("ownership", {})
+                                  if r.status_code == 200 else {})
+            except requests.RequestException:
+                per_master.append({})
+        report["ownership_per_master"] = per_master
     return report
 
 
 def run_bench(requests_n: int = 256, concurrency: int = 8,
               prompt_chars: int = 1024, max_tokens: int = 16,
               reply_chars: int = 64, rps: float = 0.0,
-              policy: str = "RR", n_engines: int = 1) -> dict:
+              policy: str = "RR", n_engines: int = 1,
+              n_masters: int = 1,
+              master_args: tuple = (),
+              distinct_prompts: bool = False) -> dict:
     """Spawn the multiproc stack, drive it, tear it down. Importable for
     the tier-1 budget test. ``policy`` selects the master's load-balance
     policy (RR | CAR | SLO_AWARE) — the kvcache routing bench drives the
     same harness under RR and CAR to price cache-aware routing on the
-    schedule path; ``n_engines`` > 1 gives the policy a real choice."""
+    schedule path; ``n_engines`` > 1 gives the policy a real choice.
+    ``n_masters`` > 1 spawns an active-active multi-master service plane
+    (every process an active frontend; the first wins the election and
+    carries the write lease) and the driver spreads its workers evenly
+    across the frontends — the multi-master rps-scaling acceptance run."""
+    n_masters = max(1, n_masters)
     args = argparse.Namespace(
         requests=requests_n, concurrency=concurrency,
-        prompt_chars=prompt_chars, max_tokens=max_tokens, rps=rps)
-    coord_port, http_port, rpc_port = free_port(), free_port(), free_port()
+        prompt_chars=prompt_chars, max_tokens=max_tokens, rps=rps,
+        distinct_prompts=distinct_prompts)
+    coord_port = free_port()
+    http_ports = [free_port() for _ in range(n_masters)]
+    rpc_ports = [free_port() for _ in range(n_masters)]
     procs: list[subprocess.Popen] = []
     names: list[str] = []
     logdir = Path(os.environ.get("XLLM_BENCH_LOGDIR", "/tmp"))
@@ -198,12 +264,20 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
                         "xllm_service_tpu.coordination.server",
                         "--port", str(coord_port)])
         time.sleep(0.3)
-        spawn("master", [sys.executable, "-m", "xllm_service_tpu.master",
-                         "--coordination-addr", f"127.0.0.1:{coord_port}",
-                         "--host", "127.0.0.1",
-                         "--http-port", str(http_port),
-                         "--rpc-port", str(rpc_port),
-                         "--load-balance-policy", policy])
+        for i in range(n_masters):
+            spawn(f"master{i}",
+                  [sys.executable, "-m", "xllm_service_tpu.master",
+                   "--coordination-addr", f"127.0.0.1:{coord_port}",
+                   "--host", "127.0.0.1",
+                   "--http-port", str(http_ports[i]),
+                   "--rpc-port", str(rpc_ports[i]),
+                   "--load-balance-policy", policy,
+                   *master_args])
+            if i == 0 and n_masters > 1:
+                # Let master0 win the election deterministically so the
+                # write lease (frames, LOADMETRICS, planner) sits on a
+                # known process for the whole run.
+                time.sleep(0.5)
         for i in range(max(1, n_engines)):
             spawn(f"engine{i}", [sys.executable,
                                  str(REPO / "examples" / "run_fake_engine.py"),
@@ -212,26 +286,47 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
                                  "--reply", "x" * reply_chars,
                                  "--chunk-size", "4", "--delay", "0"])
 
-        base = f"http://127.0.0.1:{http_port}"
+        bases = [f"http://127.0.0.1:{p}" for p in http_ports]
         deadline = time.monotonic() + 60
+        ready: set[str] = set()
         while time.monotonic() < deadline:
             for name, p in zip(names, procs):
                 if p.poll() is not None:
                     raise RuntimeError(
                         f"{name} process died rc={p.returncode} — see "
                         f"{logdir}/hotpath_bench_{name}.log")
-            try:
-                r = requests.post(base + "/v1/completions", json={
-                    "model": "fake-model", "prompt": "ready?",
-                    "max_tokens": 2}, timeout=10)
-                if r.status_code == 200:
-                    break
-            except requests.RequestException:
-                pass
+            for base in bases:
+                if base in ready:
+                    continue
+                try:
+                    r = requests.post(base + "/v1/completions", json={
+                        "model": "fake-model", "prompt": "ready?",
+                        "max_tokens": 2}, timeout=10)
+                    if r.status_code == 200:
+                        ready.add(base)
+                except requests.RequestException:
+                    pass
+            if len(ready) == len(bases):
+                break
             time.sleep(0.25)
         else:
-            raise RuntimeError("fake-engine cluster never became ready")
-        report = drive(base, args)
+            raise RuntimeError(
+                f"cluster never became ready ({len(ready)}/{len(bases)} "
+                f"frontends serving)")
+        cpu0 = {n: _proc_cpu_s(p.pid) for n, p in zip(names, procs)}
+        report = drive(bases if n_masters > 1 else bases[0], args)
+        # Per-process CPU attribution over the drive window: on a small
+        # box the aggregate rps saturates on TOTAL cpu, so the scaling
+        # evidence is each of N masters doing ~1/N of the frontend work
+        # (master CPU-ms per request ~constant while per-master share
+        # drops near-linearly).
+        cpu = {n: round(_proc_cpu_s(p.pid) - cpu0[n], 2)
+               for n, p in zip(names, procs)}
+        report["cpu_s_during_drive"] = cpu
+        served = max(1, args.requests - report.get("errors", 0))
+        master_cpu = sum(v for n, v in cpu.items() if n.startswith("master"))
+        report["master_cpu_ms_per_request"] = round(
+            master_cpu * 1000.0 / served, 2)
         report["policy"] = policy
         report["n_engines"] = max(1, n_engines)
         return report
@@ -263,10 +358,20 @@ def main() -> None:
                     help="master load-balance policy (RR | CAR | SLO_AWARE)")
     ap.add_argument("--engines", type=int, default=1,
                     help="fake engine instances (give CAR a real choice)")
+    ap.add_argument("--masters", type=int, default=1,
+                    help="active frontends (multi-master service plane); "
+                         "workers are spread evenly across them")
+    ap.add_argument("--distinct-prompts", action="store_true",
+                    help="unique prompt per request at 3 lengths (zero "
+                         "prefix overlap — the heterogeneous-mix soak for "
+                         "the CAR default)")
     args = ap.parse_args()
     report = run_bench(args.requests, args.concurrency, args.prompt_chars,
                        args.max_tokens, args.reply_chars, args.rps,
-                       policy=args.policy, n_engines=args.engines)
+                       policy=args.policy, n_engines=args.engines,
+                       n_masters=args.masters,
+                       distinct_prompts=args.distinct_prompts)
+    report["distinct_prompts"] = args.distinct_prompts
     print(json.dumps(report, indent=2))
 
 

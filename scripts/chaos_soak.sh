@@ -6,11 +6,19 @@
 # all randomness from one seeded RNG), so any failing iteration can be
 # replayed exactly with:   XLLM_CHAOS_SEED=<seed> pytest -m chaos
 #
-# Usage: scripts/chaos_soak.sh [iterations] [extra pytest args...]
+# Usage: scripts/chaos_soak.sh [iterations] [--masters] [extra pytest args...]
+#   --masters   soak the multi-master plane drills (tests/test_multimaster.py:
+#               owner/master kill mid-stream, split-brain demotion, write-lease
+#               proxying) instead of the single-master failover drills.
 set -u
 
 ITERS="${1:-20}"
 shift 2>/dev/null || true
+SUITE="tests/test_chaos_failover.py"
+if [ "${1:-}" = "--masters" ]; then
+    SUITE="tests/test_multimaster.py"
+    shift
+fi
 cd "$(dirname "$0")/.."
 
 pass=0
@@ -18,9 +26,9 @@ fail=0
 failed_seeds=()
 for i in $(seq 1 "$ITERS"); do
     seed=$((RANDOM * 32768 + RANDOM))
-    echo "=== chaos iteration $i/$ITERS (seed=$seed) ==="
+    echo "=== chaos iteration $i/$ITERS (seed=$seed, suite=$SUITE) ==="
     if JAX_PLATFORMS=cpu XLLM_CHAOS_SEED=$seed \
-        python -m pytest tests/test_chaos_failover.py -q -m chaos \
+        python -m pytest "$SUITE" -q -m chaos \
         -p no:cacheprovider "$@"; then
         pass=$((pass + 1))
     else

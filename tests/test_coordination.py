@@ -146,6 +146,35 @@ class TestTcpServer:
             timeout=5.0)
         observer.close()
 
+    def test_watch_callback_may_issue_calls(self, server):
+        """Election takeover over TCP: the replica's MASTER-key watch
+        callback itself calls `create_if_absent`. Regression — callbacks
+        used to run ON the reader thread, so that call waited on a
+        response only the (blocked) reader could deliver: the server
+        applied the write, the client timed out believing the election
+        failed, and the unrefreshed key lapsed into a promotion loop that
+        never completed. Callbacks now run on a dedicated dispatcher."""
+        owner = TcpCoordinationClient(f"127.0.0.1:{server.port}")
+        observer = TcpCoordinationClient(f"127.0.0.1:{server.port}",
+                                         timeout_s=2.0)
+        won = threading.Event()
+
+        def takeover(events, _prefix):
+            for e in events:
+                if e.type == WatchEventType.DELETE and e.key == "svc/MASTER":
+                    if observer.create_if_absent("svc/MASTER", "observer",
+                                                 ttl_s=0.3):
+                        won.set()
+
+        observer.add_watch("svc/MASTER", takeover)
+        assert owner.create_if_absent("svc/MASTER", "owner", ttl_s=0.2)
+        owner.close()  # lease lapses -> DELETE -> takeover runs inline
+        assert won.wait(5.0), "election callback deadlocked on its own call"
+        assert observer.get("svc/MASTER") == "observer"
+        time.sleep(0.6)   # keepalive must hold the won key across ttl
+        assert observer.get("svc/MASTER") == "observer"
+        observer.close()
+
     def test_auth(self):
         srv = CoordinationServer(host="127.0.0.1", port=0, auth=("u", "p"))
         srv.start_background()

@@ -1,0 +1,590 @@
+"""Multi-master service plane drills (ISSUE 6).
+
+The acceptance bar: N active frontends serve concurrently off mirrored
+routing state; every request has exactly ONE owning master (rendezvous
+hash of its id), foreign-owned accepts relay through `/rpc/handoff`;
+killing either the elected master or a request's owning frontend
+mid-stream completes the request on a survivor, byte-identical, with one
+`/admin/trace` tree assembled across incarnations and no frame-log
+divergence; a split-brain demotion leaves the demoted master serving its
+streams but publishing nothing.
+
+All in-process (Master + InMemoryCoordination + FakeEngine): the masters
+share the process-global TRACER/metrics registries, which is exactly what
+lets the drills assert one assembled trace tree and counter movement
+without scraping N processes. Chaos drills run green under
+``XLLM_LOCK_DEBUG=1`` (conftest's instrumented-lock guard).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+import requests
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.metrics import (
+    HANDOFF_FORWARDED_TOTAL,
+    HANDOFF_RECOVERIES_TOTAL,
+    HANDOFF_SERVED_TOTAL,
+)
+from xllm_service_tpu.common.hashing import prefix_block_hash_hexes
+from xllm_service_tpu.common.types import InstanceType
+from xllm_service_tpu.coordination.base import WatchEventType
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.multimaster.ownership import OwnershipRouter
+from xllm_service_tpu.rpc import (
+    CACHE_FRAME_KEY_PREFIX,
+    CACHE_KEY_PREFIX,
+    MASTER_KEY,
+    SERVICE_KEY_PREFIX,
+)
+from xllm_service_tpu.scheduler.global_kvcache_mgr import GlobalKVCacheMgr
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import wait_until
+
+REPLY = "Many masters, one owner per request; the stream never notices."
+BLOCK = 16
+
+
+def _opts(**kw) -> ServiceOptions:
+    base = dict(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        lease_ttl_s=0.5, sync_interval_s=0.2,
+        reconcile_interval_s=0.05,
+        heartbeat_silence_to_suspect_s=0.3,
+        detect_disconnected_instance_interval_s=0.3,
+        health_probe_attempts=1, health_probe_timeout_s=0.2,
+        failover_backoff_base_s=0.05, failover_backoff_max_s=0.3,
+        rpc_backoff_base_s=0.02, rpc_backoff_max_s=0.1,
+        # A killed in-process master's aiohttp cleanup can leave the
+        # relay's TCP stream open-but-silent; the stall deadline is what
+        # detects it. Short here so the drills converge fast.
+        handoff_stall_timeout_s=1.5)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def _master(store, **kw) -> Master:
+    m = Master(_opts(**kw), coord=InMemoryCoordination(store))
+    m.start()
+    return m
+
+
+def _engine(store, delay_s=0.0, **cfg_kw) -> FakeEngine:
+    cfg = FakeEngineConfig(reply_text=REPLY, chunk_size=4, delay_s=delay_s,
+                           heartbeat_interval_s=0.1, lease_ttl_s=0.5,
+                           **cfg_kw)
+    return FakeEngine(InMemoryCoordination(store), cfg).start()
+
+
+def _base(m: Master) -> str:
+    return f"http://127.0.0.1:{m.http_port}"
+
+
+def _await_plane(masters, engines) -> None:
+    """Every frontend sees every engine AND the full ownership membership
+    (a relay decision off a partial member set would bounce)."""
+    addrs = {m.scheduler.self_addr for m in masters}
+    assert wait_until(
+        lambda: all(
+            all(m.scheduler.instance_mgr.get_instance_meta(e.name) is not None
+                for e in engines)
+            and set(m.scheduler.ownership.members()) == addrs
+            for m in masters), timeout=5)
+
+
+def _key_owned_by(router: OwnershipRouter, addr: str) -> str:
+    """A client-affinity key whose rendezvous owner is `addr`."""
+    for i in range(10000):
+        k = f"affinity-{i}"
+        if router.owner_of(k) == addr:
+            return k
+    raise AssertionError(f"no key owned by {addr} in 10k draws")
+
+
+def _stream_completion(m: Master, okey=None, after_frames=0, hook=None,
+                       timeout=90):
+    """One streamed completion; optionally fire `hook()` once after
+    `after_frames` data frames (mid-stream chaos trigger). Returns
+    (text, finish_reasons)."""
+    body = {"model": "fake-model", "prompt": "multimaster", "stream": True,
+            "max_tokens": 1000}
+    if okey is not None:
+        body["ownership_key"] = okey
+    r = requests.post(_base(m) + "/v1/completions", json=body,
+                      stream=True, timeout=timeout)
+    assert r.status_code == 200, r.text
+    text, finishes, n, fired = "", [], 0, False
+    for line in r.iter_lines():
+        if not line.startswith(b"data: "):
+            continue
+        data = line[len(b"data: "):]
+        if data == b"[DONE]":
+            break
+        obj = json.loads(data)
+        if "error" in obj:
+            raise RuntimeError(f"stream error: {obj['error']}")
+        for c in obj.get("choices", ()):
+            text += c.get("text", "")
+            if c.get("finish_reason"):
+                finishes.append(c["finish_reason"])
+        n += 1
+        if hook is not None and not fired and n >= after_frames:
+            fired = True
+            hook()
+    return text, finishes
+
+
+def _completion(m: Master, okey=None) -> str:
+    body = {"model": "fake-model", "prompt": "multimaster", "max_tokens": 1000}
+    if okey is not None:
+        body["ownership_key"] = okey
+    r = requests.post(_base(m) + "/v1/completions", json=body, timeout=30)
+    assert r.status_code == 200, r.text
+    return r.json()["choices"][0]["text"]
+
+
+def _kill_async(m: Master) -> threading.Thread:
+    """Stop a master from a background thread (stop() joins its loop; the
+    drill must keep consuming its stream meanwhile)."""
+    t = threading.Thread(target=m.stop, daemon=True)
+    t.start()
+    return t
+
+
+def _blocks(mgr: GlobalKVCacheMgr) -> dict:
+    return {h: loc.to_row() for h, loc in mgr._snapshot.blocks.items()}
+
+
+# ------------------------------------------------------------- ownership unit
+class TestOwnershipRouter:
+    def _routers(self, store, addrs):
+        coord = InMemoryCoordination(store)
+        for a in addrs:
+            coord.set(SERVICE_KEY_PREFIX + a, "{}")
+        routers = [OwnershipRouter(InMemoryCoordination(store), a)
+                   for a in addrs]
+        assert wait_until(lambda: all(
+            set(r.members()) == set(addrs) for r in routers), timeout=5)
+        return coord, routers
+
+    def test_deterministic_across_nodes(self, store):
+        addrs = ["10.0.0.1:1", "10.0.0.2:1", "10.0.0.3:1"]
+        _, routers = self._routers(store, addrs)
+        keys = [f"req-{i}" for i in range(300)]
+        owners = {k: routers[0].owner_of(k) for k in keys}
+        for r in routers[1:]:
+            assert all(r.owner_of(k) == owners[k] for k in keys)
+        # Rendezvous spreads ownership over every member.
+        assert set(owners.values()) == set(addrs)
+
+    def test_successor_moves_only_the_dead_owners_keys(self, store):
+        addrs = ["10.0.0.1:1", "10.0.0.2:1", "10.0.0.3:1"]
+        coord, routers = self._routers(store, addrs)
+        keys = [f"req-{i}" for i in range(300)]
+        before = {k: routers[0].owner_of(k) for k in keys}
+        dead = addrs[2]
+        # exclude= (observed-dead, lease not lapsed): deterministic
+        # successor, identical from every node; unaffected keys stay put.
+        for r in routers[:2]:
+            for k in keys:
+                succ = r.owner_of(k, exclude=[dead])
+                if before[k] != dead:
+                    assert succ == before[k]
+                else:
+                    assert succ != dead
+        # Membership delete (lease lapsed): same successor answer.
+        coord.rm(SERVICE_KEY_PREFIX + dead)
+        assert wait_until(lambda: all(
+            len(r.members()) == 2 for r in routers[:2]), timeout=5)
+        for k in keys:
+            assert routers[0].owner_of(k) == \
+                routers[1].owner_of(k, exclude=[dead])
+
+    def test_election_key_is_not_a_member(self, store):
+        coord = InMemoryCoordination(store)
+        coord.set(MASTER_KEY, "10.0.0.9:1")   # shares the service prefix
+        router = OwnershipRouter(InMemoryCoordination(store), "10.0.0.1:1")
+        coord.set(SERVICE_KEY_PREFIX + "10.0.0.2:1", "{}")
+        assert wait_until(lambda: len(router.members()) == 2, timeout=5)
+        assert "MASTER" not in "".join(router.members())
+        # A DELETE for self (lease blip) must not drop self.
+        coord.rm(SERVICE_KEY_PREFIX + "10.0.0.1:1")
+        time.sleep(0.1)
+        assert "10.0.0.1:1" in router.members()
+
+    def test_mining_yields_self_owned_ids(self, store):
+        addrs = ["10.0.0.1:1", "10.0.0.2:1", "10.0.0.3:1", "10.0.0.4:1"]
+        _, routers = self._routers(store, addrs)
+        r = routers[0]
+        for _ in range(20):
+            sid, owner = r.mine("completion")
+            assert owner == r.self_addr
+            assert r.owner_of(sid) == r.self_addr
+        assert r.stats()["mined"] == 20
+
+    def test_disabled_owns_everything_locally(self, store):
+        r = OwnershipRouter(InMemoryCoordination(store), "10.0.0.1:1",
+                            enabled=False)
+        assert r.owner_of("anything") == "10.0.0.1:1"
+        sid, owner = r.mine("completion")
+        assert owner == "10.0.0.1:1" and sid
+
+
+# ------------------------------------------------- coordination batch revision
+class TestBulkApplyAndCompaction:
+    def test_memory_bulk_apply_is_one_watch_batch(self, store):
+        coord = InMemoryCoordination(store)
+        coord.set("K:a", "1")
+        coord.set("K:b", "2")
+        batches = []
+        coord.add_watch("K:", lambda evs, _p: batches.append(list(evs)))
+        coord.bulk_apply({"K:c": "3"}, ["K:a", "K:b"])
+        assert wait_until(lambda: any(len(b) == 3 for b in batches),
+                          timeout=5)
+        batch = next(b for b in batches if len(b) == 3)
+        # DELETEs first, then PUTs — one revision, no half-applied window.
+        assert [(e.type, e.key) for e in batch] == [
+            (WatchEventType.DELETE, "K:a"),
+            (WatchEventType.DELETE, "K:b"),
+            (WatchEventType.PUT, "K:c")]
+        assert coord.get("K:c") == "3" and coord.get("K:a") is None
+
+    def test_replica_match_never_blanks_through_compaction(self, store):
+        """Satellite: the compaction frame (legacy prune + full-state
+        install) applies RCU-style on replicas — a concurrent lock-free
+        match() sees the pre-batch or post-batch index, never the
+        half-pruned intermediate (the old two-revision scheme blanked
+        match() between the legacy DELETEs and the frame PUT)."""
+        toks = list(range(BLOCK * 4))
+        hexes = prefix_block_hash_hexes(toks, BLOCK)
+        seed = InMemoryCoordination(store)
+        for h in hexes:   # a previous build's per-block JSON sync
+            seed.set(CACHE_KEY_PREFIX + h, json.dumps({"hbm": ["i1"]}))
+        replica = GlobalKVCacheMgr(InMemoryCoordination(store), BLOCK,
+                                   is_master=False)
+        assert replica.match(toks).matched_blocks == 4
+        promoted = GlobalKVCacheMgr(InMemoryCoordination(store), BLOCK,
+                                    is_master=False)
+
+        holes, stop = [], threading.Event()
+
+        def poll():
+            while not stop.is_set():
+                m = replica.match(toks).matched_blocks
+                if m < 4:
+                    holes.append(m)
+
+        t = threading.Thread(target=poll, daemon=True)
+        t.start()
+        try:
+            # Promotion forces a full-state compaction frame on the next
+            # upload: ONE bulk_apply revision pruning all 4 legacy keys
+            # and installing the frame.
+            promoted.set_as_master()
+            promoted.upload_kvcache()
+            assert wait_until(
+                lambda: not any(
+                    not k.startswith(CACHE_FRAME_KEY_PREFIX)
+                    for k in seed.get_prefix(CACHE_KEY_PREFIX)), timeout=5)
+            time.sleep(0.2)   # let the poller chew on the post state
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert not holes, f"match() blanked to {holes[:5]} during compaction"
+        assert replica.match(toks).matched_blocks == 4
+        # A fresh bootstrap off the compacted log converges too.
+        fresh = GlobalKVCacheMgr(InMemoryCoordination(store), BLOCK,
+                                 is_master=False)
+        assert fresh.match(toks).matched_blocks == 4
+        for mgr in (replica, promoted, fresh):
+            mgr.stop()
+
+    def test_replica_upload_is_refused(self, store):
+        """Write-lease discipline: only the elected master publishes
+        frames — a replica (or demoted master) tick is a no-op."""
+        replica = GlobalKVCacheMgr(InMemoryCoordination(store), BLOCK,
+                                   is_master=False)
+        seed = InMemoryCoordination(store)
+        from xllm_service_tpu.common.types import KvCacheEvent
+        replica.record_updated_kvcaches(
+            "i1", KvCacheEvent(stored=prefix_block_hash_hexes(
+                list(range(BLOCK)), BLOCK)))
+        replica.upload_kvcache()
+        assert not list(seed.get_prefix(CACHE_FRAME_KEY_PREFIX))
+        replica.stop()
+
+
+# --------------------------------------------------------- active-active e2e
+@pytest.mark.chaos
+class TestActiveActivePlane:
+    def test_foreign_owner_accept_relays_and_affinity_sticks(self, store):
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store)
+        try:
+            _await_plane([m1, m2], [engine])
+            okey = _key_owned_by(m1.scheduler.ownership,
+                                 m2.scheduler.self_addr)
+            fwd0 = HANDOFF_FORWARDED_TOTAL.value()
+            served0 = HANDOFF_SERVED_TOTAL.value()
+            # Accept on m1, owner m2 → exactly one forward, one serve.
+            assert _completion(m1, okey=okey) == REPLY
+            assert HANDOFF_FORWARDED_TOTAL.value() == fwd0 + 1
+            assert HANDOFF_SERVED_TOTAL.value() == served0 + 1
+            # Same affinity key accepted on the OWNER serves locally.
+            assert _completion(m2, okey=okey) == REPLY
+            assert HANDOFF_FORWARDED_TOTAL.value() == fwd0 + 1
+            # Streaming through the relay is byte-identical to direct.
+            text, finishes = _stream_completion(m1, okey=okey)
+            assert text == REPLY and finishes == ["stop"]
+        finally:
+            engine.stop()
+            m1.stop()
+            m2.stop()
+
+    def test_mined_accepts_serve_locally(self, store):
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store)
+        try:
+            _await_plane([m1, m2], [engine])
+            fwd0 = HANDOFF_FORWARDED_TOTAL.value()
+            mined0 = m1.scheduler.ownership.mined
+            for _ in range(8):
+                assert _completion(m1) == REPLY
+            # Id mining keeps the common case hop-free on BOTH frontends.
+            assert HANDOFF_FORWARDED_TOTAL.value() == fwd0
+            assert m1.scheduler.ownership.mined >= mined0 + 8
+        finally:
+            engine.stop()
+            m1.stop()
+            m2.stop()
+
+    def test_replica_routes_off_mirrored_state(self, store):
+        """A NON-elected frontend serves off watch-mirrored routing state:
+        instance membership, load-metrics mirror and the frame-fed prefix
+        index all live without ever being the master."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store)
+        try:
+            _await_plane([m1, m2], [engine])
+            assert m1.scheduler.is_master and not m2.scheduler.is_master
+            okey = _key_owned_by(m2.scheduler.ownership,
+                                 m2.scheduler.self_addr)
+            # Long prompt: ≥2 full 128-token blocks, so the engine's KV
+            # events actually carry block hashes.
+            r = requests.post(_base(m2) + "/v1/completions", json={
+                "model": "fake-model", "prompt": "m" * 300,
+                "max_tokens": 1000, "ownership_key": okey}, timeout=30)
+            assert r.status_code == 200, r.text
+            assert r.json()["choices"][0]["text"] == REPLY
+            # The replica's prefix index converges off the master's frames
+            # (the engine's KV events flow engine→master→frames→replica).
+            assert wait_until(
+                lambda: _blocks(m2.scheduler.kvcache_mgr) ==
+                _blocks(m1.scheduler.kvcache_mgr)
+                and m2.scheduler.kvcache_mgr.num_blocks() > 0, timeout=5)
+            # And its load-info mirror carries fresh telemetry ages. The
+            # master's LOADMETRICS publish rides its own scheduler tick, so
+            # wait for the mirrored entry rather than sampling instantly.
+            assert wait_until(
+                lambda: m2.scheduler.instance_mgr.load_info_ages_s()
+                .get(engine.name, -1.0) >= 0, timeout=5)
+        finally:
+            engine.stop()
+            m1.stop()
+            m2.stop()
+
+
+@pytest.mark.chaos
+class TestOwnerDeathMidStream:
+    def test_kill_owning_frontend_completes_on_survivor(self, store):
+        """The drill the subsystem exists for: the accepting frontend
+        relays to the owner, the owner dies mid-stream, the relay re-owns
+        to the rendezvous successor and the client stream completes
+        byte-identical — with ONE trace tree across the relay and both
+        owner incarnations."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store, delay_s=0.12)
+        killer = None
+        try:
+            _await_plane([m1, m2], [engine])
+            okey = _key_owned_by(m1.scheduler.ownership,
+                                 m2.scheduler.self_addr)
+            rec0 = HANDOFF_RECOVERIES_TOTAL.value()
+            kills: list[threading.Thread] = []
+            text, finishes = _stream_completion(
+                m1, okey=okey, after_frames=3,
+                hook=lambda: kills.append(_kill_async(m2)))
+            killer = kills[0] if kills else None
+            assert text == REPLY          # no gap, no duplicate
+            assert finishes == ["stop"]
+            assert HANDOFF_RECOVERIES_TOTAL.value() >= rec0 + 1
+
+            # ONE assembled trace tree: the relay's root plus the
+            # replacement owner's serve, correlated by one trace_id.
+            recent = requests.get(
+                _base(m1) + "/admin/trace/recent?sort=recent",
+                timeout=5).json()["traces"]
+            sid = next(t["request_id"] for t in recent
+                       if t["request_id"].startswith("completion-"))
+            got = requests.get(
+                _base(m1) + f"/admin/trace?request_id={sid}",
+                timeout=5).json()
+            spans = got["spans"]
+            assert len({s["span_id"] for s in spans}) == len(spans)
+            assert len({s["trace_id"] for s in spans}) == 1
+            fronts = [s for s in spans if s["point"] == "frontend.request"]
+            assert any(s["attrs"].get("relay") for s in fronts)
+            assert any(not s["attrs"].get("relay") for s in fronts)
+            relay_root = next(s for s in fronts if s["attrs"].get("relay"))
+            assert relay_root["attrs"].get("reowned_to") == \
+                m1.scheduler.self_addr
+        finally:
+            engine.stop()
+            m1.stop()
+            if killer is not None:
+                killer.join(timeout=15)
+            else:
+                m2.stop()
+
+    def test_kill_elected_master_completes_and_converges(self, store):
+        """Same drill with the owner ALSO being the elected master: the
+        stream completes on the survivor, the survivor wins the election,
+        and the frame log converges (a fresh bootstrap equals the new
+        master's index — no divergence from the old master's writes)."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store, delay_s=0.12)
+        killer = None
+        try:
+            _await_plane([m1, m2], [engine])
+            assert m1.scheduler.is_master
+            okey = _key_owned_by(m2.scheduler.ownership,
+                                 m1.scheduler.self_addr)
+            kills: list[threading.Thread] = []
+            text, finishes = _stream_completion(
+                m2, okey=okey, after_frames=3,
+                hook=lambda: kills.append(_kill_async(m1)))
+            killer = kills[0] if kills else None
+            assert text == REPLY and finishes == ["stop"]
+            # Survivor takes the election and the write lease.
+            assert wait_until(lambda: m2.scheduler.is_master, timeout=5)
+            # Frame-log convergence: a fresh replica bootstrapping from
+            # coordination sees exactly the new master's index.
+            def converged():
+                fresh = GlobalKVCacheMgr(
+                    InMemoryCoordination(store),
+                    m2.options.block_size, is_master=False)
+                try:
+                    return (_blocks(fresh) ==
+                            _blocks(m2.scheduler.kvcache_mgr))
+                finally:
+                    fresh.stop()
+            assert wait_until(converged, timeout=5)
+            # And the promoted master keeps serving.
+            assert _completion(m2) == REPLY
+        finally:
+            engine.stop()
+            m2.stop()
+            if killer is not None:
+                killer.join(timeout=15)
+            else:
+                m1.stop()
+
+
+@pytest.mark.chaos
+class TestSplitBrainDemotion:
+    def test_replica_election_win_demotes_streaming_master(self, store):
+        """Satellite drill: a coordination outage lapses the master's
+        election lease mid-stream and a replica legitimately wins. The old
+        master must demote (not split-brain), stop publishing frames and
+        load metrics, and still finish its in-flight streams cleanly; the
+        frame log stays convergent."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store, delay_s=0.12)
+        try:
+            _await_plane([m1, m2], [engine])
+            assert m1.scheduler.is_master
+            okey = _key_owned_by(m1.scheduler.ownership,
+                                 m1.scheduler.self_addr)
+
+            # Mid-stream, the outage: m1's election lease lapses (release
+            # stops the keepalive; the TTL expires it) and m2's watch wins
+            # the re-election while m1 still *believes* it is master.
+            def outage():
+                m1.scheduler._coord.release(MASTER_KEY)
+
+            text, finishes = _stream_completion(
+                m1, okey=okey, after_frames=3, hook=outage)
+            # The demoted master's in-flight stream finished cleanly.
+            assert text == REPLY and finishes == ["stop"]
+
+            assert wait_until(lambda: m2.scheduler.is_master, timeout=5)
+            # The old master notices the loss on its sync tick and demotes
+            # instead of split-braining.
+            assert wait_until(
+                lambda: not m1.scheduler.is_master, timeout=5)
+
+            # Demotion revoked the write lease: a straggler upload tick on
+            # the demoted master publishes nothing.
+            tail_before = sorted(
+                m1.scheduler._coord.get_prefix(CACHE_FRAME_KEY_PREFIX))
+            m1.scheduler.kvcache_mgr.upload_kvcache()
+            m1.scheduler.instance_mgr.upload_load_metrics()
+            assert sorted(m1.scheduler._coord.get_prefix(
+                CACHE_FRAME_KEY_PREFIX)) == tail_before
+
+            # Frame log convergent: demoted master mirrors the new
+            # master's index (and a fresh bootstrap agrees).
+            assert wait_until(
+                lambda: _blocks(m1.scheduler.kvcache_mgr) ==
+                _blocks(m2.scheduler.kvcache_mgr), timeout=10)
+            # Both frontends keep serving, active-active.
+            assert _completion(m1) == REPLY
+            assert _completion(m2) == REPLY
+        finally:
+            engine.stop()
+            m1.stop()
+            m2.stop()
+
+
+# ------------------------------------------------------ write-lease proxying
+@pytest.mark.chaos
+class TestWriteLeaseProxy:
+    def test_replica_flip_hint_funnels_through_master(self, store):
+        """A non-elected frontend's SLO pass wants a PD-role flip; the
+        coordination writes are master-only, so the hint proxies to the
+        elected master's /rpc/flip_hint and ITS reconcile thread executes
+        — every frontend then converges off the moved instance key."""
+        m1 = _master(store)
+        m2 = _master(store)
+        prefill = _engine(store, instance_type=InstanceType.PREFILL)
+        decode = _engine(store, instance_type=InstanceType.DECODE)
+        try:
+            _await_plane([m1, m2], [prefill, decode])
+            assert not m2.scheduler.is_master
+            # The hint lands on the REPLICA (as the SLO policy would).
+            m2.scheduler.instance_mgr.request_flip(
+                prefill.name, InstanceType.DECODE)
+            assert wait_until(
+                lambda: all(
+                    (meta := m.scheduler.instance_mgr.get_instance_meta(
+                        prefill.name)) is not None
+                    and meta.type == InstanceType.DECODE
+                    for m in (m1, m2)), timeout=10)
+            # The engine itself was told to swap programs.
+            assert prefill.instance_type == InstanceType.DECODE
+        finally:
+            prefill.stop()
+            decode.stop()
+            m1.stop()
+            m2.stop()

@@ -117,11 +117,15 @@ class TestLabeledMetrics:
             _await_fleet(master, [engine])
             assert _stream(master)[0] == REPLY
             text = requests.get(_base(master) + "/metrics", timeout=5).text
+            # Policy label follows the shipped default (CAR since the
+            # multi-master round) — derive it, don't hard-code RR.
+            policy = master.options.load_balance_policy
             assert ("time_to_first_token_latency_milliseconds_bucket"
-                    '{le="1",instance="' + engine.name + '",policy="RR"}'
-                    in text)
+                    '{le="1",instance="' + engine.name
+                    + '",policy="' + policy + '"}' in text)
             assert ("time_to_first_token_latency_milliseconds_count"
-                    '{instance="' + engine.name + '",policy="RR"}' in text)
+                    '{instance="' + engine.name
+                    + '",policy="' + policy + '"}' in text)
             assert ('server_request_in_total{kind="completion"}' in text)
             assert ('instance_inflight_requests{instance="' + engine.name
                     + '",phase="decode"} 0.0' in text)

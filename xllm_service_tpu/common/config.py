@@ -39,7 +39,11 @@ class ServiceOptions:
     coordination_password: str = field(
         default_factory=lambda: os.environ.get("ETCD_PASSWORD", ""))
     # --- scheduling ---
-    load_balance_policy: str = "RR"   # RR | CAR | SLO_AWARE
+    # CAR shipped default since the multi-master round: the PR-5 data
+    # plane made its schedule path as cheap as RR, and the
+    # heterogeneous-mix soak (docs/performance.md) showed it no worse
+    # than RR on zero-overlap traffic and far better on cache-hot mixes.
+    load_balance_policy: str = "CAR"  # RR | CAR | SLO_AWARE
     block_size: int = 128             # prefix-hash block (`global_gflags.cpp:114-116`)
     max_waiting_requests: int = 1024  # CAR normalization denominator
     # CAR tier weights: what one matched block is worth per residence tier
@@ -92,6 +96,32 @@ class ServiceOptions:
     trace_span_capacity: int = 2048
     debug_log: bool = field(
         default_factory=lambda: os.environ.get("ENABLE_XLLM_DEBUG_LOG", "") not in ("", "0", "false"))
+    # --- multi-master service plane (multimaster/) ---
+    # Every replica is an ACTIVE frontend; requests are owned by exactly
+    # one master via rendezvous hashing of the request id over the live
+    # service records (docs/multi_master.md).
+    multimaster_ownership: bool = True
+    # Mine generated request ids until the accepting frontend owns them
+    # (expected N draws on an N-replica plane) so the common case pays no
+    # forward hop. Off = ids are assigned by pure rendezvous (~(N-1)/N of
+    # accepts relay through /rpc/handoff — useful to drill the path).
+    multimaster_mine_owned_ids: bool = True
+    # Owner attempts per relayed request: the first POST plus
+    # (attempts-1) deterministic re-ownership recoveries.
+    handoff_max_attempts: int = 3
+    # Max silence between reads of the owner's response before the relay
+    # treats the owner as hung and re-owns (a killed-but-not-closed owner
+    # — SIGKILL mid-accept, a stalled event loop — leaves the TCP
+    # connection open; without a read deadline the relayed stream would
+    # stall forever instead of failing over). Engine token gaps beyond
+    # this are pathological.
+    handoff_stall_timeout_s: float = 60.0
+    # Load-info staleness (multi-master replicas score routing off
+    # coordination-mirrored telemetry): entries older than this are
+    # discounted by CAR/SLO scoring, up to `stale_load_penalty` score
+    # units (CAR) / a proportional predicted-TPOT inflation (SLO).
+    loadinfo_stale_after_s: float = 9.0
+    stale_load_penalty: float = 0.5
     # --- request registry ---
     num_output_threads: int = 16      # per-request output-ordering lanes
     request_timeout_s: float = 600.0

@@ -342,3 +342,19 @@ REQUESTS_CANCELLED_ON_FAILURE_TOTAL = REGISTRY.counter(
     "requests_cancelled_on_failure_total",
     "Requests surfaced as errors after instance failure "
     "(failover disabled, budget exhausted, or no payload to replay)")
+
+# Multi-master service plane (multimaster/): ownership handoffs between
+# active frontends and owner-death recoveries. `owner` is the TARGET
+# master of the forward (small cardinality: one series per replica).
+HANDOFF_FORWARDED_TOTAL = REGISTRY.counter(
+    "handoff_forwarded_total",
+    "Requests relayed to their owning master by an accepting frontend",
+    labelnames=("owner",))
+HANDOFF_SERVED_TOTAL = REGISTRY.counter(
+    "handoff_served_total",
+    "Foreign-accepted requests served by this master as owner")
+HANDOFF_RECOVERIES_TOTAL = REGISTRY.counter(
+    "handoff_recoveries_total",
+    "Mid-flight re-ownerships after an owning master died "
+    "(owner = the rendezvous successor)",
+    labelnames=("owner",))

@@ -359,3 +359,8 @@ class InstanceLoadInfo:
     load: LoadMetrics = field(default_factory=LoadMetrics)
     latency: LatencyMetrics = field(default_factory=LatencyMetrics)
     schedulable: bool = True
+    # When this entry's telemetry was last refreshed (heartbeat on the
+    # master; LOADMETRICS mirror on replicas). 0 = never. Multi-master
+    # frontends score routing off mirrored telemetry, so CAR/SLO scoring
+    # discounts entries older than `loadinfo_stale_after_s`.
+    updated_ms: int = 0

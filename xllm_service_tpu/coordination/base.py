@@ -83,6 +83,19 @@ class CoordinationClient(abc.ABC):
     @abc.abstractmethod
     def bulk_rm(self, keys: Iterable[str]) -> int: ...
 
+    def bulk_apply(self, kvs: Mapping[str, str],
+                   rm_keys: Iterable[str]) -> bool:
+        """Deletes + puts as ONE revision: watchers receive a single
+        event batch (DELETEs first, then PUTs), so a multi-key state
+        transition — e.g. the KV-index compaction's prune-legacy +
+        install-full-frame — is applied atomically by replicas instead
+        of exposing the half-pruned intermediate state. Backends that
+        can't batch fall back to this default (rm then set, two
+        revisions — correct but with a transient window; the
+        memory/native backends override with a true single batch)."""
+        self.bulk_rm(rm_keys)
+        return self.bulk_set(kvs)
+
     @abc.abstractmethod
     def release(self, key: str) -> None:
         """Stop keepalive for a leased key (lease then expires naturally)."""

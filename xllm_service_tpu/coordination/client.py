@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import queue
 import socket
 import threading
 from typing import Optional
@@ -63,10 +64,24 @@ class TcpCoordinationClient(CoordinationClient):
         # Connection generation, bumped under _wlock with each (re)connect;
         # lets reconnect fail exactly the calls sent on dead connections.
         self._gen = 0
+        # Watch callbacks run on a DEDICATED dispatcher thread, fed FIFO
+        # from the reader (one queue + one consumer = delivery order
+        # preserved, which the replica frame-log apply depends on). They
+        # must NOT run on the reader thread itself: a callback that makes
+        # a coordination call — the master-election takeover does exactly
+        # this (`scheduler._on_master_event` -> `create_if_absent`) —
+        # would wait on a response only the reader can deliver, while the
+        # reader waits inside the callback. The server had already applied
+        # the write, so the deadlock's timeout left the caller believing
+        # the election failed while its key sat in the store unrefreshed.
+        self._watch_q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._connect()
         self._reader = threading.Thread(target=self._read_loop,
                                         name="coord-reader", daemon=True)
         self._reader.start()
+        self._watch_thread = threading.Thread(target=self._watch_loop,
+                                              name="coord-watch", daemon=True)
+        self._watch_thread.start()
         self._ka_thread = threading.Thread(target=self._keepalive_loop,
                                            name="coord-ka", daemon=True)
         self._ka_thread.start()
@@ -145,7 +160,7 @@ class TcpCoordinationClient(CoordinationClient):
     def _request_on_reader(self, req: dict) -> Optional[dict]:
         """Synchronous exchange issued FROM the reader thread (reconnect
         path — `_call` would deadlock waiting on ourselves). Watch pushes
-        interleaved on the wire are dispatched inline."""
+        interleaved on the wire are enqueued to the dispatcher."""
         rid = next(self._ids)
         req["id"] = rid
         if not self._send_raw(req):
@@ -154,7 +169,7 @@ class TcpCoordinationClient(CoordinationClient):
             for line in self._rfile:
                 msg = json.loads(line)
                 if msg.get("event") == "watch":
-                    self._dispatch_watch(msg)
+                    self._enqueue_watch(msg)
                     continue
                 if msg.get("id") == rid:
                     return msg
@@ -187,10 +202,11 @@ class TcpCoordinationClient(CoordinationClient):
             self._watch_known[wid] = set(current)
             if not events:
                 continue
-            try:
-                cb(events, prefix)
-            except Exception:  # noqa: BLE001
-                logger.exception("watch resync callback failed")
+            # Through the dispatcher queue like live pushes: resync events
+            # must not run callbacks on the reader thread either (same
+            # election-takeover deadlock), and FIFO keeps them ordered
+            # before any pushes the fresh connection delivers next.
+            self._watch_q.put((cb, events, prefix))
 
     def _send_raw(self, req: dict) -> bool:
         data = (json.dumps(req) + "\n").encode()
@@ -242,7 +258,10 @@ class TcpCoordinationClient(CoordinationClient):
                 resp["error"] = "connection closed"
                 ev.set()
 
-    def _dispatch_watch(self, msg: dict) -> None:
+    def _enqueue_watch(self, msg: dict) -> None:
+        """Reader-thread half of watch delivery: decode, update the
+        known-key bookkeeping (kept on the reader thread so the resync
+        diff never races the dispatcher), and queue for the dispatcher."""
         wid = msg["watch_id"]
         entry = self._watches.get(wid)
         if entry is None:
@@ -257,17 +276,29 @@ class TcpCoordinationClient(CoordinationClient):
                 known.add(e.key)
             else:
                 known.discard(e.key)
-        try:
-            cb(events, prefix)
-        except Exception:  # noqa: BLE001
-            logger.exception("watch callback failed")
+        self._watch_q.put((cb, events, prefix))
+
+    def _watch_loop(self) -> None:
+        """Dispatcher half: the ONLY thread that runs watch callbacks, so
+        callbacks may freely issue coordination calls (election takeover)
+        without deadlocking the reader, and per-client delivery stays
+        strictly ordered."""
+        while True:
+            item = self._watch_q.get()
+            if item is None:
+                return
+            cb, events, prefix = item
+            try:
+                cb(events, prefix)
+            except Exception:  # noqa: BLE001
+                logger.exception("watch callback failed")
 
     def _read_one_connection(self) -> None:
         try:
             for line in self._rfile:
                 msg = json.loads(line)
                 if msg.get("event") == "watch":
-                    self._dispatch_watch(msg)
+                    self._enqueue_watch(msg)
                     continue
                 rid = msg.get("id")
                 with self._plock:
@@ -387,6 +418,18 @@ class TcpCoordinationClient(CoordinationClient):
         return self._call({"op": "bulk_rm",
                            "keys": [self._k(k) for k in keys]}).get("count", 0)
 
+    def bulk_apply(self, kvs, rm_keys) -> bool:
+        resp = self._call({"op": "bulk_apply",
+                           "kvs": {self._k(k): v for k, v in kvs.items()},
+                           "rm_keys": [self._k(k) for k in rm_keys]})
+        if resp.get("ok"):
+            return True
+        if "unknown op" in str(resp.get("error", "")):
+            # Legacy coordination server: fall back to two revisions
+            # (correct, with the pre-batch transient window).
+            return super().bulk_apply(kvs, rm_keys)
+        return False
+
     def release(self, key) -> None:
         with self._ka_lock:
             self._keepalives.pop(self._k(key), None)
@@ -406,6 +449,7 @@ class TcpCoordinationClient(CoordinationClient):
         if self._closed.is_set():
             return
         self._closed.set()
+        self._watch_q.put(None)   # dispatcher sentinel
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -181,6 +181,22 @@ class MemoryStore:
                 self._emit_locked([KeyEvent(WatchEventType.DELETE, k, "") for k in removed])
             return len(removed)
 
+    def bulk_apply(self, kvs: Mapping[str, str], rm_keys: Iterable[str]) -> bool:
+        """Deletes + puts in ONE emission: watchers see one event batch
+        (DELETEs first), so multi-key transitions apply atomically."""
+        with self._lock:
+            events = []
+            for k in rm_keys:
+                if k in self._data and k not in kvs:
+                    del self._data[k]
+                    events.append(KeyEvent(WatchEventType.DELETE, k, ""))
+            for k, v in kvs.items():
+                self._data[k] = _Entry(v, None)
+                events.append(KeyEvent(WatchEventType.PUT, k, v))
+            if events:
+                self._emit_locked(events)
+            return True
+
     def add_watch(self, prefix: str, cb: WatchCallback) -> int:
         with self._lock:
             wid = self._next_watch_id
@@ -271,6 +287,10 @@ class InMemoryCoordination(CoordinationClient):
 
     def bulk_rm(self, keys) -> int:
         return self._store.bulk_rm([self._k(k) for k in keys])
+
+    def bulk_apply(self, kvs, rm_keys) -> bool:
+        return self._store.bulk_apply({self._k(k): v for k, v in kvs.items()},
+                                      [self._k(k) for k in rm_keys])
 
     def release(self, key) -> None:
         with self._ka_lock:

@@ -102,6 +102,9 @@ class _Conn(socketserver.BaseRequestHandler):
             return {"ok": store.bulk_set(req["kvs"])}
         if op == "bulk_rm":
             return {"ok": True, "count": store.bulk_rm(req["keys"])}
+        if op == "bulk_apply":
+            return {"ok": store.bulk_apply(req.get("kvs", {}),
+                                           req.get("rm_keys", []))}
         if op == "watch":
             cwid = req["watch_id"]
             prefix = req["prefix"]

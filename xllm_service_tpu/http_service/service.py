@@ -37,11 +37,16 @@ import aiohttp
 from aiohttp import web
 
 from ..common.hotpath import HOTPATH
-from ..common.metrics import REGISTRY, SERVER_REQUEST_IN_TOTAL
+from ..common.metrics import (
+    HANDOFF_SERVED_TOTAL,
+    REGISTRY,
+    SERVER_REQUEST_IN_TOTAL,
+)
 from ..common.request import Request, RequestOutput, SamplingParams
 from ..common import tracing
-from ..common.tracing import TRACER
+from ..common.tracing import TRACER, TraceContext
 from ..common.types import InstanceType
+from ..multimaster.handoff import HandoffRelay
 from ..rpc import wire
 from ..scheduler.scheduler import Scheduler
 from ..utils import generate_service_request_id, get_logger, short_uuid
@@ -135,6 +140,12 @@ class XllmHttpService:
         # The event loop keeps only weak refs to tasks; hold forward tasks
         # here so they can't be garbage-collected mid-flight.
         self._forward_tasks: set[asyncio.Task] = set()
+        # Multi-master: owner-forward path for the minority of requests
+        # this frontend accepts but does not own (multimaster/handoff.py).
+        self._relay = HandoffRelay(
+            scheduler.ownership,
+            max_attempts=self.opts.handoff_max_attempts,
+            stall_timeout_s=self.opts.handoff_stall_timeout_s)
 
     # ------------------------------------------------------------- HTTP app
     def build_http_app(self) -> web.Application:
@@ -168,6 +179,10 @@ class XllmHttpService:
         app = web.Application()
         app.router.add_post("/rpc/heartbeat", self.handle_heartbeat)
         app.router.add_post("/rpc/generations", self.handle_generations)
+        # Multi-master plane: owner-side ingest of relayed requests, and
+        # the replica→master write-lease proxy for PD-role flip hints.
+        app.router.add_post("/rpc/handoff", self.handle_handoff)
+        app.router.add_post("/rpc/flip_hint", self.handle_flip_hint)
         app.router.add_get("/rpc/hello", self.handle_hello)
         app.router.add_get("/rpc/instance_info", self.handle_instance_info)
         app.router.add_get("/rpc/static_prefill_list", self.handle_prefill_list)
@@ -207,19 +222,31 @@ class XllmHttpService:
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_generate(request, kind="chat")
 
-    async def handle_messages(self, http_req: web.Request) -> web.StreamResponse:
+    async def handle_messages(self, http_req: web.Request,
+                              sid: Optional[str] = None) -> web.StreamResponse:
         """Anthropic-style Messages API (`/v1/messages`): the reference
         family acknowledges this surface only as an engine proto
         (`anthropic.proto` in `proto/CMakeLists.txt:18-37`) with no
         service route; here it is a first-class endpoint mapped onto the
         chat pipeline with Anthropic request/response/stream framing."""
-        SERVER_REQUEST_IN_TOTAL.labels(kind="anthropic").inc()
+        if sid is None:
+            # Relayed handoffs already counted at their accepting
+            # frontend; HANDOFF_SERVED_TOTAL tracks the owner-side serve.
+            SERVER_REQUEST_IN_TOTAL.labels(kind="anthropic").inc()
+        raw = await http_req.read()
         try:
-            body = await http_req.json()
-        except json.JSONDecodeError:
+            body = json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError):
             return _error_response(400, "invalid JSON body")
         if not isinstance(body, dict):
             return _error_response(400, "request body must be a JSON object")
+        handoff = sid is not None
+        if not handoff:
+            sid, owner, owner_key = self._assign_ownership("messages", body)
+            if owner != self.scheduler.self_addr:
+                return await self._relay_to_owner(
+                    http_req, raw, "messages", sid, owner, owner_key,
+                    bool(body.get("stream", False)))
         if not isinstance(body.get("max_tokens"), int) \
                 or body["max_tokens"] < 1:
             return _error_response(400, "max_tokens is required")
@@ -232,7 +259,7 @@ class XllmHttpService:
         if isinstance(stops, list):
             sp.stop = [str(s) for s in stops]
         req = Request(
-            service_request_id=generate_service_request_id("messages"),
+            service_request_id=sid,
             request_id="msg_" + short_uuid(),
             model=body.get("model", self.opts.model_id or ""),
             stream=bool(body.get("stream", False)),
@@ -258,7 +285,10 @@ class XllmHttpService:
         if self.tracer.enabled:
             req.trace_callback = self.tracer.log
             self.tracer.log(req.service_request_id, {"request": body})
-        self._start_root_span(req, "anthropic")
+        self._start_root_span(
+            req, "anthropic",
+            ctx=TraceContext.from_headers(http_req.headers) if handoff
+            else None)
 
         t0 = time.perf_counter()
         status = await asyncio.get_running_loop().run_in_executor(
@@ -302,10 +332,15 @@ class XllmHttpService:
         task.add_done_callback(self._forward_tasks.discard)
         return await self._respond(http_req, req, conn, emit_done=False)
 
-    def _start_root_span(self, req: Request, kind: str) -> None:
+    def _start_root_span(self, req: Request, kind: str,
+                         ctx: Optional[TraceContext] = None) -> None:
         """Root the request's trace in the frontend (no-op when tracing is
-        off): every downstream hop parents its spans under this context."""
-        root = TRACER.start_span("frontend.request",
+        off): every downstream hop parents its spans under this context.
+        With `ctx` (a relayed handoff: the accepting frontend rooted the
+        trace and sent it as x-xllm-* headers) this span parents under
+        the relay instead, so /admin/trace assembles ONE tree across the
+        accepting frontend, every owner incarnation, and the engines."""
+        root = TRACER.start_span("frontend.request", ctx=ctx,
                                  request_id=req.service_request_id,
                                  kind=kind, model=req.model,
                                  stream=req.stream)
@@ -313,19 +348,60 @@ class XllmHttpService:
             req.span = root
             req.trace = root.context()
 
-    async def _handle_generate(self, http_req: web.Request,
-                               kind: str) -> web.StreamResponse:
-        SERVER_REQUEST_IN_TOTAL.labels(kind=kind).inc()
+    # ------------------------------------------------- multi-master ownership
+    def _assign_ownership(self, kind: str,
+                          body: dict[str, Any]) -> tuple[str, str, str]:
+        """(service_request_id, owner_addr, ownership_key) for a new
+        accept. A client-pinned string `ownership_key` in the body gives
+        session affinity — every request carrying the same key is owned
+        by the same master (and fails over to the same successor);
+        otherwise the generated id is mined so that, in the common case,
+        this frontend owns what it accepts and no forward hop is paid."""
+        ownership = self.scheduler.ownership
+        okey = body.get("ownership_key")
+        if isinstance(okey, str) and okey:
+            return (generate_service_request_id(kind),
+                    ownership.owner_of(okey), okey)
+        sid, owner = ownership.mine(kind)
+        return sid, owner, sid
+
+    async def _relay_to_owner(self, http_req: web.Request, raw: bytes,
+                              kind: str, sid: str, owner: str,
+                              owner_key: str, stream: bool) -> web.StreamResponse:
+        assert self._client is not None
+        return await self._relay.relay(
+            http_req, self._client, raw, kind, sid, owner, owner_key,
+            stream, self.opts.request_timeout_s)
+
+    async def _handle_generate(self, http_req: web.Request, kind: str,
+                               sid: Optional[str] = None) -> web.StreamResponse:
+        if sid is None:
+            # Relayed handoffs already counted at their accepting
+            # frontend; HANDOFF_SERVED_TOTAL tracks the owner-side serve.
+            SERVER_REQUEST_IN_TOTAL.labels(kind=kind).inc()
+        raw = await http_req.read()
         try:
-            body = await http_req.json()
-        except json.JSONDecodeError:
+            body = json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError):
             return _error_response(400, "invalid JSON body")
         if not isinstance(body, dict):
             return _error_response(400, "request body must be a JSON object")
 
+        # Multi-master ownership: `sid` set means this request was relayed
+        # here by its accepting frontend — serve it locally under the
+        # relay-supplied id (never re-relay). Otherwise resolve ownership
+        # and forward the raw body to the owner when it isn't us.
+        handoff = sid is not None
+        if not handoff:
+            sid, owner, owner_key = self._assign_ownership(kind, body)
+            if owner != self.scheduler.self_addr:
+                return await self._relay_to_owner(
+                    http_req, raw, kind, sid, owner, owner_key,
+                    bool(body.get("stream", False)))
+
         try:
             req = Request(
-                service_request_id=generate_service_request_id(kind),
+                service_request_id=sid,
                 request_id=("chatcmpl-" if kind == "chat" else "cmpl-") + short_uuid(),
                 model=body.get("model", self.opts.model_id or ""),
                 stream=bool(body.get("stream", False)),
@@ -366,7 +442,10 @@ class XllmHttpService:
         if self.tracer.enabled:
             req.trace_callback = self.tracer.log
             self.tracer.log(req.service_request_id, {"request": body})
-        self._start_root_span(req, kind)
+        self._start_root_span(
+            req, kind,
+            ctx=TraceContext.from_headers(http_req.headers) if handoff
+            else None)
 
         # Schedule (tokenize + route) off the event loop — CPU-bound, on
         # the dedicated bounded pool so admission never queues behind
@@ -632,9 +711,19 @@ class XllmHttpService:
     async def handle_hotpath(self, request: web.Request) -> web.Response:
         """Per-stage master hot-path latency table (always-on recorder,
         common/hotpath.py): schedule / enrich / forward / first_delta
-        percentiles over the recent sample window. serve_bench and
-        master_hotpath_bench read this for their attribution tables."""
-        return web.json_response({"stages": HOTPATH.summary()})
+        percentiles over the recent sample window, plus the multi-master
+        plane's view — ownership/mining stats and the load-info
+        telemetry ages staleness-aware scoring discounts by."""
+        mgr = self.scheduler.instance_mgr
+        return web.json_response({
+            "stages": HOTPATH.summary(),
+            "ownership": self.scheduler.ownership.stats(),
+            "loadinfo": {
+                "ages_s": mgr.load_info_ages_s(),
+                "stale": sorted(mgr.stale_load_names()),
+                "stale_after_s": self.opts.loadinfo_stale_after_s,
+            },
+        })
 
     async def handle_get_faults(self, request: web.Request) -> web.Response:
         """Inspect the deterministic fault-injection plane (rules + hit/fire
@@ -694,6 +783,48 @@ class XllmHttpService:
         return web.json_response({"ok": True, "applied": applied})
 
     # ----------------------------------------------------------- RPC routes
+    async def handle_handoff(self, request: web.Request) -> web.StreamResponse:
+        """Owner-side ingest of a request relayed by another frontend
+        (multimaster/handoff.py): run the FULL local pipeline — schedule,
+        dispatch, failover bookkeeping, trace assembly — under the
+        relay-supplied service id. Never re-relays: the accepting
+        frontend resolved ownership, and re-resolving here on a
+        membership race could loop. The response (SSE frames or one JSON
+        document) streams back to the relay, which copies it to the
+        client — dropping the already-delivered frame prefix on a
+        re-owned replay."""
+        sid = request.query.get("sid", "")
+        kind = request.query.get("kind", "completion")
+        if not sid:
+            return _error_response(400, "missing sid")
+        HANDOFF_SERVED_TOTAL.inc()
+        if kind == "messages":
+            return await self.handle_messages(request, sid=sid)
+        if kind not in ("chat", "completion"):
+            return _error_response(400, f"unknown handoff kind {kind}")
+        return await self._handle_generate(request, kind, sid=sid)
+
+    async def handle_flip_hint(self, request: web.Request) -> web.Response:
+        """Replica→master write-lease proxy for PD-role flips: a
+        non-elected frontend's SLO policy saw a role imbalance, but the
+        coordination writes a flip performs are master-only (frame-log +
+        instance-key invariants). The hint lands in this master's pending
+        set; its reconcile thread executes. If mastership just moved, the
+        local drain re-proxies to the current master — convergent."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON")
+        if not isinstance(body, dict) or not body.get("name"):
+            return _error_response(400, "missing instance name")
+        try:
+            new_type = InstanceType.parse(body.get("type"))
+        except ValueError:
+            return _error_response(400, f"bad type {body.get('type')!r}")
+        self.scheduler.instance_mgr.request_flip(str(body["name"]), new_type)
+        return web.json_response({"ok": True,
+                                  "master": self.scheduler.is_master})
+
     async def handle_heartbeat(self, request: web.Request) -> web.Response:
         """Per-instance heartbeat (load/latency metrics + KV-cache event
         delta). Wire is msgpack by default — KV-event block keys ride as

@@ -1,0 +1,29 @@
+"""Active-active multi-master service plane.
+
+The reference runs exactly one *active* master elected via etcd; passive
+replicas mirror state through watches and only serve after winning an
+election (`scheduler.cpp:72-102`, PAPER.md §7). This package goes beyond
+that: every service replica is an active frontend. The pieces:
+
+- :mod:`ownership` — rendezvous-hash request ownership over the live
+  service-replica set (`XLLM:SERVICE:` records), so every in-flight
+  request has exactly ONE owning master for failover bookkeeping, trace
+  assembly and cancel-on-instance-death, resolvable from the request id
+  alone by any node.
+- :mod:`handoff` — the thin forward path for the minority of requests an
+  accepting frontend does not own: relay the client call to the owner's
+  `/rpc/handoff` endpoint and stream the response back, with
+  deterministic re-ownership (re-forward to the rendezvous successor)
+  when the owner dies mid-stream.
+
+Write-lease discipline: mutating coordination writes (KV frame
+publishing, load-metric uploads, planner hints, PD-role flips, instance
+eviction records) stay funneled through the *elected* master so the
+PR-5 frame-log invariants hold; replicas proxy their flip hints to the
+master (`/rpc/flip_hint`) instead of writing themselves. See
+docs/multi_master.md.
+"""
+
+from .ownership import OwnershipRouter
+
+__all__ = ["OwnershipRouter"]

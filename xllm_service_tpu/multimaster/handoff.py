@@ -1,0 +1,254 @@
+"""Thin owner-forward path for requests the accepting frontend does not
+own.
+
+The accepting frontend relays the ORIGINAL client body to the owner's
+``/rpc/handoff`` endpoint and streams the owner's response (SSE frames or
+one JSON document) straight back to the client — the owner runs the full
+schedule/dispatch/failover pipeline; the relay never parses payloads
+beyond SSE frame boundaries.
+
+Owner-death recovery (the "drain to successors" half of sticky
+ownership): if the owner connection dies mid-stream, the relay recomputes
+ownership over the surviving members (rendezvous successor — every relay
+holding requests of the dead owner lands them on the same survivors,
+deterministically), re-forwards with the count of data frames already
+delivered, and drops exactly that many frames from the replacement stream
+before resuming the client copy. The replacement owner re-runs the
+request through the normal pipeline; with the engine-side prefix cache
+warm, the replay prefills from cache. Frame-skip dedup assumes the
+upstream stream is reproducible for the same request (true of the
+fake-engine drills; a temperature>0 real engine may splice a divergent
+continuation — same contract as the reference's cancel-and-surface, but
+the stream *completes*).
+
+Trace correlation: the relay roots the request's trace and sends the
+context as ``x-xllm-*`` headers; the owner parents its ``frontend.request``
+span under it, so ``/admin/trace`` assembles one tree across the relay,
+every owner incarnation, and the engines they dispatched to.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..common.metrics import (
+    HANDOFF_FORWARDED_TOTAL,
+    HANDOFF_RECOVERIES_TOTAL,
+)
+from ..common.tracing import TRACER
+from ..utils import get_logger
+from .ownership import OwnershipRouter
+
+logger = get_logger(__name__)
+
+_DATA_PREFIX = b"data: "
+
+
+class HandoffRelay:
+    """Relays one frontend's foreign-owned requests to their owners."""
+
+    def __init__(self, ownership: OwnershipRouter, max_attempts: int = 3,
+                 stall_timeout_s: float = 60.0):
+        self._ownership = ownership
+        self.max_attempts = max(1, max_attempts)
+        # Read deadline per response chunk: a killed-but-not-closed owner
+        # (hung event loop, SIGKILL mid-handler) leaves the TCP stream
+        # open and silent — without this the relay would stall forever
+        # instead of re-owning. Found by the kill-the-owner chaos drill.
+        self.stall_timeout_s = stall_timeout_s
+
+    def _url(self, owner: str, kind: str, sid: str) -> str:
+        return f"http://{owner}/rpc/handoff?kind={kind}&sid={sid}"
+
+    async def relay(self, http_req: web.Request, client: aiohttp.ClientSession,
+                    body: bytes, kind: str, sid: str, owner: str,
+                    owner_key: str, stream: bool,
+                    timeout_s: float) -> web.StreamResponse:
+        """Forward ``body`` to ``owner`` and copy the response back to the
+        client of ``http_req``. Returns the prepared client response."""
+        span = TRACER.start_span("frontend.request", request_id=sid,
+                                 kind=kind, stream=stream, relay=True,
+                                 owner=owner)
+        headers = {"Content-Type": "application/json"}
+        if span:
+            headers.update(span.context().to_headers())
+        HANDOFF_FORWARDED_TOTAL.labels(owner=owner).inc()
+        try:
+            if stream:
+                return await self._relay_stream(
+                    http_req, client, body, kind, sid, owner, owner_key,
+                    headers, timeout_s, span)
+            return await self._relay_unary(
+                http_req, client, body, kind, sid, owner, owner_key,
+                headers, timeout_s, span)
+        finally:
+            if span:
+                span.end()
+
+    # ----------------------------------------------------------- non-stream
+    async def _relay_unary(self, http_req, client, body, kind, sid, owner,
+                           owner_key, headers, timeout_s,
+                           span) -> web.Response:
+        failed: list[str] = []
+        last_err: Any = None
+        for attempt in range(self.max_attempts):
+            if attempt:
+                owner = self._recover(owner, failed, owner_key, sid, span)
+                HANDOFF_RECOVERIES_TOTAL.labels(owner=owner).inc()
+            url = self._url(owner, kind, sid) + f"&attempt={attempt}"
+            try:
+                # No per-read stall deadline here (unlike the stream
+                # relay): a unary owner legitimately sends ZERO bytes
+                # until the whole generation is done, which can far
+                # exceed any silence threshold that would still catch a
+                # hung owner usefully. A SIGKILLed owner closes its
+                # sockets (kernel teardown) and fails fast below; the
+                # rare hung-but-open owner is bounded by `total`.
+                async with client.post(
+                        url, data=body, headers=headers,
+                        timeout=aiohttp.ClientTimeout(
+                            total=timeout_s, sock_connect=10)) as r:
+                    payload = await r.read()
+                    # Any HTTP status from the owner is an answer (client
+                    # errors replay identically anywhere; 5xx came from
+                    # the owner's own pipeline, which already ran its
+                    # failover budget) — only transport failures recover.
+                    return web.Response(
+                        body=payload, status=r.status,
+                        content_type=(r.content_type or "application/json"))
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                last_err = e
+                failed.append(owner)
+                logger.warning("handoff of %s to %s failed: %s",
+                               sid, owner, e)
+        if span:
+            span.set(error=str(last_err), error_code=503)
+            span.status = "ERROR: 503"
+        return web.json_response(
+            {"error": {"message": f"request owner unreachable: {last_err}",
+                       "type": "service_unavailable", "code": 503}},
+            status=503)
+
+    # --------------------------------------------------------------- stream
+    async def _relay_stream(self, http_req, client, body, kind, sid, owner,
+                            owner_key, headers, timeout_s,
+                            span) -> web.StreamResponse:
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "text/event-stream"
+        resp.headers["Cache-Control"] = "no-cache"
+        prepared = False
+        delivered = 0          # data frames already copied to the client
+        failed: list[str] = []
+        last_err: Any = None
+        for attempt in range(self.max_attempts):
+            if attempt:
+                owner = self._recover(owner, failed, owner_key, sid, span)
+                HANDOFF_RECOVERIES_TOTAL.labels(owner=owner).inc()
+            url = (self._url(owner, kind, sid)
+                   + f"&attempt={attempt}&skip={delivered}")
+            skip = delivered
+            try:
+                async with client.post(
+                        url, data=body, headers=headers,
+                        timeout=aiohttp.ClientTimeout(
+                            total=timeout_s, sock_connect=10,
+                            sock_read=self.stall_timeout_s)) as r:
+                    if r.status != 200:
+                        # The owner answered (error body, non-stream): an
+                        # authoritative reply, not a transport failure.
+                        payload = await r.read()
+                        if prepared:
+                            # Frames already went out — append an SSE
+                            # error frame instead of rewriting the status.
+                            await resp.write(
+                                _DATA_PREFIX + payload + b"\n\n")
+                            await resp.write_eof()
+                            return resp
+                        return web.Response(
+                            body=payload, status=r.status,
+                            content_type=(r.content_type
+                                          or "application/json"))
+                    # Client-facing writes are guarded INDIVIDUALLY: a
+                    # dead client raises ClientConnectionResetError,
+                    # which is an aiohttp.ClientError too — letting it
+                    # reach the owner-failure handler below would
+                    # misclassify the disconnect as owner death and
+                    # re-run the whole generation on the rendezvous
+                    # successor (up to max_attempts times) for a client
+                    # that is gone. OSError covers it: the reset is a
+                    # ConnectionResetError subclass.
+                    try:
+                        if not prepared:
+                            await resp.prepare(http_req)
+                            prepared = True
+                    except OSError:
+                        return resp    # CLIENT went away before prepare
+                    async for frame in self._frames(r.content):
+                        if frame.startswith(_DATA_PREFIX) and skip > 0:
+                            # Replay dedup: this frame was already
+                            # delivered by a previous owner incarnation.
+                            skip -= 1
+                            continue
+                        try:
+                            await resp.write(frame)
+                        except OSError:
+                            return resp    # CLIENT went away mid-copy
+                        if frame.startswith(_DATA_PREFIX):
+                            delivered += 1
+                    try:
+                        await resp.write_eof()
+                    except OSError:
+                        pass
+                    return resp
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                last_err = e
+                failed.append(owner)
+                logger.warning("handoff stream of %s via %s broke after "
+                               "%d frames: %s", sid, owner, delivered, e)
+        # Recovery budget exhausted mid-stream: surface in-band.
+        if span:
+            span.set(error=str(last_err), error_code=503)
+        if prepared:
+            try:
+                await resp.write(
+                    b'data: {"error": {"message": "request owner lost mid-'
+                    b'stream; recovery budget exhausted", "code": 503}}\n\n')
+                await resp.write_eof()
+            except (ConnectionResetError, OSError):
+                pass
+            return resp
+        return web.json_response(
+            {"error": {"message": f"request owner unreachable: {last_err}",
+                       "type": "service_unavailable", "code": 503}},
+            status=503)
+
+    @staticmethod
+    async def _frames(content: aiohttp.StreamReader):
+        """Yield complete SSE frames (through the blank-line terminator) so
+        the skip/count logic never sees a torn frame."""
+        buf = bytearray()
+        async for chunk, _ in content.iter_chunks():
+            buf += chunk
+            while True:
+                i = buf.find(b"\n\n")
+                if i < 0:
+                    break
+                yield bytes(buf[:i + 2])
+                del buf[:i + 2]
+        if buf:
+            yield bytes(buf)
+
+    def _recover(self, dead: str, failed: list[str], owner_key: str,
+                 sid: str, span) -> str:
+        """Deterministic re-ownership: the rendezvous successor over the
+        members that have not failed this relay."""
+        successor = self._ownership.owner_of(owner_key, exclude=failed)
+        logger.info("re-owning %s: %s -> %s (failed: %s)",
+                    sid, dead, successor, failed)
+        if span:
+            span.set(reowned_to=successor, attempt_failed=dead)
+        return successor

@@ -1,0 +1,172 @@
+"""Sticky request ownership over the live service-replica set.
+
+Every service replica registers under ``XLLM:SERVICE:<rpc_addr>`` with a
+TTL lease (scheduler ctor); this router watches that prefix and publishes
+the live member set as an immutable tuple (RCU, like instance_mgr's
+``RoutingSnapshot``). Ownership of a request is decided by rendezvous
+(highest-random-weight) hashing of its id over the members:
+
+- **deterministic** — any node resolves the same owner from the id alone
+  (no ownership table to replicate),
+- **minimally disruptive** — when a master dies, only the requests it
+  owned move, each to its deterministic successor (the next-highest
+  scoring survivor); everyone else's ownership is untouched. That is the
+  re-ownership rule the handoff relay uses to drain a dead owner's
+  in-flight requests onto survivors.
+
+The accepting frontend *mines* the ids it generates so that, in the
+common case, it owns what it accepts (expected ``N`` draws over an
+``N``-replica plane — one blake2b per member per draw) and no forward
+hop is paid; the rendezvous map then only has to carry the exceptions:
+client-pinned ``ownership_key`` affinity, membership races, and
+owner-death recovery.
+
+``owner_of`` runs on the request hot path → registered in xlint's
+``HOT_PATH_FUNCTIONS``.
+"""
+
+from __future__ import annotations
+
+import threading
+from hashlib import blake2b
+from typing import Callable, Iterable, Optional
+
+from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..devtools.locks import make_lock
+from ..rpc import MASTER_KEY, SERVICE_KEY_PREFIX
+from ..utils import generate_service_request_id, get_logger
+
+logger = get_logger(__name__)
+
+#: Bounded id-mining draws. P(all misses) = (1-1/N)^tries — at N=8 still
+#: under 2%; a miss just means this request pays one handoff hop.
+MINE_TRIES = 32
+
+
+class OwnershipRouter:
+    """Rendezvous-hash request→master ownership (lock-free reads)."""
+
+    def __init__(self, coord: CoordinationClient, self_addr: str,
+                 enabled: bool = True, mine_ids: bool = True,
+                 start_watch: bool = True):
+        self._coord = coord
+        self.self_addr = self_addr
+        self.enabled = enabled
+        self.mine_ids = mine_ids
+        # Writers (watch callbacks, self-addr updates) serialize here and
+        # publish an immutable sorted tuple; readers never take the lock.
+        self._lock = make_lock("multimaster.ownership", order=28)  # lock-order: 28
+        self._addrs: set[str] = {self_addr}
+        self._members: tuple[str, ...] = (self_addr,)
+        self.mined = 0          # ids mined to self-ownership
+        self.mine_misses = 0    # draws exhausted -> foreign owner accepted
+        self._watch_id: Optional[int] = None
+        if enabled and start_watch:
+            self._watch_id = coord.add_watch(SERVICE_KEY_PREFIX,
+                                             self._on_service_event)
+            self._bootstrap()
+
+    # ------------------------------------------------------------ membership
+    def _bootstrap(self) -> None:
+        addrs = {k[len(SERVICE_KEY_PREFIX):]
+                 for k in self._coord.get_prefix(SERVICE_KEY_PREFIX)
+                 if k != MASTER_KEY}
+        with self._lock:
+            self._addrs |= addrs
+            self._publish_locked()
+
+    def _on_service_event(self, events: list[KeyEvent], _prefix: str) -> None:
+        with self._lock:
+            for ev in events:
+                if ev.key == MASTER_KEY:
+                    continue   # election key shares the prefix
+                addr = ev.key[len(SERVICE_KEY_PREFIX):]
+                if ev.type == WatchEventType.PUT:
+                    self._addrs.add(addr)
+                elif addr != self.self_addr:
+                    # Self stays a member even through a lease blip: this
+                    # process is alive by construction, and dropping it
+                    # would stampede every mined id into handoffs.
+                    self._addrs.discard(addr)
+            self._publish_locked()
+
+    def _publish_locked(self) -> None:
+        self._members = tuple(sorted(self._addrs))
+
+    def update_self_addr(self, addr: str) -> None:
+        """Follow the scheduler's post-bind re-registration (ephemeral
+        ports are only known after the RPC site binds)."""
+        with self._lock:
+            self._addrs.discard(self.self_addr)
+            self.self_addr = addr
+            self._addrs.add(addr)
+            self._publish_locked()
+
+    def members(self) -> tuple[str, ...]:
+        """Live service-replica addresses (lock-free, immutable)."""
+        return self._members
+
+    # ------------------------------------------------------------- ownership
+    @staticmethod
+    def _score(member: str, key: str) -> int:
+        return int.from_bytes(
+            blake2b(f"{member}|{key}".encode(), digest_size=8).digest(),
+            "big")
+
+    def owner_of(self, key: str,
+                 exclude: Iterable[str] = ()) -> str:
+        """The owning master's rpc address for a request id (or explicit
+        affinity key). ``exclude`` drops members the caller has observed
+        dead but whose lease has not lapsed yet — the result is the
+        deterministic rendezvous successor. Falls back to self when the
+        plane is empty or ownership is disabled."""
+        if not self.enabled:
+            return self.self_addr
+        members = self._members
+        if exclude:
+            excluded = set(exclude)
+            members = tuple(m for m in members if m not in excluded)
+        if not members:
+            return self.self_addr
+        if len(members) == 1:
+            return members[0]
+        best, best_score = members[0], -1
+        for m in members:
+            s = self._score(m, key)
+            if s > best_score:
+                best, best_score = m, s
+        return best
+
+    def is_self(self, key: str, exclude: Iterable[str] = ()) -> bool:
+        return self.owner_of(key, exclude) == self.self_addr
+
+    def mine(self, kind: str,
+             gen: Optional[Callable[[str], str]] = None) -> tuple[str, str]:
+        """Generate a service request id, preferring one THIS node owns
+        (bounded draws). Returns ``(sid, owner_addr)``; the caller hands
+        off when ``owner_addr != self_addr`` (draws exhausted against an
+        unlucky membership, or mining disabled)."""
+        gen = gen or generate_service_request_id
+        if not self.enabled or len(self._members) <= 1:
+            return gen(kind), self.self_addr
+        if not self.mine_ids:
+            sid = gen(kind)
+            return sid, self.owner_of(sid)
+        sid = gen(kind)
+        for _ in range(MINE_TRIES):
+            if self.owner_of(sid) == self.self_addr:
+                self.mined += 1
+                return sid, self.self_addr
+            sid = gen(kind)
+        self.mine_misses += 1
+        return sid, self.owner_of(sid)
+
+    def stats(self) -> dict:
+        return {"self": self.self_addr, "members": list(self._members),
+                "enabled": self.enabled, "mine_ids": self.mine_ids,
+                "mined": self.mined, "mine_misses": self.mine_misses}
+
+    def stop(self) -> None:
+        if self._watch_id is not None:
+            self._coord.remove_watch(self._watch_id)
+            self._watch_id = None

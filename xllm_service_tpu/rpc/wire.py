@@ -72,6 +72,12 @@ HOT_PATH_FUNCTIONS: dict[str, str] = {
         "fake-engine heartbeat push (wire-contract reference impl)",
     "GlobalKVCacheMgr.upload_kvcache":
         "master→coordination KV-index sync (binary delta frames)",
+    "OwnershipRouter.owner_of":
+        "per-request ownership resolution (every accept + every relay)",
+    "HandoffRelay._relay_stream":
+        "owner-forward SSE relay (frames must pass through as raw bytes)",
+    "XllmHttpService.handle_handoff":
+        "owner-side ingest of relayed requests (full dispatch pipeline)",
 }
 
 

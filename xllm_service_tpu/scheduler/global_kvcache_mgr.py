@@ -37,8 +37,12 @@ Hot-path design (the cache-aware-routing data plane):
   tick — outside the lock — and batch-apply. Every
   ``kvcache_frame_compact_every`` frames (and on promotion) the master
   writes a full-state frame and prunes the log, which is also how
-  replicas bootstrap. Legacy per-block ``XLLM:CACHE:<hex>`` JSON keys
-  remain readable (bootstrap + watch) for mixed-version clusters.
+  replicas bootstrap. Compaction is ONE coordination revision
+  (``bulk_apply``: legacy-key prune + frame install in a single watch
+  batch) and replicas apply such batches copy-on-write, so an active
+  multi-master frontend's ``match()`` never observes the half-pruned
+  intermediate. Legacy per-block ``XLLM:CACHE:<hex>`` JSON keys remain
+  readable (bootstrap + watch) for mixed-version clusters.
 - **No dirty/removed resurrection.** The frame log is ordered: a
   ``remove_instance`` racing an in-flight upload lands its removals in
   the *next* frame, which replicas apply after the current one — a
@@ -381,6 +385,11 @@ class GlobalKVCacheMgr:
         written instead and the older log pruned (also the replica
         bootstrap path). Frame encode + coordination I/O run outside the
         index lock."""
+        if not self._is_master:
+            # Write-lease discipline (multi-master): frame publishing is
+            # master-only — a demoted master's straggler tick must not
+            # interleave its stale view into the new master's log.
+            return
         with self._lock:
             full = self._frames_since_full >= self._compact_every
             blocks = self._snapshot.blocks
@@ -404,25 +413,26 @@ class GlobalKVCacheMgr:
         frame = encode_kv_frame(upserts, removals, full=full)
         key = f"{CACHE_FRAME_KEY_PREFIX}{seq:020d}"
         if full:
-            # Compaction pruning must be ORDER-AWARE for watching
-            # replicas. Legacy per-block keys (a previous build's sync)
-            # are pruned BEFORE the full frame lands: a replica applies
-            # the DELETEs (transiently dropping those blocks) and then
-            # the full frame rebuilds complete state — pruning them after
-            # would permanently delete blocks the frame just installed.
-            # Old FRAME keys are pruned after (frame DELETEs are ignored
-            # by replicas, and keeping them until the new full frame is
-            # durable means a bootstrapping replica always sees a
-            # complete log).
+            # Compaction is ONE coordination revision (`bulk_apply`):
+            # prune the stale legacy per-block keys AND install the
+            # full-state frame in a single watch batch, DELETEs first.
+            # A replica applies the whole batch copy-on-write (see
+            # `_apply_parsed_locked`), so its lock-free `match()` jumps
+            # straight from the pre-compaction index to the complete
+            # post-frame index — no half-pruned intermediate, and the
+            # legacy-deletes-after-frame permanent-loss ordering bug
+            # can't occur because there is no cross-revision ordering
+            # left to get wrong. Old FRAME keys are pruned after (frame
+            # DELETEs are ignored by replicas, and keeping them until
+            # the new full frame is durable means a bootstrapping
+            # replica always sees a complete log).
             stale = list(self._coord.get_prefix(CACHE_KEY_PREFIX))
             legacy_stale = [k for k in stale
                             if not k.startswith(CACHE_FRAME_KEY_PREFIX)]
             frame_stale = [k for k in stale
                            if k.startswith(CACHE_FRAME_KEY_PREFIX)
                            and k != key]
-            if legacy_stale:
-                self._coord.bulk_rm(legacy_stale)
-            self._coord.bulk_set({key: frame})
+            self._coord.bulk_apply({key: frame}, legacy_stale)
             if frame_stale:
                 self._coord.bulk_rm(frame_stale)
         else:
@@ -471,6 +481,34 @@ class GlobalKVCacheMgr:
             self._apply_parsed_locked(ops)
 
     def _apply_parsed_locked(self, ops: list) -> None:
+        # Delta batches (frame ticks, legacy per-block sync from an old
+        # master) take the in-place path: entry-level RCU swaps into the
+        # shared dict, O(batch) with incremental reverse-index upkeep —
+        # each op is an independent block, so per-entry swaps never
+        # expose an incoherent index. Only a batch carrying a FULL-state
+        # frame (compaction, promotion) applies COPY-ON-WRITE: the whole
+        # batch lands in a side dict published with ONE reference swap,
+        # so a lock-free match() walking the superseded index sees a
+        # complete pre-batch generation — never the half-applied state
+        # (compaction's legacy prune without its full frame).
+        cow = any(op[0] != "legacy" and op[3] for op in ops)
+        if cow:
+            blocks = dict(self._snapshot.blocks)
+            for op in ops:
+                if op[0] == "legacy":
+                    _, h, loc = op
+                    if loc is None or loc.empty():
+                        blocks.pop(h, None)
+                    else:
+                        blocks[h] = loc
+                    continue
+                _, upserts, removals, full = op
+                if full:
+                    blocks = {}
+                self._apply_frame_into(blocks, upserts, removals)
+            self._by_instance = _build_by_instance(blocks)
+            self._snapshot = PrefixIndex(blocks)
+            return
         for op in ops:
             if op[0] == "legacy":
                 _, h, loc = op
@@ -479,16 +517,7 @@ class GlobalKVCacheMgr:
                 else:
                     self._put_key_locked(h, loc)
                 continue
-            _, upserts, removals, full = op
-            if full:
-                # Wholesale rebuild: fresh dict + reverse index,
-                # published with one reference swap so lock-free
-                # readers keep a coherent generation.
-                blocks: dict[bytes, _BlockLoc] = {}
-                self._apply_frame_into(blocks, upserts, removals)
-                self._by_instance = _build_by_instance(blocks)
-                self._snapshot = PrefixIndex(blocks)
-                continue
+            _, upserts, removals, _full = op
             for h in removals:
                 k = as_key(h)
                 if k is not None:

@@ -197,6 +197,12 @@ class InstanceMgr:
         self._metrics_lock = make_lock("instance_mgr.metrics", order=24)  # lock-order: 24
         self._load_metrics: dict[str, LoadMetrics] = {}
         self._latency_metrics: dict[str, LatencyMetrics] = {}
+        # Telemetry freshness per instance: when load/latency was last
+        # refreshed (heartbeat ingest here on the master; LOADMETRICS
+        # mirror on replicas). Feeds InstanceLoadInfo.updated_ms so
+        # staleness-aware scoring can discount entries a multi-master
+        # frontend is routing on from an old mirror.
+        self._load_updated_ms: dict[str, int] = {}
         self._request_loads: dict[str, _RequestLoad] = {}
         self._updated_load_names: set[str] = set()
         self._removed_load_names: set[str] = set()
@@ -259,7 +265,8 @@ class InstanceMgr:
             name=name, type=entry.meta.type,
             load=self._load_metrics.get(name, LoadMetrics()),
             latency=self._latency_metrics.get(name, LatencyMetrics()),
-            schedulable=name in snap.schedulable)
+            schedulable=name in snap.schedulable,
+            updated_ms=self._load_updated_ms.get(name, 0))
 
     def _update_load_info_locked(self, name: str) -> None:
         """Copy-on-write republish of one instance's load-info entry
@@ -418,9 +425,11 @@ class InstanceMgr:
                         d.get("load", {}))
                     self._latency_metrics[name] = LatencyMetrics.from_dict(
                         d.get("latency", {}))
+                    self._load_updated_ms[name] = now_ms()
                 else:
                     self._load_metrics.pop(name, None)
                     self._latency_metrics.pop(name, None)
+                    self._load_updated_ms.pop(name, None)
                 self._update_load_info_locked(name)
 
     # --------------------------------------------------------- registration
@@ -525,6 +534,7 @@ class InstanceMgr:
         with self._metrics_lock:
             self._load_metrics.pop(name, None)
             self._latency_metrics.pop(name, None)
+            self._load_updated_ms.pop(name, None)
             self._request_loads.pop(name, None)
             self._removed_load_names.add(name)
             self._updated_load_names.discard(name)
@@ -582,6 +592,7 @@ class InstanceMgr:
                     self._load_metrics[name] = load
                 if latency is not None:
                     self._latency_metrics[name] = latency
+                self._load_updated_ms[name] = now_ms()
                 self._updated_load_names.add(name)
                 self._update_load_info_locked(name)
         return True
@@ -653,8 +664,35 @@ class InstanceMgr:
         """Per-instance view for CAR scoring (reference `get_load_metrics`,
         `instance_mgr.cpp:287-359`). LOCK-FREE: returns the published
         view (rebuilt by load/latency/membership writers) — callers must
-        treat it as immutable."""
+        treat it as immutable. Each entry carries ``updated_ms``
+        (telemetry freshness) so staleness-aware scoring can discount
+        entries mirrored from an old master upload."""
         return self._load_infos
+
+    def stale_load_names(self, now: Optional[int] = None) -> set[str]:
+        """Instances whose telemetry is older than
+        ``loadinfo_stale_after_s`` — RELATIVE staleness: when every entry
+        is equally stale (bootstrap, idle fleet, no heartbeats yet) the
+        set is empty, because a uniform discount carries no routing
+        signal and would only distort absolute SLO thresholds.
+        Lock-free: one read of the published load-info view."""
+        infos = self._load_infos
+        if not infos:
+            return set()
+        now = now or now_ms()
+        horizon = now - int(self._opts.loadinfo_stale_after_s * 1000)
+        stale = {n for n, i in infos.items() if i.updated_ms < horizon}
+        if len(stale) == len(infos):
+            return set()
+        return stale
+
+    def load_info_ages_s(self, now: Optional[int] = None) -> dict[str, float]:
+        """Per-instance telemetry age in seconds (-1 = never updated) for
+        the admin surface and the planner's staleness report."""
+        now = now or now_ms()
+        return {n: round((now - i.updated_ms) / 1000.0, 3)
+                if i.updated_ms else -1.0
+                for n, i in self._load_infos.items()}
 
     def bind_request_instance_incarnations(self, req: Request) -> bool:
         """Reference `instance_mgr.cpp:408-449`: record the incarnations the
@@ -771,13 +809,26 @@ class InstanceMgr:
             loads = {n: self._request_loads.get(n, _RequestLoad())
                      for n, _ in prefills + decodes}
 
+        # Staleness discount (multi-master: a non-elected frontend scores
+        # off the LOADMETRICS mirror, refreshed once per master sync tick;
+        # an entry whose telemetry stopped flowing looks idle forever).
+        # Stale entries get their predicted cost inflated so fresh
+        # telemetry wins ties; relative-staleness (empty when ALL entries
+        # are stale) keeps absolute SLO thresholds undistorted at
+        # bootstrap.
+        stale = self.stale_load_names()
+        stale_factor = 1.0 + max(0.0, self._opts.stale_load_penalty)
+
         # 1) best prefill by estimated time-to-serve this prompt.
         def prefill_cost(item):
             name, entry = item
             ld = loads[name]
             if entry.predictor.has_ttft:
-                return (entry.predictor.predict_ttft(ld.num_prefill_tokens + prompt_len))
-            return float(ld.num_prefill_tokens + prompt_len)
+                cost = entry.predictor.predict_ttft(
+                    ld.num_prefill_tokens + prompt_len)
+            else:
+                cost = float(ld.num_prefill_tokens + prompt_len)
+            return cost * (stale_factor if name in stale else 1.0)
 
         best_prefill_name, best_prefill = min(prefills, key=prefill_cost)
         req.metrics.estimated_ttft_ms = best_prefill.predictor.predict_ttft(
@@ -793,6 +844,8 @@ class InstanceMgr:
             tpot = entry.predictor.predict_tpot(
                 ld.num_decode_requests + 1, ld.num_decode_tokens + prompt_len) \
                 if entry.predictor.has_tpot else 0.0
+            if name in stale:
+                tpot *= stale_factor
             if tpot <= self._opts.target_tpot_ms:
                 chosen_decode = name
                 break
@@ -844,11 +897,40 @@ class InstanceMgr:
         with self._flip_lock:
             pending = dict(self._pending_flips)
             self._pending_flips.clear()
+        if pending and not self._is_master:
+            # Write-lease discipline (multi-master): PD-role flips mutate
+            # coordination (instance-key move) and must stay funneled
+            # through the ELECTED master, or concurrent frontends would
+            # flip the same engine back and forth. Non-elected frontends
+            # forward the hint to the master's /rpc/flip_hint; its
+            # reconcile thread executes (and if mastership just moved,
+            # the receiver re-proxies — convergent).
+            self._proxy_flip_hints(pending)
+            return
         for name, new_type in pending.items():
             try:
                 self.flip_instance_role(name, new_type)
             except Exception:  # noqa: BLE001 — keep the reconcile loop up
                 logger.exception("async role flip of %s failed", name)
+
+    def _proxy_flip_hints(self, pending: dict[str, InstanceType]) -> None:
+        """Best-effort replica→master flip-hint forward (runs on the
+        reconcile thread, never a request path). A lost hint is re-raised
+        by the next SLO/planner pass that still sees the imbalance."""
+        import requests as _requests
+
+        master_addr = self._coord.get(MASTER_KEY)
+        if not master_addr:
+            return
+        for name, new_type in pending.items():
+            try:
+                _requests.post(f"http://{master_addr}/rpc/flip_hint",
+                               json={"name": name, "type": new_type.value},
+                               timeout=2)
+            except _requests.RequestException as e:
+                logger.warning("flip hint for %s -> %s lost (master %s "
+                               "unreachable: %s)", name, new_type.value,
+                               master_addr, e)
 
     def flip_instance_role(self, name: str, new_type: InstanceType) -> bool:
         """Dynamic PD-role switch: tell the engine to swap programs, then
@@ -900,6 +982,11 @@ class InstanceMgr:
     def upload_load_metrics(self) -> None:
         """Master: push updated load metrics to coordination; replicas mirror
         (reference `instance_mgr.cpp:372-391`)."""
+        if not self._is_master:
+            # Write-lease discipline (multi-master): LOADMETRICS records
+            # are master-published; a demoted master's straggler tick
+            # must not overwrite the new master's fresher uploads.
+            return
         with self._metrics_lock:
             updated = {n: json.dumps({
                 "load": self._load_metrics.get(n, LoadMetrics()).to_dict(),

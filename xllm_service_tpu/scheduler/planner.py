@@ -42,6 +42,11 @@ class PlanDecision:
     kv_pressure: float = 0.0
     flips_requested: list = field(default_factory=list)
     reasons: list = field(default_factory=list)
+    # Telemetry freshness of the load-info view this decision was planned
+    # from (multi-master: a plan computed off a stale mirror should say
+    # so). max_load_age_s is -1 when no entry ever updated.
+    max_load_age_s: float = 0.0
+    stale_load_entries: list = field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -66,6 +71,14 @@ class Planner:
             d.scale_hint = self.MIN_FLEET
             d.reasons.append("no instances registered")
             return self._finish(d)
+
+        ages = self._mgr.load_info_ages_s()
+        d.max_load_age_s = max(ages.values(), default=0.0)
+        d.stale_load_entries = sorted(self._mgr.stale_load_names())
+        if d.stale_load_entries:
+            d.reasons.append(
+                f"load telemetry stale for {len(d.stale_load_entries)} "
+                f"instance(s); their scoring is discounted")
 
         n = len(infos)
         waiting = sum(i.load.waiting_requests_num for i in infos)

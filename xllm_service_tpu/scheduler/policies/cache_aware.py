@@ -37,12 +37,21 @@ class CacheAwareRoutingPolicy(LoadBalancePolicy):
         infos = self._mgr.get_load_infos()
         max_blocks = max(overlap.max_block_num, 1)
         max_waiting = max(self._opts.max_waiting_requests, 1)
+        # Staleness discount (multi-master frontends score off mirrored
+        # telemetry): an entry whose load stopped updating looks idle and
+        # cache-hot forever — dock it `stale_load_penalty` score units so
+        # fresh telemetry wins. Relative staleness: the set is empty when
+        # ALL entries are equally stale (bootstrap / idle fleet), where a
+        # uniform discount carries no signal.
+        stale = self._mgr.stale_load_names()
+        penalty = max(0.0, self._opts.stale_load_penalty)
 
         def score(info) -> float:
             matched = overlap.scores.get(info.name, 0.0)
             return (matched / max_blocks
                     - info.load.hbm_cache_usage_perc
-                    - info.load.waiting_requests_num / max_waiting)
+                    - info.load.waiting_requests_num / max_waiting
+                    - (penalty if info.name in stale else 0.0))
 
         prefills = [i for i in infos.values()
                     if i.schedulable and i.type in _PREFILL_TYPES]
@@ -59,9 +68,13 @@ class CacheAwareRoutingPolicy(LoadBalancePolicy):
             # chosen for prefill (only a MIX node can appear in both
             # lists). On a PD-disaggregated fleet, collapsing both stages
             # onto it would silently drop the decode leg — take the
-            # second-best decode instead, and serve single-instance only
-            # when no other decode exists.
-            others = [i for i in decodes if i.name != best_p.name]
+            # second-best DEDICATED decode instead. When the only
+            # alternatives are other MIX nodes, collapse onto the winner:
+            # a MIX instance serves both stages natively, and splitting
+            # two MIX nodes pays a cross-instance KV handoff for capacity
+            # the collapsed instance already has.
+            others = [i for i in decodes if i.name != best_p.name
+                      and i.type == InstanceType.DECODE]
             if not others:
                 return Routing(prefill_name=best_p.name)
             best_d = max(others, key=score)

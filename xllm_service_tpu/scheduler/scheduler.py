@@ -150,6 +150,15 @@ class Scheduler:
         self.is_master = self._coord.create_if_absent(
             MASTER_KEY, self.self_addr, ttl_s=options.lease_ttl_s)
 
+        # Multi-master service plane: every replica is an ACTIVE frontend;
+        # per-request ownership is decided by rendezvous hashing over the
+        # live service records this router mirrors (multimaster/).
+        from ..multimaster import OwnershipRouter
+        self.ownership = OwnershipRouter(
+            self._coord, self.self_addr,
+            enabled=options.multimaster_ownership,
+            mine_ids=options.multimaster_mine_owned_ids)
+
         self.instance_mgr = InstanceMgr(self._coord, options,
                                         is_master=self.is_master,
                                         start_threads=start_threads)
@@ -204,6 +213,7 @@ class Scheduler:
         self._coord.set(SERVICE_KEY_PREFIX + addr,
                         json.dumps({"rpc_address": addr}),
                         ttl_s=self._opts.lease_ttl_s)
+        self.ownership.update_self_addr(addr)
         if self.is_master:
             # Overwrite in place — we hold the lease. A rm+create would fire
             # a DELETE watch event and race replica takeover (split brain).
@@ -929,6 +939,7 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stopped.set()
+        self.ownership.stop()
         self.instance_mgr.stop()
         self.kvcache_mgr.stop()
         self._output_executor.shutdown()
