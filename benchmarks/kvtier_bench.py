@@ -1,0 +1,361 @@
+"""Tiered KV-cache data-plane benchmark (ISSUE 7 acceptance numbers).
+
+Four legs, all on the real engine (tiny model, ``JAX_PLATFORMS=cpu``):
+
+1. **Tier-aware TTFT** — one ≥1k-token shared prefix, measured hot
+   (prefix in HBM), warm (prefix offloaded to the DRAM arena), cold-SSD
+   (prefix demoted to the spill file) and cold-recompute (tiering off:
+   the full prefill runs again). The warm/cold gap is the Mooncake-style
+   claim: an onload is a host memcpy + device scatter, a recompute is
+   the whole prefill.
+2. **Capacity multiplier** — distinct prefixes pushed through a fixed
+   HBM budget until far past eviction; addressable prefix blocks
+   (HBM + fence-complete tier blocks) vs the HBM-only baseline.
+3. **Decode-step latency under background offload** — identical
+   decode+churn workload on a tiered and an untiered engine,
+   interleaved rounds; the tier pump must not move p50 step time.
+4. **Streaming transfer framing** — chunked offer/pull throughput at
+   two chunk sizes, plus a DCN-budgeted run showing the token-bucket
+   pacing converge on the configured bytes/s.
+
+    python benchmarks/kvtier_bench.py                 # all legs
+    python benchmarks/kvtier_bench.py --quick         # CI-scale
+    python benchmarks/kvtier_bench.py --out BENCH_kvtier_r09.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.common.request import RequestOutput, SamplingParams
+from xllm_service_tpu.engine.config import EngineConfig
+from xllm_service_tpu.engine.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.engine.kv_transfer import (
+    BandwidthAccountant,
+    StreamOfferTable,
+    pull_stream,
+)
+from xllm_service_tpu.models.base import tiny_config
+
+
+def percentile(xs, p):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))
+    return xs[i]
+
+
+# --------------------------------------------------------------- harness
+class _FirstToken:
+    """Records the wall time of the first emitted token."""
+
+    def __init__(self):
+        self.t_first = None
+        self.done = False
+
+    def __call__(self, out: RequestOutput) -> None:
+        if self.t_first is None and any(s.token_ids for s in out.outputs):
+            self.t_first = time.perf_counter()
+        if out.finished:
+            self.done = True
+
+
+def _mk_engine(num_pages: int, tier_dram: int = 0, tier_ssd: int = 0,
+               hash_block: int = 64, max_ctx: int = 2048,
+               buckets=(64, 128, 1088, 2048)) -> InferenceEngine:
+    return InferenceEngine(EngineConfig(
+        model=tiny_config(dtype=jnp.float32, max_context_len=max_ctx),
+        num_pages=num_pages, page_size=16, hash_block_size=hash_block,
+        max_batch_size=4, max_seq_len=max_ctx, prefill_buckets=buckets,
+        kv_tier_dram_bytes=tier_dram, kv_tier_ssd_bytes=tier_ssd))
+
+
+def _run(engine: InferenceEngine, rid: str, prompt, max_tokens=8) -> float:
+    """Submit one request, drive the loop to completion; returns TTFT s."""
+    col = _FirstToken()
+    t0 = time.perf_counter()
+    engine.submit(EngineRequest(
+        rid, rid, token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                                ignore_eos=True),
+        on_output=col))
+    while not col.done:
+        if not engine.step():
+            time.sleep(0.0005)
+    return col.t_first - t0
+
+
+def _wait(pred, timeout=20.0):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError("tier state never converged")
+        time.sleep(0.01)
+
+
+# --------------------------------------------------------- leg 1: TTFT
+def bench_tier_ttft(prefix_tokens: int, rounds: int) -> dict:
+    hbs = 64
+    n_blocks = prefix_tokens // hbs
+    prefix = list(np.random.default_rng(7).integers(
+        2, 500, size=prefix_tokens))
+    tail = list(range(16))           # distinct suffix past the prefix
+
+    def prompt():
+        return prefix + tail
+
+    def churn(engine, start, count, tokens=384):
+        """Distinct throwaway prompts that force LRU eviction of the
+        shared prefix (and, tiered, its offload)."""
+        for i in range(count):
+            base = 10_000 + (start + i) * 1000
+            p = list(np.random.default_rng(base).integers(
+                2, 500, size=tokens))
+            _run(engine, f"churn-{base}", p, max_tokens=2)
+
+    out = {"prefix_tokens": prefix_tokens, "prefix_blocks": n_blocks}
+
+    hx = [h.hex()
+          for h in prefix_block_hashes(prompt(), hbs)][:n_blocks]
+
+    # Tiered engine: DRAM generously sized; prefix offloads whole.
+    eng = _mk_engine(num_pages=96, tier_dram=256 << 20)
+    blk = eng.tier_store.block_nbytes
+    hot, warm = [], []
+    _run(eng, "seed", prompt())      # donate the prefix blocks
+    # Warm-up cycle: compile the suffix-prefill bucket and the tier
+    # scatter program OUTSIDE the measured rounds.
+    _run(eng, "wu-hot", prompt())
+    churn(eng, 900, 5)
+    _wait(lambda: all(eng.tier_store.ready(h) for h in hx))
+    _run(eng, "wu-warm", prompt())
+    for r in range(rounds):
+        hot.append(_run(eng, f"hot-{r}", prompt()))
+        churn(eng, r * 10, 5)
+        _wait(lambda: all(eng.tier_store.ready(h) for h in hx))
+        warm.append(_run(eng, f"warm-{r}", prompt()))
+    tier_stats = eng.tier_store.stats()
+
+    # SSD leg: DRAM squeezed to 2 blocks so the prefix demotes to disk.
+    eng_ssd = _mk_engine(num_pages=96, tier_dram=2 * blk,
+                         tier_ssd=256 << 20)
+    ssd = []
+    _run(eng_ssd, "seed", prompt())
+    for r in range(-1, rounds):      # round -1 = compile warm-up
+        churn(eng_ssd, 100 + r * 10, 5)
+        _wait(lambda: all(eng_ssd.tier_store.ready(h) for h in hx)
+              and eng_ssd.tier_store.ssd_blocks() >= n_blocks - 2)
+        t = _run(eng_ssd, f"ssd-{r}", prompt())
+        if r >= 0:
+            ssd.append(t)
+
+    # Cold recompute: tiering OFF — eviction destroys the prefix, every
+    # re-admission pays the full prefill.
+    eng_cold = _mk_engine(num_pages=96)
+    cold = []
+    _run(eng_cold, "seed", prompt())
+    for r in range(rounds):
+        churn(eng_cold, 200 + r * 10, 5)
+        cold.append(_run(eng_cold, f"cold-{r}", prompt()))
+
+    out.update({
+        "block_nbytes": blk,
+        "hot_hbm_ttft_ms": round(statistics.median(hot) * 1e3, 2),
+        "warm_dram_ttft_ms": round(statistics.median(warm) * 1e3, 2),
+        "warm_ssd_ttft_ms": round(statistics.median(ssd) * 1e3, 2),
+        "cold_recompute_ttft_ms": round(statistics.median(cold) * 1e3, 2),
+        "warm_vs_cold_speedup": round(
+            statistics.median(cold) / statistics.median(warm), 2),
+        "ssd_vs_cold_speedup": round(
+            statistics.median(cold) / statistics.median(ssd), 2),
+        "tier_stats": tier_stats,
+    })
+    return out
+
+
+# ------------------------------------------------- leg 2: capacity
+def bench_capacity(num_prefixes: int) -> dict:
+    """Fixed HBM budget; distinct 256-token prefixes far past HBM
+    capacity. Addressable = still-matchable prefix blocks."""
+    def feed(engine):
+        for i in range(num_prefixes):
+            p = list(np.random.default_rng(5_000 + i).integers(
+                2, 500, size=256))
+            _run(engine, f"cap-{i}", p, max_tokens=2)
+
+    base = _mk_engine(num_pages=64, max_ctx=512, buckets=(64, 128, 512))
+    feed(base)
+    hbm_only = base.page_mgr.cached_block_count()
+
+    tiered = _mk_engine(num_pages=64, tier_dram=16 << 20,
+                        tier_ssd=64 << 20, max_ctx=512,
+                        buckets=(64, 128, 512))
+    feed(tiered)
+    _wait(lambda: not tiered.page_mgr._evicted_pending)
+    time.sleep(0.3)          # let in-flight offload writes fence
+    st = tiered.tier_store.stats()
+    hbm = tiered.page_mgr.cached_block_count()
+    addressable = hbm + st["dram_blocks"] + st["ssd_blocks"]
+    return {
+        "distinct_prefixes": num_prefixes,
+        "prefix_blocks_fed": num_prefixes * 4,
+        "hbm_budget_pages": 64,
+        "hbm_only_addressable_blocks": hbm_only,
+        "tiered_addressable_blocks": addressable,
+        "tiered_split": {"hbm": hbm, "dram": st["dram_blocks"],
+                         "ssd": st["ssd_blocks"]},
+        "offload_dropped": st["offload_dropped"],
+        "capacity_multiplier": round(addressable / max(1, hbm_only), 2),
+    }
+
+
+# -------------------------------------- leg 3: step latency under offload
+def _step_workload(engine: InferenceEngine, churn_every: int,
+                   n_churn: int) -> list[float]:
+    """One long decode + periodic churn admissions; returns step() wall
+    times for steps taken while the long decode is live."""
+    col = _FirstToken()
+    engine.submit(EngineRequest(
+        "longdec", "longdec", token_ids=list(range(40, 72)),
+        sampling=SamplingParams(max_tokens=160, temperature=0.0,
+                                ignore_eos=True),
+        on_output=col))
+    durs = []
+    steps = 0
+    injected = 0
+    sink = []
+    while not col.done:
+        if injected < n_churn and steps and steps % churn_every == 0:
+            c = _FirstToken()
+            sink.append(c)
+            p = list(np.random.default_rng(9_000 + injected).integers(
+                2, 500, size=192))
+            engine.submit(EngineRequest(
+                f"churn-{injected}", f"churn-{injected}", token_ids=p,
+                sampling=SamplingParams(max_tokens=2, temperature=0.0,
+                                        ignore_eos=True),
+                on_output=c))
+            injected += 1
+        t0 = time.perf_counter()
+        busy = engine.step()
+        durs.append(time.perf_counter() - t0)
+        steps += 1
+        if not busy:
+            time.sleep(0.0005)
+    while not all(c.done for c in sink):
+        engine.step()
+    return durs
+
+
+def bench_step_latency(rounds: int) -> dict:
+    base = _mk_engine(num_pages=64, max_ctx=512, buckets=(64, 256, 512))
+    tier = _mk_engine(num_pages=64, tier_dram=256 << 20, max_ctx=512,
+                      buckets=(64, 256, 512))
+    base_durs, tier_durs = [], []
+    for _ in range(rounds):          # interleaved rounds: drift-proof
+        base_durs += _step_workload(base, churn_every=12, n_churn=8)
+        tier_durs += _step_workload(tier, churn_every=12, n_churn=8)
+    st = tier.tier_store.stats()
+    b50 = statistics.median(base_durs)
+    t50 = statistics.median(tier_durs)
+    return {
+        "rounds": rounds,
+        "baseline_step_p50_ms": round(b50 * 1e3, 3),
+        "tiered_step_p50_ms": round(t50 * 1e3, 3),
+        "baseline_step_p90_ms": round(percentile(base_durs, 90) * 1e3, 3),
+        "tiered_step_p90_ms": round(percentile(tier_durs, 90) * 1e3, 3),
+        "delta_p50_perc": round((t50 - b50) / b50 * 100, 2),
+        "offloads_during_tiered_run": st["offload_total"],
+        "offload_dropped": st["offload_dropped"],
+    }
+
+
+# ----------------------------------------------- leg 4: stream framing
+def bench_stream(payload_mb: int) -> dict:
+    data = np.random.default_rng(3).standard_normal(
+        payload_mb * (1 << 20) // 4).astype(np.float32)
+    out = {"payload_mb": payload_mb, "chunks": {}}
+    for chunk in (1 << 18, 1 << 20):
+        table = StreamOfferTable(default_chunk_bytes=chunk)
+        desc = table.offer("bench", data.tobytes(),
+                           shape=[data.size], dtype="float32")
+
+        def post(url, payload):
+            return table.read_chunk(payload["uuid"], payload["offset"],
+                                    payload["max_bytes"])
+
+        bw = BandwidthAccountant()
+        t0 = time.perf_counter()
+        got = pull_stream("peer:0", desc, accountant=bw, post=post)
+        el = time.perf_counter() - t0
+        assert got.nbytes == data.nbytes
+        table.release(desc["stream_uuid"])
+        out["chunks"][f"{chunk >> 10}KiB"] = {
+            "mb_per_s": round(data.nbytes / el / 1e6, 1),
+            "round_trips": -(-data.nbytes // chunk),
+        }
+    # Budgeted run: the token bucket allows ONE budget-second of burst,
+    # so a payload of ~3 budget-seconds must take ~2s of pacing sleep.
+    budget = data.nbytes // 3
+    table = StreamOfferTable(default_chunk_bytes=1 << 20)
+    desc = table.offer("bench-paced", data.tobytes(),
+                       shape=[data.size], dtype="float32")
+
+    def post(url, payload):
+        return table.read_chunk(payload["uuid"], payload["offset"],
+                                payload["max_bytes"])
+
+    bw = BandwidthAccountant(dcn_bytes_per_s=budget)
+    t0 = time.perf_counter()
+    pull_stream("peer:0", desc, accountant=bw, link="dcn", post=post)
+    el = time.perf_counter() - t0
+    out["paced_dcn"] = {
+        "budget_mb_per_s": round(budget / 1e6, 1),
+        "achieved_mb_per_s": round(data.nbytes / el / 1e6, 1),
+    }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI scale: small prefix, 1 round")
+    ap.add_argument("--prefix-tokens", type=int, default=1024)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--prefixes", type=int, default=14)
+    ap.add_argument("--payload-mb", type=int, default=8)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    if args.quick:
+        args.prefix_tokens, args.rounds, args.prefixes = 256, 1, 12
+        args.payload_mb = 2
+
+    report = {
+        "round": 9,
+        "box": "CI container, JAX_PLATFORMS=cpu",
+        "bench": "benchmarks/kvtier_bench.py",
+        "tier_ttft": bench_tier_ttft(args.prefix_tokens, args.rounds),
+        "capacity": bench_capacity(args.prefixes),
+        "step_latency": bench_step_latency(max(1, args.rounds - 1)),
+        "stream": bench_stream(args.payload_mb),
+    }
+    print(json.dumps(report, indent=2))
+    if args.out:
+        Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,10 +6,14 @@
 # all randomness from one seeded RNG), so any failing iteration can be
 # replayed exactly with:   XLLM_CHAOS_SEED=<seed> pytest -m chaos
 #
-# Usage: scripts/chaos_soak.sh [iterations] [--masters] [extra pytest args...]
+# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier] [extra pytest args...]
 #   --masters   soak the multi-master plane drills (tests/test_multimaster.py:
 #               owner/master kill mid-stream, split-brain demotion, write-lease
 #               proxying) instead of the single-master failover drills.
+#   --tier      soak the tiered KV-cache churn drills (tests/test_kv_tiering.py:
+#               eviction→offload→onload round trips under a saturated pump,
+#               streamed PD handoff with faults injected at the
+#               kv_transfer.offer / kv_transfer.pull points → inline fallback).
 set -u
 
 ITERS="${1:-20}"
@@ -17,6 +21,9 @@ shift 2>/dev/null || true
 SUITE="tests/test_chaos_failover.py"
 if [ "${1:-}" = "--masters" ]; then
     SUITE="tests/test_multimaster.py"
+    shift
+elif [ "${1:-}" = "--tier" ]; then
+    SUITE="tests/test_kv_tiering.py"
     shift
 fi
 cd "$(dirname "$0")/.."

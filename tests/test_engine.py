@@ -216,8 +216,11 @@ class TestEngineCorrectness:
         assert col.finish_reason == "stop"
         assert len(col.tokens) == 1
         # OpenAI/vLLM semantics: the matched stop token's text must not
-        # leak into visible content.
-        assert engine.tokenizer.decode([first]) not in col.text
+        # leak into visible content. (The sampled token may fall in the
+        # SimpleTokenizer's silent special range and decode to "" — the
+        # leak check is only meaningful when it has text at all.)
+        stop_text = engine.tokenizer.decode([first])
+        assert not stop_text or stop_text not in col.text
 
     def test_horizon_bounded_by_remaining_budget(self):
         """The decode horizon is bounded by the LONGEST remaining token

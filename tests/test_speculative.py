@@ -108,7 +108,11 @@ class TestSpeculativeDecoding:
             on_output=col)
         run_all(make_engine(4), [req])
         assert col.finish_reason == "stop"
-        assert col.tokens == b.tokens[:4]
+        # Stop fires at the FIRST occurrence of the stop token in the
+        # baseline stream (the repetitive prompt may repeat it well
+        # before the index it was drawn from).
+        k = b.tokens.index(stop_tok) + 1
+        assert col.tokens == b.tokens[:k]
 
     def test_sampling_request_uses_normal_path(self):
         """With NO spec-eligible slot the plain decode horizon is used

@@ -239,7 +239,16 @@ class KvCacheEvent:
     def merge(self, other: "KvCacheEvent") -> None:
         """Union of two replicas' deltas (dp_size>1: the instance-level
         event is the union of its replicas'; a block removed by one replica
-        but stored by another stays stored)."""
+        but stored by another stays stored). Same best-state rule for
+        tiers: a block one replica offloaded cold but another holds in HBM
+        ships stored-only — the index applies stored before offloaded, so
+        shipping both would demote the instance below its best tier.
+        Within ONE delta stored+offloaded is the donate-then-evict
+        sequence (per-replica deltas are internally ordered) and the cold
+        move must survive, hence the per-side stored-minus-offloaded
+        sets."""
+        hbm_only = (set(self.stored) - set(self.offloaded)) \
+            | (set(other.stored) - set(other.offloaded))
         removed_here = set(other.stored)
         self.removed = [h for h in self.removed if h not in removed_here]
         stored_there = set(self.stored)
@@ -247,8 +256,9 @@ class KvCacheEvent:
         kept = set(self.stored)
         self.removed += [h for h in other.removed
                          if h not in kept and h not in set(self.removed)]
-        self.offloaded += [h for h in other.offloaded
-                           if h not in set(self.offloaded)]
+        offloaded = self.offloaded + [h for h in other.offloaded
+                                      if h not in set(self.offloaded)]
+        self.offloaded = [h for h in offloaded if h not in hbm_only]
 
     def to_dict(self) -> dict[str, Any]:
         """JSON-safe form: hex-string keys (legacy heartbeat wire)."""

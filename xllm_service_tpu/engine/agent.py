@@ -52,11 +52,14 @@ logger = get_logger(__name__)
 def pack_handoff(h: PrefillHandoff, source_service_addr: str,
                  kv_ref: Optional[dict] = None,
                  source_instance: str = "",
-                 trace_context: Optional[dict] = None) -> bytes:
+                 trace_context: Optional[dict] = None,
+                 kv_stream: Optional[dict] = None) -> bytes:
     """Serialize a PD handoff control message. With `kv_ref` (device
     transfer path) the KV stays on device and only the pull descriptor is
-    sent; otherwise the blob is downloaded and carried inline (DCN host
-    path; msgpack + raw array bytes, bf16 as ml_dtypes bytes).
+    sent; with `kv_stream` the host bytes are pulled back in chunked
+    frames (streaming multi-block transfer, bandwidth-accounted);
+    otherwise the blob is downloaded and carried inline (DCN host path;
+    msgpack + raw array bytes, bf16 as ml_dtypes bytes).
     `source_instance` identifies the sending prefill instance — the decode
     side only accepts handoffs from linked peers."""
     lp = h.first_logprob
@@ -78,6 +81,8 @@ def pack_handoff(h: PrefillHandoff, source_service_addr: str,
         msg["trace_context"] = trace_context
     if kv_ref is not None:
         msg["kv_ref"] = kv_ref
+    elif kv_stream is not None:
+        msg["kv_stream"] = kv_stream
     else:
         blob = np.asarray(h.kv_blob)
         msg["kv"] = {"bytes": blob.tobytes(),
@@ -125,6 +130,19 @@ class AgentConfig:
     # advertising an identical mesh topology (shard layouts must line
     # up) — mismatched pairs fall back to the host path.
     enable_device_kv_transfer: bool = True
+    # Host-path streaming transfer (engine/kv_transfer.py StreamOfferTable
+    # + pull_stream): payloads at or above the threshold are pulled back
+    # in chunked msgpack frames — many blocks per round-trip — instead of
+    # one monolithic inline POST. 0 threshold streams everything; a
+    # negative threshold disables streaming.
+    kv_stream_threshold_bytes: int = 256 * 1024
+    kv_stream_chunk_bytes: int = 1 << 20
+    # Per-link-class bandwidth budgets, bytes/s (0 = unthrottled): links
+    # to a peer on the SAME slice are ICI-shaped, cross-slice links are
+    # DCN-shaped. The pull side paces to the budget; throughput reports
+    # in spans and /stats either way.
+    ici_bytes_per_s: float = 0.0
+    dcn_bytes_per_s: float = 0.0
 
 
 class _ChoiceAggregator:
@@ -326,15 +344,25 @@ class EngineAgent:
         self.engines: list[InferenceEngine] = []
         for i in range(dp):
             dev = devs[i % len(devs)]
+            ecfg_i = engine_cfg
+            if i > 0 and engine_cfg.kv_tier_ssd_path:
+                # Each replica owns its own TieredKVStore; a shared spill
+                # path would have replica i's open('w+b') truncate the
+                # file under replica 0's live mmap.
+                import dataclasses
+
+                ecfg_i = dataclasses.replace(
+                    engine_cfg,
+                    kv_tier_ssd_path=f"{engine_cfg.kv_tier_ssd_path}.{i}")
             with jax.default_device(dev):
                 if i == 0:
-                    eng = InferenceEngine(engine_cfg, tokenizer=tokenizer,
+                    eng = InferenceEngine(ecfg_i, tokenizer=tokenizer,
                                           params=params)
                 else:
                     # Replicate the first replica's weights (same values on
                     # every replica; a copy only when the device differs).
                     eng = InferenceEngine(
-                        engine_cfg, tokenizer=tokenizer,
+                        ecfg_i, tokenizer=tokenizer,
                         params=jax.device_put(self.engines[0].params, dev))
             self.engines.append(eng)
         # Multi-host lockstep (parallel/multihost.py): this agent runs on
@@ -386,6 +414,16 @@ class EngineAgent:
             if self.kv_transfer is not None:
                 logger.info("device KV transfer server on %s",
                             self.kv_transfer.address)
+        # Host-path streaming transfer: offer table served via
+        # /rpc/kv_stream_pull + per-link bandwidth accounting (ICI vs DCN
+        # shaped by peer slice id).
+        from .kv_transfer import BandwidthAccountant, StreamOfferTable
+
+        self.kv_stream = StreamOfferTable(agent_cfg.kv_stream_chunk_bytes)
+        self.bandwidth = BandwidthAccountant(agent_cfg.ici_bytes_per_s,
+                                             agent_cfg.dcn_bytes_per_s)
+        self.kv_stream_sent = 0
+        self.kv_stream_received = 0
         self.linked_peers: dict[str, InstanceMetaInfo] = {}
         # Handoff idempotency: sid -> receive time. A device-path control
         # POST whose response is lost makes the prefill side retry via the
@@ -590,6 +628,7 @@ class EngineAgent:
         app.router.add_post("/rpc/cancel", self._h_cancel)
         app.router.add_post("/rpc/flip_role", self._h_flip)
         app.router.add_post("/rpc/kv_transfer", self._h_kv_transfer)
+        app.router.add_post("/rpc/kv_stream_pull", self._h_kv_stream_pull)
         app.router.add_post("/rpc/encode", self._h_encode)
 
         async def _start():
@@ -616,6 +655,7 @@ class EngineAgent:
                 self.register()   # lease refresh via re-registration
                 if self.kv_transfer is not None:
                     self.kv_transfer.gc()   # free never-pulled KV offers
+                self.kv_stream.gc()         # ... and expired stream offers
                 master = self.coord.get(MASTER_KEY)
                 if not master:
                     continue
@@ -703,9 +743,25 @@ class EngineAgent:
                 "host_sent": self.kv_host_sent,
                 "device_received": self.kv_device_received,
                 "host_received": self.kv_host_received,
+                "stream_sent": self.kv_stream_sent,
+                "stream_received": self.kv_stream_received,
+                "bandwidth": self.bandwidth.stats(),
             },
+            "kv_tier": self._tier_stats(),
             "ttft_spans": self._span_summary(),
         })
+
+    def _tier_stats(self) -> dict[str, Any]:
+        """Summed tier-store telemetry across replicas ({} = tiering
+        off)."""
+        out: dict[str, Any] = {}
+        for eng in self.engines:
+            store = getattr(eng, "tier_store", None)
+            if store is None:
+                continue
+            for k, v in store.stats().items():
+                out[k] = out.get(k, 0) + v if k != "block_nbytes" else v
+        return out
 
     def _span_summary(self) -> dict[str, float]:
         """p50s of the TTFT span samples (agent accept -> first delta;
@@ -753,6 +809,33 @@ class EngineAgent:
             "# TYPE engine_sarathi_rides_total counter",
             f"engine_sarathi_rides_total {st['sarathi_rides']}",
         ]
+        tier = self._tier_stats()
+        if tier:
+            lines += [
+                "# TYPE engine_kv_tier_blocks gauge",
+                f'engine_kv_tier_blocks{{tier="dram"}} '
+                f"{tier.get('dram_blocks', 0)}",
+                f'engine_kv_tier_blocks{{tier="ssd"}} '
+                f"{tier.get('ssd_blocks', 0)}",
+                "# TYPE engine_kv_tier_offloads_total counter",
+                f"engine_kv_tier_offloads_total "
+                f"{tier.get('offload_total', 0)}",
+                "# TYPE engine_kv_tier_onloads_total counter",
+                f"engine_kv_tier_onloads_total "
+                f"{tier.get('onload_total', 0)}",
+                "# TYPE engine_kv_tier_bytes_total counter",
+                f'engine_kv_tier_bytes_total{{direction="offload"}} '
+                f"{tier.get('bytes_offloaded', 0)}",
+                f'engine_kv_tier_bytes_total{{direction="onload"}} '
+                f"{tier.get('bytes_onloaded', 0)}",
+            ]
+        for link, bw in self.bandwidth.stats().items():
+            lines += [
+                f'engine_kv_stream_bytes_total{{link="{link}"}} '
+                f"{bw['bytes_total']:.0f}",
+                f'engine_kv_stream_throughput_bytes_per_s{{link="{link}"}} '
+                f"{bw['throughput_bytes_per_s']:.1f}",
+            ]
         spans = self._span_summary()
         lines += [
             "# TYPE engine_ttft_span_p50_milliseconds gauge",
@@ -1037,6 +1120,39 @@ class EngineAgent:
                 logger.warning(
                     "device KV transfer of %s to %s failed (%s); falling "
                     "back to host path", h.service_request_id, peer, e)
+        # Streaming host path: big payloads are offered for chunked pull
+        # (many blocks per round-trip, bandwidth-accounted) instead of
+        # being carried inline in one monolithic POST.
+        blob_np = None
+        thresh = self.cfg.kv_stream_threshold_bytes
+        if thresh >= 0:
+            try:
+                blob_np = np.asarray(h.kv_blob)
+            except Exception:  # noqa: BLE001  # xlint: allow-broad-except(an invalidated/donated device buffer downgrades to the inline path, which re-fetches)
+                blob_np = None
+        if blob_np is not None and blob_np.nbytes >= thresh:
+            desc = None
+            try:
+                desc = self.kv_stream.offer(
+                    h.service_request_id, blob_np.tobytes(),
+                    shape=list(blob_np.shape), dtype=str(blob_np.dtype),
+                    incarnation=self.incarnation_id,
+                    block_bytes=blob_np.nbytes
+                    // max(1, blob_np.shape[2]), ctx=ctx)
+                self._post_handoff(peer, pack_handoff(
+                    h, dest, source_instance=self.name,
+                    trace_context=trace_dict, kv_stream=desc))
+                self.kv_stream.release(desc["stream_uuid"])
+                self.kv_stream_sent += 1
+                self.kv_host_sent += 1
+                return
+            except Exception as e:  # noqa: BLE001
+                if desc is not None:
+                    self.kv_stream.release(desc["stream_uuid"])
+                logger.warning(
+                    "streamed KV transfer of %s to %s failed (%s); "
+                    "falling back to inline host path",
+                    h.service_request_id, peer, e)
         try:
             with TRACER.span("kv_transfer.offer", ctx=ctx, require_ctx=True,
                              request_id=h.service_request_id,
@@ -1126,6 +1242,33 @@ class EngineAgent:
             "dtype": "float32"}, use_bin_type=True),
             content_type="application/msgpack")
 
+    async def _h_kv_stream_pull(self, req: web.Request) -> web.Response:
+        """Serve one chunk of a streamed KV offer (msgpack in/out). The
+        peer drives offsets; a chunk read is one memoryview slice — no
+        per-frame re-serialization of the whole payload."""
+        try:
+            obj = msgpack.unpackb(await req.read(), raw=False)
+            frame = self.kv_stream.read_chunk(
+                int(obj["uuid"]), int(obj.get("offset", 0)),
+                int(obj.get("max_bytes", self.cfg.kv_stream_chunk_bytes)))
+        except Exception as e:  # noqa: BLE001  # xlint: allow-broad-except(malformed pull frame is surfaced as a 400 to the peer)
+            return web.json_response({"error": f"bad pull frame: {e}"},
+                                     status=400)
+        if frame is None:
+            return web.json_response({"error": "unknown or expired offer"},
+                                     status=404)
+        return web.Response(body=msgpack.packb(frame, use_bin_type=True),
+                            content_type="application/msgpack")
+
+    def _link_class(self, peer_name: str) -> str:
+        """ICI-shaped (same slice) vs DCN-shaped (cross-slice) for
+        bandwidth budgeting."""
+        meta = self.linked_peers.get(peer_name)
+        if meta is not None and meta.topology.slice_id \
+                and meta.topology.slice_id == self.cfg.slice_id:
+            return "ici"
+        return "dcn"
+
     async def _h_kv_transfer(self, req: web.Request) -> web.Response:
         """Decode side of the PD handoff: accept prompt KV + first token,
         inject into the local decode batch. KV arrives either inline
@@ -1156,7 +1299,33 @@ class EngineAgent:
             # Duplicate delivery (prefill retried after a lost response):
             # the sequence is already injected — ack, don't re-inject.
             return web.json_response({"ok": True, "duplicate": True})
-        self._handoffs_seen[sid] = now
+        # NOTE: sid is marked seen only once the payload is IN HAND (below,
+        # after any pull awaits). Marking before a pull would bounce the
+        # sender's inline retry as "duplicate" while the pull it raced can
+        # still fail — the request would be lost with both sides reporting
+        # success.
+        if "kv_blob" not in obj and obj.get("kv_stream") is not None:
+            # Streaming host path: pull the payload back in chunked
+            # frames (executor thread — round-trips + pacing sleeps must
+            # not stall the event loop), link-classed ICI vs DCN by the
+            # peer's slice for bandwidth accounting.
+            from .kv_transfer import pull_stream
+
+            desc = obj["kv_stream"]
+            link = self._link_class(src)
+            try:
+                obj["kv_blob"] = await asyncio.get_running_loop() \
+                    .run_in_executor(
+                        None, lambda: pull_stream(
+                            src, desc, accountant=self.bandwidth,
+                            link=link, ctx=ctx))
+                # (the kv_blob else-branch below counts the host receive)
+                self.kv_stream_received += 1
+            except Exception as e:  # noqa: BLE001
+                logger.warning("streamed KV pull for %s failed: %s",
+                               sid, e)
+                return web.json_response(
+                    {"error": f"streamed KV pull failed: {e}"}, status=502)
         if "kv_blob" not in obj:
             ref = obj.get("kv_ref")
             if ref is None or self.kv_transfer is None:
@@ -1170,15 +1339,20 @@ class EngineAgent:
                         None, lambda: self.kv_transfer.pull(ref, ctx=ctx))
                 self.kv_device_received += 1
             except Exception as e:  # noqa: BLE001
-                # Unmark: the prefill side will retry via the host path,
-                # which must not be rejected as a duplicate.
-                self._handoffs_seen.pop(sid, None)
                 logger.warning("device KV pull for %s failed: %s",
                                obj.get("service_request_id"), e)
                 return web.json_response(
                     {"error": f"device KV pull failed: {e}"}, status=502)
         else:
             self.kv_host_received += 1
+        if sid in self._handoffs_seen:
+            # An inline retry (sender gave up on the pull we were running)
+            # interleaved on the event loop and already injected — this
+            # incarnation of the payload is the duplicate.
+            return web.json_response({"ok": True, "duplicate": True})
+        # No await between this mark and submit() below: on the single
+        # event loop the mark+inject pair is atomic wrt other deliveries.
+        self._handoffs_seen[sid] = time.monotonic()
         dest = obj.get("source_service_addr", "")
         lp_d = obj.get("first_logprob")
         lp = None
@@ -1397,6 +1571,16 @@ def main() -> None:
                         "(0 = whole-suffix installs); with a chunk set, "
                         "mid chunks ride decode steps (Sarathi mixed "
                         "programs) unless XLLM_SARATHI=0")
+    p.add_argument("--kv-tier-dram-mb", type=int, default=0,
+                   help="host-RAM tier for evicted prefix KV blocks, MiB "
+                        "(0 disables tiering; docs/kv_tiering.md)")
+    p.add_argument("--kv-tier-ssd-mb", type=int, default=0,
+                   help="disk spill tier behind the DRAM arena, MiB "
+                        "(0 = DRAM-only; requires --kv-tier-dram-mb > 0 "
+                        "— offloads land in DRAM first, SSD is overflow)")
+    p.add_argument("--kv-tier-ssd-path", default="",
+                   help="spill file path ('' = tempfile owned by the "
+                        "store)")
     args = p.parse_args()
 
     # Multi-host: join the process group (XLLM_MH_COORDINATOR /
@@ -1469,6 +1653,10 @@ def main() -> None:
         # Pre-compile horizon variants on real chips so the first
         # short-budget request doesn't hit a mid-serving XLA compile.
         warmup_programs=jax.default_backend() != "cpu")
+    if args.kv_tier_dram_mb > 0:
+        ecfg.kv_tier_dram_bytes = args.kv_tier_dram_mb << 20
+        ecfg.kv_tier_ssd_bytes = args.kv_tier_ssd_mb << 20
+        ecfg.kv_tier_ssd_path = args.kv_tier_ssd_path
     if args.decode_horizon > 0:
         ecfg.decode_horizon = args.decode_horizon
     if args.prefill_chunk > 0:

@@ -81,6 +81,24 @@ class EngineConfig:
     speculate_k: int = 0
     speculate_ngram: int = 3
     speculate_cycles: int = 4
+    # --- Tiered KV cache (engine/kv_tier.py) ---
+    # Host-RAM tier capacity for evicted prefix blocks (bytes; 0 disables
+    # tiering entirely). Evictions offload HBM→DRAM asynchronously and
+    # prefix-matching admissions onload them back ahead of prefill.
+    kv_tier_dram_bytes: int = 0
+    # Disk spill tier (bytes; 0 = DRAM-only). DRAM overflow demotes
+    # LRU-first into an mmap'd spill file with per-block checksums.
+    # Requires kv_tier_dram_bytes > 0 — offloads land in the DRAM arena
+    # first, SSD holds its overflow (SSD-only is ignored, with a warning).
+    kv_tier_ssd_bytes: int = 0
+    # Spill file path ("" = a tempfile owned, and unlinked, by the store).
+    kv_tier_ssd_path: str = ""
+    # Bounded transfer executor: worker threads moving blocks between
+    # device and the host tiers, and the hard cap on in-flight offloads
+    # (saturation DROPS further offloads — the decode loop never queues
+    # behind tier I/O).
+    kv_tier_threads: int = 2
+    kv_tier_max_inflight: int = 8
     # Sequence/context parallelism (SURVEY.md §5.7): when the engine's mesh
     # has a `seq` axis of size > 1, uncached prompts whose suffix is at
     # least this many tokens prefill with ring attention sharded over that

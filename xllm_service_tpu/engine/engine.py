@@ -242,6 +242,50 @@ class InferenceEngine:
                 "without a seq axis")
         self.page_mgr = KVPageManager(cfg.num_pages, cfg.page_size,
                                       cfg.hash_block_size)
+        # Tiered KV store (DRAM arena + SSD spill): populated by evictions,
+        # drained by prefix-matching admissions. None = tiering off.
+        self.tier_store = None
+        if cfg.kv_tier_dram_bytes <= 0 < cfg.kv_tier_ssd_bytes:
+            # SSD-only is not a mode: offloads land in the DRAM arena
+            # first and SSD is its overflow — a spill budget with no arena
+            # would otherwise be ignored without a trace.
+            logger.warning(
+                "kv_tier_ssd_bytes=%d ignored: tiering is DRAM-fronted "
+                "(SSD holds DRAM overflow) — set kv_tier_dram_bytes > 0 "
+                "to enable the tiers", cfg.kv_tier_ssd_bytes)
+        if cfg.kv_tier_dram_bytes > 0 and jax.process_count() > 1:
+            # Multi-host lockstep runs every device program collectively;
+            # the tier pump's off-thread downloads would break the step
+            # ordering contract. Host tiers are a single-process feature
+            # for now.
+            logger.warning("KV tiering disabled: multi-host mesh")
+        elif cfg.kv_tier_dram_bytes > 0:
+            from .kv_tier import TieredKVStore
+
+            mc = cfg.model
+            self.tier_store = TieredKVStore(
+                block_shape=(mc.num_layers, 2, self.page_mgr.pages_per_block,
+                             mc.num_kv_heads, cfg.page_size, mc.head_dim),
+                dtype=mc.dtype,
+                dram_bytes=cfg.kv_tier_dram_bytes,
+                ssd_bytes=cfg.kv_tier_ssd_bytes,
+                ssd_path=cfg.kv_tier_ssd_path,
+                threads=cfg.kv_tier_threads,
+                max_inflight=cfg.kv_tier_max_inflight)
+            if not self.tier_store.enabled:
+                # Capacity below one block: a store that can hold nothing
+                # must not swallow evictions (they'd vanish from the
+                # global index instead of reporting `removed`).
+                logger.warning(
+                    "KV tiering disabled: kv_tier_dram_bytes=%d is below "
+                    "one block (%d bytes)", cfg.kv_tier_dram_bytes,
+                    self.tier_store.block_nbytes)
+                self.tier_store.close()
+                self.tier_store = None
+        # Evictions divert to the tier pump ONLY when a usable store is
+        # actually attached (multi-host and too-small stores fall through
+        # to plain `removed` reporting).
+        self.page_mgr.enable_tiering(self.tier_store is not None)
 
         B = cfg.max_batch_size
         # Device-resident decode state (donated through every program).
@@ -839,6 +883,31 @@ class InferenceEngine:
 
         self._extract_kv = extract_kv
 
+        @jax.jit
+        def tier_gather(d, page_ids):
+            """Gather one hash block's pages for offload (a NEW buffer —
+            the pool is untouched, so the host download can proceed while
+            later programs recycle the pages). pallas_page_dma mover: a
+            pure-DMA Pallas kernel on TPU, XLA gather elsewhere."""
+            from ..ops.pallas_page_dma import gather_kv_pages
+
+            return gather_kv_pages(d["kv"], page_ids)
+
+        self._tier_gather = tier_gather
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def tier_scatter(d, page_ids, block):
+            """Write an onloaded block back into the pool at `page_ids`
+            (dispatched BEFORE the prefill that reads those pages —
+            device-stream order is the only fence needed)."""
+            from ..ops.pallas_page_dma import scatter_kv_pages
+
+            d = dict(d)
+            d["kv"] = scatter_kv_pages(d["kv"], page_ids, block)
+            return d
+
+        self._tier_scatter = tier_scatter
+
         @partial(jax.jit, donate_argnums=(1,))
         def inject_install(d, kv_blob, ints, floats, counts_row, key):
             """Install a remotely-prefilled sequence (PD decode side):
@@ -1058,6 +1127,8 @@ class InferenceEngine:
         # futures.
         self._pending_decode = None
         self._pending_spec = None
+        if self.tier_store is not None:
+            self.tier_store.close()
 
     # ---------------------------------------------------------------- API
     def submit(self, req: EngineRequest) -> None:
@@ -1091,16 +1162,28 @@ class InferenceEngine:
 
     def stats(self) -> dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "waiting": len(self._waiting),
                 "running": len(self._running),
                 "kv_usage_perc": self.page_mgr.usage_perc(),
                 "cached_blocks": self.page_mgr.cached_block_count(),
                 "total_generated": self.total_generated,
             }
+        if self.tier_store is not None:
+            out["kv_tier"] = self.tier_store.stats()
+        return out
 
     def drain_kv_events(self) -> KvCacheEvent:
-        return self.page_mgr.drain_events()
+        """Heartbeat delta: page-manager stored/removed plus the tier
+        store's completed transitions (HBM→DRAM and DRAM→SSD ride as
+        `offloaded`; capacity/corruption drops as `removed`) — the
+        existing binary event wire carries the whole tier lifecycle."""
+        ev = self.page_mgr.drain_events()
+        if self.tier_store is not None:
+            off, rem = self.tier_store.drain_events()
+            ev.offloaded.extend(off)
+            ev.removed.extend(rem)
+        return ev
 
     def embed(self, token_id_lists: list[list[int]]) -> np.ndarray:
         """Text embeddings for a batch of token lists -> [n, D] f32
@@ -1464,6 +1547,77 @@ class InferenceEngine:
         """Fetch a sequence's KV pages to host (PD handoff, DCN path)."""
         return self._fetch(self.extract_kv_pages_device(pages))
 
+    def _pump_tier_offloads(self) -> None:
+        """Hand freshly evicted blocks to the tier store. Called right
+        after EVERY page allocation: the device gather is dispatched
+        here, before any program that could overwrite the recycled
+        pages — device-stream order makes the capture exact; the
+        host download + arena write then run on the store's bounded
+        executor, never this thread."""
+        if self.tier_store is None:
+            return
+        for h, pages in self.page_mgr.drain_evicted():
+            # Lazy gather: the device copy is dispatched (on THIS thread,
+            # preserving device-stream order) only if the pump accepts the
+            # block — a saturated pump drops without paying for it. A drop
+            # is reported by the store itself as a plain `removed`
+            # eviction.
+            self.tier_store.offload(
+                h,
+                lambda p=pages: self._tier_gather(
+                    self._dstate, jnp.asarray(p, jnp.int32)),
+                fetch=self._fetch)
+
+    def _onload_cold_prefix(self, prompt_hashes, matched: int,
+                            cached_pages: list[int],
+                            cached_hashes: list[str],
+                            P0: int) -> int:
+        """Extend an HBM prefix match from the cold tiers: contiguous
+        next blocks that are fence-complete in DRAM/SSD are restored into
+        freshly allocated pages (device scatter dispatched ahead of the
+        prefill that reads them) and re-donated to the HBM cache. Blocks
+        still resident in HBM beyond a cold gap are stitched in directly
+        (match_prefix alone stops at the first HBM miss). Mutates
+        cached_pages/cached_hashes in place; returns the new matched
+        token count. Stops at the first miss, corruption, or page-
+        pressure failure — the prefix must stay contiguous."""
+        cfg = self.cfg
+        hbs = cfg.hash_block_size
+        ppb = self.page_mgr.pages_per_block
+        i = matched // hbs
+        while i < len(prompt_hashes) and matched + hbs < P0:
+            hx = prompt_hashes[i].hex()
+            hbm_pages = self.page_mgr.match_block(hx)
+            if hbm_pages is not None:
+                cached_hashes.append(hx)
+                cached_pages.extend(hbm_pages)
+                matched += hbs
+                i += 1
+                continue
+            if not self.tier_store.ready(hx):
+                break
+            pages = self.page_mgr.allocate(ppb)
+            self._pump_tier_offloads()
+            if pages is None:
+                break
+            arr = self.tier_store.fetch(hx)
+            if arr is None:
+                # Miss (raced an eviction) or SSD checksum corruption:
+                # fails only this block; the walk stops here.
+                self.page_mgr.free(pages)
+                break
+            if not self.page_mgr.install_block(hx, pages):
+                self.page_mgr.free(pages)
+                break
+            self._dstate = self._tier_scatter(
+                self._dstate, jnp.asarray(pages, jnp.int32),
+                jnp.asarray(arr))
+            cached_hashes.append(hx)
+            cached_pages.extend(pages)
+            matched += hbs
+            i += 1
+        return matched
+
     def _start_sequence(self, req: EngineRequest,
                         batch: Optional[list] = None) -> bool:
         if req.injected_kv is not None:
@@ -1504,9 +1658,17 @@ class InferenceEngine:
             matched = len(cached_hashes) * cfg.hash_block_size
             cached_pages = cached_pages[:matched // cfg.page_size]
 
+        # Cold-tier onload: extend the HBM match with fence-complete
+        # DRAM/SSD blocks restored ahead of prefill (suffix-only prefill
+        # then starts past them, exactly like an HBM hit).
+        if self.tier_store is not None and prompt_hashes is not None:
+            matched = self._onload_cold_prefix(
+                prompt_hashes, matched, cached_pages, cached_hashes, P0)
+
         total_pages = -(-max_total // cfg.page_size)   # ceil
         own_needed = total_pages - len(cached_pages)
         own_pages = self.page_mgr.allocate(own_needed)
+        self._pump_tier_offloads()
         if own_pages is None:
             self.page_mgr.release_prefix(cached_hashes)
             return False
@@ -1722,6 +1884,12 @@ class InferenceEngine:
                 block_hashes=seq.pages.block_hashes)
             seq.pages.donated_hashes = stored
             seq.pages.donated_pages = donated
+            if self.tier_store is not None:
+                # A re-prefilled block supersedes any cold-tier copy (the
+                # heartbeat `stored` event moves the instance to HBM; a
+                # stale arena/spill slot would only waste capacity).
+                for hx in stored:
+                    self.tier_store.discard(hx)
 
         if req.prefill_only and req.on_prefill_done is not None:
             # PD handoff: extract prompt KV, free local resources, and let
@@ -1784,6 +1952,7 @@ class InferenceEngine:
         max_total = min(P0 + max_new, cfg.max_seq_len)
         total_pages = -(-max_total // cfg.page_size)
         own_pages = self.page_mgr.allocate(total_pages)
+        self._pump_tier_offloads()
         if own_pages is None:
             return False
         seq = _Sequence(req=req, pages=SequencePages(own_pages=own_pages),
@@ -1853,6 +2022,9 @@ class InferenceEngine:
                                                      seq.pages.all_pages)
         seq.pages.donated_hashes = stored
         seq.pages.donated_pages = donated
+        if self.tier_store is not None:
+            for hx in stored:
+                self.tier_store.discard(hx)
 
         self._running[seq.slot] = seq
         # The decode side emits everything, starting with the prefill-

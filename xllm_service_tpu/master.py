@@ -103,12 +103,58 @@ class Master:
         for runner in self._runners:
             await runner.cleanup()
 
+    async def _abort_sites(self) -> None:
+        """SIGKILL-shaped teardown: close the listening sockets and abort
+        every in-flight connection NOW — no graceful drain, no waiting on
+        handlers. Peers observe an instant RST, exactly like a killed
+        process."""
+        for runner in self._runners:
+            for site in list(runner.sites):
+                try:
+                    await site.stop()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+            server = runner.server
+            if server is not None:
+                for proto in list(server.connections):
+                    transport = getattr(proto, "transport", None)
+                    if transport is not None:
+                        transport.abort()
+
     def stop(self) -> None:
         self.scheduler.stop()
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
             self._thread.join(timeout=10)
+
+    def kill(self) -> threading.Thread:
+        """Abrupt death for chaos drills. Unlike :meth:`stop` (graceful:
+        scheduler drains first, handlers finish), this severs every server
+        socket and live connection BEFORE any cleanup, synchronously — by
+        the time it returns, peers have seen the connection reset. The
+        slow part (joining the loop thread, stopping scheduler threads,
+        lease release) runs on the returned background thread; join it
+        for test hygiene."""
+        if self._loop is not None:
+            fut = asyncio.run_coroutine_threadsafe(self._abort_sites(),
+                                                   self._loop)
+            fut.result(timeout=5)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        # A killed process also stops refreshing its coordination leases:
+        # closing the client kills the keepalive thread and the watches,
+        # so the election/membership keys lapse by TTL (they are NOT
+        # released early — successors win by expiry, as under SIGKILL).
+        self.scheduler._coord.close()
+
+        def _reap() -> None:
+            if self._thread is not None:
+                self._thread.join(timeout=10)
+            self.scheduler.stop()
+
+        t = threading.Thread(target=_reap, name="master-reaper", daemon=True)
+        t.start()
+        return t
 
 
 def main() -> None:

@@ -34,7 +34,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:   # pre-0.5 spelling of the same API
+    from jax.experimental.shard_map import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
@@ -45,6 +48,7 @@ from .pallas_page_dma import (
     flash_accumulate,
     masked_kv_f32_pos,
     page_chunk_size,
+    tpu_compiler_params,
 )
 
 _NEG_INF = NEG_INF
@@ -235,7 +239,7 @@ def _paged_partial_impl(q, k_pages, v_pages, local_pt, starts, n_local,
             jax.ShapeDtypeStruct((B, n_q, 128), jnp.float32),
             jax.ShapeDtypeStruct((B, n_q, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(local_pt, starts, n_local, context_lens, q, k_pages, v_pages)
@@ -307,13 +311,18 @@ def cp_paged_attention(q: jax.Array, k_pages: jax.Array,
     else:
         body = functools.partial(_local_partial, axis_name=seq_axis,
                                  scale=scale)
+    # pallas_call's out_shape carries no varying-mesh-axes metadata,
+    # which trips shard_map's replication/vma check on the kernel body —
+    # disable it under whichever name this jax spells it.
+    import inspect
+
+    relax = ("check_vma" if "check_vma"
+             in inspect.signature(shard_map).parameters else "check_rep")
     fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(seq_axis), P(seq_axis), P(), P()),
         out_specs=P(),
-        # pallas_call's out_shape carries no varying-mesh-axes metadata,
-        # which trips shard_map's vma check on the kernel body.
-        check_vma=False,
+        **{relax: False},
     )
     return fn(q, k_pages, v_pages, page_table, context_lens)

@@ -20,6 +20,16 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def tpu_compiler_params(**kw):
+    """TPU Pallas compiler params across the JAX API rename: newer
+    releases expose ``pltpu.CompilerParams``, older ones (<= 0.4.x)
+    ``pltpu.TPUCompilerParams`` — same fields either way. Every kernel in
+    this package builds its ``compiler_params`` through here so the suite
+    runs under both spellings."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
 def page_chunk_size(max_pages: int, default: int = 8) -> int:
     """Pages per double-buffered DMA chunk in the paged-attention
     kernels. Bigger chunks mean fewer, larger DMAs — the decode walk is
@@ -122,7 +132,9 @@ def chunked_page_walk(page_table_ref, b, nb, n_pages, n_pages_of, chunk,
                 compute(c, slot)
                 return ()
 
-            jax.lax.fori_loop(0, n_chunks, body, (), unroll=False)
+            # No unroll kwarg: older jax rejects it outright when the
+            # trip count is dynamic (and False is the default anyway).
+            jax.lax.fori_loop(0, n_chunks, body, ())
         return
 
     b_next = jnp.minimum(b + 1, nb - 1)
@@ -156,7 +168,115 @@ def chunked_page_walk(page_table_ref, b, nb, n_pages, n_pages_of, chunk,
         compute(c, slot)
         return ()
 
-    jax.lax.fori_loop(0, n_chunks_e, body, (), unroll=False)
+    jax.lax.fori_loop(0, n_chunks_e, body, ())
+
+
+# --------------------------------------------------------------- page movers
+#
+# Device-side movers for the tiered KV-cache data plane (engine/kv_tier.py):
+# gather a hash block's pages out of the pool (offload: the gathered buffer
+# is downloaded to the host tier off-thread) and scatter a host-restored
+# block back into freshly allocated pages (onload, dispatched ahead of the
+# prefill that reads them). On TPU the gather runs as a Pallas kernel — one
+# async copy per (layer, k/v, page) row, pure DMA, no compute — so the
+# block never stages through VMEM-size-limited compute tiles; elsewhere
+# (CPU tests, interpret mode off) a plain XLA gather/scatter is identical.
+
+
+def _pallas_page_mover_on() -> bool:
+    """Pallas DMA mover on real TPU backends; XLA gather/scatter fallback
+    elsewhere. XLLM_PALLAS_INTERPRET=1 forces the kernel in interpret
+    mode (parity tests on CPU)."""
+    import os
+
+    if os.environ.get("XLLM_PALLAS_INTERPRET", "") == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _gather_pages_kernel(ids_ref, pool, out, sem):
+    """grid (L, 2, n): one page row per step, pure DMA (ANY→ANY), no
+    compute tile — the block never stages through VMEM."""
+    li = pl.program_id(0)
+    si = pl.program_id(1)
+    i = pl.program_id(2)
+    cp = pltpu.make_async_copy(pool.at[li, si, ids_ref[i]],
+                               out.at[li, si, i], sem)
+    cp.start()
+    cp.wait()
+
+
+def _scatter_pages_kernel(ids_ref, blk, pool_in, pool_out, sem):
+    """grid (L, 2, n): pool_in aliases pool_out (in-place page writes);
+    only the selected page rows move."""
+    del pool_in   # aliased with pool_out; pages not written keep their data
+    li = pl.program_id(0)
+    si = pl.program_id(1)
+    i = pl.program_id(2)
+    cp = pltpu.make_async_copy(blk.at[li, si, i],
+                               pool_out.at[li, si, ids_ref[i]], sem)
+    cp.start()
+    cp.wait()
+
+
+def gather_kv_pages(kv, page_ids):
+    """kv: [L, 2, num_pages, n_kv, ps, hd]; page_ids: [n] int32 →
+    [L, 2, n, n_kv, ps, hd] block buffer (a NEW array; the pool is
+    untouched, so the caller can download it off-thread while later
+    programs overwrite the pages)."""
+    if not _pallas_page_mover_on():
+        return kv[:, :, page_ids]
+    import os
+
+    L, _, _, n_kv, ps, hd = kv.shape
+    n = page_ids.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(L, 2, n),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        _gather_pages_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((L, 2, n, n_kv, ps, hd), kv.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=os.environ.get("XLLM_PALLAS_INTERPRET", "") == "1",
+    )(page_ids, kv)
+
+
+def scatter_kv_pages(kv, page_ids, block):
+    """Inverse of :func:`gather_kv_pages`: write `block`
+    [L, 2, n, n_kv, ps, hd] into the pool at `page_ids`; returns the
+    updated pool (callers donate it through their jit wrapper)."""
+    block = block.astype(kv.dtype)
+    if not _pallas_page_mover_on():
+        return kv.at[:, :, page_ids].set(block)
+    import os
+
+    L = kv.shape[0]
+    n = page_ids.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(L, 2, n),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        _scatter_pages_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(kv.shape, kv.dtype),
+        # Flattened operand order (ids, blk, pool): pool at 2 aliases the
+        # output — in-place page writes, no pool copy.
+        input_output_aliases={2: 0},
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=os.environ.get("XLLM_PALLAS_INTERPRET", "") == "1",
+    )(page_ids, block, kv)
 
 
 def masked_kv_f32(k_buf, v_buf, slot, kv, start, bound):

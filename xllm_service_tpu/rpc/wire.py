@@ -78,6 +78,20 @@ HOT_PATH_FUNCTIONS: dict[str, str] = {
         "owner-forward SSE relay (frames must pass through as raw bytes)",
     "XllmHttpService.handle_handoff":
         "owner-side ingest of relayed requests (full dispatch pipeline)",
+    "TieredKVStore.offload":
+        "per-eviction tier-offload admission (engine thread, never blocks)",
+    "TieredKVStore.fetch":
+        "cold-tier onload read on the engine admission path",
+    "InferenceEngine._pump_tier_offloads":
+        "eviction drain after every page allocation (decode loop)",
+    "InferenceEngine._onload_cold_prefix":
+        "cold-prefix extension walk at admission (ahead of prefill)",
+    "StreamOfferTable.read_chunk":
+        "per-chunk streaming-transfer serve (one memoryview slice)",
+    "pull_stream":
+        "chunked KV pull loop (decode-side executor thread, paced)",
+    "EngineAgent._h_kv_stream_pull":
+        "streaming-transfer pull endpoint (msgpack frames)",
 }
 
 
