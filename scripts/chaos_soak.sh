@@ -14,6 +14,13 @@
 #               eviction→offload→onload round trips under a saturated pump,
 #               streamed PD handoff with faults injected at the
 #               kv_transfer.offer / kv_transfer.pull points → inline fallback).
+#
+# After the randomized-seed loop, three INSTRUMENTED legs run (one
+# iteration each, counted in the pass rate): XLLM_LOCK_DEBUG=1 (the
+# lock-order/hold race detector), XLLM_RCU_DEBUG=1 (the snapshot
+# deep-freeze race detector — any in-place mutation of a published RCU
+# snapshot fails the drill), and both combined as a smoke. Set
+# XLLM_SOAK_SKIP_DEBUG_LEGS=1 to run the plain loop only.
 set -u
 
 ITERS="${1:-20}"
@@ -44,8 +51,26 @@ for i in $(seq 1 "$ITERS"); do
     fi
 done
 
+total="$ITERS"
+if [ "${XLLM_SOAK_SKIP_DEBUG_LEGS:-}" != "1" ]; then
+    for leg in "XLLM_LOCK_DEBUG=1" "XLLM_RCU_DEBUG=1" \
+               "XLLM_LOCK_DEBUG=1 XLLM_RCU_DEBUG=1"; do
+        seed=$((RANDOM * 32768 + RANDOM))
+        total=$((total + 1))
+        echo "=== instrumented leg: $leg (seed=$seed, suite=$SUITE) ==="
+        if JAX_PLATFORMS=cpu XLLM_CHAOS_SEED=$seed \
+            env $leg python -m pytest "$SUITE" -q -m chaos \
+            -p no:cacheprovider "$@"; then
+            pass=$((pass + 1))
+        else
+            fail=$((fail + 1))
+            failed_seeds+=("$seed($leg)")
+        fi
+    done
+fi
+
 echo
-echo "chaos soak: $pass/$ITERS passed"
+echo "chaos soak: $pass/$total passed"
 if [ "$fail" -gt 0 ]; then
     echo "failing seeds (replay with XLLM_CHAOS_SEED=<seed>): ${failed_seeds[*]}"
     exit 1

@@ -5,8 +5,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== xlint (concurrency invariants) =="
+echo "== xlint (concurrency + RCU publication invariants) =="
 python -m xllm_service_tpu.devtools.xlint xllm_service_tpu
+
+echo "== xlint --support (tests/ + benchmarks/, relaxed profile) =="
+python -m xllm_service_tpu.devtools.xlint --support tests benchmarks
 
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check =="

@@ -40,6 +40,7 @@ import pytest  # noqa: E402
 
 from xllm_service_tpu.coordination.memory import MemoryStore  # noqa: E402
 from xllm_service_tpu.devtools import locks as _xlocks  # noqa: E402
+from xllm_service_tpu.devtools import rcu as _xrcu  # noqa: E402
 
 
 @pytest.fixture()
@@ -63,4 +64,22 @@ def _instrumented_lock_guard():
     yield
     vs = _xlocks.violations()
     assert not vs, ("instrumented-lock violations:\n"
+                    + "\n".join(str(v) for v in vs))
+
+
+@pytest.fixture(autouse=True)
+def _rcu_freeze_guard():
+    """Under XLLM_RCU_DEBUG=1 every test doubles as a snapshot-race
+    detector: RCU publications are deep-frozen (devtools/rcu.py) and any
+    in-place mutation recorded during the test fails it — even when the
+    raising path was swallowed by a broad except. The chaos, multimaster
+    kill, and tier-transition drills all moonlight as detectors this
+    way, mirroring the instrumented-lock guard above."""
+    if not _xrcu.debug_enabled():
+        yield
+        return
+    _xrcu.reset_violations()
+    yield
+    vs = _xrcu.violations()
+    assert not vs, ("rcu deep-freeze violations:\n"
                     + "\n".join(str(v) for v in vs))

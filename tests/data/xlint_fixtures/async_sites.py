@@ -1,0 +1,60 @@
+"""Fixture: async-blocking rule (blocking primitives inside ``async
+def``) and async-aware lock discipline/ordering (``asyncio.Lock``
+declarations + ``async with`` acquisition edges). Never imported."""
+
+import asyncio
+import threading
+import time
+
+import requests
+
+
+class AsyncOrderly:
+    def __init__(self):
+        self.alock_outer = asyncio.Lock()   # lock-order: 50
+        self.alock_inner = asyncio.Lock()   # lock-order: 51
+
+    async def respects(self):
+        async with self.alock_outer:
+            async with self.alock_inner:
+                pass
+
+    async def inverts(self):
+        async with self.alock_inner:
+            async with self.alock_outer:    # VIOLATION lock-order (async with)
+                pass
+
+
+class AsyncSloppy:
+    def __init__(self):
+        self.alock_raw = asyncio.Lock()     # VIOLATION: no order annotation
+
+
+class AsyncBlocky:
+    async def sleeps(self):
+        time.sleep(0.1)                     # VIOLATION async-blocking
+
+    async def fetches(self):
+        return requests.get("http://x")     # VIOLATION async-blocking
+
+    async def raw_channel(self, ch):
+        return ch._post("/rpc/x", {})       # VIOLATION async-blocking
+
+    async def awaited_ok(self):
+        await asyncio.sleep(0)              # clean: awaited async API
+
+    async def async_cm_ok(self, session):
+        async with session.post("http://x") as r:   # clean: async CM
+            return r
+
+    async def nested_sync_ok(self):
+        def work():
+            time.sleep(0.1)                 # clean: fresh execution context
+        return work
+
+    async def hatched(self):
+        time.sleep(0.1)  # xlint: allow-async-blocking(fixture demonstrates the hatch)
+
+
+def sync_blocking_is_not_flagged_here():
+    time.sleep(0.0)   # clean: the rule only applies inside async def

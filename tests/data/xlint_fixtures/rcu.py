@@ -1,0 +1,20 @@
+"""RCU fixture registry (stands in for devtools/rcu.py — the rcu rules
+key on the file name). Carries deliberate registry-staleness violations
+alongside the live entries used by rcu_sites.py / rcu_regress.py."""
+
+RCU_FROZEN_TYPES = {
+    "FrozSnap": "published fixture snapshot (rcu_sites.py)",
+    "PrefixIndex": "published fixture index (rcu_regress.py)",
+    "GhostType": "VIOLATION: stale registry entry (no such class)",
+}
+
+RCU_PUBLICATIONS = {
+    "Publisher._snap": "FrozSnap @ _lock",
+    "Publisher._infos": "dict @ _lock",
+    "GlobalKVCacheMgr._snapshot": "PrefixIndex @ _lock",
+    "Phantom._x": "dict @ _lock",            # VIOLATION: no such class
+    "Publisher._never": "dict @ _lock",      # VIOLATION: never assigned
+    "Publisher._unlocked": "dict @ _nolock",  # VIOLATION: undeclared lock
+    "Publisher._badspec": "dict-no-at-sign",  # VIOLATION: malformed spec
+    "Publisher._weird": "Widget @ _lock",    # VIOLATION: unknown type
+}

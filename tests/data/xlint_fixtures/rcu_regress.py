@@ -1,0 +1,41 @@
+"""Fixture: RESURRECTED PR-5 BUG (frame prune-after-install), as the
+pre-fix replica apply wrote it — the static regression that proves the
+rcu-frozen rule catches the class.
+
+Historically: compaction pruned the legacy per-block keys and installed
+the full-state frame in SEPARATE coordination revisions; a watching
+replica applied the prune DELETEs in place on the LIVE published index
+(and, delivered after the frame install, permanently dropped fresh
+blocks). The in-place delete is the static signature: a lock-free
+``match()`` racing this loop sees the half-pruned intermediate. The
+fixed code (scheduler/global_kvcache_mgr.py) batches prune+install into
+one ``bulk_apply`` revision and applies it copy-on-write.
+
+Never imported; only parsed by xlint (tests/test_xlint.py asserts the
+rule fires on the marked line)."""
+
+import threading
+
+
+class PrefixIndex:
+    __slots__ = ("blocks",)
+
+    def __init__(self, blocks=None):
+        self.blocks = blocks if blocks is not None else {}
+
+
+class GlobalKVCacheMgr:
+    def __init__(self):
+        self._lock = threading.Lock()   # lock-order: 40
+        self._snapshot = PrefixIndex()
+
+    def _on_cache_event(self, events):
+        with self._lock:
+            for ev in events:
+                if ev.type == "DELETE":
+                    # PR-5 pre-fix: prune applied IN PLACE on the live
+                    # published index, ordered independently of the
+                    # full-frame install below.
+                    self._snapshot.blocks.pop(ev.key, None)   # VIOLATION rcu-frozen
+                else:
+                    self._snapshot = PrefixIndex(dict(ev.blocks))

@@ -1,0 +1,137 @@
+"""Fixture: rcu-frozen / rcu-publish / rcu-read rules — frozen-type
+mutation (in-class, via local, via publication field), publication swaps
+(fresh under lock = clean; aliased / unlocked / field-by-field =
+violations), the thaw escape hatch, and single-load discipline for
+hot-registered readers. Never imported; only parsed by xlint."""
+
+import threading
+
+
+class FrozSnap:
+    """Registered frozen type: immutable once constructed."""
+
+    __slots__ = ("items", "n")
+
+    def __init__(self, items):
+        self.items = dict(items)   # fine: construction scope
+        self.n = len(items)
+
+    def grow(self, k, v):
+        self.items[k] = v          # VIOLATION rcu-frozen: in-class mutation
+        self.n += 1                # VIOLATION rcu-frozen: attribute write
+
+
+class Publisher:
+    def __init__(self):
+        self._lock = threading.Lock()        # lock-order: 30
+        self._other_lock = threading.Lock()  # lock-order: 31
+        self._snap = FrozSnap({})
+        self._infos = {}
+        self._unlocked = {}
+        self._badspec = {}
+        self._weird = {}
+        self._stash = FrozSnap({})
+
+    # ------------------------------------------------------ clean publishes
+    def publish_ok(self):
+        with self._lock:
+            self._snap = rcu.publish(FrozSnap({"a": 1}))
+
+    def publish_fresh_local_ok(self):
+        nxt = dict(self._infos)
+        nxt["k"] = 1
+        with self._lock:
+            self._infos = nxt
+
+    def publish_via_helper(self):
+        with self._lock:
+            self._publish_locked()
+
+    def _publish_locked(self):
+        # Clean: not lexically under the lock, but every resolvable call
+        # site holds it (the one-level call-site summary).
+        self._infos = {}
+
+    def get_infos(self):
+        return self._infos
+
+    # -------------------------------------------------- publish violations
+    def publish_unlocked(self):
+        self._snap = FrozSnap({})      # VIOLATION rcu-publish: no lock held
+
+    def publish_wrong_lock(self):
+        with self._other_lock:
+            self._infos = {}           # VIOLATION rcu-publish: wrong lock
+
+    def publish_alias(self):
+        with self._lock:
+            self._snap = self._stash   # VIOLATION rcu-publish: not fresh
+
+    def publish_augassign(self):
+        with self._lock:
+            self._infos += {}          # VIOLATION rcu-publish: augmented
+
+    def publish_annassign_alias(self):
+        with self._lock:
+            # Annotated swaps are checked too (the PR-4 AnnAssign lesson).
+            self._snap: FrozSnap = self._stash   # VIOLATION rcu-publish
+
+    def publish_hatched(self):
+        self._infos = {}  # xlint: allow-rcu-publish(fixture demonstrates the hatch)
+
+    # --------------------------------------------------- frozen violations
+    def field_by_field(self):
+        with self._lock:
+            self._infos["k"] = 1        # VIOLATION rcu-frozen: item write
+            self._snap.items.update({})  # VIOLATION rcu-frozen: mutator call
+
+    def mutate_via_local(self):
+        snap = self._snap
+        snap.items["k"] = 1            # VIOLATION rcu-frozen: via local
+
+    def mutate_via_annotated_local(self):
+        snap: FrozSnap = self._snap
+        snap.items["q"] = 1            # VIOLATION rcu-frozen: AnnAssign alias
+
+    def mutate_ctor_local(self):
+        fresh = FrozSnap({})
+        fresh.n = 7                    # VIOLATION rcu-frozen: post-ctor write
+
+    def mutate_hatched(self):
+        snap = self._snap
+        snap.items["k"] = 1  # xlint: allow-rcu-frozen(fixture demonstrates the hatch)
+
+    # -------------------------------------------------------- thaw hatches
+    def thaw_ok(self):
+        with self._lock:
+            store = rcu.thaw(self._snap.items, "declared entry-level writer")
+            store["k"] = 1             # clean: thaw-bound local not tracked
+
+    def thaw_no_reason(self):
+        with self._lock:
+            store = rcu.thaw(self._snap.items)   # VIOLATION rcu-frozen: no reason
+            store["k"] = 1
+
+    # --------------------------------------------------- hot-path readers
+    def hot_double_read(self):
+        if self._snap.n:               # load 1
+            return self._snap.items    # load 2 -> VIOLATION rcu-read
+        return None
+
+    def hot_single_read(self):
+        snap = self._snap              # one load into a local: clean
+        return snap.items if snap.n else None
+
+    def hot_hatched_double(self):
+        a = self._snap.n  # xlint: allow-rcu-read(fixture demonstrates the hatch)
+        return a + self._snap.n
+
+
+class Reader:
+    def __init__(self, pub):
+        self._pub = pub
+
+    def hot_accessor_double(self):
+        a = self._pub.get_infos()
+        b = self._pub.get_infos()      # VIOLATION rcu-read: accessor x2
+        return a, b

@@ -6,4 +6,10 @@ HOT_PATH_FUNCTIONS = {
     "HotDispatcher.forward_hatched": "dispatch wire with a hatched encode",
     "push_hot": "module-level hot function with a dumps alias",
     "Ghost.never_defined": "stale registry entry (no such function)",
+    # rcu-read fixtures (rcu_sites.py): single-load discipline applies
+    # to registered hot readers.
+    "Publisher.hot_double_read": "double publication load (violation)",
+    "Publisher.hot_single_read": "single publication load (clean)",
+    "Publisher.hot_hatched_double": "double load with a hatch (clean)",
+    "Reader.hot_accessor_double": "double accessor load (violation)",
 }

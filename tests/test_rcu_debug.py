@@ -1,0 +1,288 @@
+"""XLLM_RCU_DEBUG deep-freeze detector tests: frozen views, recursion,
+the thaw escape hatch, passthrough-when-disabled, publication integration
+for the registered managers, and the resurrected PR-6 in-place-apply bug
+(caught at runtime by the freezer — the static half of that regression
+pair lives in tests/test_xlint.py / rcu_regress.py)."""
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.hashing import prefix_block_hash_hexes
+from xllm_service_tpu.common.types import KvCacheEvent
+from xllm_service_tpu.coordination.base import KeyEvent, WatchEventType
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.devtools import rcu
+from xllm_service_tpu.engine.kv_tier import TieredKVStore
+from xllm_service_tpu.multimaster.ownership import OwnershipRouter
+from xllm_service_tpu.rpc import CACHE_FRAME_KEY_PREFIX, CACHE_KEY_PREFIX
+from xllm_service_tpu.rpc.wire import encode_kv_frame
+from xllm_service_tpu.scheduler.global_kvcache_mgr import (
+    GlobalKVCacheMgr,
+    PrefixIndex,
+    _BlockLoc,
+)
+from xllm_service_tpu.scheduler.instance_mgr import InstanceMgr, RoutingSnapshot
+
+from fakes import FakeChannel, make_meta, wait_until
+
+BLOCK = 16
+
+
+@pytest.fixture()
+def coord(store):
+    c = InMemoryCoordination(store)
+    yield c
+    c.close()
+
+
+@pytest.fixture()
+def rcu_debug():
+    """Arm the freezer for the test body; restore the PRIOR state on
+    teardown (hardcoding False here would silently disarm a suite-wide
+    XLLM_RCU_DEBUG=1 run for every test collected after this file)."""
+    was = rcu.debug_enabled()
+    rcu.set_debug(True)
+    rcu.reset_violations()
+    yield
+    rcu.reset_violations()
+    rcu.set_debug(was)
+
+
+@pytest.fixture(autouse=True)
+def _reset_channels():
+    FakeChannel.reset()
+    yield
+    FakeChannel.reset()
+
+
+# --------------------------------------------------------------- frozen views
+class TestFrozenViews:
+    def test_frozen_dict_reads_work_writes_raise(self, rcu_debug):
+        d = rcu.freeze({"a": 1, "b": 2})
+        assert d["a"] == 1 and dict(d) == {"a": 1, "b": 2}
+        assert isinstance(d, dict)
+        rcu.reset_violations()
+        with pytest.raises(rcu.RcuMutationError):
+            d["c"] = 3
+        with pytest.raises(rcu.RcuMutationError):
+            d.pop("a")
+        with pytest.raises(rcu.RcuMutationError):
+            d.update({"x": 1})
+        with pytest.raises(rcu.RcuMutationError):
+            del d["a"]
+        assert len(rcu.violations()) == 4
+        rcu.reset_violations()
+
+    def test_frozen_list_and_set(self, rcu_debug):
+        lst = rcu.freeze([1, 2, 3])
+        st = rcu.freeze({1, 2})
+        assert list(lst) == [1, 2, 3] and 1 in st
+        rcu.reset_violations()
+        with pytest.raises(rcu.RcuMutationError):
+            lst.append(4)
+        with pytest.raises(rcu.RcuMutationError):
+            lst[0] = 9
+        with pytest.raises(rcu.RcuMutationError):
+            st.add(3)
+        with pytest.raises(rcu.RcuMutationError):
+            st.discard(1)
+        rcu.reset_violations()
+
+    def test_nested_freeze_recursion(self, rcu_debug):
+        v = rcu.freeze({"outer": {"inner": [1, {2, 3}]}})
+        inner = v["outer"]["inner"]
+        rcu.reset_violations()
+        with pytest.raises(rcu.RcuMutationError):
+            v["outer"]["x"] = 1
+        with pytest.raises(rcu.RcuMutationError):
+            inner.append(4)
+        with pytest.raises(rcu.RcuMutationError):
+            inner[1].add(9)
+        rcu.reset_violations()
+
+    def test_tuple_children_frozen(self, rcu_debug):
+        t = rcu.freeze(("a", [1], {"k": 2}))
+        assert t[0] == "a"
+        rcu.reset_violations()
+        with pytest.raises(rcu.RcuMutationError):
+            t[1].append(2)
+        with pytest.raises(rcu.RcuMutationError):
+            t[2]["k"] = 3
+        rcu.reset_violations()
+        # All-immutable tuples keep their identity (no rebuild).
+        plain = ("a", 1)
+        assert rcu.freeze(plain) is plain
+
+    def test_freeze_idempotent(self, rcu_debug):
+        d = rcu.freeze({"a": [1]})
+        assert rcu.freeze(d) is d
+
+    def test_registered_type_attribute_writes_raise(self, rcu_debug):
+        idx = rcu.publish(PrefixIndex({b"k": _BlockLoc(hbm=("i1",))}))
+        assert isinstance(idx, PrefixIndex)       # shadow subclass
+        assert idx.blocks[b"k"].hbm == frozenset({"i1"})
+        rcu.reset_violations()
+        with pytest.raises(rcu.RcuMutationError):
+            idx.blocks = {}
+        with pytest.raises(rcu.RcuMutationError):
+            idx.blocks[b"x"] = _BlockLoc(hbm=("i2",))
+        loc = idx.blocks[b"k"]
+        with pytest.raises(rcu.RcuMutationError):
+            loc.scored = ()
+        rcu.reset_violations()
+
+    def test_unregistered_leaves_stay_mutable(self, rcu_debug):
+        class Plain:
+            pass
+
+        p = Plain()
+        snap = rcu.freeze({"entry": p})
+        assert snap["entry"] is p
+        p.x = 1   # shared-mutable leaf by design (e.g. _Entry)
+        assert p.x == 1
+
+
+# -------------------------------------------------------------- passthrough
+class TestPassthrough:
+    def test_publish_is_identity_when_disabled(self):
+        assert not rcu.debug_enabled()
+        obj = {"a": [1]}
+        assert rcu.publish(obj) is obj
+        snap = RoutingSnapshot({})
+        assert rcu.publish(snap) is snap
+
+    def test_thaw_is_identity_on_plain_containers(self):
+        d = {"a": 1}
+        assert rcu.thaw(d, "reason") is d
+
+    def test_thaw_requires_reason_even_when_disabled(self):
+        with pytest.raises(ValueError):
+            rcu.thaw({}, "")
+
+
+# -------------------------------------------------------------- escape hatch
+class TestThaw:
+    def test_thaw_mutates_underlying_frozen_dict(self, rcu_debug):
+        d = rcu.freeze({"a": 1})
+        store = rcu.thaw(d, "declared entry-level writer")
+        store["b"] = 2
+        assert d["b"] == 2 and store.get("a") == 1
+        assert store.pop("a") == 1 and "a" not in d
+        store.update({"c": 3})
+        del store["c"]
+        assert set(store) == {"b"} and len(store) == 1
+        assert not rcu.violations()
+
+
+# -------------------------------------------------- manager integration
+class TestManagerIntegration:
+    def test_instance_mgr_publishes_frozen_snapshot(self, coord, rcu_debug):
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        try:
+            assert mgr.register_instance(make_meta("i1"))
+            snap = mgr.routing_snapshot()
+            assert "i1" in snap.schedulable
+            rcu.reset_violations()
+            with pytest.raises(rcu.RcuMutationError):
+                snap.entries["ghost"] = None
+            with pytest.raises(rcu.RcuMutationError):
+                snap.prefill = ()
+            rcu.reset_violations()
+            infos = mgr.get_load_infos()
+            with pytest.raises(rcu.RcuMutationError):
+                infos["ghost"] = None
+            info = infos["i1"]
+            with pytest.raises(rcu.RcuMutationError):
+                info.schedulable = False
+            rcu.reset_violations()
+        finally:
+            mgr.stop()
+
+    def test_kvcache_ingest_and_match_run_frozen(self, coord, rcu_debug):
+        """The declared entry-level writers (thaw) still work with the
+        freezer armed, and the lock-free reader sees their writes."""
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        toks = list(range(BLOCK * 2))
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(stored=hashes))
+        assert mgr.match(toks).scores["i1"] == pytest.approx(2.0)
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(offloaded=hashes[:1]))
+        mgr.remove_instance("i1")
+        assert mgr.match(toks).scores == {}
+        assert not rcu.violations()
+        # Direct mutation of the published index still raises.
+        with pytest.raises(rcu.RcuMutationError):
+            mgr._snapshot.blocks[b"x" * 16] = _BlockLoc(hbm=("i9",))
+        rcu.reset_violations()
+
+    def test_ownership_members_published(self, coord, rcu_debug):
+        router = OwnershipRouter(coord, "a:1", start_watch=False)
+        router.update_self_addr("a:2")
+        assert router.members() == ("a:2",)
+        assert not rcu.violations()
+
+    def test_tier_drained_events_are_frozen(self, rcu_debug):
+        store = TieredKVStore(block_shape=(2, 2), dtype="float32",
+                              dram_bytes=64, threads=1, max_inflight=2)
+        try:
+            assert store.offload("ab" * 16, np.ones((2, 2), np.float32))
+            wait_until(lambda: store.ready("ab" * 16))
+            off, rem = store.drain_events()
+            assert off == ["ab" * 16]
+            rcu.reset_violations()
+            with pytest.raises(rcu.RcuMutationError):
+                off.append("late-delta")   # the PR-7 bug class
+            rcu.reset_violations()
+        finally:
+            store.close()
+
+
+# ------------------------------------------------- resurrected PR-6 bug
+class TestResurrectedInPlaceApply:
+    """PR-6 regression pair, runtime half: full-frame watch batches
+    applied IN PLACE on the live index (the pre-COW-fix code). The
+    mutation reaches the dict through a parameter alias the static rule
+    cannot track — XLLM_RCU_DEBUG is what catches it."""
+
+    def _compaction_events(self, hashes):
+        legacy_key = CACHE_KEY_PREFIX + hashes[0]
+        frame = encode_kv_frame(
+            {bytes.fromhex(h): [["i1"], [], []] for h in hashes}, [],
+            full=True)
+        return [
+            KeyEvent(WatchEventType.DELETE, legacy_key, ""),
+            KeyEvent(WatchEventType.PUT, f"{CACHE_FRAME_KEY_PREFIX}"
+                                         f"{0:020d}", frame),
+        ]
+
+    def test_bug_flipped_on_is_caught_by_freezer(self, coord, rcu_debug):
+        replica = GlobalKVCacheMgr(coord, block_size=BLOCK, is_master=False)
+        try:
+            toks = list(range(BLOCK * 2))
+            hashes = prefix_block_hash_hexes(toks, BLOCK)
+            replica.record_updated_kvcaches("i1", KvCacheEvent(stored=hashes))
+            replica._inplace_full_apply = True   # resurrect the bug
+            rcu.reset_violations()
+            with pytest.raises(rcu.RcuMutationError):
+                replica._on_cache_event(self._compaction_events(hashes), "")
+            assert rcu.violations(), "freezer must record the mutation"
+            rcu.reset_violations()
+        finally:
+            replica.stop()
+
+    def test_fixed_path_applies_clean(self, coord, rcu_debug):
+        replica = GlobalKVCacheMgr(coord, block_size=BLOCK, is_master=False)
+        try:
+            toks = list(range(BLOCK * 2))
+            hashes = prefix_block_hash_hexes(toks, BLOCK)
+            replica.record_updated_kvcaches("i1", KvCacheEvent(stored=hashes))
+            rcu.reset_violations()
+            replica._on_cache_event(self._compaction_events(hashes), "")
+            assert not rcu.violations()
+            # COW apply: the post-compaction index is complete.
+            assert replica.match(toks).scores["i1"] == pytest.approx(2.0)
+        finally:
+            replica.stop()

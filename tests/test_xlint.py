@@ -253,6 +253,150 @@ class TestBroadExcept:
                 and "neither logs nor re-raises" in v.message]
 
 
+# ---------------------------------------------------------------- rcu-frozen
+class TestRcuFrozen:
+    def test_in_class_mutation_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-frozen", "rcu_sites.py",
+                    "self.items")
+        assert hits(fixture_violations, "rcu-frozen", "rcu_sites.py",
+                    "attribute write to published value 'self.n'")
+
+    def test_mutation_via_tracked_local_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-frozen", "rcu_sites.py",
+                    "snap.items")
+
+    def test_mutation_of_fresh_ctor_local_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-frozen", "rcu_sites.py",
+                    "fresh.n")
+
+    def test_publication_field_write_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-frozen", "rcu_sites.py",
+                    "item write on published value 'self._infos'")
+        assert hits(fixture_violations, "rcu-frozen", "rcu_sites.py",
+                    ".update() on published value 'self._snap.items'")
+
+    def test_thaw_without_reason_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-frozen", "rcu_sites.py",
+                    "thaw() without a reason")
+
+    def test_annassign_bound_alias_tracked(self, fixture_violations):
+        # An annotated alias must not escape tracking (the PR-4 lesson:
+        # AnnAssign parse gaps silently make registry rules vacuous).
+        assert hits(fixture_violations, "rcu-frozen", "rcu_sites.py",
+                    "snap.items'")
+
+    def test_thaw_and_hatch_quiet(self, fixture_violations):
+        # thaw_ok + mutate_hatched stay quiet: exactly the eight
+        # deliberate rcu-frozen violations fire in rcu_sites.py.
+        assert len(hits(fixture_violations, "rcu-frozen",
+                        "rcu_sites.py")) == 8
+
+    def test_stale_frozen_type_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-frozen", "rcu.py",
+                    "GhostType")
+
+    def test_pr5_prune_after_install_resurrection_caught(
+            self, fixture_violations):
+        """The resurrected PR-5 compaction bug (prune DELETEs applied in
+        place on the live published index) is caught statically."""
+        assert hits(fixture_violations, "rcu-frozen", "rcu_regress.py",
+                    ".pop() on published value 'self._snapshot.blocks'")
+
+
+# --------------------------------------------------------------- rcu-publish
+class TestRcuPublish:
+    def test_swap_outside_lock_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-publish", "rcu_sites.py",
+                    "Publisher._snap swapped outside")
+
+    def test_swap_under_wrong_lock_flagged(self, fixture_violations):
+        flagged = hits(fixture_violations, "rcu-publish", "rcu_sites.py",
+                       "Publisher._infos swapped outside")
+        assert flagged
+
+    def test_aliased_swap_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-publish", "rcu_sites.py",
+                    "freshly built FrozSnap")
+
+    def test_augmented_assign_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-publish", "rcu_sites.py",
+                    "augmented assignment")
+
+    def test_annassign_swap_checked(self, fixture_violations):
+        # `self._snap: FrozSnap = alias` is a swap site like any other:
+        # both the plain and the annotated aliased swap fire.
+        assert len(hits(fixture_violations, "rcu-publish", "rcu_sites.py",
+                        "freshly built FrozSnap")) == 2
+
+    def test_clean_and_hatched_publishes_quiet(self, fixture_violations):
+        # publish_ok / publish_fresh_local_ok / publish_via_helper (call-
+        # site summary) / publish_hatched: exactly the five deliberate
+        # site violations fire.
+        assert len(hits(fixture_violations, "rcu-publish",
+                        "rcu_sites.py")) == 5
+
+    def test_registry_staleness_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-publish", "rcu.py", "Phantom")
+        assert hits(fixture_violations, "rcu-publish", "rcu.py",
+                    "never assigned")
+        assert hits(fixture_violations, "rcu-publish", "rcu.py", "_nolock")
+        assert hits(fixture_violations, "rcu-publish", "rcu.py",
+                    "_badspec")
+        assert hits(fixture_violations, "rcu-publish", "rcu.py", "Widget")
+
+
+# ------------------------------------------------------------------ rcu-read
+class TestRcuRead:
+    def test_double_direct_load_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-read", "rcu_sites.py",
+                    "hot_double_read")
+
+    def test_double_accessor_load_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "rcu-read", "rcu_sites.py",
+                    "hot_accessor_double")
+
+    def test_single_and_hatched_loads_quiet(self, fixture_violations):
+        assert len(hits(fixture_violations, "rcu-read",
+                        "rcu_sites.py")) == 2
+
+
+# ------------------------------------------------------------ async-blocking
+class TestAsyncBlocking:
+    def test_sleep_in_coroutine_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "async-blocking", "async_sites.py",
+                    "sleeps")
+
+    def test_requests_in_coroutine_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "async-blocking", "async_sites.py",
+                    "HTTP I/O")
+
+    def test_raw_channel_in_coroutine_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "async-blocking", "async_sites.py",
+                    "_post")
+
+    def test_awaited_nested_and_hatched_quiet(self, fixture_violations):
+        # awaited_ok / async_cm_ok / nested_sync_ok / hatched / the
+        # module-level sync function: exactly three violations fire.
+        assert len(hits(fixture_violations, "async-blocking",
+                        "async_sites.py")) == 3
+
+
+# ------------------------------------------------------- async lock ordering
+class TestAsyncLockDiscipline:
+    def test_async_with_inversion_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "lock-order", "async_sites.py",
+                    "AsyncOrderly.alock_inner (order 51) -> "
+                    "AsyncOrderly.alock_outer (order 50)")
+
+    def test_asyncio_lock_requires_annotation(self, fixture_violations):
+        assert hits(fixture_violations, "lock-discipline", "async_sites.py",
+                    "alock_raw")
+
+    def test_ordered_async_with_not_flagged(self, fixture_violations):
+        assert not hits(fixture_violations, "lock-order", "async_sites.py",
+                        "alock_outer (order 50) -> AsyncOrderly.alock_inner")
+
+
 # ------------------------------------------------------------------- CLI + CI
 class TestDriver:
     def test_cli_reports_and_exits_nonzero_on_fixtures(self, capsys):
@@ -269,13 +413,74 @@ class TestDriver:
 
 
 def test_xlint_tree_clean():
-    """Tier-1 gate: the analyzer over the real package must be clean."""
+    """Tier-1 gate: the analyzer over the real package must be clean
+    (the RCU pass included — publication discipline holds tree-wide)."""
     violations = xlint.run([str(PACKAGE)])
     assert not violations, (
         "xlint violations in the tree:\n"
         + "\n".join(str(v) for v in violations)
         + "\n\nrun: python -m xllm_service_tpu.devtools.xlint "
           "xllm_service_tpu")
+
+
+def test_xlint_rcu_registry_is_live():
+    """The RCU pass must actually be armed on the real tree: the
+    registries parse non-empty and the rule is not silently inert (the
+    PR-4 lesson — an AnnAssign parse gap made two registry rules vacuous
+    for two rounds). Probe: injecting a known-bad snippet next to the
+    real registry file must produce rcu violations."""
+    import xllm_service_tpu.devtools.rcu as rcu_mod
+
+    assert rcu_mod.RCU_FROZEN_TYPES and rcu_mod.RCU_PUBLICATIONS
+    reg = Path(rcu_mod.__file__)
+    probe = (
+        "class PrefixIndex:\n"
+        "    def __init__(self):\n"
+        "        self.blocks = {}\n"
+        "class Mgr:\n"
+        "    def bad(self, snap):\n"
+        "        snap = PrefixIndex()\n"
+        "        snap.blocks = {}\n")
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        bad = Path(td) / "probe.py"
+        bad.write_text(probe)
+        vs = xlint.run([str(reg), str(bad)])
+        assert any(v.rule == "rcu-frozen" and "probe.py" in v.path
+                   for v in vs), vs
+
+
+def test_xlint_support_tree_clean():
+    """Tier-1 gate: tests/ + benchmarks/ under the relaxed profile
+    (behavioral rules only; the fixture dir is excluded by design)."""
+    root = Path(__file__).parent.parent
+    violations = xlint.run([str(root / "tests"), str(root / "benchmarks")],
+                           profile="support")
+    assert not violations, (
+        "xlint violations in support code:\n"
+        + "\n".join(str(v) for v in violations)
+        + "\n\nrun: python -m xllm_service_tpu.devtools.xlint --support "
+          "tests benchmarks")
+
+
+def test_support_profile_keeps_behavioral_rules(tmp_path):
+    bad = tmp_path / "bench_helper.py"
+    bad.write_text(
+        "import threading, time\n"
+        "lock = threading.Lock()\n"
+        "def drive():\n"
+        "    with lock:\n"
+        "        time.sleep(1.0)\n"
+        "async def handler():\n"
+        "    time.sleep(0.1)\n")
+    vs = xlint.run([str(bad)], profile="support")
+    rules = {v.rule for v in vs}
+    assert "no-blocking-under-lock" in rules
+    assert "async-blocking" in rules
+    # ...but not the declaration discipline (module-level lock without an
+    # annotation is fine in support code).
+    assert "lock-discipline" not in rules
 
 
 def test_cli_clean_on_tree():

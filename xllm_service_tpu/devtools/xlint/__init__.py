@@ -34,7 +34,31 @@ rule id                    invariant
 ``broad-except``           no bare ``except:`` anywhere; in scheduler/rpc/
                            coordination/engine paths every ``except
                            Exception`` handler logs or re-raises
+``rcu-frozen``             types registered in ``devtools/rcu.py``'s
+                           ``RCU_FROZEN_TYPES`` are never mutated after
+                           construction — not in their own methods, not
+                           through a local holding a published value
+                           (``rcu.thaw(x, reason)`` is the declared-writer
+                           hatch)
+``rcu-publish``            writes to attributes registered in
+                           ``RCU_PUBLICATIONS`` are a
+                           single reference swap of a freshly built object
+                           under the declared writer lock (one level of
+                           call-site summaries, like the lock-order graph)
+                           — never a field-by-field update
+``rcu-read``               functions registered in ``HOT_PATH_FUNCTIONS``
+                           load each publication attribute at most once
+                           (a double load is a torn read: the two loads
+                           may observe different snapshots)
+``async-blocking``         no blocking primitives (``time.sleep``,
+                           ``requests.*``/session HTTP, socket I/O,
+                           coordination calls, channel RPC / ``_get`` /
+                           ``_post``) lexically inside ``async def`` —
+                           they stall the whole event loop
 =========================  ==================================================
+
+``async with`` acquisitions of declared asyncio locks participate in the
+lock-discipline and lock-order rules exactly like threaded ``with``.
 
 Escape hatches are inline comments with a mandatory reason::
 
@@ -45,9 +69,22 @@ Escape hatches are inline comments with a mandatory reason::
     # xlint: allow-lock-annotation(reason)
     # xlint: allow-span-point(reason)
     # xlint: allow-hot-json(reason)
+    # xlint: allow-rcu-frozen(reason)
+    # xlint: allow-rcu-publish(reason)
+    # xlint: allow-rcu-read(reason)
+    # xlint: allow-async-blocking(reason)
 
 Run: ``python -m xllm_service_tpu.devtools.xlint xllm_service_tpu``
 (exit 0 = clean, 1 = violations, 2 = usage/parse error).
+
+Support code (tests/, benchmarks/) is linted with the RELAXED profile —
+``python -m xllm_service_tpu.devtools.xlint --support tests benchmarks``
+— which drops the declaration-discipline rule (support code does not
+register locks/points) but keeps the behavioral rules: blocking under a
+lock in a bench driver corrupts the measurement it wraps just as surely
+as it stalls a scheduler. Files under a ``xlint_fixtures`` directory are
+skipped unless they are the scan root (they are deliberate
+anti-patterns).
 """
 
 from __future__ import annotations
@@ -64,6 +101,7 @@ _SUPPRESS_RE = re.compile(r"#\s*xlint:\s*allow-([a-z-]+)\(([^)]*)\)")
 SUPPRESSIBLE = {
     "broad-except", "blocking-under-lock", "lock-order", "bare-acquire",
     "lock-annotation", "local-lock", "span-point", "hot-json",
+    "rcu-frozen", "rcu-publish", "rcu-read", "async-blocking",
 }
 
 
@@ -123,9 +161,14 @@ def load_files(roots: list[str]) -> tuple[list[SourceFile], list[Violation]]:
         rp = Path(root)
         paths = sorted(rp.rglob("*.py")) if rp.is_dir() else [rp]
         base = rp.parent
+        root_in_fixtures = "xlint_fixtures" in rp.resolve().parts
         for p in paths:
             p = p.resolve()
             if p in seen:
+                continue
+            if "xlint_fixtures" in p.parts and not root_in_fixtures:
+                # Deliberate anti-pattern fixtures: linted only when the
+                # fixture tree itself is the scan root (the rule tests).
                 continue
             seen.add(p)
             try:
@@ -145,12 +188,18 @@ def load_files(roots: list[str]) -> tuple[list[SourceFile], list[Violation]]:
     return files, errors
 
 
-def run(roots: list[str]) -> list[Violation]:
+def run(roots: list[str], profile: str = "strict") -> list[Violation]:
+    """Lint ``roots``. ``profile="support"`` (tests/, benchmarks/) drops
+    the declaration-discipline rule — support code does not register
+    locks or points — but keeps every behavioral rule; the registry
+    rules are inert on partial trees anyway (no registry file in the
+    roots)."""
     from . import rules
 
     files, violations = load_files(roots)
     project = rules.Project(files)
-    for rule_fn in rules.ALL_RULES:
+    active = rules.ALL_RULES if profile == "strict" else rules.SUPPORT_RULES
+    for rule_fn in active:
         violations.extend(rule_fn(project))
     return sorted(set(violations), key=lambda v: (v.path, v.line, v.rule))
 
@@ -158,13 +207,14 @@ def run(roots: list[str]) -> list[Violation]:
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     quiet = "-q" in argv
+    profile = "support" if "--support" in argv else "strict"
     roots = [a for a in argv if not a.startswith("-")]
     if not roots:
         pkg = Path(__file__).resolve().parents[2]
         roots = [str(pkg)]
-    violations = run(roots)
+    violations = run(roots, profile=profile)
     for v in violations:
         print(v)
     if not violations and not quiet:
-        print(f"xlint: clean ({len(roots)} root(s))")
+        print(f"xlint: clean ({len(roots)} root(s), {profile} profile)")
     return 1 if violations else 0

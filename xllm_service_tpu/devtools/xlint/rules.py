@@ -15,6 +15,12 @@ DECL_METHODS = {"__init__", "setup", "__post_init__"}
 # threading factories that produce a lock-like object.
 LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 
+# asyncio factories: `async with self._alock` participates in the same
+# declaration/annotation/ordering machinery as the threaded locks (the
+# event loop serializes coroutines, but await points inside an async
+# critical section interleave other coroutines — ordering still matters).
+ASYNC_LOCK_FACTORIES = {"Lock", "Semaphore", "BoundedSemaphore", "Condition"}
+
 # EngineChannel control-plane methods: calling any of these is an RPC.
 CHANNEL_METHODS = {"forward", "forward_status", "health", "link", "unlink",
                    "flip_role", "models"}
@@ -63,6 +69,9 @@ def _lock_factory_kind(node: ast.AST) -> Optional[str]:
     if isinstance(f, ast.Attribute) and f.attr in LOCK_FACTORIES \
             and _expr_text(f.value) == "threading":
         return "threading"
+    if isinstance(f, ast.Attribute) and f.attr in ASYNC_LOCK_FACTORIES \
+            and _expr_text(f.value) == "asyncio":
+        return "asyncio"
     if isinstance(f, ast.Name) and f.id in LOCK_FACTORIES:
         return "threading"
     if (isinstance(f, ast.Name) and f.id == "make_lock") or \
@@ -113,6 +122,29 @@ def _registry_dict(f: SourceFile, name: str) -> dict[str, int]:
         for k in value.keys:
             if isinstance(k, ast.Constant) and isinstance(k.value, str):
                 out[k.value] = k.lineno
+    return out
+
+
+def _registry_items(f: SourceFile, name: str) -> "dict[str, tuple[Optional[str], int]]":
+    """Like :func:`_registry_dict` but also captures constant-string
+    VALUES → {key: (value-or-None, lineno)} — for registries whose value
+    carries machine-readable structure (the RCU publication specs)."""
+    out: dict[str, tuple[Optional[str], int]] = {}
+    for node in f.tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not isinstance(value, ast.Dict) or not any(
+                isinstance(t, ast.Name) and t.id == name for t in targets):
+            continue
+        for k, v in zip(value.keys, value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                val = v.value if isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str) else None
+                out[k.value] = (val, k.lineno)
     return out
 
 
@@ -899,6 +931,548 @@ def _rel_parts(rel: str) -> list[str]:
     return rel.replace("\\", "/").split("/")
 
 
+# ------------------------------------------------------------ async blocking
+def _is_async_blocking_call(node: ast.Call) -> Optional[str]:
+    """Blocking primitives that stall the event loop when called from a
+    coroutine. Reuses the under-lock detector and adds the raw channel
+    helpers (`_get`/`_post` are requests-backed)."""
+    why = _is_blocking_call(node)
+    if why is not None:
+        return why
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in ("_get", "_post"):
+        recv = _expr_text(node.func.value)
+        return f"channel {recv}.{node.func.attr}() performs blocking HTTP I/O"
+    return None
+
+
+def rule_async_blocking(project: Project) -> list[Violation]:
+    """No blocking calls lexically inside ``async def``: one
+    ``time.sleep``/``requests.post`` in a handler freezes EVERY in-flight
+    request on that loop, not just its own. Awaited calls and async-with/
+    async-for operands are exempt (they are the async API); nested sync
+    defs start a fresh execution context (they run wherever they are
+    called — usually an executor)."""
+    out: list[Violation] = []
+    for f in project.files:
+        for fn in [n for n in ast.walk(f.tree)
+                   if isinstance(n, ast.AsyncFunctionDef)]:
+            exempt: set[int] = set()
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Await):
+                    exempt.add(id(n.value))
+                elif isinstance(n, ast.AsyncWith):
+                    for item in n.items:
+                        exempt.add(id(item.context_expr))
+                elif isinstance(n, ast.AsyncFor):
+                    exempt.add(id(n.iter))
+
+            def visit(node, top=False):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)) and not top:
+                    return   # fresh execution context
+                if isinstance(node, ast.Call) and id(node) not in exempt:
+                    why = _is_async_blocking_call(node)
+                    if why is not None \
+                            and not f.allowed("async-blocking", node.lineno):
+                        out.append(Violation(
+                            "async-blocking", f.rel, node.lineno,
+                            f"{why} inside 'async def {fn.name}' — "
+                            f"blocking a coroutine stalls the whole event "
+                            f"loop (await the async API or move to an "
+                            f"executor)"))
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+
+            visit(fn, top=True)
+    return out
+
+
+# ------------------------------------------------------- RCU publication
+#: In-place container mutators: calling any of these on a published
+#: value is a torn-state bug (readers hold the same object).
+RCU_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "clear", "remove", "discard", "setdefault", "sort", "reverse",
+    "difference_update", "intersection_update",
+    "symmetric_difference_update", "__setitem__",
+}
+
+#: Builtins whose call yields a FRESH container (safe to publish, safe
+#: to mutate before publication even when fed a frozen source).
+_FRESH_BUILTINS = {"dict", "list", "set", "tuple", "frozenset", "sorted"}
+
+_FRESH_DISPLAYS = (ast.Dict, ast.List, ast.Set, ast.Tuple, ast.DictComp,
+                   ast.ListComp, ast.SetComp)
+
+
+@dataclass
+class _RcuPub:
+    cls: str
+    attr: str
+    type_name: str        # registered frozen type or builtin container
+    lock_attr: str        # declared writer lock attribute
+    line: int
+
+
+class _RcuModel:
+    """Parsed RCU registries + derived cross-file facts."""
+
+    def __init__(self, project: Project, reg_file: SourceFile,
+                 frozen: dict[str, int], pub_specs):
+        self.project = project
+        self.reg_file = reg_file
+        self.frozen = frozen                       # type name -> line
+        self.pubs: dict[tuple[str, str], _RcuPub] = {}
+        self.spec_errors: list[Violation] = []
+        for key, (val, line) in pub_specs.items():
+            cls, _, attr = key.partition(".")
+            tname, sep, lock = (val or "").partition("@")
+            if not attr or not sep or not tname.strip() or not lock.strip():
+                self.spec_errors.append(Violation(
+                    "rcu-publish", reg_file.rel, line,
+                    f"RCU publication {key!r} must be registered as "
+                    f"'Class.attr': 'Type @ writer_lock'"))
+                continue
+            self.pubs[(cls, attr)] = _RcuPub(
+                cls=cls, attr=attr, type_name=tname.strip(),
+                lock_attr=lock.strip(), line=line)
+        self.pub_attr_names = {attr for (_, attr) in self.pubs}
+        # Accessors: (cls, meth) -> (cls, attr) it returns, for methods
+        # whose body contains `return self.<registered pub attr>`; plus
+        # frozen-returning methods (any `return FrozenType(...)`).
+        self.accessors: dict[tuple[str, str], tuple[str, str]] = {}
+        self.frozen_returning: set[tuple[str, str]] = set()
+        for (cls, meth), (fn, _f) in project.methods.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                v = node.value
+                if isinstance(v, ast.Attribute) \
+                        and isinstance(v.value, ast.Name) \
+                        and v.value.id == "self" \
+                        and (cls, v.attr) in self.pubs:
+                    self.accessors[(cls, meth)] = (cls, v.attr)
+                elif isinstance(v, ast.Call) \
+                        and self.call_makes_frozen_type(v):
+                    self.frozen_returning.add((cls, meth))
+
+    def call_makes_frozen_type(self, call: ast.Call) -> bool:
+        fn = call.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        return name in self.frozen
+
+
+def _is_thaw_call(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Name) and fn.id == "thaw") or \
+        (isinstance(fn, ast.Attribute) and fn.attr == "thaw")
+
+
+def _is_publish_call(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Name) and fn.id == "publish") or \
+        (isinstance(fn, ast.Attribute) and fn.attr == "publish")
+
+
+class _FnRcu:
+    """Per-function frozen-value tracking (the one-level call summaries
+    of the RCU pass: ctor calls, publication reads, accessor calls)."""
+
+    def __init__(self, model: _RcuModel, f: SourceFile,
+                 cls_name: Optional[str], fn) -> None:
+        self.model = model
+        self.f = f
+        self.cls = cls_name
+        self.fn = fn
+        self.in_frozen_class = (cls_name in model.frozen
+                                and fn.name not in DECL_METHODS)
+        self.class_pubs = {attr for (c, attr) in model.pubs
+                          if c == cls_name}
+        self.frozen_names: set[str] = set()
+        self.poisoned: set[str] = set()   # ever bound to a non-frozen RHS
+        self._track_locals()
+
+    def _track_locals(self) -> None:
+        # Fixpoint over simple name bindings: a local is frozen iff every
+        # binding it receives is a frozen source. Loop/with/aug targets
+        # poison (conservative: no flow analysis).
+        for node in ast.walk(self.fn):
+            tgt = None
+            if isinstance(node, ast.For):
+                tgt = node.target
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        self._poison(item.optional_vars)
+            elif isinstance(node, ast.AugAssign):
+                tgt = node.target
+            if tgt is not None:
+                self._poison(tgt)
+        for _ in range(3):
+            changed = False
+            for node in ast.walk(self.fn):
+                # AnnAssign counts as a binding too — an annotated alias
+                # (`snap: RoutingSnapshot = self._snapshot`) must not
+                # escape tracking (the PR-4 AnnAssign lesson, again).
+                if isinstance(node, ast.AnnAssign) and node.value is not None \
+                        and isinstance(node.target, ast.Name):
+                    name, value = node.target.id, node.value
+                elif isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    name, value = node.targets[0].id, node.value
+                else:
+                    continue
+                if name in self.poisoned:
+                    continue
+                if self.is_frozen_expr(value):
+                    if name not in self.frozen_names:
+                        self.frozen_names.add(name)
+                        changed = True
+                else:
+                    self.poisoned.add(name)
+                    if name in self.frozen_names:
+                        self.frozen_names.discard(name)
+                    changed = True
+            if not changed:
+                break
+
+    def _poison(self, tgt: ast.AST) -> None:
+        for n in ast.walk(tgt):
+            if isinstance(n, ast.Name):
+                self.poisoned.add(n.id)
+
+    def is_frozen_expr(self, node: ast.AST) -> bool:
+        """Is this expression a published / frozen value? (Fields of
+        frozen values are frozen — the freeze is deep.)"""
+        if isinstance(node, ast.Name):
+            if node.id == "self" and self.in_frozen_class:
+                return True
+            return node.id in self.frozen_names
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in ("self", "cls"):
+                if self.in_frozen_class:
+                    return True
+                return node.attr in self.class_pubs
+            return self.is_frozen_expr(node.value)
+        if isinstance(node, ast.Call):
+            if _is_thaw_call(node):
+                return False   # the declared-writer escape hatch
+            if _is_publish_call(node):
+                return True
+            if self.model.call_makes_frozen_type(node):
+                return True
+            # Accessor / frozen-returning project methods (one level).
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                recv = _expr_text(fn.value)
+                target = self.model.project.resolve_call(
+                    self.cls, recv == "self", fn.attr)
+                if target is not None and (
+                        target in self.model.accessors
+                        or target in self.model.frozen_returning):
+                    return True
+            return False
+        return False
+
+
+def rule_rcu(project: Project) -> list[Violation]:
+    """The RCU publication-discipline pass (three rules over the
+    ``devtools/rcu.py`` registries — see the module docstring table)."""
+    frozen: dict[str, int] = {}
+    pub_specs = {}
+    reg_file: Optional[SourceFile] = None
+    for f in project.files:
+        if f.path.name != "rcu.py":
+            continue
+        fr = _registry_dict(f, "RCU_FROZEN_TYPES")
+        pb = _registry_items(f, "RCU_PUBLICATIONS")
+        if fr or pb:
+            frozen, pub_specs, reg_file = fr, pb, f
+    if reg_file is None:
+        return []   # partial tree (e.g. fixture subset without a registry)
+
+    model = _RcuModel(project, reg_file, frozen, pub_specs)
+    out: list[Violation] = list(model.spec_errors)
+
+    # ---- bidirectional registry checks
+    class_index: dict[str, tuple[SourceFile, int]] = {}
+    for f in project.files:
+        for node in f.tree.body:
+            if isinstance(node, ast.ClassDef):
+                class_index.setdefault(node.name, (f, node.lineno))
+    for tname, line in sorted(frozen.items()):
+        if tname not in class_index:
+            out.append(Violation(
+                "rcu-frozen", reg_file.rel, line,
+                f"registered frozen type {tname!r} has no class "
+                f"definition in the tree (stale registry entry)"))
+    attr_assigned: set[tuple[str, str]] = set()
+    for (cls, meth), (fn, _f) in project.methods.items():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in ("self", "cls"):
+                        attr_assigned.add((cls, t.attr))
+    for (cls, attr), pub in sorted(model.pubs.items()):
+        if cls not in class_index:
+            out.append(Violation(
+                "rcu-publish", reg_file.rel, pub.line,
+                f"registered publication {cls}.{attr} has no class "
+                f"{cls!r} in the tree (stale registry entry)"))
+            continue
+        if (cls, attr) not in attr_assigned:
+            out.append(Violation(
+                "rcu-publish", reg_file.rel, pub.line,
+                f"registered publication {cls}.{attr} is never assigned "
+                f"in class {cls} (stale registry entry)"))
+        if (cls, pub.lock_attr) not in project.lock_decls:
+            out.append(Violation(
+                "rcu-publish", reg_file.rel, pub.line,
+                f"publication {cls}.{attr} declares writer lock "
+                f"{pub.lock_attr!r}, which is not a declared lock of "
+                f"{cls} (the lock registry has no {cls}.{pub.lock_attr})"))
+        if pub.type_name not in frozen \
+                and pub.type_name not in _FRESH_BUILTINS:
+            out.append(Violation(
+                "rcu-publish", reg_file.rel, pub.line,
+                f"publication {cls}.{attr} declares type "
+                f"{pub.type_name!r}, which is neither a registered "
+                f"frozen type nor a builtin container"))
+
+    # ---- per-function analysis
+    # Publication-swap sites lacking a lexical lock, keyed by enclosing
+    # method, checked against call sites afterwards (one-level summary).
+    pending_lock: dict[tuple[str, str], list[tuple[_RcuPub, SourceFile, int]]] = {}
+    # Call sites: (cls, meth) -> list of lock-attr sets held at the call.
+    call_locks: dict[tuple[str, str], list[set[str]]] = {}
+
+    def fresh_rhs(node: ast.AST, fr: _FnRcu, fresh_names: set[str]) -> bool:
+        if isinstance(node, _FRESH_DISPLAYS):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in fresh_names
+        if isinstance(node, ast.Call):
+            if _is_publish_call(node):
+                return bool(node.args) and fresh_rhs(node.args[0], fr,
+                                                     fresh_names)
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if name in _FRESH_BUILTINS or name in model.frozen:
+                return True
+        return False
+
+    def scan_function(f: SourceFile, cls_name: Optional[str], fn) -> None:
+        fr = _FnRcu(model, f, cls_name, fn)
+        # Locals bound (only) from fresh builders, for the swap check.
+        fresh_names: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and fresh_rhs(node.value, fr, fresh_names):
+                fresh_names.add(node.targets[0].id)
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None \
+                    and isinstance(node.target, ast.Name) \
+                    and fresh_rhs(node.value, fr, fresh_names):
+                fresh_names.add(node.target.id)
+        in_decl = fn.name in DECL_METHODS
+
+        def pub_of_target(t: ast.AST) -> Optional[_RcuPub]:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id in ("self", "cls"):
+                return model.pubs.get((cls_name, t.attr))
+            return None
+
+        def flag_frozen(node: ast.AST, what: str) -> None:
+            if not f.allowed("rcu-frozen", node.lineno):
+                out.append(Violation(
+                    "rcu-frozen", f.rel, node.lineno,
+                    f"{what} — RCU-published values are immutable after "
+                    f"publish; build a replacement and swap the "
+                    f"reference (declared entry-level writers go through "
+                    f"rcu.thaw(..., reason))"))
+
+        def visit(node: ast.AST, lock_stack: list[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                # Nested defs: fresh lexical context for the lock stack
+                # (the RCU mutation checks still apply — same values).
+                for child in ast.iter_child_nodes(node):
+                    visit(child, [])
+                return
+            entered = 0
+            if isinstance(node, (ast.With, ast.AsyncWith)) \
+                    and cls_name is not None:
+                for key in _with_decl_locks(node, cls_name, project):
+                    lock_stack.append(key[1])
+                    entered += 1
+            elif isinstance(node, ast.Assign) or (
+                    isinstance(node, ast.AnnAssign)
+                    and node.value is not None):
+                # AnnAssign is a swap site too — an annotated publication
+                # write must not escape the rule (the PR-4 lesson).
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    pub = pub_of_target(t)
+                    if pub is not None and not in_decl:
+                        check_swap(node, t, pub, lock_stack)
+            elif isinstance(node, ast.AugAssign):
+                pub = pub_of_target(node.target)
+                if pub is not None and not in_decl \
+                        and not f.allowed("rcu-publish", node.lineno):
+                    out.append(Violation(
+                        "rcu-publish", f.rel, node.lineno,
+                        f"augmented assignment to publication "
+                        f"{pub.cls}.{pub.attr} — publish with one "
+                        f"reference swap of a freshly built object"))
+            elif isinstance(node, ast.Call) and _is_thaw_call(node):
+                reason = None
+                if len(node.args) >= 2:
+                    reason = node.args[1]
+                else:
+                    for kw in node.keywords:
+                        if kw.arg == "reason":
+                            reason = kw.value
+                if reason is None or (isinstance(reason, ast.Constant)
+                                      and not reason.value):
+                    flag_frozen(node, "rcu.thaw() without a reason "
+                                      "(the hatch requires one, like "
+                                      "# xlint: allow-*(reason))")
+            # ---- in-place mutation checks
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    mut = mutated_frozen(t)
+                    if mut:
+                        flag_frozen(t, mut)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    mut = mutated_frozen(t)
+                    if mut:
+                        flag_frozen(t, mut)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in RCU_MUTATORS \
+                    and fr.is_frozen_expr(node.func.value):
+                flag_frozen(node, f"in-place .{node.func.attr}() on "
+                                  f"published value "
+                                  f"{_expr_text(node.func.value)!r}")
+            # ---- call-site lock capture for the one-level summary
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                recv = _expr_text(node.func.value)
+                target = project.resolve_call(cls_name, recv == "self",
+                                              node.func.attr)
+                if target is not None:
+                    call_locks.setdefault(target, []).append(
+                        set(lock_stack))
+            for child in ast.iter_child_nodes(node):
+                visit(child, lock_stack)
+            for _ in range(entered):
+                lock_stack.pop()
+
+        def mutated_frozen(t: ast.AST) -> Optional[str]:
+            """An assignment/delete target that mutates a frozen value:
+            attribute store on a frozen expr, or subscript store whose
+            container is frozen."""
+            if isinstance(t, ast.Attribute) and fr.is_frozen_expr(t.value):
+                # `self.<pub> = ...` swap sites were handled above — a
+                # pub attr on `self` is only "frozen" through
+                # in_frozen_class, which publication classes are not.
+                return (f"attribute write to published value "
+                        f"{_expr_text(t)!r}")
+            if isinstance(t, ast.Subscript) and fr.is_frozen_expr(t.value):
+                return (f"item write on published value "
+                        f"{_expr_text(t.value)!r}")
+            return None
+
+        def check_swap(node: ast.Assign, t: ast.AST, pub: _RcuPub,
+                       lock_stack: list[str]) -> None:
+            if not fresh_rhs(node.value, fr, fresh_names) \
+                    and not f.allowed("rcu-publish", node.lineno):
+                out.append(Violation(
+                    "rcu-publish", f.rel, node.lineno,
+                    f"publication {pub.cls}.{pub.attr} must swap in a "
+                    f"freshly built {pub.type_name} (ctor call, builtin "
+                    f"copy, display, or a local bound from one) — not "
+                    f"{_expr_text(node.value) or 'this expression'!r}"))
+            if pub.lock_attr not in lock_stack:
+                pending_lock.setdefault((cls_name, fn.name), []).append(
+                    (pub, f, node.lineno))
+
+        visit(fn, [])
+
+    for f in project.files:
+        for cls_name, fn in _iter_functions(f):
+            scan_function(f, cls_name, fn)
+
+    # ---- one-level call-site summaries for non-lexical lock holds
+    for (cls, meth), sites in pending_lock.items():
+        callers = call_locks.get((cls, meth), [])
+        for pub, f, line in sites:
+            ok = bool(callers) and all(pub.lock_attr in held
+                                       for held in callers)
+            if not ok and not f.allowed("rcu-publish", line):
+                held_desc = "no resolvable call sites" if not callers \
+                    else "a call site without it"
+                out.append(Violation(
+                    "rcu-publish", f.rel, line,
+                    f"publication {pub.cls}.{pub.attr} swapped outside "
+                    f"'with self.{pub.lock_attr}' and {held_desc} "
+                    f"(writers must serialize on the declared lock)"))
+
+    # ---- rcu-read: registered hot readers load each publication once
+    hot_registry: dict[str, int] = {}
+    for f in project.files:
+        if f.path.name != "wire.py":
+            continue
+        found = _registry_dict(f, "HOT_PATH_FUNCTIONS")
+        if found:
+            hot_registry = found
+    if hot_registry:
+        for f in project.files:
+            for cls_name, fn in _iter_functions(f):
+                qual = f"{cls_name}.{fn.name}" if cls_name else fn.name
+                if qual not in hot_registry:
+                    continue
+                loads: dict[str, list[int]] = {}
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Attribute) \
+                            and isinstance(node.ctx, ast.Load) \
+                            and node.attr in model.pub_attr_names:
+                        loads.setdefault(node.attr, []).append(node.lineno)
+                    elif isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute):
+                        recv = _expr_text(node.func.value)
+                        target = project.resolve_call(
+                            cls_name, recv == "self", node.func.attr)
+                        acc = model.accessors.get(target) \
+                            if target is not None else None
+                        if acc is not None:
+                            loads.setdefault(acc[1], []).append(node.lineno)
+                for attr, lines in sorted(loads.items()):
+                    if len(lines) > 1 \
+                            and not f.allowed("rcu-read", *lines):
+                        out.append(Violation(
+                            "rcu-read", f.rel, lines[1],
+                            f"hot-path reader {qual} loads publication "
+                            f"{attr!r} {len(lines)} times (lines "
+                            f"{', '.join(map(str, lines))}) — a double "
+                            f"load is a torn read; load once into a "
+                            f"local"))
+    return out
+
+
 ALL_RULES = (
     rule_lock_discipline,
     rule_no_blocking_under_lock,
@@ -908,4 +1482,12 @@ ALL_RULES = (
     rule_metrics_registry,
     rule_hot_json,
     rule_broad_except,
+    rule_async_blocking,
+    rule_rcu,
 )
+
+#: Relaxed profile for support code (tests/, benchmarks/): every
+#: behavioral rule, minus the declaration-discipline rule — support code
+#: does not register locks/points, and a bench driver's ad-hoc local
+#: lock is fine as long as nothing blocks under it.
+SUPPORT_RULES = tuple(r for r in ALL_RULES if r is not rule_lock_discipline)

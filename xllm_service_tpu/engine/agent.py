@@ -894,8 +894,16 @@ class EngineAgent:
         new_type = InstanceType.parse(body.get("type"))
         old_key = instance_key(self.instance_type.value, self.name)
         self.instance_type = new_type
-        self.coord.rm(old_key)
-        self.register()
+
+        def _reregister():
+            # Coordination I/O is blocking (requests-backed client) — off
+            # the event loop, or a slow coordination server stalls every
+            # in-flight stream on this agent (found by xlint's
+            # async-blocking rule).
+            self.coord.rm(old_key)
+            self.register()
+
+        await asyncio.to_thread(_reregister)
         return web.json_response({"ok": True})
 
     async def _h_embeddings(self, req: web.Request) -> web.Response:

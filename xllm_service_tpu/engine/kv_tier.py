@@ -51,6 +51,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..devtools import rcu
 from ..devtools.locks import make_lock
 from ..utils import get_logger
 
@@ -394,12 +395,21 @@ class TieredKVStore:
 
     # --------------------------------------------------------------- events
     def drain_events(self) -> tuple[list[str], list[str]]:
-        """(offloaded, removed) hex hashes since the last heartbeat."""
+        """(offloaded, removed) hex hashes since the last heartbeat.
+
+        The drained lists are PUBLISHED on handoff (``rcu.publish``):
+        once a delta batch leaves the store it belongs to the heartbeat
+        it ships in — appending to (or cancelling from) an already
+        drained batch is exactly the intra-window ordering bug class the
+        PR-7 `offloaded`-delta cancellation fix closed, and the
+        XLLM_RCU_DEBUG freezer turns any such late mutation into a
+        raise."""
         with self._lock:
             off, rem = self._offloaded, self._removed
             self._offloaded = []
             self._removed = []
-            return off, rem
+            return (rcu.publish(off, "kv_tier.drained"),
+                    rcu.publish(rem, "kv_tier.drained"))
 
     # ------------------------------------------------------------ lifecycle
     def close(self) -> None:

@@ -32,6 +32,7 @@ from hashlib import blake2b
 from typing import Callable, Iterable, Optional
 
 from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..devtools import rcu
 from ..devtools.locks import make_lock
 from ..rpc import MASTER_KEY, SERVICE_KEY_PREFIX
 from ..utils import generate_service_request_id, get_logger
@@ -91,7 +92,8 @@ class OwnershipRouter:
             self._publish_locked()
 
     def _publish_locked(self) -> None:
-        self._members = tuple(sorted(self._addrs))
+        self._members = rcu.publish(tuple(sorted(self._addrs)),
+                                    "ownership.members")
 
     def update_self_addr(self, addr: str) -> None:
         """Follow the scheduler's post-bind re-registration (ephemeral

@@ -92,6 +92,23 @@ HOT_PATH_FUNCTIONS: dict[str, str] = {
         "chunked KV pull loop (decode-side executor thread, paced)",
     "EngineAgent._h_kv_stream_pull":
         "streaming-transfer pull endpoint (msgpack frames)",
+    # RCU snapshot readers (rcu-read single-load discipline applies: one
+    # load of the publication attribute per call, or two loads may
+    # observe different snapshots — the PR-6 COW-apply torn-read smell).
+    "GlobalKVCacheMgr.match":
+        "lock-free prefix-index walk on every CAR schedule",
+    "InstanceMgr.get_next_instance_pair":
+        "RR pair selection off the routing snapshot",
+    "InstanceMgr.select_instance_pair_on_slo":
+        "SLO pair selection off the routing snapshot",
+    "InstanceMgr.bind_request_instance_incarnations":
+        "RCU bind re-validation against the current snapshot",
+    "InstanceMgr.get_channel":
+        "per-dispatch channel lookup off the routing snapshot",
+    "InstanceMgr.get_load_infos":
+        "published load-info accessor for CAR/planner scoring",
+    "CacheAwareRoutingPolicy.select_instances_pair":
+        "whole CAR selection (match + load-info scoring, lock-free)",
 }
 
 

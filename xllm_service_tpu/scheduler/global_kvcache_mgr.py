@@ -60,6 +60,7 @@ from ..common.config import ServiceOptions
 from ..common.hashing import as_key, prefix_block_hashes
 from ..common.types import CacheLocations, KvCacheEvent, OverlapScores
 from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..devtools import rcu
 from ..devtools.locks import make_lock
 from ..rpc import CACHE_FRAME_KEY_PREFIX, CACHE_KEY_PREFIX
 from ..rpc.wire import decode_kv_frame, encode_kv_frame
@@ -157,7 +158,12 @@ class GlobalKVCacheMgr:
         # Writer lock: serializes index WRITERS only (ingest, eviction,
         # frame apply, bootstrap). match() never takes it.
         self._lock = make_lock("global_kvcache_mgr.cache", order=26)  # lock-order: 26
-        self._snapshot = PrefixIndex()
+        self._snapshot = rcu.publish(PrefixIndex(), "kvcache.index")
+        # Test-only regression flag: resurrects the historical PR-6 bug
+        # (full-frame watch batches applied IN PLACE on the live index
+        # instead of copy-on-write). The XLLM_RCU_DEBUG regression test
+        # flips it to prove the deep-freeze detector catches the class.
+        self._inplace_full_apply = False
         # Reverse index: instance → keys it holds (any tier). Keeps
         # remove_instance / eviction O(blocks owned by that instance).
         self._by_instance: dict[str, set[bytes]] = {}
@@ -227,7 +233,7 @@ class GlobalKVCacheMgr:
                 self._apply_frame_into(blocks, upserts, removals)
             self._by_instance = _build_by_instance(blocks)
             self._frame_seq = max(self._frame_seq, max_seq + 1)
-            self._snapshot = PrefixIndex(blocks)
+            self._snapshot = rcu.publish(PrefixIndex(blocks), "kvcache.index")
             # Replay watch deliveries that raced the rebuild, then disarm.
             buffered = self._bootstrap_buffer or []
             self._bootstrap_buffer = None
@@ -297,7 +303,9 @@ class GlobalKVCacheMgr:
         offloaded = [k for k in map(as_key, event.offloaded) if k is not None]
         removed = [k for k in map(as_key, event.removed) if k is not None]
         with self._lock:
-            blocks = self._snapshot.blocks
+            blocks = rcu.thaw(self._snapshot.blocks,
+                              "entry-level RCU writer: immutable _BlockLoc "
+                              "slot swaps are atomic under the GIL")
             owned = self._by_instance.setdefault(instance, set())
             for h in stored:
                 loc = blocks.get(h)
@@ -352,7 +360,9 @@ class GlobalKVCacheMgr:
         """Drop a dead instance from every block it holds — O(blocks owned
         by that instance) via the reverse index, not O(index)."""
         with self._lock:
-            blocks = self._snapshot.blocks
+            blocks = rcu.thaw(self._snapshot.blocks,
+                              "entry-level RCU writer: immutable _BlockLoc "
+                              "slot swaps are atomic under the GIL")
             removed, dirty = self._removed, self._dirty
             for h in self._by_instance.pop(instance, ()):
                 loc = blocks.get(h)
@@ -492,6 +502,28 @@ class GlobalKVCacheMgr:
         # complete pre-batch generation — never the half-applied state
         # (compaction's legacy prune without its full frame).
         cow = any(op[0] != "legacy" and op[3] for op in ops)
+        if cow and self._inplace_full_apply:
+            # RESURRECTED PR-6 BUG (test flag only, see __init__): the
+            # pre-fix replica applied full-frame batches in place on the
+            # LIVE published dict, exposing the half-pruned intermediate
+            # to a concurrent lock-free match(). Every mutation flows
+            # through _apply_frame_into's parameter — an alias the static
+            # rcu-frozen rule's one-level summaries do NOT track — which
+            # is exactly the gap the XLLM_RCU_DEBUG deep-freeze closes:
+            # the first in-place pop/store on the frozen dict raises.
+            blocks = self._snapshot.blocks
+            for op in ops:
+                if op[0] == "legacy":
+                    _, h, loc = op
+                    if loc is None or loc.empty():
+                        self._apply_frame_into(blocks, {}, [h])
+                    else:
+                        self._apply_frame_into(blocks, {h: loc.to_row()}, [])
+                    continue
+                _, upserts, removals, _full = op
+                self._apply_frame_into(blocks, upserts, removals)
+            self._by_instance = _build_by_instance(blocks)
+            return
         if cow:
             blocks = dict(self._snapshot.blocks)
             for op in ops:
@@ -507,7 +539,7 @@ class GlobalKVCacheMgr:
                     blocks = {}
                 self._apply_frame_into(blocks, upserts, removals)
             self._by_instance = _build_by_instance(blocks)
-            self._snapshot = PrefixIndex(blocks)
+            self._snapshot = rcu.publish(PrefixIndex(blocks), "kvcache.index")
             return
         for op in ops:
             if op[0] == "legacy":
@@ -543,7 +575,9 @@ class GlobalKVCacheMgr:
                 del self._by_instance[inst]
 
     def _put_key_locked(self, h: bytes, loc: _BlockLoc) -> None:
-        blocks = self._snapshot.blocks
+        blocks = rcu.thaw(self._snapshot.blocks,
+                          "entry-level RCU writer: immutable _BlockLoc "
+                          "slot swaps are atomic under the GIL")
         old = blocks.get(h)
         if old is not None:
             for inst in old.holders():
@@ -554,7 +588,9 @@ class GlobalKVCacheMgr:
         blocks[h] = loc
 
     def _drop_key_locked(self, h: bytes) -> None:
-        old = self._snapshot.blocks.pop(h, None)
+        old = rcu.thaw(self._snapshot.blocks,
+                       "entry-level RCU writer: immutable _BlockLoc "
+                       "slot swaps are atomic under the GIL").pop(h, None)
         if old is not None:
             for inst in old.holders():
                 self._unindex_locked(inst, h)

@@ -68,6 +68,7 @@ from ..common.types import (
 )
 from ..common.request import Request
 from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..devtools import rcu
 from ..devtools.locks import make_lock
 from ..rpc import (
     INSTANCE_KEY_PREFIX,
@@ -183,7 +184,7 @@ class InstanceMgr:
         # published RoutingSnapshot, not this lock.
         self._cluster_lock = make_lock("instance_mgr.cluster", order=20, reentrant=True)  # lock-order: 20
         self._instances: dict[str, _Entry] = {}
-        self._snapshot = RoutingSnapshot({})
+        self._snapshot = rcu.publish(RoutingSnapshot({}), "routing.snapshot")
         # RR cursors: shared monotonic counters (next() on itertools.count
         # is atomic under the GIL) — no lock, stable fairness across
         # snapshot republishes.
@@ -210,7 +211,8 @@ class InstanceMgr:
         # rebuilt under `_metrics_lock` by every load/latency/membership
         # writer, read lock-free by CAR / planner / admin. Treat as
         # immutable.
-        self._load_infos: dict[str, InstanceLoadInfo] = {}
+        self._load_infos: dict[str, InstanceLoadInfo] = rcu.publish(
+            {}, "routing.load_infos")
         # Hook for request cancellation on instance death (reference keeps a
         # Scheduler back-pointer, `instance_mgr.h:196-198`).
         self.on_instance_failure: Optional[Callable[[str, str, InstanceType], None]] = None
@@ -243,7 +245,8 @@ class InstanceMgr:
         republished in the same step (nested `_metrics_lock` is fine:
         lock order 20 → 24, and no path nests them the other way)."""
         with self._cluster_lock:
-            self._snapshot = RoutingSnapshot(self._instances)
+            self._snapshot = rcu.publish(RoutingSnapshot(self._instances),
+                                         "routing.snapshot")
             with self._metrics_lock:
                 self._rebuild_load_infos_locked()
 
@@ -255,9 +258,9 @@ class InstanceMgr:
         of ONE entry, so a large fleet's heartbeat stream doesn't rebuild
         O(fleet) objects per beat)."""
         snap = self._snapshot
-        self._load_infos = {
+        self._load_infos = rcu.publish({
             name: self._make_load_info_locked(name, entry, snap)
-            for name, entry in snap.entries.items()}
+            for name, entry in snap.entries.items()}, "routing.load_infos")
 
     def _make_load_info_locked(self, name: str, entry: _Entry,
                                snap: RoutingSnapshot) -> InstanceLoadInfo:
@@ -279,11 +282,11 @@ class InstanceMgr:
             if name in self._load_infos:
                 nxt = dict(self._load_infos)
                 nxt.pop(name, None)
-                self._load_infos = nxt
+                self._load_infos = rcu.publish(nxt, "routing.load_infos")
             return
         nxt = dict(self._load_infos)
         nxt[name] = self._make_load_info_locked(name, entry, snap)
-        self._load_infos = nxt
+        self._load_infos = rcu.publish(nxt, "routing.load_infos")
 
     def routing_snapshot(self) -> RoutingSnapshot:
         """The current immutable routing view (lock-free read)."""

@@ -337,7 +337,7 @@ class FakeEngine:
             hctx = TraceContext.from_headers(req.headers)
             if hctx is not None:
                 body["trace_context"] = hctx.to_dict()
-        rule = FAULTS.fire("engine.accept", instance=self.name,
+        rule = FAULTS.fire("engine.accept", instance=self.name,  # xlint: allow-async-blocking(test double: a delay rule on engine.accept deliberately models a stalled engine loop, serialized accepts included)
                            sid=body.get("service_request_id", ""))
         if rule is not None and rule.action == "error":
             return web.Response(status=503, text="fault injected")
