@@ -1,21 +1,30 @@
-"""Tracing-overhead micro-bench (ISSUE 3 acceptance: tracing-off <2%).
+"""Tracing-overhead micro-bench (ISSUE 3 acceptance: tracing-off <2%;
+re-run in ISSUE 9 with federation + sampling in the tree).
 
 Measures the fake-engine request path end-to-end (HTTP frontend ->
-scheduler -> fake engine -> generations ingest -> response) under three
+scheduler -> fake engine -> generations ingest -> response) under four
 tracer configurations, against ONE shared cluster with the modes
 interleaved round-robin (cluster-to-cluster and drift noise would
 otherwise swamp the sub-ms effect being measured):
 
-- ``off``    — tracing disabled: every span call is one attribute check +
-               shared no-op singleton.
-- ``ring``   — spans recorded into the in-memory SpanStore ring (default).
-- ``jsonl``  — ring + every finished span mirrored into a RequestTracer
-               JSONL (the enable_request_trace pairing).
+- ``off``     — tracing disabled: every span call is one attribute check +
+                shared no-op singleton.
+- ``ring``    — spans recorded into the in-memory SpanStore ring (default).
+- ``sampled`` — ring at ``sample_rate=0.1`` with tail-based keep: ~90% of
+                traces park in the pending buffer and are dropped at
+                clean exit (the high-QPS always-on configuration).
+- ``jsonl``   — ring + every finished span mirrored into a RequestTracer
+                JSONL (the enable_request_trace pairing).
 
-Also times the disabled `start_span` call in isolation (ns/call).
+Also times the disabled `start_span` call in isolation (ns/call), and —
+fleet observability plane — the cost of one `/admin/trace?scope=fleet`
+assembly and one `/metrics/fleet` scrape against the live cluster
+(query-side cost; the request path is untouched by federation).
 
-Prints one JSON line per mode plus p50 overhead ratios vs ``off``.
-Results are quoted in docs/observability.md.
+Prints one JSON line per mode plus p50 overhead ratios vs ``off``, and a
+BENCH_tracing-shaped document at the end (headline tracked by
+scripts/bench_trend.py). Results are quoted in docs/observability.md and
+docs/performance.md.
 """
 
 from __future__ import annotations
@@ -36,7 +45,7 @@ import time
 
 import requests
 
-MODES = ("off", "ring", "jsonl")
+MODES = ("off", "ring", "sampled", "jsonl")
 
 
 def disabled_span_call_ns(iters: int = 200_000) -> float:
@@ -95,7 +104,8 @@ def main() -> None:
 
     def set_mode(mode: str) -> None:
         TRACER.configure(enabled=mode != "off",
-                         mirror=mirror if mode == "jsonl" else None)
+                         mirror=mirror if mode == "jsonl" else None,
+                         sample_rate=0.1 if mode == "sampled" else 1.0)
 
     url = f"http://127.0.0.1:{master.http_port}/v1/completions"
     body = {"model": "fake-model", "prompt": "bench", "max_tokens": 8}
@@ -130,10 +140,41 @@ def main() -> None:
         }
         print(json.dumps(results[mode]))
     base = results["off"]["p50_ms"]
-    for mode in ("ring", "jsonl"):
+    overheads = {}
+    for mode in ("ring", "sampled", "jsonl"):
         ratio = (results[mode]["p50_ms"] - base) / base * 100.0
-        print(json.dumps({"overhead_vs_off": mode,
-                          "p50_pct": round(ratio, 2)}))
+        overheads[mode] = round(ratio, 2)
+        print(json.dumps({"overhead_vs_off": mode, "p50_pct": ratio}))
+
+    # Fleet-endpoint query cost (not on the request path; informational).
+    recent = session.get(
+        f"http://127.0.0.1:{master.http_port}/admin/trace/recent",
+        timeout=10).json()
+    sid = recent["traces"][0]["request_id"] if recent["traces"] else ""
+    fleet = {}
+    for name, path, params in (
+            ("fleet_trace_ms", "/admin/trace",
+             {"scope": "fleet", "request_id": sid}),
+            ("fleet_metrics_ms", "/metrics/fleet", {})):
+        t0 = time.perf_counter()
+        session.get(f"http://127.0.0.1:{master.http_port}{path}",
+                    params=params, timeout=10)
+        fleet[name] = round((time.perf_counter() - t0) * 1000.0, 3)
+    print(json.dumps(fleet))
+
+    doc = {
+        "bench": "benchmarks/bench_tracing_overhead.py",
+        "modes": results,
+        "fleet_endpoint_cost": fleet,
+        # Signed: negative = measured faster than off (noise); the
+        # bench-trend tripwire judges *_pct headlines in absolute
+        # points, so a clamped 0 would hide a later real regression.
+        "headline": {
+            "ring_overhead_p50_pct": overheads["ring"],
+            "sampled_overhead_p50_pct": overheads["sampled"],
+        },
+    }
+    print("BENCH_DOC " + json.dumps(doc))
 
     jsonl_tracer.close()
     engine.stop()

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Bench-trend tripwire: fail when the newest recorded benchmark round
+regresses its family's tracked headline metric by more than 10%.
+
+The repo records one ``BENCH_<family>_r<NN>.json`` artifact per perf
+round (hotpath, kvcache, kvtier, multimaster, tracing, ...). Each family
+has a few *headline* metrics — the numbers quoted in
+``docs/performance.md`` — and a silent regression there is exactly the
+kind of drift a later PR ships by accident. This script:
+
+1. groups the ``BENCH_*.json`` artifacts by family,
+2. for every family with >= 2 rounds, compares the newest round's
+   tracked metrics against the previous round's,
+3. exits non-zero when any tracked metric regressed past the threshold
+   (default 10) in its bad direction (lower for throughput/speedups,
+   higher for latencies). Metrics that are already percentages
+   (``*_pct``/``*_perc`` — overhead ratios, step deltas) are judged in
+   ABSOLUTE percentage points, not relative change: their baselines sit
+   at the noise floor near 0, where relative math is meaningless.
+
+Tracked metrics are dotted JSON paths per family (``TRACKED`` below);
+families may also self-describe by shipping a top-level ``"headline"``
+object — every numeric leaf under it is auto-tracked, direction inferred
+from the key name (``*_ms``/``*_seconds`` regress upward, everything
+else downward). Missing paths are skipped with a note (schemas evolve);
+a missing FAMILY is never an error.
+
+Wired into ``scripts/check.sh``; ``--list`` prints what would be
+compared without judging.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+#: family -> [(dotted path, higher_is_better)]
+TRACKED: dict[str, list[tuple[str, bool]]] = {
+    "hotpath": [
+        ("headline.sustained_req_per_s_conc8.after", True),
+        ("headline.ttft_p50_at_equal_offered_load_6p5rps_ms.after", False),
+    ],
+    "kvcache": [
+        ("index.match_new.throughput_1t_per_s", True),
+        ("index.match_new.throughput_4t_per_s", True),
+        ("hashing.new_us_per_prompt", False),
+        ("routed_ttft.CAR.req_per_s", True),
+    ],
+    "kvtier": [
+        ("tier_ttft.warm_vs_cold_speedup", True),
+        ("capacity.capacity_multiplier", True),
+        ("step_latency.delta_p50_perc", False),
+    ],
+    "tracing": [
+        ("headline.ring_overhead_p50_pct", False),
+        ("headline.sampled_overhead_p50_pct", False),
+    ],
+}
+
+_NAME_RE = re.compile(r"^BENCH_(?:([a-z0-9]+)_)?r(\d+)\.json$")
+
+#: Key suffixes whose headline values regress UPWARD (latencies, costs).
+_LOWER_IS_BETTER_SUFFIXES = ("_ms", "_us", "_ns", "_seconds", "_pct",
+                             "_perc")
+
+
+def _lookup(obj: Any, path: str) -> Optional[float]:
+    for part in path.split("."):
+        if not isinstance(obj, dict) or part not in obj:
+            return None
+        obj = obj[part]
+    if isinstance(obj, bool) or not isinstance(obj, (int, float)):
+        return None
+    return float(obj)
+
+
+def _headline_paths(doc: dict) -> Iterator[tuple[str, bool]]:
+    """Auto-tracked numeric leaves under a top-level "headline" object."""
+    def walk(obj: Any, prefix: str) -> Iterator[tuple[str, bool]]:
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                yield from walk(v, f"{prefix}.{k}")
+        elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+            leaf = prefix.rsplit(".", 1)[-1]
+            higher = not leaf.endswith(_LOWER_IS_BETTER_SUFFIXES)
+            yield prefix, higher
+
+    if isinstance(doc.get("headline"), dict):
+        yield from walk(doc["headline"], "headline")
+
+
+def families(root: Path) -> dict[str, list[tuple[int, Path]]]:
+    out: dict[str, list[tuple[int, Path]]] = {}
+    for p in sorted(root.glob("BENCH_*.json")):
+        m = _NAME_RE.match(p.name)
+        if not m or m.group(1) is None:
+            continue   # seed BENCH_rNN.json artifacts carry no metrics
+        out.setdefault(m.group(1), []).append((int(m.group(2)), p))
+    for rounds in out.values():
+        rounds.sort()
+    return out
+
+
+def compare(root: Path, threshold_pct: float = 10.0,
+            list_only: bool = False) -> int:
+    regressions: list[str] = []
+    compared = 0
+    for family, rounds in sorted(families(root).items()):
+        if len(rounds) < 2:
+            print(f"bench_trend: {family}: only round r{rounds[-1][0]:02d} "
+                  f"recorded; nothing to diff")
+            continue
+        (prev_r, prev_p), (new_r, new_p) = rounds[-2], rounds[-1]
+        try:
+            prev = json.loads(prev_p.read_text())
+            new = json.loads(new_p.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_trend: {family}: unreadable artifact ({e}); "
+                  f"skipping")
+            continue
+        tracked = dict(TRACKED.get(family, ()))
+        for path, higher in _headline_paths(new):
+            tracked.setdefault(path, higher)
+        for path, higher in sorted(tracked.items()):
+            a, b = _lookup(prev, path), _lookup(new, path)
+            if a is None or b is None:
+                print(f"bench_trend: {family}.{path}: absent in "
+                      f"r{prev_r:02d} or r{new_r:02d}; skipping")
+                continue
+            compared += 1
+            leaf = path.rsplit(".", 1)[-1]
+            if leaf.endswith(("_pct", "_perc")):
+                # Already-a-percentage metrics (e.g. tracing overhead,
+                # decode-step delta) compare in absolute points: their
+                # baselines sit at the noise floor (~0), where relative
+                # change is meaningless — and a 0 baseline must not
+                # silently disarm the tripwire.
+                delta = b - a
+                regressed_pct = -delta if higher else delta
+                shown = f"{delta:+.2f} points"
+            elif a == 0:
+                print(f"bench_trend: {family}.{path}: zero baseline in "
+                      f"r{prev_r:02d}; cannot judge relative change — "
+                      f"skipping (non-pct metric)")
+                continue
+            else:
+                delta_pct = (b - a) / abs(a) * 100.0
+                regressed_pct = -delta_pct if higher else delta_pct
+                shown = f"{delta_pct:+.1f}%"
+            arrow = "better" if regressed_pct < 0 else "worse"
+            line = (f"{family}.{path}: r{prev_r:02d}={a:g} -> "
+                    f"r{new_r:02d}={b:g} ({shown}, {arrow})")
+            if list_only:
+                print("bench_trend:", line)
+                continue
+            if regressed_pct > threshold_pct:
+                regressions.append(line)
+            else:
+                print("bench_trend: ok:", line)
+    if regressions:
+        print(f"\nbench_trend: FAIL — headline metric regression(s) over "
+              f"{threshold_pct:g}%:")
+        for line in regressions:
+            print("  " + line)
+        return 1
+    print(f"bench_trend: OK ({compared} tracked metric(s) compared)")
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--root", default=str(Path(__file__).resolve()
+                                         .parent.parent),
+                   help="directory holding the BENCH_*.json artifacts")
+    p.add_argument("--threshold-pct", type=float, default=10.0)
+    p.add_argument("--list", action="store_true", dest="list_only",
+                   help="print comparisons without judging")
+    args = p.parse_args(argv)
+    return compare(Path(args.root), args.threshold_pct, args.list_only)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,7 @@
 # all randomness from one seeded RNG), so any failing iteration can be
 # replayed exactly with:   XLLM_CHAOS_SEED=<seed> pytest -m chaos
 #
-# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier] [extra pytest args...]
+# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs] [extra pytest args...]
 #   --masters   soak the multi-master plane drills (tests/test_multimaster.py:
 #               owner/master kill mid-stream, split-brain demotion, write-lease
 #               proxying) instead of the single-master failover drills.
@@ -14,6 +14,11 @@
 #               eviction→offload→onload round trips under a saturated pump,
 #               streamed PD handoff with faults injected at the
 #               kv_transfer.offer / kv_transfer.pull points → inline fallback).
+#   --obs       soak the fleet-observability drills
+#               (tests/test_fleet_observability.py: fleet-trace merge across
+#               frontends+engines under a mid-stream engine kill, dead-agent
+#               partial-result markers, and the owner-kill drill asserting the
+#               anomaly flight recorder captured the recovery).
 #
 # After the randomized-seed loop, three INSTRUMENTED legs run (one
 # iteration each, counted in the pass rate): XLLM_LOCK_DEBUG=1 (the
@@ -31,6 +36,9 @@ if [ "${1:-}" = "--masters" ]; then
     shift
 elif [ "${1:-}" = "--tier" ]; then
     SUITE="tests/test_kv_tiering.py"
+    shift
+elif [ "${1:-}" = "--obs" ]; then
+    SUITE="tests/test_fleet_observability.py"
     shift
 fi
 cd "$(dirname "$0")/.."

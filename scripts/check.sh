@@ -11,6 +11,9 @@ python -m xllm_service_tpu.devtools.xlint xllm_service_tpu
 echo "== xlint --support (tests/ + benchmarks/, relaxed profile) =="
 python -m xllm_service_tpu.devtools.xlint --support tests benchmarks
 
+echo "== bench trend (headline-metric regression tripwire, >10% fails) =="
+python scripts/bench_trend.py
+
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check =="
     ruff check xllm_service_tpu tests benchmarks scripts

@@ -1,0 +1,831 @@
+"""Tier-1 tests for the fleet-scope observability plane (ISSUE 9):
+
+- trace federation: `/admin/trace?scope=fleet` assembles ONE tree across
+  frontends + engine agents (relayed request drill), degrades partially
+  when an agent dies, and merges spans a peer holds that this frontend
+  never recorded (standalone span-peer server with its own Tracer),
+- metrics federation: `/metrics/fleet` merges + re-labels engine and
+  peer-frontend series, keeps serving with a dead agent (partial,
+  non-erroring),
+- SLO burn-rate monitor: window math units + the fault-plane latency
+  drill moving `/admin/slo` burn rates,
+- anomaly flight recorder: ring/JSONL capture units + the owner-kill
+  chaos drill asserting a `handoff_recovery` bundle was captured,
+- tail-based trace sampling: sampled-out traces drop on clean exit and
+  ALWAYS record on failover/error/SLO breach,
+- engine-agent labeled-series eviction (PD unlink, master change),
+- the bench-trend regression tripwire (scripts/bench_trend.py).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+import requests
+from aiohttp import web
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.faults import FAULTS
+from xllm_service_tpu.common.flightrecorder import RECORDER, FlightRecorder
+from xllm_service_tpu.common.metrics import (
+    ENGINE_HEARTBEATS_TOTAL,
+    ENGINE_PEER_LINKED,
+    relabel_prometheus_text,
+)
+from xllm_service_tpu.common.slo import SloMonitor
+from xllm_service_tpu.common.tracing import (
+    TRACER,
+    Tracer,
+    make_trace_handlers,
+    merge_fleet_spans,
+)
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.engine.agent import EngineAgent
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.rpc import SERVICE_KEY_PREFIX
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+from xllm_service_tpu.utils import pick_free_port
+
+from fakes import wait_until
+
+SEED = int(os.environ.get("XLLM_CHAOS_SEED", "0"))
+REPO = Path(__file__).resolve().parent.parent
+REPLY = "One fleet, one trace tree, one merged scrape."
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    FAULTS.configure((), seed=SEED)
+    TRACER.configure(enabled=True, mirror=None, sample_rate=1.0)
+    TRACER.store.clear()
+    RECORDER.clear()
+    RECORDER.configure(capacity=64, directory="")
+    yield
+    FAULTS.clear()
+    TRACER.configure(enabled=True, mirror=None, sample_rate=1.0)
+    RECORDER.configure(capacity=64, directory="")
+
+
+# ----------------------------------------------------------------- helpers
+def _opts(**kw) -> ServiceOptions:
+    base = dict(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        lease_ttl_s=0.5, sync_interval_s=0.2,
+        reconcile_interval_s=0.05,
+        heartbeat_silence_to_suspect_s=0.3,
+        detect_disconnected_instance_interval_s=0.3,
+        health_probe_attempts=1, health_probe_timeout_s=0.2,
+        failover_backoff_base_s=0.05, failover_backoff_max_s=0.3,
+        rpc_backoff_base_s=0.02, rpc_backoff_max_s=0.1,
+        handoff_stall_timeout_s=1.5,
+        metrics_fleet_cache_ttl_s=0.0,
+        fleet_peer_timeout_s=2.0)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def _master(store, **kw) -> Master:
+    m = Master(_opts(**kw), coord=InMemoryCoordination(store))
+    m.start()
+    return m
+
+
+def _engine(store, **cfg_kw) -> FakeEngine:
+    cfg_kw.setdefault("delay_s", 0.02)
+    cfg = FakeEngineConfig(reply_text=REPLY, chunk_size=4,
+                           heartbeat_interval_s=0.1, lease_ttl_s=0.5,
+                           **cfg_kw)
+    return FakeEngine(InMemoryCoordination(store), cfg).start()
+
+
+def _base(m: Master) -> str:
+    return f"http://127.0.0.1:{m.http_port}"
+
+
+def _await_fleet(masters, engines) -> None:
+    addrs = {m.scheduler.self_addr for m in masters}
+    assert wait_until(
+        lambda: all(
+            all(m.scheduler.instance_mgr.get_instance_meta(e.name)
+                is not None for e in engines)
+            and set(m.scheduler.ownership.members()) == addrs
+            for m in masters), timeout=20)
+
+
+def _stream(m: Master, okey=None, after_frames=0, hook=None, timeout=90):
+    body = {"model": "fake-model", "prompt": "fleet", "stream": True,
+            "max_tokens": 1000}
+    if okey is not None:
+        body["ownership_key"] = okey
+    r = requests.post(_base(m) + "/v1/completions", json=body,
+                      stream=True, timeout=timeout)
+    assert r.status_code == 200, r.text
+    text, n, fired = "", 0, False
+    for line in r.iter_lines():
+        if not line.startswith(b"data: "):
+            continue
+        data = line[len(b"data: "):]
+        if data == b"[DONE]":
+            break
+        obj = json.loads(data)
+        if "error" in obj:
+            raise RuntimeError(f"stream error: {obj['error']}")
+        for c in obj.get("choices", ()):
+            text += c.get("text", "")
+        n += 1
+        if hook is not None and not fired and n >= after_frames:
+            fired = True
+            hook()
+    return text
+
+
+def _completion(m: Master, max_tokens=50) -> str:
+    r = requests.post(_base(m) + "/v1/completions", json={
+        "model": "fake-model", "prompt": "fleet",
+        "max_tokens": max_tokens}, timeout=30)
+    assert r.status_code == 200, r.text
+    return r.json()["choices"][0]["text"]
+
+
+def _latest_sid(m: Master) -> str:
+    rec = requests.get(_base(m) + "/admin/trace/recent", timeout=5).json()
+    return next(r["request_id"] for r in rec["traces"]
+                if r["request_id"].startswith("completion-"))
+
+
+def _fleet_trace(m: Master, **params):
+    params["scope"] = "fleet"
+    return requests.get(_base(m) + "/admin/trace", params=params,
+                        timeout=15)
+
+
+def _key_owned_by(router, addr: str) -> str:
+    for i in range(10000):
+        k = f"obs-affinity-{i}"
+        if router.owner_of(k) == addr:
+            return k
+    raise AssertionError(f"no key owned by {addr}")
+
+
+class _SpanPeer:
+    """Standalone span-server: serves /admin/trace(+recent) + /metrics
+    from its OWN Tracer instance — a fleet peer whose spans this process's
+    global TRACER never saw, so the merge is provably doing network
+    federation, not reading shared memory."""
+
+    def __init__(self):
+        self.tracer = Tracer(capacity=128)
+        self.port = pick_free_port("127.0.0.1")
+        self.addr = f"127.0.0.1:{self.port}"
+        self._loop = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(10)
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        trace_h, recent_h = make_trace_handlers(self.tracer)
+        app = web.Application()
+        app.router.add_get("/admin/trace", trace_h)
+        app.router.add_get("/admin/trace/recent", recent_h)
+
+        async def metrics(_req):
+            return web.Response(text="peer_requests_total 7\n",
+                                content_type="text/plain")
+
+        app.router.add_get("/metrics", metrics)
+
+        async def start():
+            self._runner = web.AppRunner(app)
+            await self._runner.setup()
+            await web.TCPSite(self._runner, "127.0.0.1", self.port).start()
+
+        self._loop.run_until_complete(start())
+        self._started.set()
+        self._loop.run_forever()
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+# ------------------------------------------------------------ SLO monitor
+class TestSloMonitor:
+    def test_burn_rate_math(self):
+        mon = SloMonitor()
+        mon.configure(ttft_ms=100.0, tpot_ms=10.0, budget=0.1,
+                      fast_s=60.0, slow_s=600.0, alert=2.0)
+        now = 1000.0
+        for i in range(8):
+            mon.record_ttft(50.0, now=now + i)       # good
+        for i in range(2):
+            mon.record_ttft(500.0, now=now + 8 + i)  # bad
+        rep = mon.report(now=now + 10)
+        ttft = rep["objectives"]["ttft"]
+        assert ttft["fast"]["n"] == 10 and ttft["fast"]["bad"] == 2
+        # bad_fraction 0.2 / budget 0.1 = burn 2.0 in both windows.
+        assert ttft["fast"]["burn_rate"] == pytest.approx(2.0)
+        assert ttft["slow"]["burn_rate"] == pytest.approx(2.0)
+        assert ttft["breaching"] is True
+        assert "ttft" in rep["breaching"]
+
+    def test_multiwindow_requires_both_hot(self):
+        """A burst that already ended burns the fast window cold again —
+        only a sustained burn (both windows hot) breaches."""
+        mon = SloMonitor()
+        mon.configure(ttft_ms=100.0, tpot_ms=10.0, budget=0.01,
+                      fast_s=10.0, slow_s=600.0, alert=5.0)
+        now = 2000.0
+        for i in range(50):
+            mon.record_ttft(500.0, now=now + i * 0.1)   # hot burst
+        for i in range(100):
+            mon.record_ttft(5.0, now=now + 20 + i * 0.1)  # recovered
+        rep = mon.report(now=now + 31)
+        ttft = rep["objectives"]["ttft"]
+        assert ttft["fast"]["bad"] == 0          # burst aged out of fast
+        assert ttft["slow"]["bad"] == 50         # still burning slow
+        assert ttft["breaching"] is False
+
+    def test_error_rate_objective_and_windows_age_out(self):
+        mon = SloMonitor()
+        mon.configure(ttft_ms=100.0, tpot_ms=10.0, budget=0.5,
+                      fast_s=5.0, slow_s=50.0, alert=1.5)
+        now = 3000.0
+        mon.record_request(ok=False, now=now)
+        mon.record_request(ok=True, now=now + 1)
+        rep = mon.report(now=now + 2)
+        err = rep["objectives"]["error_rate"]
+        assert err["fast"]["bad_fraction"] == pytest.approx(0.5)
+        # Past the fast window both samples are gone.
+        rep = mon.report(now=now + 30)
+        assert rep["objectives"]["error_rate"]["fast"]["n"] == 0
+        assert rep["objectives"]["error_rate"]["slow"]["n"] == 2
+
+    @pytest.mark.chaos
+    def test_slo_endpoint_moves_under_injected_latency(self, store):
+        """Acceptance drill: the fault plane injects per-token latency,
+        TTFT blows through a tight objective, /admin/slo burn rates move
+        from 0 to hot."""
+        master = _master(store, slo_ttft_ms=10000.0)
+        engine = _engine(store, delay_s=0.0)
+        try:
+            _await_fleet([master], [engine])
+            assert _completion(master) == REPLY
+            rep = requests.get(_base(master) + "/admin/slo",
+                               timeout=5).json()
+            assert rep["objectives"]["ttft"]["fast"]["bad"] == 0
+            # Tighten the target live, then inject latency ahead of the
+            # first token.
+            master.options.slo_ttft_ms = 1.0
+            from xllm_service_tpu.common.slo import SLO_MONITOR
+            SLO_MONITOR.ttft_target_ms = 1.0
+            FAULTS.configure([dict(point="engine.token", action="delay",
+                                   delay_s=0.2, max_fires=2)], seed=SEED)
+            assert _completion(master) == REPLY
+            rep = requests.get(_base(master) + "/admin/slo",
+                               timeout=5).json()
+            ttft = rep["objectives"]["ttft"]
+            assert ttft["fast"]["bad"] >= 1
+            assert ttft["fast"]["burn_rate"] > 1.0
+            # ... and the gauges rode along to /metrics.
+            text = requests.get(_base(master) + "/metrics", timeout=5).text
+            assert 'slo_burn_rate{objective="ttft",window="fast"}' in text
+        finally:
+            engine.stop()
+            master.stop()
+
+
+# ------------------------------------------------------- flight recorder
+class TestFlightRecorder:
+    def test_ring_capture_and_jsonl(self, tmp_path):
+        rec = FlightRecorder(capacity=4)
+        rec.configure(directory=str(tmp_path))
+        rec.add_context_provider("ctx", lambda: {"x": 1})
+        rec.add_context_provider("broken", lambda: 1 / 0)
+        with TRACER.span("scheduler.schedule", request_id="fr-1") as sp:
+            pass
+        rec.record("error", request_id="fr-1", trace_id=sp.trace_id,
+                   detail={"code": 503})
+        got = rec.recent()
+        assert len(got) == 1
+        b = got[0]
+        assert b["kind"] == "error" and b["detail"]["code"] == 503
+        assert b["ctx"] == {"x": 1}
+        assert "error" in b["broken"]            # provider failure inline
+        assert b["num_spans"] == 1
+        assert b["trace"][0]["point"] == "scheduler.schedule"
+        assert "hotpath" in b
+        lines = (tmp_path / "flightrecorder.jsonl").read_text().splitlines()
+        assert json.loads(lines[0])["kind"] == "error"
+        for i in range(10):
+            rec.record("failover", request_id=f"r{i}")
+        assert len(rec.recent(limit=50)) == 4    # bounded ring
+        rec.close()
+
+    def test_recent_filters_by_kind(self):
+        rec = FlightRecorder(capacity=8)
+        rec.record("error", request_id="a")
+        rec.record("failover", request_id="b")
+        assert [r["request_id"] for r in rec.recent(kind="failover")] == ["b"]
+
+    def test_failover_drill_captures_bundle(self, store):
+        """Engine dies mid-stream -> transparent failover -> the recorder
+        holds a 'failover' bundle with the dead instance, and the
+        /admin/flightrecorder/recent endpoint serves it."""
+        master = _master(store)
+        engines = [_engine(store), _engine(store)]
+        try:
+            _await_fleet([master], engines)
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=4, max_fires=1)], seed=SEED)
+            assert _stream(master) == REPLY
+            assert wait_until(
+                lambda: RECORDER.recent(kind="failover"), timeout=10)
+            flr = requests.get(
+                _base(master) + "/admin/flightrecorder/recent",
+                params={"kind": "failover"}, timeout=5).json()
+            assert flr["num_records"] >= 1
+            b = flr["records"][0]
+            dead = next(e for e in engines if not e._alive)
+            assert b["detail"]["dead_instance"] == dead.name
+            assert b["service"]["is_master"] is True
+            # The bundle froze the trace at anomaly time: the dead
+            # incarnation's spans are in it.
+            points = set()
+
+            def walk(nodes):
+                for n in nodes:
+                    points.add(n["point"])
+                    walk(n["children"])
+            walk(b.get("trace", []))
+            assert "frontend.request" in points or b["num_spans"] >= 1
+        finally:
+            for e in engines:
+                e.stop()
+            master.stop()
+
+
+# ------------------------------------------------------- tail sampling
+class TestTailSampling:
+    def test_sampled_out_clean_trace_drops(self):
+        tr = Tracer(capacity=64)
+        tr.configure(sample_rate=0.0)
+        sp = tr.start_span("frontend.request", request_id="clean-1")
+        sp.end()
+        # Pending (queryable by id) but not in the ring.
+        assert tr.query_trace(request_id="clean-1")[0] == 200
+        assert tr.query_recent()["traces"] == []
+        tr.drop_trace(sp.trace_id)
+        assert tr.query_trace(request_id="clean-1")[0] == 404
+
+    def test_anomalous_trace_promotes(self):
+        tr = Tracer(capacity=64)
+        tr.configure(sample_rate=0.0)
+        sp = tr.start_span("frontend.request", request_id="anom-1")
+        child = tr.start_span("scheduler.schedule", ctx=sp.context(),
+                              request_id="anom-1")
+        child.end()
+        sp.end()
+        tr.keep_trace(sp.trace_id)
+        recent = tr.query_recent()["traces"]
+        assert [r["request_id"] for r in recent] == ["anom-1"]
+        assert tr.query_trace(request_id="anom-1")[1]["num_spans"] == 2
+        # Late span of a kept trace goes straight to the ring.
+        late = tr.start_span("engine.decode", ctx=sp.context(),
+                             request_id="anom-1")
+        late.end()
+        assert tr.query_trace(request_id="anom-1")[1]["num_spans"] == 3
+
+    def test_rate_restored_to_one_still_settles_parked_traces(self):
+        """Raising trace_sample_rate back to 1.0 live must not strand
+        traces already parked in the pending buffer: their tail verdict
+        (keep OR drop) still lands."""
+        tr = Tracer(capacity=64)
+        tr.configure(sample_rate=0.0)
+        kept = tr.start_span("frontend.request", request_id="parked-keep")
+        kept.end()
+        dropped = tr.start_span("frontend.request", request_id="parked-drop")
+        dropped.end()
+        tr.configure(sample_rate=1.0)
+        tr.keep_trace(kept.trace_id)      # anomaly verdict -> ring
+        tr.drop_trace(dropped.trace_id)   # clean verdict -> gone
+        assert [r["request_id"] for r in tr.query_recent()["traces"]] \
+            == ["parked-keep"]
+        assert tr.query_trace(request_id="parked-drop")[0] == 404
+
+    def test_sampling_decision_is_deterministic_across_tracers(self):
+        a, b = Tracer(), Tracer()
+        a.configure(sample_rate=0.5)
+        b.configure(sample_rate=0.5)
+        ids = [f"trace-{i:04d}" for i in range(400)]
+        va = [a.is_sampled(t) for t in ids]
+        assert va == [b.is_sampled(t) for t in ids]
+        # Rate lands in the right ballpark.
+        assert 100 < sum(va) < 300
+
+    def test_e2e_sampled_out_kept_only_on_anomaly(self, store):
+        """sample_rate=0: a clean request leaves no queryable trace; a
+        crash-failover request ALWAYS records, engine spans included."""
+        master = _master(store, trace_sample_rate=0.0)
+        engines = [_engine(store), _engine(store)]
+        try:
+            _await_fleet([master], engines)
+            assert _stream(master) == REPLY
+            time.sleep(0.3)
+            assert requests.get(_base(master) + "/admin/trace/recent",
+                                timeout=5).json()["traces"] == []
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=4, max_fires=1)], seed=SEED)
+            assert _stream(master) == REPLY
+
+            def kept():
+                rows = requests.get(
+                    _base(master) + "/admin/trace/recent",
+                    timeout=5).json()["traces"]
+                return [r for r in rows
+                        if r["request_id"].startswith("completion-")]
+            assert wait_until(lambda: kept(), timeout=10)
+            sid = kept()[0]["request_id"]
+            got = requests.get(_base(master) + "/admin/trace",
+                               params={"request_id": sid}, timeout=5).json()
+            points = {s["point"] for s in got["spans"]}
+            assert {"frontend.request", "scheduler.failover",
+                    "engine.prefill"} <= points
+        finally:
+            for e in engines:
+                e.stop()
+            master.stop()
+
+
+# -------------------------------------------------- fleet trace federation
+class TestFleetTraceFederation:
+    @pytest.mark.chaos
+    def test_relayed_failed_over_request_one_tree(self, store):
+        """Acceptance drill: master + 2 engines + a request relayed
+        across 2 frontends that ALSO fails over mid-stream (engine crash)
+        -> `/admin/trace?scope=fleet` assembles ONE tree whose root is
+        the accepting frontend's relay span, containing the owner's
+        frontend.request, the failover attempt, and BOTH engines' spans;
+        every peer reports ok."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engines = [_engine(store), _engine(store)]
+        try:
+            _await_fleet([m1, m2], engines)
+            okey = _key_owned_by(m1.scheduler.ownership,
+                                 m2.scheduler.self_addr)
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=4, max_fires=1)], seed=SEED)
+            assert _stream(m1, okey=okey) == REPLY
+            assert wait_until(
+                lambda: requests.get(
+                    _base(m1) + "/admin/trace/recent",
+                    timeout=5).json()["traces"], timeout=10)
+            sid = _latest_sid(m1)
+
+            def fleet_has_failover():
+                doc = _fleet_trace(m1, request_id=sid).json()
+                pts = {s["point"] for s in doc.get("spans", ())}
+                return "scheduler.failover" in pts
+            assert wait_until(fleet_has_failover, timeout=10)
+            got = _fleet_trace(m1, request_id=sid)
+            assert got.status_code == 200, got.text
+            doc = got.json()
+            assert doc["scope"] == "fleet"
+            # Every engine + the peer frontend was consulted. The crashed
+            # engine's port is dead, so its marker may be non-ok — but
+            # the peer frontend and the surviving engine answered.
+            roles = {a: p["role"] for a, p in doc["peers"].items()}
+            assert roles[m2.scheduler.self_addr] == "frontend"
+            assert sum(1 for r in roles.values() if r == "engine") >= 1
+            assert doc["peers"][m2.scheduler.self_addr]["status"] in (
+                "ok", "no_spans")
+            # ONE tree: the relay's root; owner + engines inside it.
+            assert len(doc["tree"]) == 1
+            root = doc["tree"][0]
+            assert root["point"] == "frontend.request"
+            assert root["attrs"].get("relay") is True
+
+            points = set()
+
+            def walk(nodes):
+                for n in nodes:
+                    points.add(n["point"])
+                    walk(n["children"])
+            walk(doc["tree"])
+            assert {"frontend.request", "scheduler.schedule",
+                    "scheduler.failover", "engine.prefill",
+                    "engine.decode"} <= points
+            # Both incarnations: prefill ran on both engines.
+            prefills = [s for s in doc["spans"]
+                        if s["point"] == "engine.prefill"]
+            assert len({s["instance"] for s in prefills}) == 2
+            # Dedup: merged spans are unique by span_id.
+            ids = [s["span_id"] for s in doc["spans"]]
+            assert len(ids) == len(set(ids)) == doc["num_spans"]
+        finally:
+            for e in engines:
+                e.stop()
+            m1.stop()
+            m2.stop()
+
+    def test_foreign_peer_spans_are_merged(self, store):
+        """A peer's spans that THIS process never recorded appear in the
+        fleet view (true network federation, not shared memory)."""
+        master = _master(store)
+        engine = _engine(store)
+        peer = _SpanPeer()
+        coord = InMemoryCoordination(store)
+        try:
+            _await_fleet([master], [engine])
+            assert _completion(master) == REPLY
+            sid = _latest_sid(master)
+            local = requests.get(_base(master) + "/admin/trace",
+                                 params={"request_id": sid},
+                                 timeout=5).json()
+            tid = local["trace_id"]
+            root = next(s for s in local["spans"]
+                        if s["point"] == "frontend.request")
+            # The foreign peer holds an extra span of the same trace.
+            from xllm_service_tpu.common.tracing import TraceContext
+            fsp = peer.tracer.start_span(
+                "kv_transfer.pull",
+                ctx=TraceContext(trace_id=tid, span_id=root["span_id"]),
+                request_id=sid, instance="foreign-peer")
+            fsp.end()
+            # Register the peer as a service member -> fleet target.
+            coord.set(SERVICE_KEY_PREFIX + peer.addr,
+                      json.dumps({"rpc_address": peer.addr}))
+            assert wait_until(
+                lambda: peer.addr in master.scheduler.ownership.members(),
+                timeout=5)
+            doc = _fleet_trace(master, trace_id=tid).json()
+            assert doc["peers"][peer.addr]["status"] == "ok"
+            foreign = [s for s in doc["spans"]
+                       if s["instance"] == "foreign-peer"]
+            assert len(foreign) == 1
+            assert foreign[0]["parent_span_id"] == root["span_id"]
+            # ... and it hangs under the local root in the merged tree.
+            assert len(doc["tree"]) == 1
+        finally:
+            coord.rm(SERVICE_KEY_PREFIX + peer.addr)
+            peer.stop()
+            engine.stop()
+            master.stop()
+
+    @pytest.mark.chaos
+    def test_dead_agent_partial_marker(self, store):
+        """Kill one agent: the fleet query still answers 200 with the
+        survivors' spans and a non-ok marker for the dead peer."""
+        # Slow eviction so the dead agent stays a fan-out target.
+        master = _master(store,
+                         heartbeat_silence_to_suspect_s=3.0,
+                         detect_disconnected_instance_interval_s=30.0,
+                         fleet_peer_timeout_s=1.0)
+        engines = [_engine(store), _engine(store)]
+        try:
+            _await_fleet([master], engines)
+            assert _completion(master) == REPLY
+            sid = _latest_sid(master)
+            victim = next(e for e in engines
+                          if any(s["instance"] == e.name for s in
+                                 requests.get(
+                                     _base(master) + "/admin/trace",
+                                     params={"request_id": sid},
+                                     timeout=5).json()["spans"]
+                                 if s["point"].startswith("engine.")))
+            victim.kill()
+            time.sleep(0.2)
+            doc = _fleet_trace(master, request_id=sid)
+            assert doc.status_code == 200, doc.text
+            doc = doc.json()
+            status = doc["peers"][victim.name]["status"]
+            assert status not in ("ok", "no_spans"), doc["peers"]
+            # The view degraded (the dead agent's engine spans came from
+            # the shared in-process store here, but the endpoint itself
+            # stayed partial-not-erroring) and still has ONE root.
+            assert len(doc["tree"]) == 1
+        finally:
+            for e in engines:
+                e.stop()
+            master.stop()
+
+
+# ------------------------------------------------- fleet metrics federation
+class TestFleetMetrics:
+    def test_relabel_prometheus_text(self):
+        text = ("# TYPE x_total counter\n"
+                "x_total 3.0\n"
+                'y_ms{instance="e1",phase="p"} 1.5\n'
+                "garbage line\n")
+        out = relabel_prometheus_text(text, "10.0.0.1:99", "frontend")
+        assert ('x_total{instance="10.0.0.1:99",role="frontend"} 3.0'
+                in out)
+        # Pre-existing instance label survives as exported_instance.
+        assert ('y_ms{exported_instance="e1",phase="p",'
+                'instance="10.0.0.1:99",role="frontend"} 1.5') in out
+        assert "garbage" not in out
+        assert "# TYPE x_total counter" in out
+
+    def test_fleet_scrape_merges_and_survives_dead_agent(self, store):
+        master = _master(store,
+                         heartbeat_silence_to_suspect_s=3.0,
+                         detect_disconnected_instance_interval_s=30.0,
+                         fleet_peer_timeout_s=1.0)
+        m2 = _master(store,
+                     heartbeat_silence_to_suspect_s=3.0,
+                     detect_disconnected_instance_interval_s=30.0)
+        engines = [_engine(store), _engine(store)]
+        try:
+            _await_fleet([master, m2], engines)
+            assert _completion(master) == REPLY
+            text = requests.get(_base(master) + "/metrics/fleet",
+                                timeout=15).text
+            # Engine series re-labeled by instance/role.
+            for e in engines:
+                assert (f'engine_running_requests{{instance="{e.name}",'
+                        f'role="engine"}}') in text
+            # Peer frontend series present, labeled frontend.
+            peer_addr = m2.scheduler.self_addr
+            assert f'instance="{peer_addr}",role="frontend"' in text
+            # Master's own per-engine series keep their original label as
+            # exported_instance (no duplicate 'instance' key).
+            assert "exported_instance=" in text
+            # Kill an agent: scrape stays 200, dead target marked down.
+            engines[0].kill()
+            time.sleep(0.2)
+            r = requests.get(_base(master) + "/metrics/fleet", timeout=15)
+            assert r.status_code == 200
+            assert (f'fleet_scrape_up{{instance="{engines[0].name}",'
+                    f'role="engine"}} 0') in r.text
+            assert (f'fleet_scrape_up{{instance="{engines[1].name}",'
+                    f'role="engine"}} 1') in r.text
+        finally:
+            for e in engines:
+                e.stop()
+            master.stop()
+            m2.stop()
+
+    def test_fleet_scrape_ttl_cache(self, store):
+        master = _master(store, metrics_fleet_cache_ttl_s=30.0)
+        engine = _engine(store)
+        try:
+            _await_fleet([master], [engine])
+            t1 = requests.get(_base(master) + "/metrics/fleet",
+                              timeout=15).text
+            engine.kill()   # within the TTL the cached merge still serves
+            t2 = requests.get(_base(master) + "/metrics/fleet",
+                              timeout=15).text
+            assert t1 == t2
+        finally:
+            engine.stop()
+            master.stop()
+
+
+# ------------------------------------------- owner-kill flight-record drill
+class TestOwnerKillDrill:
+    pytestmark = pytest.mark.chaos
+
+    def test_owner_kill_captures_handoff_recovery(self, store):
+        """The multimaster owner-kill drill is self-documenting now: the
+        relay's re-ownership lands a handoff_recovery bundle in the
+        flight recorder (chaos_soak.sh --obs asserts this leg)."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store, delay_s=0.05)
+        reaper = None
+        try:
+            _await_fleet([m1, m2], [engine])
+            okey = _key_owned_by(m1.scheduler.ownership,
+                                 m2.scheduler.self_addr)
+            holder = {}
+
+            def kill_owner():
+                holder["t"] = m2.kill()
+
+            text = _stream(m1, okey=okey, after_frames=3, hook=kill_owner)
+            reaper = holder.get("t")
+            assert text == REPLY     # stream completed on the survivor
+            recs = RECORDER.recent(kind="handoff_recovery")
+            assert recs, "owner-kill drill captured no recovery bundle"
+            b = recs[0]
+            assert b["detail"]["dead_owner"] == m2.scheduler.self_addr
+            assert b["detail"]["successor"] == m1.scheduler.self_addr
+        finally:
+            if reaper is not None:
+                reaper.join(timeout=10)
+            engine.stop()
+            m1.stop()
+            m2.stop()
+
+
+# ------------------------------------------------ agent series eviction
+class TestAgentSeriesEviction:
+    class _Req:
+        def __init__(self, body):
+            self._body = body
+
+        async def json(self):
+            return self._body
+
+    def test_unlink_evicts_peer_series(self):
+        ENGINE_PEER_LINKED.labels(peer="p1:1").set(1)
+        ENGINE_PEER_LINKED.labels(peer="p2:2").set(1)
+        agent = SimpleNamespace(linked_peers={"p1:1": object(),
+                                              "p2:2": object()})
+        resp = asyncio.run(EngineAgent._h_unlink(
+            agent, self._Req({"peer_name": "p1:1"})))
+        assert resp.status == 200
+        text = ENGINE_PEER_LINKED.render()
+        assert 'peer="p1:1"' not in text
+        assert 'peer="p2:2"' in text
+        # Unknown peer: no-op, nothing re-created.
+        asyncio.run(EngineAgent._h_unlink(
+            agent, self._Req({"peer_name": "nope"})))
+        assert 'peer="nope"' not in ENGINE_PEER_LINKED.render()
+        ENGINE_PEER_LINKED.remove(peer="p2:2")
+
+    def test_master_change_evicts_heartbeat_series(self):
+        from xllm_service_tpu.rpc import wire
+        ENGINE_HEARTBEATS_TOTAL.labels(master="old:1").inc(5)
+        agent = SimpleNamespace(_hb_master="old:1",
+                                _hb_wire=wire.WIRE_JSON)
+        EngineAgent._note_master(agent, "new:2")
+        assert agent._hb_master == "new:2"
+        # Wire re-probes msgpack against the new master...
+        assert agent._hb_wire == wire.WIRE_MSGPACK
+        # ...and the dead master's labeled series is gone.
+        assert 'master="old:1"' not in ENGINE_HEARTBEATS_TOTAL.render()
+        # Same master again: no churn.
+        EngineAgent._note_master(agent, "new:2")
+        ENGINE_HEARTBEATS_TOTAL.remove(master="new:2")
+
+
+# --------------------------------------------------------- bench trend
+class TestBenchTrend:
+    def _run(self, root: Path, *args):
+        return subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "bench_trend.py"),
+             "--root", str(root), *args],
+            capture_output=True, text=True)
+
+    def test_regression_fails(self, tmp_path):
+        (tmp_path / "BENCH_hotpath_r06.json").write_text(json.dumps(
+            {"headline": {"sustained_req_per_s_conc8": {"after": 17.3}}}))
+        (tmp_path / "BENCH_hotpath_r10.json").write_text(json.dumps(
+            {"headline": {"sustained_req_per_s_conc8": {"after": 12.0}}}))
+        r = self._run(tmp_path)
+        assert r.returncode == 1, r.stdout
+        assert "FAIL" in r.stdout
+        assert "sustained_req_per_s_conc8" in r.stdout
+
+    def test_improvement_and_small_drift_pass(self, tmp_path):
+        (tmp_path / "BENCH_kvtier_r09.json").write_text(json.dumps(
+            {"tier_ttft": {"warm_vs_cold_speedup": 3.56},
+             "capacity": {"capacity_multiplier": 3.73},
+             "step_latency": {"delta_p50_perc": 0.58}}))
+        (tmp_path / "BENCH_kvtier_r11.json").write_text(json.dumps(
+            {"tier_ttft": {"warm_vs_cold_speedup": 3.40},   # -4.5%: ok
+             "capacity": {"capacity_multiplier": 4.1},      # better
+             "step_latency": {"delta_p50_perc": 0.60}}))
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout
+
+    def test_pct_headline_judged_in_absolute_points(self, tmp_path):
+        # A noise-floor baseline (even negative) must not disarm the
+        # tripwire: +15 points of tracing overhead fails ...
+        (tmp_path / "BENCH_tracing_r10.json").write_text(json.dumps(
+            {"headline": {"ring_overhead_p50_pct": -7.2}}))
+        (tmp_path / "BENCH_tracing_r12.json").write_text(json.dumps(
+            {"headline": {"ring_overhead_p50_pct": 8.0}}))
+        r = self._run(tmp_path)
+        assert r.returncode == 1
+        assert "ring_overhead_p50_pct" in r.stdout
+        # ... while drift inside the threshold (points, not relative —
+        # -7.2 -> -0.5 is +1300% relative but only +6.7 points) passes.
+        (tmp_path / "BENCH_tracing_r12.json").write_text(json.dumps(
+            {"headline": {"ring_overhead_p50_pct": -0.5}}))
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout
+
+    def test_single_round_and_missing_paths_are_not_errors(self, tmp_path):
+        (tmp_path / "BENCH_kvcache_r07.json").write_text(json.dumps(
+            {"index": {"match_new": {"throughput_1t_per_s": 57444.5}}}))
+        (tmp_path / "BENCH_solo_r01.json").write_text(json.dumps({}))
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout
+        assert "nothing to diff" in r.stdout
+
+    def test_real_repo_artifacts_pass(self):
+        r = self._run(REPO)
+        assert r.returncode == 0, r.stdout

@@ -94,6 +94,42 @@ class ServiceOptions:
     # mirrored to the RequestTracer JSONL when enable_request_trace is on.
     enable_tracing: bool = True
     trace_span_capacity: int = 2048
+    # Head sampling with tail-based keep (common/tracing.py): the fraction
+    # of traces recorded into the queryable ring. Sampled-out traces park
+    # in a bounded pending buffer and are PROMOTED whenever the request
+    # ends anomalously (failover, error, SLO breach) — so always-on
+    # tracing stays viable at high QPS without losing the traces worth
+    # debugging. 1.0 = record everything (default).
+    trace_sample_rate: float = 1.0
+    # --- fleet observability plane (docs/observability.md) ---
+    # Per-peer deadline for /admin/trace?scope=fleet and /metrics/fleet
+    # fan-out: a dead agent degrades the view (partial-result marker),
+    # never the endpoint.
+    fleet_peer_timeout_s: float = 2.0
+    # Bounded fan-out concurrency for fleet scrapes/queries.
+    fleet_scrape_concurrency: int = 8
+    # /metrics/fleet TTL cache: scrape storms against the fleet endpoint
+    # hit the cache, not every engine.
+    metrics_fleet_cache_ttl_s: float = 2.0
+    # SLO objectives for the burn-rate monitor (common/slo.py): a TTFT/
+    # TPOT observation over its target — or a failed request — burns
+    # error budget; budget is the allowed bad fraction. Burn rates are
+    # tracked over a fast and a slow rolling window (Google-SRE
+    # multi-window multi-burn-rate) and served at /admin/slo + /metrics.
+    slo_ttft_ms: float = 1000.0
+    slo_tpot_ms: float = 50.0
+    slo_error_budget: float = 0.01
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    # Burn-rate alert threshold: an objective is "breaching" when BOTH
+    # windows burn at or above this multiple of budget-neutral pace.
+    slo_burn_alert: float = 14.4
+    # Anomaly flight recorder (common/flightrecorder.py): bounded ring of
+    # post-mortem bundles (trace tree + hotpath stages + load snapshot)
+    # captured on SLO breach / failover / error / KV-stream fallback.
+    flightrecorder_capacity: int = 64
+    # JSONL dump directory ("" = in-memory ring only).
+    flightrecorder_dir: str = ""
     debug_log: bool = field(
         default_factory=lambda: os.environ.get("ENABLE_XLLM_DEBUG_LOG", "") not in ("", "0", "false"))
     # --- multi-master service plane (multimaster/) ---

@@ -1,0 +1,183 @@
+"""Anomaly flight recorder: bounded post-mortem bundles for requests
+that went wrong.
+
+When a request breaches its SLO, fails over, errors out, or a KV stream
+falls back to the inline path, the operator's first questions are always
+the same: what did this request's trace look like, what were the hot-path
+stages doing, and what state was the fleet in *at that moment*? By the
+time a human queries `/admin/trace`, the evidence has often aged out of
+the rings. The flight recorder snapshots it at anomaly time:
+
+- the request's assembled span list/tree (from the per-process
+  ``SpanStore``, including tail-sampled pending spans — anomalies always
+  record, see ``Tracer.keep_trace``),
+- the always-on hot-path stage percentiles (``common/hotpath.py``),
+- whatever context the hosting process registered (the master registers
+  load-info ages + ownership stats; the engine agent registers its
+  tier/transfer stats),
+
+into a bounded ring served at ``GET /admin/flightrecorder/recent`` and
+optionally appended to ``flightrecorder.jsonl`` (``flightrecorder_dir``
+option) — chaos drills become self-documenting.
+
+Recording runs on the caller's thread but never under a scheduler lock
+(call sites sit on exit paths after locks release); the ring append is a
+leaf-lock deque push, and the JSONL write is line-buffered append.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ..devtools.locks import make_lock
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+#: Anomaly kinds (stable API: ring records, JSONL, and the
+#: flight_records_total{kind} counter use these values).
+KINDS = ("slo_breach", "failover", "error", "kv_stream_fallback",
+         "handoff_recovery")
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 64):
+        self._lock = make_lock("flightrecorder.ring", order=818)  # lock-order: 818
+        self._ring: deque[dict[str, Any]] = deque(maxlen=max(1, capacity))
+        self._path: Optional[str] = None
+        self._file = None
+        self._file_lock = threading.Lock()   # lock-order: 819
+        # Context providers: name -> zero-arg callable returning a JSON-
+        # able snapshot, captured into every bundle. Provider errors are
+        # recorded in place of their value, never raised.
+        self._context: dict[str, Callable[[], Any]] = {}
+
+    def configure(self, capacity: Optional[int] = None,
+                  directory: Any = "__unset__") -> None:
+        with self._lock:
+            if capacity is not None and capacity != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=max(1, capacity))
+        if directory != "__unset__":
+            with self._file_lock:
+                if self._file is not None:
+                    self._file.close()
+                    self._file = None
+                self._path = (os.path.join(directory, "flightrecorder.jsonl")
+                              if directory else None)
+
+    def add_context_provider(self, name: str,
+                             fn: Callable[[], Any]) -> None:
+        self._context[name] = fn
+
+    def remove_context_provider(self, name: str,
+                                fn: Optional[Callable[[], Any]] = None
+                                ) -> None:
+        """Deregister a provider at owner shutdown. With `fn`, removes
+        only if the slot still holds that callable — a newer owner of the
+        same name (tests build several masters per process) keeps its
+        registration when an older one stops."""
+        # == not `is`: bound methods are fresh objects per attribute
+        # access but compare equal on (func, self).
+        if fn is None or self._context.get(name) == fn:
+            self._context.pop(name, None)
+
+    # ------------------------------------------------------------ recording
+    def record(self, kind: str, request_id: str = "", trace_id: str = "",
+               detail: Optional[dict[str, Any]] = None) -> dict[str, Any]:
+        """Capture one anomaly bundle. Never raises: a failed capture
+        logs and records whatever it got."""
+        from .hotpath import HOTPATH
+        from .metrics import FLIGHT_RECORDS_TOTAL
+        from .tracing import TRACER, span_tree
+
+        bundle: dict[str, Any] = {
+            "ts_ms": time.time() * 1000.0,
+            "kind": kind,
+            "request_id": request_id,
+            "trace_id": trace_id,
+            "detail": dict(detail or {}),
+        }
+        try:
+            if not trace_id and request_id:
+                trace_id = TRACER.store.trace_id_for_request(
+                    request_id) or ""
+                bundle["trace_id"] = trace_id
+            if trace_id:
+                spans = TRACER.store.trace(trace_id)
+                bundle["num_spans"] = len(spans)
+                bundle["trace"] = span_tree(spans)
+            bundle["hotpath"] = HOTPATH.summary()
+            for name, fn in list(self._context.items()):
+                try:
+                    bundle[name] = fn()
+                except Exception as e:  # noqa: BLE001 — a broken provider must not lose the bundle
+                    bundle[name] = {"error": str(e)}
+        except Exception:  # noqa: BLE001 — capture is best-effort by contract
+            logger.exception("flight-recorder capture failed (%s)", kind)
+        FLIGHT_RECORDS_TOTAL.labels(kind=kind).inc()
+        with self._lock:
+            self._ring.append(bundle)
+        self._dump(bundle)
+        return bundle
+
+    def _dump(self, bundle: dict[str, Any]) -> None:
+        if self._path is None:
+            return
+        try:
+            # default=str: bundles embed arbitrary span attrs / provider
+            # output (bytes, enums, numpy scalars) — a non-serializable
+            # leaf must degrade to its repr, never break record()'s
+            # never-raises contract on the request-exit path.
+            line = json.dumps(bundle, default=str)
+            with self._file_lock:
+                if self._file is None:
+                    os.makedirs(os.path.dirname(self._path), exist_ok=True)
+                    self._file = open(self._path, "a", buffering=1)
+                self._file.write(line + "\n")
+        except (OSError, TypeError, ValueError):
+            logger.exception("flight-recorder JSONL append failed")
+
+    # -------------------------------------------------------------- reading
+    def recent(self, limit: int = 20,
+               kind: str = "") -> list[dict[str, Any]]:
+        with self._lock:
+            records = list(self._ring)
+        if kind:
+            records = [r for r in records if r.get("kind") == kind]
+        return records[-max(0, int(limit)):][::-1]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def close(self) -> None:
+        with self._file_lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+#: Process-global recorder (master and engine agent each configure their
+#: own process's instance and register their context providers).
+RECORDER = FlightRecorder()
+
+
+async def handle_flightrecorder_recent(request):
+    """Shared aiohttp handler: ``GET /admin/flightrecorder/recent
+    [?limit=N&kind=...]`` — newest first."""
+    from aiohttp import web
+
+    try:
+        limit = int(request.query.get("limit", 20))
+    except ValueError:
+        return web.json_response({"error": "limit must be an integer"},
+                                 status=400)
+    records = RECORDER.recent(limit=limit,
+                              kind=request.query.get("kind", ""))
+    return web.json_response({"num_records": len(records),
+                              "records": records})

@@ -19,6 +19,7 @@ bucket/sum/count, family child sets) are not, so everything reads locked.
 
 from __future__ import annotations
 
+import re as _re
 import threading
 from bisect import bisect_right
 from typing import Any, Iterable, Optional
@@ -246,6 +247,56 @@ class Histogram(_Metric):
         return "".join(out)
 
 
+def relabel_prometheus_text(text: str, instance: str, role: str,
+                            strip_comments: bool = False) -> str:
+    """Stamp fleet-target labels onto a scraped Prometheus exposition
+    (the /metrics/fleet merge): every sample line gains
+    ``instance="<addr>",role="frontend|engine"``. A series that already
+    carries an ``instance`` label (the master's per-engine series) keeps
+    it as ``exported_instance`` — the same collision rule Prometheus
+    federation applies with honor_labels=false. Comment/blank lines pass
+    through (or are dropped with ``strip_comments`` — the fleet merge
+    strips foreign sources' ``# TYPE`` lines, which would duplicate);
+    unparseable lines are dropped rather than corrupting the merged
+    exposition."""
+    extra = (f'instance="{_escape_label_value(instance)}",'
+             f'role="{_escape_label_value(role)}"')
+
+    def _is_value(v: str) -> bool:
+        try:
+            float(v.split()[0])
+            return True
+        except (ValueError, IndexError):
+            return False
+
+    out: list[str] = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            if line and not strip_comments:
+                out.append(line)
+            continue
+        brace = line.find("{")
+        if brace < 0:
+            # name value [ts]
+            parts = line.split(None, 1)
+            if len(parts) != 2 or not _is_value(parts[1]):
+                continue
+            out.append(f"{parts[0]}{{{extra}}} {parts[1]}")
+            continue
+        close = line.rfind("}")
+        if close < brace:
+            continue
+        name, labels, rest = line[:brace], line[brace + 1:close], \
+            line[close + 1:].lstrip()
+        if not rest or not _is_value(rest):
+            continue
+        labels = _re.sub(r"(^|,)instance=", r"\1exported_instance=",
+                         labels)
+        inner = f"{labels},{extra}" if labels else extra
+        out.append(f"{name}{{{inner}}} {rest}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: dict[str, _Metric] = {}
@@ -342,6 +393,57 @@ REQUESTS_CANCELLED_ON_FAILURE_TOTAL = REGISTRY.counter(
     "requests_cancelled_on_failure_total",
     "Requests surfaced as errors after instance failure "
     "(failover disabled, budget exhausted, or no payload to replay)")
+
+# Fleet observability plane (docs/observability.md): locally-exported
+# control-plane freshness gauges (previously visible only as
+# /admin/hotpath JSON), the SLO burn-rate surface (common/slo.py), and
+# the anomaly flight recorder's capture counter. The freshness gauges
+# are refreshed at scrape time by the /metrics handler — no background
+# thread.
+ROUTING_SNAPSHOT_AGE_SECONDS = REGISTRY.gauge(
+    "routing_snapshot_age_seconds",
+    "Age of the published RCU routing snapshot (how stale this "
+    "frontend's lock-free fleet view is)")
+LOADINFO_MAX_AGE_SECONDS = REGISTRY.gauge(
+    "loadinfo_max_age_seconds",
+    "Age of the stalest per-instance load-info entry (-1 = never "
+    "updated)")
+LOADINFO_STALE_INSTANCES = REGISTRY.gauge(
+    "loadinfo_stale_instances",
+    "Instances whose load telemetry is older than loadinfo_stale_after_s "
+    "(relative staleness: 0 when all entries are equally stale)")
+KVCACHE_FRAME_LOG_SEQ = REGISTRY.gauge(
+    "kvcache_frame_log_seq",
+    "Next coordination KV-index frame-log sequence number (replicas "
+    "lagging this have not applied the newest frames)")
+PLANNER_SCALE_HINT = REGISTRY.gauge(
+    "planner_scale_hint",
+    "Latest planner scale decision (positive = add instances, negative "
+    "= remove; hint for an external autoscaler)")
+SLO_BURN_RATE = REGISTRY.gauge(
+    "slo_burn_rate",
+    "Error-budget burn rate per objective and rolling window "
+    "(1.0 = budget-neutral pace; see /admin/slo)",
+    labelnames=("objective", "window"))
+FLIGHT_RECORDS_TOTAL = REGISTRY.counter(
+    "flight_records_total",
+    "Anomaly bundles captured by the flight recorder",
+    labelnames=("kind",))
+
+# Engine-agent-side labeled series (the agent's /metrics appends the
+# registry render to its hand-rolled engine_* text). Both are evicted
+# when their label subject goes away — ENGINE_PEER_LINKED on PD unlink,
+# ENGINE_HEARTBEATS_TOTAL when the master changes — mirroring the
+# master's evicted-instance series eviction (instance_mgr), so a
+# long-lived engine doesn't grow /metrics without bound.
+ENGINE_PEER_LINKED = REGISTRY.gauge(
+    "engine_peer_linked",
+    "PD peers currently linked to this engine agent (1 per live link)",
+    labelnames=("peer",))
+ENGINE_HEARTBEATS_TOTAL = REGISTRY.counter(
+    "engine_heartbeats_total",
+    "Heartbeats this agent pushed, by destination master",
+    labelnames=("master",))
 
 # Multi-master service plane (multimaster/): ownership handoffs between
 # active frontends and owner-death recoveries. `owner` is the TARGET

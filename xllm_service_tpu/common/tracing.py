@@ -36,6 +36,7 @@ import time
 import uuid
 from collections import OrderedDict, deque
 from dataclasses import dataclass
+from hashlib import blake2b
 from typing import Any, Callable, Optional
 
 from ..devtools.locks import make_lock
@@ -279,7 +280,15 @@ NOOP_SPAN = _NoopSpan()
 class SpanStore:
     """Bounded per-process ring of finished spans, indexed by trace_id and
     request_id. Eviction is strictly FIFO over spans; a trace disappears
-    from the index once its last span is evicted."""
+    from the index once its last span is evicted.
+
+    Tail sampling support: spans of sampled-out traces park in a bounded
+    side buffer (`_pending`, whole traces, FIFO evicted) instead of the
+    ring. `promote()` moves a pending trace into the ring (the request
+    ended anomalously — failover/error/SLO breach always record);
+    `drop()` discards it (clean exit). Pending spans stay queryable by
+    trace_id until evicted, so a live sampled-out request can still be
+    debugged."""
 
     def __init__(self, capacity: int = 2048):
         self.capacity = max(1, int(capacity))
@@ -288,6 +297,9 @@ class SpanStore:
         self._by_trace: dict[str, list[Span]] = {}
         # request_id -> trace_id, insertion-ordered for bounded eviction.
         self._req_index: OrderedDict[str, str] = OrderedDict()
+        # Sampled-out traces awaiting their tail-based keep/drop verdict.
+        self._pending: OrderedDict[str, list[Span]] = OrderedDict()
+        self._pending_traces_cap = max(16, self.capacity // 4)
 
     def add(self, span: Span) -> None:
         with self._lock:
@@ -309,9 +321,37 @@ class SpanStore:
                     if not spans:
                         self._by_trace.pop(old.trace_id, None)
 
+    def add_pending(self, span: Span) -> None:
+        """Park a sampled-out trace's span pending the tail verdict."""
+        with self._lock:
+            spans = self._pending.get(span.trace_id)
+            if spans is None:
+                spans = self._pending[span.trace_id] = []
+                while len(self._pending) > self._pending_traces_cap:
+                    self._pending.popitem(last=False)
+            spans.append(span)
+            if span.request_id:
+                self._req_index[span.request_id] = span.trace_id
+                self._req_index.move_to_end(span.request_id)
+                while len(self._req_index) > 4 * self.capacity:
+                    self._req_index.popitem(last=False)
+
+    def promote(self, trace_id: str) -> None:
+        """Tail-based keep: move a pending trace into the ring."""
+        with self._lock:
+            spans = self._pending.pop(trace_id, None)
+        for s in spans or ():
+            self.add(s)
+
+    def drop(self, trace_id: str) -> None:
+        """Tail-based drop: the request ended cleanly; discard."""
+        with self._lock:
+            self._pending.pop(trace_id, None)
+
     def trace(self, trace_id: str) -> list[dict[str, Any]]:
         with self._lock:
             spans = list(self._by_trace.get(trace_id, ()))
+            spans += self._pending.get(trace_id, ())
         return [s.to_dict() for s in sorted(spans, key=lambda s: s.start_ms)]
 
     def trace_id_for_request(self, request_id: str) -> Optional[str]:
@@ -350,6 +390,7 @@ class SpanStore:
             self._ring.clear()
             self._by_trace.clear()
             self._req_index.clear()
+            self._pending.clear()
 
 
 def span_tree(spans: list[dict[str, Any]]) -> list[dict[str, Any]]:
@@ -370,22 +411,76 @@ def span_tree(spans: list[dict[str, Any]]) -> list[dict[str, Any]]:
 class Tracer:
     """Process-global tracer façade. `enabled=False` turns every span call
     into a no-op; `mirror` (optional callable taking the span dict) lets
-    the HTTP layer tee finished spans into the RequestTracer JSONL."""
+    the HTTP layer tee finished spans into the RequestTracer JSONL.
+
+    `sample_rate` < 1.0 enables head sampling with a tail-based keep: the
+    keep decision is a deterministic hash of the trace_id (so every
+    process of the fleet samples the SAME traces without coordination),
+    sampled-out spans park in the store's pending buffer, and
+    `keep_trace()` (called by the request-exit path on failover / error /
+    SLO breach) promotes them into the queryable ring — anomalies always
+    record; `drop_trace()` discards a clean exit."""
 
     def __init__(self, capacity: int = 2048):
         self.enabled = True
+        self.sample_rate = 1.0
         self.store = SpanStore(capacity)
         self._mirror: Optional[Callable[[dict[str, Any]], None]] = None
+        # Traces force-kept by a tail decision: later spans of the same
+        # trace (e.g. an engine decode ending after the promote) go
+        # straight to the ring. Bounded ordered set.
+        self._kept: OrderedDict[str, None] = OrderedDict()
 
     def configure(self, enabled: Optional[bool] = None,
                   capacity: Optional[int] = None,
-                  mirror: Any = "__unset__") -> None:
+                  mirror: Any = "__unset__",
+                  sample_rate: Optional[float] = None) -> None:
         if enabled is not None:
             self.enabled = enabled
         if capacity is not None and capacity != self.store.capacity:
             self.store = SpanStore(capacity)
         if mirror != "__unset__":
             self._mirror = mirror
+        if sample_rate is not None:
+            self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
+
+    # ------------------------------------------------------- tail sampling
+    def is_sampled(self, trace_id: str) -> bool:
+        """Deterministic head-sampling verdict for a trace. Hash-based so
+        every process in the fleet agrees from the trace_id alone."""
+        rate = self.sample_rate
+        if rate >= 1.0:
+            return True
+        if rate <= 0.0:
+            return False
+        h = int.from_bytes(blake2b(trace_id.encode(),
+                                   digest_size=8).digest(), "big")
+        return (h % 1_000_000) < rate * 1_000_000
+
+    def keep_trace(self, trace_id: str) -> None:
+        """Tail-based keep: the request ended in failover/error/SLO
+        breach — promote its pending spans and record future ones."""
+        if not trace_id:
+            return
+        if self.sample_rate >= 1.0 and not self.store._pending:
+            # Fast path at full sampling (everything records directly) —
+            # but a NON-empty pending buffer means the rate was just
+            # raised live: parked traces must still get their verdict,
+            # or they'd be stranded in memory forever.
+            return
+        self._kept[trace_id] = None
+        while len(self._kept) > 1024:
+            self._kept.popitem(last=False)
+        self.store.promote(trace_id)
+
+    def drop_trace(self, trace_id: str) -> None:
+        """Tail-based drop: clean exit of a sampled-out trace."""
+        if not trace_id:
+            return
+        if self.sample_rate >= 1.0 and not self.store._pending:
+            return   # fast path; see keep_trace
+        if trace_id not in self._kept:
+            self.store.drop(trace_id)
 
     def start_span(self, point: str, ctx: Optional[TraceContext] = None,
                    request_id: str = "", instance: str = "",
@@ -404,7 +499,11 @@ class Tracer:
     span = start_span
 
     def _record(self, span: Span) -> None:
-        self.store.add(span)
+        if self.sample_rate >= 1.0 or span.trace_id in self._kept \
+                or self.is_sampled(span.trace_id):
+            self.store.add(span)
+        else:
+            self.store.add_pending(span)
         mirror = self._mirror
         if mirror is not None:
             try:
@@ -446,25 +545,50 @@ class Tracer:
 TRACER = Tracer()
 
 
+def merge_fleet_spans(span_lists: list[list[dict[str, Any]]]
+                      ) -> list[dict[str, Any]]:
+    """Merge per-process span dicts for ONE trace into a single ordered
+    list, deduped by span_id (fleet fan-out targets may overlap — e.g. an
+    in-process engine sharing the frontend's store)."""
+    seen: dict[str, dict[str, Any]] = {}
+    for spans in span_lists:
+        for s in spans:
+            sid = s.get("span_id", "")
+            if sid and sid not in seen:
+                seen[sid] = s
+    return sorted(seen.values(), key=lambda s: s.get("start_ms", 0.0))
+
+
+def make_trace_handlers(tracer: "Tracer"):
+    """aiohttp handlers bound to a specific tracer instance (tests spin up
+    standalone peer span-servers this way). Returns
+    ``(handle_trace, handle_trace_recent)``."""
+
+    async def handle_trace(request):
+        from aiohttp import web
+
+        status, payload = tracer.query_trace(
+            request_id=request.query.get("request_id", ""),
+            trace_id=request.query.get("trace_id", ""))
+        return web.json_response(payload, status=status)
+
+    async def handle_trace_recent(request):
+        from aiohttp import web
+
+        try:
+            limit = int(request.query.get("limit", 20))
+        except ValueError:
+            return web.json_response({"error": "limit must be an integer"},
+                                     status=400)
+        return web.json_response(tracer.query_recent(
+            limit=limit, sort=request.query.get("sort", "recent")))
+
+    return handle_trace, handle_trace_recent
+
+
 # Shared aiohttp handlers for the /admin/trace query surface — the master
 # HTTP app, the engine agent and the fake engine all register these (each
-# process serves its own SpanStore's view of a trace).
-async def handle_admin_trace(request):
-    from aiohttp import web
-
-    status, payload = TRACER.query_trace(
-        request_id=request.query.get("request_id", ""),
-        trace_id=request.query.get("trace_id", ""))
-    return web.json_response(payload, status=status)
-
-
-async def handle_admin_trace_recent(request):
-    from aiohttp import web
-
-    try:
-        limit = int(request.query.get("limit", 20))
-    except ValueError:
-        return web.json_response({"error": "limit must be an integer"},
-                                 status=400)
-    return web.json_response(TRACER.query_recent(
-        limit=limit, sort=request.query.get("sort", "recent")))
+# process serves its own SpanStore's view of a trace; the master's
+# fleet-scope handler in http_service/service.py fans out to every
+# peer's copy of this endpoint and merges).
+handle_admin_trace, handle_admin_trace_recent = make_trace_handlers(TRACER)

@@ -32,7 +32,9 @@ from aiohttp import web
 
 import jax
 
-from ..common import tracing
+from ..common import flightrecorder, tracing
+from ..common.flightrecorder import RECORDER
+from ..common.metrics import ENGINE_HEARTBEATS_TOTAL, ENGINE_PEER_LINKED
 from ..common.request import LogProb, RequestOutput, SamplingParams, Status, StatusCode
 from ..common.tracing import NOOP_SPAN, TRACER, TraceContext
 from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
@@ -441,6 +443,24 @@ class EngineAgent:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner: Optional[web.AppRunner] = None
         self._threads: list[threading.Thread] = []
+        # Anomaly flight recorder: this agent's bundles carry the engine
+        # state (queue depth, tier stats, transfer counters) at anomaly
+        # time; served at /admin/flightrecorder/recent.
+        RECORDER.add_context_provider("engine", self._anomaly_context)
+
+    def _anomaly_context(self) -> dict[str, Any]:
+        return {
+            "instance": self.name,
+            "incarnation": self.incarnation_id,
+            "stats": self.aggregate_stats(),
+            "kv_tier": self._tier_stats(),
+            "kv_transfer": {
+                "device_sent": self.kv_device_sent,
+                "host_sent": self.kv_host_sent,
+                "stream_sent": self.kv_stream_sent,
+                "stream_received": self.kv_stream_received,
+            },
+        }
 
     # --------------------------------------------------------- dp dispatch
     def cancel(self, service_request_id: str) -> None:
@@ -596,6 +616,7 @@ class EngineAgent:
 
     def stop(self) -> None:
         self._alive = False
+        RECORDER.remove_context_provider("engine", self._anomaly_context)
         self.coord.rm(instance_key(self.instance_type.value, self.name))
         self.streamer.stop()
         if self.kv_transfer is not None:
@@ -623,6 +644,8 @@ class EngineAgent:
         app.router.add_get("/admin/trace", tracing.handle_admin_trace)
         app.router.add_get("/admin/trace/recent",
                            tracing.handle_admin_trace_recent)
+        app.router.add_get("/admin/flightrecorder/recent",
+                           flightrecorder.handle_flightrecorder_recent)
         app.router.add_post("/rpc/link", self._h_link)
         app.router.add_post("/rpc/unlink", self._h_unlink)
         app.router.add_post("/rpc/cancel", self._h_cancel)
@@ -687,10 +710,7 @@ class EngineAgent:
                 # 400/415 — demote to the JSON form (hex keys) and re-send
                 # this delta so it isn't lost (heartbeat replay is
                 # idempotent: the index applies absolute tier moves).
-                if master != self._hb_master:
-                    # New master (election/failover): re-probe msgpack.
-                    self._hb_master = master
-                    self._hb_wire = dispatch_wire.WIRE_MSGPACK
+                self._note_master(master)
                 fmt = self._hb_wire
                 payload["kv_cache_event"] = (
                     ev.to_wire_dict() if fmt == dispatch_wire.WIRE_MSGPACK
@@ -700,6 +720,7 @@ class EngineAgent:
                                    data=body,
                                    headers={"Content-Type": ctype},
                                    timeout=3)
+                ENGINE_HEARTBEATS_TOTAL.labels(master=master).inc()
                 if r.status_code in (400, 415) \
                         and fmt == dispatch_wire.WIRE_MSGPACK:
                     logger.warning(
@@ -715,6 +736,22 @@ class EngineAgent:
                                    timeout=3)
             except Exception:  # noqa: BLE001
                 logger.exception("heartbeat failed")
+
+    def _note_master(self, master: str) -> None:
+        """Track the heartbeat destination master. On a change
+        (election / failover): re-probe the msgpack wire (the new master
+        may be a newer build than the one that demoted us) AND evict the
+        old master's labeled heartbeat series — the master address is
+        ephemeral (host:port), so a long-lived engine that outlives many
+        masters would otherwise grow /metrics one dead series per
+        election (the agent-side mirror of instance_mgr's
+        evicted-instance series eviction)."""
+        if master == self._hb_master:
+            return
+        if self._hb_master:
+            ENGINE_HEARTBEATS_TOTAL.remove(master=self._hb_master)
+        self._hb_master = master
+        self._hb_wire = dispatch_wire.WIRE_MSGPACK
 
     # ------------------------------------------------------------ handlers
     def aggregate_stats(self) -> dict[str, Any]:
@@ -846,6 +883,14 @@ class EngineAgent:
             'engine_ttft_span_p50_milliseconds{span="engine_prefill"} '
             f"{spans['engine_prefill_ms']:.3f}",
         ]
+        # Agent-side labeled series (common/metrics.py instruments; only
+        # the agent-owned families render here — evicted on unlink /
+        # master change so the exposition stays bounded).
+        for inst in (ENGINE_PEER_LINKED, ENGINE_HEARTBEATS_TOTAL):
+            rendered = inst.render()
+            if rendered:
+                lines.append(f"# TYPE {inst.name} {inst.kind}")
+                lines.append(rendered.rstrip("\n"))
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
@@ -874,11 +919,17 @@ class EngineAgent:
                     {"ok": False,
                      "error": f"kv layout mismatch on {f}"}, status=409)
         self.linked_peers[peer.name] = peer
+        ENGINE_PEER_LINKED.labels(peer=peer.name).set(1)
         return web.json_response({"ok": True})
 
     async def _h_unlink(self, req: web.Request) -> web.Response:
         body = await req.json()
-        self.linked_peers.pop(body.get("peer_name", ""), None)
+        peer_name = body.get("peer_name", "")
+        if self.linked_peers.pop(peer_name, None) is not None:
+            # PD link torn down: evict the peer's labeled series, or a
+            # long-lived engine's /metrics grows one dead series per
+            # departed peer (ephemeral ports make the set unbounded).
+            ENGINE_PEER_LINKED.remove(peer=peer_name)
         return web.json_response({"ok": True})
 
     async def _h_cancel(self, req: web.Request) -> web.Response:
@@ -1161,6 +1212,16 @@ class EngineAgent:
                     "streamed KV transfer of %s to %s failed (%s); "
                     "falling back to inline host path",
                     h.service_request_id, peer, e)
+                # Stream fallback is an anomaly worth a post-mortem: the
+                # handoff survives (inline path below), but bandwidth
+                # pacing and chunked-pull benefits were lost mid-request.
+                trace_id = ctx.trace_id if ctx is not None else ""
+                TRACER.keep_trace(trace_id)
+                RECORDER.record(
+                    "kv_stream_fallback",
+                    request_id=h.service_request_id, trace_id=trace_id,
+                    detail={"peer": peer, "error": str(e),
+                            "bytes": int(blob_np.nbytes)})
         try:
             with TRACER.span("kv_transfer.offer", ctx=ctx, require_ctx=True,
                              request_id=h.service_request_id,

@@ -36,15 +36,23 @@ from typing import Any, Optional
 import aiohttp
 from aiohttp import web
 
+from ..common import flightrecorder
+from ..common.flightrecorder import RECORDER
 from ..common.hotpath import HOTPATH
 from ..common.metrics import (
     HANDOFF_SERVED_TOTAL,
+    KVCACHE_FRAME_LOG_SEQ,
+    LOADINFO_MAX_AGE_SECONDS,
+    LOADINFO_STALE_INSTANCES,
     REGISTRY,
+    ROUTING_SNAPSHOT_AGE_SECONDS,
     SERVER_REQUEST_IN_TOTAL,
+    relabel_prometheus_text,
 )
 from ..common.request import Request, RequestOutput, SamplingParams
+from ..common.slo import SLO_MONITOR
 from ..common import tracing
-from ..common.tracing import TRACER, TraceContext
+from ..common.tracing import TRACER, TraceContext, merge_fleet_spans, span_tree
 from ..common.types import InstanceType
 from ..multimaster.handoff import HandoffRelay
 from ..rpc import wire
@@ -134,8 +142,28 @@ class XllmHttpService:
         TRACER.configure(
             enabled=self.opts.enable_tracing,
             capacity=self.opts.trace_span_capacity,
-            mirror=self._mirror_span if self.tracer.enabled else None)
+            mirror=self._mirror_span if self.tracer.enabled else None,
+            sample_rate=self.opts.trace_sample_rate)
+        # SLO burn-rate monitor + anomaly flight recorder (fleet
+        # observability plane, docs/observability.md). The recorder's
+        # context provider captures this frontend's control-plane view
+        # into every anomaly bundle.
+        SLO_MONITOR.configure(
+            ttft_ms=self.opts.slo_ttft_ms, tpot_ms=self.opts.slo_tpot_ms,
+            budget=self.opts.slo_error_budget,
+            fast_s=self.opts.slo_fast_window_s,
+            slow_s=self.opts.slo_slow_window_s,
+            alert=self.opts.slo_burn_alert)
+        RECORDER.configure(capacity=self.opts.flightrecorder_capacity,
+                           directory=self.opts.flightrecorder_dir)
+        RECORDER.add_context_provider("service", self._anomaly_context)
+        # /metrics/fleet TTL cache: (monotonic deadline, rendered text).
+        self._fleet_metrics_cache: Optional[tuple[float, str]] = None
         self._client: Optional[aiohttp.ClientSession] = None
+        # Fleet fan-out concurrency bound (asyncio primitives bind their
+        # loop lazily on first await, so construction here is safe).
+        self._fleet_sem = asyncio.Semaphore(  # lock-order: 830
+            max(1, self.opts.fleet_scrape_concurrency))
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         # The event loop keeps only weak refs to tasks; hold forward tasks
         # here so they can't be garbage-collected mid-flight.
@@ -164,13 +192,20 @@ class XllmHttpService:
         app.router.add_get("/admin/hotpath", self.handle_hotpath)
         app.router.add_get("/admin/faults", self.handle_get_faults)
         app.router.add_post("/admin/faults", self.handle_set_faults)
-        # Span-trace query surface (shared handlers; each process serves
-        # its own SpanStore — this is the orchestration plane's view,
-        # including failover re-dispatch attempts correlated by trace_id
-        # across instance incarnations).
-        app.router.add_get("/admin/trace", tracing.handle_admin_trace)
+        # Span-trace query surface. Default scope serves this process's
+        # SpanStore (orchestration legs, failover re-dispatch attempts
+        # correlated by trace_id); `?scope=fleet` fans out to every live
+        # engine agent and peer frontend and merges the per-process span
+        # rings into ONE tree.
+        app.router.add_get("/admin/trace", self.handle_admin_trace)
         app.router.add_get("/admin/trace/recent",
-                           tracing.handle_admin_trace_recent)
+                           self.handle_admin_trace_recent)
+        # Fleet observability plane: merged fleet metrics, the SLO
+        # burn-rate report, and the anomaly flight recorder.
+        app.router.add_get("/metrics/fleet", self.handle_metrics_fleet)
+        app.router.add_get("/admin/slo", self.handle_slo)
+        app.router.add_get("/admin/flightrecorder/recent",
+                           flightrecorder.handle_flightrecorder_recent)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -188,6 +223,15 @@ class XllmHttpService:
         app.router.add_get("/rpc/static_prefill_list", self.handle_prefill_list)
         app.router.add_get("/rpc/static_decode_list", self.handle_decode_list)
         app.router.add_get("/health", self.handle_hello)
+        # Fleet fan-out targets reach peer frontends by their RPC address
+        # (the only address the XLLM:SERVICE:* records carry), so the
+        # LOCAL-scope observability surface is served here too. scope=
+        # fleet is deliberately not honored on this app — a peer's fan-out
+        # must terminate at one hop, never cascade.
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/admin/trace", tracing.handle_admin_trace)
+        app.router.add_get("/admin/trace/recent",
+                           tracing.handle_admin_trace_recent)
         return app
 
     async def _on_startup(self, app: web.Application) -> None:
@@ -199,6 +243,23 @@ class XllmHttpService:
         if self._client is not None:
             await self._client.close()
         self.tracer.close()
+        RECORDER.remove_context_provider("service", self._anomaly_context)
+        RECORDER.close()
+
+    def _anomaly_context(self) -> dict[str, Any]:
+        """Flight-recorder context provider: this frontend's control-plane
+        state at anomaly time (lock-free reads only)."""
+        mgr = self.scheduler.instance_mgr
+        return {
+            "self_addr": self.scheduler.self_addr,
+            "is_master": self.scheduler.is_master,
+            "snapshot_age_s": mgr.snapshot_age_s(),
+            "loadinfo_ages_s": mgr.load_info_ages_s(),
+            "stale_load": sorted(mgr.stale_load_names()),
+            "frame_log_seq": self.scheduler.kvcache_mgr.frame_log_seq(),
+            "ownership": self.scheduler.ownership.stats(),
+            "inflight_requests": self.scheduler.num_inflight_requests(),
+        }
 
     def _mirror_span(self, span: dict[str, Any]) -> None:
         self.tracer.log(span.get("request_id", ""),
@@ -674,9 +735,191 @@ class XllmHttpService:
                                    str(msg))
         return web.json_response(resp)
 
+    def _refresh_local_gauges(self) -> None:
+        """Scrape-time refresh of the control-plane freshness gauges +
+        the SLO burn rates (cheap lock-free reads; no background
+        thread)."""
+        mgr = self.scheduler.instance_mgr
+        ROUTING_SNAPSHOT_AGE_SECONDS.set(mgr.snapshot_age_s())
+        ages = mgr.load_info_ages_s()
+        # A never-updated instance (age sentinel -1) IS the stalest case
+        # — routing has zero telemetry for it; it must win the gauge,
+        # not be hidden by a fresher peer's finite age.
+        LOADINFO_MAX_AGE_SECONDS.set(
+            -1.0 if any(a < 0 for a in ages.values())
+            else max(ages.values(), default=0.0))
+        LOADINFO_STALE_INSTANCES.set(len(mgr.stale_load_names()))
+        KVCACHE_FRAME_LOG_SEQ.set(
+            self.scheduler.kvcache_mgr.frame_log_seq())
+        SLO_MONITOR.export_gauges()
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        self._refresh_local_gauges()
         return web.Response(text=REGISTRY.render_prometheus(),
                             content_type="text/plain")
+
+    # ----------------------------------------------- fleet observability
+    def _fleet_targets(self) -> list[tuple[str, str]]:
+        """(addr, role) fan-out targets: every known engine agent (from
+        the RCU routing snapshot — SUSPECT/draining included, their view
+        may hold the evidence) and every peer frontend (ownership member
+        set)."""
+        targets = [(name, "engine") for name in
+                   self.scheduler.instance_mgr.routing_snapshot().entries]
+        self_addr = self.scheduler.self_addr
+        targets += [(addr, "frontend")
+                    for addr in self.scheduler.ownership.members()
+                    if addr != self_addr]
+        return targets
+
+    async def _fanout_get(self, path: str, params: dict[str, str],
+                          as_json: bool = True
+                          ) -> list[tuple[str, str, str, Any]]:
+        """Concurrent bounded GET against every fleet target. Returns
+        ``(addr, role, status, payload)`` rows where status is ``ok``,
+        ``http <code>``, ``timeout`` or ``error`` — a dead peer degrades
+        the view, never the endpoint."""
+        assert self._client is not None
+        timeout = aiohttp.ClientTimeout(
+            total=max(0.1, self.opts.fleet_peer_timeout_s))
+
+        async def one(addr: str, role: str):
+            async with self._fleet_sem:
+                try:
+                    async with self._client.get(
+                            f"http://{addr}{path}", params=params,
+                            timeout=timeout) as r:
+                        payload = (await r.json(content_type=None)
+                                   if as_json else await r.text())
+                        status = "ok" if r.status == 200 \
+                            else f"http {r.status}"
+                        return addr, role, status, payload
+                except asyncio.TimeoutError:
+                    return addr, role, "timeout", None
+                except (aiohttp.ClientError, OSError, ValueError) as e:
+                    return addr, role, f"error: {type(e).__name__}", None
+
+        return list(await asyncio.gather(
+            *(one(a, r) for a, r in self._fleet_targets())))
+
+    async def handle_admin_trace(self, request: web.Request) -> web.Response:
+        if request.query.get("scope") != "fleet":
+            return await tracing.handle_admin_trace(request)
+        request_id = request.query.get("request_id", "")
+        trace_id = request.query.get("trace_id", "")
+        if not request_id and not trace_id:
+            return _error_response(400, "pass request_id= or trace_id=")
+        status, local = TRACER.query_trace(request_id=request_id,
+                                           trace_id=trace_id)
+        span_lists: list[list[dict[str, Any]]] = []
+        if status == 200:
+            span_lists.append(local["spans"])
+            trace_id = trace_id or local["trace_id"]
+        # Peers resolve request_id against their own stores, so the
+        # fan-out works even when this frontend recorded nothing (e.g. a
+        # trace rooted by a peer that relayed elsewhere).
+        params = {"trace_id": trace_id} if trace_id \
+            else {"request_id": request_id}
+        peers: dict[str, dict[str, str]] = {}
+        for addr, role, pstatus, payload in await self._fanout_get(
+                "/admin/trace", params):
+            if pstatus == "ok" and isinstance(payload, dict):
+                span_lists.append(payload.get("spans") or [])
+                trace_id = trace_id or payload.get("trace_id", "")
+            elif pstatus == "http 404":
+                pstatus = "no_spans"   # a peer this trace never touched
+            peers[addr] = {"role": role, "status": pstatus}
+        spans = merge_fleet_spans(span_lists)
+        if not spans:
+            return web.json_response(
+                {"error": "no spans recorded anywhere in the fleet",
+                 "scope": "fleet", "peers": peers}, status=404)
+        return web.json_response({
+            "scope": "fleet",
+            "trace_id": trace_id,
+            "request_id": request_id or next(
+                (s["request_id"] for s in spans if s.get("request_id")), ""),
+            "num_spans": len(spans),
+            "peers": peers,
+            "spans": spans,
+            "tree": span_tree(spans),
+        })
+
+    async def handle_admin_trace_recent(self,
+                                        request: web.Request) -> web.Response:
+        if request.query.get("scope") != "fleet":
+            return await tracing.handle_admin_trace_recent(request)
+        try:
+            limit = int(request.query.get("limit", 20))
+        except ValueError:
+            return _error_response(400, "limit must be an integer")
+        sort = request.query.get("sort", "recent")
+        local = TRACER.query_recent(limit=limit, sort=sort)
+        rows: dict[str, dict[str, Any]] = {
+            r["trace_id"]: r for r in local["traces"]}
+        peers: dict[str, dict[str, str]] = {}
+        for addr, role, pstatus, payload in await self._fanout_get(
+                "/admin/trace/recent",
+                {"limit": str(limit), "sort": sort}):
+            if pstatus == "ok" and isinstance(payload, dict):
+                for r in payload.get("traces") or ():
+                    cur = rows.get(r.get("trace_id", ""))
+                    # Keep the row closest to the root (a frontend's view
+                    # names the root point; an engine's view doesn't).
+                    if cur is None or (not cur.get("root_point")
+                                       and r.get("root_point")):
+                        rows[r["trace_id"]] = r
+            peers[addr] = {"role": role, "status": pstatus}
+        key = "duration_ms" if sort == "slowest" else "start_ms"
+        merged = sorted(rows.values(), key=lambda r: r.get(key, 0.0),
+                        reverse=True)[:max(0, limit)]
+        return web.json_response({"scope": "fleet", "sort": sort,
+                                  "peers": peers, "traces": merged})
+
+    async def handle_metrics_fleet(self,
+                                   request: web.Request) -> web.Response:
+        """Merged fleet Prometheus exposition: local series + every peer
+        frontend's + every engine agent's /metrics, each sample re-labeled
+        with ``instance``/``role``, behind a short TTL cache. A dead
+        target contributes only ``fleet_scrape_up 0`` — partial, never an
+        error."""
+        now = time.monotonic()
+        cached = self._fleet_metrics_cache
+        if cached is not None and now < cached[0]:
+            return web.Response(text=cached[1], content_type="text/plain")
+        self._refresh_local_gauges()
+        self_addr = self.scheduler.self_addr
+        parts = [relabel_prometheus_text(REGISTRY.render_prometheus(),
+                                         self_addr, "frontend")]
+        up_lines = ["# TYPE fleet_scrape_up gauge",
+                    f'fleet_scrape_up{{instance="{self_addr}",'
+                    f'role="frontend"}} 1']
+        for addr, role, pstatus, payload in await self._fanout_get(
+                "/metrics", {}, as_json=False):
+            up = 1 if pstatus == "ok" and isinstance(payload, str) else 0
+            up_lines.append(f'fleet_scrape_up{{instance="{addr}",'
+                            f'role="{role}"}} {up}')
+            if up:
+                # Foreign comments dropped: duplicate # TYPE lines across
+                # sources would make the merged exposition invalid.
+                parts.append(relabel_prometheus_text(
+                    payload, addr, role, strip_comments=True))
+        text = "".join(parts) + "\n".join(up_lines) + "\n"
+        self._fleet_metrics_cache = (
+            now + max(0.0, self.opts.metrics_fleet_cache_ttl_s), text)
+        return web.Response(text=text, content_type="text/plain")
+
+    async def handle_slo(self, request: web.Request) -> web.Response:
+        """Scored SLO report: per-objective multi-window burn rates
+        (common/slo.py) — the machine-readable signal the autoscaling /
+        SLO-policy loop consumes."""
+        report = SLO_MONITOR.export_gauges()
+        report["targets"] = {
+            "slo_ttft_ms": self.opts.slo_ttft_ms,
+            "slo_tpot_ms": self.opts.slo_tpot_ms,
+            "slo_error_budget": self.opts.slo_error_budget,
+        }
+        return web.json_response(report)
 
     async def handle_hello(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok",
@@ -687,7 +930,8 @@ class XllmHttpService:
     _RELOADABLE = {"target_ttft_ms": float, "target_tpot_ms": float,
                    "max_waiting_requests": int, "request_timeout_s": float,
                    "enable_request_trace": _cast_bool,
-                   "enable_tracing": _cast_bool}
+                   "enable_tracing": _cast_bool,
+                   "trace_sample_rate": float}
 
     async def handle_get_config(self, request: web.Request) -> web.Response:
         import dataclasses
@@ -718,6 +962,8 @@ class XllmHttpService:
         return web.json_response({
             "stages": HOTPATH.summary(),
             "ownership": self.scheduler.ownership.stats(),
+            "snapshot_age_s": mgr.snapshot_age_s(),
+            "frame_log_seq": self.scheduler.kvcache_mgr.frame_log_seq(),
             "loadinfo": {
                 "ages_s": mgr.load_info_ages_s(),
                 "stale": sorted(mgr.stale_load_names()),
@@ -780,6 +1026,10 @@ class XllmHttpService:
             # Live span-tracing toggle (e.g. shed the overhead under a
             # traffic spike without a restart).
             TRACER.configure(enabled=self.opts.enable_tracing)
+        if "trace_sample_rate" in applied:
+            # Live sampling knob: dial down under a traffic spike without
+            # losing anomalies (tail-based keep still promotes them).
+            TRACER.configure(sample_rate=self.opts.trace_sample_rate)
         return web.json_response({"ok": True, "applied": applied})
 
     # ----------------------------------------------------------- RPC routes

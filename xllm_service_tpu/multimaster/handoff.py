@@ -35,6 +35,7 @@ from typing import Any, Optional
 import aiohttp
 from aiohttp import web
 
+from ..common.flightrecorder import RECORDER
 from ..common.metrics import (
     HANDOFF_FORWARDED_TOTAL,
     HANDOFF_RECOVERIES_TOTAL,
@@ -251,4 +252,14 @@ class HandoffRelay:
                     sid, dead, successor, failed)
         if span:
             span.set(reowned_to=successor, attempt_failed=dead)
+            # Owner death is an anomaly by definition: force the
+            # tail-sampling keep so the relay-side spans survive, and
+            # capture the re-ownership in the flight recorder (the
+            # owner-kill chaos drill asserts on this bundle).
+            TRACER.keep_trace(span.trace_id)
+        RECORDER.record(
+            "handoff_recovery", request_id=sid,
+            trace_id=span.trace_id if span else "",
+            detail={"dead_owner": dead, "successor": successor,
+                    "failed_so_far": list(failed)})
         return successor

@@ -187,6 +187,12 @@ class GlobalKVCacheMgr:
             self._watch_id = coord.add_watch(CACHE_KEY_PREFIX, self._on_cache_event)
         self._load_existing()
 
+    def frame_log_seq(self) -> int:
+        """Next frame-log sequence number (lock-free read of an int —
+        fleet-observability gauge; a replica lagging this has not applied
+        the newest coordination frames)."""
+        return self._frame_seq
+
     # ------------------------------------------------------------ bootstrap
     def _load_existing(self) -> None:
         """Rebuild the index from coordination: legacy per-block JSON keys

@@ -131,9 +131,14 @@ class RoutingSnapshot:
     the lock."""
 
     __slots__ = ("prefill", "decode", "encode", "schedulable", "entries",
-                 "incarnations", "channels", "wire", "has_available")
+                 "incarnations", "channels", "wire", "has_available",
+                 "built_ms")
 
     def __init__(self, instances: dict[str, _Entry]):
+        # Build timestamp: the fleet-observability gauge
+        # routing_snapshot_age_seconds reports now - built_ms (a frontend
+        # whose snapshot stopped republishing is routing blind).
+        self.built_ms = now_ms()
         prefill: list[str] = []
         decode: list[str] = []
         encode: list[str] = []
@@ -291,6 +296,12 @@ class InstanceMgr:
     def routing_snapshot(self) -> RoutingSnapshot:
         """The current immutable routing view (lock-free read)."""
         return self._snapshot
+
+    def snapshot_age_s(self, now: Optional[int] = None) -> float:
+        """Age of the published routing snapshot in seconds (lock-free;
+        fleet-observability gauge + /admin/hotpath)."""
+        return round(((now or now_ms()) - self._snapshot.built_ms)
+                     / 1000.0, 3)
 
     def dispatch_wire(self, name: str) -> str:
         """Negotiated dispatch-wire format for an instance (lock-free)."""

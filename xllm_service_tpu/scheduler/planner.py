@@ -23,6 +23,7 @@ from dataclasses import asdict, dataclass, field
 from typing import Optional
 
 from ..common.config import ServiceOptions
+from ..common.metrics import PLANNER_SCALE_HINT
 from ..common.types import InstanceType
 from ..utils import get_logger
 
@@ -124,4 +125,7 @@ class Planner:
 
     def _finish(self, d: PlanDecision) -> PlanDecision:
         self.last_decision = d
+        # Export the headline decision so SLO dashboards / the autoscaler
+        # can read it off /metrics without polling /admin/planner.
+        PLANNER_SCALE_HINT.set(d.scale_hint)
         return d

@@ -46,7 +46,9 @@ from ..common.metrics import (
     REQUESTS_CANCELLED_ON_FAILURE_TOTAL,
     TTFT_MS,
 )
+from ..common.flightrecorder import RECORDER
 from ..common.ordered_executor import OrderedExecutor
+from ..common.slo import SLO_MONITOR
 from ..common.request import (
     Request,
     RequestOutput,
@@ -524,6 +526,7 @@ class Scheduler:
                 TTFT_MS.labels(instance=req.routing.prefill_name or "none",
                                policy=policy).observe(
                     now - req.created_time_ms)
+                SLO_MONITOR.record_ttft(now - req.created_time_ms)
             req.prefill_stage_finished = True
             req.metrics.prefill_finish_time_ms = now
             self.instance_mgr.update_request_metrics(
@@ -534,6 +537,7 @@ class Scheduler:
                     instance=(req.routing.decode_name
                               or req.routing.prefill_name or "none"),
                     policy=policy).observe(now - st.last_token_ms)
+                SLO_MONITOR.record_tpot(now - st.last_token_ms)
             self.instance_mgr.update_request_metrics(
                 req, RequestAction.DECODE_STEP, n_new=n_new)
         if n_new:
@@ -546,10 +550,14 @@ class Scheduler:
         if req.trace_callback is not None:
             req.trace_callback(req.service_request_id, output.to_dict())
         if not output.status.ok():
-            st.conn.finish_with_error(
-                503 if output.status.code == StatusCode.UNAVAILABLE else 500,
-                output.status.message or output.status.code.name)
-            self._remove_request(st, output)
+            code = 503 if output.status.code == StatusCode.UNAVAILABLE \
+                else 500
+            msg = output.status.message or output.status.code.name
+            st.conn.finish_with_error(code, msg)
+            # Stamp the engine error onto the root span (and through it
+            # the flight recorder's anomaly hook) — an engine-surfaced
+            # failure is as much an anomaly as a dispatch failure.
+            self._remove_request(st, output, error=(code, msg))
             return
         ok = True
         if req.stream:
@@ -628,6 +636,7 @@ class Scheduler:
                 st.request.span.status = f"ERROR: {error[0]}"
             self._account_request_exit(st.request)
         self._trace_spans(st)
+        self._finish_request_observability(st, error)
         return True
 
     def _trace_spans(self, st: _RequestState) -> None:
@@ -666,6 +675,53 @@ class Scheduler:
             r.trace_callback(r.service_request_id, summary)
         except Exception:  # noqa: BLE001 — tracing must never break exit
             logger.exception("span trace emit failed")
+
+    def _finish_request_observability(self, st: _RequestState,
+                                      error: Optional[tuple[int, str]]
+                                      ) -> None:
+        """Exit-time observability, on the winning exit path only and
+        outside `_remove_request`'s own lock hold (leaf locks only;
+        bundle capture is deque+file appends, never a scheduler lock):
+
+        - feed the request outcome to the SLO error-rate objective,
+        - tail-sampling verdict: an anomalous exit (error, failover, TTFT
+          SLO breach) KEEPS the trace — sampled-out anomalies promote
+          out of the pending buffer; a clean exit drops it,
+        - capture a flight-recorder bundle for errors and SLO breaches
+          (failovers are captured at failover time, where the dead
+          instance is still known).
+        """
+        r = st.request
+        m = r.metrics
+        SLO_MONITOR.record_request(ok=error is None)
+        ttft_ms = (m.prefill_finish_time_ms - r.created_time_ms) \
+            if m.prefill_finish_time_ms else None
+        slo_breach = ttft_ms is not None and SLO_MONITOR.ttft_breached(
+            ttft_ms)
+        trace_id = r.span.trace_id if r.span else \
+            (r.trace.trace_id if r.trace else "")
+        if error is None and st.failover_attempts == 0 and not slo_breach:
+            TRACER.drop_trace(trace_id)
+            return
+        TRACER.keep_trace(trace_id)
+        if error is not None:
+            RECORDER.record(
+                "error", request_id=r.service_request_id,
+                trace_id=trace_id,
+                detail={"code": error[0], "message": error[1],
+                        "ttft_ms": ttft_ms,
+                        "failover_attempts": st.failover_attempts,
+                        "prefill": r.routing.prefill_name,
+                        "decode": r.routing.decode_name})
+        elif slo_breach:
+            RECORDER.record(
+                "slo_breach", request_id=r.service_request_id,
+                trace_id=trace_id,
+                detail={"ttft_ms": ttft_ms,
+                        "slo_ttft_ms": SLO_MONITOR.ttft_target_ms,
+                        "failover_attempts": st.failover_attempts,
+                        "prefill": r.routing.prefill_name,
+                        "decode": r.routing.decode_name})
 
     def _account_request_exit(self, req: Request) -> None:
         """Reverse this request's load-accounting increments on any exit
@@ -877,6 +933,19 @@ class Scheduler:
                     "request %s failed over to %s (attempt %d, resuming "
                     "after %d tokens)", req.service_request_id,
                     routing.prefill_name, attempt, len(resume))
+                # Anomaly capture at failover time (the dead instance and
+                # resume state are still in hand); also forces the
+                # tail-sampling keep so a sampled-out trace's spans —
+                # including the dead incarnation's — promote to the ring.
+                trace_id = req.trace.trace_id if req.trace else ""
+                TRACER.keep_trace(trace_id)
+                RECORDER.record(
+                    "failover", request_id=req.service_request_id,
+                    trace_id=trace_id,
+                    detail={"dead_instance": dead_name,
+                            "target": routing.prefill_name,
+                            "attempt": attempt,
+                            "resumed_tokens": len(resume)})
                 return
             logger.warning("failover dispatch of %s to %s failed: %s",
                            req.service_request_id, routing.prefill_name, err)

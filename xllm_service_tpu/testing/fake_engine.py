@@ -163,6 +163,10 @@ class FakeEngine:
         app.router.add_post("/v1/chat/completions", self._h_chat)
         app.router.add_get("/v1/models", self._h_models)
         app.router.add_get("/health", self._h_health)
+        # Minimal engine /metrics (wire-contract reference: the fleet
+        # scrape — /metrics/fleet on any frontend — collects every
+        # engine's exposition and re-labels it by instance/role).
+        app.router.add_get("/metrics", self._h_metrics)
         app.router.add_post("/rpc/link", self._h_link)
         app.router.add_post("/rpc/unlink", self._h_unlink)
         app.router.add_post("/rpc/cancel", self._h_cancel)
@@ -291,6 +295,16 @@ class FakeEngine:
     async def _h_models(self, req: web.Request) -> web.Response:
         return web.json_response({"object": "list", "data": [
             {"id": m, "object": "model"} for m in self.cfg.models]})
+
+    async def _h_metrics(self, req: web.Request) -> web.Response:
+        lines = [
+            "# TYPE engine_running_requests gauge",
+            f"engine_running_requests {len(self.accepted_requests)}",
+            "# TYPE engine_cached_prefix_blocks gauge",
+            f"engine_cached_prefix_blocks {len(self._stored_hashes)}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
 
     async def _h_link(self, req: web.Request) -> web.Response:
         body = await req.json()
