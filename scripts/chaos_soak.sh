@@ -6,7 +6,7 @@
 # all randomness from one seeded RNG), so any failing iteration can be
 # replayed exactly with:   XLLM_CHAOS_SEED=<seed> pytest -m chaos
 #
-# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs] [extra pytest args...]
+# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs|--state] [extra pytest args...]
 #   --masters   soak the multi-master plane drills (tests/test_multimaster.py:
 #               owner/master kill mid-stream, split-brain demotion, write-lease
 #               proxying) instead of the single-master failover drills.
@@ -19,12 +19,18 @@
 #               frontends+engines under a mid-stream engine kill, dead-agent
 #               partial-result markers, and the owner-kill drill asserting the
 #               anomaly flight recorder captured the recovery).
+#   --state     soak the state-ownership verifier drills
+#               (tests/test_state_debug.py: a deliberate unguarded
+#               cross-thread write must be caught, and a heartbeat storm
+#               against a churning fleet must record no discipline
+#               violations).
 #
-# After the randomized-seed loop, three INSTRUMENTED legs run (one
+# After the randomized-seed loop, the INSTRUMENTED legs run (one
 # iteration each, counted in the pass rate): XLLM_LOCK_DEBUG=1 (the
 # lock-order/hold race detector), XLLM_RCU_DEBUG=1 (the snapshot
-# deep-freeze race detector — any in-place mutation of a published RCU
-# snapshot fails the drill), and both combined as a smoke. Set
+# deep-freeze race detector), XLLM_STATE_DEBUG=1 (the shared-state
+# ownership / attribute-race verifier — any write violating its declared
+# discipline fails the drill), and all three combined as a smoke. Set
 # XLLM_SOAK_SKIP_DEBUG_LEGS=1 to run the plain loop only.
 set -u
 
@@ -39,6 +45,9 @@ elif [ "${1:-}" = "--tier" ]; then
     shift
 elif [ "${1:-}" = "--obs" ]; then
     SUITE="tests/test_fleet_observability.py"
+    shift
+elif [ "${1:-}" = "--state" ]; then
+    SUITE="tests/test_state_debug.py"
     shift
 fi
 cd "$(dirname "$0")/.."
@@ -62,7 +71,8 @@ done
 total="$ITERS"
 if [ "${XLLM_SOAK_SKIP_DEBUG_LEGS:-}" != "1" ]; then
     for leg in "XLLM_LOCK_DEBUG=1" "XLLM_RCU_DEBUG=1" \
-               "XLLM_LOCK_DEBUG=1 XLLM_RCU_DEBUG=1"; do
+               "XLLM_STATE_DEBUG=1" \
+               "XLLM_LOCK_DEBUG=1 XLLM_RCU_DEBUG=1 XLLM_STATE_DEBUG=1"; do
         seed=$((RANDOM * 32768 + RANDOM))
         total=$((total + 1))
         echo "=== instrumented leg: $leg (seed=$seed, suite=$SUITE) ==="

@@ -1,15 +1,39 @@
 #!/usr/bin/env bash
 # Static-analysis gate: xlint (project concurrency invariants, always) +
 # ruff (generic lint, when installed). CI runs the same xlint pass via
-# tests/test_xlint.py::test_xlint_tree_clean.
+# tests/test_xlint.py::test_xlint_tree_clean. Tier-1 tests run separately
+# via scripts/tier1.sh (the canonical 3-chunk split).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== xlint (concurrency + RCU publication invariants) =="
-python -m xllm_service_tpu.devtools.xlint xllm_service_tpu
+# One xlint invocation per profile, consumed as --format json: stable
+# exit codes (0 clean / 1 violations / 2 usage), machine-readable
+# violation list, file counts from the single shared parse.
+run_xlint() {
+    local label="$1"; shift
+    local out rc=0
+    out=$(python -m xllm_service_tpu.devtools.xlint --format json "$@") \
+        || rc=$?
+    if [ "$rc" -eq 0 ]; then
+        echo "$out" | python -c 'import json, sys
+d = json.load(sys.stdin)
+print("xlint: clean (%d files, %s profile)" % (d["files"], d["profile"]))'
+        return 0
+    fi
+    echo "$out" | python -c 'import json, sys
+d = json.load(sys.stdin)
+for v in d["violations"]:
+    print("%s:%d: [%s] %s" % (v["path"], v["line"], v["rule"], v["message"]))
+print("xlint: %d violation(s)" % d["count"])' 2>/dev/null \
+        || echo "$out"
+    return "$rc"
+}
+
+echo "== xlint (concurrency + RCU + state-ownership invariants) =="
+run_xlint strict xllm_service_tpu
 
 echo "== xlint --support (tests/ + benchmarks/, relaxed profile) =="
-python -m xllm_service_tpu.devtools.xlint --support tests benchmarks
+run_xlint support --support tests benchmarks
 
 echo "== bench trend (headline-metric regression tripwire, >10% fails) =="
 python scripts/bench_trend.py
@@ -21,4 +45,4 @@ else
     echo "== ruff check: skipped (ruff not installed; config lives in pyproject.toml) =="
 fi
 
-echo "check.sh: OK"
+echo "check.sh: OK  (tier-1 tests: scripts/tier1.sh)"

@@ -40,6 +40,7 @@ import pytest  # noqa: E402
 
 from xllm_service_tpu.coordination.memory import MemoryStore  # noqa: E402
 from xllm_service_tpu.devtools import locks as _xlocks  # noqa: E402
+from xllm_service_tpu.devtools import ownership as _xownership  # noqa: E402
 from xllm_service_tpu.devtools import rcu as _xrcu  # noqa: E402
 
 
@@ -64,6 +65,25 @@ def _instrumented_lock_guard():
     yield
     vs = _xlocks.violations()
     assert not vs, ("instrumented-lock violations:\n"
+                    + "\n".join(str(v) for v in vs))
+
+
+@pytest.fixture(autouse=True)
+def _state_ownership_guard():
+    """Under XLLM_STATE_DEBUG=1 every test doubles as an attribute-race
+    detector: registered classes (devtools/ownership.py
+    STATE_DISCIPLINES) record (thread role, locks held) for every write
+    and any discipline violation recorded during the test fails it — so
+    the chaos, multimaster-kill and tier drills moonlight as a
+    shared-state ownership verifier, mirroring the lock and RCU guards
+    around this one."""
+    if not _xownership.debug_enabled():
+        yield
+        return
+    _xownership.reset_violations()
+    yield
+    vs = _xownership.violations()
+    assert not vs, ("state-ownership violations:\n"
                     + "\n".join(str(v) for v in vs))
 
 

@@ -1,0 +1,41 @@
+"""State-ownership fixture registry (stands in for
+devtools/ownership.py — the state rules key on the file name). Carries
+deliberate registry-staleness violations alongside the live entries
+used by state_sites.py / state_regress.py."""
+
+STATE_DISCIPLINES = {
+    # Live entries exercised by state_sites.py.
+    "StateHolder._table": "lock:_lock",
+    "StateHolder._mode": "confined:demo-loop",
+    "StateHolder._config": "init-only",
+    "StateHolder._weights": "immutable",
+    "StateHolder._snap": "rcu",
+    # Live entries exercised by state_regress.py (the resurrected
+    # pre-PR-5 shape: load infos were a lock-guarded dict back then).
+    "MiniInstanceMgr._load_infos": "lock:_metrics_lock",
+    # Deliberate registry-staleness violations.
+    "Ghost._attr": "lock:_lock",              # VIOLATION: no such class
+    "StateHolder._never": "lock:_lock",       # VIOLATION: never assigned
+    "StateHolder._nolock": "lock:_missing_lock",   # VIOLATION: no such lock
+    "StateHolder._badrole": "confined:ghost-role",  # VIOLATION: no such role
+    "StateHolder._badspec": "franchised",     # VIOLATION: malformed spec
+    "StateHolder._unpub": "rcu",              # VIOLATION: not in RCU_PUBLICATIONS
+}
+
+THREAD_ROLES = {
+    "demo-loop": {
+        "threads": ("demo-loop",),
+        "entries": ("StateHolder._run_loop", "StateHolder.tick"),
+    },
+    # VIOLATION: no confined declaration references this role.
+    "dead-role": {
+        "threads": ("never",),
+        "entries": ("StateHolder.tick",),
+    },
+}
+
+STATE_CLASSES = (
+    "StateHolder",
+    "MiniInstanceMgr",
+    "GhostStrict",   # VIOLATION: no class definition in the tree
+)

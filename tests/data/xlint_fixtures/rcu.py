@@ -10,6 +10,7 @@ RCU_FROZEN_TYPES = {
 
 RCU_PUBLICATIONS = {
     "Publisher._snap": "FrozSnap @ _lock",
+    "StateHolder._snap": "FrozSnap @ _lock",   # state-decl rcu cross-check
     "Publisher._infos": "dict @ _lock",
     "GlobalKVCacheMgr._snapshot": "PrefixIndex @ _lock",
     "Phantom._x": "dict @ _lock",            # VIOLATION: no such class

@@ -1,0 +1,34 @@
+"""RESURRECTED pre-PR-5 bug, static half (never imported).
+
+Before the RCU refactors, `InstanceMgr` kept its per-instance load-info
+view as a plain lock-guarded dict and REBUILT it — O(fleet) allocations
+— on every heartbeat ingest, under `_cluster_lock` instead of the
+`_metrics_lock` the load tables actually belong to: a heartbeat storm
+stalled routing behind the rebuild, and the metrics writers raced the
+rebuild because the lock didn't cover them. The state-write ownership
+rule catches the class statically: `MiniInstanceMgr._load_infos` is
+declared `lock:_metrics_lock` in this directory's ownership.py registry
+stand-in, so the wrong-lock rebuild flags while the fixed shape stays
+quiet."""
+
+import threading
+
+
+class MiniInstanceMgr:
+    def __init__(self):
+        self._cluster_lock = threading.Lock()   # lock-order: 85
+        self._metrics_lock = threading.Lock()   # lock-order: 86
+        self._instances = {}
+        self._load_infos = {}
+
+    def record_heartbeat_buggy(self, name, load):
+        # VIOLATION (the resurrected shape): the O(fleet) per-heartbeat
+        # rebuild ran under the CLUSTER lock — the declared discipline
+        # is lock:_metrics_lock.
+        with self._cluster_lock:
+            self._load_infos = {n: (n, load) for n in self._instances}
+
+    def record_heartbeat_fixed(self, name, load):
+        # Control: the fixed path rebuilds under the declared lock.
+        with self._metrics_lock:
+            self._load_infos = {n: (n, load) for n in self._instances}

@@ -1,0 +1,131 @@
+"""state-decl / state-write / state-read fixtures (never imported).
+
+StateHolder's disciplines live in this directory's ownership.py registry
+stand-in; ``hot_read`` / ``hot_read_locked`` are registered in the
+wire.py HOT_PATH_FUNCTIONS stand-in for the state-read rule."""
+
+import threading
+
+from somewhere import ownership  # noqa — parsed, not imported
+
+
+class StateHolder:
+    def __init__(self):
+        self._lock = threading.Lock()        # lock-order: 80
+        self._other_lock = threading.Lock()  # lock-order: 81
+        self._table = {}
+        self._mode = "idle"
+        self._config = {"a": 1}
+        self._weights = {"hbm": 1.0}
+        self._snap = FrozSnap()
+        self._unpub = {}
+
+    # ------------------------------------------------------------ lock: ok
+    def write_ok(self):
+        with self._lock:
+            self._table["k"] = 1          # clean: lexical lock
+
+    def write_via_helper(self):
+        with self._lock:
+            self._rebuild_locked()
+
+    def _rebuild_locked(self):
+        # Clean: every resolvable call site holds the lock (transitive
+        # call-summary, the *_locked convention).
+        self._table = {"fresh": True}
+
+    def write_escaped(self):
+        with ownership.escape("single-writer bootstrap, pre-thread"):
+            self._table = {}              # clean: escape hatch
+
+    def write_hatched(self):
+        self._table["k"] = 2  # xlint: allow-state-write(benign test knob)
+
+    # ---------------------------------------------------- lock: violations
+    def write_unlocked(self):
+        self._table["k"] = 1              # VIOLATION: no lock
+
+    def write_wrong_lock(self):
+        with self._other_lock:
+            self._table.pop("k", None)    # VIOLATION: wrong lock
+
+    def rebind_unlocked(self):
+        self._table = {}                  # VIOLATION: rebind, no lock
+
+    def escape_empty(self):
+        with ownership.escape(""):        # VIOLATION: reason required
+            self._table = {}
+
+    def _cycle_a(self):
+        self._table = {"cyc": 1}      # VIOLATION: mutual recursion only —
+        self._cycle_b()               # no locked external entry exists
+
+    def _cycle_b(self):
+        self._cycle_a()
+
+    # ------------------------------------------------------------ confined
+    def tick(self):
+        self._mode = "running"            # clean: role entry function
+
+    def _advance(self):
+        self._mode = "advancing"          # clean: only called from tick
+
+    def _helper_chain(self):
+        self._advance()
+
+    def rogue_rebind(self):
+        self._mode = "hijacked"           # VIOLATION: not a role entry
+
+    def stop(self):
+        self._mode = "stopped"            # clean: lifecycle teardown
+
+    # ------------------------------------------------- init-only/immutable
+    def reconfigure(self):
+        self._config = {"a": 2}           # VIOLATION: init-only rebind
+
+    def reconfigure_hatched(self):
+        self._config = {"a": 3}  # xlint: allow-state-write(test-only reset knob)
+
+    def tweak_weights(self):
+        self._weights = {}                # VIOLATION: immutable rebind
+
+    def poke_weights(self):
+        self._weights["ssd"] = 0.1        # VIOLATION: immutable item write
+
+    # -------------------------------------------------- rcu (cross-check)
+    def publish_snap(self):
+        with self._lock:
+            self._snap = FrozSnap()       # clean here: rcu-publish owns it
+
+    def touch_unpub(self):
+        with self._lock:
+            self._unpub = {}              # decl says rcu but not published
+
+    # ------------------------------------------------------ undeclared attr
+    def late_init(self):
+        self._surprise = 1                # VIOLATION: state-decl (undeclared)
+
+    def late_init_hatched(self):
+        self._scratch = 2  # xlint: allow-state-decl(ephemeral debug probe)
+
+    def close(self):
+        self._teardown_flag = True        # clean: lifecycle scope
+
+    # ----------------------------------------------------------- state-read
+    def hot_read(self):
+        return self._table.get("k")       # VIOLATION: unlocked hot read
+
+    def hot_read_locked(self):
+        with self._lock:
+            return self._table.get("k")   # clean: lock taken
+
+    def cold_read(self):
+        return self._table.get("k")       # clean: not a hot function
+
+    def _run_loop(self):
+        # Role entry: ONLY the confined-clean chain is reachable from
+        # here (calling the violating methods would launder them through
+        # the transitive caller summary).
+        while True:
+            self.tick()
+            self._helper_chain()

@@ -12,4 +12,8 @@ HOT_PATH_FUNCTIONS = {
     "Publisher.hot_single_read": "single publication load (clean)",
     "Publisher.hot_hatched_double": "double load with a hatch (clean)",
     "Reader.hot_accessor_double": "double accessor load (violation)",
+    # state-read fixtures (state_sites.py): lock-guarded attrs are not
+    # read on hot paths without the lock.
+    "StateHolder.hot_read": "unlocked lock-guarded read (violation)",
+    "StateHolder.hot_read_locked": "lock taken before the read (clean)",
 }

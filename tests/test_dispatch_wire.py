@@ -12,6 +12,7 @@ import json
 
 import pytest
 
+from xllm_service_tpu.devtools import ownership
 from xllm_service_tpu.rpc import wire
 from xllm_service_tpu.rpc.channel import EngineChannel
 
@@ -89,7 +90,9 @@ class TestChannelNegotiation:
     def test_forward_demotes_on_415_and_resends_json(self):
         ch = EngineChannel("e:1", retries=1)
         ch._session = _StubSession([415, 200])
-        ch.wire_format = wire.WIRE_MSGPACK
+        with ownership.escape("test knob: simulate a negotiated "
+                              "msgpack channel"):
+            ch.wire_format = wire.WIRE_MSGPACK
         ok, resp = ch.forward("/v1/completions", PAYLOAD)
         assert ok
         assert ch.wire_format == wire.WIRE_JSON
@@ -104,7 +107,9 @@ class TestChannelNegotiation:
     def test_forward_msgpack_when_negotiated(self):
         ch = EngineChannel("e:1", retries=1)
         ch._session = _StubSession([200])
-        ch.wire_format = wire.WIRE_MSGPACK
+        with ownership.escape("test knob: simulate a negotiated "
+                              "msgpack channel"):
+            ch.wire_format = wire.WIRE_MSGPACK
         ok, _ = ch.forward("/v1/completions", PAYLOAD)
         assert ok
         ctype, data = ch._session.posts[0]
@@ -114,7 +119,9 @@ class TestChannelNegotiation:
     def test_non_415_failure_does_not_demote(self):
         ch = EngineChannel("e:1", retries=1)
         ch._session = _StubSession([503])
-        ch.wire_format = wire.WIRE_MSGPACK
+        with ownership.escape("test knob: simulate a negotiated "
+                              "msgpack channel"):
+            ch.wire_format = wire.WIRE_MSGPACK
         ok, _ = ch.forward("/v1/completions", PAYLOAD)
         assert not ok
         assert ch.wire_format == wire.WIRE_MSGPACK

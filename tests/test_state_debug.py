@@ -1,0 +1,342 @@
+"""XLLM_STATE_DEBUG attribute-race verifier tests: discipline checks on
+the instrumented ``__setattr__``, guarded container views, the escape
+hatch, passthrough-when-disabled, clean-operation integration for the
+registered managers, and the resurrected PR-9 context-provider shape
+(caught at runtime by the verifier — the static half of this round's
+regression pair lives in tests/test_xlint.py / state_regress.py)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.flightrecorder import FlightRecorder
+from xllm_service_tpu.common.hashing import prefix_block_hash_hexes
+from xllm_service_tpu.common.types import KvCacheEvent
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.devtools import locks, ownership, rcu
+from xllm_service_tpu.engine.kv_tier import TieredKVStore
+from xllm_service_tpu.scheduler.global_kvcache_mgr import (
+    GlobalKVCacheMgr,
+    PrefixIndex,
+)
+from xllm_service_tpu.scheduler.instance_mgr import InstanceMgr
+
+from fakes import FakeChannel, make_meta, wait_until
+
+BLOCK = 16
+
+
+@pytest.fixture()
+def coord(store):
+    c = InMemoryCoordination(store)
+    yield c
+    c.close()
+
+
+@pytest.fixture()
+def state_debug():
+    """Arm the verifier for the test body; restore the PRIOR state on
+    teardown (hardcoding False would disarm a suite-wide
+    XLLM_STATE_DEBUG=1 run for every test collected after this file).
+    Arming also arms the instrumented locks — restore those too."""
+    was = ownership.debug_enabled()
+    was_locks = locks.debug_enabled()
+    ownership.set_debug(True)
+    ownership.reset_violations()
+    locks.reset_violations()
+    yield
+    ownership.reset_violations()
+    locks.reset_violations()
+    ownership.set_debug(was)
+    locks.set_debug(was_locks)
+
+
+@pytest.fixture(autouse=True)
+def _reset_channels():
+    FakeChannel.reset()
+    yield
+    FakeChannel.reset()
+
+
+def _run_in_thread(fn, name):
+    t = threading.Thread(target=fn, name=name)
+    t.start()
+    t.join()
+
+
+# ----------------------------------------------------------- escape hatch
+class TestEscape:
+    def test_escape_requires_reason(self):
+        with pytest.raises(ValueError):
+            ownership.escape("")
+        with pytest.raises(ValueError):
+            ownership.escape(None)
+
+    def test_escape_suppresses_checks(self, coord, state_debug):
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        ownership.reset_violations()
+        with ownership.escape("test: deliberate unguarded write"):
+            mgr._frame_seq = 99
+        assert not ownership.violations()
+
+
+# ------------------------------------------------------------ passthrough
+class TestPassthrough:
+    def test_identity_when_disabled(self, coord):
+        if ownership.debug_enabled():
+            pytest.skip("XLLM_STATE_DEBUG armed for this whole run")
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        mgr._frame_seq = 5            # unguarded: nothing records
+        mgr._dirty.add(b"x" * 16)
+        assert not ownership.violations()
+        assert type(mgr._dirty) is set   # no guarded view installed
+
+
+# ------------------------------------------------- discipline enforcement
+class TestDisciplines:
+    def test_lock_guarded_rebind_without_lock_caught(self, coord,
+                                                     state_debug):
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        ownership.reset_violations()
+        mgr._frame_seq = 123          # declared lock:_lock, none held
+        vs = ownership.violations()
+        assert any(v.kind == "state-lock"
+                   and "GlobalKVCacheMgr._frame_seq" in v.message
+                   for v in vs), vs
+
+    def test_lock_guarded_container_mutation_caught(self, coord,
+                                                    state_debug):
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        try:
+            assert mgr.register_instance(make_meta("i1"))
+            ownership.reset_violations()
+            # The deliberate unguarded cross-thread write drill: a rogue
+            # thread mutates the metrics table without _metrics_lock.
+            _run_in_thread(
+                lambda: mgr._load_metrics.__setitem__("ghost", None),
+                "rogue-writer")
+            vs = ownership.violations()
+            assert any(v.kind == "state-lock"
+                       and "_load_metrics" in v.message
+                       and "rogue-writer" in v.thread for v in vs), vs
+        finally:
+            ownership.reset_violations()
+            mgr.stop()
+
+    def test_rcu_swap_without_writer_lock_caught(self, coord, state_debug):
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        ownership.reset_violations()
+        mgr._snapshot = PrefixIndex()   # declared rcu @ _lock, none held
+        vs = ownership.violations()
+        assert any(v.kind == "state-lock" and "rcu" in v.message
+                   for v in vs), vs
+
+    def test_confined_write_from_wrong_thread_caught(self, coord,
+                                                     state_debug):
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        try:
+            ownership.reset_violations()
+            _run_in_thread(lambda: setattr(mgr, "_is_master", True),
+                           "rogue-writer")
+            vs = ownership.violations()
+            assert any(v.kind == "state-confined"
+                       and "mastership" in v.message for v in vs), vs
+        finally:
+            ownership.reset_violations()
+            mgr.stop()
+
+    def test_confined_write_from_main_thread_exempt(self, coord,
+                                                    state_debug):
+        # Single-threaded test drivers stand in for every role.
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        try:
+            ownership.reset_violations()
+            mgr.set_as_master()
+            mgr.set_as_replica()
+            assert not ownership.violations()
+        finally:
+            mgr.stop()
+
+    def test_confined_write_from_role_thread_clean(self, coord,
+                                                   state_debug):
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        try:
+            ownership.reset_violations()
+            # scheduler-sync is a declared mastership-role thread.
+            _run_in_thread(mgr.set_as_master, "scheduler-sync")
+            assert not ownership.violations()
+        finally:
+            mgr.stop()
+
+    def test_init_only_reassign_caught(self, coord, state_debug):
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        try:
+            ownership.reset_violations()
+            mgr._opts = ServiceOptions(block_size=BLOCK)
+            vs = ownership.violations()
+            assert any(v.kind == "state-reassign" for v in vs), vs
+        finally:
+            ownership.reset_violations()
+            mgr.stop()
+
+
+# --------------------------------------------------- manager integration
+class TestManagerIntegration:
+    def test_kvcache_ingest_and_match_run_clean(self, coord, state_debug):
+        """The real write paths hold their declared locks: a full
+        ingest/offload/remove cycle records nothing."""
+        mgr = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        toks = list(range(BLOCK * 2))
+        hashes = prefix_block_hash_hexes(toks, BLOCK)
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(stored=hashes))
+        assert mgr.match(toks).scores["i1"] == pytest.approx(2.0)
+        mgr.record_updated_kvcaches("i1", KvCacheEvent(offloaded=hashes[:1]))
+        mgr.remove_instance("i1")
+        mgr.upload_kvcache()
+        assert not ownership.violations(), ownership.violations()[:3]
+
+    def test_instance_mgr_lifecycle_runs_clean(self, coord, state_debug):
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        try:
+            assert mgr.register_instance(make_meta("i1"))
+            mgr.record_instance_heartbeat("i1", "")
+            mgr.reconcile_once()
+            mgr.upload_load_metrics()
+            mgr.deregister_instance("i1", reason="test")
+            assert not ownership.violations(), ownership.violations()[:3]
+        finally:
+            mgr.stop()
+
+    def test_tier_store_runs_clean_and_freeze_compat(self, coord,
+                                                     state_debug):
+        """Tier offload/drain under the verifier records nothing — and
+        with the RCU freezer ALSO armed (the combined soak leg), the
+        drained guarded lists still deep-freeze, so the PR-7 late-append
+        bug class still raises."""
+        was_rcu = rcu.debug_enabled()
+        rcu.set_debug(True)
+        store = TieredKVStore(block_shape=(2, 2), dtype="float32",
+                              dram_bytes=64, threads=1, max_inflight=2)
+        try:
+            assert store.offload("ab" * 16, np.ones((2, 2), np.float32))
+            wait_until(lambda: store.ready("ab" * 16))
+            off, rem = store.drain_events()
+            assert off == ["ab" * 16]
+            assert not ownership.violations(), ownership.violations()[:3]
+            rcu.reset_violations()
+            with pytest.raises(rcu.RcuMutationError):
+                off.append("late-delta")   # the PR-7 bug class
+            rcu.reset_violations()
+        finally:
+            store.close()
+            rcu.reset_violations()
+            rcu.set_debug(was_rcu)
+
+
+# ------------------------------------- resurrected PR-9 provider shape
+class TestResurrectedContextProviderRace:
+    """PR-9 regression pair, runtime half: context providers were
+    registered/deregistered with a bare dict write while record()
+    iterated the same table from request-exit threads — and a stopped
+    owner's provider could linger process-long. The fixed paths hold
+    the ring lock; the pre-fix shape (a bare cross-thread table write)
+    is exactly what the verifier catches."""
+
+    def test_pre_fix_shape_is_caught(self, state_debug):
+        fr = FlightRecorder(capacity=4)
+        ownership.reset_violations()
+        _run_in_thread(
+            lambda: fr._context.__setitem__("svc", lambda: {}),
+            "service-startup")
+        vs = ownership.violations()
+        assert any(v.kind == "state-lock" and "_context" in v.message
+                   for v in vs), vs
+
+    def test_fixed_path_is_clean(self, state_debug):
+        fr = FlightRecorder(capacity=4)
+        ownership.reset_violations()
+
+        def register():
+            fr.add_context_provider("svc", lambda: {"ok": True})
+
+        _run_in_thread(register, "service-startup")
+        bundle = fr.record("error", request_id="r1")
+        assert bundle["svc"] == {"ok": True}
+        fr.remove_context_provider("svc")
+        assert not ownership.violations(), ownership.violations()[:3]
+
+
+# ----------------------------------------------------------- chaos drills
+@pytest.mark.chaos
+class TestStateChaosDrills:
+    """Drill leg for ``chaos_soak.sh --state``: the detector proves it is
+    live (deliberate unguarded cross-thread write caught) and the real
+    concurrent write paths prove they are disciplined (a heartbeat storm
+    against a churning fleet records nothing)."""
+
+    def test_deliberate_unguarded_write_is_caught(self, coord, state_debug):
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        try:
+            assert mgr.register_instance(make_meta("i1"))
+            ownership.reset_violations()
+            _run_in_thread(
+                lambda: mgr._request_loads.pop("i1", None),
+                "rogue-accountant")
+            assert any("_request_loads" in v.message
+                       for v in ownership.violations())
+        finally:
+            ownership.reset_violations()
+            mgr.stop()
+
+    def test_concurrent_heartbeat_storm_runs_clean(self, coord,
+                                                   state_debug):
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        kv = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        try:
+            for i in range(4):
+                assert mgr.register_instance(make_meta(f"i{i}"))
+            stop = threading.Event()
+
+            def beat(name):
+                toks = list(range(BLOCK))
+                hashes = prefix_block_hash_hexes(toks, BLOCK)
+                while not stop.is_set():
+                    mgr.record_instance_heartbeat(name, "")
+                    kv.record_updated_kvcaches(
+                        name, KvCacheEvent(stored=hashes))
+                    kv.match(toks)
+
+            threads = [threading.Thread(target=beat, args=(f"i{i}",),
+                                        name=f"agent-heartbeat-{i}")
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for _ in range(10):
+                mgr.reconcile_once()
+                mgr.upload_load_metrics()
+                kv.upload_kvcache()
+            stop.set()
+            for t in threads:
+                t.join()
+            assert not ownership.violations(), ownership.violations()[:3]
+        finally:
+            mgr.stop()
+            kv.stop()

@@ -397,6 +397,122 @@ class TestAsyncLockDiscipline:
                         "alock_outer (order 50) -> AsyncOrderly.alock_inner")
 
 
+# ------------------------------------------------------------ state rules
+class TestStateDecl:
+    def test_stale_class_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-decl", "ownership.py",
+                    "Ghost._attr")
+
+    def test_never_assigned_attr_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-decl", "ownership.py",
+                    "StateHolder._never")
+
+    def test_unknown_lock_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-decl", "ownership.py",
+                    "_missing_lock")
+
+    def test_unknown_role_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-decl", "ownership.py",
+                    "ghost-role")
+
+    def test_malformed_spec_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-decl", "ownership.py",
+                    "franchised")
+
+    def test_rcu_without_publication_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-decl", "ownership.py",
+                    "_unpub")
+
+    def test_dead_role_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-decl", "ownership.py",
+                    "dead-role")
+
+    def test_stale_strict_class_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-decl", "ownership.py",
+                    "GhostStrict")
+
+    def test_undeclared_post_init_attr_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-decl", "state_sites.py",
+                    "_surprise")
+
+    def test_hatched_and_lifecycle_attrs_quiet(self, fixture_violations):
+        # _scratch carries allow-state-decl; _teardown_flag is assigned in
+        # close() (lifecycle scope): only _surprise fires in the file.
+        assert len(hits(fixture_violations, "state-decl",
+                        "state_sites.py")) == 1
+
+
+class TestStateWrite:
+    def test_unlocked_item_write_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-write", "state_sites.py",
+                    "write_unlocked")
+
+    def test_wrong_lock_mutator_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-write", "state_sites.py",
+                    "write_wrong_lock")
+
+    def test_unlocked_rebind_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-write", "state_sites.py",
+                    "rebind_unlocked")
+
+    def test_escape_without_reason_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-write", "state_sites.py",
+                    "without a reason")
+
+    def test_confined_rebind_off_role_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-write", "state_sites.py",
+                    "rogue_rebind")
+
+    def test_init_only_rebind_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-write", "state_sites.py",
+                    "reconfigure()")
+
+    def test_immutable_rebind_and_mutation_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-write", "state_sites.py",
+                    "tweak_weights")
+        assert hits(fixture_violations, "state-write", "state_sites.py",
+                    "mutated in place in poke_weights")
+
+    def test_clean_locked_and_summary_writes_quiet(self, fixture_violations):
+        # write_ok (lexical) / _rebuild_locked (transitive call summary) /
+        # write_escaped (hatch CM) / write_hatched (comment) / tick +
+        # _advance (role entry + caller fixpoint) / stop (lifecycle) /
+        # publish_snap (rcu-owned): exactly the nine deliberate
+        # violations fire in the file.
+        assert len(hits(fixture_violations, "state-write",
+                        "state_sites.py")) == 9
+
+    def test_pure_call_cycle_is_not_a_lock_summary(self,
+                                                   fixture_violations):
+        # Mutually recursive helpers with no locked external call site
+        # must flag — a cycle edge contributes no independent entry.
+        assert hits(fixture_violations, "state-write", "state_sites.py",
+                    "_cycle_a")
+
+    def test_pre_pr5_heartbeat_rebuild_resurrection_caught(
+            self, fixture_violations):
+        """The resurrected pre-PR-5 bug (per-heartbeat O(fleet) load-info
+        rebuild under the WRONG lock) is caught statically."""
+        assert hits(fixture_violations, "state-write", "state_regress.py",
+                    "record_heartbeat_buggy")
+
+    def test_fixed_heartbeat_rebuild_control_quiet(self, fixture_violations):
+        assert not hits(fixture_violations, "state-write",
+                        "state_regress.py", "record_heartbeat_fixed")
+
+
+class TestStateRead:
+    def test_unlocked_hot_read_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "state-read", "state_sites.py",
+                    "hot_read")
+
+    def test_locked_and_cold_reads_quiet(self, fixture_violations):
+        # hot_read_locked takes the lock; cold_read is unregistered:
+        # exactly one state-read violation in the file.
+        assert len(hits(fixture_violations, "state-read",
+                        "state_sites.py")) == 1
+
+
 # ------------------------------------------------------------------- CLI + CI
 class TestDriver:
     def test_cli_reports_and_exits_nonzero_on_fixtures(self, capsys):
@@ -449,6 +565,109 @@ def test_xlint_rcu_registry_is_live():
         vs = xlint.run([str(reg), str(bad)])
         assert any(v.rule == "rcu-frozen" and "probe.py" in v.path
                    for v in vs), vs
+
+
+def test_xlint_state_registry_is_live():
+    """The state-ownership pass must actually be armed on the real tree:
+    the registries parse non-empty and each of the three rules fires when
+    a known-bad snippet is linted next to the REAL registry file (the
+    PR-4 vacuous-rule lesson, applied to the new rules on day one)."""
+    import tempfile
+
+    import xllm_service_tpu.devtools.ownership as own_mod
+    import xllm_service_tpu.rpc.wire as wire_mod
+
+    assert own_mod.STATE_DISCIPLINES and own_mod.THREAD_ROLES \
+        and own_mod.STATE_CLASSES
+    assert own_mod.STATE_DISCIPLINES["GlobalKVCacheMgr._frame_seq"] \
+        == "lock:_lock"
+    reg = Path(own_mod.__file__)
+    wire = Path(wire_mod.__file__)
+    # The probe impersonates a registered class: an unlocked write to a
+    # lock-guarded attr, an undeclared post-init attr, and an unlocked
+    # hot-path read (GlobalKVCacheMgr.match is in HOT_PATH_FUNCTIONS).
+    probe = (
+        "import threading\n"
+        "class GlobalKVCacheMgr:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()  # lock-order: 26\n"
+        "        self._frame_seq = 0\n"
+        "        self._dirty = set()\n"
+        "    def bad_write(self):\n"
+        "        self._frame_seq = 7\n"
+        "    def bad_decl(self):\n"
+        "        self._made_up_attr = 1\n"
+        "    def match(self):\n"
+        "        return self._frame_seq\n")
+    with tempfile.TemporaryDirectory() as td:
+        bad = Path(td) / "probe.py"
+        bad.write_text(probe)
+        vs = xlint.run([str(reg), str(wire), str(bad)])
+        by_rule = {r: [v for v in vs if v.rule == r and "probe.py" in v.path]
+                   for r in ("state-decl", "state-write", "state-read")}
+        assert by_rule["state-write"], vs
+        assert any("_made_up_attr" in v.message
+                   for v in by_rule["state-decl"]), vs
+        assert by_rule["state-read"], vs
+
+
+def test_xlint_state_registry_disciplines_parse():
+    """Every live registry entry parses into a known discipline and the
+    cross-referenced objects exist at runtime (the registry the static
+    rule reads is the same dict the runtime verifier reads)."""
+    import xllm_service_tpu.devtools.ownership as own_mod
+
+    kinds = set()
+    for key, spec in own_mod.STATE_DISCIPLINES.items():
+        assert "." in key, key
+        kind, _, arg = spec.partition(":")
+        kinds.add(kind)
+        assert kind in ("lock", "rcu", "confined", "init-only",
+                        "immutable"), (key, spec)
+        if kind == "confined":
+            assert arg in own_mod.THREAD_ROLES, (key, spec)
+        if kind == "rcu":
+            from xllm_service_tpu.devtools.rcu import RCU_PUBLICATIONS
+
+            assert key in RCU_PUBLICATIONS, key
+    # Every discipline kind is exercised by the live registry (a kind
+    # nothing uses would mean untested rule surface).
+    assert kinds == {"lock", "rcu", "confined", "init-only", "immutable"}
+
+
+def test_cli_json_format(tmp_path, capsys):
+    """--format json: machine-readable output with the stable exit
+    codes scripts/check.sh consumes (0 clean, 1 violations, 2 usage)."""
+    import json
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import threading, time\n"
+                   "class C:\n"
+                   "    def __init__(self):\n"
+                   "        self.lk = threading.Lock()  # lock-order: 1\n"
+                   "    def f(self):\n"
+                   "        with self.lk:\n"
+                   "            time.sleep(1)\n")
+    rc = xlint.main(["--format", "json", str(bad)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["count"] == len(doc["violations"]) >= 1
+    assert doc["files"] == 1
+    assert {"rule", "path", "line", "message"} <= set(
+        doc["violations"][0])
+
+    good = tmp_path / "good.py"
+    good.write_text("X = 1\n")
+    rc = xlint.main(["--format", "json", str(good)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["count"] == 0
+
+
+def test_cli_usage_errors_exit_2(capsys):
+    assert xlint.main(["--format"]) == 2
+    assert xlint.main(["--format", "yaml", "x"]) == 2
+    assert xlint.main(["--no-such-flag"]) == 2
+    capsys.readouterr()
 
 
 def test_xlint_support_tree_clean():

@@ -34,6 +34,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Optional
 
+from ..devtools import ownership as _ownership
 from ..devtools.locks import make_lock
 from ..utils import get_logger
 
@@ -45,6 +46,7 @@ KINDS = ("slo_breach", "failover", "error", "kv_stream_fallback",
          "handoff_recovery")
 
 
+@_ownership.verify_state
 class FlightRecorder:
     def __init__(self, capacity: int = 64):
         self._lock = make_lock("flightrecorder.ring", order=818)  # lock-order: 818
@@ -72,7 +74,12 @@ class FlightRecorder:
 
     def add_context_provider(self, name: str,
                              fn: Callable[[], Any]) -> None:
-        self._context[name] = fn
+        # Under the ring lock: providers register from owner startup
+        # threads while record() snapshots the table on request-exit
+        # threads — the unguarded dict write here was the first real
+        # finding of the state-write ownership rule.
+        with self._lock:
+            self._context[name] = fn
 
     def remove_context_provider(self, name: str,
                                 fn: Optional[Callable[[], Any]] = None
@@ -83,8 +90,9 @@ class FlightRecorder:
         registration when an older one stops."""
         # == not `is`: bound methods are fresh objects per attribute
         # access but compare equal on (func, self).
-        if fn is None or self._context.get(name) == fn:
-            self._context.pop(name, None)
+        with self._lock:
+            if fn is None or self._context.get(name) == fn:
+                self._context.pop(name, None)
 
     # ------------------------------------------------------------ recording
     def record(self, kind: str, request_id: str = "", trace_id: str = "",
@@ -112,7 +120,9 @@ class FlightRecorder:
                 bundle["num_spans"] = len(spans)
                 bundle["trace"] = span_tree(spans)
             bundle["hotpath"] = HOTPATH.summary()
-            for name, fn in list(self._context.items()):
+            with self._lock:
+                providers = list(self._context.items())
+            for name, fn in providers:
                 try:
                     bundle[name] = fn()
                 except Exception as e:  # noqa: BLE001 — a broken provider must not lose the bundle

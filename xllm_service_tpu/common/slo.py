@@ -33,6 +33,7 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+from ..devtools import ownership as _ownership
 from ..devtools.locks import make_lock
 
 #: Objective keys (stable API: gauge label values and report keys).
@@ -118,6 +119,7 @@ class _Objective:
         }
 
 
+@_ownership.verify_state
 class SloMonitor:
     """Process-global burn-rate tracker over the three serving
     objectives. Writers (the scheduler's token/exit paths) hold one leaf

@@ -118,6 +118,13 @@ def held_locks() -> list[str]:
     return [h.lock.name for h in _held_list()]
 
 
+def thread_holds(lock: "InstrumentedLock") -> bool:
+    """Does the calling thread currently hold this instrumented lock?
+    (Identity check against the per-thread acquisition stack — the
+    ownership state verifier's lock-held cross-check reads this.)"""
+    return any(h.lock is lock for h in _held_list())
+
+
 class InstrumentedLock:
     """Context-manager lock recording acquisition order + stacks."""
 

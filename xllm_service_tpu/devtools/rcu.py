@@ -251,11 +251,16 @@ def freeze(value: Any) -> Any:
     t = type(value)
     if t in _FROZEN_VIEWS:
         return value
-    if t is dict:
+    # The ownership verifier's guarded views (devtools/ownership.py)
+    # freeze as their base container — a drained/published guarded list
+    # must still deep-freeze (the PR-7 bug class) when both detectors
+    # are armed.
+    guarded = getattr(t, "_xllm_guarded_kind", None)
+    if t is dict or guarded == "dict":
         return FrozenDict({k: freeze(v) for k, v in value.items()})
-    if t is list:
+    if t is list or guarded == "list":
         return FrozenList(freeze(v) for v in value)
-    if t is set:
+    if t is set or guarded == "set":
         return FrozenSet(value)   # elements are hashable ⇒ immutable
     if t is tuple:
         frozen = tuple(freeze(v) for v in value)

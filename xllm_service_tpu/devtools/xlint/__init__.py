@@ -55,6 +55,26 @@ rule id                    invariant
                            coordination calls, channel RPC / ``_get`` /
                            ``_post``) lexically inside ``async def`` —
                            they stall the whole event loop
+``state-decl``             every attribute assigned outside ``__init__`` in
+                           a class registered in ``devtools/ownership.py``'s
+                           ``STATE_CLASSES`` carries a declared discipline in
+                           ``STATE_DISCIPLINES``; stale registry entries
+                           (missing class / never-assigned attribute /
+                           unknown lock / unknown or dead thread role /
+                           ``rcu`` without an ``RCU_PUBLICATIONS`` entry)
+                           are violations too
+``state-write``            ``lock:<attr>``-disciplined attributes are
+                           written only while the declared lock is held
+                           (lexically, or via transitive ``*_locked``
+                           call-site summaries); ``confined:<role>``
+                           attributes rebound only from the role's entry
+                           functions; ``init-only``/``immutable`` never
+                           rebound after construction (``immutable`` never
+                           mutated in place either)
+``state-read``             functions registered in ``HOT_PATH_FUNCTIONS``
+                           do not read lock-guarded mutable attributes
+                           without the lock — go through an RCU snapshot
+                           or take it
 =========================  ==================================================
 
 ``async with`` acquisitions of declared asyncio locks participate in the
@@ -73,9 +93,21 @@ Escape hatches are inline comments with a mandatory reason::
     # xlint: allow-rcu-publish(reason)
     # xlint: allow-rcu-read(reason)
     # xlint: allow-async-blocking(reason)
+    # xlint: allow-state-decl(reason)
+    # xlint: allow-state-write(reason)
+    # xlint: allow-state-read(reason)
+
+The state rules also accept the runtime hatch — writes lexically inside
+``with ownership.escape("reason"):`` are exempt (and an empty reason is
+itself a violation, mirroring ``rcu.thaw``).
 
 Run: ``python -m xllm_service_tpu.devtools.xlint xllm_service_tpu``
-(exit 0 = clean, 1 = violations, 2 = usage/parse error).
+(exit 0 = clean, 1 = violations, 2 = usage error). ``--format json``
+emits one machine-readable object (``{"profile", "roots", "files",
+"count", "violations": [{"rule", "path", "line", "message"}, ...]}``)
+with the same exit codes — ``scripts/check.sh`` consumes it. The whole
+tree is parsed ONCE per run: every rule shares the same per-file AST
+and cached node walks (``SourceFile.walk`` / ``Project.fn_walk``).
 
 Support code (tests/, benchmarks/) is linted with the RELAXED profile —
 ``python -m xllm_service_tpu.devtools.xlint --support tests benchmarks``
@@ -102,6 +134,7 @@ SUPPRESSIBLE = {
     "broad-except", "blocking-under-lock", "lock-order", "bare-acquire",
     "lock-annotation", "local-lock", "span-point", "hot-json",
     "rcu-frozen", "rcu-publish", "rcu-read", "async-blocking",
+    "state-decl", "state-write", "state-read",
 }
 
 
@@ -124,6 +157,15 @@ class SourceFile:
     lines: list[str]
     # line number -> set of rule tokens allowed on that line.
     suppressions: dict[int, set[str]] = field(default_factory=dict)
+    # Cached flat node list: the tree is parsed once per run and every
+    # rule shares the same walk instead of re-walking per rule (the
+    # single-parse/single-walk contract the CLI advertises).
+    _nodes: "list[ast.AST] | None" = field(default=None, repr=False)
+
+    def walk(self) -> "list[ast.AST]":
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
 
     def allowed(self, token: str, *linenos: int) -> bool:
         # A hatch comment may trail the offending line or sit on its own
@@ -188,15 +230,20 @@ def load_files(roots: list[str]) -> tuple[list[SourceFile], list[Violation]]:
     return files, errors
 
 
-def run(roots: list[str], profile: str = "strict") -> list[Violation]:
+def run(roots: list[str], profile: str = "strict",
+        stats: "dict | None" = None) -> list[Violation]:
     """Lint ``roots``. ``profile="support"`` (tests/, benchmarks/) drops
     the declaration-discipline rule — support code does not register
     locks or points — but keeps every behavioral rule; the registry
     rules are inert on partial trees anyway (no registry file in the
-    roots)."""
+    roots). The tree is parsed once; every rule shares the parse and
+    the cached walks. ``stats`` (optional dict) receives run metadata
+    (currently ``files``)."""
     from . import rules
 
     files, violations = load_files(roots)
+    if stats is not None:
+        stats["files"] = len(files)
     project = rules.Project(files)
     active = rules.ALL_RULES if profile == "strict" else rules.SUPPORT_RULES
     for rule_fn in active:
@@ -204,15 +251,54 @@ def run(roots: list[str], profile: str = "strict") -> list[Violation]:
     return sorted(set(violations), key=lambda v: (v.path, v.line, v.rule))
 
 
+#: Flags the CLI understands; anything else dash-prefixed is a usage
+#: error (stable exit code 2, so callers can tell "violations" from
+#: "you invoked me wrong").
+_KNOWN_FLAGS = {"-q", "--support", "--format"}
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     quiet = "-q" in argv
     profile = "support" if "--support" in argv else "strict"
-    roots = [a for a in argv if not a.startswith("-")]
+    fmt = "text"
+    roots: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--format":
+            if i + 1 >= len(argv) or argv[i + 1] not in ("text", "json"):
+                print("xlint: --format takes 'text' or 'json'",
+                      file=sys.stderr)
+                return 2
+            fmt = argv[i + 1]
+            i += 2
+            continue
+        if a.startswith("-") and a not in _KNOWN_FLAGS:
+            print(f"xlint: unknown flag {a!r} (known: "
+                  f"{' '.join(sorted(_KNOWN_FLAGS))})", file=sys.stderr)
+            return 2
+        if not a.startswith("-"):
+            roots.append(a)
+        i += 1
     if not roots:
         pkg = Path(__file__).resolve().parents[2]
         roots = [str(pkg)]
-    violations = run(roots, profile=profile)
+    stats: dict = {}
+    violations = run(roots, profile=profile, stats=stats)
+    if fmt == "json":
+        import json as _json
+
+        print(_json.dumps({
+            "profile": profile,
+            "roots": roots,
+            "files": stats.get("files", 0),
+            "count": len(violations),
+            "violations": [{"rule": v.rule, "path": v.path,
+                            "line": v.line, "message": v.message}
+                           for v in violations],
+        }, indent=None))
+        return 1 if violations else 0
     for v in violations:
         print(v)
     if not violations and not quiet:

@@ -161,7 +161,8 @@ class LockDecl:
 
 
 class Project:
-    """Cross-file indices shared by the rules."""
+    """Cross-file indices shared by the rules (one parse, one set of
+    walks — every rule reads these instead of re-walking the tree)."""
 
     def __init__(self, files: list[SourceFile]):
         self.files = files
@@ -177,9 +178,66 @@ class Project:
         # Locks bound to function locals: invisible to the annotation /
         # ordering machinery, so their creation is itself a violation.
         self.local_lock_decls: list[LockDecl] = []
+        # Cached per-function node walks (keyed by node identity; the
+        # nodes stay alive for the project's lifetime via `files`).
+        self._fn_nodes: dict[int, list[ast.AST]] = {}
+        # Lazy shared call-site index, see call_contexts().
+        self._call_contexts: "dict[tuple, list] | None" = None
         for f in files:
             self._index_file(f)
             self._index_local_locks(f)
+
+    def fn_walk(self, fn: ast.AST) -> list[ast.AST]:
+        """Cached ``ast.walk`` over one function — several rules walk the
+        same methods; they share one traversal."""
+        nodes = self._fn_nodes.get(id(fn))
+        if nodes is None:
+            nodes = self._fn_nodes[id(fn)] = list(ast.walk(fn))
+        return nodes
+
+    def call_contexts(self) -> "dict[tuple, list]":
+        """Shared call-site index: resolvable call target ``(cls, meth)``
+        -> list of ``(caller_key, frozenset(held lock attrs),
+        caller_in_decl_scope)`` — the lock-context summaries the RCU and
+        state-write rules both consume (computed once)."""
+        if self._call_contexts is not None:
+            return self._call_contexts
+        ctx: dict[tuple, list] = {}
+
+        def visit(node, f: SourceFile, cls_name, caller, in_decl,
+                  outer_fn, stack: list[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not outer_fn:
+                # Nested defs run later: fresh lexical lock context, but
+                # call sites still belong to the enclosing method.
+                for child in ast.iter_child_nodes(node):
+                    visit(child, f, cls_name, caller, in_decl, outer_fn, [])
+                return
+            entered = 0
+            if isinstance(node, (ast.With, ast.AsyncWith)) \
+                    and cls_name is not None:
+                for key in _with_decl_locks(node, cls_name, self):
+                    stack.append(key[1])
+                    entered += 1
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                recv = _expr_text(node.func.value)
+                target = self.resolve_call(cls_name, recv == "self",
+                                           node.func.attr)
+                if target is not None:
+                    ctx.setdefault(target, []).append(
+                        (caller, frozenset(stack), in_decl))
+            for child in ast.iter_child_nodes(node):
+                visit(child, f, cls_name, caller, in_decl, outer_fn, stack)
+            for _ in range(entered):
+                stack.pop()
+
+        for f in self.files:
+            for cls_name, fn in _iter_functions(f):
+                visit(fn, f, cls_name, (cls_name, fn.name),
+                      fn.name in DECL_METHODS, fn, [])
+        self._call_contexts = ctx
+        return ctx
 
     def _index_file(self, f: SourceFile) -> None:
         for node in f.tree.body:
@@ -238,7 +296,7 @@ class Project:
         """Lock factories assigned to plain names inside any function:
         a short-lived local lock protects nothing across threads unless it
         escapes, and escapes untracked — both are bugs."""
-        for node in ast.walk(f.tree):
+        for node in f.walk():
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             for sub in ast.walk(node):
@@ -312,7 +370,7 @@ def rule_lock_discipline(project: Project) -> list[Violation]:
     for f in project.files:
         for cls_name, fn in _iter_functions(f):
             lock_attrs = project.class_lock_attrs(cls_name) if cls_name else set()
-            for node in ast.walk(fn):
+            for node in project.fn_walk(fn):
                 if not (isinstance(node, ast.Call)
                         and isinstance(node.func, ast.Attribute)):
                     continue
@@ -466,7 +524,7 @@ def rule_lock_order(project: Project) -> list[Violation]:
     for (cls_name, meth), (fn, _f) in project.methods.items():
         acq: set[tuple[str, str]] = set()
         refs: set[tuple[bool, str]] = set()
-        for node in ast.walk(fn):
+        for node in project.fn_walk(fn):
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 acq.update(_with_decl_locks(node, cls_name, project))
             elif isinstance(node, ast.Call) \
@@ -595,7 +653,7 @@ def rule_fault_point(project: Project) -> list[Violation]:
     out: list[Violation] = []
     used: set[str] = set()
     for f in project.files:
-        for node in ast.walk(f.tree):
+        for node in f.walk():
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr in ("check", "fire")
@@ -732,7 +790,7 @@ def rule_metrics_registry(project: Project) -> list[Violation]:
     for f in project.files:
         if f is decl_file:
             continue
-        for node in ast.walk(f.tree):
+        for node in f.walk():
             if isinstance(node, ast.Call) \
                     and isinstance(node.func, ast.Attribute) \
                     and node.func.attr in ("counter", "gauge", "histogram") \
@@ -787,7 +845,7 @@ def rule_span_point(project: Project) -> list[Violation]:
     out: list[Violation] = []
     used: set[str] = set()
     for f in project.files:
-        for node in ast.walk(f.tree):
+        for node in f.walk():
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr in ("span", "start_span")):
@@ -849,7 +907,7 @@ def rule_hot_json(project: Project) -> list[Violation]:
             if qual not in registry:
                 continue
             found.add(qual)
-            for node in ast.walk(fn):
+            for node in project.fn_walk(fn):
                 why = None
                 # Any json.dumps REFERENCE (call or alias like
                 # `dumps = json.dumps`) — an alias would otherwise launder
@@ -907,7 +965,7 @@ def rule_broad_except(project: Project) -> list[Violation]:
         # single-file invocation strips the parent dirs from f.rel and
         # would silently drop the scheduler/rpc/coordination/engine scope.
         scoped = bool(EXCEPT_SCOPED_DIRS & set(f.path.parts))
-        for node in ast.walk(f.tree):
+        for node in f.walk():
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if node.type is None:
@@ -955,7 +1013,7 @@ def rule_async_blocking(project: Project) -> list[Violation]:
     called — usually an executor)."""
     out: list[Violation] = []
     for f in project.files:
-        for fn in [n for n in ast.walk(f.tree)
+        for fn in [n for n in f.walk()
                    if isinstance(n, ast.AsyncFunctionDef)]:
             exempt: set[int] = set()
             for n in ast.walk(fn):
@@ -1044,7 +1102,7 @@ class _RcuModel:
         self.accessors: dict[tuple[str, str], tuple[str, str]] = {}
         self.frozen_returning: set[tuple[str, str]] = set()
         for (cls, meth), (fn, _f) in project.methods.items():
-            for node in ast.walk(fn):
+            for node in project.fn_walk(fn):
                 if not isinstance(node, ast.Return) or node.value is None:
                     continue
                 v = node.value
@@ -1212,7 +1270,7 @@ def rule_rcu(project: Project) -> list[Violation]:
                 f"definition in the tree (stale registry entry)"))
     attr_assigned: set[tuple[str, str]] = set()
     for (cls, meth), (fn, _f) in project.methods.items():
-        for node in ast.walk(fn):
+        for node in project.fn_walk(fn):
             if isinstance(node, ast.Assign):
                 for t in node.targets:
                     if isinstance(t, ast.Attribute) \
@@ -1247,10 +1305,9 @@ def rule_rcu(project: Project) -> list[Violation]:
 
     # ---- per-function analysis
     # Publication-swap sites lacking a lexical lock, keyed by enclosing
-    # method, checked against call sites afterwards (one-level summary).
+    # method, checked against call sites afterwards (one-level summary
+    # over the shared call-context index).
     pending_lock: dict[tuple[str, str], list[tuple[_RcuPub, SourceFile, int]]] = {}
-    # Call sites: (cls, meth) -> list of lock-attr sets held at the call.
-    call_locks: dict[tuple[str, str], list[set[str]]] = {}
 
     def fresh_rhs(node: ast.AST, fr: _FnRcu, fresh_names: set[str]) -> bool:
         if isinstance(node, _FRESH_DISPLAYS):
@@ -1272,7 +1329,7 @@ def rule_rcu(project: Project) -> list[Violation]:
         fr = _FnRcu(model, f, cls_name, fn)
         # Locals bound (only) from fresh builders, for the swap check.
         fresh_names: set[str] = set()
-        for node in ast.walk(fn):
+        for node in project.fn_walk(fn):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name) \
                     and fresh_rhs(node.value, fr, fresh_names):
@@ -1367,15 +1424,6 @@ def rule_rcu(project: Project) -> list[Violation]:
                 flag_frozen(node, f"in-place .{node.func.attr}() on "
                                   f"published value "
                                   f"{_expr_text(node.func.value)!r}")
-            # ---- call-site lock capture for the one-level summary
-            if isinstance(node, ast.Call) \
-                    and isinstance(node.func, ast.Attribute):
-                recv = _expr_text(node.func.value)
-                target = project.resolve_call(cls_name, recv == "self",
-                                              node.func.attr)
-                if target is not None:
-                    call_locks.setdefault(target, []).append(
-                        set(lock_stack))
             for child in ast.iter_child_nodes(node):
                 visit(child, lock_stack)
             for _ in range(entered):
@@ -1417,8 +1465,11 @@ def rule_rcu(project: Project) -> list[Violation]:
             scan_function(f, cls_name, fn)
 
     # ---- one-level call-site summaries for non-lexical lock holds
+    # (read off the shared call-context index — same traversal the
+    # state-write rule uses).
+    contexts = project.call_contexts()
     for (cls, meth), sites in pending_lock.items():
-        callers = call_locks.get((cls, meth), [])
+        callers = [held for (_ck, held, _d) in contexts.get((cls, meth), [])]
         for pub, f, line in sites:
             ok = bool(callers) and all(pub.lock_attr in held
                                        for held in callers)
@@ -1446,7 +1497,7 @@ def rule_rcu(project: Project) -> list[Violation]:
                 if qual not in hot_registry:
                     continue
                 loads: dict[str, list[int]] = {}
-                for node in ast.walk(fn):
+                for node in project.fn_walk(fn):
                     if isinstance(node, ast.Attribute) \
                             and isinstance(node.ctx, ast.Load) \
                             and node.attr in model.pub_attr_names:
@@ -1473,6 +1524,487 @@ def rule_rcu(project: Project) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------- state-ownership rules
+#: Teardown methods counted as declaration scope by the state rules
+#: (mirrors devtools/ownership.py LIFECYCLE_METHODS): they run after the
+#: worker threads are joined, so their rebinds are bookkeeping.
+STATE_LIFECYCLE_METHODS = {"stop", "close", "shutdown"}
+
+_STATE_KINDS = {"lock", "rcu", "confined", "init-only", "immutable"}
+
+#: In-place mutators checked on lock:/immutable attrs (superset of the
+#: RCU set: deque-style ends included).
+STATE_MUTATORS = RCU_MUTATORS | {"appendleft", "popleft", "__ior__",
+                                 "__iand__", "__isub__", "__ixor__"}
+
+
+def _parse_discipline(spec: str) -> tuple[str, str]:
+    kind, _, arg = spec.partition(":")
+    return kind.strip(), arg.strip()
+
+
+def _parse_thread_roles(f: SourceFile) -> "dict[str, tuple[tuple[str, ...], int]]":
+    """``THREAD_ROLES = {"role": {"threads": (...), "entries": (...)}}``
+    → role -> (entry "Class.method" names, lineno)."""
+    out: dict[str, tuple[tuple[str, ...], int]] = {}
+    for node in f.tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not isinstance(value, ast.Dict) or not any(
+                isinstance(t, ast.Name) and t.id == "THREAD_ROLES"
+                for t in targets):
+            continue
+        for k, v in zip(value.keys, value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            entries: tuple[str, ...] = ()
+            if isinstance(v, ast.Dict):
+                for kk, vv in zip(v.keys, v.values):
+                    if isinstance(kk, ast.Constant) \
+                            and kk.value == "entries" \
+                            and isinstance(vv, (ast.Tuple, ast.List)):
+                        entries = tuple(
+                            e.value for e in vv.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str))
+            out[k.value] = (entries, k.lineno)
+    return out
+
+
+def _parse_state_classes(f: SourceFile) -> "dict[str, int]":
+    """``STATE_CLASSES = ("Scheduler", ...)`` → {class name: lineno}."""
+    out: dict[str, int] = {}
+    for node in f.tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not isinstance(value, (ast.Tuple, ast.List)) or not any(
+                isinstance(t, ast.Name) and t.id == "STATE_CLASSES"
+                for t in targets):
+            continue
+        for e in value.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out[e.value] = e.lineno
+    return out
+
+
+def _is_escape_call(node: ast.AST) -> bool:
+    """``ownership.escape(...)`` / ``escape(...)`` — the unified hatch."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    return (isinstance(fn, ast.Name) and fn.id == "escape") or \
+        (isinstance(fn, ast.Attribute) and fn.attr == "escape")
+
+
+def _escape_reason_missing(call: ast.Call) -> bool:
+    if not call.args:
+        return True
+    a = call.args[0]
+    return isinstance(a, ast.Constant) and not a.value
+
+
+@dataclass
+class _StateDecl:
+    cls: str
+    attr: str
+    kind: str            # lock | rcu | confined | init-only | immutable
+    arg: str             # lock attr / role name
+    line: int
+
+
+@dataclass
+class _StateSite:
+    decl: "Optional[_StateDecl]"   # None = undeclared post-init assign
+    cls: str
+    attr: str
+    shape: str                     # rebind | item | mut
+    file: SourceFile
+    meth: str
+    line: int
+    locks: frozenset
+    escaped: bool
+
+
+def rule_state(project: Project) -> list[Violation]:
+    """The shared-state ownership pass: ``state-decl`` (bidirectional
+    registry discipline), ``state-write`` (writes obey the declared
+    discipline), ``state-read`` (registered hot-path functions do not
+    read lock-guarded attrs without the lock). Driven by
+    ``devtools/ownership.py``'s ``STATE_DISCIPLINES`` / ``STATE_CLASSES``
+    / ``THREAD_ROLES``; inert on partial trees without the registry."""
+    reg_file: Optional[SourceFile] = None
+    specs: dict = {}
+    roles: dict = {}
+    strict_classes: dict = {}
+    for f in project.files:
+        if f.path.name != "ownership.py":
+            continue
+        found = _registry_items(f, "STATE_DISCIPLINES")
+        if found:
+            specs, reg_file = found, f
+            roles = _parse_thread_roles(f)
+            strict_classes = _parse_state_classes(f)
+    if reg_file is None:
+        return []   # partial tree (e.g. fixture subset without a registry)
+
+    out: list[Violation] = []
+
+    # ---- parse declarations
+    decls: dict[tuple[str, str], _StateDecl] = {}
+    for key, (val, line) in specs.items():
+        cls, _, attr = key.partition(".")
+        kind, arg = _parse_discipline(val or "")
+        bad = (not attr or kind not in _STATE_KINDS
+               or (kind in ("lock", "confined") and not arg)
+               or (kind in ("rcu", "init-only", "immutable") and arg))
+        if bad:
+            out.append(Violation(
+                "state-decl", reg_file.rel, line,
+                f"state discipline {key!r}: {val!r} is not one of "
+                f"lock:<attr> | rcu | confined:<role> | init-only | "
+                f"immutable"))
+            continue
+        decls[(cls, attr)] = _StateDecl(cls, attr, kind, arg, line)
+    registered_classes = {c for (c, _a) in decls}
+
+    # ---- class index + per-class assigned/mutated attr sets
+    class_index: dict[str, tuple[SourceFile, int]] = {}
+    for f in project.files:
+        for node in f.tree.body:
+            if isinstance(node, ast.ClassDef):
+                class_index.setdefault(node.name, (f, node.lineno))
+
+    touched: dict[str, set[str]] = {}      # cls -> attrs assigned/mutated
+    sites: list[_StateSite] = []
+
+    def scan_method(f: SourceFile, cls_name: str, fn) -> None:
+        meth = fn.name
+        cls_touched = touched.setdefault(cls_name, set())
+
+        def visit(node, lock_stack: list[str], esc: int) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                for child in ast.iter_child_nodes(node):
+                    visit(child, [], esc)
+                return
+            entered = 0
+            esc_entered = 0
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for key in _with_decl_locks(node, cls_name, project):
+                    lock_stack.append(key[1])
+                    entered += 1
+                for item in node.items:
+                    if _is_escape_call(item.context_expr):
+                        esc_entered += 1
+                        if _escape_reason_missing(item.context_expr) \
+                                and not f.allowed("state-write",
+                                                  node.lineno):
+                            out.append(Violation(
+                                "state-write", f.rel, node.lineno,
+                                "ownership.escape() without a reason "
+                                "(the hatch requires one, like "
+                                "# xlint: allow-*(reason))"))
+            esc += esc_entered
+
+            def emit(attr: str, shape: str, line: int) -> None:
+                cls_touched.add(attr)
+                sites.append(_StateSite(
+                    decl=decls.get((cls_name, attr)), cls=cls_name,
+                    attr=attr, shape=shape, file=f, meth=meth, line=line,
+                    locks=frozenset(lock_stack), escaped=esc > 0))
+
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if isinstance(node, ast.AnnAssign) and node.value is None:
+                    targets = []
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in ("self", "cls"):
+                        emit(t.attr, "rebind", node.lineno)
+                    elif isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Attribute) \
+                            and isinstance(t.value.value, ast.Name) \
+                            and t.value.value.id in ("self", "cls"):
+                        emit(t.value.attr, "item", node.lineno)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in ("self", "cls"):
+                        emit(t.attr, "rebind", node.lineno)
+                    elif isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Attribute) \
+                            and isinstance(t.value.value, ast.Name) \
+                            and t.value.value.id in ("self", "cls"):
+                        emit(t.value.attr, "item", node.lineno)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in STATE_MUTATORS \
+                    and isinstance(node.func.value, ast.Attribute) \
+                    and isinstance(node.func.value.value, ast.Name) \
+                    and node.func.value.value.id in ("self", "cls"):
+                emit(node.func.value.attr, "mut", node.lineno)
+            for child in ast.iter_child_nodes(node):
+                visit(child, lock_stack, esc)
+            for _ in range(entered):
+                lock_stack.pop()
+
+        visit(fn, [], 0)
+
+    for f in project.files:
+        for cls_name, fn in _iter_functions(f):
+            if cls_name in registered_classes \
+                    or cls_name in strict_classes:
+                scan_method(f, cls_name, fn)
+
+    # ---- state-decl: registry liveness + cross-checks
+    rcu_pubs: dict = {}
+    for f in project.files:
+        if f.path.name == "rcu.py":
+            found = _registry_items(f, "RCU_PUBLICATIONS")
+            if found:
+                rcu_pubs = found
+    role_used: set[str] = set()
+    for (cls, attr), d in sorted(decls.items()):
+        if cls not in class_index:
+            out.append(Violation(
+                "state-decl", reg_file.rel, d.line,
+                f"registered state attribute {cls}.{attr} has no class "
+                f"{cls!r} in the tree (stale registry entry)"))
+            continue
+        if attr not in touched.get(cls, set()):
+            out.append(Violation(
+                "state-decl", reg_file.rel, d.line,
+                f"registered state attribute {cls}.{attr} is never "
+                f"assigned or mutated in class {cls} (stale registry "
+                f"entry)"))
+        if d.kind == "lock" and (cls, d.arg) not in project.lock_decls:
+            out.append(Violation(
+                "state-decl", reg_file.rel, d.line,
+                f"{cls}.{attr} declares discipline lock:{d.arg}, but "
+                f"{cls}.{d.arg} is not a declared lock (no "
+                f"# lock-order annotation in the lock registry)"))
+        if d.kind == "confined":
+            if d.arg not in roles:
+                out.append(Violation(
+                    "state-decl", reg_file.rel, d.line,
+                    f"{cls}.{attr} declares confined:{d.arg}, but role "
+                    f"{d.arg!r} is not defined in THREAD_ROLES"))
+            else:
+                role_used.add(d.arg)
+        if d.kind == "rcu" and f"{cls}.{attr}" not in rcu_pubs:
+            out.append(Violation(
+                "state-decl", reg_file.rel, d.line,
+                f"{cls}.{attr} declares discipline rcu, but is not "
+                f"registered in RCU_PUBLICATIONS (devtools/rcu.py)"))
+    for role, (_entries, line) in sorted(roles.items()):
+        if role not in role_used:
+            out.append(Violation(
+                "state-decl", reg_file.rel, line,
+                f"thread role {role!r} has no confined:{role} "
+                f"declaration (dead role — stale registry entry)"))
+    # Reverse rcu cross-check: publications on state-registered classes
+    # must be declared rcu here (one ownership model, no blind spots).
+    for key, (_val, line) in sorted(rcu_pubs.items()):
+        cls, _, attr = key.partition(".")
+        if cls in registered_classes and (cls, attr) not in decls:
+            out.append(Violation(
+                "state-decl", reg_file.rel, 0,
+                f"RCU publication {key} (rcu.py:{line}) belongs to "
+                f"state-registered class {cls} but has no "
+                f"STATE_DISCIPLINES entry (declare it 'rcu')"))
+    # STATE_CLASSES liveness.
+    for cls, line in sorted(strict_classes.items()):
+        if cls not in class_index:
+            out.append(Violation(
+                "state-decl", reg_file.rel, line,
+                f"STATE_CLASSES entry {cls!r} has no class definition "
+                f"in the tree (stale registry entry)"))
+        elif cls not in registered_classes:
+            out.append(Violation(
+                "state-decl", reg_file.rel, line,
+                f"STATE_CLASSES entry {cls!r} has no STATE_DISCIPLINES "
+                f"declarations at all (register its attributes or drop "
+                f"it)"))
+
+    # ---- state-decl: completeness over the strict classes — every
+    # attribute assigned outside construction/lifecycle scope must carry
+    # a discipline (the enforcement ratchet the registry exists for).
+    decl_scope = DECL_METHODS | STATE_LIFECYCLE_METHODS
+    for s in sites:
+        if s.decl is not None or s.shape != "rebind":
+            continue
+        if s.cls not in strict_classes or s.meth in decl_scope:
+            continue
+        if s.escaped or s.file.allowed("state-decl", s.line):
+            continue
+        out.append(Violation(
+            "state-decl", s.file.rel, s.line,
+            f"{s.cls}.{s.attr} is assigned outside __init__ but has no "
+            f"STATE_DISCIPLINES entry — declare its discipline "
+            f"(lock:<attr> | rcu | confined:<role> | init-only | "
+            f"immutable) in devtools/ownership.py"))
+
+    # ---- state-write
+    contexts = project.call_contexts()
+
+    def held_at_all_callsites(meth_key, lock_attr, path) -> bool:
+        # Greatest-fixpoint walk with one twist: a cycle edge (caller
+        # already on the path) contributes NO independent entry — a
+        # write reachable only through mutually recursive helpers with
+        # no locked external call site must flag, not vacuously pass.
+        callers = contexts.get(meth_key)
+        if not callers:
+            return False
+        external = False
+        for ck, held, is_decl in callers:
+            if is_decl or lock_attr in held:
+                external = True
+                continue
+            if ck in path:
+                continue   # cycle edge: no independent entry
+            if not held_at_all_callsites(ck, lock_attr, path | {ck}):
+                return False
+            external = True
+        return external
+
+    def confined_via_callers(meth_key, entries, path) -> bool:
+        callers = contexts.get(meth_key)
+        if not callers:
+            return False
+        external = False
+        for ck, _held, is_decl in callers:
+            if is_decl or f"{ck[0]}.{ck[1]}" in entries \
+                    or ck[1] in STATE_LIFECYCLE_METHODS:
+                external = True
+                continue
+            if ck in path:
+                continue   # cycle edge: no independent entry
+            if not confined_via_callers(ck, entries, path | {ck}):
+                return False
+            external = True
+        return external
+
+    for s in sites:
+        d = s.decl
+        if d is None:
+            continue
+        if s.escaped or s.file.allowed("state-write", s.line):
+            continue
+        if s.meth in DECL_METHODS:
+            continue
+        key = (d.cls, s.meth)
+        if d.kind == "lock":
+            if d.arg in s.locks:
+                continue
+            if held_at_all_callsites(key, d.arg, {key}):
+                continue
+            out.append(Violation(
+                "state-write", s.file.rel, s.line,
+                f"{d.cls}.{d.attr} (lock:{d.arg}) written outside "
+                f"'with self.{d.arg}' and not all call sites of "
+                f"{s.meth}() hold it (writers must serialize on the "
+                f"declared lock)"))
+        elif d.kind == "confined":
+            if s.shape != "rebind" or s.meth in STATE_LIFECYCLE_METHODS:
+                continue
+            entries = set(roles.get(d.arg, ((), 0))[0])
+            if f"{d.cls}.{s.meth}" in entries:
+                continue
+            if confined_via_callers(key, entries, {key}):
+                continue
+            out.append(Violation(
+                "state-write", s.file.rel, s.line,
+                f"{d.cls}.{d.attr} (confined:{d.arg}) rebound in "
+                f"{s.meth}(), which is not an entry function of role "
+                f"{d.arg!r} (and not every call site resolves into "
+                f"one)"))
+        elif d.kind in ("init-only", "immutable"):
+            if s.shape == "rebind":
+                if s.meth in STATE_LIFECYCLE_METHODS:
+                    continue
+                out.append(Violation(
+                    "state-write", s.file.rel, s.line,
+                    f"{d.cls}.{d.attr} ({d.kind}) rebound in "
+                    f"{s.meth}() — declared assign-once at "
+                    f"construction"))
+            elif d.kind == "immutable":
+                out.append(Violation(
+                    "state-write", s.file.rel, s.line,
+                    f"{d.cls}.{d.attr} (immutable) mutated in place in "
+                    f"{s.meth}() — immutable state is never written "
+                    f"after construction"))
+        # d.kind == "rcu": writes are governed by the rcu-publish rule.
+
+    # ---- state-read: hot-path functions vs lock-guarded attrs
+    hot_registry: dict[str, int] = {}
+    for f in project.files:
+        if f.path.name == "wire.py":
+            found = _registry_dict(f, "HOT_PATH_FUNCTIONS")
+            if found:
+                hot_registry = found
+    if hot_registry:
+        for f in project.files:
+            for cls_name, fn in _iter_functions(f):
+                if cls_name not in registered_classes:
+                    continue
+                qual = f"{cls_name}.{fn.name}"
+                if qual not in hot_registry:
+                    continue
+
+                def visit_read(node, lock_stack, esc,
+                               _f=f, _cls=cls_name, _fn=fn, _qual=qual):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)) and node is not _fn:
+                        for child in ast.iter_child_nodes(node):
+                            visit_read(child, [], esc)
+                        return
+                    entered = 0
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        for key in _with_decl_locks(node, _cls, project):
+                            lock_stack.append(key[1])
+                            entered += 1
+                        for item in node.items:
+                            if _is_escape_call(item.context_expr):
+                                esc += 1
+                    elif isinstance(node, ast.Attribute) \
+                            and isinstance(node.ctx, ast.Load) \
+                            and isinstance(node.value, ast.Name) \
+                            and node.value.id == "self":
+                        d = decls.get((_cls, node.attr))
+                        if d is not None and d.kind == "lock" \
+                                and d.arg not in lock_stack \
+                                and not esc \
+                                and not _f.allowed("state-read",
+                                                   node.lineno):
+                            out.append(Violation(
+                                "state-read", _f.rel, node.lineno,
+                                f"hot-path function {_qual} reads "
+                                f"{_cls}.{node.attr} (lock:{d.arg}) "
+                                f"without the lock — read an RCU "
+                                f"snapshot instead, or take "
+                                f"self.{d.arg}"))
+                    for child in ast.iter_child_nodes(node):
+                        visit_read(child, lock_stack, esc)
+                    for _ in range(entered):
+                        lock_stack.pop()
+
+                visit_read(fn, [], 0)
+    return out
+
+
 ALL_RULES = (
     rule_lock_discipline,
     rule_no_blocking_under_lock,
@@ -1484,6 +2016,7 @@ ALL_RULES = (
     rule_broad_except,
     rule_async_blocking,
     rule_rcu,
+    rule_state,
 )
 
 #: Relaxed profile for support code (tests/, benchmarks/): every

@@ -686,6 +686,11 @@ class EngineAgent:
                 ev = self.engines[0].drain_kv_events()
                 for eng in self.engines[1:]:
                     ev.merge(eng.drain_kv_events())
+                # Atomic take-and-reset per engine: the old bare
+                # read-then-zero raced the pump's read-max-write and
+                # could drop the window's worst sample (the exact number
+                # SLO routing keys off). Found by XLLM_STATE_DEBUG.
+                drained = [e.drain_recent_latency() for e in self.engines]
                 payload = {
                     "name": self.name,
                     "incarnation_id": self.incarnation_id,
@@ -695,15 +700,10 @@ class EngineAgent:
                         "hbm_cache_usage_perc": stats["kv_usage_perc"],
                     },
                     "latency_metrics": {
-                        "recent_max_ttft": max(
-                            e.recent_max_ttft_ms for e in self.engines),
-                        "recent_max_tbt": max(
-                            e.recent_max_tbt_ms for e in self.engines),
+                        "recent_max_ttft": max(t for t, _ in drained),
+                        "recent_max_tbt": max(t for _, t in drained),
                     },
                 }
-                for eng in self.engines:
-                    eng.recent_max_ttft_ms = 0.0
-                    eng.recent_max_tbt_ms = 0.0
                 # Binary heartbeat wire: KV-event block keys ride as raw
                 # 16-byte msgpack bins (half the bytes of hex, no codec on
                 # either end). A legacy master can't parse it and answers

@@ -58,6 +58,8 @@ from ..common.request import (
 )
 from ..common.hashing import prefix_block_hashes
 from ..common.types import KvCacheEvent
+from ..devtools import ownership as _ownership
+from ..devtools.locks import make_lock
 from ..models.base import get_model_family
 from ..parallel.mesh import build_mesh
 from ..parallel.sharding import shard_params
@@ -155,6 +157,7 @@ class _Sequence:
     decoded_ok: int = 0
 
 
+@_ownership.verify_state
 class InferenceEngine:
     def __init__(self, cfg: EngineConfig, mesh=None,
                  tokenizer: Optional[Tokenizer] = None,
@@ -362,7 +365,13 @@ class InferenceEngine:
         self._build_programs()
         if cfg.warmup_programs:
             self._warmup_programs()
-        # Telemetry for heartbeats (reference LatencyMetrics).
+        # Telemetry for heartbeats (reference LatencyMetrics). The
+        # decaying maxima are written by the engine pump and drained
+        # (take-and-reset) by the agent heartbeat thread — a leaf lock
+        # makes the window atomic: the bare read-then-reset used to race
+        # the pump's read-max-write and could silently drop the worst
+        # sample of the window (found by the XLLM_STATE_DEBUG verifier).
+        self._telemetry_lock = make_lock("engine.telemetry", order=822)  # lock-order: 822
         self.recent_max_ttft_ms = 0.0
         self.recent_max_tbt_ms = 0.0
         self.total_generated = 0
@@ -1173,6 +1182,19 @@ class InferenceEngine:
             out["kv_tier"] = self.tier_store.stats()
         return out
 
+    def drain_recent_latency(self) -> "tuple[float, float]":
+        """Heartbeat drain: atomically take-and-reset the decaying
+        (recent_max_ttft_ms, recent_max_tbt_ms) window. The previous
+        read-then-reset from the heartbeat thread raced the pump's
+        read-max-write: a worst-case sample landing between the read and
+        the reset vanished from the window — and these maxima are what
+        SLO-aware routing keys off."""
+        with self._telemetry_lock:
+            out = (self.recent_max_ttft_ms, self.recent_max_tbt_ms)
+            self.recent_max_ttft_ms = 0.0
+            self.recent_max_tbt_ms = 0.0
+        return out
+
     def drain_kv_events(self) -> KvCacheEvent:
         """Heartbeat delta: page-manager stored/removed plus the tier
         store's completed transitions (HBM→DRAM and DRAM→SSD ride as
@@ -1862,7 +1884,8 @@ class InferenceEngine:
             raise
         now = time.monotonic()
         ttft_ms = (now - t0) * 1000
-        self.recent_max_ttft_ms = max(self.recent_max_ttft_ms, ttft_ms)
+        with self._telemetry_lock:
+            self.recent_max_ttft_ms = max(self.recent_max_ttft_ms, ttft_ms)
         self.ttft_samples.append((len(prompt), ttft_ms))
         # Engine-side TTFT span: how long the request queued before
         # admission vs how long the prefill program itself took. The
@@ -2278,7 +2301,8 @@ class InferenceEngine:
         packed_np = self._fetch(packed)   # [H, B, 2+2K]
         elapsed = time.monotonic() - t0
         ms_per_tok = elapsed * 1000 / max(1, horizon)
-        self.recent_max_tbt_ms = max(self.recent_max_tbt_ms, ms_per_tok)
+        with self._telemetry_lock:
+            self.recent_max_tbt_ms = max(self.recent_max_tbt_ms, ms_per_tok)
         live = [s for s in snapshot.values() if not s.finished]
         if live:
             self.tpot_samples.append(
@@ -2404,7 +2428,8 @@ class InferenceEngine:
                 self._emit_tokens(seq, tokens, lps)
         per_seq = emitted / max(1, n_seqs)
         ms_per_tok = elapsed * 1000 / max(1.0, per_seq)
-        self.recent_max_tbt_ms = max(self.recent_max_tbt_ms, ms_per_tok)
+        with self._telemetry_lock:
+            self.recent_max_tbt_ms = max(self.recent_max_tbt_ms, ms_per_tok)
         live = [s for s in snapshot.values() if not s.finished]
         if live:
             self.tpot_samples.append(

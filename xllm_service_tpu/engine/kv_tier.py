@@ -51,6 +51,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..devtools import ownership as _ownership
 from ..devtools import rcu
 from ..devtools.locks import make_lock
 from ..utils import get_logger
@@ -72,6 +73,7 @@ def _np_dtype(dtype: Any) -> np.dtype:
     return np.dtype(dtype)
 
 
+@_ownership.verify_state
 class TieredKVStore:
     """Host-side cold tiers for evicted prefix-cache blocks.
 
@@ -197,9 +199,11 @@ class TieredKVStore:
         if not self._inflight.acquire(blocking=False):
             # Transfer pump saturated: dropping is the correct backpressure
             # (the alternative — unbounded queueing of device buffers —
-            # pins HBM and eventually stalls the loop).
-            self.offload_dropped += 1
+            # pins HBM and eventually stalls the loop). The drop counter
+            # moves inside the lock hold it already pays: concurrent
+            # engine threads were losing increments on the bare +=.
             with self._lock:
+                self.offload_dropped += 1
                 self._removed.append(hash_hex)
             return False
         with self._lock:

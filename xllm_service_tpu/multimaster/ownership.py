@@ -32,6 +32,7 @@ from hashlib import blake2b
 from typing import Callable, Iterable, Optional
 
 from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..devtools import ownership as _ownership
 from ..devtools import rcu
 from ..devtools.locks import make_lock
 from ..rpc import MASTER_KEY, SERVICE_KEY_PREFIX
@@ -44,6 +45,7 @@ logger = get_logger(__name__)
 MINE_TRIES = 32
 
 
+@_ownership.verify_state
 class OwnershipRouter:
     """Rendezvous-hash request→master ownership (lock-free reads)."""
 
@@ -100,7 +102,10 @@ class OwnershipRouter:
         ports are only known after the RPC site binds)."""
         with self._lock:
             self._addrs.discard(self.self_addr)
-            self.self_addr = addr
+            with _ownership.escape("post-bind re-registration: rebinds "
+                                   "the init-only self_addr once, before "
+                                   "traffic"):
+                self.self_addr = addr
             self._addrs.add(addr)
             self._publish_locked()
 
@@ -155,12 +160,15 @@ class OwnershipRouter:
             sid = gen(kind)
             return sid, self.owner_of(sid)
         sid = gen(kind)
-        for _ in range(MINE_TRIES):
-            if self.owner_of(sid) == self.self_addr:
-                self.mined += 1
-                return sid, self.self_addr
-            sid = gen(kind)
-        self.mine_misses += 1
+        with _ownership.escape("stat counters on the accept hot path: "
+                               "GIL-atomic int adds; losing a rare "
+                               "increment beats a lock per accept"):
+            for _ in range(MINE_TRIES):
+                if self.owner_of(sid) == self.self_addr:
+                    self.mined += 1
+                    return sid, self.self_addr
+                sid = gen(kind)
+            self.mine_misses += 1
         return sid, self.owner_of(sid)
 
     def stats(self) -> dict:

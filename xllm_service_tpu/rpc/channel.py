@@ -31,6 +31,7 @@ from requests.adapters import HTTPAdapter
 from ..common import tracing
 from ..common.faults import FAULTS, FaultInjected
 from ..common.metrics import RPC_RETRIES_TOTAL
+from ..devtools import ownership as _ownership
 from ..common.types import InstanceMetaInfo
 from ..utils import get_logger, jittered_backoff
 from . import wire
@@ -70,6 +71,7 @@ class _KeepaliveAdapter(HTTPAdapter):
         return super().init_poolmanager(*args, **kwargs)
 
 
+@_ownership.verify_state
 class EngineChannel:
     def __init__(self, name: str, base_url: Optional[str] = None,
                  timeout_s: float = DEFAULT_TIMEOUT_S,
@@ -226,7 +228,10 @@ class EngineChannel:
                 and isinstance(resp, str) and resp.startswith("HTTP 415"):
             logger.warning("engine %s rejected msgpack dispatch; demoting "
                            "channel to JSON wire", self.name)
-            self.wire_format = wire.WIRE_JSON
+            with _ownership.escape("415 wire demotion: one-way monotonic "
+                                   "fallback to JSON; GIL-atomic string "
+                                   "swap on the negotiation slot"):
+                self.wire_format = wire.WIRE_JSON
             ok, resp = self._post(path, payload, retries=1)
         return ok, resp
 

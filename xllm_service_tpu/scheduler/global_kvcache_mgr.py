@@ -60,6 +60,7 @@ from ..common.config import ServiceOptions
 from ..common.hashing import as_key, prefix_block_hashes
 from ..common.types import CacheLocations, KvCacheEvent, OverlapScores
 from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..devtools import ownership as _ownership
 from ..devtools import rcu
 from ..devtools.locks import make_lock
 from ..rpc import CACHE_FRAME_KEY_PREFIX, CACHE_KEY_PREFIX
@@ -139,6 +140,7 @@ class PrefixIndex:
         self.blocks: dict[bytes, _BlockLoc] = blocks if blocks is not None else {}
 
 
+@_ownership.verify_state
 class GlobalKVCacheMgr:
     def __init__(self, coord: CoordinationClient, block_size: int = 128,
                  is_master: bool = True,

@@ -68,6 +68,7 @@ from ..common.types import (
 )
 from ..common.request import Request
 from ..coordination.base import CoordinationClient, KeyEvent, WatchEventType
+from ..devtools import ownership as _ownership
 from ..devtools import rcu
 from ..devtools.locks import make_lock
 from ..rpc import (
@@ -175,6 +176,7 @@ class RoutingSnapshot:
         self.has_available = has_default or (has_prefill and has_decode)
 
 
+@_ownership.verify_state
 class InstanceMgr:
     def __init__(self, coord: CoordinationClient, options: ServiceOptions,
                  is_master: bool = True,
@@ -319,7 +321,10 @@ class InstanceMgr:
             if entry is None:
                 return
             if entry.channel is not None:
-                entry.channel.wire_format = WIRE_JSON
+                with _ownership.escape("415 wire demotion: monotonic "
+                                       "JSON fallback on the negotiation "
+                                       "slot (GIL-atomic string swap)"):
+                    entry.channel.wire_format = WIRE_JSON
             if WIRE_JSON == negotiate(entry.meta.wire_formats):
                 return
             entry.meta.wire_formats = [WIRE_JSON]
@@ -380,7 +385,12 @@ class InstanceMgr:
                 if cur.channel is not None:
                     # Keep the sync-dispatch flag coherent with the
                     # refreshed advertisement (one negotiation truth).
-                    cur.channel.wire_format = negotiate(meta.wire_formats)
+                    with _ownership.escape("registration refresh under "
+                                           "_cluster_lock re-negotiates "
+                                           "the wire slot (GIL-atomic "
+                                           "string swap)"):
+                        cur.channel.wire_format = \
+                            negotiate(meta.wire_formats)
                 if refit:
                     if meta.ttft_profiling_data:
                         cur.predictor.fit_ttft(meta.ttft_profiling_data)
@@ -458,7 +468,9 @@ class InstanceMgr:
         # watch thread, and an unreachable instance's connect timeout must
         # not stall eviction/heartbeat event processing behind it. Both
         # tolerate test doubles without the richer channel API.
-        channel.wire_format = negotiate(meta.wire_formats)
+        with _ownership.escape("pre-publication: the channel is not yet "
+                               "visible to any other thread"):
+            channel.wire_format = negotiate(meta.wire_formats)
         warm = getattr(channel, "warm_up", None)
         if warm is not None:
             threading.Thread(target=warm, daemon=True,

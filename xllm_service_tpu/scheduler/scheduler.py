@@ -68,6 +68,7 @@ from ..common.types import (
 )
 from ..coordination import CoordinationClient, connect
 from ..coordination.base import KeyEvent, WatchEventType
+from ..devtools import ownership as _ownership
 from ..devtools.locks import make_lock
 from ..rpc import MASTER_KEY, SERVICE_KEY_PREFIX
 from ..scheduler.global_kvcache_mgr import GlobalKVCacheMgr
@@ -129,6 +130,7 @@ class _RequestState:
         self.dispatch_done_pc: Optional[float] = None
 
 
+@_ownership.verify_state
 class Scheduler:
     def __init__(self, options: ServiceOptions,
                  coord: Optional[CoordinationClient] = None,
@@ -210,7 +212,9 @@ class Scheduler:
         if addr == self.self_addr:
             return
         old = self.self_addr
-        self.self_addr = addr
+        with _ownership.escape("post-bind re-registration: rebinds the "
+                               "init-only self_addr once, before traffic"):
+            self.self_addr = addr
         self._coord.rm(SERVICE_KEY_PREFIX + old)
         self._coord.set(SERVICE_KEY_PREFIX + addr,
                         json.dumps({"rpc_address": addr}),
