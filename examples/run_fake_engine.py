@@ -35,13 +35,28 @@ def main() -> None:
                    help="inter-delta delay in seconds (0 = instant; the "
                         "hot-path bench uses 0 so client TTFT isolates "
                         "the master+wire span)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="advertised port (0 = pick free; the autoscaler's "
+                        "local actuator passes one so the instance name is "
+                        "known at launch)")
+    p.add_argument("--accept-delay", type=float, default=0.0,
+                   help="blocking per-accept delay: serializes accepts, "
+                        "capping this engine at ~1/delay req/s (the "
+                        "closed-loop autoscaling bench's capacity model)")
+    p.add_argument("--heartbeat-interval", type=float, default=0.5)
+    p.add_argument("--lease-ttl", type=float, default=1.0)
     args = p.parse_args()
 
     coord = connect(args.coordination_addr)
     engine = FakeEngine(coord, FakeEngineConfig(
         instance_type=InstanceType.parse(args.type),
         models=[args.model], reply_text=args.reply,
-        chunk_size=max(1, args.chunk_size), delay_s=max(0.0, args.delay))
+        chunk_size=max(1, args.chunk_size), delay_s=max(0.0, args.delay),
+        host=args.host, port=max(0, args.port),
+        accept_delay_s=max(0.0, args.accept_delay),
+        heartbeat_interval_s=max(0.05, args.heartbeat_interval),
+        lease_ttl_s=max(0.2, args.lease_ttl))
     ).start()
     print(f"fake engine {engine.name} ({args.type}) registered; Ctrl-C to stop",
           flush=True)

@@ -6,7 +6,7 @@
 # all randomness from one seeded RNG), so any failing iteration can be
 # replayed exactly with:   XLLM_CHAOS_SEED=<seed> pytest -m chaos
 #
-# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs|--state] [extra pytest args...]
+# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs|--state|--autoscale] [extra pytest args...]
 #   --masters   soak the multi-master plane drills (tests/test_multimaster.py:
 #               owner/master kill mid-stream, split-brain demotion, write-lease
 #               proxying) instead of the single-master failover drills.
@@ -24,6 +24,12 @@
 #               cross-thread write must be caught, and a heartbeat storm
 #               against a churning fleet must record no discipline
 #               violations).
+#   --autoscale soak the closed-loop autoscaler drills
+#               (tests/test_autoscaler.py: instance killed mid-burst is
+#               failed over AND replaced through the actuator, a
+#               DRAINING instance killed mid-drain falls back to the
+#               normal failover path, graceful drains retire without an
+#               eviction alarm).
 #
 # After the randomized-seed loop, the INSTRUMENTED legs run (one
 # iteration each, counted in the pass rate): XLLM_LOCK_DEBUG=1 (the
@@ -48,6 +54,9 @@ elif [ "${1:-}" = "--obs" ]; then
     shift
 elif [ "${1:-}" = "--state" ]; then
     SUITE="tests/test_state_debug.py"
+    shift
+elif [ "${1:-}" = "--autoscale" ]; then
+    SUITE="tests/test_autoscaler.py"
     shift
 fi
 cd "$(dirname "$0")/.."

@@ -30,6 +30,8 @@ class FakeChannel:
         self.cancels: list[str] = []
         self.flips: list[str] = []
         self.flip_ok = True
+        self.drains = 0
+        self.drain_ok = True
         self.closed = False
         FakeChannel.registry[name] = self
 
@@ -61,6 +63,10 @@ class FakeChannel:
         if self.flip_ok:
             self.flips.append(new_type)
         return self.flip_ok
+
+    def drain(self) -> bool:
+        self.drains += 1
+        return self.drain_ok
 
     def models(self):
         return []

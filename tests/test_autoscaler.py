@@ -1,0 +1,1041 @@
+"""Closed-loop fleet autoscaler (ISSUE 13): decision-kernel tables,
+guard edges (hysteresis / cooldowns / clamps / stale-telemetry hold),
+actuator contracts (hint publish, local process lifecycle, spawn-failure
+retry), the graceful DRAINING lifecycle, multimaster write-lease
+discipline, and the full-loop chaos drills (`scripts/chaos_soak.sh
+--autoscale`): a killed instance is replaced, a killed DRAINING instance
+falls back to the normal failover path, a flaky actuator never wedges
+the loop."""
+
+import shlex
+import sys
+import time
+
+import pytest
+import requests
+
+from xllm_service_tpu.autoscaler import (
+    Action,
+    AutoscalerConfig,
+    AutoscalerController,
+    HintActuator,
+    KernelInputs,
+    KernelState,
+    LocalProcessActuator,
+    decide,
+)
+from xllm_service_tpu.autoscaler.actuator import (
+    AUTOSCALER_ACTION_KEY_PREFIX,
+    AUTOSCALER_DECISION_KEY,
+    FleetActuator,
+)
+from xllm_service_tpu.autoscaler.controller import (
+    ACTION_FLIP,
+    ACTION_HOLD,
+    ACTION_SCALE_IN,
+    ACTION_SCALE_OUT,
+)
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.metrics import INSTANCE_EVICTIONS_TOTAL
+from xllm_service_tpu.common.request import Request
+from xllm_service_tpu.common.slo import SloMonitor
+from xllm_service_tpu.common.types import (
+    InstanceRuntimeState,
+    InstanceType,
+    LatencyMetrics,
+    LoadMetrics,
+)
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.devtools import ownership as _ownership
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.scheduler.instance_mgr import InstanceMgr
+from xllm_service_tpu.scheduler.policies import create_policy
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import FakeChannel, make_meta, wait_until
+
+CFG = AutoscalerConfig(min_instances=1, max_instances=4, breach_ticks=2,
+                       idle_ticks=3, scale_out_step=0.5,
+                       scale_out_cooldown_s=10.0, scale_in_cooldown_s=10.0,
+                       flip_cooldown_s=5.0, stale_hold_s=15.0)
+
+
+def inputs(**kw) -> KernelInputs:
+    base = dict(now_s=1000.0, live=2, max_load_age_s=1.0)
+    base.update(kw)
+    return KernelInputs(**base)
+
+
+@pytest.fixture(autouse=True)
+def _reset_channels():
+    FakeChannel.reset()
+    yield
+    FakeChannel.reset()
+
+
+@pytest.fixture()
+def coordination(store):
+    return InMemoryCoordination(store)
+
+
+# --------------------------------------------------------------------------
+# The pure decision kernel: input -> expected-action tables.
+# --------------------------------------------------------------------------
+class TestDecisionKernel:
+    def test_quiet_fleet_no_action(self):
+        st = KernelState(desired=2)
+        actions, nxt, _ = decide(
+            inputs(worst_fast_burn=0.5, pressure=0.5), st, CFG)
+        assert actions == []
+        assert nxt.desired == 2
+        assert nxt.breach_streak == 0
+
+    def test_breach_below_hysteresis_waits(self):
+        st = KernelState(desired=2)
+        actions, nxt, _ = decide(
+            inputs(breaching=("ttft",), worst_fast_burn=30.0), st, CFG)
+        assert actions == []
+        assert nxt.breach_streak == 1
+
+    def test_breach_at_hysteresis_scales_out(self):
+        st = KernelState(desired=2, breach_streak=1)
+        actions, nxt, _ = decide(
+            inputs(breaching=("ttft",), worst_fast_burn=30.0), st, CFG)
+        assert [a.kind for a in actions] == [ACTION_SCALE_OUT]
+        assert actions[0].count == 1          # ceil(2 * 0.5)
+        assert nxt.desired == 3
+        assert nxt.last_scale_out_s == 1000.0
+
+    def test_pressure_alone_triggers_breach(self):
+        st = KernelState(desired=2, breach_streak=1)
+        actions, _, _ = decide(inputs(pressure=2.0), st, CFG)
+        assert [a.kind for a in actions] == [ACTION_SCALE_OUT]
+
+    def test_kv_pressure_alone_triggers_breach(self):
+        st = KernelState(desired=2, breach_streak=1)
+        actions, _, _ = decide(inputs(kv_pressure=0.95), st, CFG)
+        assert [a.kind for a in actions] == [ACTION_SCALE_OUT]
+
+    def test_max_instances_clamp(self):
+        st = KernelState(desired=4, breach_streak=5)
+        actions, nxt, reasons = decide(
+            inputs(live=4, breaching=("ttft",)), st, CFG)
+        assert actions == []
+        assert nxt.desired == 4
+        assert any("max_instances" in r for r in reasons)
+
+    def test_scale_out_step_clamped_to_max(self):
+        cfg = AutoscalerConfig(max_instances=4, breach_ticks=1,
+                               scale_out_step=5.0)
+        st = KernelState(desired=3)
+        actions, nxt, _ = decide(
+            inputs(live=3, breaching=("ttft",)), st, cfg)
+        assert actions[0].count == 1          # 3 -> 4, never past max
+        assert nxt.desired == 4
+
+    def test_scale_out_cooldown(self):
+        st = KernelState(desired=2, breach_streak=5, last_scale_out_s=995.0)
+        actions, _, reasons = decide(
+            inputs(breaching=("ttft",)), st, CFG)
+        assert actions == []
+        assert any("cooldown" in r for r in reasons)
+        # Cooldown elapsed -> fires.
+        st2 = KernelState(desired=2, breach_streak=5,
+                          last_scale_out_s=985.0)
+        actions, _, _ = decide(inputs(breaching=("ttft",)), st2, CFG)
+        assert [a.kind for a in actions] == [ACTION_SCALE_OUT]
+
+    def test_idle_hysteresis_and_scale_in(self):
+        st = KernelState(desired=3)
+        for tick in range(CFG.idle_ticks - 1):
+            actions, st, _ = decide(
+                inputs(now_s=1000.0 + tick, live=3,
+                       scale_in_candidate="e3"), st, CFG)
+            assert actions == []
+        actions, nxt, _ = decide(
+            inputs(now_s=1010.0, live=3, scale_in_candidate="e3"), st, CFG)
+        assert [(a.kind, a.instance) for a in actions] == \
+            [(ACTION_SCALE_IN, "e3")]
+        assert nxt.desired == 2
+        assert nxt.idle_streak == 0           # streak resets after acting
+
+    def test_min_instances_clamp(self):
+        st = KernelState(desired=1, idle_streak=99)
+        actions, nxt, reasons = decide(
+            inputs(live=1, scale_in_candidate="e1"), st, CFG)
+        assert actions == []
+        assert nxt.desired == 1
+        assert any("min_instances" in r for r in reasons)
+
+    def test_scale_in_needs_candidate(self):
+        st = KernelState(desired=3, idle_streak=99)
+        actions, _, reasons = decide(
+            inputs(live=3, scale_in_candidate=""), st, CFG)
+        assert actions == []
+        assert any("role availability" in r for r in reasons)
+
+    def test_scale_in_waits_for_inflight_drain(self):
+        st = KernelState(desired=3, idle_streak=99)
+        actions, _, reasons = decide(
+            inputs(live=2, draining=1, scale_in_candidate="e2"), st, CFG)
+        assert actions == []
+        assert any("drain is already in progress" in r for r in reasons)
+
+    def test_stale_telemetry_holds_and_freezes_streaks(self):
+        st = KernelState(desired=2, breach_streak=1)
+        for age in (-1.0, CFG.stale_hold_s + 1.0):
+            actions, nxt, reasons = decide(
+                inputs(breaching=("ttft",), max_load_age_s=age), st, CFG)
+            assert [a.kind for a in actions] == [ACTION_HOLD]
+            assert nxt.breach_streak == 1     # frozen, not advanced
+            assert nxt.desired == 2
+            assert any("HOLD" in r for r in reasons)
+
+    def test_replacement_bypasses_cooldown_and_hysteresis(self):
+        # A scale-out just happened (cooldown hot) and there is no
+        # breach — but capacity below desired is replaced immediately.
+        st = KernelState(desired=3, last_scale_out_s=999.0)
+        actions, _, _ = decide(inputs(live=1), st, CFG)
+        assert [(a.kind, a.count) for a in actions] == [(ACTION_SCALE_OUT, 2)]
+        assert "replacing lost capacity" in actions[0].reason
+
+    def test_replacement_honors_spawn_retry_backoff(self):
+        st = KernelState(desired=3, retry_at_s=1005.0, retry_count=2)
+        actions, _, reasons = decide(inputs(live=1), st, CFG)
+        assert actions == []
+        assert any("backed off" in r for r in reasons)
+        # Backoff elapsed -> replacement resumes.
+        actions, _, _ = decide(inputs(now_s=1006.0, live=1), st, CFG)
+        assert [a.kind for a in actions] == [ACTION_SCALE_OUT]
+
+    def test_external_join_raises_desired(self):
+        st = KernelState(desired=2)
+        _, nxt, reasons = decide(inputs(live=4), st, CFG)
+        assert nxt.desired == 4
+        assert any("observed fleet" in r for r in reasons)
+
+    def test_flip_proposal_enacted_with_cooldown(self):
+        st = KernelState(desired=2)
+        actions, nxt, _ = decide(
+            inputs(flip_proposals=(("p2", "DECODE"),)), st, CFG)
+        assert [(a.kind, a.instance, a.target_type) for a in actions] == \
+            [(ACTION_FLIP, "p2", "DECODE")]
+        # Second proposal inside the flip cooldown is deferred.
+        actions, _, reasons = decide(
+            inputs(now_s=1002.0, flip_proposals=(("p1", "DECODE"),)),
+            nxt, CFG)
+        assert actions == []
+        assert any("deferred" in r for r in reasons)
+
+    def test_replacement_never_exceeds_max_instances(self):
+        """Review regression: the replacement path must honor the fleet
+        bounds too — an over-joined fleet is tolerated while alive but
+        never re-grown past max by the controller."""
+        st = KernelState(desired=2)
+        # 10 engines joined externally with max_instances=4: desired
+        # clamps to max, no replacement storm when some later die.
+        _, nxt, _ = decide(inputs(live=10), st, CFG)
+        assert nxt.desired == CFG.max_instances
+        actions, _, _ = decide(inputs(live=5), nxt, CFG)
+        assert actions == []          # 5 live >= desired 4: nothing to do
+        actions, _, _ = decide(inputs(live=3), nxt, CFG)
+        assert [(a.kind, a.count) for a in actions] == [(ACTION_SCALE_OUT, 1)]
+
+    def test_min_above_max_misconfig_normalized(self):
+        opts = _opts(autoscaler_min_instances=9, autoscaler_max_instances=4)
+        cfg = AutoscalerConfig.from_options(opts)
+        assert cfg.max_instances >= cfg.min_instances
+
+    def test_suspect_instance_is_not_lost_capacity(self):
+        """Review regression: a network-blip SUSPECT either recovers or
+        is evicted within the detection window — replacing it on the
+        next tick (hysteresis-free) would permanently inflate the fleet
+        when it recovers."""
+        st = KernelState(desired=3)
+        actions, nxt, _ = decide(
+            inputs(live=2, suspect=1), st, CFG)
+        assert actions == []
+        assert nxt.desired == 3
+        # Evicted (suspect gone, still dead) -> NOW it is lost capacity.
+        actions, _, _ = decide(inputs(live=2, suspect=0), nxt, CFG)
+        assert [(a.kind, a.count) for a in actions] == [(ACTION_SCALE_OUT, 1)]
+
+    def test_one_scale_action_per_tick(self):
+        # Breaching AND missing capacity: replacement wins, growth waits.
+        st = KernelState(desired=3, breach_streak=9)
+        actions, _, _ = decide(
+            inputs(live=2, breaching=("ttft",)), st, CFG)
+        scale_actions = [a for a in actions
+                         if a.kind in (ACTION_SCALE_OUT, ACTION_SCALE_IN)]
+        assert len(scale_actions) == 1
+        assert "replacing lost capacity" in scale_actions[0].reason
+
+
+# --------------------------------------------------------------------------
+# Controller over a live InstanceMgr (fake channels) + recording actuator.
+# --------------------------------------------------------------------------
+class RecordingActuator(FleetActuator):
+    name = "recording"
+
+    def __init__(self, scale_out_result=None):
+        self.scale_outs: list[tuple[int, str]] = []
+        self.scale_ins: list[str] = []
+        self.reaps: list[str] = []
+        self._result = scale_out_result   # None = echo count
+
+    def scale_out(self, count, reason):
+        self.scale_outs.append((count, reason))
+        return count if self._result is None else self._result
+
+    def scale_in(self, instance, reason):
+        self.scale_ins.append(instance)
+        return True
+
+    def reap(self, instance):
+        self.reaps.append(instance)
+
+
+def _opts(**kw) -> ServiceOptions:
+    base = dict(autoscaler_enabled=True, autoscaler_breach_ticks=2,
+                autoscaler_idle_ticks=2, autoscaler_min_instances=1,
+                autoscaler_max_instances=4,
+                autoscaler_scale_out_cooldown_s=0.2,
+                autoscaler_scale_in_cooldown_s=0.2,
+                autoscaler_flip_cooldown_s=0.1,
+                autoscaler_stale_hold_s=30.0,
+                autoscaler_drain_grace_s=0.05,
+                autoscaler_spawn_retry_base_s=0.05,
+                autoscaler_spawn_retry_max_s=0.2)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def make_mgr(coordination, n_mix=2, opts=None) -> InstanceMgr:
+    mgr = InstanceMgr(coordination, opts or _opts(), start_threads=False,
+                      channel_factory=FakeChannel.factory)
+    for i in range(n_mix):
+        mgr.register_instance(make_meta(f"e{i + 1}"), link_peers=False)
+    return mgr
+
+
+def heartbeat_all(mgr):
+    for meta in mgr.list_instances():
+        mgr.record_instance_heartbeat(
+            meta.name, meta.incarnation_id, LoadMetrics(), LatencyMetrics())
+
+
+def breach_monitor(bad_samples=30) -> SloMonitor:
+    mon = SloMonitor()
+    mon.configure(ttft_ms=100.0, tpot_ms=50.0, budget=0.01,
+                  fast_s=60.0, slow_s=120.0, alert=14.4)
+    for _ in range(bad_samples):
+        mon.record_ttft(500.0)   # every sample over target -> burn 100x
+    return mon
+
+
+def make_controller(mgr, opts=None, actuator=None, monitor=None,
+                    is_master=None):
+    opts = opts or _opts()
+    return AutoscalerController(
+        opts, mgr, actuator if actuator is not None else RecordingActuator(),
+        is_master_fn=is_master or (lambda: True),
+        slo_monitor=monitor or SloMonitor())
+
+
+class TestController:
+    def test_disabled_controller_never_ticks(self, coordination):
+        mgr = make_mgr(coordination)
+        ctl = make_controller(mgr, opts=_opts(autoscaler_enabled=False))
+        assert ctl.tick() is None
+        assert ctl.report()["ticks"] == 0
+        mgr.stop()
+
+    def test_burn_breach_drives_scale_out(self, coordination):
+        mgr = make_mgr(coordination, n_mix=2)
+        heartbeat_all(mgr)
+        act = RecordingActuator()
+        ctl = make_controller(mgr, actuator=act, monitor=breach_monitor())
+        rec1 = ctl.tick()
+        assert rec1["actions"] == []          # hysteresis tick 1
+        rec2 = ctl.tick()
+        kinds = [a["kind"] for a in rec2["actions"]]
+        assert kinds == [ACTION_SCALE_OUT]
+        assert act.scale_outs and act.scale_outs[0][0] == 1
+        assert rec2["inputs"]["breaching"] == ["ttft"]
+        mgr.stop()
+
+    def test_stale_telemetry_holds(self, coordination):
+        mgr = make_mgr(coordination, n_mix=2)   # no heartbeats -> age -1
+        act = RecordingActuator()
+        ctl = make_controller(mgr, actuator=act, monitor=breach_monitor())
+        rec = ctl.tick()
+        assert [a["kind"] for a in rec["actions"]] == [ACTION_HOLD]
+        assert act.scale_outs == []
+        mgr.stop()
+
+    def test_idle_fleet_scale_in_drains_least_loaded(self, coordination):
+        mgr = make_mgr(coordination, n_mix=3)
+        heartbeat_all(mgr)
+        # e1 is visibly busy; e2/e3 idle -> victim must not be e1.
+        mgr.record_instance_heartbeat(
+            "e1", mgr.get_instance_meta("e1").incarnation_id,
+            LoadMetrics(waiting_requests_num=5, running_requests_num=3))
+        act = RecordingActuator()
+        ctl = make_controller(mgr, actuator=act)
+        recs = [ctl.tick(), ctl.tick()]
+        acted = [a for rec in recs for a in rec["actions"]]
+        assert [a["kind"] for a in acted] == [ACTION_SCALE_IN]
+        victim = acted[0]["instance"]
+        assert victim in ("e2", "e3")
+        # The drain is enqueued; the reconcile pass marks DRAINING and
+        # the routing snapshot stops offering the victim.
+        mgr.reconcile_once()
+        assert mgr.get_instance_state(victim) == InstanceRuntimeState.DRAINING
+        assert victim not in mgr.routing_snapshot().schedulable
+        assert FakeChannel.registry[victim].drains == 1
+        mgr.stop()
+
+    def test_scale_in_never_breaks_role_availability(self, coordination):
+        mgr = InstanceMgr(coordination, _opts(), start_threads=False,
+                          channel_factory=FakeChannel.factory)
+        mgr.register_instance(make_meta("p1", InstanceType.PREFILL),
+                              link_peers=False)
+        mgr.register_instance(make_meta("d1", InstanceType.DECODE),
+                              link_peers=False)
+        heartbeat_all(mgr)
+        act = RecordingActuator()
+        ctl = make_controller(mgr, actuator=act)
+        for _ in range(4):
+            rec = ctl.tick()
+        assert act.scale_ins == []
+        assert any("role availability" in r for r in rec["reasons"])
+        mgr.stop()
+
+    def test_spawn_failure_backs_off_and_recovers(self, coordination):
+        mgr = make_mgr(coordination, n_mix=1)
+        heartbeat_all(mgr)
+        act = RecordingActuator(scale_out_result=0)   # every launch fails
+        ctl = make_controller(mgr, actuator=act, monitor=breach_monitor())
+        ctl.tick()
+        rec = ctl.tick()                   # acts: scale_out -> fails
+        assert rec["enacted"][0]["launched"] == 0
+        assert ctl.report()["state"]["retry_count"] == 1
+        n_calls = len(act.scale_outs)
+        rec = ctl.tick()                   # inside backoff: no new launch
+        assert len(act.scale_outs) == n_calls
+        assert any("backed off" in r or "backoff" in r
+                   for r in rec["reasons"])
+        # Loop never wedges: ticks keep completing and, once the actuator
+        # heals and the backoff elapses, the replacement lands.
+        act._result = None
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            rec = ctl.tick()
+            if len(act.scale_outs) > n_calls:
+                break
+            time.sleep(0.05)
+        assert len(act.scale_outs) > n_calls
+        assert ctl.report()["state"]["retry_count"] == 0
+        mgr.stop()
+
+    def test_killed_capacity_gets_replaced(self, coordination):
+        mgr = make_mgr(coordination, n_mix=3)
+        heartbeat_all(mgr)
+        act = RecordingActuator()
+        ctl = make_controller(mgr, actuator=act)
+        ctl.tick()
+        assert ctl.report()["state"]["desired"] == 3
+        mgr.deregister_instance("e2", reason="test kill")
+        heartbeat_all(mgr)
+        rec = ctl.tick()
+        assert [(a["kind"], a["count"]) for a in rec["actions"]] == \
+            [(ACTION_SCALE_OUT, 1)]
+        assert "replacing lost capacity" in rec["actions"][0]["reason"]
+        mgr.stop()
+
+    def test_flip_proposals_route_through_controller(self, coordination):
+        mgr = make_mgr(coordination, n_mix=0)
+        for n, t in (("p1", InstanceType.PREFILL),
+                     ("p2", InstanceType.PREFILL),
+                     ("d1", InstanceType.DECODE)):
+            mgr.register_instance(make_meta(n, t), link_peers=False)
+        heartbeat_all(mgr)
+        ctl = make_controller(mgr)
+        ctl.propose_flip("p2", InstanceType.DECODE)
+        rec = ctl.tick()
+        assert [a["kind"] for a in rec["actions"]] == [ACTION_FLIP]
+        mgr.reconcile_once()   # the reconcile thread executes the flip
+        assert mgr.get_instance_meta("p2").type == InstanceType.DECODE
+        mgr.stop()
+
+    def test_deferred_flip_proposal_survives_cooldown(self, coordination):
+        """Review regression: a proposal that hits the flip cooldown is
+        logged as 'deferred' — it must actually survive to a later tick
+        instead of being silently dropped."""
+        mgr = make_mgr(coordination, n_mix=0)
+        for n, t in (("p1", InstanceType.PREFILL),
+                     ("p2", InstanceType.PREFILL),
+                     ("p3", InstanceType.PREFILL),
+                     ("d1", InstanceType.DECODE)):
+            mgr.register_instance(make_meta(n, t), link_peers=False)
+        heartbeat_all(mgr)
+        # idle_ticks pinned high: this test watches the flip queue, not
+        # the idle scale-in path.
+        ctl = make_controller(mgr, opts=_opts(autoscaler_flip_cooldown_s=0.3,
+                                              autoscaler_idle_ticks=99))
+        ctl.propose_flip("p2", InstanceType.DECODE)
+        rec = ctl.tick()
+        assert [a["kind"] for a in rec["actions"]] == [ACTION_FLIP]
+        ctl.propose_flip("p3", InstanceType.DECODE)
+        rec = ctl.tick()                  # inside the flip cooldown
+        assert rec["actions"] == []
+        assert any("deferred" in r for r in rec["reasons"])
+        time.sleep(0.35)
+        rec = ctl.tick()                  # cooldown over: p3 still queued
+        assert [(a["kind"], a["instance"]) for a in rec["actions"]] == \
+            [(ACTION_FLIP, "p3")]
+        mgr.stop()
+
+    def test_drains_dropped_after_demotion(self, coordination):
+        """Review regression (write-lease): a drain enqueued by the
+        elected master's controller must not be enacted by a frontend
+        that was demoted before its reconcile pass ran."""
+        mgr = make_mgr(coordination, n_mix=2)
+        mgr.request_drain("e2")
+        mgr._is_master = False            # demotion lands before reconcile
+        mgr.reconcile_once()
+        assert mgr.get_instance_state("e2") == InstanceRuntimeState.ACTIVE
+        assert FakeChannel.registry["e2"].drains == 0
+        # Re-elected: a fresh drain request is enacted normally.
+        mgr._is_master = True
+        mgr.request_drain("e2")
+        mgr.reconcile_once()
+        assert mgr.get_instance_state("e2") == InstanceRuntimeState.DRAINING
+        mgr.stop()
+
+    def test_decision_log_is_bounded_and_reasoned(self, coordination):
+        mgr = make_mgr(coordination, n_mix=1)
+        heartbeat_all(mgr)
+        ctl = make_controller(
+            mgr, opts=_opts(autoscaler_decision_log_capacity=8))
+        for _ in range(20):
+            ctl.tick()
+        rep = ctl.report()
+        assert len(rep["decisions"]) <= 8
+        assert rep["ticks"] == 20
+        assert rep["last_decision_age_s"] >= 0.0
+        mgr.stop()
+
+
+# --------------------------------------------------------------------------
+# Write-lease discipline: only the elected master's controller acts.
+# --------------------------------------------------------------------------
+class TestWriteLease:
+    def test_non_master_controller_acts_on_nothing(self, coordination):
+        mgr = make_mgr(coordination, n_mix=2)
+        heartbeat_all(mgr)
+        act = RecordingActuator()
+        ctl = make_controller(mgr, actuator=act,
+                              monitor=breach_monitor(),
+                              is_master=lambda: False)
+        for _ in range(3):
+            assert ctl.tick() is None
+        assert act.scale_outs == [] and act.scale_ins == []
+        assert ctl.report()["ticks"] == 0
+        assert ctl.report()["decisions"] == []
+        mgr.stop()
+
+    def test_demoted_master_straggler_tick_acts_on_nothing(self, coordination):
+        """The multimaster drill: a controller that was acting loses the
+        election between ticks — its straggler tick must gather nothing,
+        enact nothing, log nothing."""
+        mgr = make_mgr(coordination, n_mix=2)
+        heartbeat_all(mgr)
+        mastership = {"is_master": True}
+        act = RecordingActuator()
+        ctl = make_controller(mgr, actuator=act, monitor=breach_monitor(),
+                              is_master=lambda: mastership["is_master"])
+        ctl.tick()
+        ctl.tick()
+        assert act.scale_outs          # acted while elected
+        calls = len(act.scale_outs)
+        ticks = ctl.report()["ticks"]
+        mastership["is_master"] = False   # demotion lands
+        for _ in range(3):
+            assert ctl.tick() is None     # straggler ticks
+        assert len(act.scale_outs) == calls
+        assert ctl.report()["ticks"] == ticks
+        mgr.stop()
+
+    def test_scheduler_demotion_gates_controller(self, store):
+        """Multimaster end-to-end: two schedulers over one coordination
+        plane, both with the autoscaler enabled. Only the elected
+        master's controller ticks; after the election moves, the old
+        master's next sync pass demotes it and its controller goes
+        silent while the new master's starts acting."""
+        from xllm_service_tpu.rpc import MASTER_KEY
+        from xllm_service_tpu.scheduler.scheduler import Scheduler
+
+        opts = _opts(lease_ttl_s=1.0)
+        s1 = Scheduler(opts, coord=InMemoryCoordination(store),
+                       start_threads=False)
+        s2 = Scheduler(opts.with_overrides(rpc_port=8890),
+                       coord=InMemoryCoordination(store),
+                       start_threads=False)
+        try:
+            assert s1.is_master and not s2.is_master
+            s1.sync_once()
+            s2.sync_once()
+            assert s1.autoscaler.report()["ticks"] == 1
+            assert s2.autoscaler.report()["ticks"] == 0   # replica: silent
+            # Election moves (s1's lease lapsed during an outage and s2
+            # won): s1's next sync pass must demote and its straggler
+            # autoscaler tick acts on nothing.
+            s1._coord.set(MASTER_KEY, s2.self_addr)
+            s2.is_master = True
+            s1.sync_once()
+            assert not s1.is_master
+            assert s1.autoscaler.report()["ticks"] == 1   # no new tick
+            s2.sync_once()
+            assert s2.autoscaler.report()["ticks"] == 1   # new master acts
+        finally:
+            s1.stop()
+            s2.stop()
+
+
+# --------------------------------------------------------------------------
+# Actuators.
+# --------------------------------------------------------------------------
+class TestHintActuator:
+    def test_publishes_action_records(self, coordination):
+        act = HintActuator(coordination)
+        assert act.scale_out(2, "burn over alert") == 2
+        act.scale_in("e2", "idle")
+        act.reap("e2")
+        latest = coordination.get(AUTOSCALER_DECISION_KEY)
+        assert latest is not None
+        import json
+        d = json.loads(latest)
+        assert d["action"] == "scale_in" and d["phase"] == "drained"
+        stream = coordination.get_prefix(AUTOSCALER_ACTION_KEY_PREFIX)
+        assert len(stream) == 3
+
+    def test_identical_unsatisfied_hint_not_respammed(self, coordination):
+        act = HintActuator(coordination)
+        act.scale_out(2, "replacing lost capacity")
+        act.scale_out(2, "replacing lost capacity")   # same hint, same tick
+        stream = coordination.get_prefix(AUTOSCALER_ACTION_KEY_PREFIX)
+        assert len(stream) == 1
+
+
+class TestLocalProcessActuator:
+    def _actuator(self, cmd, **opt_kw):
+        return LocalProcessActuator(
+            _opts(autoscaler_actuator="local", **opt_kw),
+            spawn_cmd=cmd)
+
+    def test_spawn_and_reap(self):
+        cmd = f"{shlex.quote(sys.executable)} -c " \
+              f"{shlex.quote('import time; time.sleep(30)')}"
+        act = self._actuator(cmd)
+        try:
+            assert act.scale_out(1, "test") == 1
+            kids = act.live_children()
+            assert len(kids) == 1 and kids[0].startswith("127.0.0.1:")
+            act.reap(kids[0])
+            assert act.live_children() == []
+        finally:
+            act.stop()
+
+    def test_spawn_failure_reports_zero(self):
+        act = self._actuator("/nonexistent-binary-xyz --port {port}")
+        try:
+            assert act.scale_out(2, "test") == 0
+            assert act.spawn_failures_total == 2
+        finally:
+            act.stop()
+
+    def test_immediate_child_death_detected(self):
+        cmd = f"{shlex.quote(sys.executable)} -c " \
+              f"{shlex.quote('import sys; sys.exit(3)')}"
+        act = self._actuator(cmd)
+        try:
+            assert act.scale_out(1, "test") == 0
+            assert act.spawn_failures_total == 1
+        finally:
+            act.stop()
+
+    def test_runaway_cap(self):
+        cmd = f"{shlex.quote(sys.executable)} -c " \
+              f"{shlex.quote('import time; time.sleep(30)')}"
+        act = self._actuator(cmd, autoscaler_max_instances=1)
+        try:
+            assert act.scale_out(5, "test") == act._max_procs
+        finally:
+            act.stop()
+
+
+# --------------------------------------------------------------------------
+# Rebuilt SLO policy: lock-free + staleness-aware (the sensing side).
+# --------------------------------------------------------------------------
+class _PoisonLock:
+    def __enter__(self):
+        raise AssertionError("manager lock taken on the SLO hot path")
+
+    def __exit__(self, *exc):
+        return False
+
+    def acquire(self, *a, **k):
+        raise AssertionError("manager lock taken on the SLO hot path")
+
+    def release(self):
+        pass
+
+
+class TestRebuiltSloPolicy:
+    def _fleet(self, coordination):
+        mgr = InstanceMgr(coordination, _opts(), start_threads=False,
+                          channel_factory=FakeChannel.factory)
+        ttft = [[128, 20.0], [512, 60.0], [2048, 200.0]]
+        tpot = [[1, 100, 5.0], [4, 1000, 10.0], [16, 8000, 30.0]]
+        mgr.register_instance(make_meta(
+            "p1", InstanceType.PREFILL, ttft_profiling_data=ttft),
+            link_peers=False)
+        mgr.register_instance(make_meta(
+            "d1", InstanceType.DECODE, tpot_profiling_data=tpot),
+            link_peers=False)
+        return mgr
+
+    def test_selection_is_lock_free(self, coordination):
+        """Regression (ISSUE 13 satellite): the SLO selection must not
+        touch `_metrics_lock` — poison it and select anyway."""
+        mgr = self._fleet(coordination)
+        policy = create_policy("SLO_AWARE", mgr, None, _opts())
+        with _ownership.escape("test poisons the lock to prove the hot "
+                               "path never takes it"):
+            mgr._metrics_lock = _PoisonLock()
+        r = policy.select_instances_pair(
+            Request(service_request_id="s1", token_ids=list(range(256))))
+        assert r.prefill_name == "p1" and r.decode_name == "d1"
+
+    def test_request_load_view_tracks_accounting(self, coordination):
+        from xllm_service_tpu.common.types import RequestAction
+
+        mgr = self._fleet(coordination)
+        req = Request(service_request_id="s1", token_ids=list(range(64)))
+        req.routing.prefill_name = "p1"
+        req.routing.decode_name = "d1"
+        mgr.update_request_metrics(req, RequestAction.SCHEDULE)
+        assert mgr.get_request_loads()["p1"] == (1, 64, 0, 0)
+        mgr.update_request_metrics(req, RequestAction.FINISH_PREFILL,
+                                   n_new=2)
+        view = mgr.get_request_loads()
+        assert view["p1"] == (0, 0, 0, 0)
+        assert view["d1"] == (0, 0, 1, 66)
+        mgr.stop()
+
+    def test_no_flip_of_stale_idle_prefill(self, coordination):
+        """A stale idle-LOOKING prefill may be carrying load its
+        telemetry stopped reporting — never a flip target."""
+        opts = _opts(loadinfo_stale_after_s=0.15, target_tpot_ms=1.0)
+        mgr = InstanceMgr(coordination, opts, start_threads=False,
+                          channel_factory=FakeChannel.factory)
+        tpot_awful = [[1, 100, 500.0], [4, 1000, 900.0],
+                      [16, 8000, 2000.0]]
+        mgr.register_instance(make_meta("p1", InstanceType.PREFILL),
+                              link_peers=False)
+        mgr.register_instance(make_meta("p2", InstanceType.PREFILL),
+                              link_peers=False)
+        mgr.register_instance(make_meta(
+            "d1", InstanceType.DECODE, tpot_profiling_data=tpot_awful),
+            link_peers=False)
+        for n in ("p1", "p2", "d1"):
+            mgr.record_instance_heartbeat(
+                n, mgr.get_instance_meta(n).incarnation_id, LoadMetrics())
+        time.sleep(0.25)
+        for n in ("p1", "d1"):            # p2's telemetry goes stale
+            mgr.record_instance_heartbeat(
+                n, mgr.get_instance_meta(n).incarnation_id, LoadMetrics())
+        assert mgr.stale_load_names() == {"p2"}
+        flips: list = []
+        from xllm_service_tpu.scheduler.policies.slo_aware import \
+            select_pair_on_slo
+
+        select_pair_on_slo(
+            mgr, opts, Request(service_request_id="s1",
+                               token_ids=list(range(128))),
+            flip_sink=lambda n, t: flips.append((n, t)))
+        assert flips == []                # p2 stale -> not flipped
+        mgr.stop()
+
+
+# --------------------------------------------------------------------------
+# Planner: flips through the controller sink + staleness regression.
+# --------------------------------------------------------------------------
+class TestPlannerThroughController:
+    def test_planner_flip_rides_sink(self, coordination):
+        from xllm_service_tpu.scheduler.planner import Planner
+
+        mgr = InstanceMgr(coordination, _opts(), start_threads=False,
+                          channel_factory=FakeChannel.factory)
+        for n, t in (("p1", InstanceType.PREFILL),
+                     ("p2", InstanceType.PREFILL),
+                     ("d1", InstanceType.DECODE)):
+            mgr.register_instance(make_meta(n, t), link_peers=False)
+        mgr.record_instance_heartbeat(
+            "p1", mgr.get_instance_meta("p1").incarnation_id,
+            LoadMetrics(waiting_requests_num=4, running_requests_num=2))
+        mgr.record_instance_heartbeat(
+            "p2", mgr.get_instance_meta("p2").incarnation_id, LoadMetrics())
+        mgr.record_instance_heartbeat(
+            "d1", mgr.get_instance_meta("d1").incarnation_id,
+            LoadMetrics(running_requests_num=8),
+            LatencyMetrics(recent_max_tbt=500.0))
+        planner = Planner(mgr, _opts())
+        proposals: list = []
+        planner.flip_sink = lambda n, t: proposals.append((n, t))
+        d = planner.plan_once()
+        assert d.flips_requested == [["p2", "DECODE"]]
+        assert proposals == [("p2", InstanceType.DECODE)]
+        # Nothing hit the instance manager's pending-flip queue directly.
+        with mgr._flip_lock:
+            assert mgr._pending_flips == {}
+        mgr.stop()
+
+    def test_planner_skips_stale_flip_target(self, coordination):
+        from xllm_service_tpu.scheduler.planner import Planner
+
+        opts = _opts(loadinfo_stale_after_s=0.15)
+        mgr = InstanceMgr(coordination, opts, start_threads=False,
+                          channel_factory=FakeChannel.factory)
+        for n, t in (("p1", InstanceType.PREFILL),
+                     ("p2", InstanceType.PREFILL),
+                     ("d1", InstanceType.DECODE)):
+            mgr.register_instance(make_meta(n, t), link_peers=False)
+        # p2 (the only idle prefill) heartbeats once, then goes silent.
+        mgr.record_instance_heartbeat(
+            "p2", mgr.get_instance_meta("p2").incarnation_id, LoadMetrics())
+        time.sleep(0.25)
+        mgr.record_instance_heartbeat(
+            "p1", mgr.get_instance_meta("p1").incarnation_id,
+            LoadMetrics(waiting_requests_num=4, running_requests_num=2))
+        mgr.record_instance_heartbeat(
+            "d1", mgr.get_instance_meta("d1").incarnation_id,
+            LoadMetrics(running_requests_num=8),
+            LatencyMetrics(recent_max_tbt=500.0))
+        planner = Planner(mgr, opts)
+        d = planner.plan_once()
+        assert d.flips_requested == []
+        assert "p2" in d.stale_load_entries
+        mgr.stop()
+
+
+# --------------------------------------------------------------------------
+# Full-stack drills: Master + fake engines + in-process actuator.
+# --------------------------------------------------------------------------
+class FakeEngineActuator(FleetActuator):
+    """In-process actuator for hermetic closed-loop drills: 'launching an
+    instance' starts a FakeEngine against the shared coordination
+    store."""
+
+    name = "fake-engine"
+
+    def __init__(self, store, **cfg_kw):
+        self._store = store
+        self._cfg_kw = cfg_kw
+        self.engines: dict[str, FakeEngine] = {}
+
+    def scale_out(self, count, reason):
+        for _ in range(count):
+            e = FakeEngine(InMemoryCoordination(self._store),
+                           FakeEngineConfig(**self._cfg_kw)).start()
+            self.engines[e.name] = e
+        return count
+
+    def pending(self, live):
+        return sum(1 for n in self.engines if n not in live)
+
+    def reap(self, instance):
+        e = self.engines.pop(instance, None)
+        if e is not None:
+            e.stop()
+
+    def stop(self):
+        for e in list(self.engines.values()):
+            e.stop()
+        self.engines.clear()
+
+
+REPLY = "Scaling is the art of adding exactly what the burst demands."
+
+ENGINE_CFG = dict(reply_text=REPLY, chunk_size=4, delay_s=0.05,
+                  heartbeat_interval_s=0.1, lease_ttl_s=0.5)
+
+
+def _master_opts(**kw) -> ServiceOptions:
+    base = dict(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        lease_ttl_s=0.5, reconcile_interval_s=0.05,
+        heartbeat_silence_to_suspect_s=0.3,
+        detect_disconnected_instance_interval_s=0.3,
+        health_probe_attempts=1, health_probe_timeout_s=0.2,
+        sync_interval_s=0.1,
+        failover_backoff_base_s=0.05, failover_backoff_max_s=0.3,
+        rpc_backoff_base_s=0.02, rpc_backoff_max_s=0.1,
+        autoscaler_enabled=True,
+        # Floor at the drill fleet size: these drills exercise
+        # replacement and drains, not idle scale-in — without the floor
+        # the controller (correctly) trims the idle 2-engine fleet to 1
+        # mid-drill.
+        autoscaler_min_instances=2,
+        autoscaler_breach_ticks=2, autoscaler_idle_ticks=3,
+        autoscaler_scale_out_cooldown_s=0.3,
+        autoscaler_scale_in_cooldown_s=0.3,
+        autoscaler_stale_hold_s=30.0,
+        autoscaler_drain_grace_s=0.05,
+        autoscaler_drain_deadline_s=10.0,
+        autoscaler_spawn_retry_base_s=0.05,
+        autoscaler_spawn_retry_max_s=0.3,
+        # The drills isolate replacement/drain mechanics: the fake
+        # engine's deliberate 50ms inter-delta delay must not read as a
+        # TPOT breach, or burn-driven growth runs the fleet to max
+        # mid-drill (that loop is covered by the kernel tests and the
+        # closed-loop bench).
+        slo_ttft_ms=60000.0, slo_tpot_ms=60000.0)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+@pytest.fixture()
+def scaled_cluster(store):
+    """Master (autoscaler on, in-process actuator) + 2 fake engines."""
+    master = Master(_master_opts(), coord=InMemoryCoordination(store))
+    master.start()
+    engines = [FakeEngine(InMemoryCoordination(store),
+                          FakeEngineConfig(**ENGINE_CFG)).start()
+               for _ in range(2)]
+    mgr = master.scheduler.instance_mgr
+    assert wait_until(
+        lambda: len(mgr.routing_snapshot().schedulable) == 2, timeout=5)
+    # Swap in the hermetic actuator only once the external fleet is
+    # registered: the cold-start ticks (live=0, desired=min) go to the
+    # default hint actuator, so they publish intents instead of
+    # spawning extra engines under the drill.
+    act = FakeEngineActuator(store, **ENGINE_CFG)
+    with _ownership.escape("test injects the hermetic in-process "
+                           "actuator between ticks"):
+        master.scheduler.autoscaler._actuator = act
+    yield master, engines, act
+    act.stop()
+    for e in engines:
+        e.stop()
+    master.stop()
+
+
+def _base(master) -> str:
+    return f"http://127.0.0.1:{master.http_port}"
+
+
+def _stream(master, timeout=30) -> str:
+    r = requests.post(_base(master) + "/v1/completions", json={
+        "model": "fake-model", "prompt": "autoscale", "stream": True,
+        "max_tokens": 64}, stream=True, timeout=timeout)
+    assert r.status_code == 200, r.text
+    text = []
+    for line in r.iter_lines():
+        if not line.startswith(b"data: ") or line == b"data: [DONE]":
+            continue
+        import json as _json
+
+        payload = _json.loads(line[len(b"data: "):])
+        text.append(payload["choices"][0]["text"])
+    return "".join(text)
+
+
+@pytest.mark.chaos
+class TestClosedLoopDrills:
+    def test_admin_autoscaler_surface(self, scaled_cluster):
+        master, engines, act = scaled_cluster
+        assert wait_until(lambda: requests.get(
+            _base(master) + "/admin/autoscaler",
+            timeout=5).json()["ticks"] > 0, timeout=10)
+        assert wait_until(lambda: requests.get(
+            _base(master) + "/admin/autoscaler",
+            timeout=5).json()["state"]["desired"] == 2, timeout=10)
+        rep = requests.get(_base(master) + "/admin/autoscaler",
+                           timeout=5).json()
+        assert rep["enabled"] and rep["master"]
+        assert rep["actuator"] == "fake-engine"
+        assert rep["decisions"]
+        metrics = requests.get(_base(master) + "/metrics", timeout=5).text
+        assert "autoscaler_last_decision_age_seconds" in metrics
+        assert 'fleet_size{role="prefill"}' in metrics
+
+    def test_instance_killed_mid_burst_is_replaced(self, scaled_cluster):
+        """Chaos drill (ISSUE 13): an instance killed while serving gets
+        its in-flight request failed over AND the lost capacity
+        replaced through the actuator."""
+        master, engines, act = scaled_cluster
+        mgr = master.scheduler.instance_mgr
+        assert wait_until(
+            lambda: master.scheduler.autoscaler.report()["state"]
+            ["desired"] == 2, timeout=10)
+        # Kill the engine serving a live stream, mid-stream.
+        import threading
+
+        texts: list[str] = []
+        t = threading.Thread(target=lambda: texts.append(_stream(master)))
+        t.start()
+        assert wait_until(
+            lambda: any(e.accepted_requests for e in engines), timeout=5)
+        victim = next(e for e in engines if e.accepted_requests)
+        time.sleep(0.1)       # a few deltas in flight
+        victim.kill()
+        t.join(timeout=30)
+        assert texts and texts[0] == REPLY    # failover completed it
+        # Replacement: the controller observes live < desired and spawns
+        # a fresh engine through the actuator.
+        assert wait_until(lambda: len(act.engines) >= 1, timeout=10)
+        assert wait_until(
+            lambda: len(mgr.routing_snapshot().schedulable) == 2,
+            timeout=10)
+
+    def test_graceful_drain_retires_idle_instance(self, scaled_cluster):
+        master, engines, act = scaled_cluster
+        mgr = master.scheduler.instance_mgr
+        victim = engines[1].name
+        mgr.request_drain(victim)
+        # Reconcile marks DRAINING; the engine self-stops once idle; the
+        # lease-lapse handler deregisters it as cleanly drained.
+        assert wait_until(
+            lambda: mgr.get_instance_meta(victim) is None, timeout=10)
+        # Planned retirement, not an eviction.
+        assert INSTANCE_EVICTIONS_TOTAL.labels(
+            instance=victim).value() == 0
+        # Traffic still flows on the survivor.
+        assert _stream(master) == REPLY
+
+    def test_draining_instance_killed_mid_drain_fails_over(
+            self, scaled_cluster):
+        """Chaos drill (ISSUE 13): a DRAINING instance that dies before
+        its in-flight streams finish falls back to the NORMAL failover
+        path — the client still gets the full reply."""
+        master, engines, act = scaled_cluster
+        mgr = master.scheduler.instance_mgr
+        import threading
+
+        texts: list[str] = []
+        t = threading.Thread(target=lambda: texts.append(_stream(master)))
+        t.start()
+        assert wait_until(
+            lambda: any(e.accepted_requests for e in engines), timeout=5)
+        victim = next(e for e in engines if e.accepted_requests)
+        mgr.request_drain(victim.name)
+        assert wait_until(
+            lambda: mgr.get_instance_state(victim.name)
+            == InstanceRuntimeState.DRAINING, timeout=5)
+        victim.kill()         # dies mid-drain with the stream in flight
+        t.join(timeout=30)
+        assert texts and texts[0] == REPLY
+        assert wait_until(
+            lambda: mgr.get_instance_meta(victim.name) is None, timeout=10)

@@ -128,6 +128,60 @@ class ServiceOptions:
     # post-mortem bundles (trace tree + hotpath stages + load snapshot)
     # captured on SLO breach / failover / error / KV-stream fallback.
     flightrecorder_capacity: int = 64
+    # --- closed-loop fleet autoscaler (autoscaler/, docs/autoscaling.md) ---
+    # Master-gated control loop turning SLO burn rates + planner pressure
+    # into SCALE_OUT / SCALE_IN(drain) / FLIP actions through a pluggable
+    # actuator. Default OFF for one release: with the controller off the
+    # planner keeps today's hint-only behavior (scale_hint published to
+    # XLLM:PLANNER:decision, flips enacted directly by the planner/SLO
+    # policy) — turning it on funnels every actuation through the
+    # controller, the single actuation path.
+    autoscaler_enabled: bool = False
+    # Actuator backend: "hint" publishes typed action records to a
+    # coordination key (today's external-autoscaler contract); "local"
+    # launches/stops engine agent processes on this box (drills, benches,
+    # single-host deployments).
+    autoscaler_actuator: str = "hint"
+    # Fleet bounds the controller never crosses (draining instances count
+    # toward the max until they deregister).
+    autoscaler_min_instances: int = 1
+    autoscaler_max_instances: int = 8
+    # Hysteresis: consecutive breaching ticks before a SCALE_OUT /
+    # consecutive idle ticks before a SCALE_IN (one tick per sync pass).
+    autoscaler_breach_ticks: int = 2
+    autoscaler_idle_ticks: int = 5
+    # Growth step per SCALE_OUT as a fraction of the desired fleet
+    # (always at least one instance, clamped to the max).
+    autoscaler_scale_out_step: float = 0.5
+    # Per-action cooldowns: after an action of a kind, no further action
+    # of that kind until the cooldown elapses. Replacement of lost
+    # capacity (live < desired) bypasses the scale-out cooldown but rides
+    # the spawn-retry backoff below.
+    autoscaler_scale_out_cooldown_s: float = 20.0
+    autoscaler_scale_in_cooldown_s: float = 45.0
+    autoscaler_flip_cooldown_s: float = 10.0
+    # Hold-state guard: when the stalest load-info entry is older than
+    # this (or an instance never reported), the controller HOLDs — a
+    # control loop acting on dead telemetry amplifies outages.
+    autoscaler_stale_hold_s: float = 15.0
+    # Graceful drain: a DRAINING instance whose in-flight work is done
+    # deregisters after this grace; one that can't drain by the deadline
+    # is deregistered anyway (its stragglers ride the normal failover
+    # path).
+    autoscaler_drain_grace_s: float = 1.0
+    autoscaler_drain_deadline_s: float = 120.0
+    # Actuator spawn-failure retry (exponential backoff with jitter): a
+    # failed launch never wedges the loop — the controller re-tries the
+    # replacement on a later tick.
+    autoscaler_spawn_retry_base_s: float = 1.0
+    autoscaler_spawn_retry_max_s: float = 30.0
+    # Bounded decision log behind /admin/autoscaler.
+    autoscaler_decision_log_capacity: int = 256
+    # Local actuator launch command template (shell-split; {port} and
+    # {coordination_addr} placeholders). "" = the built-in fake-engine
+    # launcher (examples/run_fake_engine.py) — drills and benches
+    # exercise the full loop against real OS processes.
+    autoscaler_spawn_cmd: str = ""
     # JSONL dump directory ("" = in-memory ring only).
     flightrecorder_dir: str = ""
     debug_log: bool = field(

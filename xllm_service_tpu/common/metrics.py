@@ -420,6 +420,26 @@ PLANNER_SCALE_HINT = REGISTRY.gauge(
     "planner_scale_hint",
     "Latest planner scale decision (positive = add instances, negative "
     "= remove; hint for an external autoscaler)")
+# Closed-loop autoscaler (autoscaler/, docs/autoscaling.md): enacted
+# action counts by kind, the controller's live fleet view by role, and
+# the age of the newest decision (a stuck control loop shows up here
+# before it shows up as an unserved burst). fleet_size and the decision
+# age are refreshed at tick time and at scrape time.
+AUTOSCALER_ACTIONS_TOTAL = REGISTRY.counter(
+    "autoscaler_actions_total",
+    "Actions enacted by the autoscaler controller, by kind "
+    "(scale_out|scale_in|drain|flip|hold)",
+    labelnames=("action",))
+FLEET_SIZE = REGISTRY.gauge(
+    "fleet_size",
+    "Schedulable instances per role as seen by this frontend's routing "
+    "snapshot (draining/suspect excluded; role=draining counts retiring "
+    "instances)",
+    labelnames=("role",))
+AUTOSCALER_LAST_DECISION_AGE_SECONDS = REGISTRY.gauge(
+    "autoscaler_last_decision_age_seconds",
+    "Seconds since the autoscaler controller last completed a decision "
+    "tick (-1 = never ticked / disabled)")
 SLO_BURN_RATE = REGISTRY.gauge(
     "slo_burn_rate",
     "Error-budget burn rate per objective and rolling window "

@@ -67,6 +67,8 @@ SPAN_POINTS: dict[str, str] = {
     "engine.decode": "engine-side decode stage (first delta -> finish)",
     "kv_transfer.offer": "prefill-side KV offer/handoff to the decode peer",
     "kv_transfer.pull": "decode-side device KV pull",
+    "autoscaler.tick": "one autoscaler enactment pass (only ticks that "
+                       "act record a span; attrs carry the action kinds)",
 }
 
 #: Wire header names (RPC channel hop).

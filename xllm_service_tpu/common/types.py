@@ -51,16 +51,25 @@ class InstanceType(str, enum.Enum):
 
 
 class InstanceRuntimeState(str, enum.Enum):
-    """Three-state liveness (reference `common/types.h:85-89`).
+    """Liveness state machine (reference `common/types.h:85-89` has three
+    states; DRAINING is ours — the reference has no graceful shutdown).
 
     ACTIVE -> LEASE_LOST (lease expired but health probe passed; still
     schedulable) -> SUSPECT (probe failed or heartbeat silence; excluded from
     scheduling) -> evicted. See SURVEY.md §3.4.
+
+    DRAINING (planned retirement — autoscaler scale-in or an operator
+    drain): excluded from new scheduling, in-flight requests finish, then
+    the instance deregisters gracefully (no eviction alarm, no failover).
+    A DRAINING instance that dies mid-drain transitions through the
+    normal LEASE_LOST/SUSPECT failure path, so its remaining requests
+    still fail over.
     """
 
     ACTIVE = "ACTIVE"
     LEASE_LOST = "LEASE_LOST"
     SUSPECT = "SUSPECT"
+    DRAINING = "DRAINING"
 
 
 class RequestAction(str, enum.Enum):

@@ -107,8 +107,10 @@ STATE_DISCIPLINES: dict[str, str] = {
     # --------------------------------------------------------- InstanceMgr
     "InstanceMgr._snapshot": "rcu",
     "InstanceMgr._load_infos": "rcu",
+    "InstanceMgr._request_load_view": "rcu",
     "InstanceMgr._instances": "lock:_cluster_lock",
     "InstanceMgr._pending_flips": "lock:_flip_lock",
+    "InstanceMgr._pending_drains": "lock:_flip_lock",
     "InstanceMgr._load_metrics": "lock:_metrics_lock",
     "InstanceMgr._latency_metrics": "lock:_metrics_lock",
     "InstanceMgr._load_updated_ms": "lock:_metrics_lock",
@@ -182,6 +184,36 @@ STATE_DISCIPLINES: dict[str, str] = {
     "FlightRecorder._path": "lock:_file_lock",
     # ------------------------------------------------------------- Planner
     "Planner.last_decision": "confined:sync-thread",
+    # ------------------------------------------------- AutoscalerController
+    # The decision loop's private state: kernel state swapped by tick
+    # (sync thread) and the spawn-backoff update (enactment), flip
+    # proposals arriving from schedule-path threads, the retiring set,
+    # and the bounded decision log — all behind one leaf lock.
+    "AutoscalerController._state": "lock:_lock",
+    "AutoscalerController._flip_proposals": "lock:_lock",
+    "AutoscalerController._retiring": "lock:_lock",
+    "AutoscalerController._log": "lock:_lock",
+    "AutoscalerController._last_decision_ms": "lock:_lock",
+    "AutoscalerController._ticks": "lock:_lock",
+    "AutoscalerController._opts": "init-only",
+    "AutoscalerController._mgr": "init-only",
+    "AutoscalerController._actuator": "init-only",
+    "AutoscalerController._planner": "init-only",
+    "AutoscalerController._is_master_fn": "init-only",
+    "AutoscalerController._slo": "init-only",
+    "AutoscalerController._cfg": "init-only",
+    "AutoscalerController._enabled": "init-only",
+    # ------------------------------------------------------ FleetActuators
+    "HintActuator._seq": "lock:_lock",
+    "HintActuator._last_publish": "lock:_lock",
+    "HintActuator._coord": "init-only",
+    "LocalProcessActuator._procs": "lock:_lock",
+    "LocalProcessActuator._spawned_at": "lock:_lock",
+    "LocalProcessActuator.launched_total": "lock:_lock",
+    "LocalProcessActuator.spawn_failures_total": "lock:_lock",
+    "LocalProcessActuator._opts": "init-only",
+    "LocalProcessActuator._spawn_cmd": "init-only",
+    "LocalProcessActuator._max_procs": "init-only",
     # ------------------------------------------------------- EngineChannel
     # The negotiated dispatch-wire slot: set at registration, demoted
     # (one-way, to JSON) on an HTTP 415 — every write site carries an
@@ -215,6 +247,9 @@ STATE_CLASSES: tuple = (
     "SloMonitor",
     "FlightRecorder",
     "Planner",
+    "AutoscalerController",
+    "HintActuator",
+    "LocalProcessActuator",
 )
 
 #: Thread roles for ``confined:<role>`` disciplines. ``threads`` are

@@ -83,6 +83,7 @@ RCU_FROZEN_TYPES: dict[str, str] = {
 RCU_PUBLICATIONS: dict[str, str] = {
     "InstanceMgr._snapshot": "RoutingSnapshot @ _cluster_lock",
     "InstanceMgr._load_infos": "dict @ _metrics_lock",
+    "InstanceMgr._request_load_view": "dict @ _metrics_lock",
     "GlobalKVCacheMgr._snapshot": "PrefixIndex @ _lock",
     "OwnershipRouter._members": "tuple @ _lock",
 }

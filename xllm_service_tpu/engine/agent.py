@@ -650,6 +650,7 @@ class EngineAgent:
         app.router.add_post("/rpc/unlink", self._h_unlink)
         app.router.add_post("/rpc/cancel", self._h_cancel)
         app.router.add_post("/rpc/flip_role", self._h_flip)
+        app.router.add_post("/rpc/drain", self._h_drain)
         app.router.add_post("/rpc/kv_transfer", self._h_kv_transfer)
         app.router.add_post("/rpc/kv_stream_pull", self._h_kv_stream_pull)
         app.router.add_post("/rpc/encode", self._h_encode)
@@ -936,6 +937,17 @@ class EngineAgent:
         body = await req.json()
         self.cancel(body.get("service_request_id", ""))
         return web.json_response({"ok": True})
+
+    async def _h_drain(self, req: web.Request) -> web.Response:
+        """Master-initiated graceful retirement (the autoscaler's
+        scale-in path): run the existing drain sequence — advertise
+        `draining`, wait for in-flight work, stop — on a background
+        thread; the RPC acks immediately so the controller's reconcile
+        pass never blocks on an engine's drain window."""
+        if not self._draining:
+            threading.Thread(target=self.drain, name="agent-drain",
+                             daemon=True).start()
+        return web.json_response({"ok": True, "draining": True})
 
     async def _h_flip(self, req: web.Request) -> web.Response:
         """Dynamic PD-role switch (reference `instance_mgr.cpp:1023-1063`).

@@ -40,6 +40,8 @@ from ..common import flightrecorder
 from ..common.flightrecorder import RECORDER
 from ..common.hotpath import HOTPATH
 from ..common.metrics import (
+    AUTOSCALER_LAST_DECISION_AGE_SECONDS,
+    FLEET_SIZE,
     HANDOFF_SERVED_TOTAL,
     KVCACHE_FRAME_LOG_SEQ,
     LOADINFO_MAX_AGE_SECONDS,
@@ -189,6 +191,7 @@ class XllmHttpService:
         app.router.add_get("/admin/config", self.handle_get_config)
         app.router.add_post("/admin/config", self.handle_set_config)
         app.router.add_get("/admin/planner", self.handle_planner)
+        app.router.add_get("/admin/autoscaler", self.handle_autoscaler)
         app.router.add_get("/admin/hotpath", self.handle_hotpath)
         app.router.add_get("/admin/faults", self.handle_get_faults)
         app.router.add_post("/admin/faults", self.handle_set_faults)
@@ -751,6 +754,15 @@ class XllmHttpService:
         LOADINFO_STALE_INSTANCES.set(len(mgr.stale_load_names()))
         KVCACHE_FRAME_LOG_SEQ.set(
             self.scheduler.kvcache_mgr.frame_log_seq())
+        # Autoscaler surface: fleet census by role + decision freshness
+        # (a stuck control loop shows up as a growing age).
+        snap = mgr.routing_snapshot()
+        FLEET_SIZE.labels(role="prefill").set(len(snap.prefill))
+        FLEET_SIZE.labels(role="decode").set(len(snap.decode))
+        FLEET_SIZE.labels(role="encode").set(len(snap.encode))
+        FLEET_SIZE.labels(role="draining").set(len(mgr.draining_names()))
+        AUTOSCALER_LAST_DECISION_AGE_SECONDS.set(
+            self.scheduler.autoscaler.last_decision_age_s())
         SLO_MONITOR.export_gauges()
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
@@ -951,6 +963,13 @@ class XllmHttpService:
         if d is None:
             return web.json_response({"decision": None})
         return web.json_response({"decision": dataclasses.asdict(d)})
+
+    async def handle_autoscaler(self, request: web.Request) -> web.Response:
+        """The autoscaler controller's decision log + state
+        (docs/autoscaling.md): every tick's inputs, actions and the
+        reasons they were (or were not) taken — PlanDecision.reasons,
+        but acted on."""
+        return web.json_response(self.scheduler.autoscaler.report())
 
     async def handle_hotpath(self, request: web.Request) -> web.Response:
         """Per-stage master hot-path latency table (always-on recorder,

@@ -197,6 +197,15 @@ class EngineChannel:
         ok, _ = self._post("/rpc/flip_role", {"type": new_type})
         return ok
 
+    def drain(self) -> bool:
+        """Graceful retirement (autoscaler scale-in / operator drain —
+        no reference counterpart, its instances die abruptly): the
+        engine advertises `draining` in its registration, finishes
+        in-flight work and self-stops. Best effort; the master marks the
+        instance DRAINING either way."""
+        ok, _ = self._post("/rpc/drain", {})
+        return ok
+
     def cancel(self, service_request_id: str) -> bool:
         """Propagate client disconnect / service-side cancellation to the
         engine (reference cancels via the engine contract on disconnect,

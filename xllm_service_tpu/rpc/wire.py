@@ -101,6 +101,16 @@ HOT_PATH_FUNCTIONS: dict[str, str] = {
         "RR pair selection off the routing snapshot",
     "InstanceMgr.select_instance_pair_on_slo":
         "SLO pair selection off the routing snapshot",
+    "select_pair_on_slo":
+        "lock-free SLO selection kernel (snapshot + request-load view + "
+        "staleness-aware predictive scoring)",
+    "SloAwarePolicy.select_instances_pair":
+        "whole SLO_AWARE selection on the schedule path",
+    "InstanceMgr.get_request_loads":
+        "published request-load accessor for SLO predictive scoring",
+    "AutoscalerController.tick":
+        "autoscaler decision loop (sync cadence; lock-free telemetry "
+        "gather, pure kernel, rate-limited enactment)",
     "InstanceMgr.bind_request_instance_incarnations":
         "RCU bind re-validation against the current snapshot",
     "InstanceMgr.get_channel":

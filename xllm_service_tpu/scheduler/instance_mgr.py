@@ -114,8 +114,11 @@ class _Entry:
         # grace window and still schedulable (reference
         # `is_instance_schedulable`, `instance_mgr.cpp:63-66`). DRAINING
         # instances (graceful shutdown: finish in-flight, take no new
-        # traffic) are excluded while still alive.
-        return self.state != InstanceRuntimeState.SUSPECT \
+        # traffic) are excluded while still alive — either
+        # master-initiated (entry state, the autoscaler's scale-in path)
+        # or self-advertised (meta flag, an agent-side drain).
+        return self.state not in (InstanceRuntimeState.SUSPECT,
+                                  InstanceRuntimeState.DRAINING) \
             and not self.meta.draining
 
 
@@ -198,9 +201,12 @@ class InstanceMgr:
         self._rr_prefill = itertools.count()
         self._rr_decode = itertools.count()
         self._rr_encode = itertools.count()
-        # Pending async role flips (performed by the reconcile thread).
+        # Pending async role flips + graceful drains (performed by the
+        # reconcile thread — the engine RPCs and coordination writes they
+        # issue never run on a request path).
         self._flip_lock = make_lock("instance_mgr.flip", order=22)  # lock-order: 22
         self._pending_flips: dict[str, InstanceType] = {}
+        self._pending_drains: set[str] = set()
         # L2: metrics.
         self._metrics_lock = make_lock("instance_mgr.metrics", order=24)  # lock-order: 24
         self._load_metrics: dict[str, LoadMetrics] = {}
@@ -212,6 +218,14 @@ class InstanceMgr:
         # frontend is routing on from an old mirror.
         self._load_updated_ms: dict[str, int] = {}
         self._request_loads: dict[str, _RequestLoad] = {}
+        # Published request-load view (RCU, like _load_infos): immutable
+        # (np_req, np_tok, nd_req, nd_tok) tuples per instance, rebuilt
+        # copy-on-write by update_request_metrics under `_metrics_lock`
+        # and read LOCK-FREE by the SLO policy's predictive scoring —
+        # the selection no longer re-scans `_request_loads` under the
+        # manager lock on every schedule/planner tick.
+        self._request_load_view: dict[str, tuple] = rcu.publish(
+            {}, "routing.request_loads")
         self._updated_load_names: set[str] = set()
         self._removed_load_names: set[str] = set()
         # Published load-info view (RCU, like the routing snapshot):
@@ -295,9 +309,47 @@ class InstanceMgr:
         nxt[name] = self._make_load_info_locked(name, entry, snap)
         self._load_infos = rcu.publish(nxt, "routing.load_infos")
 
+    def _publish_request_load_locked(self, *names: str) -> None:
+        """Copy-on-write republish of the lock-free request-load view for
+        the given instances (callers hold `_metrics_lock`). Entries are
+        immutable (np_req, np_tok, nd_req, nd_tok) tuples."""
+        nxt = dict(self._request_load_view)
+        for name in names:
+            rl = self._request_loads.get(name)
+            if rl is None:
+                nxt.pop(name, None)
+            else:
+                nxt[name] = (rl.num_prefill_requests, rl.num_prefill_tokens,
+                             rl.num_decode_requests, rl.num_decode_tokens)
+        self._request_load_view = rcu.publish(nxt, "routing.request_loads")
+
+    def get_request_loads(self) -> dict[str, tuple]:
+        """Per-instance in-flight accounting for the SLO policy's
+        predictive scoring: name -> (num_prefill_requests,
+        num_prefill_tokens, num_decode_requests, num_decode_tokens).
+        LOCK-FREE: returns the published view — treat as immutable."""
+        return self._request_load_view
+
+    def inflight_requests(self, name: str) -> int:
+        """This frontend's in-flight request count against an instance
+        (lock-free; the drain-completion check — note a multi-master
+        peer's requests are not visible here, which is why drains also
+        wait for the ENGINE-reported load to go idle)."""
+        rl = self._request_load_view.get(name)
+        return (rl[0] + rl[2]) if rl else 0
+
     def routing_snapshot(self) -> RoutingSnapshot:
         """The current immutable routing view (lock-free read)."""
         return self._snapshot
+
+    def draining_names(self) -> list[str]:
+        """Instances on their way out — master-marked DRAINING or
+        self-advertised draining (lock-free read off the snapshot's
+        entry refs; state is a single reference read)."""
+        snap = self._snapshot
+        return [n for n, e in snap.entries.items()
+                if e.state == InstanceRuntimeState.DRAINING
+                or e.meta.draining]
 
     def snapshot_age_s(self, now: Optional[int] = None) -> float:
         """Age of the published routing snapshot in seconds (lock-free;
@@ -396,7 +448,12 @@ class InstanceMgr:
                         cur.predictor.fit_ttft(meta.ttft_profiling_data)
                     if meta.tpot_profiling_data:
                         cur.predictor.fit_tpot(meta.tpot_profiling_data)
-                self._set_state(cur, InstanceRuntimeState.ACTIVE)
+                if cur.state != InstanceRuntimeState.DRAINING:
+                    # A draining instance keeps re-registering while its
+                    # in-flight work finishes (lease keepalive) — the
+                    # refresh must not resurrect it into the schedulable
+                    # set mid-drain.
+                    self._set_state(cur, InstanceRuntimeState.ACTIVE)
                 # Meta replacement can change schedulability (draining
                 # flag) or the wire format even when the state didn't
                 # flip — republish unconditionally.
@@ -411,7 +468,14 @@ class InstanceMgr:
 
     def _handle_instance_delete(self, name: str) -> None:
         """Lease lapse: probe health, then LEASE_LOST (grace) or SUSPECT
-        (reference `instance_mgr.cpp:500-539,604-661`)."""
+        (reference `instance_mgr.cpp:500-539,604-661`).
+
+        DRAINING special case: a draining instance that stops refreshing
+        its lease AND fails the probe has completed its planned shutdown
+        (agents self-stop once their in-flight work finishes) — it
+        deregisters gracefully, no SUSPECT window, no eviction alarm. If
+        it still had bound requests (killed mid-drain), the deregister's
+        failure callback routes them through the NORMAL failover path."""
         with self._cluster_lock:
             entry = self._instances.get(name)
             channel = entry.channel if entry else None
@@ -425,12 +489,21 @@ class InstanceMgr:
                     break
                 time.sleep(0.01 if self._stopped.is_set() else
                            min(self._opts.health_probe_timeout_s, 1.0))
+        drained = False
         with self._cluster_lock:
             entry = self._instances.get(name)
             if entry is None:
                 return
-            self._set_state(entry, InstanceRuntimeState.LEASE_LOST if ok
-                            else InstanceRuntimeState.SUSPECT)
+            if entry.state == InstanceRuntimeState.DRAINING:
+                if ok:
+                    return   # lease blip while draining: stay DRAINING
+                drained = True
+            else:
+                self._set_state(entry, InstanceRuntimeState.LEASE_LOST if ok
+                                else InstanceRuntimeState.SUSPECT)
+        if drained:
+            self.deregister_instance(name, reason="drained")
+            return
         logger.info("instance %s lease lost; probe %s -> %s", name,
                     "ok" if ok else "failed", entry.state.value)
 
@@ -517,6 +590,7 @@ class InstanceMgr:
         with self._metrics_lock:
             self._load_metrics.setdefault(meta.name, LoadMetrics())
             self._request_loads.setdefault(meta.name, _RequestLoad())
+            self._publish_request_load_locked(meta.name)
         logger.info("registered instance %s type=%s incarnation=%s",
                     meta.name, meta.type.value, meta.incarnation_id)
         return True
@@ -562,6 +636,7 @@ class InstanceMgr:
             self._latency_metrics.pop(name, None)
             self._load_updated_ms.pop(name, None)
             self._request_loads.pop(name, None)
+            self._publish_request_load_locked(name)
             self._removed_load_names.add(name)
             self._updated_load_names.discard(name)
             # Drop the dead instance's gauge series so /metrics stops
@@ -581,9 +656,11 @@ class InstanceMgr:
         TTFT_MS.remove(instance=name, policy=policy)
         ITL_MS.remove(instance=name, policy=policy)
         RPC_RETRIES_TOTAL.remove(instance=name)
-        if reason != "replaced":
-            # A re-registration with a new incarnation is planned churn
-            # (rolling restart), not an eviction — don't page anyone.
+        if reason not in ("replaced", "drained"):
+            # Planned churn — a rolling-restart re-registration or a
+            # completed graceful drain (autoscaler scale-in) — is not an
+            # eviction; don't page anyone. A drain that blew its deadline
+            # ("drain deadline") still counts: something held requests.
             INSTANCE_EVICTIONS_TOTAL.labels(instance=name).inc()
         logger.info("deregistered instance %s (%s)", name, reason)
         if self.on_instance_failure is not None:
@@ -639,9 +716,12 @@ class InstanceMgr:
     def reconcile_once(self) -> None:
         """One pass of the 1s reconcile thread (reference
         `instance_mgr.cpp:719-781`): LEASE_LOST with heartbeat silence →
-        SUSPECT; SUSPECT older than eviction window → deregister."""
+        SUSPECT; SUSPECT older than eviction window → deregister;
+        DRAINING instances deregister gracefully once idle (or at the
+        drain deadline, stragglers riding the normal failover path)."""
         now = now_ms()
         to_evict: list[str] = []
+        to_drain_check: list[tuple[str, int]] = []
         with self._cluster_lock:
             for name, entry in self._instances.items():
                 if entry.state == InstanceRuntimeState.LEASE_LOST:
@@ -654,11 +734,37 @@ class InstanceMgr:
                     age = now - entry.state_since_ms
                     if age > self._opts.detect_disconnected_instance_interval_s * 1000:
                         to_evict.append(name)
+                elif entry.state == InstanceRuntimeState.DRAINING:
+                    to_drain_check.append((name, now - entry.state_since_ms))
         for name in to_evict:
             self.deregister_instance(name, reason="suspect eviction")
-        # SLO role flips requested on the scheduling path run here, off
+        for name, age_ms in to_drain_check:
+            if age_ms > self._opts.autoscaler_drain_deadline_s * 1000:
+                # Deadline: something is holding requests open — cut it
+                # loose; bound requests ride the normal failover path.
+                logger.warning("instance %s blew the drain deadline "
+                               "(%.0fs); deregistering", name, age_ms / 1000)
+                self.deregister_instance(name, reason="drain deadline")
+            elif age_ms > self._opts.autoscaler_drain_grace_s * 1000 \
+                    and self.inflight_requests(name) == 0 \
+                    and self._engine_reported_idle(name):
+                # Idle on BOTH books — this frontend's in-flight
+                # accounting AND the engine's own reported load (which
+                # covers multi-master peers' requests too).
+                self.deregister_instance(name, reason="drained")
+        # SLO role flips + drains requested off-path run here, never on
         # the client's critical path.
         self.drain_pending_flips()
+
+    def _engine_reported_idle(self, name: str) -> bool:
+        """True when the instance's last heartbeat reported zero waiting
+        and running requests (lock-free read of the published load-info
+        view)."""
+        info = self._load_infos.get(name)
+        if info is None:
+            return True
+        return (info.load.waiting_requests_num == 0
+                and info.load.running_requests_num == 0)
 
     # ------------------------------------------------------ scheduling reads
     # All lock-free: one read of the published snapshot reference.
@@ -811,107 +917,20 @@ class InstanceMgr:
                     INSTANCE_INFLIGHT_REQUESTS.labels(
                         instance=dname, phase="decode").set(
                         dl.num_decode_requests)
+            # Republish the lock-free request-load view (COW of the two
+            # touched entries) so SLO scoring reads current in-flight
+            # token counts without taking `_metrics_lock`.
+            self._publish_request_load_locked(pname, dname)
 
     def select_instance_pair_on_slo(self, req: Request) -> Routing:
         """SLO-aware pair selection with dynamic PD flipping (reference
-        `instance_mgr.cpp:905-1063`):
+        `instance_mgr.cpp:905-1063`). The selection kernel lives in
+        policies/slo_aware.py and is LOCK-FREE: routing snapshot +
+        published request-load view, staleness-aware — no
+        `_metrics_lock` fleet re-scan on the schedule path."""
+        from .policies.slo_aware import select_pair_on_slo
 
-        1. prefill = argmin estimated prefill completion time (TTFT predictor
-           over queued prefill tokens + this prompt).
-        2. decode = first decode instance whose predicted TPOT at (batch+1)
-           meets `target_tpot_ms`.
-        3. If no decode meets the target and prefill headroom exists, flip an
-           idle PREFILL → DECODE; if decode fleet is over-provisioned (an
-           idle decode) flip one DECODE → PREFILL.
-        """
-        prompt_len = len(req.token_ids)
-        snap = self._snapshot
-        prefills = [(n, snap.entries[n]) for n in snap.prefill]
-        decodes = [(n, snap.entries[n]) for n in snap.decode]
-        if not prefills:
-            return Routing()
-
-        with self._metrics_lock:
-            loads = {n: self._request_loads.get(n, _RequestLoad())
-                     for n, _ in prefills + decodes}
-
-        # Staleness discount (multi-master: a non-elected frontend scores
-        # off the LOADMETRICS mirror, refreshed once per master sync tick;
-        # an entry whose telemetry stopped flowing looks idle forever).
-        # Stale entries get their predicted cost inflated so fresh
-        # telemetry wins ties; relative-staleness (empty when ALL entries
-        # are stale) keeps absolute SLO thresholds undistorted at
-        # bootstrap.
-        stale = self.stale_load_names()
-        stale_factor = 1.0 + max(0.0, self._opts.stale_load_penalty)
-
-        # 1) best prefill by estimated time-to-serve this prompt.
-        def prefill_cost(item):
-            name, entry = item
-            ld = loads[name]
-            if entry.predictor.has_ttft:
-                cost = entry.predictor.predict_ttft(
-                    ld.num_prefill_tokens + prompt_len)
-            else:
-                cost = float(ld.num_prefill_tokens + prompt_len)
-            return cost * (stale_factor if name in stale else 1.0)
-
-        best_prefill_name, best_prefill = min(prefills, key=prefill_cost)
-        req.metrics.estimated_ttft_ms = best_prefill.predictor.predict_ttft(
-            loads[best_prefill_name].num_prefill_tokens + prompt_len)
-
-        if not decodes:
-            return Routing(prefill_name=best_prefill_name)
-
-        # 2) first decode meeting the TPOT target.
-        chosen_decode: Optional[str] = None
-        for name, entry in decodes:
-            ld = loads[name]
-            tpot = entry.predictor.predict_tpot(
-                ld.num_decode_requests + 1, ld.num_decode_tokens + prompt_len) \
-                if entry.predictor.has_tpot else 0.0
-            if name in stale:
-                tpot *= stale_factor
-            if tpot <= self._opts.target_tpot_ms:
-                chosen_decode = name
-                break
-
-        if chosen_decode is None:
-            # 3) overloaded decode fleet: REQUEST a P→D flip of an idle
-            # prefill (reference `instance_mgr.cpp:1023-1063`); the flip's
-            # engine RPC + coordination writes run on the reconcile path —
-            # never on this request path, where a slow engine would stall
-            # the client's TTFT. This request falls back least-loaded; the
-            # flipped capacity serves the ones after it.
-            idle_prefill = next(
-                (n for n, e in prefills
-                 if n != best_prefill_name
-                 and loads[n].num_prefill_requests == 0
-                 and e.meta.type == InstanceType.PREFILL),
-                None)
-            if idle_prefill is not None and len(prefills) > 1:
-                self.request_flip(idle_prefill, InstanceType.DECODE)
-            chosen_decode = min(
-                decodes, key=lambda it: loads[it[0]].num_decode_tokens)[0]
-        else:
-            # Opportunistic D→P flip when some decode instance is completely
-            # idle and prefill queue is deep (reference auto flip at zero
-            # decode load, `instance_mgr.cpp:900-902`).
-            if len(decodes) > 1 and loads[best_prefill_name].num_prefill_requests > 0:
-                idle_decode = next(
-                    (n for n, e in decodes
-                     if n != chosen_decode
-                     and loads[n].num_decode_requests == 0
-                     and e.meta.type == InstanceType.DECODE),
-                    None)
-                surplus = sum(1 for n, _ in decodes
-                              if loads[n].num_decode_requests == 0)
-                if idle_decode is not None and surplus > 1:
-                    self.request_flip(idle_decode, InstanceType.PREFILL)
-
-        if chosen_decode == best_prefill_name:
-            return Routing(prefill_name=best_prefill_name)
-        return Routing(prefill_name=best_prefill_name, decode_name=chosen_decode)
+        return select_pair_on_slo(self, self._opts, req)
 
     def request_flip(self, name: str, new_type: InstanceType) -> None:
         """Enqueue a role flip to be performed by the reconcile thread
@@ -919,10 +938,37 @@ class InstanceMgr:
         with self._flip_lock:
             self._pending_flips[name] = new_type
 
+    def request_drain(self, name: str) -> None:
+        """Enqueue a graceful drain (autoscaler scale-in / operator
+        retirement): the reconcile thread tells the engine to drain
+        (it advertises `draining` and self-stops once idle) and marks
+        the entry DRAINING so routing excludes it immediately. Enqueued
+        only by the elected master's controller (write-lease
+        discipline)."""
+        with self._flip_lock:
+            self._pending_drains.add(name)
+
     def drain_pending_flips(self) -> None:
         with self._flip_lock:
             pending = dict(self._pending_flips)
             self._pending_flips.clear()
+            drains = sorted(self._pending_drains)
+            self._pending_drains.clear()
+        if drains and not self._is_master:
+            # Write-lease discipline: a drain enqueued by the elected
+            # master's controller must NOT be enacted by a frontend that
+            # was demoted before its reconcile pass ran — the new master
+            # owns retirement decisions now (and may pick a different
+            # victim). Dropped, not proxied: unlike flips, a drain hint
+            # is not idempotent fleet-wide.
+            logger.info("dropping %d pending drain(s) after demotion: %s",
+                        len(drains), drains)
+            drains = []
+        for name in drains:
+            try:
+                self._drain_instance(name)
+            except Exception:  # noqa: BLE001 — keep the reconcile loop up
+                logger.exception("drain of %s failed", name)
         if pending and not self._is_master:
             # Write-lease discipline (multi-master): PD-role flips mutate
             # coordination (instance-key move) and must stay funneled
@@ -1002,6 +1048,38 @@ class InstanceMgr:
         self._coord.set(instance_key(new_type.value, name), meta_json)
         logger.info("flipped instance %s: %s -> %s", name, old_type.value,
                     new_type.value)
+        return True
+
+    def _drain_instance(self, name: str) -> bool:
+        """Begin a graceful drain (runs on the reconcile thread): notify
+        the engine (best effort — it advertises `draining` on its next
+        registration refresh and self-stops once idle), then mark the
+        entry DRAINING and republish the snapshot so this frontend stops
+        routing to it NOW. Completion is detected by reconcile_once /
+        the lease-lapse handler; a mid-drain death falls back to the
+        normal SUSPECT/failover path."""
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            if entry is None:
+                return False
+            if entry.state == InstanceRuntimeState.DRAINING:
+                return True
+            channel = entry.channel
+        # Engine RPC outside locks (same shape as flip_instance_role).
+        drain_rpc = getattr(channel, "drain", None)
+        if drain_rpc is not None:
+            try:
+                if not drain_rpc():
+                    logger.warning("drain RPC to %s failed; draining "
+                                   "master-side anyway", name)
+            except Exception:  # noqa: BLE001 — drain must proceed locally
+                logger.exception("drain RPC to %s raised", name)
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            if entry is None:
+                return False
+            self._set_state(entry, InstanceRuntimeState.DRAINING)
+        logger.info("instance %s draining (graceful retirement)", name)
         return True
 
     # ----------------------------------------------------- master sync loop

@@ -66,6 +66,13 @@ class Planner:
         self._mgr = instance_mgr
         self._opts = options
         self.last_decision: Optional[PlanDecision] = None
+        # Flip actuation sink: by default straight into the instance
+        # manager's pending-flip queue (today's behavior); with the
+        # closed-loop autoscaler enabled the scheduler rewires this to
+        # the controller's propose_flip, so there is exactly ONE
+        # actuation path (autoscaler/controller.py) and the controller's
+        # cooldown/hysteresis guards govern planner-driven flips too.
+        self.flip_sink = instance_mgr.request_flip
 
     def plan_once(self) -> PlanDecision:
         d = PlanDecision(ts_ms=int(time.time() * 1000))
@@ -102,15 +109,23 @@ class Planner:
 
         # TPOT SLO breach on decodes with idle prefills -> request a flip
         # (the same corrective the SLO policy applies per-request, but
-        # driven fleet-wide from telemetry).
+        # driven fleet-wide from telemetry). Target selection runs on
+        # the RCU load-info snapshot (`infos` above — no manager lock),
+        # staleness-aware like the rebuilt SLO policy: stale entries are
+        # neither breach evidence (their worst-TBT sample may predate an
+        # instance restart) nor flip candidates (an idle-LOOKING stale
+        # prefill may be carrying load its telemetry stopped reporting).
+        stale = set(d.stale_load_entries)
         slow_decode = any(
             i.latency.recent_max_tbt > self._opts.target_tpot_ms
+            and i.name not in stale
             for i in decodes)
         idle_prefill = next(
             (i.name for i in prefills if i.load.waiting_requests_num == 0
-             and i.load.running_requests_num == 0), None)
+             and i.load.running_requests_num == 0
+             and i.name not in stale), None)
         if slow_decode and idle_prefill and len(prefills) > 1:
-            self._mgr.request_flip(idle_prefill, InstanceType.DECODE)
+            self.flip_sink(idle_prefill, InstanceType.DECODE)
             d.flips_requested.append([idle_prefill, "DECODE"])
             d.reasons.append("decode TPOT over target; flipping idle "
                              "prefill")

@@ -19,5 +19,5 @@ def create_policy(name: str, instance_mgr, kvcache_mgr, options):
     if name == "CAR":
         return CacheAwareRoutingPolicy(instance_mgr, kvcache_mgr, options)
     if name == "SLO_AWARE":
-        return SloAwarePolicy(instance_mgr)
+        return SloAwarePolicy(instance_mgr, options)
     raise ValueError(f"unknown load balance policy: {name}")

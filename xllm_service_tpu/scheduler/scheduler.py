@@ -175,6 +175,25 @@ class Scheduler:
                                        options)
         from .planner import Planner
         self.planner = Planner(self.instance_mgr, options)
+        # Closed-loop autoscaler (autoscaler/): master-gated controller
+        # turning SLO burn rates + planner pressure into fleet actions
+        # through a pluggable actuator. Constructed always (the admin
+        # surface reports state either way); it self-gates on
+        # `autoscaler_enabled` and the election. With the controller
+        # enabled, planner and SLO-policy PD flips route through it —
+        # ONE actuation path; disabled (default) keeps today's
+        # hint-only behavior.
+        from ..autoscaler import AutoscalerController, create_actuator
+        self.autoscaler = AutoscalerController(
+            options, self.instance_mgr,
+            create_actuator(options, self._coord),
+            planner=self.planner,
+            is_master_fn=lambda: self.is_master)
+        if options.autoscaler_enabled:
+            self.planner.flip_sink = self.autoscaler.propose_flip
+            from .policies.slo_aware import SloAwarePolicy
+            if isinstance(self.lb_policy, SloAwarePolicy):
+                self.lb_policy.flip_sink = self.autoscaler.propose_flip
         self.response_handler = ResponseHandler(
             options.model_id, options.tool_call_parser,
             options.reasoning_parser)
@@ -262,6 +281,7 @@ class Scheduler:
                 if self._master_watch_id is None:
                     self._master_watch_id = self._coord.add_watch(
                         MASTER_KEY, self._on_master_event)
+        decision = None
         if self.is_master:
             self.kvcache_mgr.upload_kvcache()
             self.instance_mgr.upload_load_metrics()
@@ -273,6 +293,15 @@ class Scheduler:
                 self._coord.set(PLANNER_KEY, decision.to_json())
             except Exception:  # noqa: BLE001 — planning must not kill sync
                 logger.exception("planner pass failed")
+        # Closed-loop autoscaler tick. Self-gating: disabled or
+        # non-elected controllers gather nothing and act on nothing (a
+        # demoted master's straggler tick enacts zero actions — the
+        # write-lease discipline the multimaster drills assert).
+        try:
+            self.autoscaler.tick(decision)
+            self.autoscaler.reap_departed()
+        except Exception:  # noqa: BLE001 — scaling must not kill sync
+            logger.exception("autoscaler tick failed")
         self._gc_stale_requests()
 
     def _gc_stale_requests(self) -> None:
@@ -1013,6 +1042,7 @@ class Scheduler:
     def stop(self) -> None:
         self._stopped.set()
         self.ownership.stop()
+        self.autoscaler.stop()
         self.instance_mgr.stop()
         self.kvcache_mgr.stop()
         self._output_executor.shutdown()

@@ -69,6 +69,15 @@ class FakeEngineConfig:
     block_size: int = 128
     emit_kv_events: bool = True
     host: str = "127.0.0.1"
+    # Advertised port (0 = pick a free one). The autoscaler's local
+    # actuator passes an explicit port so the instance NAME (host:port)
+    # is known to the launcher before the process registers.
+    port: int = 0
+    # Capacity model for closed-loop scaling drills/benches: a blocking
+    # sleep INSIDE the accept handler serializes accepts on the event
+    # loop, capping this engine at ~1/accept_delay_s requests per
+    # second — so adding instances genuinely adds fleet throughput.
+    accept_delay_s: float = 0.0
 
 
 class FakeEngine:
@@ -80,7 +89,7 @@ class FakeEngine:
                  config: Optional[FakeEngineConfig] = None):
         self.coord = coord
         self.cfg = config or FakeEngineConfig()
-        self.port = pick_free_port(self.cfg.host)
+        self.port = self.cfg.port or pick_free_port(self.cfg.host)
         self.name = f"{self.cfg.host}:{self.port}"
         self.incarnation_id = uuid.uuid4().hex[:12]
         self.instance_type = self.cfg.instance_type
@@ -98,6 +107,12 @@ class FakeEngine:
         self.healthy = True
         self._alive = True
         self._paused = False
+        # Graceful drain (wire-contract mirror of EngineAgent.drain):
+        # draining engines advertise the flag, reject new accepts, and
+        # self-stop once the active generation count hits zero.
+        self.draining = False
+        self._active_lock = make_lock("fake_engine.active", order=66)  # lock-order: 66
+        self._active_gens = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner: Optional[web.AppRunner] = None
         self._thread: Optional[threading.Thread] = None
@@ -136,6 +151,7 @@ class FakeEngine:
     def meta(self) -> InstanceMetaInfo:
         return InstanceMetaInfo(
             name=self.name, rpc_address=self.name, type=self.instance_type,
+            draining=self.draining,
             dp_size=1,
             topology=TpuTopology(slice_id="fake-slice", mesh_shape=[1],
                                  axis_names=["data"],
@@ -171,6 +187,7 @@ class FakeEngine:
         app.router.add_post("/rpc/unlink", self._h_unlink)
         app.router.add_post("/rpc/cancel", self._h_cancel)
         app.router.add_post("/rpc/flip_role", self._h_flip)
+        app.router.add_post("/rpc/drain", self._h_drain)
         # Same per-process trace surface the real agent serves — useful
         # when the fake engine runs out-of-process
         # (examples/run_fake_engine.py).
@@ -254,7 +271,9 @@ class FakeEngine:
                 "incarnation_id": self.incarnation_id,
                 "load_metrics": {
                     "waiting_requests_num": 0,
-                    "running_requests_num": len(self.accepted_requests),
+                    # Live streams, not the accept log: drain-completion
+                    # checks and scale-in victim picks read this.
+                    "running_requests_num": self._active_gens,
                     "hbm_cache_usage_perc": 0.1,
                 },
                 "latency_metrics": {"recent_max_ttft": 12.0,
@@ -326,6 +345,33 @@ class FakeEngine:
         self.instance_type = InstanceType.parse(body.get("type"))
         return web.json_response({"ok": True})
 
+    async def _h_drain(self, req: web.Request) -> web.Response:
+        """Graceful retirement (mirror of EngineAgent.drain, on the
+        wire-contract reference impl): advertise `draining` on the next
+        registration refresh, reject new accepts, and self-stop once the
+        in-flight generations finish — the master's lease-lapse handler
+        then deregisters the instance as cleanly drained."""
+        if not self.draining:
+            self.draining = True
+            # register() is a blocking coordination write — it runs on
+            # the drain thread, never this event loop (the async-blocking
+            # bug class PR 8's rule caught in the real agent's /rpc/flip).
+            threading.Thread(target=self._drain_then_stop,
+                             name=f"fake-drain-{self.port}",
+                             daemon=True).start()
+        return web.json_response({"ok": True, "draining": True})
+
+    def _drain_then_stop(self, timeout_s: float = 60.0) -> None:
+        self.register()   # advertise draining now, not at the next beat
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._active_lock:
+                idle = self._active_gens == 0
+            if idle:
+                break
+            time.sleep(0.05)
+        self.stop()
+
 
     async def _h_completion(self, req: web.Request) -> web.Response:
         return await self._accept(req, chat=False)
@@ -340,6 +386,17 @@ class FakeEngine:
         except ValueError:
             return web.json_response({"error": "invalid request body"},
                                      status=400)
+        if self.draining:
+            # A draining engine takes no new work; a request that raced
+            # the drain (routed from a pre-drain snapshot) fails over to
+            # a surviving instance via the 503 dispatch-failure path.
+            return web.json_response({"error": "draining"}, status=503)
+        if self.cfg.accept_delay_s:
+            # Deliberate capacity model: blocking the event loop
+            # serializes accepts, capping this engine's throughput (the
+            # closed-loop autoscaling bench scales fleet capacity by
+            # adding engines).
+            time.sleep(self.cfg.accept_delay_s)  # xlint: allow-async-blocking(test double: the blocking sleep IS the capacity model — serialized accepts cap per-engine throughput for scaling drills)
         self.accepted_wire.append((req.content_type or "", raw))
         self.accepted_trace_headers.append(
             {k.lower(): v for k, v in req.headers.items()
@@ -377,6 +434,19 @@ class FakeEngine:
 
     # ----------------------------------------------------------- generation
     def _generate(self, sid: str, source: str, body: dict[str, Any]) -> None:
+        # Active-generation accounting gates the drain self-stop: a
+        # draining engine only exits once every stream it accepted has
+        # finished (or been cancelled).
+        with self._active_lock:
+            self._active_gens += 1
+        try:
+            self._generate_stream(sid, source, body)
+        finally:
+            with self._active_lock:
+                self._active_gens -= 1
+
+    def _generate_stream(self, sid: str, source: str,
+                         body: dict[str, Any]) -> None:
         session = self._push_session
         text = self.cfg.reply_text
         max_tokens = int(body.get("max_tokens", 1 << 30))
