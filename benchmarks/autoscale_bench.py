@@ -70,7 +70,11 @@ def free_port() -> int:
     return port
 
 
-ACCEPT_DELAY_S = 0.04           # per-engine capacity ~25 req/s
+SERVICE_RATE_RPS = 25.0         # per-engine capacity (deterministic model)
+# Deep accept queue: overload manifests as queueing delay (the TTFT
+# collapse the static control demonstrates), not fast 503s — the same
+# shape the old blocking-accept hack produced.
+ACCEPT_QUEUE = 512
 REPLY_CHARS = 32
 
 
@@ -96,9 +100,14 @@ class Stack:
         return p
 
     def engine_cmd_template(self) -> str:
+        # The fake engine's DETERMINISTIC capacity model (bounded accept
+        # queue + service rate; ISSUE 14) replaced the old 40ms
+        # blocking-accept hack — same ~25 req/s per engine, same
+        # headline, reproducible queueing under overload.
         return (f"{sys.executable} {REPO}/examples/run_fake_engine.py "
                 f"--coordination-addr {{coordination_addr}} "
-                f"--port {{port}} --accept-delay {ACCEPT_DELAY_S} "
+                f"--port {{port}} --service-rate {SERVICE_RATE_RPS} "
+                f"--accept-queue {ACCEPT_QUEUE} "
                 f"--reply {'x' * REPLY_CHARS} --chunk-size 8 --delay 0")
 
     def start(self):
@@ -192,9 +201,15 @@ class Sampler(threading.Thread):
             try:
                 slo = requests.get(self.base + "/admin/slo",
                                    timeout=3).json()
-                ttft = slo["objectives"]["ttft"]
-                row["burn_fast"] = ttft["fast"]["burn_rate"]
-                row["burn_slow"] = ttft["slow"]["burn_rate"]
+                # Worst objective per window — the controller's own view
+                # (overload shows as TTFT collapse when requests queue,
+                # as error_rate when a bounded engine queue 503s the
+                # excess; either is a breach).
+                objs = slo["objectives"].values()
+                row["burn_fast"] = max(
+                    o["fast"]["burn_rate"] for o in objs)
+                row["burn_slow"] = max(
+                    o["slow"]["burn_rate"] for o in objs)
                 row["breaching"] = slo["breaching"]
             except (requests.RequestException, KeyError, ValueError):
                 pass
@@ -404,7 +419,8 @@ def main() -> None:
                if static and auto["burst_ttft_p50_ms"] else None)
     report = {
         "config": {
-            "accept_delay_s": ACCEPT_DELAY_S,
+            "service_rate_rps": SERVICE_RATE_RPS,
+            "accept_queue": ACCEPT_QUEUE,
             "slo_ttft_ms": args.slo_ttft_ms,
             "fast_window_s": args.fast_window_s,
             "slow_window_s": args.slow_window_s,

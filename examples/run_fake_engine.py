@@ -40,21 +40,37 @@ def main() -> None:
                    help="advertised port (0 = pick free; the autoscaler's "
                         "local actuator passes one so the instance name is "
                         "known at launch)")
+    p.add_argument("--service-rate", type=float, default=0.0,
+                   help="deterministic capacity model: serve at most "
+                        "this many generations per second (0 = "
+                        "unlimited); the overload/autoscaling benches' "
+                        "per-engine capacity knob")
+    p.add_argument("--accept-queue", type=int, default=0,
+                   help="bounded accept queue in front of the service "
+                        "rate (0 = unbounded); a full queue 503s")
+    p.add_argument("--first-delta-delay", type=float, default=0.0,
+                   help="simulated prefill latency: sleep before the "
+                        "first delta of each generation")
     p.add_argument("--accept-delay", type=float, default=0.0,
-                   help="blocking per-accept delay: serializes accepts, "
-                        "capping this engine at ~1/delay req/s (the "
-                        "closed-loop autoscaling bench's capacity model)")
+                   help="DEPRECATED alias: mapped to "
+                        "--service-rate 1/delay (the old blocking-"
+                        "accept hack is gone)")
     p.add_argument("--heartbeat-interval", type=float, default=0.5)
     p.add_argument("--lease-ttl", type=float, default=1.0)
     args = p.parse_args()
 
+    rate = max(0.0, args.service_rate)
+    if not rate and args.accept_delay > 0:
+        rate = 1.0 / args.accept_delay
     coord = connect(args.coordination_addr)
     engine = FakeEngine(coord, FakeEngineConfig(
         instance_type=InstanceType.parse(args.type),
         models=[args.model], reply_text=args.reply,
         chunk_size=max(1, args.chunk_size), delay_s=max(0.0, args.delay),
         host=args.host, port=max(0, args.port),
-        accept_delay_s=max(0.0, args.accept_delay),
+        service_rate_rps=rate,
+        accept_queue_limit=max(0, args.accept_queue),
+        first_delta_delay_s=max(0.0, args.first_delta_delay),
         heartbeat_interval_s=max(0.05, args.heartbeat_interval),
         lease_ttl_s=max(0.2, args.lease_ttl))
     ).start()

@@ -6,7 +6,7 @@
 # all randomness from one seeded RNG), so any failing iteration can be
 # replayed exactly with:   XLLM_CHAOS_SEED=<seed> pytest -m chaos
 #
-# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs|--state|--autoscale] [extra pytest args...]
+# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs|--state|--autoscale|--overload] [extra pytest args...]
 #   --masters   soak the multi-master plane drills (tests/test_multimaster.py:
 #               owner/master kill mid-stream, split-brain demotion, write-lease
 #               proxying) instead of the single-master failover drills.
@@ -30,6 +30,12 @@
 #               DRAINING instance killed mid-drain falls back to the
 #               normal failover path, graceful drains retire without an
 #               eviction alarm).
+#   --overload  soak the overload-hardening drills (tests/
+#               test_overload.py: deadline expiry mid-decode stops the
+#               engine within one pump, shed-under-burst keeps admitted
+#               requests whole, circuit-breaker open/probe/restore, the
+#               relayed client-disconnect cancellation drill, retry-
+#               budget exhaustion).
 #
 # After the randomized-seed loop, the INSTRUMENTED legs run (one
 # iteration each, counted in the pass rate): XLLM_LOCK_DEBUG=1 (the
@@ -57,6 +63,9 @@ elif [ "${1:-}" = "--state" ]; then
     shift
 elif [ "${1:-}" = "--autoscale" ]; then
     SUITE="tests/test_autoscaler.py"
+    shift
+elif [ "${1:-}" = "--overload" ]; then
+    SUITE="tests/test_overload.py"
     shift
 fi
 cd "$(dirname "$0")/.."

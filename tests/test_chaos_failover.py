@@ -23,7 +23,7 @@ from xllm_service_tpu.common.config import ServiceOptions
 from xllm_service_tpu.common.faults import FAULTS, FaultInjected, FaultPlane
 from xllm_service_tpu.common.metrics import (
     FAILOVER_SUCCESS_TOTAL,
-    REQUESTS_CANCELLED_ON_FAILURE_TOTAL,
+    REQUESTS_CANCELLED_TOTAL,
 )
 from xllm_service_tpu.common.request import Request, RequestOutput, SequenceOutput
 from xllm_service_tpu.common.call_data import CollectingConnection
@@ -206,12 +206,12 @@ class TestRetryBudget:
                     engine.name) is not None, timeout=5)
             FAULTS.configure([dict(point="engine.token", action="crash",
                                    after=4, max_fires=1)], seed=SEED)
-            cancelled_before = REQUESTS_CANCELLED_ON_FAILURE_TOTAL.value()
+            cancelled_before = REQUESTS_CANCELLED_TOTAL.value()
             start = time.time()
             with pytest.raises(RuntimeError, match="stream error"):
                 _stream_completion(master, timeout=30)
             assert time.time() - start < 20   # prompt, not a timeout hang
-            assert REQUESTS_CANCELLED_ON_FAILURE_TOTAL.value() == \
+            assert REQUESTS_CANCELLED_TOTAL.value() == \
                 cancelled_before + 1
             assert wait_until(lambda: _loads_zero(master), timeout=5)
         finally:
@@ -417,7 +417,7 @@ class TestAdminFaultsEndpoint:
             text = requests.get(_base(master) + "/metrics", timeout=5).text
             for name in ("failover_attempts_total", "failover_success_total",
                          "rpc_retries_total", "instance_evictions_total",
-                         "requests_cancelled_on_failure_total"):
+                         "requests_cancelled_total"):
                 assert name in text
         finally:
             master.stop()

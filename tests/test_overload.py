@@ -1,0 +1,916 @@
+"""Overload-hardening plane drills (ISSUE 14, docs/robustness.md).
+
+Acceptance surface:
+
+- end-to-end deadlines: expired work refused at admission, a mid-decode
+  expiry stops engine token production within one pump interval
+  (asserted on the fake engine's stop log), including across a relayed
+  multimaster handoff; deadline cancellations are counted
+  (`requests_cancelled_total{reason="deadline"}`) and flight-recorded,
+- admission control + priority shedding: the decision kernel table, the
+  fast-429-under-burst drill (admitted requests still complete), the
+  shed-rate coupling into the autoscaler kernel,
+- per-instance circuit breakers: the OPEN/half-open/close state table
+  and the routing integration (BREAKER_OPEN excluded like SUSPECT,
+  restored by the reconcile probe),
+- brownout: enter/exit hysteresis, batch max_tokens clamping end to
+  end, transition log + flight-recorder capture,
+- the global retry budget capping failover/relay amplification,
+- the client-disconnect drill through the multimaster relay (a dropped
+  RELAYED stream propagates cancel to the owner and the engines),
+- the fake engine's deterministic capacity model (bounded accept queue
+  + service rate).
+
+Chaos-marked like the failover drills: `scripts/chaos_soak.sh
+--overload` sweeps seeds and runs the instrumented LOCK/RCU/STATE legs.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from xllm_service_tpu.autoscaler import (
+    AutoscalerConfig,
+    KernelInputs,
+    KernelState,
+    decide,
+)
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.faults import FAULTS
+from xllm_service_tpu.common.flightrecorder import RECORDER
+from xllm_service_tpu.common.metrics import REQUESTS_CANCELLED_TOTAL
+from xllm_service_tpu.common.types import InstanceRuntimeState, now_ms
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.overload import (
+    ADMISSION,
+    BROWNOUT,
+    RETRY_BUDGET,
+    parse_deadline_ms,
+    parse_priority,
+)
+from xllm_service_tpu.overload.admission import (
+    AdmissionInputs,
+    decide_admission,
+)
+from xllm_service_tpu.rpc.breaker import CircuitBreaker
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import wait_until
+
+pytestmark = pytest.mark.chaos
+
+SEED = int(os.environ.get("XLLM_CHAOS_SEED", "0"))
+REPLY = "Degrade gracefully: shed what cannot be served, bound the rest."
+
+
+@pytest.fixture(autouse=True)
+def _reset_overload_plane():
+    """The overload singletons are process-global (like SLO_MONITOR);
+    each drill starts from a clean slate and leaves one behind."""
+    FAULTS.configure((), seed=SEED)
+    ADMISSION.configure(per_instance_limit=0)
+    ADMISSION.reset()
+    BROWNOUT.configure(enabled=True)
+    BROWNOUT.reset()
+    RETRY_BUDGET.configure(ratio=0.1, cap=50.0)
+    yield
+    FAULTS.clear()
+    ADMISSION.configure(per_instance_limit=0)
+    ADMISSION.reset()
+    BROWNOUT.configure(enabled=True)
+    BROWNOUT.reset()
+    RETRY_BUDGET.configure(ratio=0.1, cap=50.0)
+
+
+def _opts(**kw) -> ServiceOptions:
+    base = dict(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        lease_ttl_s=0.5, sync_interval_s=0.2,
+        reconcile_interval_s=0.05,
+        heartbeat_silence_to_suspect_s=0.3,
+        detect_disconnected_instance_interval_s=0.3,
+        health_probe_attempts=1, health_probe_timeout_s=0.2,
+        failover_backoff_base_s=0.05, failover_backoff_max_s=0.3,
+        rpc_backoff_base_s=0.02, rpc_backoff_max_s=0.1,
+        handoff_stall_timeout_s=1.5)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def _master(store, **kw) -> Master:
+    m = Master(_opts(**kw), coord=InMemoryCoordination(store))
+    m.start()
+    return m
+
+
+def _engine(store, **cfg_kw) -> FakeEngine:
+    base = dict(reply_text=REPLY, chunk_size=4,
+                heartbeat_interval_s=0.1, lease_ttl_s=0.5)
+    base.update(cfg_kw)
+    return FakeEngine(InMemoryCoordination(store),
+                      FakeEngineConfig(**base)).start()
+
+
+def _base(m: Master) -> str:
+    return f"http://127.0.0.1:{m.http_port}"
+
+
+def _await_fleet(masters, engines, timeout=20) -> None:
+    addrs = {m.scheduler.self_addr for m in masters}
+    assert wait_until(
+        lambda: all(
+            all(m.scheduler.instance_mgr.get_instance_meta(e.name)
+                is not None for e in engines)
+            and set(m.scheduler.ownership.members()) == addrs
+            for m in masters), timeout=timeout)
+
+
+def _key_owned_by(router, addr: str) -> str:
+    for i in range(10000):
+        k = f"affinity-{i}"
+        if router.owner_of(k) == addr:
+            return k
+    raise AssertionError(f"no key owned by {addr} in 10k draws")
+
+
+def _cancelled(reason: str) -> float:
+    return REQUESTS_CANCELLED_TOTAL.labels(reason=reason).value()
+
+
+# =========================================================== pure kernels
+class TestDeadlineParsing:
+    def test_header_wins_over_body_over_default(self):
+        now = 1_000_000
+        d = parse_deadline_ms({"timeout": 2.0},
+                              {"x-request-deadline-ms": "500"},
+                              default_ms=9000, now=now)
+        assert d == now + 500
+        d = parse_deadline_ms({"timeout": 2.0}, {}, default_ms=9000,
+                              now=now)
+        assert d == now + 2000
+        d = parse_deadline_ms({}, {}, default_ms=9000, now=now)
+        assert d == now + 9000
+        assert parse_deadline_ms({}, {}, default_ms=0, now=now) == 0
+
+    def test_malformed_values_fall_through(self):
+        now = 1_000_000
+        d = parse_deadline_ms({"timeout": "nope"},
+                              {"x-request-deadline-ms": "bogus"},
+                              default_ms=100, now=now)
+        assert d == now + 100
+        # Zero / negative budgets are "no deadline from this source".
+        assert parse_deadline_ms({"timeout": -5}, {}, 0, now=now) == 0
+        assert parse_deadline_ms(
+            {"timeout": True}, {}, 0, now=now) == 0   # bools are not budgets
+
+    def test_priority_parse(self):
+        assert parse_priority({}, {}) == "interactive"
+        assert parse_priority({}, {"x-request-priority": "batch"}) == "batch"
+        assert parse_priority({"priority_class": "batch"}, {}) == "batch"
+        assert parse_priority({"priority_class": "BATCH"}, {}) == "batch"
+        assert parse_priority({"priority_class": "weird"}, {}) \
+            == "interactive"
+        assert parse_priority({"offline": True}, {}) == "batch"
+        # Explicit priority beats the offline default.
+        assert parse_priority({"offline": True,
+                               "priority_class": "interactive"}, {}) \
+            == "interactive"
+
+
+class TestAdmissionKernel:
+    def test_disabled_admits_everything(self):
+        ok, _ = decide_admission(AdmissionInputs(
+            pending=10**6, live=0, per_instance_limit=0))
+        assert ok
+
+    def test_limit_scales_with_live_fleet(self):
+        base = dict(per_instance_limit=4, priority="interactive")
+        assert decide_admission(AdmissionInputs(
+            pending=7, live=2, **base))[0]
+        ok, reason = decide_admission(AdmissionInputs(
+            pending=8, live=2, **base))
+        assert not ok and "queue full" in reason
+        # Scale-out raises the watermark with no reconfiguration.
+        assert decide_admission(AdmissionInputs(
+            pending=8, live=3, **base))[0]
+
+    def test_batch_watermark_and_burn_hot(self):
+        base = dict(per_instance_limit=10, live=1, batch_watermark=0.5)
+        assert decide_admission(AdmissionInputs(
+            pending=4, priority="batch", **base))[0]
+        ok, reason = decide_admission(AdmissionInputs(
+            pending=5, priority="batch", **base))
+        assert not ok and "batch" in reason
+        # Interactive rides to the full limit.
+        assert decide_admission(AdmissionInputs(
+            pending=9, priority="interactive", **base))[0]
+        # Burn hot: batch admission closes entirely.
+        ok, reason = decide_admission(AdmissionInputs(
+            pending=0, priority="batch", burn_hot=True, **base))
+        assert not ok and "burn" in reason
+        assert decide_admission(AdmissionInputs(
+            pending=0, priority="interactive", burn_hot=True, **base))[0]
+
+    def test_controller_pending_and_shed_rate(self):
+        ADMISSION.configure(per_instance_limit=1, batch_watermark=0.5,
+                            retry_after_s=2.0)
+        ok, _, _ = ADMISSION.try_admit("interactive", live=1,
+                                       burn_hot=False)
+        assert ok and ADMISSION.pending() == 1
+        ok, reason, retry_after = ADMISSION.try_admit(
+            "interactive", live=1, burn_hot=False)
+        assert not ok and retry_after == 2.0
+        assert ADMISSION.shed_rate() > 0
+        ADMISSION.release()
+        assert ADMISSION.pending() == 0
+        ADMISSION.release()      # over-release clamps, never goes negative
+        assert ADMISSION.pending() == 0
+        rep = ADMISSION.report()
+        assert rep["admitted_total"] == 1
+        assert rep["shed_total"] == {"interactive": 1}
+
+
+class TestCircuitBreakerStateTable:
+    def _mk(self, **kw):
+        base = dict(name="t", window_s=5.0, min_samples=4,
+                    failure_ratio=0.5, open_cooldown_s=10.0)
+        base.update(kw)
+        return CircuitBreaker(**base)
+
+    def test_closed_until_min_samples_and_ratio(self):
+        b = self._mk()
+        for _ in range(3):
+            b.record(False, now=0.0)
+        assert b.state() == "closed"          # under min_samples
+        b = self._mk()
+        b.record(False, now=0.0)
+        for _ in range(3):
+            b.record(True, now=0.0)
+        assert b.state() == "closed"          # ratio 0.25 < 0.5
+        b.record(False, now=0.0)
+        b.record(False, now=0.0)
+        assert b.state() == "open"            # 3/6 = 0.5 trips
+        assert not b.allow(now=1.0)
+
+    def test_half_open_single_probe_then_close(self):
+        b = self._mk(open_cooldown_s=1.0)
+        for _ in range(4):
+            b.record(False, now=0.0)
+        assert b.state() == "open"
+        assert not b.allow(now=0.5)           # cooldown holds
+        assert b.allow(now=1.5)               # the one half-open probe
+        assert b.state() == "half_open"
+        assert not b.allow(now=1.6)           # second caller fenced out
+        b.record(True, now=1.7)
+        assert b.state() == "closed"
+        # Window was reset: old failures cannot immediately re-trip.
+        b.record(False, now=1.8)
+        assert b.state() == "closed"
+
+    def test_half_open_failure_reopens(self):
+        b = self._mk(open_cooldown_s=1.0)
+        for _ in range(4):
+            b.record(False, now=0.0)
+        assert b.allow(now=1.5)
+        b.record(False, now=1.6)
+        assert b.state() == "open"
+        assert not b.allow(now=2.0)           # fresh cooldown from 1.6
+        assert b.allow(now=2.7)               # next half-open probe
+
+    def test_stale_window_expires(self):
+        b = self._mk(window_s=1.0)
+        for _ in range(3):
+            b.record(False, now=0.0)
+        b.record(False, now=2.0)              # the old three pruned
+        assert b.state() == "closed"
+
+    def test_disabled_is_transparent(self):
+        b = self._mk(enabled=False)
+        for _ in range(20):
+            b.record(False, now=0.0)
+        assert b.allow(now=0.0) and b.state() == "closed"
+
+
+class TestRetryBudget:
+    def test_deposit_spend_deny(self):
+        RETRY_BUDGET.configure(ratio=0.5, cap=2.0)
+        assert RETRY_BUDGET.try_spend()       # full bucket: 2 tokens
+        assert RETRY_BUDGET.try_spend()
+        assert not RETRY_BUDGET.try_spend()   # empty
+        for _ in range(2):
+            RETRY_BUDGET.note_request()       # 2 x 0.5 = 1 token back
+        assert RETRY_BUDGET.try_spend()
+        assert not RETRY_BUDGET.try_spend()
+        rep = RETRY_BUDGET.report()
+        assert rep["spent_total"] == 3 and rep["denied_total"] == 2
+
+    def test_cap_bounds_deposits(self):
+        RETRY_BUDGET.configure(ratio=10.0, cap=3.0)
+        for _ in range(100):
+            RETRY_BUDGET.note_request()
+        assert RETRY_BUDGET.tokens() == 3.0
+
+    def test_disabled(self):
+        RETRY_BUDGET.configure(ratio=0.1, cap=0.0)
+        for _ in range(100):
+            assert RETRY_BUDGET.try_spend()
+
+
+class TestBrownoutController:
+    HOT = {"breaching": ["ttft"], "worst_fast_burn_rate": 50.0}
+    COOL = {"breaching": [], "worst_fast_burn_rate": 0.2}
+
+    def test_enter_clamp_exit_hysteresis(self):
+        BROWNOUT.configure(enabled=True, batch_max_tokens=8,
+                           recover_ticks=2, trace_sample_rate=0.0,
+                           restore_rate_fn=lambda: 1.0)
+        assert not BROWNOUT.active()
+        assert BROWNOUT.tick(report=self.HOT)
+        assert BROWNOUT.active()
+        assert BROWNOUT.clamp_max_tokens("batch", 1000) == 8
+        assert BROWNOUT.clamp_max_tokens("interactive", 1000) == 1000
+        assert BROWNOUT.clamp_max_tokens("batch", 4) == 4
+        # One clean tick is not recovery (hysteresis)...
+        assert BROWNOUT.tick(report=self.COOL)
+        # ...a breach resets the streak...
+        assert BROWNOUT.tick(report=self.HOT)
+        assert BROWNOUT.tick(report=self.COOL)
+        # ...two consecutive clean ticks lift it.
+        assert not BROWNOUT.tick(report=self.COOL)
+        assert not BROWNOUT.active()
+        assert BROWNOUT.clamp_max_tokens("batch", 1000) == 1000
+        rep = BROWNOUT.report()
+        kinds = [t["kind"] for t in rep["transitions"]]
+        assert kinds == ["enter", "exit"]
+        assert rep["entered_total"] == 1
+        # Both transitions reached the flight recorder with reasons.
+        recs = RECORDER.recent(kind="brownout")
+        assert len(recs) >= 2
+        assert any("breaching" in r["detail"]["reason"]
+                   for r in recs if r["detail"]["kind"] == "enter")
+
+    def test_disabled_never_enters(self):
+        BROWNOUT.configure(enabled=False)
+        assert not BROWNOUT.tick(report=self.HOT)
+        assert not BROWNOUT.active()
+
+
+class TestAutoscalerShedCoupling:
+    CFG = AutoscalerConfig(min_instances=1, max_instances=4,
+                           breach_ticks=2, idle_ticks=3)
+
+    def test_shed_rate_drives_scale_out(self):
+        st = KernelState(desired=2)
+        inp = KernelInputs(now_s=1000.0, live=2, max_load_age_s=1.0,
+                           shed_rate=2.5)
+        actions, st, reasons = decide(inp, st, self.CFG)
+        assert not actions                      # hysteresis tick 1
+        assert any("shedding" in r for r in reasons)
+        inp2 = KernelInputs(now_s=1003.0, live=2, max_load_age_s=1.0,
+                            shed_rate=2.5)
+        actions, st, _ = decide(inp2, st, self.CFG)
+        assert [a.kind for a in actions] == ["scale_out"]
+        assert "unserved demand" in actions[0].reason
+
+    def test_zero_shed_rate_is_not_breach(self):
+        st = KernelState(desired=2)
+        for t in (1000.0, 1003.0, 1006.0):
+            inp = KernelInputs(now_s=t, live=2, max_load_age_s=1.0,
+                               shed_rate=0.0)
+            actions, st, _ = decide(inp, st, self.CFG)
+            assert not any(a.kind == "scale_out" for a in actions)
+
+
+# ======================================================== capacity model
+class TestFakeEngineCapacityModel:
+    def test_bounded_accept_queue_rejects_overload(self, store):
+        eng = _engine(store, service_rate_rps=1.0, accept_queue_limit=2,
+                      delay_s=0.0)
+        try:
+            codes = []
+            for i in range(6):
+                r = requests.post(
+                    f"http://{eng.name}/v1/completions",
+                    json={"service_request_id": f"cap-{i}",
+                          "source_service_addr": "127.0.0.1:1",
+                          "token_ids": [1, 2, 3], "max_tokens": 4},
+                    timeout=5)
+                codes.append(r.status_code)
+            # 1 dispatched + 2 queued; the burst beyond the bound 503s.
+            assert codes.count(503) >= 2
+            assert eng.rejected_overload >= 2
+            assert ("overload", "cap-5") in eng.stop_log
+            # Accepts are logged either way (the accept/stop log pairs).
+            assert len(eng.accepted_requests) == 6
+        finally:
+            eng.stop()
+
+    def test_service_rate_paces_dispatch(self, store):
+        eng = _engine(store, service_rate_rps=10.0, accept_queue_limit=0,
+                      delay_s=0.0)
+        try:
+            t0 = time.monotonic()
+            for i in range(5):
+                requests.post(
+                    f"http://{eng.name}/v1/completions",
+                    json={"service_request_id": f"pace-{i}",
+                          "source_service_addr": "127.0.0.1:1",
+                          "token_ids": [1], "max_tokens": 1},
+                    timeout=5)
+            accept_elapsed = time.monotonic() - t0
+            # Accepts are instant (no blocking-accept hack)...
+            assert accept_elapsed < 2.0
+            # ...while dispatch drains at the service rate: ~0.4s for
+            # the queue behind the first.
+            assert wait_until(lambda: eng._svc_queue.qsize() == 0,
+                              timeout=5)
+        finally:
+            eng.stop()
+
+
+# ========================================================== e2e deadline
+class TestDeadlineEndToEnd:
+    def test_expired_relayed_deadline_refused(self, store):
+        """The owner-side hop enforces the relay's absolute deadline."""
+        m = _master(store)
+        eng = _engine(store)
+        try:
+            _await_fleet([m], [eng])
+            before = _cancelled("deadline")
+            r = requests.post(
+                f"http://127.0.0.1:{m.rpc_port}"
+                "/rpc/handoff?kind=completion&sid=expired-sid",
+                json={"model": "fake-model", "prompt": "late",
+                      "max_tokens": 4},
+                headers={"x-xllm-deadline-ms": str(now_ms() - 5000)},
+                timeout=5)
+            assert r.status_code == 504
+            assert "expired" in r.text
+            assert _cancelled("deadline") == before + 1
+            assert not eng.accepted_requests   # never dispatched
+        finally:
+            eng.stop()
+            m.stop()
+
+    def test_mid_decode_expiry_stops_engine_within_one_pump(self, store):
+        """Engine-side enforcement, isolated from the service's cancel
+        path: the engine itself stops producing within one pump interval
+        of the deadline (asserted on its stop log + the push count)."""
+        import http.server
+
+        pushes = []
+
+        class _Sink(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                pushes.append(time.monotonic())
+                body = b'{"ok": true, "alive": {}}'
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        sink = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Sink)
+        threading.Thread(target=sink.serve_forever, daemon=True).start()
+        pump_s = 0.05
+        eng = _engine(store, delay_s=pump_s, chunk_size=1,
+                      reply_text="x" * 60)     # ~3s of tokens
+        try:
+            deadline = now_ms() + 400
+            r = requests.post(
+                f"http://{eng.name}/v1/completions",
+                json={"service_request_id": "mid-decode",
+                      "source_service_addr":
+                          f"127.0.0.1:{sink.server_address[1]}",
+                      "token_ids": [1, 2, 3], "max_tokens": 1000,
+                      "deadline_ms": deadline},
+                timeout=5)
+            assert r.status_code == 200
+            assert wait_until(
+                lambda: ("deadline", "mid-decode") in eng.stop_log,
+                timeout=5)
+            stopped_at = time.monotonic()
+            # Production stopped: no pushes after stop + one pump.
+            time.sleep(10 * pump_s)
+            assert not [t for t in pushes if t > stopped_at + 2 * pump_s]
+            # Far fewer than the full 60 deltas were produced.
+            assert len(pushes) < 30
+        finally:
+            eng.stop()
+            sink.shutdown()
+
+    def test_service_side_expiry_cancels_and_records(self, store):
+        """Full-stack: a too-slow generation 504s the client at its
+        deadline, cancels on the engines, bumps the deadline counter and
+        captures a flight-recorder bundle."""
+        m = _master(store)
+        eng = _engine(store, delay_s=0.1, chunk_size=1,
+                      reply_text="y" * 50)     # ~5s of tokens
+        try:
+            _await_fleet([m], [eng])
+            before = _cancelled("deadline")
+            t0 = time.monotonic()
+            r = requests.post(
+                _base(m) + "/v1/completions",
+                json={"model": "fake-model", "prompt": "slow",
+                      "max_tokens": 1000, "timeout": 0.6},
+                timeout=10)
+            elapsed = time.monotonic() - t0
+            assert r.status_code == 504, r.text
+            assert "deadline" in r.text
+            assert elapsed < 3.0               # the deadline, not the GC
+            assert wait_until(
+                lambda: _cancelled("deadline") >= before + 1, timeout=5)
+            sid = eng.accepted_requests[-1]["service_request_id"]
+            assert wait_until(
+                lambda: any(s == sid for _, s in eng.stop_log), timeout=5)
+            assert wait_until(
+                lambda: any(
+                    rec["request_id"] == sid
+                    for rec in RECORDER.recent(kind="deadline")),
+                timeout=5)
+        finally:
+            eng.stop()
+            m.stop()
+
+    def test_deadline_enforced_across_relayed_handoff(self, store):
+        """A relayed stream's deadline survives the hop: the owner
+        enforces the ACCEPTING frontend's absolute deadline and the
+        engine stops decoding."""
+        m1 = _master(store)
+        m2 = _master(store)
+        eng = _engine(store, delay_s=0.1, chunk_size=1,
+                      reply_text="z" * 50)
+        try:
+            _await_fleet([m1, m2], [eng])
+            okey = _key_owned_by(m1.scheduler.ownership,
+                                 m2.scheduler.self_addr)
+            before = _cancelled("deadline")
+            r = requests.post(
+                _base(m1) + "/v1/completions",
+                json={"model": "fake-model", "prompt": "relayed-slow",
+                      "max_tokens": 1000, "timeout": 0.6,
+                      "ownership_key": okey, "stream": True},
+                stream=True, timeout=15)
+            deadline_err = False
+            for line in r.iter_lines():
+                if line.startswith(b"data: ") and b"deadline" in line:
+                    deadline_err = True
+            r.close()
+            assert deadline_err
+            assert m1.scheduler.ownership.owner_of(okey) \
+                == m2.scheduler.self_addr
+            assert wait_until(
+                lambda: _cancelled("deadline") >= before + 1, timeout=5)
+            sid = eng.accepted_requests[-1]["service_request_id"]
+            assert wait_until(
+                lambda: any(s == sid for _, s in eng.stop_log), timeout=5)
+        finally:
+            eng.stop()
+            m1.stop()
+            m2.stop()
+
+
+# ===================================================== admission shedding
+class TestAdmissionShedding:
+    def test_shed_under_burst_keeps_admitted_requests_whole(self, store):
+        """A burst over the watermark: excess gets FAST 429s with
+        Retry-After, admitted requests complete normally, the shed rate
+        shows at /admin/overload, and the shed counter carries
+        reason="shed"."""
+        m = _master(store, admission_max_inflight_per_instance=2)
+        eng = _engine(store, service_rate_rps=10.0, delay_s=0.0,
+                      chunk_size=8)
+        try:
+            _await_fleet([m], [eng])
+            before = _cancelled("shed")
+            results = []
+            lock = threading.Lock()
+
+            def one(i):
+                t0 = time.monotonic()
+                try:
+                    r = requests.post(
+                        _base(m) + "/v1/completions",
+                        json={"model": "fake-model", "prompt": f"b{i}",
+                              "max_tokens": 8}, timeout=30)
+                    with lock:
+                        results.append(
+                            (r.status_code, time.monotonic() - t0,
+                             r.headers.get("Retry-After")))
+                except requests.RequestException:
+                    with lock:
+                        results.append((0, time.monotonic() - t0, None))
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            shed = [x for x in results if x[0] == 429]
+            served = [x for x in results if x[0] == 200]
+            assert shed, f"nothing shed: {results}"
+            assert served, f"nothing served: {results}"
+            # Shed responses are FAST (the whole point) + carry
+            # Retry-After.
+            assert max(x[1] for x in shed) < 2.0
+            assert all(x[2] is not None for x in shed)
+            assert _cancelled("shed") >= before + len(shed)
+            rep = requests.get(_base(m) + "/admin/overload",
+                               timeout=5).json()
+            assert rep["admission"]["enabled"]
+            assert rep["admission"]["shed_total"].get("interactive", 0) \
+                >= len(shed)
+            # The gate drains: pending returns to 0 after the burst.
+            assert wait_until(lambda: ADMISSION.pending() == 0, timeout=10)
+        finally:
+            eng.stop()
+            m.stop()
+
+    def test_brownout_clamps_batch_max_tokens_end_to_end(self, store):
+        m = _master(store, brownout_batch_max_tokens=2)
+        eng = _engine(store, chunk_size=4)
+        try:
+            _await_fleet([m], [eng])
+            BROWNOUT.tick(report=TestBrownoutController.HOT)
+            assert BROWNOUT.active()
+            r = requests.post(
+                _base(m) + "/v1/completions",
+                json={"model": "fake-model", "prompt": "bulk",
+                      "max_tokens": 1000},
+                headers={"x-request-priority": "batch"}, timeout=10)
+            assert r.status_code == 200
+            assert eng.accepted_requests[-1]["max_tokens"] == 2
+            # 2 deltas x 4 chars: the reply is clamped.
+            assert len(r.json()["choices"][0]["text"]) == 8
+            # Interactive traffic is untouched.
+            r = requests.post(
+                _base(m) + "/v1/completions",
+                json={"model": "fake-model", "prompt": "chat",
+                      "max_tokens": 1000}, timeout=10)
+            assert eng.accepted_requests[-1]["max_tokens"] == 1000
+        finally:
+            eng.stop()
+            m.stop()
+
+
+# ======================================================= circuit breaker
+class TestBreakerRoutingIntegration:
+    def test_open_excludes_half_open_probe_restores(self, store):
+        m = _master(store, circuit_breaker_min_samples=4,
+                    circuit_breaker_open_cooldown_s=0.3)
+        e1 = _engine(store)
+        e2 = _engine(store)
+        try:
+            _await_fleet([m], [e1, e2])
+            mgr = m.scheduler.instance_mgr
+            ch = mgr.get_channel(e1.name)
+            # Sick-but-leased: RPCs fail while heartbeats keep flowing.
+            for _ in range(5):
+                ch.breaker.record(False)
+            assert wait_until(
+                lambda: mgr.get_instance_state(e1.name)
+                == InstanceRuntimeState.BREAKER_OPEN, timeout=5)
+            snap = mgr.routing_snapshot()
+            assert e1.name not in snap.schedulable
+            assert e2.name in snap.schedulable
+            # Routing never picks the fenced instance.
+            for _ in range(10):
+                pair = mgr.get_next_instance_pair()
+                assert e1.name not in (pair.prefill_name,
+                                       pair.decode_name)
+            # The engine is actually fine -> the reconcile thread's
+            # half-open probe (after the cooldown) closes the breaker
+            # and restores routing.
+            assert wait_until(
+                lambda: mgr.get_instance_state(e1.name)
+                == InstanceRuntimeState.ACTIVE, timeout=10)
+            assert e1.name in mgr.routing_snapshot().schedulable
+            assert ch.breaker.state() == "closed"
+            # A registration refresh while OPEN must not resurrect it:
+            # covered by the wait above having outlived several 0.1s
+            # heartbeat refreshes while the cooldown held.
+        finally:
+            e1.stop()
+            e2.stop()
+            m.stop()
+
+    def test_breaker_open_then_silent_is_evicted(self, store):
+        """A breaker-open instance that ALSO goes silent is dead, not
+        busy: heartbeat-silence promotion must apply to BREAKER_OPEN
+        too, or the ghost sits outside the SUSPECT/evict path forever
+        (no eviction timer, no further lease event, every probe just
+        re-opens the breaker) and its requests never fail over."""
+        m = _master(store, circuit_breaker_min_samples=4,
+                    circuit_breaker_open_cooldown_s=60.0)
+        e1 = _engine(store)
+        e2 = _engine(store)
+        try:
+            _await_fleet([m], [e1, e2])
+            mgr = m.scheduler.instance_mgr
+            ch = mgr.get_channel(e1.name)
+            for _ in range(5):
+                ch.breaker.record(False)
+            assert wait_until(
+                lambda: mgr.get_instance_state(e1.name)
+                == InstanceRuntimeState.BREAKER_OPEN, timeout=5)
+            # Now the instance dies outright (no lease-delete left to
+            # fire a probe; the long breaker cooldown means no half-open
+            # recovery either).
+            e1.kill()
+            assert wait_until(
+                lambda: mgr.get_instance_meta(e1.name) is None, timeout=10)
+            assert e2.name in mgr.routing_snapshot().schedulable
+        finally:
+            e1.stop()
+            e2.stop()
+            m.stop()
+
+    def test_open_channel_fails_fast(self, store):
+        m = _master(store)
+        e1 = _engine(store)
+        try:
+            _await_fleet([m], [e1])
+            ch = m.scheduler.instance_mgr.get_channel(e1.name)
+            for _ in range(5):
+                ch.breaker.record(False)
+            t0 = time.monotonic()
+            ok, err = ch.forward("/v1/completions", {"prompt": "x"})
+            assert not ok and "circuit breaker open" in str(err)
+            assert time.monotonic() - t0 < 0.5   # no network, no retries
+        finally:
+            e1.stop()
+            m.stop()
+
+
+# ===================================================== global retry budget
+class TestRetryBudgetEndToEnd:
+    def test_failover_denied_when_budget_exhausted(self, store):
+        m = _master(store, retry_budget_ratio=0.0, retry_budget_cap=1.0,
+                    failover_max_retries=3)
+        e1 = _engine(store, delay_s=0.05)
+        e2 = _engine(store, delay_s=0.05)
+        try:
+            _await_fleet([m], [e1, e2])
+            # Drain the single token.
+            assert RETRY_BUDGET.try_spend()
+            assert RETRY_BUDGET.tokens() == 0.0
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=2, max_fires=1)], seed=SEED)
+            r = requests.post(
+                _base(m) + "/v1/completions",
+                json={"model": "fake-model", "prompt": "budget",
+                      "max_tokens": 1000}, timeout=30)
+            assert r.status_code == 503
+            assert "retry budget" in r.text
+            assert RETRY_BUDGET.report()["denied_total"] >= 1
+        finally:
+            e1.stop()
+            e2.stop()
+            m.stop()
+
+
+# =================================================== review regressions
+class TestReviewRegressions:
+    def test_admission_slot_released_on_raising_parser(self, store):
+        """A request that is admitted but then fails field parsing
+        (e.g. a non-numeric temperature in /v1/messages) must release
+        its admission slot — a leaked slot is permanent (release clamps
+        at zero) and would eventually shed everything."""
+        m = _master(store, admission_max_inflight_per_instance=2)
+        eng = _engine(store)
+        try:
+            _await_fleet([m], [eng])
+            for _ in range(5):   # more than the whole limit
+                r = requests.post(
+                    _base(m) + "/v1/messages",
+                    json={"model": "fake-model", "max_tokens": 8,
+                          "temperature": "hot",
+                          "messages": [{"role": "user", "content": "x"}]},
+                    timeout=5)
+                assert r.status_code == 400, r.text
+            assert ADMISSION.pending() == 0
+            # The gate still admits after the bad-request storm.
+            r = requests.post(
+                _base(m) + "/v1/completions",
+                json={"model": "fake-model", "prompt": "ok",
+                      "max_tokens": 4}, timeout=10)
+            assert r.status_code == 200, r.text
+        finally:
+            eng.stop()
+            m.stop()
+
+    def test_breaker_ignores_deliberate_overload_answers(self, store):
+        """An engine fast-rejecting with 503 (draining / queue full) or
+        504 (deadline) is BUSY, not sick — those answers must not trip
+        the breaker (the ejection-cascade bug class), while transport
+        failures still must."""
+        m = _master(store)
+        eng = _engine(store)
+        try:
+            _await_fleet([m], [eng])
+            ch = m.scheduler.instance_mgr.get_channel(eng.name)
+            eng.draining = True    # every accept now 503s deliberately
+            for _ in range(8):
+                ok, _ = ch.forward("/v1/completions",
+                                   {"service_request_id": "busy",
+                                    "source_service_addr": "127.0.0.1:1",
+                                    "token_ids": [1], "max_tokens": 1})
+                assert not ok
+            assert ch.breaker.state() == "closed"
+            # Transport failures DO count: kill the engine and hammer.
+            eng.stop()
+            for _ in range(8):
+                ch.cancel("gone")
+            assert ch.breaker.state() == "open"
+        finally:
+            eng.stop()
+            m.stop()
+
+    def test_relayed_shed_keeps_retry_after(self, store):
+        """A shed 429 crossing the handoff relay must keep its
+        Retry-After header (the admission gate's backoff hint)."""
+        m1 = _master(store, admission_max_inflight_per_instance=1)
+        m2 = _master(store, admission_max_inflight_per_instance=1)
+        eng = _engine(store)
+        try:
+            _await_fleet([m1, m2], [eng])
+            okey = _key_owned_by(m1.scheduler.ownership,
+                                 m2.scheduler.self_addr)
+            # Saturate the (shared in-process) gate so the owner sheds.
+            ok, _, _ = ADMISSION.try_admit("interactive", live=1,
+                                           burn_hot=False)
+            assert ok
+            r = requests.post(
+                _base(m1) + "/v1/completions",
+                json={"model": "fake-model", "prompt": "relayed-shed",
+                      "max_tokens": 4, "ownership_key": okey},
+                timeout=10)
+            assert r.status_code == 429, r.text
+            assert r.headers.get("Retry-After") is not None
+        finally:
+            ADMISSION.release()
+            eng.stop()
+            m1.stop()
+            m2.stop()
+
+
+# ========================================= relay client-disconnect drill
+class TestRelayedClientDisconnect:
+    def test_dropped_relayed_stream_cancels_on_engines(self, store):
+        """Satellite drill: a client dropping a RELAYED stream must
+        propagate cancel through /rpc/handoff to the owner and on to
+        the engines (previously only the direct path's
+        mark_disconnected -> _cancel_on_engines chain was exercised)."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engines = [_engine(store, delay_s=0.1, chunk_size=1,
+                           reply_text="d" * 80) for _ in range(2)]
+        try:
+            _await_fleet([m1, m2], engines)
+            okey = _key_owned_by(m1.scheduler.ownership,
+                                 m2.scheduler.self_addr)
+            r = requests.post(
+                _base(m1) + "/v1/completions",
+                json={"model": "fake-model", "prompt": "drop-me",
+                      "max_tokens": 1000, "stream": True,
+                      "ownership_key": okey},
+                stream=True, timeout=15)
+            assert r.status_code == 200
+            frames = 0
+            for line in r.iter_lines():
+                if line.startswith(b"data: "):
+                    frames += 1
+                    if frames >= 3:
+                        break
+            # Drop the CLIENT connection mid-stream.
+            r.close()
+            accepted = [req for e in engines
+                        for req in e.accepted_requests]
+            assert accepted, "engine never saw the relayed dispatch"
+            sid = accepted[-1]["service_request_id"]
+            # The cancel must reach the serving engine(s): the relay
+            # aborts the owner connection, the owner's next SSE write
+            # fails, and its disconnect path cancels on the engines.
+            assert wait_until(
+                lambda: any(sid in e.cancelled for e in engines),
+                timeout=10)
+            assert wait_until(
+                lambda: any(("cancel", sid) in e.stop_log
+                            or ("stopped", sid) in e.stop_log
+                            for e in engines), timeout=10)
+        finally:
+            for e in engines:
+                e.stop()
+            m1.stop()
+            m2.stop()

@@ -124,6 +124,13 @@ class KernelInputs:
     # registered. Counted toward capacity so a slow-to-register launch
     # is not re-launched every tick (the respawn-storm guard).
     pending_launches: int = 0
+    # Admission-gate shed rate (sheds/s over the overload plane's
+    # window): shedding is UNSERVED DEMAND — the burn monitor goes
+    # quiet exactly when shedding works (admitted requests meet their
+    # SLO), so without this input the controller would read a shedding
+    # fleet as healthy and never add the capacity that would stop the
+    # shedding. Any sustained shed rate is a breach signal.
+    shed_rate: float = 0.0
     max_load_age_s: float = 0.0
     scale_in_candidate: str = ""
     flip_proposals: tuple = ()     # ((instance, target_type_str), ...)
@@ -209,16 +216,20 @@ def decide(inp: KernelInputs, st: KernelState,
         return actions, dataclasses.replace(st, desired=desired), reasons
 
     breach = bool(inp.breaching) or inp.pressure >= cfg.scale_out_pressure \
-        or inp.kv_pressure >= cfg.kv_pressure
+        or inp.kv_pressure >= cfg.kv_pressure or inp.shed_rate > 0.0
     idle = (not breach and inp.pressure <= cfg.scale_in_pressure
             and inp.worst_fast_burn < 1.0 and inp.worst_slow_burn < 1.0)
     breach_streak = st.breach_streak + 1 if breach else 0
     idle_streak = st.idle_streak + 1 if idle else 0
     if breach:
+        what = ", ".join(inp.breaching) or (
+            f"shedding {inp.shed_rate:.1f}/s" if inp.shed_rate > 0
+            else "pressure")
         reasons.append(
-            "breaching: " + (", ".join(inp.breaching) or "pressure") +
+            f"breaching: {what}"
             f" (fast burn {inp.worst_fast_burn:.1f}, "
-            f"pressure {inp.pressure:.2f}, kv {inp.kv_pressure:.2f}; "
+            f"pressure {inp.pressure:.2f}, kv {inp.kv_pressure:.2f}, "
+            f"shed {inp.shed_rate:.2f}/s; "
             f"streak {breach_streak}/{cfg.breach_ticks})")
 
     last_out, last_in = st.last_scale_out_s, st.last_scale_in_s
@@ -263,7 +274,9 @@ def decide(inp: KernelInputs, st: KernelState,
             actions.append(Action(
                 ACTION_SCALE_OUT, count=n,
                 reason="SLO burn over alert" if inp.breaching
-                else "fleet pressure over threshold"))
+                else ("admission shedding load (unserved demand)"
+                      if inp.shed_rate > 0
+                      else "fleet pressure over threshold")))
             last_out = inp.now_s
             breach_streak = 0
     elif idle and idle_streak >= cfg.idle_ticks:
@@ -402,6 +415,7 @@ class AutoscalerController:
                 "draining": inputs.draining,
                 "suspect": inputs.suspect,
                 "pending_launches": inputs.pending_launches,
+                "shed_rate": round(inputs.shed_rate, 3),
                 "desired": nxt.desired,
                 "max_load_age_s": inputs.max_load_age_s,
             },
@@ -472,6 +486,12 @@ class AutoscalerController:
             kv = plan.kv_pressure
             pressure = self._plan_pressure(plan)
 
+        # Overload-plane coupling: the admission gate's shed rate is
+        # unserved demand the burn monitor can no longer see (shed
+        # requests never produce TTFT samples) — it must drive
+        # scale-out, and it decays to ~0 as the capacity arrives.
+        from ..overload import ADMISSION
+
         return KernelInputs(
             now_s=now_s,
             breaching=tuple(report.get("breaching", ())),
@@ -483,6 +503,7 @@ class AutoscalerController:
             draining=draining,
             suspect=suspect,
             pending_launches=pending,
+            shed_rate=ADMISSION.shed_rate(),
             max_load_age_s=max_age,
             scale_in_candidate=self._pick_scale_in_victim(
                 snap, live_names),

@@ -184,6 +184,46 @@ class ServiceOptions:
     autoscaler_spawn_cmd: str = ""
     # JSONL dump directory ("" = in-memory ring only).
     flightrecorder_dir: str = ""
+    # --- overload-hardening plane (overload/, docs/robustness.md) ---
+    # Default per-request deadline budget in ms (0 = none). A request's
+    # own `timeout` body field (seconds) or `x-request-deadline-ms`
+    # header wins; the absolute deadline propagates through the enriched
+    # payload and the handoff wire and is enforced at every hop —
+    # admission, scheduler GC, mid-stream ingest, and the engines. The
+    # blunt `request_timeout_s` GC remains the backstop bound.
+    default_request_deadline_ms: float = 0.0
+    # Admission gate: max in-flight admitted requests per live instance
+    # (limit = this x live fleet size off the RCU routing snapshot;
+    # 0 = admission control disabled). Requests over the watermark get a
+    # fast 429 + Retry-After instead of queueing.
+    admission_max_inflight_per_instance: int = 0
+    # Batch-priority (x-request-priority: batch) watermark as a fraction
+    # of the admission limit; batch is shed entirely while the SLO burn
+    # is hot (brownout state).
+    admission_batch_watermark: float = 0.5
+    admission_retry_after_s: float = 1.0
+    # Brownout: when any SLO objective breaches on BOTH burn windows,
+    # degrade before refusing — clamp batch max_tokens and shed optional
+    # work (trace head-sampling drops to brownout_trace_sample_rate);
+    # lifts after recover_ticks consecutive clean sync passes.
+    brownout_enabled: bool = True
+    brownout_batch_max_tokens: int = 32
+    brownout_recover_ticks: int = 2
+    brownout_trace_sample_rate: float = 0.0
+    # Per-instance circuit breaker on the engine channel (rpc/breaker.py):
+    # a rolling error/timeout window flips the channel OPEN — the routing
+    # snapshot excludes the instance like SUSPECT — and a half-open probe
+    # (reconcile thread) restores it.
+    circuit_breaker_enabled: bool = True
+    circuit_breaker_window_s: float = 30.0
+    circuit_breaker_min_samples: int = 5
+    circuit_breaker_failure_ratio: float = 0.5
+    circuit_breaker_open_cooldown_s: float = 5.0
+    # Global retry budget across failover + relay recovery (token
+    # bucket: each accepted request deposits `ratio` tokens, each retry
+    # spends one; cap = burst allowance, 0 disables).
+    retry_budget_ratio: float = 0.1
+    retry_budget_cap: float = 50.0
     debug_log: bool = field(
         default_factory=lambda: os.environ.get("ENABLE_XLLM_DEBUG_LOG", "") not in ("", "0", "false"))
     # --- multi-master service plane (multimaster/) ---

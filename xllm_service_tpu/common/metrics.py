@@ -389,10 +389,17 @@ RPC_RETRIES_TOTAL = REGISTRY.counter(
 INSTANCE_EVICTIONS_TOTAL = REGISTRY.counter(
     "instance_evictions_total", "Instances removed from the fleet",
     labelnames=("instance",))
-REQUESTS_CANCELLED_ON_FAILURE_TOTAL = REGISTRY.counter(
-    "requests_cancelled_on_failure_total",
-    "Requests surfaced as errors after instance failure "
-    "(failover disabled, budget exhausted, or no payload to replay)")
+# Successor of requests_cancelled_on_failure_total (which only counted
+# the failover-surfaced subset): every service-side cancellation, by
+# cause. Bounded label set (the four causes below) — no eviction needed,
+# unlike the per-instance series above.
+REQUESTS_CANCELLED_TOTAL = REGISTRY.counter(
+    "requests_cancelled_total",
+    "Requests cancelled by the service, by cause "
+    "(deadline = per-request deadline / GC timeout expiry, disconnect = "
+    "client went away, shed = admission control refused it, failover = "
+    "instance failure with no replay path or budget exhausted)",
+    labelnames=("reason",))
 
 # Fleet observability plane (docs/observability.md): locally-exported
 # control-plane freshness gauges (previously visible only as
@@ -440,6 +447,26 @@ AUTOSCALER_LAST_DECISION_AGE_SECONDS = REGISTRY.gauge(
     "autoscaler_last_decision_age_seconds",
     "Seconds since the autoscaler controller last completed a decision "
     "tick (-1 = never ticked / disabled)")
+# Overload-hardening plane (overload/, docs/robustness.md): admission
+# gate depth, brownout state, retry-budget level (scrape-time refreshed
+# by the /metrics handler) and per-instance breaker state (written on
+# reconcile transitions; series evicted with the instance).
+ADMISSION_PENDING_REQUESTS = REGISTRY.gauge(
+    "admission_pending_requests",
+    "In-flight requests admitted through the overload-admission gate")
+BROWNOUT_ACTIVE = REGISTRY.gauge(
+    "brownout_active",
+    "1 while the frontend is in brownout (SLO burn breaching on both "
+    "windows: batch max_tokens clamped, optional work shed)")
+RETRY_BUDGET_TOKENS = REGISTRY.gauge(
+    "retry_budget_tokens",
+    "Remaining global retry-budget tokens (failover + relay recovery "
+    "spend from this bucket; empty = retries fail fast)")
+CIRCUIT_BREAKER_OPEN = REGISTRY.gauge(
+    "circuit_breaker_open",
+    "1 while an instance's engine channel is OPEN/HALF_OPEN (excluded "
+    "from routing like SUSPECT until a half-open probe closes it)",
+    labelnames=("instance",))
 SLO_BURN_RATE = REGISTRY.gauge(
     "slo_burn_rate",
     "Error-budget burn rate per objective and rolling window "

@@ -241,6 +241,15 @@ class Request:
     include_usage: bool = False   # stream_options.include_usage
     offline: bool = False         # online/offline hybrid scheduling hook
     priority: int = 0             # higher = more urgent (offline default 0)
+    # Overload plane (overload/): admission priority class
+    # ("interactive" | "batch" — batch is shed first under overload and
+    # max_tokens-clamped under brownout), the absolute end-to-end
+    # deadline (epoch ms; 0 = none — carried in the enriched payload and
+    # the handoff wire, enforced at every hop), and whether this request
+    # holds an admission-gate slot (released exactly once at exit).
+    priority_class: str = "interactive"
+    deadline_ms: int = 0
+    admitted: bool = False
     # Inputs.
     prompt: str = ""
     messages: list[dict[str, Any]] = field(default_factory=list)

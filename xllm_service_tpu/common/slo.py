@@ -40,10 +40,13 @@ from ..devtools.locks import make_lock
 OBJECTIVES = ("ttft", "tpot", "error_rate")
 
 
-class _WindowCounts:
+class WindowCounts:
     """Rolling good/bad counts bucketed per second (one deque of
     ``[sec, good, bad]`` triples; writers append/merge at the tail,
-    readers prune the head lazily)."""
+    readers prune the head lazily — bounded at any rate with or without
+    a reader). Shared helper: the SLO objectives' windows here and the
+    admission gate's shed-rate window (overload/admission.py) both ride
+    it. NOT internally locked — the owner serializes access."""
 
     def __init__(self, window_s: float):
         self.window_s = max(1.0, float(window_s))
@@ -85,14 +88,14 @@ class _Objective:
         self.name = name
         self.target = target          # ms threshold; None = outcome-based
         self.budget = max(1e-6, float(budget))
-        self.fast = _WindowCounts(fast_s)
-        self.slow = _WindowCounts(slow_s)
+        self.fast = WindowCounts(fast_s)
+        self.slow = WindowCounts(slow_s)
 
     def record(self, bad: bool, now: Optional[float] = None) -> None:
         self.fast.record(bad, now)
         self.slow.record(bad, now)
 
-    def window_report(self, w: _WindowCounts,
+    def window_report(self, w: WindowCounts,
                       now: Optional[float] = None) -> dict[str, Any]:
         good, bad = w.counts(now)
         n = good + bad

@@ -64,12 +64,20 @@ class InstanceRuntimeState(str, enum.Enum):
     A DRAINING instance that dies mid-drain transitions through the
     normal LEASE_LOST/SUSPECT failure path, so its remaining requests
     still fail over.
+
+    BREAKER_OPEN (overload plane, rpc/breaker.py): the instance's
+    engine channel tripped its circuit breaker — sick-but-leased, its
+    lease keeps renewing while RPCs fail. Excluded from scheduling like
+    SUSPECT, but NOT evicted on a timer: the reconcile thread's
+    half-open probe restores it to ACTIVE when the channel recovers. A
+    registration refresh must not resurrect it (same rule as DRAINING).
     """
 
     ACTIVE = "ACTIVE"
     LEASE_LOST = "LEASE_LOST"
     SUSPECT = "SUSPECT"
     DRAINING = "DRAINING"
+    BREAKER_OPEN = "BREAKER_OPEN"
 
 
 class RequestAction(str, enum.Enum):

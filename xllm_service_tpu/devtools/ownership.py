@@ -214,6 +214,47 @@ STATE_DISCIPLINES: dict[str, str] = {
     "LocalProcessActuator._opts": "init-only",
     "LocalProcessActuator._spawn_cmd": "init-only",
     "LocalProcessActuator._max_procs": "init-only",
+    # ------------------------------------------------- AdmissionController
+    # The overload-admission gate (overload/admission.py): pending
+    # count + shed buckets written from every request-accept thread and
+    # the scheduler's exit paths; config rebinds from configure().
+    "AdmissionController._per_instance_limit": "lock:_lock",
+    "AdmissionController._batch_watermark": "lock:_lock",
+    "AdmissionController._retry_after_s": "lock:_lock",
+    "AdmissionController._pending": "lock:_lock",
+    "AdmissionController._admitted_total": "lock:_lock",
+    "AdmissionController._shed_total": "lock:_lock",
+    "AdmissionController._shed_window": "lock:_lock",
+    # -------------------------------------------------- BrownoutController
+    # Degradation state (overload/brownout.py): flipped by the sync
+    # thread's tick, read lock-free by the request paths (active() is
+    # one GIL-atomic bool load).
+    "BrownoutController._enabled": "lock:_lock",
+    "BrownoutController._batch_max_tokens": "lock:_lock",
+    "BrownoutController._recover_ticks": "lock:_lock",
+    "BrownoutController._trace_sample_rate": "lock:_lock",
+    "BrownoutController._restore_rate_fn": "lock:_lock",
+    "BrownoutController._active": "lock:_lock",
+    "BrownoutController._since_s": "lock:_lock",
+    "BrownoutController._recover_streak": "lock:_lock",
+    "BrownoutController._entered_total": "lock:_lock",
+    "BrownoutController._log": "lock:_lock",
+    # --------------------------------------------------------- RetryBudget
+    # Global retry token bucket (overload/retry_budget.py): deposits
+    # from accept threads, withdrawals from failover/relay threads.
+    "RetryBudget._ratio": "lock:_lock",
+    "RetryBudget._cap": "lock:_lock",
+    "RetryBudget._tokens": "lock:_lock",
+    "RetryBudget._spent_total": "lock:_lock",
+    "RetryBudget._denied_total": "lock:_lock",
+    # ------------------------------------------------------ CircuitBreaker
+    # Per-channel breaker (rpc/breaker.py): outcome recording from every
+    # channel-calling thread; state transitions under the same leaf lock.
+    "CircuitBreaker._events": "lock:_lock",
+    "CircuitBreaker._state": "lock:_lock",
+    "CircuitBreaker._opened_at": "lock:_lock",
+    "CircuitBreaker._probe_inflight": "lock:_lock",
+    "CircuitBreaker._open_total": "lock:_lock",
     # ------------------------------------------------------- EngineChannel
     # The negotiated dispatch-wire slot: set at registration, demoted
     # (one-way, to JSON) on an HTTP 415 — every write site carries an
@@ -250,6 +291,10 @@ STATE_CLASSES: tuple = (
     "AutoscalerController",
     "HintActuator",
     "LocalProcessActuator",
+    "AdmissionController",
+    "BrownoutController",
+    "RetryBudget",
+    "CircuitBreaker",
 )
 
 #: Thread roles for ``confined:<role>`` disciplines. ``threads`` are

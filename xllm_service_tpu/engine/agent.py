@@ -37,7 +37,8 @@ from ..common.flightrecorder import RECORDER
 from ..common.metrics import ENGINE_HEARTBEATS_TOTAL, ENGINE_PEER_LINKED
 from ..common.request import LogProb, RequestOutput, SamplingParams, Status, StatusCode
 from ..common.tracing import NOOP_SPAN, TRACER, TraceContext
-from ..common.types import InstanceMetaInfo, InstanceType, TpuTopology
+from ..common.types import (InstanceMetaInfo, InstanceType, TpuTopology,
+                            now_ms)
 from ..devtools.locks import make_lock
 from ..coordination import CoordinationClient, connect
 from ..rpc import MASTER_KEY, instance_key
@@ -1033,6 +1034,14 @@ class EngineAgent:
         sid = body.get("service_request_id") or f"local-{uuid.uuid4().hex[:8]}"
         source = body.get("source_service_addr", "")
         token_ids = list(body.get("token_ids") or ())
+        # End-to-end deadline (overload plane): the enriched payload
+        # carries the ABSOLUTE deadline; work that expired while queued
+        # upstream is refused outright, and a mid-decode expiry cancels
+        # the engine stream within one output callback.
+        deadline_ms = int(body.get("deadline_ms") or 0)
+        if deadline_ms and now_ms() > deadline_ms:
+            return web.json_response({"error": "deadline exceeded"},
+                                     status=504)
 
         # EPD multimodal: extract images, encode (locally or on the routed
         # ENCODE instance), and rebuild token ids with image-token runs the
@@ -1086,6 +1095,13 @@ class EngineAgent:
         def on_output(out: RequestOutput) -> None:
             # Agent-side TTFT span: HTTP accept -> first delta pushed to
             # the streamer. Client TTFT minus this is master+wire cost.
+            if deadline_ms and not out.finished and now_ms() > deadline_ms:
+                # Mid-decode deadline expiry: stop this request through
+                # the existing cancel path (fans across dp replicas) —
+                # token production halts within one pump interval. The
+                # delta in hand still ships; the service 504s the
+                # client either way.
+                self.cancel(out.service_request_id)
             err = None if out.status.ok() else \
                 f"ERROR: {out.status.message or out.status.code.name}"
             if first_delta[0]:

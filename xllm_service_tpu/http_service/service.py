@@ -40,13 +40,17 @@ from ..common import flightrecorder
 from ..common.flightrecorder import RECORDER
 from ..common.hotpath import HOTPATH
 from ..common.metrics import (
+    ADMISSION_PENDING_REQUESTS,
     AUTOSCALER_LAST_DECISION_AGE_SECONDS,
+    BROWNOUT_ACTIVE,
     FLEET_SIZE,
     HANDOFF_SERVED_TOTAL,
     KVCACHE_FRAME_LOG_SEQ,
     LOADINFO_MAX_AGE_SECONDS,
     LOADINFO_STALE_INSTANCES,
     REGISTRY,
+    REQUESTS_CANCELLED_TOTAL,
+    RETRY_BUDGET_TOKENS,
     ROUTING_SNAPSHOT_AGE_SECONDS,
     SERVER_REQUEST_IN_TOTAL,
     relabel_prometheus_text,
@@ -57,6 +61,16 @@ from ..common import tracing
 from ..common.tracing import TRACER, TraceContext, merge_fleet_spans, span_tree
 from ..common.types import InstanceType
 from ..multimaster.handoff import HandoffRelay
+from ..overload import (
+    ABS_DEADLINE_HEADER,
+    ADMISSION,
+    BROWNOUT,
+    RETRY_BUDGET,
+    deadline_expired,
+    parse_deadline_ms,
+    parse_priority,
+)
+from ..overload.deadline import remaining_ms
 from ..rpc import wire
 from ..scheduler.scheduler import Scheduler
 from ..utils import generate_service_request_id, get_logger, short_uuid
@@ -159,6 +173,21 @@ class XllmHttpService:
         RECORDER.configure(capacity=self.opts.flightrecorder_capacity,
                            directory=self.opts.flightrecorder_dir)
         RECORDER.add_context_provider("service", self._anomaly_context)
+        # Overload-hardening plane (overload/, docs/robustness.md):
+        # admission gate, brownout state, global retry budget. Ticked by
+        # the scheduler's sync loop; enforced on the request paths here.
+        ADMISSION.configure(
+            per_instance_limit=self.opts.admission_max_inflight_per_instance,
+            batch_watermark=self.opts.admission_batch_watermark,
+            retry_after_s=self.opts.admission_retry_after_s)
+        BROWNOUT.configure(
+            enabled=self.opts.brownout_enabled,
+            batch_max_tokens=self.opts.brownout_batch_max_tokens,
+            recover_ticks=self.opts.brownout_recover_ticks,
+            trace_sample_rate=self.opts.brownout_trace_sample_rate,
+            restore_rate_fn=lambda: self.opts.trace_sample_rate)
+        RETRY_BUDGET.configure(ratio=self.opts.retry_budget_ratio,
+                               cap=self.opts.retry_budget_cap)
         # /metrics/fleet TTL cache: (monotonic deadline, rendered text).
         self._fleet_metrics_cache: Optional[tuple[float, str]] = None
         self._client: Optional[aiohttp.ClientSession] = None
@@ -192,6 +221,7 @@ class XllmHttpService:
         app.router.add_post("/admin/config", self.handle_set_config)
         app.router.add_get("/admin/planner", self.handle_planner)
         app.router.add_get("/admin/autoscaler", self.handle_autoscaler)
+        app.router.add_get("/admin/overload", self.handle_overload)
         app.router.add_get("/admin/hotpath", self.handle_hotpath)
         app.router.add_get("/admin/faults", self.handle_get_faults)
         app.router.add_post("/admin/faults", self.handle_set_faults)
@@ -287,7 +317,9 @@ class XllmHttpService:
         return await self._handle_generate(request, kind="chat")
 
     async def handle_messages(self, http_req: web.Request,
-                              sid: Optional[str] = None) -> web.StreamResponse:
+                              sid: Optional[str] = None,
+                              deadline_override: int = 0
+                              ) -> web.StreamResponse:
         """Anthropic-style Messages API (`/v1/messages`): the reference
         family acknowledges this surface only as an engine proto
         (`anthropic.proto` in `proto/CMakeLists.txt:18-37`) with no
@@ -304,29 +336,66 @@ class XllmHttpService:
             return _error_response(400, "invalid JSON body")
         if not isinstance(body, dict):
             return _error_response(400, "request body must be a JSON object")
+        # Overload plane (same order as _handle_generate): deadline +
+        # priority first, admission after the relay decision.
+        priority = parse_priority(body, http_req.headers)
+        deadline_ms = deadline_override or parse_deadline_ms(
+            body, http_req.headers, self.opts.default_request_deadline_ms)
+        if deadline_expired(deadline_ms):
+            REQUESTS_CANCELLED_TOTAL.labels(reason="deadline").inc()
+            return _error_response(504, "request deadline already expired",
+                                   "timeout")
         handoff = sid is not None
         if not handoff:
             sid, owner, owner_key = self._assign_ownership("messages", body)
             if owner != self.scheduler.self_addr:
+                RETRY_BUDGET.note_request()
                 return await self._relay_to_owner(
                     http_req, raw, "messages", sid, owner, owner_key,
-                    bool(body.get("stream", False)))
+                    bool(body.get("stream", False)),
+                    deadline_ms=deadline_ms, priority=priority)
         if not isinstance(body.get("max_tokens"), int) \
                 or body["max_tokens"] < 1:
             return _error_response(400, "max_tokens is required")
         msgs = body.get("messages")
         if not isinstance(msgs, list) or not msgs:
             return _error_response(400, "messages must be a non-empty list")
+        shed = self._admission_check(priority)
+        if shed is not None:
+            return shed
+        RETRY_BUDGET.note_request()
+        # Same slot-ownership discipline as _handle_generate: the finally
+        # releases on every path that never registers the request.
+        slot = {"held": True}
+        try:
+            return await self._admitted_messages(
+                http_req, sid, body, msgs, priority, deadline_ms,
+                handoff, slot)
+        finally:
+            if slot["held"]:
+                ADMISSION.release()
 
-        sp = _parse_sampling(body)
+    async def _admitted_messages(self, http_req: web.Request, sid: str,
+                                 body: dict[str, Any], msgs: list,
+                                 priority: str, deadline_ms: int,
+                                 handoff: bool,
+                                 slot: dict) -> web.StreamResponse:
+        try:
+            sp = _parse_sampling(body)
+        except (TypeError, ValueError, AttributeError) as e:
+            return _error_response(400, f"invalid request field: {e}")
         stops = body.get("stop_sequences")
         if isinstance(stops, list):
             sp.stop = [str(s) for s in stops]
+        sp.max_tokens = BROWNOUT.clamp_max_tokens(priority, sp.max_tokens)
+        body["max_tokens"] = sp.max_tokens
         req = Request(
             service_request_id=sid,
             request_id="msg_" + short_uuid(),
             model=body.get("model", self.opts.model_id or ""),
             stream=bool(body.get("stream", False)),
+            priority_class=priority,
+            deadline_ms=deadline_ms,
             sampling=sp,
         )
         # Anthropic carries the system prompt out-of-band; normalize
@@ -381,12 +450,16 @@ class XllmHttpService:
                         "decode_name": req.routing.decode_name,
                         "encode_name": req.routing.encode_name},
         }
+        if req.deadline_ms:
+            enriched["deadline_ms"] = req.deadline_ms
         if body.get("top_p") is not None:
             enriched["top_p"] = body["top_p"]
         if body.get("top_k") is not None:
             enriched["top_k"] = body["top_k"]
         if req.trace is not None:
             enriched["trace_context"] = req.trace.to_dict()
+        req.admitted = True
+        slot["held"] = False
         self.scheduler.record_new_request(
             req, conn, "anthropic",
             forward_path="/v1/chat/completions", forward_payload=enriched)
@@ -431,14 +504,38 @@ class XllmHttpService:
 
     async def _relay_to_owner(self, http_req: web.Request, raw: bytes,
                               kind: str, sid: str, owner: str,
-                              owner_key: str, stream: bool) -> web.StreamResponse:
+                              owner_key: str, stream: bool,
+                              deadline_ms: int = 0,
+                              priority: str = "") -> web.StreamResponse:
         assert self._client is not None
         return await self._relay.relay(
             http_req, self._client, raw, kind, sid, owner, owner_key,
-            stream, self.opts.request_timeout_s)
+            stream, self.opts.request_timeout_s,
+            deadline_ms=deadline_ms, priority=priority)
+
+    def _admission_check(self, priority: str) -> Optional[web.Response]:
+        """Overload-admission gate (overload/admission.py): None =
+        admitted (the caller must set `req.admitted` so exit accounting
+        releases the slot), else the fast 429. Runs on the event loop —
+        one leaf-lock hold over integer math, no RPC, no tokenize."""
+        admit, reason, retry_after = ADMISSION.try_admit(
+            priority,
+            live=len(self.scheduler.instance_mgr
+                     .routing_snapshot().schedulable),
+            burn_hot=BROWNOUT.active())
+        if admit:
+            return None
+        REQUESTS_CANCELLED_TOTAL.labels(reason="shed").inc()
+        return web.json_response(
+            {"error": {"message": f"overloaded: {reason}",
+                       "type": "overloaded_error", "code": 429}},
+            status=429,
+            headers={"Retry-After": str(max(1, int(retry_after)))})
 
     async def _handle_generate(self, http_req: web.Request, kind: str,
-                               sid: Optional[str] = None) -> web.StreamResponse:
+                               sid: Optional[str] = None,
+                               deadline_override: int = 0
+                               ) -> web.StreamResponse:
         if sid is None:
             # Relayed handoffs already counted at their accepting
             # frontend; HANDOFF_SERVED_TOTAL tracks the owner-side serve.
@@ -451,6 +548,21 @@ class XllmHttpService:
         if not isinstance(body, dict):
             return _error_response(400, "request body must be a JSON object")
 
+        # Overload plane: resolve the end-to-end deadline and the
+        # priority class BEFORE any expensive work. A relayed handoff
+        # carries the ABSOLUTE deadline the accepting frontend computed
+        # (re-parsing the body's relative budget here would extend the
+        # deadline by the relay hop it is meant to bound).
+        priority = parse_priority(body, http_req.headers)
+        deadline_ms = deadline_override or parse_deadline_ms(
+            body, http_req.headers, self.opts.default_request_deadline_ms)
+        if deadline_expired(deadline_ms):
+            # Admission rejects already-expired work: serving it burns
+            # fleet capacity on an answer nobody is waiting for.
+            REQUESTS_CANCELLED_TOTAL.labels(reason="deadline").inc()
+            return _error_response(504, "request deadline already expired",
+                                   "timeout")
+
         # Multi-master ownership: `sid` set means this request was relayed
         # here by its accepting frontend — serve it locally under the
         # relay-supplied id (never re-relay). Otherwise resolve ownership
@@ -459,10 +571,43 @@ class XllmHttpService:
         if not handoff:
             sid, owner, owner_key = self._assign_ownership(kind, body)
             if owner != self.scheduler.self_addr:
+                # Relay-path deposit: the relay's re-ownership recovery
+                # spends from THIS process's retry bucket.
+                RETRY_BUDGET.note_request()
                 return await self._relay_to_owner(
                     http_req, raw, kind, sid, owner, owner_key,
-                    bool(body.get("stream", False)))
+                    bool(body.get("stream", False)),
+                    deadline_ms=deadline_ms, priority=priority)
 
+        # Admission control + priority shedding: the bounded gate in
+        # front of the schedule executor — a fast 429 beats a slow 200
+        # that blows everyone's SLO. Runs at the serving frontend (the
+        # owner, for relayed requests): the watermark protects THIS
+        # process's executor and the fleet behind it.
+        shed = self._admission_check(priority)
+        if shed is not None:
+            return shed
+        RETRY_BUDGET.note_request()
+        # Slot ownership: held HERE from try_admit until the request is
+        # registered (record_new_request — from then on the scheduler's
+        # winning-exit accounting releases it via `req.admitted`). The
+        # finally releases on EVERY other path — validation errors,
+        # schedule failure, a raising parser, handler-task cancellation
+        # — or a shed slot would leak forever.
+        slot = {"held": True}
+        try:
+            return await self._admitted_generate(
+                http_req, kind, sid, body, priority, deadline_ms,
+                handoff, slot)
+        finally:
+            if slot["held"]:
+                ADMISSION.release()
+
+    async def _admitted_generate(self, http_req: web.Request, kind: str,
+                                 sid: str, body: dict[str, Any],
+                                 priority: str, deadline_ms: int,
+                                 handoff: bool,
+                                 slot: dict) -> web.StreamResponse:
         try:
             req = Request(
                 service_request_id=sid,
@@ -473,12 +618,24 @@ class XllmHttpService:
                                    .get("include_usage", False)),
                 offline=bool(body.get("offline", False)),
                 priority=int(body.get("priority") or 0),
+                priority_class=priority,
+                deadline_ms=deadline_ms,
                 sampling=_parse_sampling(body),
             )
         except (TypeError, ValueError, AttributeError) as e:
             # Mistyped client fields (e.g. "max_tokens": null) are client
             # errors, not 500s.
             return _error_response(400, f"invalid request field: {e}")
+        # Brownout: clamp batch-priority generation length while the SLO
+        # burn is hot — bulk work finishes sooner and returns decode
+        # capacity to interactive traffic. The body is clamped too: the
+        # enriched engine payload is built from it.
+        clamped = BROWNOUT.clamp_max_tokens(priority,
+                                            req.sampling.max_tokens)
+        if clamped != req.sampling.max_tokens:
+            req.sampling.max_tokens = clamped
+            body["max_tokens"] = clamped
+            body.pop("max_completion_tokens", None)
         if kind == "chat":
             msgs = body.get("messages")
             if not isinstance(msgs, list) or not msgs:
@@ -521,6 +678,9 @@ class XllmHttpService:
         if not status.ok():
             if req.span:
                 req.span.end(f"ERROR: {status.code.name}")
+            # A failed schedule is never registered, so exit accounting
+            # will not release its admission slot — the caller's finally
+            # does.
             return _error_response(
                 503 if status.code.name == "UNAVAILABLE" else 400,
                 status.message, "service_unavailable"
@@ -543,12 +703,21 @@ class XllmHttpService:
         enriched["routing"] = {"prefill_name": req.routing.prefill_name,
                                "decode_name": req.routing.decode_name,
                                "encode_name": req.routing.encode_name}
+        if req.deadline_ms:
+            # Absolute deadline on the engine wire: the engine compares
+            # against its own clock, so queueing/transit time is
+            # naturally subtracted from the remaining budget.
+            enriched["deadline_ms"] = req.deadline_ms
         if req.trace is not None:
             enriched["trace_context"] = req.trace.to_dict()
         path = "/v1/chat/completions" if kind == "chat" else "/v1/completions"
         wire_body, wire_ctype = wire.encode_dispatch(
             enriched, self.scheduler.dispatch_wire(req.routing.prefill_name))
         HOTPATH.record("enrich", (time.perf_counter() - t1) * 1000)
+        # Admission-slot ownership transfers to the scheduler with the
+        # registration: its exactly-once exit accounting releases.
+        req.admitted = True
+        slot["held"] = False
         self.scheduler.record_new_request(req, conn, kind,
                                           forward_path=path,
                                           forward_payload=enriched)
@@ -617,6 +786,12 @@ class XllmHttpService:
                        conn: AioConnection,
                        emit_done: bool = True) -> web.StreamResponse:
         timeout = self.opts.request_timeout_s
+        if req.deadline_ms:
+            # The client-side wait honors the per-request deadline (plus
+            # a small grace for in-flight deltas) so a stalled stream
+            # surfaces its 504 at deadline, not at the blunt GC bound.
+            timeout = max(0.05, min(
+                timeout, remaining_ms(req.deadline_ms) / 1000.0 + 0.25))
         if req.stream:
             resp = web.StreamResponse()
             resp.headers["Content-Type"] = "text/event-stream"
@@ -665,7 +840,16 @@ class XllmHttpService:
                     if buf:
                         await resp.write(bytes(buf))
                         buf.clear()
-            except (asyncio.TimeoutError, ConnectionResetError, OSError):
+            except asyncio.TimeoutError:
+                if await self._deadline_cancel(req):
+                    # Surface the 504 in-band: frames may already be out.
+                    with contextlib.suppress(ConnectionResetError, OSError):
+                        await resp.write(
+                            b'data: {"error": {"message": "deadline '
+                            b'exceeded", "code": 504}}\n\n')
+                else:
+                    conn.mark_disconnected()
+            except (ConnectionResetError, OSError):
                 conn.mark_disconnected()
             except asyncio.CancelledError:
                 conn.mark_disconnected()
@@ -684,11 +868,25 @@ class XllmHttpService:
                     return _error_response(code, msg, "server_error")
                 return web.json_response(item)
         except asyncio.TimeoutError:
+            if await self._deadline_cancel(req):
+                return _error_response(504, "deadline exceeded", "timeout")
             conn.mark_disconnected()
             return _error_response(504, "request timed out", "timeout")
         except asyncio.CancelledError:
             conn.mark_disconnected()
             raise
+
+    async def _deadline_cancel(self, req: Request) -> bool:
+        """A response wait timed out: if the request's own deadline has
+        expired, cancel it for real (exit accounting + engine-side stop
+        — blocking RPCs, so off the event loop). False = not a deadline
+        case; the caller falls back to disconnect semantics."""
+        if not deadline_expired(req.deadline_ms):
+            return False
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.scheduler.cancel_request, req.service_request_id,
+            504, "deadline exceeded", "deadline")
+        return True
 
     # -------------------------------------------------------- other routes
     async def handle_models(self, request: web.Request) -> web.Response:
@@ -763,6 +961,11 @@ class XllmHttpService:
         FLEET_SIZE.labels(role="draining").set(len(mgr.draining_names()))
         AUTOSCALER_LAST_DECISION_AGE_SECONDS.set(
             self.scheduler.autoscaler.last_decision_age_s())
+        # Overload plane: gate depth, brownout state, retry-budget level.
+        ADMISSION_PENDING_REQUESTS.set(ADMISSION.pending())
+        BROWNOUT_ACTIVE.set(1.0 if BROWNOUT.active() else 0.0)
+        tokens = RETRY_BUDGET.tokens()
+        RETRY_BUDGET_TOKENS.set(tokens if tokens != float("inf") else -1.0)
         SLO_MONITOR.export_gauges()
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
@@ -971,6 +1174,31 @@ class XllmHttpService:
         but acted on."""
         return web.json_response(self.scheduler.autoscaler.report())
 
+    async def handle_overload(self, request: web.Request) -> web.Response:
+        """Overload-hardening plane state (docs/robustness.md): the
+        admission gate (watermarks, pending, shed counts/rate), the
+        brownout controller (state + transition log with reasons), the
+        global retry budget, and every instance channel's circuit
+        breaker — one page answering "what is being degraded, shed or
+        fenced off right now, and why"."""
+        snap = self.scheduler.instance_mgr.routing_snapshot()
+        breakers = {}
+        for name, ch in snap.channels.items():
+            br = getattr(ch, "breaker", None)
+            if br is not None:
+                breakers[name] = br.snapshot()
+        return web.json_response({
+            "deadline": {
+                "default_request_deadline_ms":
+                    self.opts.default_request_deadline_ms,
+                "request_timeout_s": self.opts.request_timeout_s,
+            },
+            "admission": ADMISSION.report(),
+            "brownout": BROWNOUT.report(),
+            "retry_budget": RETRY_BUDGET.report(),
+            "breakers": breakers,
+        })
+
     async def handle_hotpath(self, request: web.Request) -> web.Response:
         """Per-stage master hot-path latency table (always-on recorder,
         common/hotpath.py): schedule / enrich / forward / first_delta
@@ -1067,11 +1295,20 @@ class XllmHttpService:
         if not sid:
             return _error_response(400, "missing sid")
         HANDOFF_SERVED_TOTAL.inc()
+        # The relay forwards the ABSOLUTE deadline it computed at accept
+        # (x-xllm-deadline-ms) so the owner enforces the original
+        # budget, not a fresh one restarted at the relay hop.
+        try:
+            deadline_ms = int(request.headers.get(ABS_DEADLINE_HEADER, 0))
+        except (TypeError, ValueError):
+            deadline_ms = 0
         if kind == "messages":
-            return await self.handle_messages(request, sid=sid)
+            return await self.handle_messages(request, sid=sid,
+                                              deadline_override=deadline_ms)
         if kind not in ("chat", "completion"):
             return _error_response(400, f"unknown handoff kind {kind}")
-        return await self._handle_generate(request, kind, sid=sid)
+        return await self._handle_generate(request, kind, sid=sid,
+                                           deadline_override=deadline_ms)
 
     async def handle_flip_hint(self, request: web.Request) -> web.Response:
         """Replica→master write-lease proxy for PD-role flips: a

@@ -30,6 +30,7 @@ every owner incarnation, and the engines they dispatched to.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any, Optional
 
 import aiohttp
@@ -41,12 +42,22 @@ from ..common.metrics import (
     HANDOFF_RECOVERIES_TOTAL,
 )
 from ..common.tracing import TRACER
+from ..overload import RETRY_BUDGET
+from ..overload.deadline import ABS_DEADLINE_HEADER, PRIORITY_HEADER
 from ..utils import get_logger
 from .ownership import OwnershipRouter
 
 logger = get_logger(__name__)
 
 _DATA_PREFIX = b"data: "
+
+
+def _passthrough_headers(r) -> dict[str, str]:
+    """Owner-response headers the relay must not swallow: Retry-After
+    carries the admission gate's backoff hint on a shed 429 — without
+    it well-behaved clients retry immediately instead of backing off."""
+    ra = r.headers.get("Retry-After")
+    return {"Retry-After": ra} if ra else {}
 
 
 class HandoffRelay:
@@ -68,13 +79,24 @@ class HandoffRelay:
     async def relay(self, http_req: web.Request, client: aiohttp.ClientSession,
                     body: bytes, kind: str, sid: str, owner: str,
                     owner_key: str, stream: bool,
-                    timeout_s: float) -> web.StreamResponse:
+                    timeout_s: float, deadline_ms: int = 0,
+                    priority: str = "") -> web.StreamResponse:
         """Forward ``body`` to ``owner`` and copy the response back to the
         client of ``http_req``. Returns the prepared client response."""
         span = TRACER.start_span("frontend.request", request_id=sid,
                                  kind=kind, stream=stream, relay=True,
                                  owner=owner)
         headers = {"Content-Type": "application/json"}
+        if deadline_ms:
+            # The ABSOLUTE deadline computed at accept rides the hop —
+            # the owner must enforce the original budget, not restart it
+            # (overload/deadline.py). The relay's own total timeout is
+            # clamped to the remaining budget below.
+            headers[ABS_DEADLINE_HEADER] = str(deadline_ms)
+            timeout_s = max(0.05, min(
+                timeout_s, deadline_ms / 1000.0 - time.time() + 0.5))
+        if priority:
+            headers[PRIORITY_HEADER] = priority
         if span:
             headers.update(span.context().to_headers())
         HANDOFF_FORWARDED_TOTAL.labels(owner=owner).inc()
@@ -98,6 +120,12 @@ class HandoffRelay:
         last_err: Any = None
         for attempt in range(self.max_attempts):
             if attempt:
+                if not RETRY_BUDGET.try_spend():
+                    # Global retry budget (overload plane): a mass owner
+                    # outage must degrade into bounded recovery, not a
+                    # relay retry storm across every accepting frontend.
+                    last_err = f"{last_err} (retry budget exhausted)"
+                    break
                 owner = self._recover(owner, failed, owner_key, sid, span)
                 HANDOFF_RECOVERIES_TOTAL.labels(owner=owner).inc()
             url = self._url(owner, kind, sid) + f"&attempt={attempt}"
@@ -120,7 +148,8 @@ class HandoffRelay:
                     # failover budget) — only transport failures recover.
                     return web.Response(
                         body=payload, status=r.status,
-                        content_type=(r.content_type or "application/json"))
+                        content_type=(r.content_type or "application/json"),
+                        headers=_passthrough_headers(r))
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 last_err = e
                 failed.append(owner)
@@ -147,6 +176,9 @@ class HandoffRelay:
         last_err: Any = None
         for attempt in range(self.max_attempts):
             if attempt:
+                if not RETRY_BUDGET.try_spend():
+                    last_err = f"{last_err} (retry budget exhausted)"
+                    break
                 owner = self._recover(owner, failed, owner_key, sid, span)
                 HANDOFF_RECOVERIES_TOTAL.labels(owner=owner).inc()
             url = (self._url(owner, kind, sid)
@@ -172,7 +204,8 @@ class HandoffRelay:
                         return web.Response(
                             body=payload, status=r.status,
                             content_type=(r.content_type
-                                          or "application/json"))
+                                          or "application/json"),
+                            headers=_passthrough_headers(r))
                     # Client-facing writes are guarded INDIVIDUALLY: a
                     # dead client raises ClientConnectionResetError,
                     # which is an aiohttp.ClientError too — letting it
@@ -187,7 +220,14 @@ class HandoffRelay:
                             await resp.prepare(http_req)
                             prepared = True
                     except OSError:
-                        return resp    # CLIENT went away before prepare
+                        # CLIENT went away before prepare: abort the
+                        # owner connection NOW — a graceful release
+                        # would drain the stream, hiding the disconnect
+                        # from the owner (whose next write is what
+                        # triggers its mark_disconnected →
+                        # _cancel_on_engines chain).
+                        r.close()
+                        return resp
                     async for frame in self._frames(r.content):
                         if frame.startswith(_DATA_PREFIX) and skip > 0:
                             # Replay dedup: this frame was already
@@ -197,7 +237,16 @@ class HandoffRelay:
                         try:
                             await resp.write(frame)
                         except OSError:
-                            return resp    # CLIENT went away mid-copy
+                            # CLIENT went away mid-copy: abort the owner
+                            # connection so the disconnect PROPAGATES —
+                            # the owner's next SSE write fails, it marks
+                            # the connection dead, and the engines get
+                            # cancelled. Without this the relay could
+                            # keep draining the owner stream to
+                            # completion, burning engine tokens for a
+                            # client that is gone.
+                            r.close()
+                            return resp
                         if frame.startswith(_DATA_PREFIX):
                             delivered += 1
                     try:

@@ -35,6 +35,7 @@ from ..devtools import ownership as _ownership
 from ..common.types import InstanceMetaInfo
 from ..utils import get_logger, jittered_backoff
 from . import wire
+from .breaker import CircuitBreaker
 
 logger = get_logger(__name__)
 
@@ -42,6 +43,22 @@ DEFAULT_TIMEOUT_S = 5.0
 DEFAULT_RETRIES = 3
 DEFAULT_BACKOFF_BASE_S = 0.05
 DEFAULT_BACKOFF_MAX_S = 1.0
+
+
+def _breaker_ok(status_code: int) -> bool:
+    """Is an HTTP answer health evidence for the circuit breaker?
+
+    Any 2xx/3xx/4xx answer is (the instance's serving loop is alive and
+    deciding) — and so are the DELIBERATE overload/lifecycle rejections
+    the overload plane itself produces: 429 (shed), 503 (draining /
+    accept-queue full), 504 (deadline refused). Counting those as
+    sickness would eject a healthy-but-busy instance from routing during
+    the exact burst the plane exists to absorb: deadline-expired
+    dispatches land 504s, the breaker opens, capacity shrinks, queues
+    deepen, MORE deadlines expire — a positive-feedback ejection
+    cascade. Only unexplained server errors (500/502/...) join
+    transport failures as breaker evidence."""
+    return status_code < 500 or status_code in (503, 504)
 
 
 class _KeepaliveAdapter(HTTPAdapter):
@@ -77,7 +94,8 @@ class EngineChannel:
                  timeout_s: float = DEFAULT_TIMEOUT_S,
                  retries: int = DEFAULT_RETRIES,
                  backoff_base_s: float = DEFAULT_BACKOFF_BASE_S,
-                 backoff_max_s: float = DEFAULT_BACKOFF_MAX_S):
+                 backoff_max_s: float = DEFAULT_BACKOFF_MAX_S,
+                 breaker: Optional[CircuitBreaker] = None):
         # `name` is the engine's HTTP address (reference: InstanceMetaInfo.name
         # doubles as the HTTP endpoint, `xllm_rpc_service.proto:31-46`).
         self.name = name
@@ -91,17 +109,31 @@ class EngineChannel:
         # this from the instance's advertised wire_formats at
         # registration; 415 responses demote it back to JSON).
         self.wire_format = wire.WIRE_JSON
+        # Per-instance circuit breaker (rpc/breaker.py): OPEN fails every
+        # call fast; the reconcile thread mirrors the state into routing
+        # (BREAKER_OPEN) and drives the half-open recovery probe.
+        self.breaker = breaker if breaker is not None \
+            else CircuitBreaker(name)
         self._session = requests.Session()
         self._session.mount("http://", _KeepaliveAdapter())
 
     @classmethod
     def from_options(cls, name: str, options: Any) -> "EngineChannel":
-        """Build with the `rpc_*` knobs from a ServiceOptions."""
+        """Build with the `rpc_*` / `circuit_breaker_*` knobs from a
+        ServiceOptions."""
         return cls(name,
                    timeout_s=options.rpc_timeout_s,
                    retries=options.rpc_retries,
                    backoff_base_s=options.rpc_backoff_base_s,
-                   backoff_max_s=options.rpc_backoff_max_s)
+                   backoff_max_s=options.rpc_backoff_max_s,
+                   breaker=CircuitBreaker(
+                       name,
+                       window_s=options.circuit_breaker_window_s,
+                       min_samples=options.circuit_breaker_min_samples,
+                       failure_ratio=options.circuit_breaker_failure_ratio,
+                       open_cooldown_s=(
+                           options.circuit_breaker_open_cooldown_s),
+                       enabled=options.circuit_breaker_enabled))
 
     def _sleep_backoff(self, prior_attempts: int) -> None:
         time.sleep(jittered_backoff(self.backoff_base_s,
@@ -113,6 +145,8 @@ class EngineChannel:
               fmt: str = wire.WIRE_JSON) -> tuple[bool, Any]:
         attempts = self.retries if retries is None else max(1, retries)
         err: Any = None
+        if not self.breaker.allow():
+            return False, "circuit breaker open"
         data, ctype = wire.encode_dispatch(payload, fmt)
         # Trace propagation: the calling thread's active span rides the
         # wire as headers ({} almost always — one thread-local read).
@@ -129,13 +163,17 @@ class EngineChannel:
                                        timeout=timeout_s or self.timeout_s)
                 if r.status_code == 200:
                     try:
+                        self.breaker.record(True)
                         return True, r.json()
                     except ValueError:  # incl. requests' JSONDecodeError,
                         return True, r.text   # else it'd retry as failure
+                self.breaker.record(_breaker_ok(r.status_code))
                 err = f"HTTP {r.status_code}: {r.text[:200]}"
             except FaultInjected as e:
+                self.breaker.record(False)
                 err = str(e)
             except requests.RequestException as e:
+                self.breaker.record(False)
                 err = str(e)
         return False, err
 
@@ -143,6 +181,8 @@ class EngineChannel:
              retries: Optional[int] = None) -> tuple[bool, Any]:
         attempts = self.retries if retries is None else max(1, retries)
         err: Any = None
+        if not self.breaker.allow():
+            return False, "circuit breaker open"
         headers = tracing.current_headers() or None
         for attempt in range(attempts):
             if attempt:
@@ -154,13 +194,17 @@ class EngineChannel:
                                       timeout=timeout_s or self.timeout_s)
                 if r.status_code == 200:
                     try:
+                        self.breaker.record(True)
                         return True, r.json()
                     except ValueError:  # same contract as _post: a non-JSON
                         return True, r.text   # 200 body is a success payload
+                self.breaker.record(_breaker_ok(r.status_code))
                 err = f"HTTP {r.status_code}"
             except FaultInjected as e:
+                self.breaker.record(False)
                 err = str(e)
             except requests.RequestException as e:
+                self.breaker.record(False)
                 err = str(e)
         return False, err
 
@@ -249,13 +293,17 @@ class EngineChannel:
         """Single-shot POST preserving the engine's status code + body (for
         proxied endpoints where 4xx/5xx must pass through to the client
         instead of collapsing into a retry/False)."""
+        if not self.breaker.allow():
+            return 503, {"error": "circuit breaker open"}
         try:
             FAULTS.check("rpc.post", instance=self.name, path=path)
             r = self._session.post(self.base_url + path, json=payload,
                                    headers=tracing.current_headers() or None,
                                    timeout=self.timeout_s)
         except (requests.RequestException, FaultInjected) as e:
+            self.breaker.record(False)
             return 502, {"error": str(e)}
+        self.breaker.record(_breaker_ok(r.status_code))
         try:
             return r.status_code, r.json()
         except ValueError:   # covers requests' own JSONDecodeError too

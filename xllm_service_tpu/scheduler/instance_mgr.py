@@ -47,6 +47,7 @@ from typing import Callable, Optional
 
 from ..common.config import ServiceOptions
 from ..common.metrics import (
+    CIRCUIT_BREAKER_OPEN,
     INSTANCE_EVICTIONS_TOTAL,
     INSTANCE_INFLIGHT_REQUESTS,
     INSTANCE_QUEUE_DEPTH,
@@ -117,8 +118,12 @@ class _Entry:
         # traffic) are excluded while still alive — either
         # master-initiated (entry state, the autoscaler's scale-in path)
         # or self-advertised (meta flag, an agent-side drain).
+        # BREAKER_OPEN (sick-but-leased: the channel's circuit breaker
+        # tripped) is excluded like SUSPECT until the half-open probe
+        # recovers it.
         return self.state not in (InstanceRuntimeState.SUSPECT,
-                                  InstanceRuntimeState.DRAINING) \
+                                  InstanceRuntimeState.DRAINING,
+                                  InstanceRuntimeState.BREAKER_OPEN) \
             and not self.meta.draining
 
 
@@ -448,11 +453,14 @@ class InstanceMgr:
                         cur.predictor.fit_ttft(meta.ttft_profiling_data)
                     if meta.tpot_profiling_data:
                         cur.predictor.fit_tpot(meta.tpot_profiling_data)
-                if cur.state != InstanceRuntimeState.DRAINING:
+                if cur.state not in (InstanceRuntimeState.DRAINING,
+                                     InstanceRuntimeState.BREAKER_OPEN):
                     # A draining instance keeps re-registering while its
                     # in-flight work finishes (lease keepalive) — the
                     # refresh must not resurrect it into the schedulable
-                    # set mid-drain.
+                    # set mid-drain. Likewise a breaker-open instance:
+                    # its lease renewing IS the sick-but-leased failure
+                    # mode; only the half-open probe restores it.
                     self._set_state(cur, InstanceRuntimeState.ACTIVE)
                 # Meta replacement can change schedulability (draining
                 # flag) or the wire format even when the state didn't
@@ -656,6 +664,7 @@ class InstanceMgr:
         TTFT_MS.remove(instance=name, policy=policy)
         ITL_MS.remove(instance=name, policy=policy)
         RPC_RETRIES_TOTAL.remove(instance=name)
+        CIRCUIT_BREAKER_OPEN.remove(instance=name)
         if reason not in ("replaced", "drained"):
             # Planned churn — a rolling-restart re-registration or a
             # completed graceful drain (autoscaler scale-in) — is not an
@@ -718,24 +727,78 @@ class InstanceMgr:
         `instance_mgr.cpp:719-781`): LEASE_LOST with heartbeat silence →
         SUSPECT; SUSPECT older than eviction window → deregister;
         DRAINING instances deregister gracefully once idle (or at the
-        drain deadline, stragglers riding the normal failover path)."""
+        drain deadline, stragglers riding the normal failover path);
+        circuit-breaker state mirrored into routing (BREAKER_OPEN) with
+        the half-open recovery probe driven from here."""
         now = now_ms()
         to_evict: list[str] = []
         to_drain_check: list[tuple[str, int]] = []
+        to_probe: list[tuple[str, EngineChannel]] = []
         with self._cluster_lock:
             for name, entry in self._instances.items():
-                if entry.state == InstanceRuntimeState.LEASE_LOST:
+                if entry.state in (InstanceRuntimeState.LEASE_LOST,
+                                   InstanceRuntimeState.BREAKER_OPEN):
+                    # Heartbeat-silence promotion applies to BREAKER_OPEN
+                    # too: a breaker-open instance that also goes SILENT
+                    # is dead, not busy — without this it would sit
+                    # outside the SUSPECT/evict path forever (no eviction
+                    # timer by design, no further lease-delete event, and
+                    # every half-open probe just re-opens the breaker),
+                    # stranding its bound requests away from failover.
                     silence = now - entry.last_heartbeat_ms
                     if silence > self._opts.heartbeat_silence_to_suspect_s * 1000:
+                        was = entry.state.value
                         self._set_state(entry, InstanceRuntimeState.SUSPECT)
-                        logger.info("instance %s: LEASE_LOST -> SUSPECT "
-                                    "(heartbeat silence %dms)", name, silence)
+                        logger.info("instance %s: %s -> SUSPECT "
+                                    "(heartbeat silence %dms)", name, was,
+                                    silence)
                 if entry.state == InstanceRuntimeState.SUSPECT:
                     age = now - entry.state_since_ms
                     if age > self._opts.detect_disconnected_instance_interval_s * 1000:
                         to_evict.append(name)
                 elif entry.state == InstanceRuntimeState.DRAINING:
                     to_drain_check.append((name, now - entry.state_since_ms))
+                elif entry.state in (InstanceRuntimeState.ACTIVE,
+                                     InstanceRuntimeState.LEASE_LOST) \
+                        and entry.channel is not None \
+                        and getattr(entry.channel, "breaker", None) is not None \
+                        and entry.channel.breaker.state() == "open":
+                    # Sick-but-leased: the channel's rolling window
+                    # tripped. Exclude from routing like SUSPECT — but
+                    # no eviction timer; recovery is probe-driven.
+                    self._set_state(entry,
+                                    InstanceRuntimeState.BREAKER_OPEN)
+                    CIRCUIT_BREAKER_OPEN.labels(instance=name).set(1)
+                    logger.warning("instance %s: circuit breaker OPEN; "
+                                   "excluded from routing", name)
+                elif entry.state == InstanceRuntimeState.BREAKER_OPEN \
+                        and entry.channel is not None:
+                    to_probe.append((name, entry.channel))
+        for name, channel in to_probe:
+            breaker = getattr(channel, "breaker", None)
+            if breaker is None:
+                continue   # test double without the breaker API
+            # Half-open probe OUTSIDE the lock: the breaker itself gates
+            # (fast no-op while the open cooldown holds, one probe at a
+            # time after it). A successful probe closes the breaker; the
+            # instance returns to routing on the same pass.
+            channel.health(timeout_s=self._opts.health_probe_timeout_s)
+            if breaker.state() == "closed":
+                restored = False
+                with self._cluster_lock:
+                    entry = self._instances.get(name)
+                    if entry is not None and \
+                            entry.state == InstanceRuntimeState.BREAKER_OPEN:
+                        self._set_state(entry, InstanceRuntimeState.ACTIVE)
+                        restored = True
+                if restored:
+                    # Gauge write gated on the entry still existing: a
+                    # concurrent deregister already evicted the series —
+                    # an unconditional set(0) would resurrect it.
+                    CIRCUIT_BREAKER_OPEN.labels(instance=name).set(0)
+                    logger.info("instance %s: circuit breaker closed "
+                                "(half-open probe ok); restored to "
+                                "routing", name)
         for name in to_evict:
             self.deregister_instance(name, reason="suspect eviction")
         for name, age_ms in to_drain_check:

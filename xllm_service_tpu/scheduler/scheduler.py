@@ -43,7 +43,7 @@ from ..common.metrics import (
     FAILOVER_ATTEMPTS_TOTAL,
     FAILOVER_SUCCESS_TOTAL,
     ITL_MS,
-    REQUESTS_CANCELLED_ON_FAILURE_TOTAL,
+    REQUESTS_CANCELLED_TOTAL,
     TTFT_MS,
 )
 from ..common.flightrecorder import RECORDER
@@ -70,6 +70,8 @@ from ..coordination import CoordinationClient, connect
 from ..coordination.base import KeyEvent, WatchEventType
 from ..devtools import ownership as _ownership
 from ..devtools.locks import make_lock
+from ..overload import ADMISSION, BROWNOUT, RETRY_BUDGET
+from ..overload.deadline import deadline_expired
 from ..rpc import MASTER_KEY, SERVICE_KEY_PREFIX
 from ..scheduler.global_kvcache_mgr import GlobalKVCacheMgr
 from ..scheduler.instance_mgr import InstanceMgr
@@ -302,22 +304,76 @@ class Scheduler:
             self.autoscaler.reap_departed()
         except Exception:  # noqa: BLE001 — scaling must not kill sync
             logger.exception("autoscaler tick failed")
+        # Brownout evaluation (overload plane): every frontend degrades
+        # its OWN traffic off its own burn monitor — no election gate.
+        try:
+            BROWNOUT.tick()
+        except Exception:  # noqa: BLE001 — degradation must not kill sync
+            logger.exception("brownout tick failed")
         self._gc_stale_requests()
 
     def _gc_stale_requests(self) -> None:
-        deadline = now_ms() - int(self._opts.request_timeout_s * 1000)
+        """Deadline sweep: per-request deadlines (overload plane) are the
+        primary bound; the blunt `request_timeout_s` silence GC remains
+        the backstop for requests without one."""
+        horizon = now_ms() - int(self._opts.request_timeout_s * 1000)
+        now = now_ms()
         with self._req_lock:
             stale = [st for st in self._requests.values()
-                     if st.request.latest_generate_time_ms < deadline]
+                     if st.request.latest_generate_time_ms < horizon
+                     or deadline_expired(st.request.deadline_ms, now)]
         for st in stale:
-            if not self._remove_request(st, error=(504, "request timed out")):
+            expired = deadline_expired(st.request.deadline_ms, now)
+            msg = "deadline exceeded" if expired else "request timed out"
+            if not self._cancel_request_state(st, 504, msg,
+                                              reason="deadline"):
                 continue   # a concurrent path finished it first
-            logger.warning("request %s timed out; cancelling",
-                           st.request.service_request_id)
-            self._cancel_on_engines(st.request)
-            self._output_executor.submit_to_lane(
-                st.lane, lambda s=st: s.conn.finish_with_error(
-                    504, "request timed out"))
+            logger.warning("request %s %s; cancelling",
+                           st.request.service_request_id, msg)
+
+    # -------------------------------------------------------- cancellation
+    def _cancel_request_state(self, st: _RequestState, code: int,
+                              message: str, reason: str) -> bool:
+        """Service-side cancellation of one in-flight request: winning-
+        exit accounting, engine-side stop (the existing
+        `_cancel_on_engines` path — engines ack and stop decoding), the
+        client error, and the `requests_cancelled_total{reason}` count.
+        Deadline cancellations also capture a flight-recorder bundle
+        (an expired request IS an anomaly worth a post-mortem)."""
+        if not self._remove_request(st, error=(code, message)):
+            return False
+        REQUESTS_CANCELLED_TOTAL.labels(reason=reason).inc()
+        if reason == "deadline":
+            r = st.request
+            trace_id = r.span.trace_id if r.span else \
+                (r.trace.trace_id if r.trace else "")
+            TRACER.keep_trace(trace_id)
+            RECORDER.record(
+                "deadline", request_id=r.service_request_id,
+                trace_id=trace_id,
+                detail={"message": message,
+                        "deadline_ms": r.deadline_ms,
+                        "overdue_ms": now_ms() - r.deadline_ms
+                        if r.deadline_ms else None,
+                        "generated_tokens": r.num_generated_tokens,
+                        "prefill": r.routing.prefill_name,
+                        "decode": r.routing.decode_name})
+        self._cancel_on_engines(st.request)
+        self._output_executor.submit_to_lane(
+            st.lane, lambda: st.conn.finish_with_error(code, message))
+        return True
+
+    def cancel_request(self, service_request_id: str, code: int = 504,
+                       message: str = "deadline exceeded",
+                       reason: str = "deadline") -> bool:
+        """Public cancellation entry (deadline enforcement from the HTTP
+        layer's response wait, operator tooling). Blocking — issues
+        engine RPCs; call off the event loop."""
+        with self._req_lock:
+            st = self._requests.get(service_request_id)
+        if st is None:
+            return False
+        return self._cancel_request_state(st, code, message, reason)
 
     # ------------------------------------------------------------- schedule
     def schedule(self, request: Request) -> Status:
@@ -488,6 +544,7 @@ class Scheduler:
         instance failure) that pop the request and reverse its accounting.
         """
         disconnected = False
+        expired = False
         with self._req_lock:
             st = self._requests.get(output.service_request_id)
             if st is None or st.finished:
@@ -504,6 +561,11 @@ class Scheduler:
                 # Between instances (failure detected, re-dispatch
                 # pending): the old stream is void; tell it to stop.
                 return False
+            # Mid-stream deadline expiry (overload plane): stop the
+            # engine NOW — the False return below is the stop signal the
+            # engine acts on, independent of the cancel RPC.
+            if deadline_expired(req.deadline_ms) and not output.finished:
+                expired = True
             req.touch()
             if output.delta_seq is not None:
                 if output.delta_seq <= st.last_delta_seq:
@@ -514,7 +576,9 @@ class Scheduler:
                 st.last_delta_seq = output.delta_seq
             # Client-disconnect cancellation (reference
             # `scheduler.cpp:507-521`).
-            if st.conn.is_disconnected():
+            if expired:
+                pass    # cancel path runs below, outside the lock
+            elif st.conn.is_disconnected():
                 self._remove_request(st)
                 disconnected = True
             else:
@@ -527,9 +591,16 @@ class Scheduler:
                             st.replay_token_ids.extend(seq.token_ids)
                 if output.finished:
                     st.finished = True
+        if expired:
+            if self._cancel_request_state(st, 504, "deadline exceeded",
+                                          reason="deadline"):
+                logger.info("request %s deadline expired mid-stream; "
+                            "cancelling", req.service_request_id)
+            return False
         if disconnected:
             logger.info("client of %s disconnected; cancelling",
                         req.service_request_id)
+            REQUESTS_CANCELLED_TOTAL.labels(reason="disconnect").inc()
             self._cancel_on_engines(req)
             return False
         self._output_executor.submit_to_lane(
@@ -621,7 +692,8 @@ class Scheduler:
         elif not ok:
             # Downstream write failed: client gone.
             st.finished = True
-            self._remove_request(st, output)
+            if self._remove_request(st, output):
+                REQUESTS_CANCELLED_TOTAL.labels(reason="disconnect").inc()
             self._cancel_on_engines(req)
 
     def _accumulate(self, st: _RequestState, output: RequestOutput) -> None:
@@ -668,6 +740,11 @@ class Scheduler:
                 st.request.span.set(error=error[1], error_code=error[0])
                 st.request.span.status = f"ERROR: {error[0]}"
             self._account_request_exit(st.request)
+            if st.request.admitted:
+                # Release the admission-gate slot exactly once (this IS
+                # the winning exit; leaf lock nests under _req_lock).
+                st.request.admitted = False
+                ADMISSION.release()
         self._trace_spans(st)
         self._finish_request_observability(st, error)
         return True
@@ -883,6 +960,21 @@ class Scheduler:
                     break
                 st.failover_attempts += 1
                 attempt = st.failover_attempts
+            if deadline_expired(req.deadline_ms):
+                # A replay that cannot finish inside the request's
+                # deadline is pure amplification — cancel instead.
+                self._cancel_request_state(
+                    st, 504, "deadline exceeded during failover",
+                    reason="deadline")
+                return
+            if not RETRY_BUDGET.try_spend():
+                # Global retry budget (overload plane): during a partial
+                # outage the per-request budget still multiplies across
+                # thousands of victims — the shared bucket caps the
+                # fleet-wide replay volume. Surface instead of retrying.
+                self._surface_failure(
+                    st, "instance failed; global retry budget exhausted")
+                return
             FAILOVER_ATTEMPTS_TOTAL.labels(
                 instance=dead_name or "dispatch-failure").inc()
             if st.conn.is_disconnected():
@@ -1025,7 +1117,7 @@ class Scheduler:
         `scheduler.cpp:443-482`): exit accounting + client error."""
         if not self._remove_request(st, error=(code, message)):
             return
-        REQUESTS_CANCELLED_ON_FAILURE_TOTAL.inc()
+        REQUESTS_CANCELLED_TOTAL.labels(reason="failover").inc()
         self._cancel_on_engines(st.request)
         self._output_executor.submit_to_lane(
             st.lane, lambda: st.conn.finish_with_error(code, message))

@@ -32,6 +32,7 @@ the deterministic plane (`common/faults.py`):
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 import uuid
@@ -47,8 +48,9 @@ from ..common.hashing import prefix_block_hash_hexes
 from ..common import tracing as _tracing
 from ..common.tracing import TRACER, TraceContext
 from ..common.types import (InstanceMetaInfo, InstanceType, KvCacheEvent,
-                            TpuTopology)
+                            TpuTopology, now_ms)
 from ..devtools.locks import make_lock
+from ..overload.deadline import deadline_expired
 from ..coordination.base import CoordinationClient
 from ..rpc import instance_key
 from ..rpc import wire
@@ -73,11 +75,22 @@ class FakeEngineConfig:
     # actuator passes an explicit port so the instance NAME (host:port)
     # is known to the launcher before the process registers.
     port: int = 0
-    # Capacity model for closed-loop scaling drills/benches: a blocking
-    # sleep INSIDE the accept handler serializes accepts on the event
-    # loop, capping this engine at ~1/accept_delay_s requests per
-    # second — so adding instances genuinely adds fleet throughput.
-    accept_delay_s: float = 0.0
+    # Deterministic capacity model for overload/scaling drills and
+    # benches (replaces the old blocking-accept hack): accepts land in a
+    # bounded queue and a dispatcher thread starts one generation per
+    # 1/service_rate_rps seconds — the engine serves EXACTLY that rate
+    # under backlog, so adding instances genuinely adds fleet
+    # throughput and overload drills are reproducible. 0 = unlimited
+    # (generations start immediately, the hermetic-test default).
+    service_rate_rps: float = 0.0
+    # Accept-queue bound (only with a service rate); a full queue 503s
+    # the accept — the dispatch-failure path upstream. 0 = unbounded.
+    accept_queue_limit: int = 0
+    # Simulated prefill latency: sleep before the FIRST delta of each
+    # generation (delay_s paces the deltas after it). Gives overload
+    # benches a realistic TTFT floor so queueing delay can be measured
+    # as a ratio against it.
+    first_delta_delay_s: float = 0.0
 
 
 class FakeEngine:
@@ -107,6 +120,21 @@ class FakeEngine:
         self.healthy = True
         self._alive = True
         self._paused = False
+        # Accept/stop log for overload drills: (reason, sid) rows —
+        # reason in {"deadline", "cancel", "stopped", "overload"} — so
+        # tests can assert WHY token production stopped (e.g. a
+        # mid-decode deadline expiry stops the engine within one pump
+        # interval) without scraping logs.
+        self.stop_log: list[tuple[str, str]] = []
+        self.rejected_overload = 0
+        # Deterministic capacity model (service_rate_rps > 0): accepts
+        # queue here; the dispatcher thread starts one generation per
+        # 1/rate seconds.
+        self._svc_queue: Optional[queue.Queue] = None
+        self._svc_thread: Optional[threading.Thread] = None
+        if self.cfg.service_rate_rps > 0:
+            self._svc_queue = queue.Queue(
+                maxsize=max(0, self.cfg.accept_queue_limit))
         # Graceful drain (wire-contract mirror of EngineAgent.drain):
         # draining engines advertise the flag, reject new accepts, and
         # self-stop once the active generation count hits zero.
@@ -146,6 +174,11 @@ class FakeEngine:
                                            daemon=True,
                                            name=f"fake-hb-{self.port}")
         self._hb_thread.start()
+        if self._svc_queue is not None:
+            self._svc_thread = threading.Thread(
+                target=self._service_loop, daemon=True,
+                name=f"fake-svc-{self.port}")
+            self._svc_thread.start()
         return self
 
     def meta(self) -> InstanceMetaInfo:
@@ -270,7 +303,12 @@ class FakeEngine:
                 "name": self.name,
                 "incarnation_id": self.incarnation_id,
                 "load_metrics": {
-                    "waiting_requests_num": 0,
+                    # Capacity-model backlog (0 without a service rate):
+                    # the planner's pressure heuristic and scale-in
+                    # victim picks read the waiting depth.
+                    "waiting_requests_num":
+                        self._svc_queue.qsize()
+                        if self._svc_queue is not None else 0,
                     # Live streams, not the accept log: drain-completion
                     # checks and scale-in victim picks read this.
                     "running_requests_num": self._active_gens,
@@ -391,12 +429,6 @@ class FakeEngine:
             # the drain (routed from a pre-drain snapshot) fails over to
             # a surviving instance via the 503 dispatch-failure path.
             return web.json_response({"error": "draining"}, status=503)
-        if self.cfg.accept_delay_s:
-            # Deliberate capacity model: blocking the event loop
-            # serializes accepts, capping this engine's throughput (the
-            # closed-loop autoscaling bench scales fleet capacity by
-            # adding engines).
-            time.sleep(self.cfg.accept_delay_s)  # xlint: allow-async-blocking(test double: the blocking sleep IS the capacity model — serialized accepts cap per-engine throughput for scaling drills)
         self.accepted_wire.append((req.content_type or "", raw))
         self.accepted_trace_headers.append(
             {k.lower(): v for k, v in req.headers.items()
@@ -427,10 +459,53 @@ class FakeEngine:
             with self._kv_lock:
                 self._pending_kv_stored.extend(
                     prefix_block_hash_hexes(token_ids, self.cfg.block_size))
+        # Already past its deadline on arrival (queued upstream too
+        # long): ack with 504 instead of burning service slots — the
+        # master's dispatch-failure path surfaces it as non-retryable.
+        if deadline_expired(int(body.get("deadline_ms") or 0)):
+            self.stop_log.append(("deadline", sid))
+            return web.json_response({"error": "deadline exceeded"},
+                                     status=504)
+        if self._svc_queue is not None:
+            # Deterministic capacity model: enqueue for the dispatcher
+            # (one generation starts per 1/service_rate_rps s); a full
+            # queue is the engine saying "overloaded" — a fast 503 the
+            # upstream admission/failover layers handle.
+            try:
+                self._svc_queue.put_nowait((sid, source, body))
+            except queue.Full:
+                self.rejected_overload += 1
+                self.stop_log.append(("overload", sid))
+                return web.json_response(
+                    {"error": "engine accept queue full"}, status=503)
+            return web.json_response({"ok": True, "queued": True})
         # Fire-and-forget: accept now, stream Generations from a thread.
         threading.Thread(target=self._generate, daemon=True,
                          args=(sid, source, body)).start()
         return web.json_response({"ok": True})
+
+    def _service_loop(self) -> None:
+        """Dispatcher for the capacity model: starts at most one
+        accepted generation per 1/service_rate_rps seconds (token-bucket
+        pacing — an idle engine dispatches immediately with NO added
+        latency; under backlog dispatches are spaced exactly one
+        interval apart, so the engine serves EXACTLY its configured
+        rate, fleet capacity is additive, and overload drills
+        reproduce)."""
+        interval = 1.0 / self.cfg.service_rate_rps
+        next_at = 0.0
+        while self._alive:
+            try:
+                sid, source, body = self._svc_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            now = time.monotonic()
+            if next_at > now:
+                time.sleep(next_at - now)
+                now = time.monotonic()
+            threading.Thread(target=self._generate, daemon=True,
+                             args=(sid, source, body)).start()
+            next_at = max(next_at, now) + interval
 
     # ----------------------------------------------------------- generation
     def _generate(self, sid: str, source: str, body: dict[str, Any]) -> None:
@@ -511,11 +586,26 @@ class FakeEngine:
                 logger.warning("fake engine: generations push failed: %s", e)
                 return None
 
+        deadline_ms = int(body.get("deadline_ms") or 0)
+        if self.cfg.first_delta_delay_s:
+            time.sleep(self.cfg.first_delta_delay_s)   # simulated prefill
         with TRACER.span("engine.decode", **span_kw) as dsp:
             for i in range(start, n):
                 chunk = chunks[i]
                 if sid in self.cancelled or not self._alive:
+                    self.stop_log.append(("cancel", sid))
                     dsp.end("CANCELLED")
+                    return
+                if deadline_ms and now_ms() > deadline_ms:
+                    # Deadline enforcement at the engine (overload
+                    # plane): stop producing tokens within ONE pump
+                    # interval of expiry — the service side 504s the
+                    # client and cancels; this side just stops burning
+                    # decode capacity. Tokens already pending are
+                    # flushed (they were produced inside the budget).
+                    flush()
+                    self.stop_log.append(("deadline", sid))
+                    dsp.end("DEADLINE")
                     return
                 rule = FAULTS.fire("engine.token", instance=self.name,
                                    sid=sid, n=i)
@@ -561,6 +651,7 @@ class FakeEngine:
                         or len(pending) >= self._PUSH_BATCH:
                     alive = flush()
                     if alive is False:
+                        self.stop_log.append(("stopped", sid))
                         dsp.end("STOPPED")
                         return  # service told us to stop
                     if alive is None:
