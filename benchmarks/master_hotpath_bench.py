@@ -28,6 +28,23 @@ box aggregate rps saturates on total CPU, so the scaling evidence is
 each of N masters doing ~1/N of the frontend work at a constant
 master-CPU-ms-per-request).
 
+ISSUE 15 additions:
+
+- ``--heartbeat-storm M`` registers M simulated (non-schedulable)
+  instances and heartbeats them from driver threads at ``--storm-hz``
+  each for the whole drive window — the telemetry-ingest load the
+  sharded plane exists to spread. Per-master ingest/route/stream CPU
+  attribution (the service's thread_time buckets, /admin/hotpath "cpu")
+  is sampled around the drive, so the report shows each master's ingest
+  CPU share directly.
+- ``--telemetry-mode shard|master`` flips the service plane between
+  sharded rendezvous-owned ingest (engines in "mux": ONE multiplexed
+  keepalive session each) and the legacy elected-master funnel — the
+  baseline the ≥2× ingest-share cut is measured against.
+- ``--traffic diurnal|burst`` drives a time-varying open-loop schedule
+  (sinusoidal day-curve / square-wave bursts on top of ``--rps``) for
+  the CAR-vs-SLO-vs-RR heterogeneous-mix comparison.
+
 The tier-1 budget test (tests/test_master_hotpath_budget.py) runs
 ``run_bench`` with a small workload and a generous ceiling to catch
 order-of-magnitude regressions without flaking on CI noise.
@@ -79,6 +96,204 @@ def _proc_cpu_s(pid: int) -> float:
         return 0.0
 
 
+def _due_offsets(n: int, args) -> "list[float]":
+    """Open-loop due times (seconds from pace start) for request k=0..n-1
+    under the selected traffic shape. steady = constant --rps;
+    diurnal = sinusoidal rate swing (amplitude --diurnal-amp around the
+    base, period --diurnal-period); burst = --burst-mult x the base rate
+    for --burst-len out of every --burst-every seconds. Time-varying
+    schedules integrate 1/rate(t) stepwise so the OFFERED rate follows
+    the profile exactly."""
+    base = getattr(args, "rps", 0.0) or 0.0
+    mode = getattr(args, "traffic", "steady")
+    if base <= 0 or mode == "steady":
+        return [k / base if base > 0 else 0.0 for k in range(n)]
+    import math
+
+    offsets: list[float] = []
+    t = 0.0
+    for _ in range(n):
+        offsets.append(t)
+        if mode == "diurnal":
+            amp = min(0.95, max(0.0, getattr(args, "diurnal_amp", 0.6)))
+            period = max(1.0, getattr(args, "diurnal_period", 20.0))
+            rate = base * (1.0 + amp * math.sin(2 * math.pi * t / period))
+        else:   # burst
+            every = max(1.0, getattr(args, "burst_every", 10.0))
+            blen = min(every, max(0.1, getattr(args, "burst_len", 2.0)))
+            mult = max(1.0, getattr(args, "burst_mult", 4.0))
+            # Off-window rate compensates so the MEAN offered rate stays
+            # at the base (bursts test absorption, not extra volume).
+            off_rate = base * max(0.1, (every - blen * mult)
+                                  / max(0.1, every - blen))
+            rate = base * mult if (t % every) < blen else off_rate
+        t += 1.0 / max(0.1, rate)
+    return offsets
+
+
+class HeartbeatStorm:
+    """Driver-side heartbeat storm: M simulated instances (DEFAULT role,
+    draining=True so they never enter routing) registered in
+    coordination with kept-alive leases, heartbeating at ``hz`` each.
+    Destination: the rendezvous telemetry owner (shard mode — resolved
+    from the mirrored SERVICE membership, like a real engine) or the
+    elected master (the legacy-funnel baseline)."""
+
+    def __init__(self, coord, n: int, hz: float, mode: str,
+                 workers: int = 8):
+        self.coord = coord
+        self.n = n
+        self.hz = max(0.1, hz)
+        self.mode = mode
+        self.names = [f"127.1.{i // 250}.{1 + i % 250}:9"
+                      for i in range(n)]
+        self.sent = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._workers = max(1, min(workers, n))
+        self._members: list[str] = []
+        self._master = ""
+
+    def start(self) -> "HeartbeatStorm":
+        import uuid
+
+        from xllm_service_tpu.common.types import (InstanceMetaInfo,
+                                                   InstanceType)
+        from xllm_service_tpu.rpc import instance_key
+
+        for name in self.names:
+            meta = InstanceMetaInfo(
+                name=name, rpc_address=name, type=InstanceType.DEFAULT,
+                draining=True, incarnation_id=uuid.uuid4().hex[:12],
+                models=["fake-model"])
+            self.coord.set(instance_key("DEFAULT", name), meta.to_json(),
+                           ttl_s=10.0)
+        t = threading.Thread(target=self._membership_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        self._refresh_membership()
+        for w in range(self._workers):
+            t = threading.Thread(target=self._worker, args=(w,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _refresh_membership(self) -> None:
+        from xllm_service_tpu.rpc import MASTER_KEY, SERVICE_KEY_PREFIX
+
+        try:
+            self._members = [
+                k[len(SERVICE_KEY_PREFIX):]
+                for k in self.coord.get_prefix(SERVICE_KEY_PREFIX)
+                if k != MASTER_KEY]
+            self._master = self.coord.get(MASTER_KEY) or ""
+        except Exception:  # noqa: BLE001 — next refresh retries
+            pass
+
+    def _membership_loop(self) -> None:
+        while not self._stop.wait(1.0):
+            self._refresh_membership()
+
+    def _worker(self, w: int) -> None:
+        import requests as _rq
+
+        from xllm_service_tpu.multimaster import telemetry_owner
+        from xllm_service_tpu.rpc import wire as _wire
+
+        session = _rq.Session()
+        session.mount("http://", _rq.adapters.HTTPAdapter(
+            pool_connections=8, pool_maxsize=8))
+        mine = self.names[w::self._workers]
+        interval = 1.0 / self.hz
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            for i, name in enumerate(mine):
+                if self._stop.is_set():
+                    return
+                if self.mode == "shard":
+                    target = telemetry_owner(self._members, name) \
+                        or self._master
+                else:
+                    target = self._master
+                if not target:
+                    continue
+                payload = {
+                    "name": name, "incarnation_id": "",
+                    "load_metrics": {
+                        "waiting_requests_num": i % 5,
+                        "running_requests_num": i % 3,
+                        "hbm_cache_usage_perc": 0.2,
+                    },
+                    "latency_metrics": {"recent_max_ttft": 20.0,
+                                        "recent_max_tbt": 5.0},
+                }
+                body, ctype = _wire.encode_dispatch(payload,
+                                                    _wire.WIRE_MSGPACK)
+                try:
+                    session.post(f"http://{target}/rpc/heartbeat",
+                                 data=body,
+                                 headers={"Content-Type": ctype},
+                                 timeout=3)
+                    self.sent += 1
+                except _rq.RequestException:
+                    self.errors += 1
+            # Pace the sweep so each instance beats at ~hz.
+            elapsed = time.monotonic() - t0
+            if elapsed < interval:
+                time.sleep(interval - elapsed)
+
+    def stop(self) -> dict:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        return {"instances": self.n, "hz": self.hz, "mode": self.mode,
+                "beats_sent": self.sent, "errors": self.errors}
+
+
+def _admin_cpu(base: str) -> dict:
+    """One master's /admin/hotpath cpu + telemetry sections ({} when
+    unreachable)."""
+    try:
+        r = requests.get(base + "/admin/hotpath", timeout=5)
+        if r.status_code != 200:
+            return {}
+        payload = r.json()
+        return {"cpu": payload.get("cpu", {}),
+                "telemetry": {k: v for k, v in
+                              (payload.get("telemetry") or {}).items()
+                              if k != "load_info_ages_s"}}
+    except requests.RequestException:
+        return {}
+
+
+def _engine_telemetry(coord) -> "list[dict]":
+    """Scrape every registered engine's /metrics for the telemetry
+    connection counters (the O(engines) fan-out evidence)."""
+    from xllm_service_tpu.rpc import INSTANCE_KEY_PREFIX, parse_instance_key
+
+    out = []
+    for key in coord.get_prefix(INSTANCE_KEY_PREFIX):
+        _t, name = parse_instance_key(key)
+        if name.endswith(":9"):
+            continue   # storm instances have no HTTP surface
+        try:
+            r = requests.get(f"http://{name}/metrics", timeout=3)
+        except requests.RequestException:
+            continue
+        row = {"engine": name}
+        for line in r.text.splitlines():
+            if line.startswith("engine_telemetry_"):
+                k, _, v = line.rpartition(" ")
+                try:
+                    row[k.replace("engine_telemetry_", "")] = float(v)
+                except ValueError:
+                    pass
+        out.append(row)
+    return out
+
+
 # ~1 KiB prompt -> 1024 token ids through the byte-level SimpleTokenizer:
 # the enriched dispatch payload carries a multi-thousand-byte token_ids
 # list, which is exactly the wire cost this bench exists to attribute.
@@ -121,6 +336,9 @@ def drive(base, args) -> dict:
     lock = threading.Lock()
     work = list(range(args.requests))
     rps = getattr(args, "rps", 0.0) or 0.0
+    # Precomputed open-loop schedule (steady constant-rate, or the
+    # diurnal/burst profile): slot j = offsets[j] seconds after start.
+    offsets = _due_offsets(args.requests, args) if rps > 0 else None
     pace_start = time.perf_counter() + 0.05
 
     def worker(wbase):
@@ -137,7 +355,7 @@ def drive(base, args) -> dict:
                 # the actual send — a tree that can't keep up accrues the
                 # queueing delay instead of hiding it (coordinated
                 # omission). k counts down; slots are order-insensitive.
-                due = pace_start + (args.requests - 1 - k) / rps
+                due = pace_start + offsets[args.requests - 1 - k]
                 now = time.perf_counter()
                 if due > now:
                     time.sleep(due - now)
@@ -228,7 +446,12 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
               policy: str = "RR", n_engines: int = 1,
               n_masters: int = 1,
               master_args: tuple = (),
-              distinct_prompts: bool = False) -> dict:
+              distinct_prompts: bool = False,
+              telemetry_mode: str = "shard",
+              heartbeat_storm: int = 0, storm_hz: float = 2.0,
+              traffic: str = "steady", diurnal_period: float = 20.0,
+              diurnal_amp: float = 0.6, burst_every: float = 10.0,
+              burst_len: float = 2.0, burst_mult: float = 4.0) -> dict:
     """Spawn the multiproc stack, drive it, tear it down. Importable for
     the tier-1 budget test. ``policy`` selects the master's load-balance
     policy (RR | CAR | SLO_AWARE) — the kvcache routing bench drives the
@@ -242,7 +465,10 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
     args = argparse.Namespace(
         requests=requests_n, concurrency=concurrency,
         prompt_chars=prompt_chars, max_tokens=max_tokens, rps=rps,
-        distinct_prompts=distinct_prompts)
+        distinct_prompts=distinct_prompts, traffic=traffic,
+        diurnal_period=diurnal_period, diurnal_amp=diurnal_amp,
+        burst_every=burst_every, burst_len=burst_len,
+        burst_mult=burst_mult)
     coord_port = free_port()
     http_ports = [free_port() for _ in range(n_masters)]
     rpc_ports = [free_port() for _ in range(n_masters)]
@@ -272,19 +498,25 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
                    "--http-port", str(http_ports[i]),
                    "--rpc-port", str(rpc_ports[i]),
                    "--load-balance-policy", policy,
+                   "--telemetry-ingest-mode", telemetry_mode,
                    *master_args])
             if i == 0 and n_masters > 1:
                 # Let master0 win the election deterministically so the
                 # write lease (frames, LOADMETRICS, planner) sits on a
                 # known process for the whole run.
                 time.sleep(0.5)
+        # Engines mirror the service-plane mode: multiplexed owner-routed
+        # telemetry under sharding, the legacy elected-master funnel for
+        # the baseline.
+        engine_telemetry = "mux" if telemetry_mode == "shard" else "master"
         for i in range(max(1, n_engines)):
             spawn(f"engine{i}", [sys.executable,
                                  str(REPO / "examples" / "run_fake_engine.py"),
                                  "--coordination-addr",
                                  f"127.0.0.1:{coord_port}",
                                  "--reply", "x" * reply_chars,
-                                 "--chunk-size", "4", "--delay", "0"])
+                                 "--chunk-size", "4", "--delay", "0",
+                                 "--telemetry-mode", engine_telemetry])
 
         bases = [f"http://127.0.0.1:{p}" for p in http_ports]
         deadline = time.monotonic() + 60
@@ -313,7 +545,17 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
             raise RuntimeError(
                 f"cluster never became ready ({len(ready)}/{len(bases)} "
                 f"frontends serving)")
+        storm = None
+        coord = None
+        if heartbeat_storm > 0:
+            from xllm_service_tpu.coordination import connect
+            coord = connect(f"127.0.0.1:{coord_port}")
+            storm = HeartbeatStorm(coord, heartbeat_storm, storm_hz,
+                                   telemetry_mode).start()
+            # Let the fleet register the storm instances before driving.
+            time.sleep(2.0)
         cpu0 = {n: _proc_cpu_s(p.pid) for n, p in zip(names, procs)}
+        attr0 = {f"master{i}": _admin_cpu(b) for i, b in enumerate(bases)}
         report = drive(bases if n_masters > 1 else bases[0], args)
         # Per-process CPU attribution over the drive window: on a small
         # box the aggregate rps saturates on TOTAL cpu, so the scaling
@@ -323,12 +565,42 @@ def run_bench(requests_n: int = 256, concurrency: int = 8,
         cpu = {n: round(_proc_cpu_s(p.pid) - cpu0[n], 2)
                for n, p in zip(names, procs)}
         report["cpu_s_during_drive"] = cpu
+        # Per-master ingest/route/stream CPU buckets over the drive
+        # (thread_time measured inside the handlers) and each bucket's
+        # share of the process's total CPU — the ISSUE-15 acceptance
+        # number is the ELECTED master's ingest share, sharded vs not.
+        attr: dict = {}
+        for i, b in enumerate(bases):
+            name = f"master{i}"
+            after = _admin_cpu(b)
+            buckets = {}
+            for cat, row in (after.get("cpu") or {}).items():
+                before = ((attr0.get(name) or {}).get("cpu") or {}) \
+                    .get(cat, {})
+                cpu_s = round(row.get("cpu_s", 0.0)
+                              - before.get("cpu_s", 0.0), 3)
+                total = max(1e-9, cpu.get(name, 0.0))
+                buckets[cat] = {
+                    "cpu_s": cpu_s,
+                    "share_of_proc": round(cpu_s / total, 4),
+                    "n": row.get("n", 0) - before.get("n", 0),
+                }
+            attr[name] = {"buckets": buckets,
+                          "telemetry": after.get("telemetry", {})}
+        report["master_cpu_attribution"] = attr
+        if storm is not None:
+            report["heartbeat_storm"] = storm.stop()
+        if coord is not None:
+            report["engine_telemetry"] = _engine_telemetry(coord)
+            coord.close()
         served = max(1, args.requests - report.get("errors", 0))
         master_cpu = sum(v for n, v in cpu.items() if n.startswith("master"))
         report["master_cpu_ms_per_request"] = round(
             master_cpu * 1000.0 / served, 2)
         report["policy"] = policy
         report["n_engines"] = max(1, n_engines)
+        report["telemetry_mode"] = telemetry_mode
+        report["traffic"] = traffic
         return report
     finally:
         for p in procs:
@@ -365,12 +637,41 @@ def main() -> None:
                     help="unique prompt per request at 3 lengths (zero "
                          "prefix overlap — the heterogeneous-mix soak for "
                          "the CAR default)")
+    ap.add_argument("--telemetry-mode", default="shard",
+                    choices=["shard", "master"],
+                    help="shard = rendezvous-owned heartbeat ingest + "
+                         "multiplexed engine sessions (default); master "
+                         "= legacy elected-master funnel (the ingest-"
+                         "share baseline)")
+    ap.add_argument("--heartbeat-storm", type=int, default=0,
+                    help="register this many simulated instances and "
+                         "heartbeat them from the driver for the whole "
+                         "drive window (the telemetry-ingest load)")
+    ap.add_argument("--storm-hz", type=float, default=2.0,
+                    help="heartbeats per second per storm instance")
+    ap.add_argument("--traffic", default="steady",
+                    choices=["steady", "diurnal", "burst"],
+                    help="open-loop schedule shape on top of --rps")
+    ap.add_argument("--diurnal-period", type=float, default=20.0)
+    ap.add_argument("--diurnal-amp", type=float, default=0.6)
+    ap.add_argument("--burst-every", type=float, default=10.0)
+    ap.add_argument("--burst-len", type=float, default=2.0)
+    ap.add_argument("--burst-mult", type=float, default=4.0)
     args = ap.parse_args()
     report = run_bench(args.requests, args.concurrency, args.prompt_chars,
                        args.max_tokens, args.reply_chars, args.rps,
                        policy=args.policy, n_engines=args.engines,
                        n_masters=args.masters,
-                       distinct_prompts=args.distinct_prompts)
+                       distinct_prompts=args.distinct_prompts,
+                       telemetry_mode=args.telemetry_mode,
+                       heartbeat_storm=args.heartbeat_storm,
+                       storm_hz=args.storm_hz,
+                       traffic=args.traffic,
+                       diurnal_period=args.diurnal_period,
+                       diurnal_amp=args.diurnal_amp,
+                       burst_every=args.burst_every,
+                       burst_len=args.burst_len,
+                       burst_mult=args.burst_mult)
     report["distinct_prompts"] = args.distinct_prompts
     print(json.dumps(report, indent=2))
 

@@ -57,6 +57,14 @@ def main() -> None:
                         "accept hack is gone)")
     p.add_argument("--heartbeat-interval", type=float, default=0.5)
     p.add_argument("--lease-ttl", type=float, default=1.0)
+    p.add_argument("--telemetry-mode", default="owner",
+                   choices=["owner", "mux", "master"],
+                   help="owner = heartbeats to the rendezvous telemetry "
+                        "owner (deltas direct); mux = heartbeats AND "
+                        "deltas multiplexed on one keepalive session to "
+                        "the owner; master = legacy elected-master "
+                        "heartbeat funnel (the ingest-sharding bench "
+                        "baseline)")
     args = p.parse_args()
 
     rate = max(0.0, args.service_rate)
@@ -72,7 +80,8 @@ def main() -> None:
         accept_queue_limit=max(0, args.accept_queue),
         first_delta_delay_s=max(0.0, args.first_delta_delay),
         heartbeat_interval_s=max(0.05, args.heartbeat_interval),
-        lease_ttl_s=max(0.2, args.lease_ttl))
+        lease_ttl_s=max(0.2, args.lease_ttl),
+        telemetry_mode=args.telemetry_mode)
     ).start()
     print(f"fake engine {engine.name} ({args.type}) registered; Ctrl-C to stop",
           flush=True)

@@ -562,6 +562,320 @@ class TestSplitBrainDemotion:
             m2.stop()
 
 
+# ---------------------------------------------------- sharded telemetry ingest
+@pytest.mark.chaos
+class TestShardedTelemetryIngest:
+    def test_frames_mirror_load_and_shard_detection(self, store):
+        """Unit-ish: the owner ingests the beat, publishes a coalesced
+        frame, and the NON-owner's lock-free load view converges off it
+        (no LOADMETRICS funnel involved — sharded mode doesn't publish
+        those keys at all)."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store)
+        try:
+            _await_plane([m1, m2], [engine])
+            owner, mirror = (m1, m2) \
+                if m1.scheduler.instance_mgr.owns_telemetry(engine.name) \
+                else (m2, m1)
+            assert owner.scheduler.instance_mgr.owns_telemetry(engine.name)
+            assert not mirror.scheduler.instance_mgr.owns_telemetry(
+                engine.name)
+            # Owner ingests beats directly; the mirror converges via the
+            # owner's frame — both end up with fresh telemetry ages.
+            assert wait_until(
+                lambda: 0 <= owner.scheduler.instance_mgr
+                .load_info_ages_s().get(engine.name, -1) < 5, timeout=10)
+            assert wait_until(
+                lambda: 0 <= mirror.scheduler.instance_mgr
+                .load_info_ages_s().get(engine.name, -1) < 5, timeout=10)
+            # Sharded mode retired the per-instance LOADMETRICS funnel.
+            from xllm_service_tpu.rpc import (LOADFRAME_KEY_PREFIX,
+                                              LOADMETRICS_KEY_PREFIX)
+            coord = m1.scheduler._coord
+            assert not coord.get_prefix(LOADMETRICS_KEY_PREFIX)
+            frames = coord.get_prefix(LOADFRAME_KEY_PREFIX)
+            assert LOADFRAME_KEY_PREFIX + owner.scheduler.self_addr \
+                in frames
+            # stats() surfaces the shard map + per-instance ages
+            # (satellite: observable, not inferred).
+            st = owner.scheduler.instance_mgr.stats()
+            assert st["mode"] == "shard"
+            assert engine.name in st["owned_instances"]
+            assert engine.name in st["load_info_ages_s"]
+        finally:
+            engine.stop()
+            m1.stop()
+            m2.stop()
+
+    def test_owner_death_hands_ingest_to_successor_without_suspect(
+            self, store):
+        """THE ingest-sharding chaos drill (ISSUE 15 acceptance): kill
+        the master that owns an instance's telemetry mid-heartbeat-
+        stream. The engine's next beat re-routes to the rendezvous
+        successor (exclusion + membership convergence), the successor
+        takes over ingest AND detection with a takeover heartbeat grace,
+        and the instance NEVER transits SUSPECT on the survivor; the
+        frame log converges to the survivor's single frame."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store)   # hb every 0.1s
+        killer = None
+        try:
+            _await_plane([m1, m2], [engine])
+            owner, survivor = (m1, m2) \
+                if m1.scheduler.instance_mgr.owns_telemetry(engine.name) \
+                else (m2, m1)
+            smgr = survivor.scheduler.instance_mgr
+            # Telemetry flowing pre-kill on the owner.
+            assert wait_until(
+                lambda: 0 <= owner.scheduler.instance_mgr
+                .load_info_ages_s().get(engine.name, -1) < 5, timeout=10)
+
+            from xllm_service_tpu.common.types import InstanceRuntimeState
+            observed: list = []
+            stop = threading.Event()
+
+            def watch_states():
+                while not stop.is_set():
+                    st = smgr.get_instance_state(engine.name)
+                    if not observed or observed[-1] != st:
+                        observed.append(st)
+                    time.sleep(0.02)
+
+            watcher = threading.Thread(target=watch_states, daemon=True)
+            watcher.start()
+
+            killer = _kill(owner)
+            # The survivor becomes the telemetry owner (membership
+            # shrinks on the dead master's lease lapse)...
+            assert wait_until(
+                lambda: smgr.owns_telemetry(engine.name), timeout=10)
+            # ...and ingests the re-routed heartbeat stream: the age
+            # keeps resetting under fresh beats for a detection window.
+            deadline = time.monotonic() + 3 * 0.3  # 3x silence threshold
+            while time.monotonic() < deadline:
+                age = smgr.load_info_ages_s().get(engine.name, -1)
+                assert age == -1 or age < 2.0
+                time.sleep(0.05)
+            assert 0 <= smgr.load_info_ages_s().get(engine.name, -1) < 2.0
+            stop.set()
+            watcher.join(timeout=5)
+            # No spurious SUSPECT/evict during the handoff.
+            assert InstanceRuntimeState.SUSPECT not in observed, observed
+            assert smgr.get_instance_meta(engine.name) is not None
+            # Converged frame log: the survivor's frame carries the
+            # instance with a fresh heartbeat.
+            from xllm_service_tpu.rpc import LOADFRAME_KEY_PREFIX
+            from xllm_service_tpu.rpc.wire import decode_load_frame
+            def survivor_frame_fresh():
+                raw = survivor.scheduler._coord.get(
+                    LOADFRAME_KEY_PREFIX + survivor.scheduler.self_addr)
+                if not raw:
+                    return False
+                frame = decode_load_frame(raw)
+                row = frame["i"].get(engine.name)
+                return row is not None \
+                    and frame["ms"] - row["hb"] < 2000
+            assert wait_until(survivor_frame_fresh, timeout=10)
+            # The surviving plane still serves.
+            assert _completion(survivor) == REPLY
+        finally:
+            engine.stop()
+            survivor.stop()
+            if killer is not None:
+                killer.join(timeout=15)
+            else:
+                owner.stop()
+
+
+    def test_reregistration_supersedes_tombstone(self, store):
+        """Review regression: an eviction tombstone must be cleared when
+        the instance re-registers — otherwise it republishes for its
+        30s window and every mirror keeps deregistering the LIVE
+        re-registered instance on each frame tick (fleet-wide flap
+        under rolling restarts)."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store)
+        try:
+            _await_plane([m1, m2], [engine])
+            owner, mirror = (m1, m2) \
+                if m1.scheduler.instance_mgr.owns_telemetry(engine.name) \
+                else (m2, m1)
+            omgr = owner.scheduler.instance_mgr
+            omgr.deregister_instance(engine.name, reason="replaced")
+            # The fake engine's keepalive loop re-registers within one
+            # heartbeat interval; the owner's instance watch re-adds it.
+            assert wait_until(
+                lambda: omgr.get_instance_meta(engine.name) is not None,
+                timeout=10)
+            omgr.publish_telemetry_frames()
+            from xllm_service_tpu.rpc import LOADFRAME_KEY_PREFIX
+            from xllm_service_tpu.rpc.wire import decode_load_frame
+            raw = owner.scheduler._coord.get(
+                LOADFRAME_KEY_PREFIX + owner.scheduler.self_addr)
+            frame = decode_load_frame(raw)
+            assert engine.name in frame["i"]
+            assert engine.name not in (frame["g"] or {}), frame["g"]
+            # The mirror converges on the live row, not the eviction.
+            mmgr = mirror.scheduler.instance_mgr
+            assert wait_until(
+                lambda: mmgr.get_instance_meta(engine.name) is not None,
+                timeout=10)
+            time.sleep(0.5)   # a frame tick later it must STILL be there
+            assert mmgr.get_instance_meta(engine.name) is not None
+        finally:
+            engine.stop()
+            m1.stop()
+            m2.stop()
+
+    def test_mirror_ignores_stale_owner_tombstone(self, store):
+        """Review regression: only the instance's CURRENT rendezvous
+        owner may tombstone it — a frame from a former owner (shard map
+        moved on) must not deregister the live instance."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engine = _engine(store)
+        try:
+            _await_plane([m1, m2], [engine])
+            owner, mirror = (m1, m2) \
+                if m1.scheduler.instance_mgr.owns_telemetry(engine.name) \
+                else (m2, m1)
+            mmgr = mirror.scheduler.instance_mgr
+            # A tombstone-bearing frame from an address that is NOT the
+            # instance's current telemetry owner: ignored.
+            mmgr._apply_load_frame(
+                "203.0.113.9:1", {"i": {}, "g": {engine.name: "stale"},
+                                  "s": 1, "ms": 1})
+            assert mmgr.get_instance_meta(engine.name) is not None
+        finally:
+            engine.stop()
+            m1.stop()
+            m2.stop()
+
+    def test_owner_resolver_pin(self, store):
+        """A master's `owner` response hint re-targets the NEXT beat
+        without waiting out the resolver cache window."""
+        from xllm_service_tpu.multimaster import TelemetryOwnerResolver
+        m1 = _master(store)
+        try:
+            resolver = TelemetryOwnerResolver(
+                m1.scheduler._coord, "engine-x", cache_s=60.0)
+            resolver()   # warm the cache with the live answer
+            resolver.pin("198.51.100.7:42")
+            assert resolver() == "198.51.100.7:42"
+        finally:
+            m1.stop()
+
+
+# ------------------------------------------------------- handoff delta journal
+@pytest.mark.chaos
+class TestHandoffDeltaJournal:
+    def _read_sse_frames(self, resp) -> list:
+        """Raw SSE frames (data: ... terminated by blank line) from a
+        streamed requests response."""
+        buf = b""
+        frames = []
+        for chunk in resp.iter_content(chunk_size=None):
+            buf += chunk
+        while b"\n\n" in buf:
+            frame, _, buf = buf.partition(b"\n\n")
+            frames.append(frame + b"\n\n")
+        return frames
+
+    def test_reconnect_replays_exact_frames_without_rerun(self, store):
+        """A relay reconnect (same sid, attempt>0, skip=N) is served
+        from the owner's delta journal: byte-identical tail frames and
+        NO pipeline re-run — proven by mutating the engine's reply
+        between attempts (a re-run would produce different text) and by
+        the engine's accept log not growing."""
+        m1 = _master(store)
+        engine = _engine(store)
+        try:
+            _await_plane([m1], [engine])
+            owner = m1.scheduler.self_addr   # rpc app serves /rpc/handoff
+            sid = "completion-journal-test-1"
+            body = {"model": "fake-model", "prompt": "journal",
+                    "stream": True, "max_tokens": 1000}
+            r = requests.post(
+                f"http://{owner}/rpc/handoff?kind=completion&sid={sid}"
+                f"&attempt=0",
+                json=body, stream=True, timeout=30)
+            assert r.status_code == 200
+            first = self._read_sse_frames(r)
+            assert len(first) >= 3
+            accepted0 = len(engine.accepted_requests)
+
+            # A re-run NOW would stream different bytes...
+            engine.cfg.reply_text = "DIVERGENT " * 8
+            # ...but the journal replay returns the ORIGINAL tail.
+            r2 = requests.post(
+                f"http://{owner}/rpc/handoff?kind=completion&sid={sid}"
+                f"&attempt=1&skip=2",
+                json=body, stream=True, timeout=30)
+            assert r2.status_code == 200
+            replay = self._read_sse_frames(r2)
+            assert replay == first[2:]
+            assert len(engine.accepted_requests) == accepted0
+            from xllm_service_tpu.common.metrics import (
+                HANDOFF_JOURNAL_REPLAYS_TOTAL,
+            )
+            assert HANDOFF_JOURNAL_REPLAYS_TOTAL.value() >= 1
+        finally:
+            engine.stop()
+            m1.stop()
+
+    def test_detached_stream_absorbs_and_replays_through_grace(self, store):
+        """Owner-side detach grace: the relay connection breaks
+        mid-stream (client close), the owner keeps absorbing deltas into
+        the journal instead of cancelling, and a reconnect replays the
+        COMPLETE remainder."""
+        m1 = _master(store)
+        engine = _engine(store, delay_s=0.08)
+        try:
+            _await_plane([m1], [engine])
+            owner = m1.scheduler.self_addr
+            sid = "completion-journal-test-2"
+            body = {"model": "fake-model", "prompt": "journal-detach",
+                    "stream": True, "max_tokens": 1000}
+            r = requests.post(
+                f"http://{owner}/rpc/handoff?kind=completion&sid={sid}"
+                f"&attempt=0",
+                json=body, stream=True, timeout=30)
+            assert r.status_code == 200
+            # Take 2 frames then drop the connection (a relay break,
+            # NOT a client abort — no /rpc/handoff_abort follows).
+            got = 0
+            buf = b""
+            for chunk in r.iter_content(chunk_size=1):
+                buf += chunk
+                got = buf.count(b"\n\n")
+                if got >= 2:
+                    break
+            r.close()
+            # The stream keeps generating into the journal; reconnect
+            # and collect the remainder.
+            time.sleep(0.3)
+            r2 = requests.post(
+                f"http://{owner}/rpc/handoff?kind=completion&sid={sid}"
+                f"&attempt=1&skip=0",
+                json=body, stream=True, timeout=30)
+            frames = self._read_sse_frames(r2)
+            text = ""
+            for f in frames:
+                if not f.startswith(b"data: ") or f.startswith(b"data: ["):
+                    continue
+                obj = json.loads(f[len(b"data: "):])
+                for c in obj.get("choices", ()):
+                    text += c.get("text", "")
+            assert text == REPLY
+            assert frames[-1] == b"data: [DONE]\n\n"
+        finally:
+            engine.stop()
+            m1.stop()
+
+
 # ------------------------------------------------------ write-lease proxying
 @pytest.mark.chaos
 class TestWriteLeaseProxy:

@@ -611,6 +611,82 @@ def test_xlint_state_registry_is_live():
         assert by_rule["state-read"], vs
 
 
+def test_xlint_owner_discipline_fires():
+    """The `owner:<guard>` state discipline (ISSUE 15): writes to the
+    sharded heartbeat fields must be dominated by a POSITIVE
+    owns_telemetry() guard — an unguarded write, and a write under a
+    NEGATED guard, both fail the build; the guarded write passes."""
+    import tempfile
+
+    import xllm_service_tpu.devtools.ownership as own_mod
+
+    assert own_mod.STATE_DISCIPLINES["InstanceMgr._shard_dirty"] \
+        == "owner:owns_telemetry"
+    reg = Path(own_mod.__file__)
+    probe = (
+        "import threading\n"
+        "class InstanceMgr:\n"
+        "    def __init__(self):\n"
+        "        self._metrics_lock = threading.Lock()  # lock-order: 24\n"
+        "        self._cluster_lock = threading.Lock()  # lock-order: 20\n"
+        "        self._shard_dirty = set()\n"
+        "        self._shard_gone = {}\n"
+        "    def owns_telemetry(self, name):\n"
+        "        return True\n"
+        "    def good(self, name):\n"
+        "        if self.owns_telemetry(name):\n"
+        "            self._shard_dirty.add(name)\n"
+        "    def bad_unguarded(self, name):\n"
+        "        self._shard_dirty.add(name)\n"
+        "    def bad_negated(self, name):\n"
+        "        if not self.owns_telemetry(name):\n"
+        "            self._shard_gone[name] = ('x', 0)\n")
+    with tempfile.TemporaryDirectory() as td:
+        bad = Path(td) / "probe.py"
+        bad.write_text(probe)
+        vs = xlint.run([str(reg), str(bad)])
+        owner_vs = [v for v in vs if v.rule == "state-write"
+                    and "probe.py" in v.path and "owner:" in v.message]
+        lines = {v.line for v in owner_vs}
+        src = probe.splitlines()
+        flagged = {src[ln - 1].strip() for ln in lines}
+        assert any("bad_unguarded" in src[ln - 2] or
+                   "_shard_dirty.add" in src[ln - 1] for ln in lines), vs
+        # The negated guard earns no credit.
+        assert any("_shard_gone[name]" in f for f in flagged), owner_vs
+        # The positively-guarded write is clean.
+        good_line = probe.splitlines().index(
+            "            self._shard_dirty.add(name)") + 1
+        assert good_line not in lines, owner_vs
+
+
+def test_owner_guard_runtime_verifier():
+    """Runtime half of `owner:`: with XLLM_STATE_DEBUG armed, a write to
+    an owner-gated container after a FAILING guard check records a
+    state-owner violation; a write after a passing check does not."""
+    import xllm_service_tpu.devtools.ownership as own_mod
+
+    class Probe:
+        pass
+
+    own_mod.note_owner_guard("owns_telemetry", True)
+    assert own_mod._owner_guard_ok("owns_telemetry")
+    own_mod.note_owner_guard("owns_telemetry", False)
+    assert not own_mod._owner_guard_ok("owns_telemetry")
+    own_mod.reset_violations()
+    own_mod._check_write(Probe(), "InstanceMgr", "_shard_dirty",
+                         "owner:owns_telemetry", first=False,
+                         meth="record_instance_heartbeat")
+    vs = own_mod.violations()
+    assert any(v.kind == "state-owner" for v in vs), vs
+    own_mod.reset_violations()
+    own_mod.note_owner_guard("owns_telemetry", True)
+    own_mod._check_write(Probe(), "InstanceMgr", "_shard_dirty",
+                         "owner:owns_telemetry", first=False,
+                         meth="record_instance_heartbeat")
+    assert not own_mod.violations()
+
+
 def test_xlint_state_registry_disciplines_parse():
     """Every live registry entry parses into a known discipline and the
     cross-referenced objects exist at runtime (the registry the static
@@ -623,16 +699,21 @@ def test_xlint_state_registry_disciplines_parse():
         kind, _, arg = spec.partition(":")
         kinds.add(kind)
         assert kind in ("lock", "rcu", "confined", "init-only",
-                        "immutable"), (key, spec)
+                        "immutable", "owner"), (key, spec)
         if kind == "confined":
             assert arg in own_mod.THREAD_ROLES, (key, spec)
+        if kind == "owner":
+            # The guard must be a live method on the class (the static
+            # rule cross-checks the same; here we pin the runtime side).
+            assert arg, (key, spec)
         if kind == "rcu":
             from xllm_service_tpu.devtools.rcu import RCU_PUBLICATIONS
 
             assert key in RCU_PUBLICATIONS, key
     # Every discipline kind is exercised by the live registry (a kind
     # nothing uses would mean untested rule surface).
-    assert kinds == {"lock", "rcu", "confined", "init-only", "immutable"}
+    assert kinds == {"lock", "rcu", "confined", "init-only", "immutable",
+                     "owner"}
 
 
 def test_cli_json_format(tmp_path, capsys):

@@ -252,6 +252,22 @@ class ServiceOptions:
     # units (CAR) / a proportional predicted-TPOT inflation (SLO).
     loadinfo_stale_after_s: float = 9.0
     stale_load_penalty: float = 0.5
+    # Telemetry-ingest plane (ISSUE 15). "shard": each ACTIVE master
+    # ingests heartbeats/load only for the instances it owns under the
+    # rendezvous telemetry map, runs failure detection only for them,
+    # and publishes a coalesced load/lease frame per sync tick
+    # (XLLM:LOADFRAME:<self>) that every other frontend mirrors — the
+    # elected master's ingest funnel is spread 1/N. "master": the
+    # reference-shaped legacy funnel (elected master ingests everything,
+    # publishes per-instance LOADMETRICS keys, replicas mirror) — the
+    # bench baseline and the mixed-version escape hatch. A string knob,
+    # not a bool: store_true CLI bools can't be turned off.
+    telemetry_ingest_mode: str = "shard"
+    # Handoff delta journal (exact replay dedup): how long the owner
+    # keeps buffering a relayed stream's deltas after the relay
+    # connection breaks, waiting for a reconnect — beyond it the request
+    # is cancelled like a plain disconnect. 0 disables the journal.
+    handoff_journal_grace_s: float = 10.0
     # --- request registry ---
     num_output_threads: int = 16      # per-request output-ordering lanes
     request_timeout_s: float = 600.0

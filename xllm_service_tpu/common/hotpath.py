@@ -73,3 +73,65 @@ class HotpathRecorder:
 #: Process-global recorder (the master is one process; the engine agent
 #: has its own ttft_spans surface).
 HOTPATH = HotpathRecorder()
+
+
+class CpuAttribution:
+    """Per-category CPU-second accounting for the master process
+    (ingest = heartbeat/telemetry-frame ingest, route = schedule
+    [template/tokenize/route/bind], stream = generation-delta ingest).
+
+    The bench divides these by the process's total /proc CPU to get the
+    ingest/route/stream shares the ISSUE-15 acceptance keys on. Each
+    measurement is two ``thread_time`` reads (CPU time of the CURRENT
+    thread — correct on executor threads, immune to wall-clock blocking)
+    and one float add; totals are plain floats mutated under the GIL —
+    a torn read of a monotonically-growing total is off by at most one
+    sample, which is noise at bench scale."""
+
+    CATEGORIES = ("ingest", "route", "stream")
+
+    def __init__(self):
+        self._totals = {c: 0.0 for c in self.CATEGORIES}
+        self._counts = {c: 0 for c in self.CATEGORIES}
+
+    def measure(self, category: str):
+        return _CpuSpan(self, category)
+
+    def add(self, category: str, seconds: float) -> None:
+        if category in self._totals:
+            self._totals[category] += seconds
+            self._counts[category] += 1
+
+    def summary(self) -> dict[str, Any]:
+        return {c: {"cpu_s": round(self._totals[c], 4),
+                    "n": self._counts[c]}
+                for c in self.CATEGORIES}
+
+    def clear(self) -> None:
+        for c in self.CATEGORIES:
+            self._totals[c] = 0.0
+            self._counts[c] = 0
+
+
+class _CpuSpan:
+    __slots__ = ("_attr", "_cat", "_t0")
+
+    def __init__(self, attr: CpuAttribution, category: str):
+        self._attr = attr
+        self._cat = category
+
+    def __enter__(self):
+        import time
+
+        self._t0 = time.thread_time()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        self._attr.add(self._cat, time.thread_time() - self._t0)
+
+
+#: Process-global CPU attribution (served by /admin/hotpath; read by
+#: master_hotpath_bench's ingest-share report).
+CPU_ATTR = CpuAttribution()

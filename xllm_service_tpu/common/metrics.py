@@ -507,3 +507,38 @@ HANDOFF_RECOVERIES_TOTAL = REGISTRY.counter(
     "Mid-flight re-ownerships after an owning master died "
     "(owner = the rendezvous successor)",
     labelnames=("owner",))
+HANDOFF_JOURNAL_REPLAYS_TOTAL = REGISTRY.counter(
+    "handoff_journal_replays_total",
+    "Relayed-stream reconnects served from the owner's seq-numbered "
+    "delta journal (exact replay — no pipeline re-run, no splice risk)")
+
+# Sharded telemetry-ingest plane (ISSUE 15): heartbeat ingest by shard
+# verdict, coalesced load-frame publication, and the master->master
+# generation-delta relay behind the multiplexed engine session.
+HEARTBEATS_INGESTED_TOTAL = REGISTRY.counter(
+    "heartbeats_ingested_total",
+    "Heartbeats ingested by this frontend, by telemetry-shard verdict "
+    "(owned = this master owns the instance's ingest, foreign = "
+    "membership race / legacy engine still funneling to the elected "
+    "master)",
+    labelnames=("shard",))
+LOADFRAMES_PUBLISHED_TOTAL = REGISTRY.counter(
+    "loadframes_published_total",
+    "Coalesced load/lease frames this master published for its "
+    "telemetry shard")
+LOADFRAMES_APPLIED_TOTAL = REGISTRY.counter(
+    "loadframes_applied_total",
+    "Peer owners' load/lease frames mirrored into this frontend's "
+    "lock-free load-info view")
+TELEMETRY_GENS_RELAYED_TOTAL = REGISTRY.counter(
+    "telemetry_gens_relayed_total",
+    "Generation-delta batches relayed master->master for engines whose "
+    "multiplexed telemetry session lands here but whose request owner "
+    "is another frontend",
+    labelnames=("dest",))
+LOADINFO_AGE_SECONDS = REGISTRY.gauge(
+    "loadinfo_age_seconds",
+    "Per-instance load-info snapshot age (scrape-time refreshed; -1 = "
+    "never updated) — the staleness signal SLO/CAR scoring discounts by, "
+    "now observable instead of inferred",
+    labelnames=("instance",))

@@ -35,6 +35,15 @@ discipline                contract
                           from threads whose name matches the role (the main
                           thread is exempt — single-threaded test drivers
                           stand in for every role)
+``owner:<guard>``         sharded-plane state owned by whichever PROCESS the
+                          rendezvous map elects (ISSUE 15): every write must
+                          be dominated by a successful ``self.<guard>(...)``
+                          check (statically: lexically inside an
+                          ``if self.<guard>(...)`` body; at runtime: the
+                          writing thread's most recent ``<guard>`` call —
+                          noted via :func:`note_owner_guard` — returned
+                          True). A non-owner writing a sharded heartbeat
+                          field is a build failure AND a recorded violation
 ``init-only``             assigned at construction (and lifecycle teardown),
                           never rebound afterwards; the value may be
                           internally synchronized elsewhere
@@ -121,6 +130,21 @@ STATE_DISCIPLINES: dict[str, str] = {
     "InstanceMgr._watch_ids": "confined:mastership",
     "InstanceMgr._opts": "init-only",
     "InstanceMgr._coord": "init-only",
+    # Sharded telemetry-ingest plane (ISSUE 15). The frame inputs are
+    # OWNER-GATED: only the master that owns an instance's telemetry
+    # under the rendezvous shard map may coalesce its beats into the
+    # published load frame or tombstone its eviction — a non-owner write
+    # here would fork the fleet's converged view.
+    "InstanceMgr._shard_dirty": "owner:owns_telemetry",
+    "InstanceMgr._shard_gone": "owner:owns_telemetry",
+    "InstanceMgr._owned_names": "lock:_cluster_lock",
+    "InstanceMgr._published_owned": "lock:_metrics_lock",
+    "InstanceMgr._shard_seq": "lock:_metrics_lock",
+    "InstanceMgr._frames_published": "lock:_metrics_lock",
+    "InstanceMgr._frames_applied": "lock:_metrics_lock",
+    "InstanceMgr._foreign_heartbeats": "lock:_metrics_lock",
+    "InstanceMgr._frame_watch_id": "init-only",
+    "InstanceMgr._ownership": "init-only",
     "InstanceMgr._rr_prefill": "init-only",
     "InstanceMgr._rr_decode": "init-only",
     "InstanceMgr._rr_encode": "init-only",
@@ -423,6 +447,25 @@ def _escaped() -> bool:
     return getattr(_tls, "escape", 0) > 0
 
 
+# ----------------------------------------------------- owner-gated guards
+def note_owner_guard(guard: str, ok: bool) -> None:
+    """Record the calling thread's most recent ``<guard>()`` verdict —
+    the runtime half of the ``owner:<guard>`` discipline. Called by the
+    guard method itself (e.g. ``InstanceMgr.owns_telemetry``) on every
+    invocation; a subsequent write to an owner-gated attribute from this
+    thread is checked against this verdict. One thread-local dict store
+    — cheap enough to run outside debug mode, so arming the verifier
+    mid-run needs no warm-up."""
+    guards = getattr(_tls, "owner_guards", None)
+    if guards is None:
+        guards = _tls.owner_guards = {}
+    guards[guard] = ok
+
+
+def _owner_guard_ok(guard: str) -> bool:
+    return getattr(_tls, "owner_guards", {}).get(guard, False)
+
+
 # --------------------------------------------------------- discipline model
 def _parse(spec: str) -> tuple[str, str]:
     """('lock', attr) | ('confined', role) | ('rcu'|'init-only'|
@@ -492,6 +535,13 @@ def _check_write(obj: Any, cls_name: str, name: str, spec: str,
                     f"thread {threading.current_thread().name!r}, which "
                     f"is not in role {arg!r} "
                     f"({THREAD_ROLES.get(arg, {}).get('threads', ())})")
+    elif kind == "owner":
+        if not first and meth not in _DECL_SCOPE \
+                and not _owner_guard_ok(arg):
+            _record("state-owner",
+                    f"{cls_name}.{name} (owner:{arg}) written without a "
+                    f"passing {arg}() check on this thread — only the "
+                    f"rendezvous owner may write sharded telemetry state")
     elif kind in ("init-only", "immutable"):
         if not first and meth not in _DECL_SCOPE:
             _record("state-reassign",
@@ -612,10 +662,12 @@ def _instrument(cls: type) -> None:
         _check_write(self, _cls, name, spec, first,
                      sys._getframe(1).f_code.co_name)
         kind, _ = _parse(spec)
-        if kind == "lock":
+        if kind in ("lock", "owner"):
             # Confined containers stay unwrapped: construction may run on
             # an arbitrary thread (e2e masters build on "master-loop") and
             # confinement only governs rebinds, not in-place bookkeeping.
+            # Owner-gated containers ARE wrapped: every in-place mutation
+            # re-checks the thread's last guard verdict.
             value = _guard_container(value, self, _cls, name, spec)
         elif kind == "immutable":
             from . import rcu

@@ -1530,7 +1530,8 @@ def rule_rcu(project: Project) -> list[Violation]:
 #: worker threads are joined, so their rebinds are bookkeeping.
 STATE_LIFECYCLE_METHODS = {"stop", "close", "shutdown"}
 
-_STATE_KINDS = {"lock", "rcu", "confined", "init-only", "immutable"}
+_STATE_KINDS = {"lock", "rcu", "confined", "init-only", "immutable",
+                "owner"}
 
 #: In-place mutators checked on lock:/immutable attrs (superset of the
 #: RCU set: deque-style ends included).
@@ -1541,6 +1542,29 @@ STATE_MUTATORS = RCU_MUTATORS | {"appendleft", "popleft", "__ior__",
 def _parse_discipline(spec: str) -> tuple[str, str]:
     kind, _, arg = spec.partition(":")
     return kind.strip(), arg.strip()
+
+
+def _guard_calls_in_test(test: ast.AST) -> set[str]:
+    """Method names called as ``self.<name>(...)``/``cls.<name>(...)``
+    inside an if-test, EXCLUDING calls under a ``not`` — the positive
+    guards whose if-body a write may rely on (``owner:`` discipline).
+    A negated guard dominates the wrong branch and earns no credit."""
+    found: set[str] = set()
+
+    def walk(node: ast.AST, negated: bool) -> None:
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            walk(node.operand, not negated)
+            return
+        if isinstance(node, ast.Call) and not negated \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in ("self", "cls"):
+            found.add(node.func.attr)
+        for child in ast.iter_child_nodes(node):
+            walk(child, negated)
+
+    walk(test, False)
+    return found
 
 
 def _parse_thread_roles(f: SourceFile) -> "dict[str, tuple[tuple[str, ...], int]]":
@@ -1632,6 +1656,8 @@ class _StateSite:
     line: int
     locks: frozenset
     escaped: bool
+    guards: frozenset = frozenset()   # positive self.<guard>() if-tests
+    #                                   dominating this write (owner:)
 
 
 def rule_state(project: Project) -> list[Violation]:
@@ -1664,24 +1690,30 @@ def rule_state(project: Project) -> list[Violation]:
         cls, _, attr = key.partition(".")
         kind, arg = _parse_discipline(val or "")
         bad = (not attr or kind not in _STATE_KINDS
-               or (kind in ("lock", "confined") and not arg)
+               or (kind in ("lock", "confined", "owner") and not arg)
                or (kind in ("rcu", "init-only", "immutable") and arg))
         if bad:
             out.append(Violation(
                 "state-decl", reg_file.rel, line,
                 f"state discipline {key!r}: {val!r} is not one of "
-                f"lock:<attr> | rcu | confined:<role> | init-only | "
-                f"immutable"))
+                f"lock:<attr> | rcu | confined:<role> | owner:<guard> | "
+                f"init-only | immutable"))
             continue
         decls[(cls, attr)] = _StateDecl(cls, attr, kind, arg, line)
     registered_classes = {c for (c, _a) in decls}
 
     # ---- class index + per-class assigned/mutated attr sets
     class_index: dict[str, tuple[SourceFile, int]] = {}
+    class_methods: dict[str, set[str]] = {}
     for f in project.files:
         for node in f.tree.body:
             if isinstance(node, ast.ClassDef):
                 class_index.setdefault(node.name, (f, node.lineno))
+                ms = class_methods.setdefault(node.name, set())
+                for b in node.body:
+                    if isinstance(b, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        ms.add(b.name)
 
     touched: dict[str, set[str]] = {}      # cls -> attrs assigned/mutated
     sites: list[_StateSite] = []
@@ -1690,11 +1722,23 @@ def rule_state(project: Project) -> list[Violation]:
         meth = fn.name
         cls_touched = touched.setdefault(cls_name, set())
 
-        def visit(node, lock_stack: list[str], esc: int) -> None:
+        def visit(node, lock_stack: list[str], esc: int,
+                  guards: frozenset = frozenset()) -> None:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda)) and node is not fn:
                 for child in ast.iter_child_nodes(node):
                     visit(child, [], esc)
+                return
+            if isinstance(node, ast.If):
+                # owner: discipline — writes inside the if-body of a
+                # positive ``self.<guard>(...)`` test are guard-credited;
+                # the test itself and the else branch are not.
+                found = _guard_calls_in_test(node.test)
+                visit(node.test, lock_stack, esc, guards)
+                for child in node.body:
+                    visit(child, lock_stack, esc, guards | found)
+                for child in node.orelse:
+                    visit(child, lock_stack, esc, guards)
                 return
             entered = 0
             esc_entered = 0
@@ -1720,7 +1764,8 @@ def rule_state(project: Project) -> list[Violation]:
                 sites.append(_StateSite(
                     decl=decls.get((cls_name, attr)), cls=cls_name,
                     attr=attr, shape=shape, file=f, meth=meth, line=line,
-                    locks=frozenset(lock_stack), escaped=esc > 0))
+                    locks=frozenset(lock_stack), escaped=esc > 0,
+                    guards=guards))
 
             if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
                 targets = node.targets if isinstance(node, ast.Assign) \
@@ -1756,7 +1801,7 @@ def rule_state(project: Project) -> list[Violation]:
                     and node.func.value.value.id in ("self", "cls"):
                 emit(node.func.value.attr, "mut", node.lineno)
             for child in ast.iter_child_nodes(node):
-                visit(child, lock_stack, esc)
+                visit(child, lock_stack, esc, guards)
             for _ in range(entered):
                 lock_stack.pop()
 
@@ -1808,6 +1853,12 @@ def rule_state(project: Project) -> list[Violation]:
                 "state-decl", reg_file.rel, d.line,
                 f"{cls}.{attr} declares discipline rcu, but is not "
                 f"registered in RCU_PUBLICATIONS (devtools/rcu.py)"))
+        if d.kind == "owner" and d.arg not in class_methods.get(cls, set()):
+            out.append(Violation(
+                "state-decl", reg_file.rel, d.line,
+                f"{cls}.{attr} declares owner:{d.arg}, but {cls}.{d.arg} "
+                f"is not a method of the class (the guard must be the "
+                f"live ownership check its writes are dominated by)"))
     for role, (_entries, line) in sorted(roles.items()):
         if role not in role_used:
             out.append(Violation(
@@ -1930,6 +1981,16 @@ def rule_state(project: Project) -> list[Violation]:
                 f"{s.meth}(), which is not an entry function of role "
                 f"{d.arg!r} (and not every call site resolves into "
                 f"one)"))
+        elif d.kind == "owner":
+            if d.arg in s.guards:
+                continue
+            out.append(Violation(
+                "state-write", s.file.rel, s.line,
+                f"{d.cls}.{d.attr} (owner:{d.arg}) written outside an "
+                f"'if self.{d.arg}(...)' guard — only the rendezvous "
+                f"owner may write sharded telemetry state (hatch: "
+                f"ownership.escape(reason) for owner-neutral "
+                f"bookkeeping)"))
         elif d.kind in ("init-only", "immutable"):
             if s.shape == "rebind":
                 if s.meth in STATE_LIFECYCLE_METHODS:

@@ -122,6 +122,12 @@ class AgentConfig:
     heartbeat_interval_s: float = 3.0
     lease_ttl_s: float = 3.0
     generation_flush_ms: float = 5.0   # batching window for Generations
+    # Telemetry wiring (ISSUE 15): "mux" = ONE multiplexed keepalive
+    # session to the owning master (tagged hb+gens frames on
+    # /rpc/telemetry; O(1) connections per engine), "owner" = heartbeats
+    # to the rendezvous owner but deltas direct per-dest, "master" = the
+    # legacy funnel (heartbeats to the elected master only).
+    telemetry_mode: str = "mux"
     slice_id: str = "slice-0"
     # Model replicas behind this one registration (reference dp_size,
     # `xllm_rpc_service.proto:40-43`): each replica is an independent
@@ -200,14 +206,25 @@ class GenerationStreamer:
     the original POST was processed but its response lost). A failed dest
     keeps its gens queued per-dest and is retried after a backoff WITHOUT
     blocking flushes to healthy dests; only after `FLUSH_RETRIES`
-    consecutive failures are that dest's requests cancelled."""
+    consecutive failures are that dest's requests cancelled.
+
+    Multiplexed session (ISSUE 15): with an `owner_fn`, every ready
+    dest's batch rides ONE tagged-frame POST to the engine's owning
+    master (`/rpc/telemetry`), which ingests its own dests and relays
+    the rest master->master — so this engine's fan-out is one keepalive
+    connection regardless of how many masters dispatched to it. The
+    per-dest retry/cancel machinery is unchanged: the owner's response
+    carries per-dest delivery verdicts. A legacy owner (404) demotes the
+    streamer to the direct per-dest wire for the process's lifetime."""
 
     # One transient blip (service GC pause, connection reset) must not kill
     # every in-flight stream on the instance: retry before cancelling.
     FLUSH_RETRIES = 2
     RETRY_BACKOFF_S = 0.25
 
-    def __init__(self, engine: InferenceEngine, flush_ms: float):
+    def __init__(self, engine: InferenceEngine, flush_ms: float,
+                 session: Optional[_requests.Session] = None,
+                 owner_fn=None):
         self._engine = engine
         self._q: "queue.Queue[Optional[tuple[str, dict]]]" = queue.Queue()
         self._flush_s = flush_ms / 1000.0
@@ -217,6 +234,14 @@ class GenerationStreamer:
         # address/incarnation are known; empty = unstamped, accepted as-is).
         self.instance_name = ""
         self.incarnation = ""
+        # Shared bounded keepalive session (None = a private one per
+        # streamer, the legacy shape) and the telemetry-owner resolver
+        # enabling the multiplexed wire (None = direct per-dest POSTs).
+        self._session = session
+        self._owner_fn = owner_fn
+        self._mux_ok = owner_fn is not None
+        self.mux_sends = 0
+        self.direct_sends = 0
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="gen-streamer")
         self._thread.start()
@@ -239,7 +264,7 @@ class GenerationStreamer:
             self._q.put((dest_addr, output.to_dict()))
 
     def _loop(self) -> None:
-        session = _requests.Session()
+        session = self._session or _requests.Session()
         # Per-dest unsent gens (order preserved) + failure bookkeeping.
         pending: dict[str, list[dict]] = {}
         attempts: dict[str, int] = {}
@@ -278,10 +303,11 @@ class GenerationStreamer:
                     pending.setdefault(nxt[0], []).append(nxt[1])
 
             now = time.monotonic()
-            for dest in list(pending):
-                if not stopping and next_try.get(dest, 0.0) > now:
-                    continue
-                if self._send(session, dest, pending[dest]):
+            ready = [d for d in list(pending)
+                     if stopping or next_try.get(d, 0.0) <= now]
+            outcomes = self._flush_ready(session, ready, pending)
+            for dest in ready:
+                if outcomes.get(dest, False):
                     del pending[dest]
                     attempts.pop(dest, None)
                     next_try.pop(dest, None)
@@ -298,6 +324,59 @@ class GenerationStreamer:
                     else:
                         attempts[dest] = n
                         next_try[dest] = now + self.RETRY_BACKOFF_S * n
+
+    def _flush_ready(self, session: _requests.Session, dests: list,
+                     pending: dict) -> dict:
+        """One flush pass over the ready dests → per-dest delivery
+        verdicts. Multiplexed wire when an owner is resolvable, direct
+        per-dest POSTs otherwise (or after a legacy-owner demotion)."""
+        if not dests:
+            return {}
+        if self._mux_ok:
+            owner = self._owner_fn()
+            if owner:
+                out = self._send_mux(session, owner,
+                                     {d: pending[d] for d in dests})
+                if out is not None:
+                    return out
+        self.direct_sends += len(dests)
+        return {d: self._send(session, d, pending[d]) for d in dests}
+
+    def _send_mux(self, session: _requests.Session, owner: str,
+                  batches: dict) -> Optional[dict]:
+        """All ready batches as tagged frames on ONE POST to the owning
+        master. Returns per-dest verdicts, or None after a legacy-owner
+        demotion (caller falls back to the direct wire THIS pass)."""
+        frames = [{"t": "gens", "dest": d, "d": {"gens": gens}}
+                  for d, gens in batches.items()]
+        body, ctype = dispatch_wire.encode_telemetry(frames)
+        try:
+            r = session.post(f"http://{owner}/rpc/telemetry", data=body,
+                             headers={"Content-Type": ctype}, timeout=10)
+            if r.status_code in (404, 405):
+                logger.warning("telemetry owner %s lacks /rpc/telemetry; "
+                               "demoting streamer to the direct per-dest "
+                               "wire", owner)
+                self._mux_ok = False
+                return None
+            r.raise_for_status()
+            payload = r.json()
+        except (_requests.RequestException, ValueError) as e:
+            logger.warning("multiplexed gens push via %s failed: %s",
+                           owner, e)
+            note = getattr(self._owner_fn, "note_failure", None)
+            if note is not None:
+                # Owner death: the resolver excludes it so the next flush
+                # targets the rendezvous successor (same successor rule
+                # as the service-side handoff relay).
+                note(owner)
+            return {d: False for d in batches}
+        self.mux_sends += 1
+        for sid, ok in (payload.get("alive") or {}).items():
+            if not ok:
+                self._engine.cancel(sid)
+        dest_ok = payload.get("dest_ok") or {}
+        return {d: bool(dest_ok.get(d, False)) for d in batches}
 
     def _send(self, session: _requests.Session, dest: str,
               gens: list[dict]) -> bool:
@@ -395,9 +474,22 @@ class EngineAgent:
         # address changes.
         self._hb_wire = dispatch_wire.WIRE_MSGPACK
         self._hb_master = ""
+        # ONE shared, bounded keepalive session for every telemetry hop
+        # this agent makes (heartbeats + delta pushes): the engine-side
+        # half of the O(engines) fan-out story. The owner resolver
+        # mirrors the SERVICE membership and applies the same rendezvous
+        # shard map the masters use.
+        from ..multimaster import TelemetryOwnerResolver
+        from ..rpc.channel import make_keepalive_session
+        self.telemetry_session = make_keepalive_session()
+        self.telemetry_owner = TelemetryOwnerResolver(self.coord, self.name)
+        self._telemetry_mode = agent_cfg.telemetry_mode
         # Pass the agent itself: cancel() fans out across replicas.
-        self.streamer = GenerationStreamer(self,
-                                           agent_cfg.generation_flush_ms)
+        self.streamer = GenerationStreamer(
+            self, agent_cfg.generation_flush_ms,
+            session=self.telemetry_session,
+            owner_fn=self.telemetry_owner
+            if self._telemetry_mode == "mux" else None)
         # Stamp sender identity on every delta: after a transparent
         # failover the service drops deltas from incarnations the request
         # is no longer bound to.
@@ -681,8 +773,16 @@ class EngineAgent:
                 if self.kv_transfer is not None:
                     self.kv_transfer.gc()   # free never-pulled KV offers
                 self.kv_stream.gc()         # ... and expired stream offers
-                master = self.coord.get(MASTER_KEY)
-                if not master:
+                # Sharded telemetry (ISSUE 15): beats go to the OWNING
+                # master under the rendezvous shard map, not the elected
+                # master — the elected master's ingest funnel was the
+                # next single-process ceiling. mode="master" keeps the
+                # legacy funnel for mixed-version fleets.
+                if self._telemetry_mode == "master":
+                    target = self.coord.get(MASTER_KEY) or ""
+                else:
+                    target = self.telemetry_owner()
+                if not target:
                     continue
                 stats = self.aggregate_stats()
                 ev = self.engines[0].drain_kv_events()
@@ -706,38 +806,97 @@ class EngineAgent:
                         "recent_max_tbt": max(t for _, t in drained),
                     },
                 }
-                # Binary heartbeat wire: KV-event block keys ride as raw
-                # 16-byte msgpack bins (half the bytes of hex, no codec on
-                # either end). A legacy master can't parse it and answers
-                # 400/415 — demote to the JSON form (hex keys) and re-send
-                # this delta so it isn't lost (heartbeat replay is
-                # idempotent: the index applies absolute tier moves).
-                self._note_master(master)
-                fmt = self._hb_wire
-                payload["kv_cache_event"] = (
-                    ev.to_wire_dict() if fmt == dispatch_wire.WIRE_MSGPACK
-                    else ev.to_dict())
-                body, ctype = dispatch_wire.encode_dispatch(payload, fmt)
-                r = _requests.post(f"http://{master}/rpc/heartbeat",
-                                   data=body,
-                                   headers={"Content-Type": ctype},
-                                   timeout=3)
-                ENGINE_HEARTBEATS_TOTAL.labels(master=master).inc()
-                if r.status_code in (400, 415) \
-                        and fmt == dispatch_wire.WIRE_MSGPACK:
-                    logger.warning(
-                        "master rejected msgpack heartbeat (%d); demoting "
-                        "to JSON wire", r.status_code)
-                    self._hb_wire = dispatch_wire.WIRE_JSON
-                    payload["kv_cache_event"] = ev.to_dict()
-                    body, ctype = dispatch_wire.encode_dispatch(
-                        payload, dispatch_wire.WIRE_JSON)
-                    _requests.post(f"http://{master}/rpc/heartbeat",
-                                   data=body,
-                                   headers={"Content-Type": ctype},
-                                   timeout=3)
+                if not self._post_heartbeat(target, payload, ev):
+                    # Owner unreachable mid-stream: the resolver excludes
+                    # it and the RENDEZVOUS SUCCESSOR gets this same beat
+                    # immediately — the takeover must not wait a full
+                    # interval or the new owner starts from silence.
+                    self.telemetry_owner.note_failure(target)
+                    successor = self.telemetry_owner() \
+                        if self._telemetry_mode != "master" else ""
+                    if successor and successor != target:
+                        self._post_heartbeat(successor, payload, ev)
             except Exception:  # noqa: BLE001
                 logger.exception("heartbeat failed")
+
+    def _post_heartbeat(self, target: str, payload: dict,
+                        ev) -> bool:
+        """One heartbeat delivery. mode="mux": a tagged frame on the
+        multiplexed telemetry session (shared keepalive connection with
+        the delta pushes); a legacy target (404) demotes this agent to
+        the per-endpoint wire. Legacy wire: msgpack with raw 16-byte
+        KV-event keys, demoted to JSON per master on 400/415 (re-sent —
+        heartbeat replay is idempotent: the index applies absolute tier
+        moves)."""
+        try:
+            self._note_master(target)
+            if self._telemetry_mode == "mux":
+                payload = dict(payload)
+                payload["kv_cache_event"] = ev.to_wire_dict()
+                body, ctype = dispatch_wire.encode_telemetry(
+                    [{"t": dispatch_wire.TELEMETRY_HB, "d": payload}])
+                r = self.telemetry_session.post(
+                    f"http://{target}/rpc/telemetry", data=body,
+                    headers={"Content-Type": ctype}, timeout=3)
+                ENGINE_HEARTBEATS_TOTAL.labels(master=target).inc()
+                if r.status_code not in (404, 405):
+                    if r.status_code == 200:
+                        self._adopt_owner_hint(r, target)
+                        return True
+                    return False
+                logger.warning("telemetry target %s lacks /rpc/telemetry; "
+                               "demoting agent to the legacy elected-"
+                               "master funnel", target)
+                # A 404 means a PRE-sharding master: in that fleet only
+                # the ELECTED master uploads load metrics from beats it
+                # ingests locally, so "owner" routing would strand our
+                # telemetry on a non-elected replica — go all the way
+                # back to the reference funnel (review catch).
+                self._telemetry_mode = "master"
+            fmt = self._hb_wire
+            payload = dict(payload)
+            payload["kv_cache_event"] = (
+                ev.to_wire_dict() if fmt == dispatch_wire.WIRE_MSGPACK
+                else ev.to_dict())
+            body, ctype = dispatch_wire.encode_dispatch(payload, fmt)
+            r = self.telemetry_session.post(
+                f"http://{target}/rpc/heartbeat", data=body,
+                headers={"Content-Type": ctype}, timeout=3)
+            ENGINE_HEARTBEATS_TOTAL.labels(master=target).inc()
+            if r.status_code in (400, 415) \
+                    and fmt == dispatch_wire.WIRE_MSGPACK:
+                logger.warning(
+                    "master rejected msgpack heartbeat (%d); demoting "
+                    "to JSON wire", r.status_code)
+                self._hb_wire = dispatch_wire.WIRE_JSON
+                payload["kv_cache_event"] = ev.to_dict()
+                body, ctype = dispatch_wire.encode_dispatch(
+                    payload, dispatch_wire.WIRE_JSON)
+                r = self.telemetry_session.post(
+                    f"http://{target}/rpc/heartbeat", data=body,
+                    headers={"Content-Type": ctype}, timeout=3)
+            if r.status_code == 200:
+                self._adopt_owner_hint(r, target)
+                return True
+            return False
+        except _requests.RequestException as e:
+            logger.warning("heartbeat to %s failed: %s", target, e)
+            return False
+
+    def _adopt_owner_hint(self, r, target: str) -> None:
+        """Heartbeat responses carry the receiving master's view of our
+        telemetry owner (`owner`): on a membership race our mirrored
+        resolution can lag the masters' — adopting the hint re-routes
+        the NEXT beat instead of waiting a resolver cache window out."""
+        if self._telemetry_mode == "master":
+            return
+        try:
+            owner = (r.json() or {}).get("owner", "")
+        except ValueError:
+            return
+        if owner and owner != target:
+            logger.info("telemetry owner hint: %s -> %s", target, owner)
+            self.telemetry_owner.pin(owner)
 
     def _note_master(self, master: str) -> None:
         """Track the heartbeat destination master. On a change
@@ -774,9 +933,24 @@ class EngineAgent:
     async def _h_health(self, req: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    def telemetry_stats(self) -> dict[str, Any]:
+        """Connection accounting for the multiplexed telemetry session —
+        the bench's O(engines) fan-out evidence (hosts = distinct master
+        pools this engine currently holds; mux mode keeps it at 1)."""
+        from ..rpc.channel import session_connection_stats
+
+        return {
+            "mode": self._telemetry_mode,
+            "owner": self.telemetry_owner() or "",
+            "mux_sends": self.streamer.mux_sends,
+            "direct_sends": self.streamer.direct_sends,
+            **session_connection_stats(self.telemetry_session),
+        }
+
     async def _h_stats(self, req: web.Request) -> web.Response:
         return web.json_response({
             **self.aggregate_stats(),
+            "telemetry": self.telemetry_stats(),
             "kv_transfer": {
                 "device_sent": self.kv_device_sent,
                 "host_sent": self.kv_host_sent,
@@ -847,6 +1021,18 @@ class EngineAgent:
             f"engine_dp_size {len(self.engines)}",
             "# TYPE engine_sarathi_rides_total counter",
             f"engine_sarathi_rides_total {st['sarathi_rides']}",
+        ]
+        tel = self.telemetry_stats()
+        lines += [
+            "# TYPE engine_telemetry_session_hosts gauge",
+            f"engine_telemetry_session_hosts {tel['hosts']}",
+            "# TYPE engine_telemetry_connections_created counter",
+            f"engine_telemetry_connections_created "
+            f"{tel['connections_created']}",
+            "# TYPE engine_telemetry_mux_sends_total counter",
+            f"engine_telemetry_mux_sends_total {tel['mux_sends']}",
+            "# TYPE engine_telemetry_direct_sends_total counter",
+            f"engine_telemetry_direct_sends_total {tel['direct_sends']}",
         ]
         tier = self._tier_stats()
         if tier:
@@ -1678,6 +1864,12 @@ def main() -> None:
     p.add_argument("--kv-tier-ssd-path", default="",
                    help="spill file path ('' = tempfile owned by the "
                         "store)")
+    p.add_argument("--telemetry-mode", default="mux",
+                   choices=["mux", "owner", "master"],
+                   help="mux = one multiplexed keepalive session to the "
+                        "owning master (tagged hb+gens frames); owner = "
+                        "heartbeats to the rendezvous owner, deltas "
+                        "direct; master = legacy elected-master funnel")
     args = p.parse_args()
 
     # Multi-host: join the process group (XLLM_MH_COORDINATOR /
@@ -1820,7 +2012,8 @@ def main() -> None:
                           model_id=args.model_id,
                           tokenizer_path=args.tokenizer_path,
                           generation_flush_ms=args.generation_flush_ms,
-                          dp_size=args.dp_size),
+                          dp_size=args.dp_size,
+                          telemetry_mode=args.telemetry_mode),
         params=params)
     agent.start()
     import signal as _signal

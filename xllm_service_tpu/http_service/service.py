@@ -38,14 +38,16 @@ from aiohttp import web
 
 from ..common import flightrecorder
 from ..common.flightrecorder import RECORDER
-from ..common.hotpath import HOTPATH
+from ..common.hotpath import CPU_ATTR, HOTPATH
 from ..common.metrics import (
     ADMISSION_PENDING_REQUESTS,
     AUTOSCALER_LAST_DECISION_AGE_SECONDS,
     BROWNOUT_ACTIVE,
     FLEET_SIZE,
+    HANDOFF_JOURNAL_REPLAYS_TOTAL,
     HANDOFF_SERVED_TOTAL,
     KVCACHE_FRAME_LOG_SEQ,
+    LOADINFO_AGE_SECONDS,
     LOADINFO_MAX_AGE_SECONDS,
     LOADINFO_STALE_INSTANCES,
     REGISTRY,
@@ -53,6 +55,7 @@ from ..common.metrics import (
     RETRY_BUDGET_TOKENS,
     ROUTING_SNAPSHOT_AGE_SECONDS,
     SERVER_REQUEST_IN_TOTAL,
+    TELEMETRY_GENS_RELAYED_TOTAL,
     relabel_prometheus_text,
 )
 from ..common.request import Request, RequestOutput, SamplingParams
@@ -60,7 +63,7 @@ from ..common.slo import SLO_MONITOR
 from ..common import tracing
 from ..common.tracing import TRACER, TraceContext, merge_fleet_spans, span_tree
 from ..common.types import InstanceType
-from ..multimaster.handoff import HandoffRelay
+from ..multimaster.handoff import DeltaJournal, HandoffRelay
 from ..overload import (
     ABS_DEADLINE_HEADER,
     ADMISSION,
@@ -200,11 +203,16 @@ class XllmHttpService:
         # here so they can't be garbage-collected mid-flight.
         self._forward_tasks: set[asyncio.Task] = set()
         # Multi-master: owner-forward path for the minority of requests
-        # this frontend accepts but does not own (multimaster/handoff.py).
+        # this frontend accepts but does not own (multimaster/handoff.py),
+        # plus the owner-side delta journal a relay reconnect replays
+        # from (exact dedup — no pipeline re-run under sampling).
+        self._journal = DeltaJournal(
+            grace_s=self.opts.handoff_journal_grace_s)
         self._relay = HandoffRelay(
             scheduler.ownership,
             max_attempts=self.opts.handoff_max_attempts,
-            stall_timeout_s=self.opts.handoff_stall_timeout_s)
+            stall_timeout_s=self.opts.handoff_stall_timeout_s,
+            same_owner_retry=self._journal.enabled)
 
     # ------------------------------------------------------------- HTTP app
     def build_http_app(self) -> web.Application:
@@ -247,9 +255,14 @@ class XllmHttpService:
         app = web.Application()
         app.router.add_post("/rpc/heartbeat", self.handle_heartbeat)
         app.router.add_post("/rpc/generations", self.handle_generations)
+        # Multiplexed engine telemetry session (ISSUE 15): ONE keepalive
+        # connection per engine carries tagged hb/gens frames to the
+        # engine's owning master; foreign-dest gens relay master->master.
+        app.router.add_post("/rpc/telemetry", self.handle_telemetry)
         # Multi-master plane: owner-side ingest of relayed requests, and
         # the replica→master write-lease proxy for PD-role flip hints.
         app.router.add_post("/rpc/handoff", self.handle_handoff)
+        app.router.add_post("/rpc/handoff_abort", self.handle_handoff_abort)
         app.router.add_post("/rpc/flip_hint", self.handle_flip_hint)
         app.router.add_get("/rpc/hello", self.handle_hello)
         app.router.add_get("/rpc/instance_info", self.handle_instance_info)
@@ -727,7 +740,13 @@ class XllmHttpService:
         self._forward_tasks.add(task)
         task.add_done_callback(self._forward_tasks.discard)
 
-        return await self._respond(http_req, req, conn)
+        # Owner-side delta journal for relayed streams: every emitted SSE
+        # data frame is teed into it so a relay reconnect (transport blip,
+        # accepting-frontend restart) replays the exact frames instead of
+        # re-running the generation.
+        journal = self._journal.start(sid) \
+            if handoff and req.stream else None
+        return await self._respond(http_req, req, conn, journal=journal)
 
     async def _forward_to_instance(self, req: Request, conn: AioConnection,
                                    path: str, payload: dict[str, Any],
@@ -784,7 +803,8 @@ class XllmHttpService:
 
     async def _respond(self, http_req: web.Request, req: Request,
                        conn: AioConnection,
-                       emit_done: bool = True) -> web.StreamResponse:
+                       emit_done: bool = True,
+                       journal=None) -> web.StreamResponse:
         timeout = self.opts.request_timeout_s
         if req.deadline_ms:
             # The client-side wait honors the per-request deadline (plus
@@ -802,35 +822,73 @@ class XllmHttpService:
             # else is already queued and flush ALL frames in one write()
             # — an engine delta batch (several tokens per Generations
             # POST) costs one event-loop write instead of one per chunk.
+            # With a `journal` (owner side of a relayed stream) every
+            # data frame is teed into it, and a broken downstream
+            # connection DETACHES instead of cancelling: deltas keep
+            # absorbing into the journal for the reconnect grace window
+            # so a relay retry replays the exact stream.
             dumps = json.dumps  # xlint: allow-hot-json(SSE frames are client-protocol JSON, not the negotiated dispatch wire)
             buf = bytearray()
             done = False
+            detached = False
+            detach_deadline = 0.0
             try:
                 while not done:
-                    tag, item = await asyncio.wait_for(conn.queue.get(),
-                                                       timeout)
+                    get_timeout = timeout
+                    if detached:
+                        # A reconnect (journal get) or an actively-
+                        # streaming replay (per-poll touch) extends the
+                        # grace: cancelling a generation whose frames a
+                        # reattached relay is mid-replay would truncate
+                        # the stream (review catch).
+                        extended = max(
+                            detach_deadline,
+                            journal.touched + self._journal.grace_s)
+                        remaining = extended - time.monotonic()
+                        if remaining <= 0:
+                            # Nobody (re)attached inside the grace
+                            # window: normal disconnect semantics from
+                            # here. Finish the journal so a late replay
+                            # drains what exists and exits instead of
+                            # polling to the request-timeout bound.
+                            DeltaJournal.finish(journal)
+                            conn.mark_disconnected()
+                            break
+                        get_timeout = min(timeout, remaining)
+                    try:
+                        tag, item = await asyncio.wait_for(conn.queue.get(),
+                                                           get_timeout)
+                    except asyncio.TimeoutError:
+                        if detached:
+                            continue   # re-check the grace window
+                        raise
                     while True:
+                        frame = b""
                         if AioConnection.is_finish(tag):
                             if emit_done:  # OpenAI framing; Anthropic streams
-                                buf += _DONE_FRAME
+                                frame = _DONE_FRAME
                             done = True
                         elif tag == "error":
                             code, msg = item
-                            buf += _DATA_PREFIX + dumps(
+                            frame = _DATA_PREFIX + dumps(
                                 {"error": {"message": msg, "code": code}},
                                 separators=_COMPACT).encode() + _FRAME_SEP
                             done = True
                         elif tag == "event":
                             name, obj = item
-                            buf += (f"event: {name}\n".encode()
-                                    + _DATA_PREFIX
-                                    + dumps(obj, ensure_ascii=False,
-                                            separators=_COMPACT).encode()
-                                    + _FRAME_SEP)
+                            frame = (f"event: {name}\n".encode()
+                                     + _DATA_PREFIX
+                                     + dumps(obj, ensure_ascii=False,
+                                             separators=_COMPACT).encode()
+                                     + _FRAME_SEP)
                         else:
-                            buf += _DATA_PREFIX + dumps(
+                            frame = _DATA_PREFIX + dumps(
                                 item, ensure_ascii=False,
                                 separators=_COMPACT).encode() + _FRAME_SEP
+                        if frame:
+                            buf += frame
+                            if journal is not None:
+                                DeltaJournal.record(journal, frame)
                         if done:
                             break
                         try:
@@ -838,8 +896,25 @@ class XllmHttpService:
                         except asyncio.QueueEmpty:
                             break
                     if buf:
-                        await resp.write(bytes(buf))
+                        if not detached:
+                            try:
+                                await resp.write(bytes(buf))
+                            except (ConnectionResetError, OSError):
+                                if journal is None:
+                                    raise
+                                detached = True
+                                detach_deadline = time.monotonic() + \
+                                    self._journal.grace_s
+                                logger.info(
+                                    "relay connection of %s broke after "
+                                    "%d journaled frames; absorbing "
+                                    "deltas for reconnect (%.1fs grace)",
+                                    req.service_request_id,
+                                    len(journal.frames),
+                                    self._journal.grace_s)
                         buf.clear()
+                if done and journal is not None:
+                    DeltaJournal.finish(journal)
             except asyncio.TimeoutError:
                 if await self._deadline_cancel(req):
                     # Surface the 504 in-band: frames may already be out.
@@ -854,8 +929,9 @@ class XllmHttpService:
             except asyncio.CancelledError:
                 conn.mark_disconnected()
                 raise
-            with contextlib.suppress(ConnectionResetError):
-                await resp.write_eof()
+            if not detached:
+                with contextlib.suppress(ConnectionResetError):
+                    await resp.write_eof()
             return resp
         # Non-stream.
         try:
@@ -949,6 +1025,11 @@ class XllmHttpService:
         LOADINFO_MAX_AGE_SECONDS.set(
             -1.0 if any(a < 0 for a in ages.values())
             else max(ages.values(), default=0.0))
+        for name, age in ages.items():
+            # Per-instance snapshot age (ISSUE 15 satellite): the exact
+            # staleness SLO/CAR scoring discounts by. Series ride the
+            # live load-info view; deregistration evicts them.
+            LOADINFO_AGE_SECONDS.labels(instance=name).set(age)
         LOADINFO_STALE_INSTANCES.set(len(mgr.stale_load_names()))
         KVCACHE_FRAME_LOG_SEQ.set(
             self.scheduler.kvcache_mgr.frame_log_seq())
@@ -1208,7 +1289,16 @@ class XllmHttpService:
         mgr = self.scheduler.instance_mgr
         return web.json_response({
             "stages": HOTPATH.summary(),
+            # Per-category CPU attribution (ingest = heartbeat/telemetry,
+            # route = schedule, stream = delta ingest): the bench's
+            # ingest-share evidence for the sharded telemetry plane.
+            "cpu": CPU_ATTR.summary(),
             "ownership": self.scheduler.ownership.stats(),
+            # Telemetry-ingest shard map + frame-log progress + the
+            # per-instance load-info snapshot ages (ISSUE 15 satellite:
+            # observable, not inferred).
+            "telemetry": mgr.stats(),
+            "handoff_journal": self._journal.stats(),
             "snapshot_age_s": mgr.snapshot_age_s(),
             "frame_log_seq": self.scheduler.kvcache_mgr.frame_log_seq(),
             "loadinfo": {
@@ -1294,6 +1384,24 @@ class XllmHttpService:
         kind = request.query.get("kind", "completion")
         if not sid:
             return _error_response(400, "missing sid")
+        try:
+            attempt = int(request.query.get("attempt", 0))
+            skip = int(request.query.get("skip", 0))
+        except (TypeError, ValueError):
+            attempt, skip = 0, 0
+        if attempt > 0:
+            # Relay reconnect: if THIS owner journaled the stream (the
+            # relay retries the same owner first), replay the exact
+            # recorded frames after `skip` — no pipeline re-run, so the
+            # continuation is identical even under temperature>0
+            # sampling. No journal (we are the rendezvous successor of a
+            # dead owner) → fall through to the legacy full re-run with
+            # relay-side frame dropping.
+            entry = self._journal.get(sid)
+            if entry is not None:
+                HANDOFF_JOURNAL_REPLAYS_TOTAL.inc()
+                return await self._replay_from_journal(request, sid, skip,
+                                                       entry)
         HANDOFF_SERVED_TOTAL.inc()
         # The relay forwards the ABSOLUTE deadline it computed at accept
         # (x-xllm-deadline-ms) so the owner enforces the original
@@ -1309,6 +1417,57 @@ class XllmHttpService:
             return _error_response(400, f"unknown handoff kind {kind}")
         return await self._handle_generate(request, kind, sid=sid,
                                            deadline_override=deadline_ms)
+
+    async def _replay_from_journal(self, http_req: web.Request, sid: str,
+                                   skip: int, entry) -> web.StreamResponse:
+        """Serve a relay reconnect from the delta journal: stream the
+        recorded frames after ``skip``, then follow the LIVE journal
+        growth (the original generation keeps appending while detached)
+        until the stream finishes. Pure frame copy — the engine sees
+        nothing."""
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "text/event-stream"
+        resp.headers["Cache-Control"] = "no-cache"
+        await resp.prepare(http_req)
+        i = max(0, skip)
+        deadline = time.monotonic() + self.opts.request_timeout_s
+        try:
+            while True:
+                # Keep the journal (and the detached generation's grace
+                # window) alive while this replay is attached: the
+                # detached _respond loop extends its deadline off
+                # `touched`, so an active replay is never cancelled
+                # under it mid-stream.
+                entry.touched = time.monotonic()
+                frames = entry.frames
+                while i < len(frames):
+                    await resp.write(frames[i])
+                    i += 1
+                if entry.finished and i >= len(entry.frames):
+                    break
+                if time.monotonic() > deadline:
+                    break
+                await asyncio.sleep(0.02)
+            await resp.write_eof()
+        except (ConnectionResetError, OSError):
+            pass   # the relay broke again; its next attempt re-enters here
+        return resp
+
+    async def handle_handoff_abort(self, request: web.Request) -> web.Response:
+        """Relay-signalled CLIENT abort of a relayed stream: the journal
+        grace exists for transport blips, but a gone client must cancel
+        NOW (engine capacity, exit accounting) — the relay distinguishes
+        the two, this endpoint enacts it. Idempotent; unknown sids ack."""
+        sid = request.query.get("sid", "")
+        if not sid:
+            return _error_response(400, "missing sid")
+        entry = self._journal.get(sid)
+        if entry is not None:
+            DeltaJournal.finish(entry)
+        cancelled = await asyncio.get_running_loop().run_in_executor(
+            None, self.scheduler.cancel_request, sid, 499,
+            "client disconnected at relay", "disconnect")
+        return web.json_response({"ok": True, "cancelled": cancelled})
 
     async def handle_flip_hint(self, request: web.Request) -> web.Response:
         """Replica→master write-lease proxy for PD-role flips: a
@@ -1347,7 +1506,117 @@ class XllmHttpService:
             return _error_response(400, "invalid payload")
         known = await asyncio.get_running_loop().run_in_executor(
             None, self.scheduler.handle_instance_heartbeat, payload)
-        return web.json_response({"ok": True, "known": known})
+        resp: dict[str, Any] = {"ok": True, "known": known}
+        owner = self.scheduler.instance_mgr.telemetry_owner_addr(
+            payload.get("name", ""))
+        if owner:
+            # Sharded ingest: tell the engine who owns its telemetry so
+            # a beat that landed here on a membership race re-routes.
+            resp["owner"] = owner
+        return web.json_response(resp)
+
+    def _ingest_gens_batch(self, gens: list) -> dict[str, bool]:
+        """Shared Generations-delta ingest (direct POSTs and multiplexed
+        telemetry frames): parse + dispatch the whole batch in one go,
+        measured into the `stream` CPU-attribution bucket."""
+        with CPU_ATTR.measure("stream"):
+            results: dict[str, bool] = {}
+            for gen in gens:
+                out = RequestOutput.from_dict(gen)
+                results[out.service_request_id] = \
+                    self.scheduler.handle_generation(out)
+            return results
+
+    async def handle_telemetry(self, request: web.Request) -> web.Response:
+        """Multiplexed engine telemetry session (ISSUE 15): tagged
+        msgpack frames on ONE keepalive connection per engine, routed to
+        the engine's owning master. "hb" frames ingest like
+        /rpc/heartbeat; "gens" frames carry a `dest` service address —
+        ingested here when dest is us, relayed master->master otherwise
+        (the fan-out the engine no longer pays: per-engine connections
+        stay O(1) while masters scale). Responses carry per-dest
+        delivery verdicts so the engine's per-dest retry/cancel
+        machinery keeps working unchanged."""
+        body = await request.read()
+        try:
+            payload = wire.decode_body(request.content_type, body)
+        except ValueError:
+            return _error_response(400, "invalid payload")
+        frames = payload.get("frames") if isinstance(payload, dict) else None
+        if not isinstance(frames, list):
+            return _error_response(400, "invalid payload: frames required")
+        loop = asyncio.get_running_loop()
+        self_addr = self.scheduler.self_addr
+        alive: dict[str, bool] = {}
+        dest_ok: dict[str, bool] = {}
+        out: dict[str, Any] = {"ok": True}
+        relays: list = []
+        for fr in frames:
+            if not isinstance(fr, dict):
+                continue
+            tag = fr.get("t")
+            if tag == wire.TELEMETRY_HB:
+                hb = fr.get("d") or {}
+                out["known"] = await loop.run_in_executor(
+                    None, self.scheduler.handle_instance_heartbeat, hb)
+                owner = self.scheduler.instance_mgr.telemetry_owner_addr(
+                    hb.get("name", ""))
+                if not owner and \
+                        not self.scheduler.instance_mgr.sharded():
+                    # A mux beat landed on a master-mode (funnel)
+                    # service: in that fleet only the ELECTED master
+                    # uploads load metrics from locally-ingested beats,
+                    # so hint the engine there — otherwise its beats
+                    # strand telemetry on whichever replica the
+                    # rendezvous map picked (mixed-config hazard).
+                    owner = await loop.run_in_executor(
+                        None, self.scheduler.elected_master_addr)
+                if owner:
+                    out["owner"] = owner
+            elif tag == wire.TELEMETRY_GENS:
+                dest = fr.get("dest") or self_addr
+                gens = (fr.get("d") or {}).get("gens", [])
+                if dest == self_addr:
+                    if len(gens) <= 32:
+                        results = self._ingest_gens_batch(gens)
+                    else:
+                        results = await loop.run_in_executor(
+                            None, self._ingest_gens_batch, gens)
+                    alive.update(results)
+                    dest_ok[dest] = True
+                else:
+                    relays.append(self._relay_gens(dest, gens))
+        for dest, ok, dest_alive in await asyncio.gather(*relays):
+            dest_ok[dest] = ok
+            alive.update(dest_alive)
+        out["alive"] = alive
+        out["dest_ok"] = dest_ok
+        return web.json_response(out)
+
+    async def _relay_gens(self, dest: str,
+                          gens: list) -> tuple[str, bool, dict]:
+        """Master->master relay of a foreign-dest generation batch (the
+        owner-side half of the multiplexed engine session). Keepalive
+        pooled connections via the shared aiohttp client; a failed relay
+        reports dest_ok=False so the ENGINE keeps those frames queued
+        and retries — the relay itself never re-sends (delta dedup
+        belongs to the per-request seq numbers)."""
+        assert self._client is not None
+        TELEMETRY_GENS_RELAYED_TOTAL.labels(dest=dest).inc()
+        data, ctype = wire.encode_dispatch({"gens": gens},
+                                           wire.WIRE_MSGPACK)
+        try:
+            async with self._client.post(
+                    f"http://{dest}/rpc/generations", data=data,
+                    headers={"Content-Type": ctype},
+                    timeout=aiohttp.ClientTimeout(total=10)) as r:
+                if r.status != 200:
+                    return dest, False, {}
+                payload = await r.json(content_type=None)
+                return dest, True, dict(payload.get("alive") or {})
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                ValueError):
+            return dest, False, {}
 
     async def handle_generations(self, request: web.Request) -> web.Response:
         """Batched generation deltas (reference `Generations` RPC,
@@ -1368,26 +1637,18 @@ class XllmHttpService:
         if not isinstance(payload, dict):
             return _error_response(400, "invalid payload")
 
-        def ingest_batch() -> dict[str, bool]:
-            results: dict[str, bool] = {}
-            for gen in payload.get("gens", ()):
-                out = RequestOutput.from_dict(gen)
-                results[out.service_request_id] = \
-                    self.scheduler.handle_generation(out)
-            return results
-
-        gens = payload.get("gens", ())
+        gens = list(payload.get("gens", ()))
         if len(gens) <= 32:
             # Small batch: ingest inline. handle_generation is dict work
             # under a short lock hold (formatting/SSE rides the output
             # lanes, not this handler) — an executor hop per batch costs
             # a thread wake on the first-token path for no protection.
-            results = ingest_batch()
+            results = self._ingest_gens_batch(gens)
         else:
             # Big batch (engine catch-up after a stall): keep the loop
             # responsive, take the one executor hop.
             results = await asyncio.get_running_loop().run_in_executor(
-                None, ingest_batch)
+                None, self._ingest_gens_batch, gens)
         return web.json_response({"ok": True, "alive": results})
 
     async def handle_instance_info(self, request: web.Request) -> web.Response:

@@ -14,16 +14,34 @@ that: every service replica is an active frontend. The pieces:
   accepting frontend does not own: relay the client call to the owner's
   `/rpc/handoff` endpoint and stream the response back, with
   deterministic re-ownership (re-forward to the rendezvous successor)
-  when the owner dies mid-stream.
+  when the owner dies mid-stream, and a seq-numbered owner-side delta
+  journal so a reconnect to a surviving owner replays the exact frames
+  already generated instead of re-running the stream.
+- telemetry-ingest sharding (``telemetry_owner`` + the InstanceMgr
+  sharded-ingest plane): each active master ingests heartbeats/load only
+  for the instances it owns under the rendezvous shard map, and
+  publishes coalesced load/lease frames (`XLLM:LOADFRAME:<owner>`) that
+  every other frontend mirrors — the elected master's ingest funnel
+  (the single-process ceiling NOTES_ROUND8 measured at ~40% CPU) is
+  spread 1/N across the plane.
 
 Write-lease discipline: mutating coordination writes (KV frame
 publishing, load-metric uploads, planner hints, PD-role flips, instance
 eviction records) stay funneled through the *elected* master so the
 PR-5 frame-log invariants hold; replicas proxy their flip hints to the
-master (`/rpc/flip_hint`) instead of writing themselves. See
+master (`/rpc/flip_hint`) instead of writing themselves. Telemetry
+load frames are the one deliberate exception: each frame key is
+single-writer by construction (the key IS the owner's address), so
+sharded publication cannot conflict with the lease. See
 docs/multi_master.md.
 """
 
-from .ownership import OwnershipRouter
+from .ownership import (
+    OwnershipRouter,
+    TelemetryOwnerResolver,
+    rendezvous_owner,
+    telemetry_owner,
+)
 
-__all__ = ["OwnershipRouter"]
+__all__ = ["OwnershipRouter", "TelemetryOwnerResolver", "rendezvous_owner",
+           "telemetry_owner"]

@@ -42,6 +42,7 @@ from ..common.metrics import (
     HANDOFF_RECOVERIES_TOTAL,
 )
 from ..common.tracing import TRACER
+from ..devtools.locks import make_lock
 from ..overload import RETRY_BUDGET
 from ..overload.deadline import ABS_DEADLINE_HEADER, PRIORITY_HEADER
 from ..utils import get_logger
@@ -50,6 +51,98 @@ from .ownership import OwnershipRouter
 logger = get_logger(__name__)
 
 _DATA_PREFIX = b"data: "
+
+
+class _JournalEntry:
+    """One relayed stream's journaled SSE data frames. ``frames`` is
+    append-only (writer: the owner's SSE emit loop; readers: replay
+    handlers polling length under the GIL); ``finished`` flips once,
+    after the last frame."""
+
+    __slots__ = ("frames", "finished", "created", "touched")
+
+    def __init__(self, now: float):
+        self.frames: list[bytes] = []
+        self.finished = False
+        self.created = now
+        self.touched = now
+
+
+class DeltaJournal:
+    """Owner-side seq-numbered delta journal for relayed streams
+    (NOTES_ROUND8 follow-up). The old recovery contract re-ran the whole
+    pipeline on the replacement owner and dropped ``skip`` frames of the
+    NEW stream — exact only if streams are reproducible, which
+    temperature>0 sampling breaks (the relay would splice a divergent
+    continuation). With the journal, the owner records every SSE data
+    frame it emits for a relayed request (frame index IS the seq — the
+    same count the relay's ``skip`` uses), keeps absorbing engine deltas
+    for ``grace_s`` after the relay's connection breaks instead of
+    cancelling, and serves a reconnect (same sid, ``skip=N``) the EXACT
+    recorded frames ``N:`` — no re-run, no splice risk. The relay's
+    first recovery attempt retries the SAME owner to hit this path; a
+    genuinely dead owner fails that attempt fast (RST) and recovery
+    falls back to the rendezvous successor with the legacy
+    reproducible-stream contract."""
+
+    def __init__(self, grace_s: float = 10.0, max_requests: int = 256,
+                 ttl_s: float = 120.0):
+        self.grace_s = grace_s
+        self.max_requests = max_requests
+        self.ttl_s = ttl_s
+        self._lock = make_lock("multimaster.journal", order=30)  # lock-order: 30
+        self._entries: dict[str, _JournalEntry] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.grace_s > 0
+
+    def start(self, sid: str) -> Optional[_JournalEntry]:
+        """Open (or resume) the journal for a relayed stream; returns
+        None when journaling is disabled or the table is full (the
+        stream still serves — it just loses exact-replay recovery)."""
+        if not self.enabled:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            self._gc_locked(now)
+            entry = self._entries.get(sid)
+            if entry is None:
+                if len(self._entries) >= self.max_requests:
+                    return None
+                entry = self._entries[sid] = _JournalEntry(now)
+            return entry
+
+    def get(self, sid: str) -> Optional[_JournalEntry]:
+        with self._lock:
+            entry = self._entries.get(sid)
+            if entry is not None:
+                entry.touched = time.monotonic()
+            return entry
+
+    @staticmethod
+    def record(entry: Optional[_JournalEntry], frame: bytes) -> None:
+        """Tee one emitted SSE frame (only ``data:`` frames — the exact
+        set the relay's delivered-frame counter increments on, so
+        journal index == relay skip)."""
+        if entry is not None and frame.startswith(_DATA_PREFIX):
+            entry.frames.append(frame)
+
+    @staticmethod
+    def finish(entry: Optional[_JournalEntry]) -> None:
+        if entry is not None:
+            entry.finished = True
+
+    def _gc_locked(self, now: float) -> None:
+        dead = [sid for sid, e in self._entries.items()
+                if now - e.touched > self.ttl_s]
+        for sid in dead:
+            del self._entries[sid]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "grace_s": self.grace_s}
 
 
 def _passthrough_headers(r) -> dict[str, str]:
@@ -64,7 +157,8 @@ class HandoffRelay:
     """Relays one frontend's foreign-owned requests to their owners."""
 
     def __init__(self, ownership: OwnershipRouter, max_attempts: int = 3,
-                 stall_timeout_s: float = 60.0):
+                 stall_timeout_s: float = 60.0,
+                 same_owner_retry: bool = True):
         self._ownership = ownership
         self.max_attempts = max(1, max_attempts)
         # Read deadline per response chunk: a killed-but-not-closed owner
@@ -72,6 +166,11 @@ class HandoffRelay:
         # open and silent — without this the relay would stall forever
         # instead of re-owning. Found by the kill-the-owner chaos drill.
         self.stall_timeout_s = stall_timeout_s
+        # First stream recovery retries the SAME owner before excluding
+        # it: a transport blip against a LIVE owner hits its delta
+        # journal (exact frame replay, no pipeline re-run); a dead owner
+        # fails the retry fast and the next attempt re-owns as before.
+        self.same_owner_retry = same_owner_retry
 
     def _url(self, owner: str, kind: str, sid: str) -> str:
         return f"http://{owner}/rpc/handoff?kind={kind}&sid={sid}"
@@ -179,8 +278,20 @@ class HandoffRelay:
                 if not RETRY_BUDGET.try_spend():
                     last_err = f"{last_err} (retry budget exhausted)"
                     break
-                owner = self._recover(owner, failed, owner_key, sid, span)
-                HANDOFF_RECOVERIES_TOTAL.labels(owner=owner).inc()
+                if attempt == 1 and self.same_owner_retry \
+                        and owner not in failed:
+                    # Same-owner-first: a live owner serves the reconnect
+                    # from its delta journal — the exact frames already
+                    # generated, no re-run (exact dedup even under
+                    # temperature>0 sampling). A dead owner RSTs this
+                    # attempt immediately and the next one re-owns.
+                    logger.info("retrying %s against the same owner %s "
+                                "(journal reconnect, %d frames delivered)",
+                                sid, owner, delivered)
+                else:
+                    owner = self._recover(owner, failed, owner_key, sid,
+                                          span)
+                    HANDOFF_RECOVERIES_TOTAL.labels(owner=owner).inc()
             url = (self._url(owner, kind, sid)
                    + f"&attempt={attempt}&skip={delivered}")
             skip = delivered
@@ -227,6 +338,7 @@ class HandoffRelay:
                         # triggers its mark_disconnected →
                         # _cancel_on_engines chain).
                         r.close()
+                        await self._abort_owner(client, owner, sid)
                         return resp
                     async for frame in self._frames(r.content):
                         if frame.startswith(_DATA_PREFIX) and skip > 0:
@@ -246,6 +358,7 @@ class HandoffRelay:
                             # completion, burning engine tokens for a
                             # client that is gone.
                             r.close()
+                            await self._abort_owner(client, owner, sid)
                             return resp
                         if frame.startswith(_DATA_PREFIX):
                             delivered += 1
@@ -256,7 +369,11 @@ class HandoffRelay:
                     return resp
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 last_err = e
-                failed.append(owner)
+                if not (self.same_owner_retry and attempt == 0):
+                    # First break with same-owner retry armed: keep the
+                    # owner out of `failed` so the journal-reconnect
+                    # attempt targets it; a second break condemns it.
+                    failed.append(owner)
                 logger.warning("handoff stream of %s via %s broke after "
                                "%d frames: %s", sid, owner, delivered, e)
         # Recovery budget exhausted mid-stream: surface in-band.
@@ -275,6 +392,24 @@ class HandoffRelay:
             {"error": {"message": f"request owner unreachable: {last_err}",
                        "type": "service_unavailable", "code": 503}},
             status=503)
+
+    @staticmethod
+    async def _abort_owner(client: aiohttp.ClientSession, owner: str,
+                           sid: str) -> None:
+        """Tell the owner the CLIENT is gone (not just the relay
+        transport): with the delta journal armed, a bare connection
+        break makes the owner absorb deltas for the reconnect grace
+        window — correct for a blip, wasted engine tokens for a real
+        client abort. This explicit signal finishes the journal and
+        cancels the request immediately. Best effort: a legacy owner
+        404s and falls back to the grace-expiry cancel."""
+        try:
+            async with client.post(
+                    f"http://{owner}/rpc/handoff_abort?sid={sid}",
+                    timeout=aiohttp.ClientTimeout(total=2)) as r:
+                await r.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            pass
 
     @staticmethod
     async def _frames(content: aiohttp.StreamReader):

@@ -44,6 +44,112 @@ logger = get_logger(__name__)
 #: under 2%; a miss just means this request pays one handoff hop.
 MINE_TRIES = 32
 
+#: Telemetry-ingest shard salt: heartbeat/LOADMETRICS ownership of an
+#: instance hashes ``<member>|hb|<instance name>`` so the telemetry shard
+#: map is independent of (but as deterministic as) request ownership.
+#: Engines compute the same owner from the mirrored SERVICE membership —
+#: the salt is the one constant both sides must share.
+TELEMETRY_SALT = "hb"
+
+
+def _rendezvous_score(member: str, key: str) -> int:
+    return int.from_bytes(
+        blake2b(f"{member}|{key}".encode(), digest_size=8).digest(), "big")
+
+
+def rendezvous_owner(members: Iterable[str], key: str,
+                     exclude: Iterable[str] = ()) -> str:
+    """Highest-random-weight owner of ``key`` over ``members`` (""
+    when no member survives ``exclude``). Module-level so the ENGINE
+    side (agent heartbeat routing, fake engine) resolves the same owner
+    from a mirrored member list without an OwnershipRouter instance;
+    ``exclude`` is the deterministic-successor rule the handoff relay
+    uses (multimaster/handoff.py ``_recover``)."""
+    excluded = set(exclude)
+    best, best_score = "", -1
+    for m in members:
+        if m in excluded:
+            continue
+        s = _rendezvous_score(m, key)
+        if s > best_score:
+            best, best_score = m, s
+    return best
+
+
+def telemetry_owner(members: Iterable[str], instance_name: str,
+                    exclude: Iterable[str] = ()) -> str:
+    """The master that owns an instance's heartbeat/load ingest under
+    the telemetry shard map ("" when no members survive)."""
+    return rendezvous_owner(members, f"{TELEMETRY_SALT}|{instance_name}",
+                            exclude)
+
+
+class TelemetryOwnerResolver:
+    """ENGINE-side owner resolution for the multiplexed telemetry
+    session: polls the SERVICE membership (cached — one get_prefix per
+    ``cache_s``, amortized across every heartbeat and delta flush),
+    applies the shared rendezvous map to this instance's name, and
+    honors observed-dead exclusions (`note_failure`) until membership
+    catches up — the engine-side mirror of the handoff relay's
+    deterministic-successor recovery. Falls back to the elected master
+    when no membership records exist (legacy / bootstrap).
+
+    Thread contract: called from the heartbeat thread and the streamer
+    thread; all state updates are single-assignment tuple/dict stores
+    (GIL-atomic), and a stale cached answer is self-correcting within
+    one cache window."""
+
+    FAILURE_TTL_S = 10.0
+
+    def __init__(self, coord, instance_name: str, cache_s: float = 2.0):
+        self._coord = coord
+        self._name = instance_name
+        self._cache_s = cache_s
+        self._cached: tuple[str, float] = ("", 0.0)
+        self._failed: dict[str, float] = {}
+
+    def __call__(self) -> str:
+        import time
+
+        now = time.monotonic()
+        owner, expires = self._cached
+        if owner and now < expires:
+            return owner
+        try:
+            members = [k[len(SERVICE_KEY_PREFIX):]
+                       for k in self._coord.get_prefix(SERVICE_KEY_PREFIX)
+                       if k != MASTER_KEY]
+        except Exception:  # noqa: BLE001  # xlint: allow-broad-except(a coordination blip degrades to the cached/master fallback; the next window re-resolves)
+            members = []
+        exclude = {o for o, ts in self._failed.items()
+                   if now - ts < self.FAILURE_TTL_S}
+        owner = telemetry_owner(members, self._name, exclude)
+        if not owner:
+            try:
+                owner = self._coord.get(MASTER_KEY) or ""
+            except Exception:  # noqa: BLE001  # xlint: allow-broad-except(same degradation contract as the membership read above)
+                owner = ""
+        self._cached = (owner, now + self._cache_s)
+        return owner
+
+    def note_failure(self, owner: str) -> None:
+        """The caller observed this owner dead (connect/POST failure):
+        exclude it and drop the cache so the next resolution lands on
+        the rendezvous successor immediately."""
+        import time
+
+        self._failed[owner] = time.monotonic()
+        self._cached = ("", 0.0)
+
+    def pin(self, owner: str) -> None:
+        """A master answered a beat with an authoritative `owner` hint
+        (its view of the shard map — fresher than our mirrored
+        membership on a race): adopt it for one cache window."""
+        import time
+
+        if owner:
+            self._cached = (owner, time.monotonic() + self._cache_s)
+
 
 @_ownership.verify_state
 class OwnershipRouter:
@@ -114,11 +220,7 @@ class OwnershipRouter:
         return self._members
 
     # ------------------------------------------------------------- ownership
-    @staticmethod
-    def _score(member: str, key: str) -> int:
-        return int.from_bytes(
-            blake2b(f"{member}|{key}".encode(), digest_size=8).digest(),
-            "big")
+    _score = staticmethod(_rendezvous_score)
 
     def owner_of(self, key: str,
                  exclude: Iterable[str] = ()) -> str:
@@ -146,6 +248,22 @@ class OwnershipRouter:
 
     def is_self(self, key: str, exclude: Iterable[str] = ()) -> bool:
         return self.owner_of(key, exclude) == self.self_addr
+
+    # ---------------------------------------------------- telemetry shard map
+    def instance_owner(self, instance_name: str,
+                       exclude: Iterable[str] = ()) -> str:
+        """The master owning an instance's heartbeat/load ingest
+        (telemetry shard map; falls back to self when ownership is
+        disabled or the plane is empty). Lock-free: one read of the
+        published member tuple."""
+        if not self.enabled:
+            return self.self_addr
+        return telemetry_owner(self._members, instance_name,
+                               exclude) or self.self_addr
+
+    def owns_instance(self, instance_name: str) -> bool:
+        """Does THIS master own the instance's telemetry ingest?"""
+        return self.instance_owner(instance_name) == self.self_addr
 
     def mine(self, kind: str,
              gen: Optional[Callable[[str], str]] = None) -> tuple[str, str]:

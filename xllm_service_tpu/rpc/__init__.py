@@ -25,6 +25,12 @@ CACHE_KEY_PREFIX = "XLLM:CACHE:"             # + block-hash hex (legacy)
 # AND legacy per-block keys ("FRAME:" cannot collide with hex).
 CACHE_FRAME_KEY_PREFIX = CACHE_KEY_PREFIX + "FRAME:"  # + %020d seq
 LOADMETRICS_KEY_PREFIX = "XLLM:LOADMETRICS:"  # + instance name
+# Sharded telemetry-ingest plane (multimaster): ONE coalesced load/lease
+# frame key per OWNING master (rpc/wire.py encode_load_frame), rewritten
+# in place each sync tick — the key is the owner's address, so each key
+# is single-writer by construction and "latest frame per owner" is the
+# whole convergence story (no log growth, no compaction).
+LOADFRAME_KEY_PREFIX = "XLLM:LOADFRAME:"      # + owner rpc addr
 
 
 def instance_key(type_str: str, name: str) -> str:

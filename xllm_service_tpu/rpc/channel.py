@@ -88,6 +88,42 @@ class _KeepaliveAdapter(HTTPAdapter):
         return super().init_poolmanager(*args, **kwargs)
 
 
+def make_keepalive_session(pool_connections: int = 4,
+                           pool_maxsize: int = 4) -> requests.Session:
+    """A shared, BOUNDED keepalive session: one connection pool for every
+    telemetry hop a process makes (heartbeats + generation-delta pushes),
+    instead of one adapter pool per destination plus a fresh TCP connect
+    per bare ``requests.post``. ``pool_connections`` bounds how many
+    per-host pools are cached (LRU — a master that stopped being a
+    destination ages out), ``pool_maxsize`` bounds sockets per host.
+    The multiplexed engine telemetry session (ISSUE 15) is one of these
+    with all traffic routed at the engine's owning master, so the
+    per-engine connection count stays O(1) regardless of ``--masters``."""
+    s = requests.Session()
+    s.mount("http://", _KeepaliveAdapter(pool_connections=pool_connections,
+                                         pool_maxsize=pool_maxsize))
+    return s
+
+
+def session_connection_stats(session: requests.Session) -> dict:
+    """Live connection accounting for a session built by
+    :func:`make_keepalive_session` — the bench's engine-side
+    connection-count evidence. ``hosts`` = distinct destination pools
+    currently cached; ``connections_created`` = TCP connects ever made
+    across them (urllib3's per-pool counter)."""
+    try:
+        pools = session.get_adapter("http://").poolmanager.pools
+        # urllib3's RecentlyUsedContainer: values() under its own lock.
+        host_pools = list(pools._container.values())  # noqa: SLF001
+        return {
+            "hosts": len(host_pools),
+            "connections_created": sum(
+                getattr(p, "num_connections", 0) for p in host_pools),
+        }
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(urllib3 pool internals are version-dependent; accounting degrades to -1 sentinels rather than breaking telemetry)
+        return {"hosts": -1, "connections_created": -1}
+
+
 @_ownership.verify_state
 class EngineChannel:
     def __init__(self, name: str, base_url: Optional[str] = None,

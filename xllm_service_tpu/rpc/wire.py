@@ -56,6 +56,9 @@ HOT_PATH_FUNCTIONS: dict[str, str] = {
         "initial engine dispatch from the HTTP frontend",
     "XllmHttpService.handle_generations":
         "token-return ingest (hottest service endpoint)",
+    "XllmHttpService.handle_telemetry":
+        "multiplexed engine telemetry ingest (tagged hb/gens frames + "
+        "master->master gens relay)",
     "XllmHttpService._respond":
         "SSE emit loop (client-facing frames are protocol JSON)",
     "Scheduler._failover_loop":
@@ -191,6 +194,71 @@ def decode_kv_frame(value: str) -> "tuple[dict[bytes, Any], list[bytes], bool]":
     except Exception as e:  # base64/msgpack raise library-specific errors
         raise ValueError(f"malformed kv frame: {e}") from None
     return upserts, removals, bool(frame.get("full"))
+
+
+# ------------------------------------------------------------- load frames
+#
+# Sharded telemetry ingest (multimaster): each active master coalesces
+# the heartbeat-fed load/latency/lease state of the instances it OWNS
+# (rendezvous shard map) into one frame per sync tick, written to its own
+# `XLLM:LOADFRAME:<owner addr>` key. Every other frontend mirrors the
+# frame — the elected master's per-instance LOADMETRICS upload funnel is
+# replaced by N single-writer keys. base64(msgpack), like the KV frames:
+# coordination values are strings on every backend.
+
+def encode_load_frame(instances: dict, gone: "dict[str, str]", seq: int,
+                      now_ms: int) -> str:
+    """One owner's full telemetry shard: ``instances`` maps instance
+    name → {"l": load dict, "y": latency dict, "hb": last-heartbeat ms,
+    "up": telemetry-updated ms, "st": runtime-state value}; ``gone``
+    maps recently-evicted owned instances to the eviction reason
+    (tombstones — mirrors deregister with the same reason, so a
+    mirrored graceful drain doesn't count as an eviction); ``now_ms``
+    is the owner's clock at build time so mirrors can re-base
+    heartbeat/telemetry ages without cross-host clock agreement."""
+    return base64.b64encode(msgpack.packb(
+        {"i": instances, "g": dict(gone), "s": seq, "ms": now_ms},
+        use_bin_type=True)).decode("ascii")
+
+
+def decode_load_frame(value: str) -> dict:
+    """Inverse of :func:`encode_load_frame` → {"i": ..., "g": [...],
+    "s": seq, "ms": build ms}. Raises ValueError on a malformed frame
+    (callers skip it)."""
+    try:
+        frame = msgpack.unpackb(base64.b64decode(value), raw=False)
+        if not isinstance(frame, dict) or not isinstance(
+                frame.get("i", {}), dict):
+            raise TypeError("load frame is not a map")
+    except Exception as e:  # base64/msgpack raise library-specific errors
+        raise ValueError(f"malformed load frame: {e}") from None
+    frame.setdefault("i", {})
+    frame.setdefault("g", {})
+    return frame
+
+
+# --------------------------------------------------------- telemetry frames
+#
+# Multiplexed engine telemetry session: ONE keepalive session per engine
+# carries tagged frames to the engine's OWNING master (`/rpc/telemetry`)
+# — heartbeats ("hb") ingested there, generation-delta batches ("gens")
+# ingested when the tagged dest is the owner itself and relayed
+# master→master otherwise — so engine-side fan-out is O(engines), not
+# O(engines × masters).
+
+TELEMETRY_HB = "hb"
+TELEMETRY_GENS = "gens"
+
+
+def encode_telemetry(frames: "list[dict]") -> tuple[bytes, str]:
+    """Tagged telemetry frames for one POST: each frame is
+    {"t": "hb", "d": heartbeat payload} or {"t": "gens",
+    "dest": service addr, "d": {"gens": [...]}}. Always msgpack — the
+    endpoint is new, so there is no legacy-JSON peer to negotiate with
+    (an old master answers 404 and the engine falls back to the legacy
+    wires)."""
+    return (msgpack.packb({"frames": frames}, use_bin_type=True),
+            MSGPACK_CONTENT_TYPE)
 
 
 def negotiate(wire_formats: Any) -> str:

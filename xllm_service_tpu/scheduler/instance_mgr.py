@@ -18,6 +18,20 @@ reference's largest component; SURVEY.md §2.5, §3.4). Responsibilities:
   PD-role flipping.
 - Master replicas: master uploads load metrics to coordination; non-masters
   mirror via watch.
+- Sharded telemetry ingest (``telemetry_ingest_mode="shard"``, the
+  default, ISSUE 15): heartbeat/load ingest AND failure detection for an
+  instance run only on its OWNING master under the rendezvous telemetry
+  map (`multimaster/ownership.py telemetry_owner`); each owner publishes
+  one coalesced load/lease frame per sync tick
+  (``XLLM:LOADFRAME:<owner>``, single-writer by construction) that every
+  other frontend mirrors into its lock-free load-info view — the elected
+  master's heartbeat funnel (NOTES_ROUND8: ~40% of its CPU) spreads 1/N
+  across the active plane. Owner death hands a shard to the rendezvous
+  successor implicitly (the member set shrinks); the successor grants a
+  takeover heartbeat grace so the handoff never transits SUSPECT.
+  ``telemetry_ingest_mode="master"`` keeps the reference-shaped funnel
+  (elected master ingests everything, LOADMETRICS mirror) — the bench
+  baseline and mixed-version escape hatch.
 
 Lock discipline (reference documents a two-lock order,
 `instance_mgr.h:156-162`): `_cluster_lock` guards fleet membership;
@@ -48,10 +62,14 @@ from typing import Callable, Optional
 from ..common.config import ServiceOptions
 from ..common.metrics import (
     CIRCUIT_BREAKER_OPEN,
+    HEARTBEATS_INGESTED_TOTAL,
     INSTANCE_EVICTIONS_TOTAL,
     INSTANCE_INFLIGHT_REQUESTS,
     INSTANCE_QUEUE_DEPTH,
     ITL_MS,
+    LOADFRAMES_APPLIED_TOTAL,
+    LOADFRAMES_PUBLISHED_TOTAL,
+    LOADINFO_AGE_SECONDS,
     RPC_RETRIES_TOTAL,
     TTFT_MS,
 )
@@ -74,13 +92,19 @@ from ..devtools import rcu
 from ..devtools.locks import make_lock
 from ..rpc import (
     INSTANCE_KEY_PREFIX,
+    LOADFRAME_KEY_PREFIX,
     LOADMETRICS_KEY_PREFIX,
     MASTER_KEY,
     instance_key,
     parse_instance_key,
 )
 from ..rpc.channel import EngineChannel
-from ..rpc.wire import WIRE_JSON, negotiate
+from ..rpc.wire import (
+    WIRE_JSON,
+    decode_load_frame,
+    encode_load_frame,
+    negotiate,
+)
 from ..utils import get_logger
 
 logger = get_logger(__name__)
@@ -189,10 +213,16 @@ class InstanceMgr:
     def __init__(self, coord: CoordinationClient, options: ServiceOptions,
                  is_master: bool = True,
                  channel_factory: Callable[[str, str], EngineChannel] | None = None,
-                 start_threads: bool = True):
+                 start_threads: bool = True,
+                 ownership=None):
         self._coord = coord
         self._opts = options
         self._is_master = is_master
+        # Telemetry-shard map source (multimaster OwnershipRouter). None
+        # (direct-construction tests, single-process embedding) degrades
+        # to the legacy funnel: owns_telemetry() is uniformly True and
+        # no frames are published or mirrored.
+        self._ownership = ownership
         self._channel_factory = channel_factory or (
             lambda name, rpc_addr: EngineChannel.from_options(name, options))
         # L1: fleet membership (writers). Scheduling reads go through the
@@ -239,6 +269,24 @@ class InstanceMgr:
         # immutable.
         self._load_infos: dict[str, InstanceLoadInfo] = rcu.publish(
             {}, "routing.load_infos")
+        # Sharded telemetry-ingest plane (ISSUE 15). `_owned_names` is the
+        # reconcile thread's view of this master's telemetry shard (the
+        # set difference against the fresh rendezvous answer is the
+        # ownership-takeover detector — newly-owned instances get a
+        # heartbeat grace so a shard handoff never transits SUSPECT).
+        # `_shard_dirty`/`_shard_gone` are the OWNER-GATED frame inputs:
+        # every write is dominated by an owns_telemetry() check (xlint's
+        # `owner:` state discipline — a non-owner writing a heartbeat
+        # field is a build failure, and a runtime violation under
+        # XLLM_STATE_DEBUG).
+        self._owned_names: set[str] = set()
+        self._shard_dirty: set[str] = set()
+        self._shard_gone: dict[str, tuple[str, int]] = {}
+        self._published_owned: set[str] = set()
+        self._shard_seq = 0
+        self._frames_published = 0
+        self._frames_applied = 0
+        self._foreign_heartbeats = 0
         # Hook for request cancellation on instance death (reference keeps a
         # Scheduler back-pointer, `instance_mgr.h:196-198`).
         self.on_instance_failure: Optional[Callable[[str, str, InstanceType], None]] = None
@@ -249,13 +297,27 @@ class InstanceMgr:
         self._stopped = threading.Event()
         self._watch_ids.append(
             coord.add_watch(INSTANCE_KEY_PREFIX, self._on_instance_event))
-        if not is_master:
+        self._frame_watch_id: Optional[int] = None
+        if self.sharded():
+            # Every ACTIVE frontend (elected or not) mirrors peer owners'
+            # coalesced load/lease frames. Held OUTSIDE `_watch_ids`:
+            # set_as_master prunes `_watch_ids[1:]` on promotion, and the
+            # frame mirror must survive every election flip.
+            self._frame_watch_id = coord.add_watch(
+                LOADFRAME_KEY_PREFIX, self._on_load_frame_event)
+        elif not is_master:
             self._watch_ids.append(
                 coord.add_watch(LOADMETRICS_KEY_PREFIX, self._on_loadmetrics_event))
             self._on_loadmetrics_event(
                 [KeyEvent(WatchEventType.PUT, k, v) for k, v in
                  coord.get_prefix(LOADMETRICS_KEY_PREFIX).items()], "")
         self._load_existing()
+        if self._frame_watch_id is not None:
+            # Bootstrap frame apply AFTER the boot-time fleet load: frames
+            # reference instances by name and skip unknowns.
+            self._on_load_frame_event(
+                [KeyEvent(WatchEventType.PUT, k, v) for k, v in
+                 coord.get_prefix(LOADFRAME_KEY_PREFIX).items()], "")
         self._reconciler: Optional[threading.Thread] = None
         if start_threads:
             self._reconciler = threading.Thread(
@@ -389,6 +451,182 @@ class InstanceMgr:
         logger.warning("instance %s rejected msgpack dispatch; demoted to "
                        "JSON wire", name)
 
+    # ------------------------------------------- sharded telemetry ingest
+    def sharded(self) -> bool:
+        """Is the sharded telemetry-ingest plane active? Requires the
+        shard mode AND a live ownership router (direct-construction
+        tests and embedded single-process use degrade to the legacy
+        funnel)."""
+        return (self._opts.telemetry_ingest_mode == "shard"
+                and self._ownership is not None
+                and self._ownership.enabled)
+
+    def owns_telemetry(self, name: str) -> bool:
+        """Does THIS master own heartbeat/load ingest and failure
+        detection for the instance? Uniformly True outside sharded mode
+        (legacy funnel: whoever receives a heartbeat ingests it, every
+        frontend runs its own detection). Lock-free: one rendezvous walk
+        over the published member tuple. Under XLLM_STATE_DEBUG the
+        answer is noted per-thread — the runtime half of the `owner:`
+        state discipline on the sharded heartbeat fields."""
+        ok = (not self.sharded()) or self._ownership.owns_instance(name)
+        _ownership.note_owner_guard("owns_telemetry", ok)
+        return ok
+
+    def telemetry_owner_addr(self, name: str) -> str:
+        """The owning master's rpc address for an instance's telemetry
+        ("" outside sharded mode)."""
+        if not self.sharded():
+            return ""
+        return self._ownership.instance_owner(name)
+
+    def publish_telemetry_frames(self) -> None:
+        """Publish this master's coalesced load/lease frame (sync-tick
+        cadence, EVERY active frontend — not just the elected master).
+        The frame carries the FULL owned shard so a mirror converges
+        from the latest frame alone; the key is this master's address,
+        single-writer by construction. Skipped when nothing owned
+        changed since the last publish (mirrors age their entries
+        locally, so an unchanged shard needs no re-publish)."""
+        if not self.sharded():
+            return
+        now = now_ms()
+        rows: dict[str, dict] = {}
+        gone: dict[str, str] = {}
+        snap = self._snapshot
+        with self._metrics_lock:
+            dirty = bool(self._shard_dirty) or bool(self._shard_gone)
+            horizon = now - 30_000
+            with _ownership.escape("frame build drains this owner's own "
+                                   "dirty set and prunes expired "
+                                   "tombstones whole — owner-neutral "
+                                   "bookkeeping, no per-instance verdict"):
+                self._shard_dirty.clear()
+                # Tombstones republish for a window (a mirror that missed
+                # one frame catches the next), then age out.
+                for n, (reason, ms) in list(self._shard_gone.items()):
+                    if ms < horizon:
+                        del self._shard_gone[n]
+                    else:
+                        gone[n] = reason
+            owned = [n for n in snap.entries if self.owns_telemetry(n)]
+            if not dirty and set(owned) == self._published_owned:
+                return
+            self._published_owned = set(owned)
+            for n in owned:
+                entry = snap.entries[n]
+                rows[n] = {
+                    "l": self._load_metrics.get(n, LoadMetrics()).to_dict(),
+                    "y": self._latency_metrics.get(
+                        n, LatencyMetrics()).to_dict(),
+                    "hb": entry.last_heartbeat_ms,
+                    "up": self._load_updated_ms.get(n, 0),
+                    "st": entry.state.value,
+                }
+            self._shard_seq += 1
+            seq = self._shard_seq
+            self._frames_published += 1
+        self._coord.set(
+            LOADFRAME_KEY_PREFIX + self._ownership.self_addr,
+            encode_load_frame(rows, gone, seq, now))
+        LOADFRAMES_PUBLISHED_TOTAL.inc()
+
+    def _on_load_frame_event(self, events: list[KeyEvent],
+                             _prefix: str) -> None:
+        """Mirror peer owners' coalesced frames into the local fleet
+        view: load/latency/heartbeat/lease state for every instance THIS
+        master does not own (local ingest is authoritative for owned
+        ones), plus tombstone-driven deregistration. Heartbeat and
+        telemetry ages are re-based onto the local clock from the frame's
+        build timestamp, so staleness scoring needs no cross-host clock
+        agreement."""
+        if not self.sharded():
+            return
+        self_addr = self._ownership.self_addr
+        for ev in events:
+            if ev.type != WatchEventType.PUT:
+                continue   # frame-key GC; latest-frame-per-owner model
+            owner = ev.key[len(LOADFRAME_KEY_PREFIX):]
+            if owner == self_addr:
+                continue   # our own publication echoing back
+            try:
+                frame = decode_load_frame(ev.value)
+            except ValueError as e:
+                logger.warning("bad load frame from %s: %s", owner, e)
+                continue
+            self._apply_load_frame(owner, frame)
+
+    def _apply_load_frame(self, owner: str, frame: dict) -> None:
+        now = now_ms()
+        frame_ms = int(frame.get("ms") or now)
+        rows = frame.get("i", {})
+        with self._cluster_lock:
+            for name, row in rows.items():
+                if self.owns_telemetry(name):
+                    continue   # local ingest is authoritative
+                entry = self._instances.get(name)
+                if entry is None:
+                    continue
+                hb = int(row.get("hb") or 0)
+                if hb:
+                    # Re-base the owner's heartbeat age onto our clock;
+                    # never move the local clock backwards (a direct
+                    # foreign-routed beat may be fresher than the frame).
+                    rebased = now - max(0, frame_ms - hb)
+                    if rebased > entry.last_heartbeat_ms:
+                        entry.last_heartbeat_ms = rebased
+                st = row.get("st")
+                if st and entry.state not in (
+                        InstanceRuntimeState.DRAINING,
+                        InstanceRuntimeState.BREAKER_OPEN):
+                    # Apply the owner's SUSPECT/LEASE_LOST/ACTIVE verdict.
+                    # DRAINING and BREAKER_OPEN stay local: draining is
+                    # the write-lease holder's decision surfaced via
+                    # meta, breaker state is THIS channel's evidence.
+                    try:
+                        new_state = InstanceRuntimeState(st)
+                    except ValueError:
+                        new_state = None
+                    if new_state in (InstanceRuntimeState.ACTIVE,
+                                     InstanceRuntimeState.LEASE_LOST,
+                                     InstanceRuntimeState.SUSPECT):
+                        self._set_state(entry, new_state)
+        with self._metrics_lock:
+            for name, row in rows.items():
+                if self.owns_telemetry(name):
+                    continue
+                if name not in self._snapshot.entries:
+                    continue
+                self._load_metrics[name] = LoadMetrics.from_dict(
+                    row.get("l") or {})
+                self._latency_metrics[name] = LatencyMetrics.from_dict(
+                    row.get("y") or {})
+                up = int(row.get("up") or 0)
+                rebased_up = now - max(0, frame_ms - up) if up else 0
+                if rebased_up > self._load_updated_ms.get(name, 0):
+                    self._load_updated_ms[name] = rebased_up
+                self._update_load_info_locked(name)
+            self._frames_applied += 1
+        LOADFRAMES_APPLIED_TOTAL.inc()
+        gone = frame.get("g") or {}
+        if isinstance(gone, list):   # tolerate a reason-less tombstone list
+            gone = {n: "owner eviction" for n in gone}
+        for name, reason in gone.items():
+            if self.owns_telemetry(name):
+                continue
+            if self._ownership.instance_owner(name) != owner:
+                # Stale tombstone from a FORMER owner (membership moved
+                # the shard since it was recorded): only the instance's
+                # current rendezvous owner may verdict it — the current
+                # owner's frames carry the live row.
+                continue
+            with self._cluster_lock:
+                known = name in self._instances
+            if known:
+                logger.info("mirroring owner %s's eviction of %s (%s)",
+                            owner, name, reason)
+                self.deregister_instance(name, reason=reason)
+
     # ------------------------------------------------------------------ boot
     def _load_existing(self) -> None:
         """Boot-time fleet load WITH link fan-out (reference
@@ -483,7 +721,15 @@ class InstanceMgr:
         (agents self-stop once their in-flight work finishes) — it
         deregisters gracefully, no SUSPECT window, no eviction alarm. If
         it still had bound requests (killed mid-drain), the deregister's
-        failure callback routes them through the NORMAL failover path."""
+        failure callback routes them through the NORMAL failover path.
+
+        Sharded telemetry ingest: only the OWNING master probes and
+        verdicts — non-owners leave the entry as-is and converge on the
+        owner's lease state via its load frames (O(1) probes per lapse
+        instead of O(masters); the owner's verdict is the one built from
+        the heartbeat stream it actually receives)."""
+        if not self.owns_telemetry(name):
+            return
         with self._cluster_lock:
             entry = self._instances.get(name)
             channel = entry.channel if entry else None
@@ -599,6 +845,16 @@ class InstanceMgr:
             self._load_metrics.setdefault(meta.name, LoadMetrics())
             self._request_loads.setdefault(meta.name, _RequestLoad())
             self._publish_request_load_locked(meta.name)
+            if self.owns_telemetry(meta.name):
+                # A (re-)registration supersedes any pending eviction
+                # tombstone: without this the tombstone keeps
+                # republishing for its 30s window and every mirror
+                # deregisters the LIVE re-registered instance on each
+                # frame tick — a fleet-wide routing flap under rolling
+                # restarts (review catch). Mark the shard dirty so the
+                # next frame carries the resurrection row immediately.
+                self._shard_gone.pop(meta.name, None)
+                self._shard_dirty.add(meta.name)
         logger.info("registered instance %s type=%s incarnation=%s",
                     meta.name, meta.type.value, meta.incarnation_id)
         return True
@@ -647,6 +903,14 @@ class InstanceMgr:
             self._publish_request_load_locked(name)
             self._removed_load_names.add(name)
             self._updated_load_names.discard(name)
+            if self.owns_telemetry(name):
+                # Owner-gated tombstone (xlint `owner:` discipline): the
+                # eviction rides this master's next load frame so every
+                # mirror deregisters too, with the original reason (a
+                # mirrored graceful drain must not page anyone either).
+                self._shard_gone[name] = (reason or "owner eviction",
+                                          now_ms())
+                self._shard_dirty.discard(name)
             # Drop the dead instance's gauge series so /metrics stops
             # exporting stale labels. Inside _metrics_lock: the gauge
             # writers gate on _load_metrics membership under the same
@@ -665,6 +929,7 @@ class InstanceMgr:
         ITL_MS.remove(instance=name, policy=policy)
         RPC_RETRIES_TOTAL.remove(instance=name)
         CIRCUIT_BREAKER_OPEN.remove(instance=name)
+        LOADINFO_AGE_SECONDS.remove(instance=name)
         if reason not in ("replaced", "drained"):
             # Planned churn — a rolling-restart re-registration or a
             # completed graceful drain (autoscaler scale-in) — is not an
@@ -691,6 +956,7 @@ class InstanceMgr:
             entry.last_heartbeat_ms = now_ms()
             if entry.state == InstanceRuntimeState.SUSPECT:
                 self._set_state(entry, InstanceRuntimeState.LEASE_LOST)
+        owned_beat: Optional[bool] = None
         if load is not None or latency is not None:
             with self._metrics_lock:
                 if load is not None:
@@ -706,7 +972,27 @@ class InstanceMgr:
                     self._latency_metrics[name] = latency
                 self._load_updated_ms[name] = now_ms()
                 self._updated_load_names.add(name)
+                if self.owns_telemetry(name):
+                    # Owner-gated frame input (xlint `owner:` discipline):
+                    # only the telemetry owner coalesces this beat into
+                    # its published load frame. A foreign-routed beat
+                    # (membership race, legacy engine) still updated the
+                    # LOCAL view above — fresh data beats none — but the
+                    # owner's frame is the one mirrors converge on.
+                    self._shard_dirty.add(name)
+                    owned_beat = True
+                else:
+                    self._foreign_heartbeats += 1
+                    owned_beat = False
                 self._update_load_info_locked(name)
+        # Reuse the in-lock verdict: a second owns_telemetry() here would
+        # be another full rendezvous walk on the exact hot path this
+        # plane exists to thin (review catch). Bare beats (no metrics —
+        # the kv-relay path) are rare enough to pay the walk.
+        if owned_beat is None:
+            owned_beat = self.owns_telemetry(name)
+        HEARTBEATS_INGESTED_TOTAL.labels(
+            shard="owned" if owned_beat else "foreign").inc()
         return True
 
     def _set_state(self, entry: _Entry, state: InstanceRuntimeState) -> None:
@@ -734,10 +1020,47 @@ class InstanceMgr:
         to_evict: list[str] = []
         to_drain_check: list[tuple[str, int]] = []
         to_probe: list[tuple[str, EngineChannel]] = []
+        to_lease_check: list[tuple[str, str]] = []
+        shard = self.sharded()
         with self._cluster_lock:
+            if shard:
+                owned_now = {n for n in self._instances
+                             if self._ownership.owns_instance(n)}
+                for name in owned_now - self._owned_names:
+                    # Ownership takeover (a member died or joined and the
+                    # rendezvous map moved this instance to us): grant a
+                    # fresh heartbeat grace. The engine re-routes its
+                    # beats within one interval; judging it on silence
+                    # accrued while SOMEBODY ELSE owned its ingest would
+                    # SUSPECT a healthy instance — the exact spurious
+                    # transition the owner-death chaos drill forbids.
+                    entry = self._instances[name]
+                    entry.last_heartbeat_ms = max(entry.last_heartbeat_ms,
+                                                  now)
+                self._owned_names = owned_now
             for name, entry in self._instances.items():
-                if entry.state in (InstanceRuntimeState.LEASE_LOST,
-                                   InstanceRuntimeState.BREAKER_OPEN):
+                # Sharded ingest: silence verdicts and eviction timers
+                # run only on the telemetry owner — non-owners converge
+                # on the owner's lease state via its load frames and
+                # tombstones. Local concerns (drain completion, circuit-
+                # breaker mirroring of THIS frontend's channel evidence)
+                # run everywhere.
+                owner = not shard or name in self._owned_names
+                if owner and entry.state == InstanceRuntimeState.ACTIVE \
+                        and shard \
+                        and now - entry.last_heartbeat_ms > (
+                            self._opts.heartbeat_silence_to_suspect_s
+                            + self._opts.lease_ttl_s) * 1000:
+                    # Missed-DELETE sweep: the lease-lapse event may have
+                    # fired while ANOTHER master owned this instance (and
+                    # died before verdicting). An owned, silent, still-
+                    # ACTIVE entry is checked against coordination
+                    # outside the lock; an absent key re-runs the normal
+                    # lapse pipeline (probe -> LEASE_LOST/SUSPECT).
+                    to_lease_check.append((name, entry.meta.type.value))
+                if owner and entry.state in (
+                        InstanceRuntimeState.LEASE_LOST,
+                        InstanceRuntimeState.BREAKER_OPEN):
                     # Heartbeat-silence promotion applies to BREAKER_OPEN
                     # too: a breaker-open instance that also goes SILENT
                     # is dead, not busy — without this it would sit
@@ -754,7 +1077,8 @@ class InstanceMgr:
                                     silence)
                 if entry.state == InstanceRuntimeState.SUSPECT:
                     age = now - entry.state_since_ms
-                    if age > self._opts.detect_disconnected_instance_interval_s * 1000:
+                    if owner and age > \
+                            self._opts.detect_disconnected_instance_interval_s * 1000:
                         to_evict.append(name)
                 elif entry.state == InstanceRuntimeState.DRAINING:
                     to_drain_check.append((name, now - entry.state_since_ms))
@@ -799,6 +1123,14 @@ class InstanceMgr:
                     logger.info("instance %s: circuit breaker closed "
                                 "(half-open probe ok); restored to "
                                 "routing", name)
+        for name, type_str in to_lease_check:
+            # Outside the lock: one coordination read per silent-but-
+            # ACTIVE owned instance (rare — only when a lapse verdict was
+            # missed during an ownership handoff).
+            if self._coord.get(instance_key(type_str, name)) is None:
+                logger.info("owned instance %s silent with no lease; "
+                            "running missed lapse detection", name)
+                self._handle_instance_delete(name)
         for name in to_evict:
             self.deregister_instance(name, reason="suspect eviction")
         for name, age_ms in to_drain_check:
@@ -1148,7 +1480,17 @@ class InstanceMgr:
     # ----------------------------------------------------- master sync loop
     def upload_load_metrics(self) -> None:
         """Master: push updated load metrics to coordination; replicas mirror
-        (reference `instance_mgr.cpp:372-391`)."""
+        (reference `instance_mgr.cpp:372-391`). Legacy-funnel mode only:
+        under sharded ingest the per-owner load frames replace the
+        per-instance LOADMETRICS keys entirely (each owner publishes its
+        shard; there is no single uploader to funnel through)."""
+        if self.sharded():
+            with self._metrics_lock:
+                # The dirty sets feed ONLY this uploader; keep them from
+                # growing unboundedly while frames carry the data.
+                self._updated_load_names.clear()
+                self._removed_load_names.clear()
+            return
         if not self._is_master:
             # Write-lease discipline (multi-master): LOADMETRICS records
             # are master-published; a demoted master's straggler tick
@@ -1180,21 +1522,57 @@ class InstanceMgr:
 
     def set_as_replica(self) -> None:
         """Demotion (a master that lost its coordination lease to a new
-        winner): stop uploading, mirror load metrics again."""
+        winner): stop uploading, mirror load metrics again. Sharded
+        ingest needs neither step: frame publication and mirroring are
+        election-independent (every frontend already does both for its
+        own shard)."""
         if not self._is_master:
             return
         self._is_master = False
+        if self.sharded():
+            return
         self._watch_ids.append(self._coord.add_watch(
             LOADMETRICS_KEY_PREFIX, self._on_loadmetrics_event))
         self._on_loadmetrics_event(
             [KeyEvent(WatchEventType.PUT, k, v) for k, v in
              self._coord.get_prefix(LOADMETRICS_KEY_PREFIX).items()], "")
 
+    def stats(self) -> dict:
+        """Telemetry-plane observability (satellite of ISSUE 15): the
+        shard map as this master sees it, frame-log progress, and the
+        per-instance load-info snapshot ages staleness-aware scoring
+        discounts by — surfaced via /admin/hotpath and mirrored into
+        /metrics by the scrape-time gauge refresh. Lock-free reads
+        plus GIL-atomic counter loads."""
+        sharded = self.sharded()
+        snap = self._snapshot
+        owned = sorted(n for n in snap.entries
+                       if self.owns_telemetry(n)) if sharded else []
+        return {
+            "mode": "shard" if sharded else "master",
+            "fleet": len(snap.entries),
+            "owned_instances": owned,
+            "owned": len(owned) if sharded else len(snap.entries),
+            "frame_seq": self._shard_seq,
+            "frames_published": self._frames_published,
+            "frames_applied": self._frames_applied,
+            "foreign_heartbeats": self._foreign_heartbeats,
+            "load_info_ages_s": self.load_info_ages_s(),
+        }
+
     def stop(self) -> None:
         self._stopped.set()
         for wid in self._watch_ids:
             self._coord.remove_watch(wid)
         self._watch_ids.clear()
+        if self._frame_watch_id is not None:
+            self._coord.remove_watch(self._frame_watch_id)
+            self._frame_watch_id = None
+            # Retire this owner's frame key: peers converge on live
+            # owners' frames only (a kill skips this, like any lease —
+            # stale frames are inert: mirrors apply frames on PUT events
+            # and age rebasing keeps bootstrap reads honest).
+            self._coord.rm(LOADFRAME_KEY_PREFIX + self._ownership.self_addr)
         with self._cluster_lock:
             for entry in self._instances.values():
                 if entry.channel is not None:

@@ -38,7 +38,7 @@ from ..chat_template import JinjaChatTemplate
 from ..common import tracing
 from ..common.call_data import ClientConnection
 from ..common.config import ServiceOptions
-from ..common.hotpath import HOTPATH
+from ..common.hotpath import CPU_ATTR, HOTPATH
 from ..common.metrics import (
     FAILOVER_ATTEMPTS_TOTAL,
     FAILOVER_SUCCESS_TOTAL,
@@ -167,7 +167,14 @@ class Scheduler:
 
         self.instance_mgr = InstanceMgr(self._coord, options,
                                         is_master=self.is_master,
-                                        start_threads=start_threads)
+                                        start_threads=start_threads,
+                                        ownership=self.ownership)
+        # Pooled session for the owner->elected-master KV-event relay
+        # (sharded telemetry: the index stays write-leased; see
+        # handle_instance_heartbeat).
+        from ..rpc.channel import make_keepalive_session
+        self._kv_relay_session = make_keepalive_session(
+            pool_connections=2, pool_maxsize=2)
         self.kvcache_mgr = GlobalKVCacheMgr(self._coord, options.block_size,
                                             is_master=self.is_master,
                                             options=options)
@@ -268,6 +275,17 @@ class Scheduler:
         while not self._stopped.wait(self._opts.sync_interval_s):
             self.sync_once()
 
+    def elected_master_addr(self) -> str:
+        """The elected master's service address ("" when unknown):
+        self when we hold the lease, a coordination read otherwise.
+        Blocking — callers off the event loop only."""
+        if self.is_master:
+            return self.self_addr
+        try:
+            return self._coord.get(MASTER_KEY) or ""
+        except Exception:  # noqa: BLE001  # xlint: allow-broad-except(hint-only: a coordination blip degrades to no owner hint, the engine keeps its current target)
+            return ""
+
     def sync_once(self) -> None:
         if self.is_master:
             # Verify we still hold the election key: after a coordination
@@ -283,6 +301,15 @@ class Scheduler:
                 if self._master_watch_id is None:
                     self._master_watch_id = self._coord.add_watch(
                         MASTER_KEY, self._on_master_event)
+        # Sharded telemetry plane: EVERY active frontend publishes the
+        # coalesced load/lease frame for its own shard — frame keys are
+        # single-writer (keyed by owner address), so this is the one
+        # coordination write that deliberately bypasses the election
+        # gate. No-op outside sharded mode.
+        try:
+            self.instance_mgr.publish_telemetry_frames()
+        except Exception:  # noqa: BLE001 — telemetry must not kill sync
+            logger.exception("telemetry frame publish failed")
         decision = None
         if self.is_master:
             self.kvcache_mgr.upload_kvcache()
@@ -411,6 +438,10 @@ class Scheduler:
         # Per-stage sub-spans under the scheduler.schedule span (the
         # thread-active context): attribution for the master hot-path
         # budget. All four are no-ops when tracing is off.
+        with CPU_ATTR.measure("route"):
+            return self._schedule_inner(request)
+
+    def _schedule_inner(self, request: Request) -> Status:
         ctx = tracing.current_context()
         sid = request.service_request_id
         if request.messages and not request.prompt:
@@ -518,20 +549,60 @@ class Scheduler:
 
     # ------------------------------------------------------------- heartbeat
     def handle_instance_heartbeat(self, payload: dict[str, Any]) -> bool:
-        """Reference `scheduler.cpp:186-198` + RPC `Heartbeat`."""
-        name = payload.get("name", "")
-        incarnation = payload.get("incarnation_id", "")
-        load = LoadMetrics.from_dict(payload.get("load_metrics", {})) \
-            if payload.get("load_metrics") else None
-        latency = LatencyMetrics.from_dict(payload.get("latency_metrics", {})) \
-            if payload.get("latency_metrics") else None
-        known = self.instance_mgr.record_instance_heartbeat(
-            name, incarnation, load, latency)
-        kv = payload.get("kv_cache_event")
-        if known and kv:
-            self.kvcache_mgr.record_updated_kvcaches(
-                name, KvCacheEvent.from_dict(kv))
-        return known
+        """Reference `scheduler.cpp:186-198` + RPC `Heartbeat`. Measured
+        into the `ingest` CPU-attribution bucket — the share the sharded
+        telemetry plane exists to spread across masters.
+
+        KV-event routing under sharded ingest: load/lease telemetry is
+        owner-ingested (this frontend), but the KV-cache INDEX stays
+        WRITE-LEASED — one frame-log writer, the elected master (the
+        PR-5/6 invariant). A non-elected telemetry owner therefore
+        forwards the heartbeat's kv_cache_event to the elected master
+        instead of applying it locally (a local apply would fork the
+        replica index from the frame log it also mirrors); a lost
+        forward costs cache-hit routing accuracy for one delta, never
+        correctness."""
+        with CPU_ATTR.measure("ingest"):
+            name = payload.get("name", "")
+            incarnation = payload.get("incarnation_id", "")
+            load = LoadMetrics.from_dict(payload.get("load_metrics", {})) \
+                if payload.get("load_metrics") else None
+            latency = LatencyMetrics.from_dict(payload.get("latency_metrics", {})) \
+                if payload.get("latency_metrics") else None
+            known = self.instance_mgr.record_instance_heartbeat(
+                name, incarnation, load, latency)
+            kv = payload.get("kv_cache_event")
+            if known and kv:
+                if self.is_master:
+                    self.kvcache_mgr.record_updated_kvcaches(
+                        name, KvCacheEvent.from_dict(kv))
+                else:
+                    self._forward_kv_event(name, incarnation, kv)
+            return known
+
+    def _forward_kv_event(self, name: str, incarnation: str,
+                          kv: dict[str, Any]) -> None:
+        """Relay a heartbeat's KV-cache event to the elected master
+        (runs on the heartbeat executor thread — blocking POST is fine).
+        Empty events are dropped here: most beats carry no delta, and
+        the common case must not pay a master round-trip."""
+        if not any(kv.get(k) for k in ("stored", "removed", "offloaded")):
+            return
+        master = self._coord.get(MASTER_KEY)
+        if not master or master == self.self_addr:
+            return
+        from ..rpc import wire as _wire
+
+        body, ctype = _wire.encode_dispatch(
+            {"name": name, "incarnation_id": incarnation,
+             "kv_cache_event": kv}, _wire.WIRE_MSGPACK)
+        try:
+            self._kv_relay_session.post(
+                f"http://{master}/rpc/heartbeat", data=body,
+                headers={"Content-Type": ctype}, timeout=3)
+        except Exception as e:  # noqa: BLE001  # xlint: allow-broad-except(a lost KV delta degrades cache-hit routing for one beat; the next heartbeat's absolute tier moves re-converge)
+            logger.warning("kv-event relay to master %s failed: %s",
+                           master, e)
 
     # ----------------------------------------------------------- generation
     def handle_generation(self, output: RequestOutput) -> bool:

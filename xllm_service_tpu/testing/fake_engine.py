@@ -91,6 +91,14 @@ class FakeEngineConfig:
     # benches a realistic TTFT floor so queueing delay can be measured
     # as a ratio against it.
     first_delta_delay_s: float = 0.0
+    # Telemetry wiring (ISSUE 15, wire-contract mirror of
+    # AgentConfig.telemetry_mode): "owner" routes heartbeats to the
+    # rendezvous telemetry owner (deltas stay direct — the hermetic-test
+    # default, identical delta wire to before); "mux" multiplexes
+    # heartbeats AND deltas as tagged frames on one keepalive session to
+    # the owner (the bench's O(engines) connection mode); "master" keeps
+    # the legacy elected-master heartbeat funnel.
+    telemetry_mode: str = "owner"
 
 
 class FakeEngine:
@@ -153,13 +161,24 @@ class FakeEngine:
         # master, re-probed when the master address changes.
         self._hb_wire = wire.WIRE_MSGPACK
         self._hb_master = ""
-        # Shared pooled session for Generations pushes (the real agent's
-        # streamer keeps one too): a fresh TCP connect per delta would
-        # charge connection setup to the master+wire span in every bench.
-        # urllib3's pool is thread-safe; we use no session-level state.
-        self._push_session = _requests.Session()
-        adapter = _requests.adapters.HTTPAdapter(pool_maxsize=32)
-        self._push_session.mount("http://", adapter)
+        # ONE shared, bounded keepalive session for every telemetry hop
+        # (heartbeats + Generations pushes): a fresh TCP connect per
+        # delta would charge connection setup to the master+wire span in
+        # every bench, and per-master pools would make fan-out
+        # O(engines × masters). urllib3's pool is thread-safe; we use no
+        # session-level state.
+        from ..rpc.channel import make_keepalive_session
+
+        self._push_session = make_keepalive_session(pool_connections=4,
+                                                    pool_maxsize=8)
+        # Rendezvous owner resolution for the sharded telemetry plane
+        # (mirrors the real agent).
+        from ..multimaster import TelemetryOwnerResolver
+
+        self.telemetry_owner = TelemetryOwnerResolver(coord, self.name)
+        self._telemetry_mode = self.cfg.telemetry_mode
+        self.mux_sends = 0
+        self.direct_sends = 0
 
     # ------------------------------------------------------------ lifecycle
     def start(self, register: bool = True) -> "FakeEngine":
@@ -289,8 +308,14 @@ class FakeEngine:
                     instance_key(self.instance_type.value, self.name))
                 continue
             self.register()  # refresh registration (lease keepalive path)
-            master_addr = self.coord.get("XLLM:SERVICE:MASTER")
-            if not master_addr:
+            # Sharded telemetry (ISSUE 15): beats route to the OWNING
+            # master under the rendezvous shard map; "master" mode keeps
+            # the legacy elected-master funnel.
+            if self._telemetry_mode == "master":
+                target = self.coord.get("XLLM:SERVICE:MASTER") or ""
+            else:
+                target = self.telemetry_owner()
+            if not target:
                 continue
             with self._kv_lock:
                 stored = self._pending_kv_stored
@@ -317,31 +342,80 @@ class FakeEngine:
                 "latency_metrics": {"recent_max_ttft": 12.0,
                                     "recent_max_tbt": 4.0},
             }
-            try:
-                if master_addr != self._hb_master:
-                    self._hb_master = master_addr
-                    self._hb_wire = wire.WIRE_MSGPACK
-                fmt = self._hb_wire
-                payload["kv_cache_event"] = (
-                    ev.to_wire_dict() if fmt == wire.WIRE_MSGPACK
-                    else ev.to_dict())
-                body, ctype = wire.encode_dispatch(payload, fmt)
-                r = _requests.post(f"http://{master_addr}/rpc/heartbeat",
-                                   data=body,
-                                   headers={"Content-Type": ctype},
-                                   timeout=2)
-                if r.status_code in (400, 415) \
-                        and fmt == wire.WIRE_MSGPACK:
-                    self._hb_wire = wire.WIRE_JSON
-                    payload["kv_cache_event"] = ev.to_dict()
-                    body, ctype = wire.encode_dispatch(payload,
-                                                       wire.WIRE_JSON)
-                    _requests.post(f"http://{master_addr}/rpc/heartbeat",
-                                   data=body,
-                                   headers={"Content-Type": ctype},
-                                   timeout=2)
-            except _requests.RequestException:
-                pass
+            if not self._post_heartbeat(target, payload, ev):
+                # Owner died mid-heartbeat-stream: exclude it and hand
+                # THIS beat to the rendezvous successor now — the
+                # takeover drill asserts no SUSPECT transit, which a
+                # full-interval gap could trip.
+                self.telemetry_owner.note_failure(target)
+                successor = self.telemetry_owner() \
+                    if self._telemetry_mode != "master" else ""
+                if successor and successor != target:
+                    self._post_heartbeat(successor, payload, ev)
+
+    def _post_heartbeat(self, target: str, payload: dict, ev) -> bool:
+        """One heartbeat delivery (mux = tagged telemetry frame on the
+        shared session; otherwise the legacy wire with msgpack->JSON
+        demotion per master)."""
+        try:
+            if target != self._hb_master:
+                self._hb_master = target
+                self._hb_wire = wire.WIRE_MSGPACK
+            if self._telemetry_mode == "mux":
+                payload = dict(payload)
+                payload["kv_cache_event"] = ev.to_wire_dict()
+                body, ctype = wire.encode_telemetry(
+                    [{"t": wire.TELEMETRY_HB, "d": payload}])
+                r = self._push_session.post(
+                    f"http://{target}/rpc/telemetry", data=body,
+                    headers={"Content-Type": ctype}, timeout=2)
+                if r.status_code not in (404, 405):
+                    if r.status_code == 200:
+                        self._adopt_owner_hint(r, target)
+                        return True
+                    return False
+                # Legacy (pre-sharding) master: only the ELECTED master
+                # uploads load metrics there, so fall back to the full
+                # reference funnel, not just per-endpoint wires.
+                self._telemetry_mode = "master"
+            fmt = self._hb_wire
+            payload = dict(payload)
+            payload["kv_cache_event"] = (
+                ev.to_wire_dict() if fmt == wire.WIRE_MSGPACK
+                else ev.to_dict())
+            body, ctype = wire.encode_dispatch(payload, fmt)
+            r = self._push_session.post(f"http://{target}/rpc/heartbeat",
+                                        data=body,
+                                        headers={"Content-Type": ctype},
+                                        timeout=2)
+            if r.status_code in (400, 415) \
+                    and fmt == wire.WIRE_MSGPACK:
+                self._hb_wire = wire.WIRE_JSON
+                payload["kv_cache_event"] = ev.to_dict()
+                body, ctype = wire.encode_dispatch(payload,
+                                                   wire.WIRE_JSON)
+                r = self._push_session.post(
+                    f"http://{target}/rpc/heartbeat", data=body,
+                    headers={"Content-Type": ctype}, timeout=2)
+            if r.status_code == 200:
+                self._adopt_owner_hint(r, target)
+                return True
+            return False
+        except _requests.RequestException:
+            return False
+
+    def _adopt_owner_hint(self, r, target: str) -> None:
+        """Adopt the receiving master's `owner` hint (its shard-map view
+        is fresher than our mirrored membership on a race) so the NEXT
+        beat re-routes without waiting a resolver cache window out."""
+        if self._telemetry_mode == "master":
+            return
+        try:
+            owner = (r.json() or {}).get("owner", "")
+        except ValueError:
+            return
+        if owner and owner != target:
+            self.telemetry_owner.pin(owner)
 
     # ------------------------------------------------------------ handlers
     async def _h_health(self, req: web.Request) -> web.Response:
@@ -354,11 +428,26 @@ class FakeEngine:
             {"id": m, "object": "model"} for m in self.cfg.models]})
 
     async def _h_metrics(self, req: web.Request) -> web.Response:
+        from ..rpc.channel import session_connection_stats
+
+        conn = session_connection_stats(self._push_session)
         lines = [
             "# TYPE engine_running_requests gauge",
             f"engine_running_requests {len(self.accepted_requests)}",
             "# TYPE engine_cached_prefix_blocks gauge",
             f"engine_cached_prefix_blocks {len(self._stored_hashes)}",
+            # Multiplexed-session fan-out evidence (ISSUE 15 bench):
+            # distinct master pools + TCP connects this engine ever made
+            # on its one telemetry session, plus the mux/direct split.
+            "# TYPE engine_telemetry_session_hosts gauge",
+            f"engine_telemetry_session_hosts {conn['hosts']}",
+            "# TYPE engine_telemetry_connections_created counter",
+            f"engine_telemetry_connections_created "
+            f"{conn['connections_created']}",
+            "# TYPE engine_telemetry_mux_sends_total counter",
+            f"engine_telemetry_mux_sends_total {self.mux_sends}",
+            "# TYPE engine_telemetry_direct_sends_total counter",
+            f"engine_telemetry_direct_sends_total {self.direct_sends}",
         ]
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
@@ -507,6 +596,35 @@ class FakeEngine:
                              args=(sid, source, body)).start()
             next_at = max(next_at, now) + interval
 
+    def _push_gens_mux(self, session: "_requests.Session", owner: str,
+                       dest: str, gens: list) -> Optional[bool]:
+        """One tagged-frame push via the owning master (every delta
+        batch here belongs to one request/dest pair — the fake engine
+        flushes per generation thread). True/False = the dest's alive
+        verdict for this request; None = owner unreachable or relay
+        failed (caller excludes the owner and falls back direct)."""
+        sid = gens[0].get("service_request_id", "") if gens else ""
+        body, ctype = wire.encode_telemetry(
+            [{"t": wire.TELEMETRY_GENS, "dest": dest,
+              "d": {"gens": gens}}])
+        try:
+            r = session.post(f"http://{owner}/rpc/telemetry", data=body,
+                             headers={"Content-Type": ctype}, timeout=5)
+            if r.status_code in (404, 405):
+                self._telemetry_mode = "owner"   # legacy master
+                return None
+            r.raise_for_status()
+            payload = r.json()
+        except (_requests.RequestException, ValueError) as e:
+            logger.warning("fake engine: mux gens push via %s failed: %s",
+                           owner, e)
+            return None
+        self.mux_sends += 1
+        dest_ok = payload.get("dest_ok") or {}
+        if not dest_ok.get(dest, False):
+            return None   # relay to the dest failed; retry direct
+        return bool((payload.get("alive") or {}).get(sid, True))
+
     # ----------------------------------------------------------- generation
     def _generate(self, sid: str, source: str, body: dict[str, Any]) -> None:
         # Active-generation accounting gates the drain self-stop: a
@@ -570,12 +688,26 @@ class FakeEngine:
 
         def flush() -> Optional[bool]:
             """POST pending deltas; True = delivered & request alive,
-            False = service said stop, None = push failed."""
+            False = service said stop, None = push failed. Mux mode
+            rides the owner-routed telemetry session (one connection
+            regardless of which master dispatched this request); owner
+            failure falls back to the direct wire for THIS flush after
+            excluding the dead owner."""
             if not pending:
                 return True
-            data, ctype = wire.encode_dispatch(
-                {"gens": list(pending)}, wire.WIRE_MSGPACK)
+            gens = list(pending)
             pending.clear()
+            if self._telemetry_mode == "mux":
+                owner = self.telemetry_owner()
+                if owner:
+                    verdict = self._push_gens_mux(session, owner, source,
+                                                  gens)
+                    if verdict is not None:
+                        return verdict
+                    self.telemetry_owner.note_failure(owner)
+            data, ctype = wire.encode_dispatch(
+                {"gens": gens}, wire.WIRE_MSGPACK)
+            self.direct_sends += 1
             try:
                 r = session.post(f"http://{source}/rpc/generations",
                                  data=data,
