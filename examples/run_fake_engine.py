@@ -65,6 +65,12 @@ def main() -> None:
                         "the owner; master = legacy elected-master "
                         "heartbeat funnel (the ingest-sharding bench "
                         "baseline)")
+    p.add_argument("--degraded-mode", default="on", choices=["on", "off"],
+                   help="on = keep heartbeats flowing to the last-known-"
+                        "good master while the coordination plane is "
+                        "unreachable (static stability); off = legacy "
+                        "behavior (no resolvable target, no beats — the "
+                        "outage bench's control leg)")
     args = p.parse_args()
 
     rate = max(0.0, args.service_rate)
@@ -81,7 +87,8 @@ def main() -> None:
         first_delta_delay_s=max(0.0, args.first_delta_delay),
         heartbeat_interval_s=max(0.05, args.heartbeat_interval),
         lease_ttl_s=max(0.2, args.lease_ttl),
-        telemetry_mode=args.telemetry_mode)
+        telemetry_mode=args.telemetry_mode,
+        degraded_mode=args.degraded_mode)
     ).start()
     print(f"fake engine {engine.name} ({args.type}) registered; Ctrl-C to stop",
           flush=True)

@@ -6,7 +6,7 @@
 # all randomness from one seeded RNG), so any failing iteration can be
 # replayed exactly with:   XLLM_CHAOS_SEED=<seed> pytest -m chaos
 #
-# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs|--state|--autoscale|--overload] [extra pytest args...]
+# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs|--state|--autoscale|--overload|--outage] [extra pytest args...]
 #   --masters   soak the multi-master plane drills (tests/test_multimaster.py:
 #               owner/master kill mid-stream, split-brain demotion, write-lease
 #               proxying) instead of the single-master failover drills.
@@ -36,6 +36,13 @@
 #               requests whole, circuit-breaker open/probe/restore, the
 #               relayed client-disconnect cancellation drill, retry-
 #               budget exhaustion).
+#   --outage    soak the coordination-plane static-stability drills
+#               (tests/test_multimaster.py TestCoordinationOutage +
+#               tests/test_chaos_failover.py TestCoordinationOutageFailover:
+#               total coordination outage mid-stream over the real TCP
+#               wire, census freeze / sticky mastership / held-action
+#               replay, fencing demotion, and an engine crash DURING
+#               the outage failing over byte-identically).
 #
 # After the randomized-seed loop, the INSTRUMENTED legs run (one
 # iteration each, counted in the pass rate): XLLM_LOCK_DEBUG=1 (the
@@ -48,24 +55,29 @@ set -u
 
 ITERS="${1:-20}"
 shift 2>/dev/null || true
-SUITE="tests/test_chaos_failover.py"
+SUITES=("tests/test_chaos_failover.py")
+KARGS=()
 if [ "${1:-}" = "--masters" ]; then
-    SUITE="tests/test_multimaster.py"
+    SUITES=("tests/test_multimaster.py")
     shift
 elif [ "${1:-}" = "--tier" ]; then
-    SUITE="tests/test_kv_tiering.py"
+    SUITES=("tests/test_kv_tiering.py")
     shift
 elif [ "${1:-}" = "--obs" ]; then
-    SUITE="tests/test_fleet_observability.py"
+    SUITES=("tests/test_fleet_observability.py")
     shift
 elif [ "${1:-}" = "--state" ]; then
-    SUITE="tests/test_state_debug.py"
+    SUITES=("tests/test_state_debug.py")
     shift
 elif [ "${1:-}" = "--autoscale" ]; then
-    SUITE="tests/test_autoscaler.py"
+    SUITES=("tests/test_autoscaler.py")
     shift
 elif [ "${1:-}" = "--overload" ]; then
-    SUITE="tests/test_overload.py"
+    SUITES=("tests/test_overload.py")
+    shift
+elif [ "${1:-}" = "--outage" ]; then
+    SUITES=("tests/test_multimaster.py" "tests/test_chaos_failover.py")
+    KARGS=(-k "CoordinationOutage")
     shift
 fi
 cd "$(dirname "$0")/.."
@@ -75,10 +87,10 @@ fail=0
 failed_seeds=()
 for i in $(seq 1 "$ITERS"); do
     seed=$((RANDOM * 32768 + RANDOM))
-    echo "=== chaos iteration $i/$ITERS (seed=$seed, suite=$SUITE) ==="
+    echo "=== chaos iteration $i/$ITERS (seed=$seed, suite=${SUITES[*]}) ==="
     if JAX_PLATFORMS=cpu XLLM_CHAOS_SEED=$seed \
-        python -m pytest "$SUITE" -q -m chaos \
-        -p no:cacheprovider "$@"; then
+        python -m pytest "${SUITES[@]}" -q -m chaos \
+        -p no:cacheprovider ${KARGS[@]+"${KARGS[@]}"} "$@"; then
         pass=$((pass + 1))
     else
         fail=$((fail + 1))
@@ -93,10 +105,10 @@ if [ "${XLLM_SOAK_SKIP_DEBUG_LEGS:-}" != "1" ]; then
                "XLLM_LOCK_DEBUG=1 XLLM_RCU_DEBUG=1 XLLM_STATE_DEBUG=1"; do
         seed=$((RANDOM * 32768 + RANDOM))
         total=$((total + 1))
-        echo "=== instrumented leg: $leg (seed=$seed, suite=$SUITE) ==="
+        echo "=== instrumented leg: $leg (seed=$seed, suite=${SUITES[*]}) ==="
         if JAX_PLATFORMS=cpu XLLM_CHAOS_SEED=$seed \
-            env $leg python -m pytest "$SUITE" -q -m chaos \
-            -p no:cacheprovider "$@"; then
+            env $leg python -m pytest "${SUITES[@]}" -q -m chaos \
+            -p no:cacheprovider ${KARGS[@]+"${KARGS[@]}"} "$@"; then
             pass=$((pass + 1))
         else
             fail=$((fail + 1))

@@ -27,7 +27,7 @@ from xllm_service_tpu.common.metrics import (
 )
 from xllm_service_tpu.common.request import Request, RequestOutput, SequenceOutput
 from xllm_service_tpu.common.call_data import CollectingConnection
-from xllm_service_tpu.common.types import InstanceType
+from xllm_service_tpu.common.types import InstanceRuntimeState, InstanceType
 from xllm_service_tpu.coordination.memory import InMemoryCoordination
 from xllm_service_tpu.master import Master
 from xllm_service_tpu.scheduler.scheduler import Scheduler
@@ -189,6 +189,103 @@ class TestDispatchFailureFailover:
         assert FAILOVER_SUCCESS_TOTAL.value() == success_before + 1
         assert all(e._alive for e in engines)   # nobody died; pure re-route
         assert wait_until(lambda: _loads_zero(master), timeout=5)
+
+
+class TestCoordinationOutageFailover:
+    """Coordination death composed with data-plane chaos: a mid-burst
+    total outage must be invisible to in-flight streams, and an engine
+    crash DURING the outage must still fail over byte-identically —
+    the failover path reads only RCU routing snapshots, never the
+    (dead) plane."""
+
+    def test_burst_survives_outage_and_midstream_crash(self, store):
+        master = Master(_opts(coordination_degraded_after_ticks=2,
+                              coordination_reconnect_jitter_s=0.2,
+                              degraded_heartbeat_silence_s=0.5),
+                        coord=InMemoryCoordination(store))
+        master.start()
+        engines = [_engine(store), _engine(store)]
+        try:
+            assert wait_until(
+                lambda: all(
+                    master.scheduler.instance_mgr.get_instance_meta(e.name)
+                    is not None for e in engines), timeout=5)
+            expected, _ = _stream_completion(master)
+            assert expected == REPLY
+            mon = master.scheduler.coordination_health
+
+            # Kill the plane mid-burst: every in-flight stream finishes
+            # byte-identical while the monitor walks to DEGRADED.
+            results: dict[int, str] = {}
+            errors: list[BaseException] = []
+
+            def run(i: int) -> None:
+                try:
+                    results[i], _ = _stream_completion(master)
+                except BaseException as e:  # noqa: BLE001 — collected
+                    errors.append(e)
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+                time.sleep(0.02)
+            FAULTS.add("coord.outage", action="error")
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors
+            assert len(results) == 4
+            assert all(text == REPLY for text in results.values()), results
+            assert wait_until(lambda: mon.state() == "DEGRADED", timeout=5)
+            assert master.scheduler.is_master   # sticky
+
+            # An engine crashes mid-stream DURING the outage: the stream
+            # fails over to the survivor with zero byte loss.
+            FAULTS.configure([dict(point="coord.outage", action="error"),
+                              dict(point="engine.token", action="crash",
+                                   after=4, max_fires=1)], seed=SEED)
+            success_before = FAILOVER_SUCCESS_TOTAL.value()
+            text, finishes = _stream_completion(master)
+            assert text == expected
+            assert finishes == ["stop"]
+            assert FAILOVER_SUCCESS_TOTAL.value() == success_before + 1
+            dead = [e for e in engines if not e._alive]
+            live = [e for e in engines if e._alive]
+            assert len(dead) == 1
+
+            # The frozen census never evicts on the lapsed lease; the
+            # crash is detected via degraded-mode heartbeat silence and
+            # the eviction HELD for post-recovery replay.
+            mgr = master.scheduler.instance_mgr
+            assert wait_until(
+                lambda: mgr.get_instance_state(dead[0].name)
+                == InstanceRuntimeState.SUSPECT, timeout=5)
+            assert wait_until(
+                lambda: any(a["kind"] == "evict" and a["key"] == dead[0].name
+                            for a in mon.held.report()["actions"]),
+                timeout=5)
+            # The chatty survivor rode the whole outage verdict-free, and
+            # streams keep completing on it.
+            assert (mgr.get_instance_state(live[0].name)
+                    == InstanceRuntimeState.ACTIVE)
+            assert _stream_completion(master)[0] == expected
+
+            # Plane returns: the held eviction replays, the survivor is
+            # untouched, traffic still flows.
+            FAULTS.configure((), seed=SEED)
+            assert wait_until(lambda: mon.state() == "CONNECTED",
+                              timeout=10)
+            assert wait_until(
+                lambda: mgr.get_instance_meta(dead[0].name) is None,
+                timeout=5)
+            assert (mgr.get_instance_state(live[0].name)
+                    == InstanceRuntimeState.ACTIVE)
+            assert _stream_completion(master)[0] == expected
+        finally:
+            for e in engines:
+                if e._alive:
+                    e.stop()
+            master.stop()
 
 
 class TestRetryBudget:

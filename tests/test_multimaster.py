@@ -24,15 +24,20 @@ import pytest
 import requests
 
 from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.faults import FAULTS
+from xllm_service_tpu.common.flightrecorder import RECORDER
 from xllm_service_tpu.common.metrics import (
     HANDOFF_FORWARDED_TOTAL,
     HANDOFF_RECOVERIES_TOTAL,
     HANDOFF_SERVED_TOTAL,
 )
 from xllm_service_tpu.common.hashing import prefix_block_hash_hexes
-from xllm_service_tpu.common.types import InstanceType
+from xllm_service_tpu.common.types import InstanceRuntimeState, InstanceType
 from xllm_service_tpu.coordination.base import WatchEventType
+from xllm_service_tpu.coordination.client import TcpCoordinationClient
+from xllm_service_tpu.coordination.health import HeldActionLog, entity_jitter
 from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.coordination.server import CoordinationServer
 from xllm_service_tpu.master import Master
 from xllm_service_tpu.multimaster.ownership import OwnershipRouter
 from xllm_service_tpu.rpc import (
@@ -907,3 +912,308 @@ class TestWriteLeaseProxy:
             decode.stop()
             m1.stop()
             m2.stop()
+
+
+# --------------------------------------------- coordination-plane outage
+class TestCoordinationHealthUnit:
+    def test_entity_jitter_deterministic_and_bounded(self):
+        a = entity_jitter("127.0.0.1:8001", 5.0)
+        b = entity_jitter("127.0.0.1:8002", 5.0)
+        assert a == entity_jitter("127.0.0.1:8001", 5.0)
+        assert 0.0 <= a < 5.0 and 0.0 <= b < 5.0
+        assert a != b  # distinct identities draw distinct slots
+        assert entity_jitter("127.0.0.1:8001", 0.0) == 0.0
+
+    def test_held_log_coalesces_and_bounds(self):
+        log = HeldActionLog(capacity=3)
+        log.hold("evict", "engine-a", reason="r1")
+        log.hold("evict", "engine-a", reason="ignored", extra=1)
+        assert log.depth() == 1
+        only = log.report()["actions"][0]
+        assert only["count"] == 2 and only["reason"] == "r1"
+        assert only["detail"] == {"extra": 1}
+        for i in range(4):
+            log.hold("flip", f"engine-{i}")
+        rep = log.report()
+        assert rep["depth"] == 3 and rep["dropped"] == 2
+        drained = log.drain()
+        assert len(drained) == 3 and log.depth() == 0
+        assert log.report()["actions"] == []
+
+
+@pytest.mark.chaos
+class TestCoordinationOutage:
+    """Tentpole drills (static stability): a total coordination outage
+    must not take the data plane with it. Census frozen (no spurious
+    SUSPECT/evict for chatty instances), mastership sticky under the
+    fencing rule, ownership-changing actions held + replayed-or-
+    discarded on recovery — and a genuinely dead engine still dies, via
+    direct heartbeat silence."""
+
+    def _outage_opts(self, **kw):
+        base = dict(coordination_degraded_after_ticks=2,
+                    coordination_reconnect_jitter_s=0.2,
+                    degraded_heartbeat_silence_s=0.5)
+        base.update(kw)
+        return base
+
+    def test_monitor_degrades_holds_and_recovers(self, store):
+        """Hermetic outage (the coord.outage fault point fails the
+        liveness ping; the store itself keeps answering — i.e. the
+        monitor classifies from PROBE evidence only): master stays
+        master, publishes are held+coalesced, a chatty engine never
+        transits SUSPECT, a killed engine dies on degraded-mode silence
+        and its held eviction replays after recovery."""
+        m = _master(store, **self._outage_opts())
+        chatty = _engine(store)
+        doomed = _engine(store)
+        mon = None
+        try:
+            _await_plane([m], [chatty, doomed])
+            assert m.scheduler.is_master
+            mon = m.scheduler.coordination_health
+            FAULTS.add("coord.outage", action="error")
+            assert wait_until(lambda: mon.state() == "DEGRADED", timeout=5)
+            assert m.scheduler.is_master  # sticky: plane unreachable
+            # The master's publish actions are suspended into the log…
+            assert wait_until(lambda: mon.held.depth() >= 3, timeout=5)
+            depth = mon.held.depth()
+            time.sleep(0.6)  # ≥ 2 more sync ticks
+            rep = mon.held.report()
+            # …and COALESCED: more ticks grow counts, not the log.
+            assert rep["depth"] == depth
+            assert any(a["count"] >= 2 for a in rep["actions"])
+            # A dead engine still dies: silence over the (plane-immune)
+            # heartbeat path SUSPECTs it and holds the eviction.
+            doomed.kill()
+            assert wait_until(
+                lambda: m.scheduler.instance_mgr.get_instance_state(
+                    doomed.name) == InstanceRuntimeState.SUSPECT,
+                timeout=5)
+            assert wait_until(
+                lambda: any(a["kind"] == "evict" and a["key"] == doomed.name
+                            for a in mon.held.report()["actions"]),
+                timeout=5)
+            # The chatty engine rode the whole outage without a verdict.
+            assert m.scheduler.instance_mgr.get_instance_state(
+                chatty.name) == InstanceRuntimeState.ACTIVE
+            assert mon.report()["frozen_events"].get("lease_lapse", 0) >= 1
+            FAULTS.clear()
+            assert wait_until(lambda: mon.state() == "CONNECTED", timeout=5)
+            assert m.scheduler.is_master
+            # Recovery replayed the eviction (still suspect-and-silent)…
+            assert wait_until(
+                lambda: m.scheduler.instance_mgr.get_instance_meta(
+                    doomed.name) is None, timeout=5)
+            assert mon.held.depth() == 0
+            replays = RECORDER.recent(limit=50, kind="held_action_replay")
+            assert any(r["detail"].get("key") == doomed.name
+                       and r["detail"].get("outcome") == "replayed: evicted"
+                       for r in replays)
+            # …and the publish holds were superseded by live republish.
+            assert any("superseded" in r["detail"].get("outcome", "")
+                       for r in replays)
+            assert RECORDER.recent(limit=50, kind="coordination_degraded")
+            assert RECORDER.recent(limit=50, kind="coordination_recovered")
+            assert _completion(m) == REPLY
+        finally:
+            FAULTS.clear()
+            chatty.stop()
+            doomed.stop()
+            m.stop()
+
+    def test_degraded_mode_off_is_legacy_behavior(self, store):
+        """Control leg: with the knob off the monitor never classifies
+        DEGRADED and nothing is held — the outage bench uses this to
+        demonstrate the fleet loss degraded mode prevents."""
+        m = _master(store, coordination_degraded_mode="off",
+                    coordination_degraded_after_ticks=2)
+        try:
+            mon = m.scheduler.coordination_health
+            FAULTS.add("coord.outage", action="error")
+            time.sleep(1.0)  # ~5 failed probes
+            assert mon.state() == "CONNECTED"
+            assert not mon.degraded()
+            assert mon.held.depth() == 0
+            assert mon.report()["enabled"] is False
+        finally:
+            FAULTS.clear()
+            m.stop()
+
+    def test_fencing_observed_owner_demotes_and_discards(self, store):
+        """The stickiness boundary: an UNREACHABLE plane never demotes,
+        but a plane that ANSWERS and names another owner always does —
+        and everything held under the stale mastership is discarded,
+        never replayed."""
+        m = _master(store, **self._outage_opts())
+        try:
+            assert wait_until(lambda: m.scheduler.is_master, timeout=5)
+            mon = m.scheduler.coordination_health
+            FAULTS.add("coord.outage", action="error")
+            assert wait_until(lambda: mon.state() == "DEGRADED", timeout=5)
+            assert wait_until(lambda: mon.held.depth() >= 3, timeout=5)
+            assert m.scheduler.is_master  # get()->value unchanged: sticky
+            # Now the plane *answers* with a different owner (only the
+            # ping fault is armed; reads still work): fencing fires.
+            InMemoryCoordination(store).set(MASTER_KEY, "10.9.9.9:1",
+                                            ttl_s=30)
+            assert wait_until(lambda: not m.scheduler.is_master, timeout=5)
+            # The election-gated holds were discarded, never replayed.
+            # (The sharded LOADFRAME publish is shard-owner-gated, not
+            # election-gated, so it may legitimately re-accumulate on
+            # the demoted-but-still-degraded frontend.)
+            master_kinds = {"kvframe_publish", "loadmetrics_upload",
+                            "planner_publish", "autoscaler_tick"}
+            assert not any(a["kind"] in master_kinds
+                           for a in mon.held.report()["actions"])
+            discards = RECORDER.recent(limit=50,
+                                       kind="held_action_discarded")
+            assert discards and any(
+                "demoted" in r["detail"].get("discard_reason", "")
+                for r in discards)
+            # Still degraded (ping still failing) — demotion and plane
+            # health are independent verdicts.
+            assert mon.degraded()
+        finally:
+            FAULTS.clear()
+            m.stop()
+
+    def test_total_outage_static_stability_over_tcp(self):
+        """The full drill, over the real wire: kill the coordination
+        server mid-stream, serve through a multi-second total outage
+        (byte-identical stream, zero spurious SUSPECT, sticky
+        mastership), kill an engine DURING the outage (detected via
+        silence, eviction held), restart the server empty on the same
+        port, and assert storm-free convergence: monitors CONNECTED,
+        fleet re-registered, held eviction replayed, traffic flowing."""
+        srv = CoordinationServer(host="127.0.0.1", port=0)
+        srv.start_background()
+        port = srv.port
+        addr = f"127.0.0.1:{port}"
+
+        def tcp_master(**kw):
+            m = Master(_opts(coordination_addr=addr,
+                             **self._outage_opts(**kw)))
+            m.start()
+            return m
+
+        def tcp_engine(delay_s=0.0):
+            coord = TcpCoordinationClient(addr,
+                                          reconnect_max_backoff_s=0.15)
+            cfg = FakeEngineConfig(reply_text=REPLY, chunk_size=4,
+                                   delay_s=delay_s,
+                                   heartbeat_interval_s=0.1,
+                                   lease_ttl_s=0.5, telemetry_mode="mux")
+            return FakeEngine(coord, cfg).start()
+
+        m1 = m2 = chatty = doomed = None
+        srv2 = None
+        stop_sampler = threading.Event()
+        spurious: list = []
+
+        def sample():
+            # High-frequency spurious-verdict detector: the chatty
+            # engine must never be SUSPECTed or deregistered, on EITHER
+            # frontend, at any instant of the drill.
+            while not stop_sampler.wait(0.01):
+                for m in (m1, m2):
+                    mgr = m.scheduler.instance_mgr
+                    st = mgr.get_instance_state(chatty.name)
+                    if st in (InstanceRuntimeState.SUSPECT,
+                              InstanceRuntimeState.LEASE_LOST):
+                        spurious.append((m.scheduler.self_addr, st))
+
+        try:
+            # The elected master gets the tighter reconnect cap: after
+            # the restart it re-creates its election lease strictly
+            # before any replica's RECOVERING jitter can expire — the
+            # same ordering a production fleet gets probabilistically
+            # from the per-entity spread, pinned here for determinism.
+            m1 = tcp_master(coordination_reconnect_jitter_s=0.1)
+            m2 = tcp_master(coordination_reconnect_jitter_s=0.5)
+            chatty = tcp_engine(delay_s=0.12)
+            doomed = tcp_engine()
+            _await_plane([m1, m2], [chatty, doomed])
+            assert m1.scheduler.is_master
+            sampler = threading.Thread(target=sample, daemon=True)
+            sampler.start()
+
+            # Kill the server mid-stream: the stream must finish
+            # byte-identical — the data plane never touches coordination.
+            text, finishes = _stream_completion(
+                m1, after_frames=3, hook=srv.kill)
+            assert text == REPLY and finishes == ["stop"]
+            mons = [m1.scheduler.coordination_health,
+                    m2.scheduler.coordination_health]
+            assert wait_until(
+                lambda: all(mon.state() == "DEGRADED" for mon in mons),
+                timeout=5)
+            assert m1.scheduler.is_master  # sticky mastership
+            assert not m2.scheduler.is_master  # no takeover storm
+            # Serving continues DURING the outage, on both frontends.
+            assert _completion(m1) == REPLY
+            assert _completion(m2) == REPLY
+            # An engine dying mid-outage is still detected — via direct
+            # heartbeat silence on its telemetry owner — and its
+            # eviction held for post-recovery replay.
+            owner_m = m1 if m1.scheduler.ownership.owns_instance(
+                doomed.name) else m2
+            doomed.kill()
+            assert wait_until(
+                lambda: owner_m.scheduler.instance_mgr.get_instance_state(
+                    doomed.name) == InstanceRuntimeState.SUSPECT,
+                timeout=5)
+            own_mon = owner_m.scheduler.coordination_health
+            assert wait_until(
+                lambda: any(a["kind"] == "evict"
+                            and a["key"] == doomed.name
+                            for a in own_mon.held.report()["actions"]),
+                timeout=5)
+
+            # Restart EMPTY on the same port (process restart semantics):
+            # clients reconnect with jittered backoff, re-create their
+            # leases, resync watches; monitors walk RECOVERING (spread by
+            # per-entity jitter) back to CONNECTED.
+            srv2 = CoordinationServer(host="127.0.0.1", port=port)
+            srv2.start_background()
+            assert wait_until(
+                lambda: all(mon.state() == "CONNECTED" for mon in mons),
+                timeout=15)
+            assert m1.scheduler.is_master  # survived its own restart race
+            assert not m2.scheduler.is_master
+            assert m1.scheduler._coord.reconnects_total >= 1
+            # The fleet re-registered (keepalive re-created the leases).
+            kvs = m1.scheduler._coord.get_prefix(SERVICE_KEY_PREFIX)
+            # MASTER_KEY shares the service prefix; the other two
+            # entries are the frontends' re-created leases.
+            assert len([k for k in kvs if k != MASTER_KEY]) == 2
+            # The held eviction replayed: the dead engine is gone from
+            # every frontend; the chatty one is ACTIVE everywhere.
+            assert wait_until(
+                lambda: all(
+                    m.scheduler.instance_mgr.get_instance_meta(doomed.name)
+                    is None for m in (m1, m2)), timeout=10)
+            assert all(
+                m.scheduler.instance_mgr.get_instance_state(chatty.name)
+                == InstanceRuntimeState.ACTIVE for m in (m1, m2))
+            stop_sampler.set()
+            sampler.join(timeout=5)
+            assert not spurious, f"spurious verdicts: {spurious[:5]}"
+            # Post-recovery traffic, both frontends.
+            assert _completion(m1) == REPLY
+            assert _completion(m2) == REPLY
+        finally:
+            stop_sampler.set()
+            for e in (chatty, doomed):
+                if e is not None:
+                    e.stop()
+                    e.coord.close()
+            for m in (m1, m2):
+                if m is not None:
+                    m.stop()
+            for s in (srv, srv2):
+                if s is not None:
+                    try:
+                        s.stop()
+                    except OSError:
+                        pass

@@ -339,12 +339,17 @@ class AutoscalerController:
     def __init__(self, options: ServiceOptions, instance_mgr,
                  actuator, planner=None,
                  is_master_fn: Optional[Callable[[], bool]] = None,
-                 slo_monitor=None):
+                 slo_monitor=None,
+                 degraded_fn: Optional[Callable[[], bool]] = None):
         self._opts = options
         self._mgr = instance_mgr
         self._actuator = actuator
         self._planner = planner
         self._is_master_fn = is_master_fn or (lambda: True)
+        # Coordination-plane health gate: while the plane is degraded the
+        # controller suspends entirely (scale/drain/flip all mutate fleet
+        # ownership — exactly the actions held during an outage).
+        self._degraded_fn = degraded_fn or (lambda: False)
         self._slo = slo_monitor if slo_monitor is not None else SLO_MONITOR
         self._cfg = AutoscalerConfig.from_options(options)
         self._enabled = bool(options.autoscaler_enabled)
@@ -387,6 +392,13 @@ class AutoscalerController:
         if not self._enabled:
             return None
         if not self._is_master_fn():
+            return None
+        if self._degraded_fn():
+            # Coordination outage: the fleet census is frozen and
+            # last-known-good — scaling decisions off it would churn a
+            # healthy fleet. The scheduler records the suppression in
+            # the held-action log; enactment resumes with live state
+            # after recovery.
             return None
         now_s = time.monotonic()
         inputs = self._gather(now_s, plan)

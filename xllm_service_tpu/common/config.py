@@ -263,6 +263,37 @@ class ServiceOptions:
     # bench baseline and the mixed-version escape hatch. A string knob,
     # not a bool: store_true CLI bools can't be turned off.
     telemetry_ingest_mode: str = "shard"
+    # --- coordination-plane static stability (ISSUE 16) ---
+    # Degraded-mode serving when the coordination plane is unreachable:
+    # the health monitor classifies CONNECTED -> DEGRADED -> RECOVERING
+    # from client-side evidence; while degraded the fleet census is
+    # frozen (lease lapses stop producing SUSPECT/evict — liveness falls
+    # back to direct heartbeat silence over the mux sessions), the
+    # elected master stays sticky, and ownership-changing actions are
+    # held in a bounded log for replay-or-discard at recovery. "on" /
+    # "off" — a string knob, not a bool: store_true CLI bools can't be
+    # turned off, and the outage bench needs the control leg.
+    coordination_degraded_mode: str = "on"
+    # Consecutive failed plane probes (one per sync tick) before the
+    # monitor declares DEGRADED. 2 ticks x sync_interval_s rides out a
+    # single blip without engaging the freeze.
+    coordination_degraded_after_ticks: int = 2
+    # While degraded: an owned instance whose direct heartbeats (mux
+    # session) have been silent this long goes SUSPECT anyway — the
+    # silent-AND-lease-lapsed instance still dies; a chatty one never
+    # does. Deliberately longer than heartbeat_silence_to_suspect_s:
+    # without lease-lapse corroboration, silence alone needs more
+    # benefit of the doubt.
+    degraded_heartbeat_silence_s: float = 10.0
+    # Recovery storm damping: each entity (master, engine agent) delays
+    # its post-outage re-assertion by a deterministic per-entity jitter
+    # drawn from [0, this window), so re-registrations spread instead of
+    # thundering the just-recovered plane. Also caps the coordination
+    # client's randomized reconnect backoff.
+    coordination_reconnect_jitter_s: float = 5.0
+    # Bound on the held-actions log (oldest coalesced entries are
+    # dropped-and-counted beyond it).
+    coordination_held_log_capacity: int = 256
     # Handoff delta journal (exact replay dedup): how long the owner
     # keeps buffering a relayed stream's deltas after the relay
     # connection breaks, waiting for a reconnect — beyond it the request

@@ -16,6 +16,7 @@ point                where it is checked
 ``rpc.get``          `rpc/channel.py` before every GET attempt
 ``coord.call``       `coordination/client.py` before each request
 ``coord.connect``    `coordination/client.py` on every (re)connect
+``coord.outage``     `coordination/memory.py` plane liveness ping
 ``kv_transfer.offer``  `engine/kv_transfer.py` prefill-side offer
 ``kv_transfer.pull``   `engine/kv_transfer.py` decode-side pull
 ``engine.accept``    `testing/fake_engine.py` request admission
@@ -64,6 +65,7 @@ FAULT_POINTS: dict[str, str] = {
     "rpc.get": "rpc/channel.py before every GET attempt",
     "coord.call": "coordination/client.py before each request",
     "coord.connect": "coordination/client.py on every (re)connect",
+    "coord.outage": "coordination/memory.py plane liveness ping",
     "kv_transfer.offer": "engine/kv_transfer.py prefill-side offer",
     "kv_transfer.pull": "engine/kv_transfer.py decode-side pull",
     "engine.accept": "testing/fake_engine.py request admission",

@@ -542,3 +542,30 @@ LOADINFO_AGE_SECONDS = REGISTRY.gauge(
     "never updated) — the staleness signal SLO/CAR scoring discounts by, "
     "now observable instead of inferred",
     labelnames=("instance",))
+
+# Coordination-plane static stability (ISSUE 16): degraded-mode serving
+# when the coordination plane is unreachable — plane health, outage
+# accounting, reconnect churn, and the held-actions backlog.
+COORDINATION_CONNECTED = REGISTRY.gauge(
+    "coordination_connected",
+    "1 while the coordination plane answers liveness probes, 0 while "
+    "the health monitor classifies it DEGRADED/RECOVERING")
+COORDINATION_DEGRADED_SECONDS_TOTAL = REGISTRY.counter(
+    "coordination_degraded_seconds_total",
+    "Cumulative seconds this frontend spent serving in degraded mode "
+    "(coordination plane unreachable; census frozen, mastership sticky)")
+COORDINATION_RECONNECTS_TOTAL = REGISTRY.counter(
+    "coordination_reconnects_total",
+    "Successful coordination-client reconnects (each one re-auths, "
+    "re-subscribes watches, and re-establishes leased keys)")
+COORDINATION_HELD_ACTIONS = REGISTRY.gauge(
+    "coordination_held_actions",
+    "Depth of the held-actions log: ownership-changing actions "
+    "(evictions, drains, flips, frame publishes, autoscaler enactment) "
+    "suspended while the coordination plane is degraded")
+COORDINATION_FROZEN_EVENTS_TOTAL = REGISTRY.counter(
+    "coordination_frozen_events_total",
+    "Census events ignored under the degraded-mode freeze (lease-lapse "
+    "verdicts and missed-lease sweeps suppressed while the plane is "
+    "down)",
+    labelnames=("kind",))

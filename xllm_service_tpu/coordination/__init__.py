@@ -25,12 +25,14 @@ __all__ = [
 ]
 
 
-def connect(addr: str = "", namespace: str = "", username: str = "", password: str = ""):
+def connect(addr: str = "", namespace: str = "", username: str = "",
+            password: str = "", reconnect_max_backoff_s: float = 2.0):
     """Create a coordination client: empty addr -> shared in-memory backend;
     'host:port' -> TCP client to a coordination server."""
     if not addr:
         return InMemoryCoordination.shared(namespace=namespace)
     from .client import TcpCoordinationClient
 
-    return TcpCoordinationClient(addr, namespace=namespace,
-                                 username=username, password=password)
+    return TcpCoordinationClient(
+        addr, namespace=namespace, username=username, password=password,
+        reconnect_max_backoff_s=reconnect_max_backoff_s)

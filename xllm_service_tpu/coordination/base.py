@@ -96,6 +96,13 @@ class CoordinationClient(abc.ABC):
         self.bulk_rm(rm_keys)
         return self.bulk_set(kvs)
 
+    def ping(self) -> bool:
+        """Liveness probe of the coordination PLANE itself (not any key):
+        the client-side evidence the degraded-mode health monitor
+        classifies CONNECTED -> DEGRADED from. Backends that can lose
+        connectivity override; the default is always-reachable."""
+        return True
+
     @abc.abstractmethod
     def release(self, key: str) -> None:
         """Stop keepalive for a leased key (lease then expires naturally)."""

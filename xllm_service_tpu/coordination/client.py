@@ -18,8 +18,9 @@ from typing import Optional
 
 from .base import CoordinationClient, KeyEvent, WatchCallback, WatchEventType
 from ..common.faults import FAULTS, FaultInjected
+from ..common.metrics import COORDINATION_RECONNECTS_TOTAL
 from ..devtools.locks import make_lock
-from ..utils import get_logger
+from ..utils import get_logger, jittered_backoff
 
 logger = get_logger(__name__)
 
@@ -37,10 +38,23 @@ class TcpCoordinationClient(CoordinationClient):
 
     def __init__(self, addr: str, namespace: str = "",
                  username: str = "", password: str = "",
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0,
+                 reconnect_max_backoff_s: float = 2.0):
         host, _, port = addr.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._auth = (username, password) if username else None
+        # Reconnect backoff cap; each attempt's delay is exponential AND
+        # randomized (jittered_backoff) so a fleet of clients that lost
+        # the same server does not retry in lockstep — the reconnect
+        # storm the degraded-mode recovery path must avoid.
+        self._reconnect_max_backoff_s = max(0.1, reconnect_max_backoff_s)
+        # Client-side plane evidence for the degraded-mode health
+        # monitor: False from the moment the reader detects connection
+        # death until the full session (auth + watches + leases) is
+        # re-established. Single-assignment bool (GIL-atomic), written
+        # only by the reader thread after __init__.
+        self.connected = True
+        self.reconnects_total = 0
         self._wlock = make_lock("coord_client.write", order=30)  # lock-order: 30
         self._ns = namespace.strip("/")
         self._ids = itertools.count(1)
@@ -107,16 +121,25 @@ class TcpCoordinationClient(CoordinationClient):
     def _reconnect_loop(self) -> bool:
         """Re-establish the connection + session state. Returns False if
         the client was closed while retrying."""
-        backoff = 0.1
+        attempt = 0
         while not self._closed.is_set():
             try:
                 self._connect()
             except (OSError, FaultInjected):
-                if self._closed.wait(backoff):
+                if self._closed.wait(jittered_backoff(
+                        0.1, self._reconnect_max_backoff_s, attempt)):
                     return False
-                backoff = min(backoff * 2, 2.0)
+                attempt += 1
                 continue
             logger.info("coordination reconnected to %s:%d", *self._addr)
+            # Bounded session establishment: the auth/resync/ping reads
+            # below must not block forever on a half-open socket (the
+            # timeout is lifted again before normal — idle-tolerant —
+            # watch reads resume).
+            try:
+                self._sock.settimeout(min(5.0, self._timeout_s))
+            except OSError:
+                continue
             if self._auth:
                 # Synchronous auth exchange (we ARE the reader thread here,
                 # so reading the response line directly is safe). A silent
@@ -130,9 +153,10 @@ class TcpCoordinationClient(CoordinationClient):
                         logger.error("coordination re-auth REJECTED after "
                                      "reconnect; retrying connection")
                         self._sock.close()
-                        if self._closed.wait(backoff):
+                        if self._closed.wait(jittered_backoff(
+                                0.1, self._reconnect_max_backoff_s, attempt)):
                             return False
-                        backoff = min(backoff * 2, 2.0)
+                        attempt += 1
                         continue
                 except (OSError, ValueError):
                     continue
@@ -154,6 +178,32 @@ class TcpCoordinationClient(CoordinationClient):
             # discovery (a registration or eviction that happened while we
             # were down would otherwise never reach the watchers).
             self._resync_watches()
+            # Liveness check before declaring the session good: a connect
+            # that raced a dying server can complete the TCP handshake in
+            # the kernel's accept backlog with no process behind it — the
+            # resync above then no-ops per watch and we would flag
+            # `connected` on a socket the next write discovers is dead.
+            resp = self._request_on_reader({"op": "ping"})
+            if not resp or not resp.get("ok"):
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                # Any call that raced onto this rejected connection
+                # must not ride out its full timeout.
+                self._fail_pending()
+                if self._closed.wait(jittered_backoff(
+                        0.1, self._reconnect_max_backoff_s, attempt)):
+                    return False
+                attempt += 1
+                continue
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                continue
+            self.reconnects_total += 1
+            COORDINATION_RECONNECTS_TOTAL.inc()
+            self.connected = True
             return True
         return False
 
@@ -228,6 +278,7 @@ class TcpCoordinationClient(CoordinationClient):
     def _read_loop(self) -> None:
         while not self._closed.is_set():
             self._read_one_connection()
+            self.connected = False
             if self._closed.is_set():
                 break
             # Close the dead socket so concurrent writers fail fast instead
@@ -309,9 +360,16 @@ class TcpCoordinationClient(CoordinationClient):
         except (OSError, ValueError):
             pass
 
-    def _call(self, req: dict) -> dict:
+    def _call(self, req: dict, timeout_s: Optional[float] = None) -> dict:
         if self._closed.is_set():
             return {"ok": False, "error": "client closed"}
+        if not self.connected:
+            # Fail fast while the reader is mid-reconnect: a call sent
+            # on a half-established socket would ride in _pending until
+            # its full timeout (nothing fails it if the reconnect
+            # attempt is later rejected), stalling the caller — which
+            # during an outage is the scheduler's sync tick itself.
+            return {"ok": False, "error": "disconnected"}
         rule = FAULTS.fire("coord.call", op=req.get("op"))
         if rule is not None:
             if rule.action == "disconnect":
@@ -340,7 +398,8 @@ class TcpCoordinationClient(CoordinationClient):
             with self._plock:
                 self._pending.pop(rid, None)
             return {"ok": False, "error": str(e)}
-        if not ev.wait(self._timeout_s):
+        if not ev.wait(timeout_s if timeout_s is not None
+                       else self._timeout_s):
             with self._plock:
                 self._pending.pop(rid, None)
             return {"ok": False, "error": "timeout"}
@@ -375,6 +434,20 @@ class TcpCoordinationClient(CoordinationClient):
                                 self._keepalives.pop(key, None)
 
     # ---- CoordinationClient ------------------------------------------------
+    def ping(self) -> bool:
+        """Plane liveness probe (degraded-mode monitor evidence): a real
+        round-trip, so a half-open connection reads as down — unlike
+        `get`, whose None conflates missing-key with unreachable."""
+        if not self.connected:
+            return False
+        # Short dedicated timeout: the probe runs on the scheduler-sync
+        # cadence, and a probe that stalls for the full call timeout
+        # would stall the sync tick itself — the probe's whole job is to
+        # answer "up or not" faster than that.
+        return bool(self._call({"op": "ping"},
+                               timeout_s=min(1.0, self._timeout_s))
+                    .get("ok"))
+
     def set(self, key, value, ttl_s=None, keepalive=True) -> bool:
         ok = self._call({"op": "put", "key": self._k(key), "value": value,
                          "ttl": ttl_s}).get("ok", False)

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Mapping, Optional
 
 from .base import CoordinationClient, KeyEvent, WatchCallback, WatchEventType
+from ..common.faults import FAULTS
 from ..devtools.locks import make_lock
 
 
@@ -253,6 +254,15 @@ class InMemoryCoordination(CoordinationClient):
                 self._store.refresh(key, ttl)
 
     # ---- CoordinationClient ------------------------------------------------
+    def ping(self) -> bool:
+        # Hermetic plane-outage simulation: a scripted `coord.outage`
+        # fault fails the liveness probe, so the degraded-mode health
+        # monitor can be drilled to DEGRADED/RECOVERING without a real
+        # TCP coordination server to kill.
+        if FAULTS.fire("coord.outage") is not None:
+            return False
+        return True
+
     def set(self, key, value, ttl_s=None, keepalive=True) -> bool:
         ok = self._store.put(self._k(key), value, ttl_s)
         if ok and ttl_s and keepalive:

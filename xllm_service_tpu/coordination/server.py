@@ -26,6 +26,7 @@ import json
 import socket
 import socketserver
 import threading
+import time
 from typing import Optional
 
 from .base import KeyEvent
@@ -44,6 +45,7 @@ class _Conn(socketserver.BaseRequestHandler):
         self.watch_ids: dict[int, int] = {}   # client watch id -> store watch id
         self.authed = not self.server.auth    # type: ignore[attr-defined]
         self.rfile = self.request.makefile("rb")
+        self.server.note_accept(self)         # type: ignore[attr-defined]
 
     def _send(self, obj: dict) -> None:
         data = (json.dumps(obj) + "\n").encode()
@@ -130,25 +132,68 @@ class _Conn(socketserver.BaseRequestHandler):
         store: MemoryStore = self.server.store  # type: ignore[attr-defined]
         for swid in self.watch_ids.values():
             store.remove_watch(swid)
+        self.server.note_close(self)          # type: ignore[attr-defined]
 
 
 class CoordinationServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
+    #: Accept-log bound: enough to audit a full fleet's post-outage
+    #: reconnect storm without unbounded growth in long-lived servers.
+    ACCEPT_LOG_CAPACITY = 4096
+
     def __init__(self, host: str = "0.0.0.0", port: int = 2379,
                  auth: Optional[tuple[str, str]] = None,
-                 store: Optional[MemoryStore] = None):
+                 store: Optional[MemoryStore] = None,
+                 accept_log_path: str = ""):
         self.store = store or MemoryStore()
         self.auth = auth
+        # Connection bookkeeping + bounded accept log (timestamps of
+        # every accepted connection): the outage bench reads the accept
+        # spread after a restart to verify recovery is storm-free.
+        self._conn_lock = make_lock("coord_server.conns", order=38)  # lock-order: 38
+        self._conns: set = set()
+        self.accept_log: list[float] = []
+        self._accept_log_path = accept_log_path
         super().__init__((host, port), _Conn)
 
     @property
     def port(self) -> int:
         return self.server_address[1]
 
+    def note_accept(self, conn) -> None:
+        ts = time.time()
+        with self._conn_lock:
+            self._conns.add(conn)
+            self.accept_log.append(ts)
+            if len(self.accept_log) > self.ACCEPT_LOG_CAPACITY:
+                del self.accept_log[:len(self.accept_log)
+                                    - self.ACCEPT_LOG_CAPACITY]
+        if self._accept_log_path:
+            try:
+                with open(self._accept_log_path, "a") as f:
+                    f.write(f"{ts:.6f}\n")
+            except OSError:
+                pass
+        logger.debug("accepted coordination connection (%d live)",
+                     len(self._conns))
+
+    def note_close(self, conn) -> None:
+        with self._conn_lock:
+            self._conns.discard(conn)
+
     def start_background(self) -> threading.Thread:
-        t = threading.Thread(target=self.serve_forever, name="coord-server",
+        def _serve() -> None:
+            try:
+                self.serve_forever()
+            except OSError:
+                # kill() closes the listener out from under the poll
+                # loop — that IS the simulated process death, not an
+                # error worth a thread traceback.
+                pass
+
+        t = threading.Thread(target=_serve, name="coord-server",
                              daemon=True)
         t.start()
         return t
@@ -158,6 +203,42 @@ class CoordinationServer(socketserver.ThreadingTCPServer):
         self.server_close()
         self.store.close()
 
+    def kill(self) -> None:
+        """Simulate abrupt process death (the chaos drills' killable
+        hook): sever every live client connection mid-stream and close
+        the listener WITHOUT the graceful teardown — clients see ECONNRESET
+        exactly as if the process got SIGKILLed. The store is dropped
+        with it (a restarted server starts empty, like a fresh
+        process)."""
+        # The LISTENER dies first: shutdown()'s serve_forever poll
+        # window (≤0.5s) would otherwise keep accepting the just-severed
+        # clients' instant reconnects into zombie handler threads — a
+        # half-dead server no real SIGKILL produces.
+        try:
+            self.socket.close()
+        except OSError:
+            pass
+        self.shutdown()
+        # Sever every connection accepted up to the listener close
+        # (snapshot AFTER shutdown so a straggler accepted during the
+        # race is included).
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.request.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.request.close()
+            except OSError:
+                pass
+        try:
+            self.server_close()
+        except OSError:
+            pass
+        self.store.close()
+
 
 def main() -> None:
     p = argparse.ArgumentParser(description="xllm-service-tpu coordination server")
@@ -165,9 +246,14 @@ def main() -> None:
     p.add_argument("--port", type=int, default=2379)
     p.add_argument("--username", default="")
     p.add_argument("--password", default="")
+    p.add_argument("--accept-log", default="",
+                   help="append an epoch timestamp per accepted "
+                        "connection to this file (outage-bench reconnect "
+                        "spread audit)")
     args = p.parse_args()
     auth = (args.username, args.password) if args.username else None
-    srv = CoordinationServer(args.host, args.port, auth=auth)
+    srv = CoordinationServer(args.host, args.port, auth=auth,
+                             accept_log_path=args.accept_log)
     logger.info("coordination server listening on %s:%d", args.host, srv.port)
     try:
         srv.serve_forever()

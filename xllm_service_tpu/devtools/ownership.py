@@ -113,6 +113,10 @@ STATE_DISCIPLINES: dict[str, str] = {
     "Scheduler.self_addr": "init-only",
     "Scheduler._opts": "init-only",
     "Scheduler._coord": "init-only",
+    # Coordination-plane health monitor (degraded-mode serving): the
+    # object is constructed once; all its mutable state lives behind its
+    # own leaf lock (see CoordinationHealthMonitor below).
+    "Scheduler.coordination_health": "init-only",
     # --------------------------------------------------------- InstanceMgr
     "InstanceMgr._snapshot": "rcu",
     "InstanceMgr._load_infos": "rcu",
@@ -130,6 +134,11 @@ STATE_DISCIPLINES: dict[str, str] = {
     "InstanceMgr._watch_ids": "confined:mastership",
     "InstanceMgr._opts": "init-only",
     "InstanceMgr._coord": "init-only",
+    "InstanceMgr._health": "init-only",
+    # Post-outage missed-DELETE sweep deadline: armed by the recovery
+    # callback, consumed by the reconcile pass — both under the cluster
+    # lock.
+    "InstanceMgr._post_outage_sweep_until_ms": "lock:_cluster_lock",
     # Sharded telemetry-ingest plane (ISSUE 15). The frame inputs are
     # OWNER-GATED: only the master that owns an instance's telemetry
     # under the rendezvous shard map may coalesce its beats into the
@@ -224,6 +233,7 @@ STATE_DISCIPLINES: dict[str, str] = {
     "AutoscalerController._actuator": "init-only",
     "AutoscalerController._planner": "init-only",
     "AutoscalerController._is_master_fn": "init-only",
+    "AutoscalerController._degraded_fn": "init-only",
     "AutoscalerController._slo": "init-only",
     "AutoscalerController._cfg": "init-only",
     "AutoscalerController._enabled": "init-only",
@@ -263,6 +273,33 @@ STATE_DISCIPLINES: dict[str, str] = {
     "BrownoutController._recover_streak": "lock:_lock",
     "BrownoutController._entered_total": "lock:_lock",
     "BrownoutController._log": "lock:_lock",
+    # ------------------------------------- CoordinationHealthMonitor (ISSUE 16)
+    # Degraded-mode plane classifier (coordination/health.py): state
+    # machine stepped by the sync thread's tick, queried (degraded()) and
+    # fed (hold()/note_frozen()) from the reconcile and watch-dispatch
+    # threads — all behind one leaf lock (order 26). The held-action log
+    # shares that lock. `_entity` follows the post-bind re-registration
+    # (escaped write site, same as Scheduler.self_addr).
+    "CoordinationHealthMonitor._state": "lock:_lock",
+    "CoordinationHealthMonitor._consec_failures": "lock:_lock",
+    "CoordinationHealthMonitor._outage_started_mono": "lock:_lock",
+    "CoordinationHealthMonitor._outage_started_unix": "lock:_lock",
+    "CoordinationHealthMonitor._recover_at_mono": "lock:_lock",
+    "CoordinationHealthMonitor._last_tick_mono": "lock:_lock",
+    "CoordinationHealthMonitor._outages_total": "lock:_lock",
+    "CoordinationHealthMonitor._frozen_events": "lock:_lock",
+    "CoordinationHealthMonitor._entity": "init-only",
+    "CoordinationHealthMonitor._coord": "init-only",
+    "CoordinationHealthMonitor._enabled": "init-only",
+    "CoordinationHealthMonitor._after_ticks": "init-only",
+    "CoordinationHealthMonitor._jitter_window_s": "init-only",
+    "CoordinationHealthMonitor.held": "init-only",
+    "CoordinationHealthMonitor.on_degraded": "init-only",
+    "CoordinationHealthMonitor.on_recovered": "init-only",
+    "HeldActionLog._items": "lock:_lock",
+    "HeldActionLog._order": "lock:_lock",
+    "HeldActionLog._dropped": "lock:_lock",
+    "HeldActionLog._capacity": "init-only",
     # --------------------------------------------------------- RetryBudget
     # Global retry token bucket (overload/retry_budget.py): deposits
     # from accept threads, withdrawals from failover/relay threads.
@@ -317,6 +354,8 @@ STATE_CLASSES: tuple = (
     "LocalProcessActuator",
     "AdmissionController",
     "BrownoutController",
+    "CoordinationHealthMonitor",
+    "HeldActionLog",
     "RetryBudget",
     "CircuitBreaker",
 )
@@ -335,6 +374,12 @@ THREAD_ROLES: dict[str, dict] = {
         "entries": (
             "Scheduler._on_master_event",
             "Scheduler.sync_once",
+            # Post-outage recovery runs on the sync thread but is
+            # reached via the health monitor's on_recovered callback, so
+            # the static call-site resolution needs the explicit entry
+            # (same for the takeover helper it shares with the watch).
+            "Scheduler._recover_from_outage",
+            "Scheduler._try_takeover",
             "InstanceMgr.set_as_master",
             "InstanceMgr.set_as_replica",
             "GlobalKVCacheMgr.set_as_master",

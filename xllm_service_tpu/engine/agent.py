@@ -128,6 +128,14 @@ class AgentConfig:
     # to the rendezvous owner but deltas direct per-dest, "master" = the
     # legacy funnel (heartbeats to the elected master only).
     telemetry_mode: str = "mux"
+    # Coordination-plane static stability: "on" keeps heartbeats flowing
+    # to the last-known-good telemetry owner / elected master while the
+    # coordination plane is unreachable (owner resolution comes back
+    # empty), so the masters' degraded-mode liveness fallback (direct
+    # heartbeat silence) sees this engine alive through a total outage.
+    # "off" restores the legacy behavior: no resolvable target, no
+    # beats.
+    degraded_mode: str = "on"
     slice_id: str = "slice-0"
     # Model replicas behind this one registration (reference dp_size,
     # `xllm_rpc_service.proto:40-43`): each replica is an independent
@@ -482,8 +490,13 @@ class EngineAgent:
         from ..multimaster import TelemetryOwnerResolver
         from ..rpc.channel import make_keepalive_session
         self.telemetry_session = make_keepalive_session()
-        self.telemetry_owner = TelemetryOwnerResolver(self.coord, self.name)
+        self.telemetry_owner = TelemetryOwnerResolver(
+            self.coord, self.name,
+            hold_last_owner=agent_cfg.degraded_mode != "off")
         self._telemetry_mode = agent_cfg.telemetry_mode
+        # Last master address that resolved ("master" funnel mode): the
+        # degraded-mode fallback target while the plane is unreachable.
+        self._last_master = ""
         # Pass the agent itself: cancel() fans out across replicas.
         self.streamer = GenerationStreamer(
             self, agent_cfg.generation_flush_ms,
@@ -780,6 +793,14 @@ class EngineAgent:
                 # legacy funnel for mixed-version fleets.
                 if self._telemetry_mode == "master":
                     target = self.coord.get(MASTER_KEY) or ""
+                    if target:
+                        self._last_master = target
+                    elif self.cfg.degraded_mode != "off":
+                        # Static stability: an unreachable plane
+                        # resolves no master — keep beating at the last
+                        # one that did (the owner path holds inside the
+                        # resolver).
+                        target = self._last_master
                 else:
                     target = self.telemetry_owner()
                 if not target:
@@ -1870,6 +1891,11 @@ def main() -> None:
                         "owning master (tagged hb+gens frames); owner = "
                         "heartbeats to the rendezvous owner, deltas "
                         "direct; master = legacy elected-master funnel")
+    p.add_argument("--degraded-mode", default="on", choices=["on", "off"],
+                   help="on = keep heartbeats flowing to the last-known-"
+                        "good master while the coordination plane is "
+                        "unreachable (static stability); off = legacy "
+                        "behavior (no resolvable target, no beats)")
     args = p.parse_args()
 
     # Multi-host: join the process group (XLLM_MH_COORDINATOR /
@@ -2013,7 +2039,8 @@ def main() -> None:
                           tokenizer_path=args.tokenizer_path,
                           generation_flush_ms=args.generation_flush_ms,
                           dp_size=args.dp_size,
-                          telemetry_mode=args.telemetry_mode),
+                          telemetry_mode=args.telemetry_mode,
+                          degraded_mode=args.degraded_mode),
         params=params)
     agent.start()
     import signal as _signal

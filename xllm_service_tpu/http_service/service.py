@@ -229,6 +229,7 @@ class XllmHttpService:
         app.router.add_post("/admin/config", self.handle_set_config)
         app.router.add_get("/admin/planner", self.handle_planner)
         app.router.add_get("/admin/autoscaler", self.handle_autoscaler)
+        app.router.add_get("/admin/coordination", self.handle_coordination)
         app.router.add_get("/admin/overload", self.handle_overload)
         app.router.add_get("/admin/hotpath", self.handle_hotpath)
         app.router.add_get("/admin/faults", self.handle_get_faults)
@@ -1254,6 +1255,15 @@ class XllmHttpService:
         reasons they were (or were not) taken — PlanDecision.reasons,
         but acted on."""
         return web.json_response(self.scheduler.autoscaler.report())
+
+    async def handle_coordination(self, request: web.Request) -> web.Response:
+        """Coordination-plane health (docs/robustness.md degraded mode):
+        CONNECTED/DEGRADED/RECOVERING state, probe-failure streak,
+        outage accounting, frozen census events, the held-action log,
+        and the client's reconnect counter — one page answering "is the
+        fleet serving through a coordination outage right now, and what
+        is being held back"."""
+        return web.json_response(self.scheduler.coordination_health.report())
 
     async def handle_overload(self, request: web.Request) -> web.Response:
         """Overload-hardening plane state (docs/robustness.md): the

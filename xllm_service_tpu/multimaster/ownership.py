@@ -101,12 +101,21 @@ class TelemetryOwnerResolver:
 
     FAILURE_TTL_S = 10.0
 
-    def __init__(self, coord, instance_name: str, cache_s: float = 2.0):
+    def __init__(self, coord, instance_name: str, cache_s: float = 2.0,
+                 hold_last_owner: bool = True):
         self._coord = coord
         self._name = instance_name
         self._cache_s = cache_s
         self._cached: tuple[str, float] = ("", 0.0)
         self._failed: dict[str, float] = {}
+        # Static stability: during a total coordination outage both the
+        # membership read and the MASTER_KEY fallback come back empty —
+        # with nothing else to go on, keep reporting the last owner that
+        # DID resolve, so heartbeats/deltas keep flowing over the
+        # (outage-immune) telemetry sessions instead of going silent.
+        # note_failure still overrides: an owner observed dead is dead.
+        self._hold_last_owner = hold_last_owner
+        self._last_good = ""
 
     def __call__(self) -> str:
         import time
@@ -129,6 +138,11 @@ class TelemetryOwnerResolver:
                 owner = self._coord.get(MASTER_KEY) or ""
             except Exception:  # noqa: BLE001  # xlint: allow-broad-except(same degradation contract as the membership read above)
                 owner = ""
+        if owner:
+            self._last_good = owner
+        elif self._hold_last_owner and \
+                self._last_good not in exclude:
+            owner = self._last_good
         self._cached = (owner, now + self._cache_s)
         return owner
 

@@ -214,10 +214,16 @@ class InstanceMgr:
                  is_master: bool = True,
                  channel_factory: Callable[[str, str], EngineChannel] | None = None,
                  start_threads: bool = True,
-                 ownership=None):
+                 ownership=None, health=None):
         self._coord = coord
         self._opts = options
         self._is_master = is_master
+        # Coordination-plane health monitor (scheduler-owned; None in
+        # direct-construction tests = never degraded). While it reports
+        # degraded the census is FROZEN: lease-lapse verdicts, missed-
+        # lease sweeps and ownership-changing actions are suppressed or
+        # held — liveness falls back to direct heartbeat silence.
+        self._health = health
         # Telemetry-shard map source (multimaster OwnershipRouter). None
         # (direct-construction tests, single-process embedding) degrades
         # to the legacy funnel: owns_telemetry() is uniformly True and
@@ -282,6 +288,11 @@ class InstanceMgr:
         self._owned_names: set[str] = set()
         self._shard_dirty: set[str] = set()
         self._shard_gone: dict[str, tuple[str, int]] = {}
+        # Post-outage missed-DELETE sweep window (ms deadline): lease
+        # DELETEs synthesized while the census was frozen were dropped,
+        # so for a bounded window after recovery the silence sweep also
+        # runs in funnel mode (sharded mode sweeps unconditionally).
+        self._post_outage_sweep_until_ms = 0
         self._published_owned: set[str] = set()
         self._shard_seq = 0
         self._frames_published = 0
@@ -461,6 +472,12 @@ class InstanceMgr:
                 and self._ownership is not None
                 and self._ownership.enabled)
 
+    def _frozen(self) -> bool:
+        """True while the coordination plane is degraded and the census
+        is frozen (see ctor `health`). Lock-free: the monitor guards its
+        own state."""
+        return self._health is not None and self._health.degraded()
+
     def owns_telemetry(self, name: str) -> bool:
         """Does THIS master own heartbeat/load ingest and failure
         detection for the instance? Uniformly True outside sharded mode
@@ -489,6 +506,15 @@ class InstanceMgr:
         changed since the last publish (mirrors age their entries
         locally, so an unchanged shard needs no re-publish)."""
         if not self.sharded():
+            return
+        if self._frozen():
+            # Degraded plane: don't publish frames built from a frozen
+            # view — and do NOT drain the dirty/tombstone sets, they
+            # keep accumulating as the frame-log resync material that
+            # `resync_after_outage` flushes once the plane answers.
+            self._health.hold(
+                "loadframe_publish", self._ownership.self_addr,
+                reason="plane degraded: frame publish suspended")
             return
         now = now_ms()
         rows: dict[str, dict] = {}
@@ -729,6 +755,15 @@ class InstanceMgr:
         instead of O(masters); the owner's verdict is the one built from
         the heartbeat stream it actually receives)."""
         if not self.owns_telemetry(name):
+            return
+        if self._frozen():
+            # Census freeze: during a coordination outage EVERY lease
+            # lapses (including the watch-resync's synthesized DELETEs
+            # after a server restart) — a lapse is evidence about the
+            # plane, not the instance. Liveness falls back to direct
+            # heartbeat silence (`reconcile_once` under the degraded
+            # threshold); a chatty instance never transits SUSPECT here.
+            self._health.note_frozen("lease_lapse", name)
             return
         with self._cluster_lock:
             entry = self._instances.get(name)
@@ -1021,7 +1056,17 @@ class InstanceMgr:
         to_drain_check: list[tuple[str, int]] = []
         to_probe: list[tuple[str, EngineChannel]] = []
         to_lease_check: list[tuple[str, str]] = []
+        to_failover: list[tuple[str, str, InstanceType]] = []
         shard = self.sharded()
+        frozen = self._frozen()
+        # Degraded liveness fallback: with lease evidence frozen, ACTIVE
+        # instances are judged on direct heartbeat silence over the
+        # (outage-immune) telemetry sessions — under the LONGER degraded
+        # threshold, so a chatty instance never dies and a genuinely
+        # silent one still does.
+        degraded_silence_ms = max(
+            self._opts.degraded_heartbeat_silence_s,
+            self._opts.heartbeat_silence_to_suspect_s) * 1000
         with self._cluster_lock:
             if shard:
                 owned_now = {n for n in self._instances
@@ -1046,17 +1091,45 @@ class InstanceMgr:
                 # breaker mirroring of THIS frontend's channel evidence)
                 # run everywhere.
                 owner = not shard or name in self._owned_names
-                if owner and entry.state == InstanceRuntimeState.ACTIVE \
-                        and shard \
+                if frozen and owner \
+                        and entry.state == InstanceRuntimeState.ACTIVE \
+                        and now - entry.last_heartbeat_ms \
+                        > degraded_silence_ms:
+                    # Every lease is lapsed during a total outage, so
+                    # silent here IS silent-and-lease-lapsed: exclude
+                    # from routing now; the eviction itself is held and
+                    # replayed (or discarded, if the beats resume) after
+                    # recovery.
+                    self._set_state(entry, InstanceRuntimeState.SUSPECT)
+                    logger.warning(
+                        "instance %s: ACTIVE -> SUSPECT on degraded-mode "
+                        "heartbeat silence (%dms, plane down)", name,
+                        now - entry.last_heartbeat_ms)
+                    # Bound in-flight requests fail over NOW: request
+                    # re-dispatch is data-plane and request-scoped, not
+                    # an ownership-changing action — only the census
+                    # eviction waits for recovery. Without this, streams
+                    # bound to an engine that died mid-outage would hang
+                    # until the plane returns.
+                    to_failover.append((name, entry.meta.incarnation_id,
+                                        entry.meta.type))
+                elif owner and entry.state == InstanceRuntimeState.ACTIVE \
+                        and not frozen \
+                        and (shard
+                             or now < self._post_outage_sweep_until_ms) \
                         and now - entry.last_heartbeat_ms > (
                             self._opts.heartbeat_silence_to_suspect_s
                             + self._opts.lease_ttl_s) * 1000:
                     # Missed-DELETE sweep: the lease-lapse event may have
                     # fired while ANOTHER master owned this instance (and
-                    # died before verdicting). An owned, silent, still-
-                    # ACTIVE entry is checked against coordination
-                    # outside the lock; an absent key re-runs the normal
-                    # lapse pipeline (probe -> LEASE_LOST/SUSPECT).
+                    # died before verdicting) — or was synthesized and
+                    # dropped under the census freeze during an outage
+                    # (the post-outage window extends the sweep to the
+                    # funnel mode, whose DELETE events are otherwise
+                    # reliable). An owned, silent, still-ACTIVE entry is
+                    # checked against coordination outside the lock; an
+                    # absent key re-runs the normal lapse pipeline
+                    # (probe -> LEASE_LOST/SUSPECT).
                     to_lease_check.append((name, entry.meta.type.value))
                 if owner and entry.state in (
                         InstanceRuntimeState.LEASE_LOST,
@@ -1069,7 +1142,9 @@ class InstanceMgr:
                     # every half-open probe just re-opens the breaker),
                     # stranding its bound requests away from failover.
                     silence = now - entry.last_heartbeat_ms
-                    if silence > self._opts.heartbeat_silence_to_suspect_s * 1000:
+                    threshold_ms = degraded_silence_ms if frozen else \
+                        self._opts.heartbeat_silence_to_suspect_s * 1000
+                    if silence > threshold_ms:
                         was = entry.state.value
                         self._set_state(entry, InstanceRuntimeState.SUSPECT)
                         logger.info("instance %s: %s -> SUSPECT "
@@ -1079,7 +1154,17 @@ class InstanceMgr:
                     age = now - entry.state_since_ms
                     if owner and age > \
                             self._opts.detect_disconnected_instance_interval_s * 1000:
-                        to_evict.append(name)
+                        if frozen:
+                            # Eviction is an ownership-changing action
+                            # (coordination rm + tombstone): held until
+                            # recovery, where it replays only if the
+                            # instance is STILL suspect-and-silent.
+                            self._health.hold(
+                                "evict", name,
+                                reason="plane degraded: suspect eviction "
+                                       "held")
+                        else:
+                            to_evict.append(name)
                 elif entry.state == InstanceRuntimeState.DRAINING:
                     to_drain_check.append((name, now - entry.state_since_ms))
                 elif entry.state in (InstanceRuntimeState.ACTIVE,
@@ -1131,10 +1216,23 @@ class InstanceMgr:
                 logger.info("owned instance %s silent with no lease; "
                             "running missed lapse detection", name)
                 self._handle_instance_delete(name)
+        for name, incarnation, itype in to_failover:
+            # Outside the lock, same callback deregister_instance fires:
+            # the scheduler voids the dead binding's streams and replays
+            # them onto survivors from the (frozen) routing snapshot.
+            if self.on_instance_failure is not None:
+                self.on_instance_failure(name, incarnation, itype)
         for name in to_evict:
             self.deregister_instance(name, reason="suspect eviction")
         for name, age_ms in to_drain_check:
-            if age_ms > self._opts.autoscaler_drain_deadline_s * 1000:
+            if frozen:
+                # Drain completion/deadline deregisters write to
+                # coordination — held; the drain clock keeps running and
+                # the verdict replays after recovery.
+                self._health.hold(
+                    "drain_deregister", name,
+                    reason="plane degraded: drain deregistration held")
+            elif age_ms > self._opts.autoscaler_drain_deadline_s * 1000:
                 # Deadline: something is holding requests open — cut it
                 # loose; bound requests ride the normal failover path.
                 logger.warning("instance %s blew the drain deadline "
@@ -1150,6 +1248,56 @@ class InstanceMgr:
         # SLO role flips + drains requested off-path run here, never on
         # the client's critical path.
         self.drain_pending_flips()
+
+    def resync_after_outage(self) -> None:
+        """Post-outage frame-log resync + census re-arm (sync thread,
+        called from the scheduler's recovery callback): every owned
+        instance is marked dirty so the next publish carries the FULL
+        shard (mirrors reconverge from a single frame), and the
+        missed-DELETE sweep window opens so lease lapses whose DELETE
+        events were dropped under the freeze are re-detected from
+        silence."""
+        now = now_ms()
+        window_ms = int((self._opts.degraded_heartbeat_silence_s
+                         + self._opts.heartbeat_silence_to_suspect_s
+                         + 2 * self._opts.lease_ttl_s) * 1000)
+        with self._cluster_lock:
+            names = list(self._instances)
+            self._post_outage_sweep_until_ms = now + max(window_ms, 1000)
+        if self.sharded():
+            with self._metrics_lock:
+                for n in names:
+                    if self.owns_telemetry(n):
+                        self._shard_dirty.add(n)
+
+    def replay_held_eviction(self, name: str, reason: str) -> str:
+        """Replay one held eviction verdict after recovery: evict only
+        if the instance is STILL suspect-and-silent now that the plane
+        answers — an instance whose beats resumed during the outage is
+        spared (the hold recorded a moment, not a sentence). Returns the
+        outcome string the scheduler flight-records."""
+        if self.sharded() and not self._ownership.owns_instance(name):
+            # Shard map moved while the plane was down: the verdict now
+            # belongs to another frontend, whose own silence pipeline
+            # re-derives it from live beats.
+            return "discarded: telemetry ownership moved during the outage"
+        with self._cluster_lock:
+            entry = self._instances.get(name)
+            if entry is None:
+                return "discarded: already gone"
+            silence_ms = now_ms() - entry.last_heartbeat_ms
+            state = entry.state
+        if state == InstanceRuntimeState.DRAINING:
+            # The drain books (in-flight counts, engine-reported load)
+            # are live again: the normal reconcile pass re-evaluates
+            # grace/deadline with current data.
+            return "superseded: reconcile re-evaluates the drain"
+        if state != InstanceRuntimeState.SUSPECT:
+            return "discarded: instance recovered"
+        if silence_ms <= self._opts.heartbeat_silence_to_suspect_s * 1000:
+            return "discarded: heartbeats resumed"
+        self.deregister_instance(name, reason=reason)
+        return "replayed: evicted"
 
     def _engine_reported_idle(self, name: str) -> bool:
         """True when the instance's last heartbeat reported zero waiting
@@ -1344,6 +1492,19 @@ class InstanceMgr:
             self._pending_drains.add(name)
 
     def drain_pending_flips(self) -> None:
+        if self._frozen():
+            # Flips move coordination records and drains retire fleet
+            # members — both ownership-changing. Leave the queues intact
+            # (they are idempotent sets); note the suppression once per
+            # pass so the recovery bundle shows how long they waited.
+            with self._flip_lock:
+                pending = len(self._pending_flips) + len(self._pending_drains)
+            if pending:
+                self._health.hold(
+                    "flip", "pending",
+                    reason="plane degraded: pending flips/drains "
+                           "suspended", pending=pending)
+            return
         with self._flip_lock:
             pending = dict(self._pending_flips)
             self._pending_flips.clear()
@@ -1404,6 +1565,14 @@ class InstanceMgr:
         update indices + coordination record (reference
         `flip_prefill_to_decode/flip_decode_to_prefill`,
         `instance_mgr.cpp:1023-1063`)."""
+        if self._frozen():
+            # Defense in depth (drain_pending_flips already gates): a
+            # flip moves the instance's coordination record — held.
+            self._health.hold(
+                "flip", name,
+                reason="plane degraded: role flip suspended",
+                target=new_type.value)
+            return False
         with self._cluster_lock:
             entry = self._instances.get(name)
             if entry is None:

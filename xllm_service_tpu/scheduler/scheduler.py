@@ -68,6 +68,7 @@ from ..common.types import (
 )
 from ..coordination import CoordinationClient, connect
 from ..coordination.base import KeyEvent, WatchEventType
+from ..coordination.health import CoordinationHealthMonitor, HeldAction
 from ..devtools import ownership as _ownership
 from ..devtools.locks import make_lock
 from ..overload import ADMISSION, BROWNOUT, RETRY_BUDGET
@@ -140,7 +141,8 @@ class Scheduler:
         self._opts = options
         self._coord = coord or connect(
             options.coordination_addr, options.coordination_namespace,
-            options.coordination_username, options.coordination_password)
+            options.coordination_username, options.coordination_password,
+            reconnect_max_backoff_s=options.coordination_reconnect_jitter_s)
         self.self_addr = f"{options.host}:{options.rpc_port}"
 
         # NLP components (reference `scheduler.cpp:35-58`).
@@ -156,6 +158,16 @@ class Scheduler:
         self.is_master = self._coord.create_if_absent(
             MASTER_KEY, self.self_addr, ttl_s=options.lease_ttl_s)
 
+        # Coordination-plane static stability: classify the plane
+        # CONNECTED -> DEGRADED -> RECOVERING from client-side evidence
+        # on the sync cadence. While degraded: census frozen (InstanceMgr
+        # consults it), mastership sticky, ownership-changing actions
+        # held; on recovery `_recover_from_outage` re-asserts and
+        # replays-or-discards.
+        self.coordination_health = CoordinationHealthMonitor(
+            self._coord, options, entity=self.self_addr,
+            on_recovered=self._recover_from_outage)
+
         # Multi-master service plane: every replica is an ACTIVE frontend;
         # per-request ownership is decided by rendezvous hashing over the
         # live service records this router mirrors (multimaster/).
@@ -168,7 +180,8 @@ class Scheduler:
         self.instance_mgr = InstanceMgr(self._coord, options,
                                         is_master=self.is_master,
                                         start_threads=start_threads,
-                                        ownership=self.ownership)
+                                        ownership=self.ownership,
+                                        health=self.coordination_health)
         # Pooled session for the owner->elected-master KV-event relay
         # (sharded telemetry: the index stays write-leased; see
         # handle_instance_heartbeat).
@@ -197,7 +210,8 @@ class Scheduler:
             options, self.instance_mgr,
             create_actuator(options, self._coord),
             planner=self.planner,
-            is_master_fn=lambda: self.is_master)
+            is_master_fn=lambda: self.is_master,
+            degraded_fn=self.coordination_health.degraded)
         if options.autoscaler_enabled:
             self.planner.flip_sink = self.autoscaler.propose_flip
             from .policies.slo_aware import SloAwarePolicy
@@ -247,6 +261,7 @@ class Scheduler:
         self._coord.set(SERVICE_KEY_PREFIX + addr,
                         json.dumps({"rpc_address": addr}),
                         ttl_s=self._opts.lease_ttl_s)
+        self.coordination_health.update_entity(addr)
         self.ownership.update_self_addr(addr)
         if self.is_master:
             # Overwrite in place — we hold the lease. A rm+create would fire
@@ -259,15 +274,33 @@ class Scheduler:
         `scheduler.cpp:200-217`)."""
         for ev in events:
             if ev.key == MASTER_KEY and ev.type == WatchEventType.DELETE:
-                if self._coord.create_if_absent(MASTER_KEY, self.self_addr,
-                                                ttl_s=self._opts.lease_ttl_s):
-                    logger.info("service %s promoted to master", self.self_addr)
-                    self.is_master = True
-                    self.instance_mgr.set_as_master()
-                    self.kvcache_mgr.set_as_master()
-                    if self._master_watch_id is not None:
-                        self._coord.remove_watch(self._master_watch_id)
-                        self._master_watch_id = None
+                if self.coordination_health.degraded():
+                    # Census freeze, mastership edition: during/right
+                    # after an outage this DELETE is (or may be) the
+                    # client's watch-resync synthesizing "every lease
+                    # lapsed" — NOT evidence the master died. Contending
+                    # now would flip mastership on every blip and storm
+                    # the recovering plane. `_recover_from_outage`
+                    # re-checks the key once our own jitter slot passes
+                    # and takes over then if it is genuinely vacant.
+                    self.coordination_health.note_frozen(
+                        "master_delete", ev.key)
+                    continue
+                self._try_takeover()
+
+    def _try_takeover(self) -> bool:
+        """Contend for the master key; promote on win."""
+        if self._coord.create_if_absent(MASTER_KEY, self.self_addr,
+                                        ttl_s=self._opts.lease_ttl_s):
+            logger.info("service %s promoted to master", self.self_addr)
+            self.is_master = True
+            self.instance_mgr.set_as_master()
+            self.kvcache_mgr.set_as_master()
+            if self._master_watch_id is not None:
+                self._coord.remove_watch(self._master_watch_id)
+                self._master_watch_id = None
+            return True
+        return False
 
     def _sync_loop(self) -> None:
         """Master 3s upload loop (reference `scheduler.cpp:160-168`) + stale
@@ -287,17 +320,31 @@ class Scheduler:
             return ""
 
     def sync_once(self) -> None:
+        # Probe the coordination plane first: everything below keys off
+        # whether THIS tick sees it degraded (a recovery callback fires
+        # inside tick(), so a recovered tick already runs un-frozen).
+        self.coordination_health.tick()
+        plane_degraded = self.coordination_health.degraded()
         if self.is_master:
             # Verify we still hold the election key: after a coordination
             # outage a replica may have legitimately won while our lease
             # was lapsed (the client will NOT re-assert a create_only key
             # someone else holds) — demote instead of split-braining.
+            # This check deliberately runs even while degraded — the
+            # fencing rule: an *unreachable* plane (get -> None) never
+            # demotes (sticky mastership), but a plane that ANSWERS and
+            # names someone else always does, immediately.
             owner = self._coord.get(MASTER_KEY)
             if owner is not None and owner != self.self_addr:
                 logger.warning("lost mastership to %s; demoting", owner)
                 self.is_master = False
                 self.instance_mgr.set_as_replica()
                 self.kvcache_mgr.set_as_replica()
+                # Fencing, part two: anything queued while we thought we
+                # were still the owner must never execute under the new
+                # master — discard, never replay.
+                self.coordination_health.discard_held(
+                    f"demoted: observed {owner} holding the write lease")
                 if self._master_watch_id is None:
                     self._master_watch_id = self._coord.add_watch(
                         MASTER_KEY, self._on_master_event)
@@ -305,13 +352,32 @@ class Scheduler:
         # coalesced load/lease frame for its own shard — frame keys are
         # single-writer (keyed by owner address), so this is the one
         # coordination write that deliberately bypasses the election
-        # gate. No-op outside sharded mode.
+        # gate. No-op outside sharded mode. (While degraded it holds
+        # internally and keeps accumulating dirty shards — the frame
+        # resync material.)
         try:
             self.instance_mgr.publish_telemetry_frames()
         except Exception:  # noqa: BLE001 — telemetry must not kill sync
             logger.exception("telemetry frame publish failed")
         decision = None
-        if self.is_master:
+        if self.is_master and plane_degraded:
+            # Sticky mastership: keep serving/routing from last-known-good
+            # snapshots, but suspend every coordination-publishing action
+            # into the held log (coalesced per kind, so a long outage
+            # stays one entry each).
+            h = self.coordination_health
+            h.hold("kvframe_publish", self.self_addr,
+                   reason="plane degraded: KV-frame publish suspended")
+            h.hold("loadmetrics_upload", self.self_addr,
+                   reason="plane degraded: load-metrics upload suspended")
+            h.hold("planner_publish", self.self_addr,
+                   reason="plane degraded: planner decision publish "
+                          "suspended")
+            if self._opts.autoscaler_enabled:
+                h.hold("autoscaler_tick", self.self_addr,
+                       reason="plane degraded: autoscaler enactment "
+                              "suspended")
+        elif self.is_master:
             self.kvcache_mgr.upload_kvcache()
             self.instance_mgr.upload_load_metrics()
             # Fleet-level planning (scale hints + PD-ratio correction;
@@ -338,6 +404,74 @@ class Scheduler:
         except Exception:  # noqa: BLE001 — degradation must not kill sync
             logger.exception("brownout tick failed")
         self._gc_stale_requests()
+
+    def _recover_from_outage(self) -> None:
+        """Post-outage re-assertion (sync thread; fired by the health
+        monitor once RECOVERING has waited out this entity's jitter slot
+        — the fleet-wide spread is what keeps recovery storm-free).
+        Order matters: re-register, reconcile mastership against what
+        coordination NOW says (fencing), replay-or-discard the held
+        actions, then queue a full frame-log resync."""
+        try:
+            self._coord.set(SERVICE_KEY_PREFIX + self.self_addr,
+                            json.dumps({"rpc_address": self.self_addr}),
+                            ttl_s=self._opts.lease_ttl_s)
+        except Exception:  # noqa: BLE001  # xlint: allow-broad-except(re-registration is retried by the client keepalive; a throw here must not abort held-action replay)
+            logger.exception("post-outage re-registration failed")
+        owner = self._coord.get(MASTER_KEY)
+        if self.is_master:
+            if owner is None:
+                # Our lease lapsed during the outage and nobody won yet:
+                # re-contend for our own seat.
+                if not self._coord.create_if_absent(
+                        MASTER_KEY, self.self_addr,
+                        ttl_s=self._opts.lease_ttl_s):
+                    owner = self._coord.get(MASTER_KEY)
+            if owner is not None and owner != self.self_addr:
+                logger.warning("post-outage: %s won mastership; demoting",
+                               owner)
+                self.is_master = False
+                self.instance_mgr.set_as_replica()
+                self.kvcache_mgr.set_as_replica()
+                self.coordination_health.discard_held(
+                    f"demoted: {owner} won the election during the outage")
+                if self._master_watch_id is None:
+                    self._master_watch_id = self._coord.add_watch(
+                        MASTER_KEY, self._on_master_event)
+        elif owner is None:
+            # The takeover we held while frozen (`_on_master_event`): the
+            # key is genuinely vacant now that the plane answers — the
+            # old master either died or has not re-asserted within its
+            # own jitter slot. Jitter spreads this contention too.
+            self._try_takeover()
+        for action in self.coordination_health.drain_held():
+            outcome = self._replay_held_action(action)
+            RECORDER.record("held_action_replay",
+                            detail={**action.to_dict(), "outcome": outcome})
+            logger.info("held action %s(%s) x%d -> %s",
+                        action.kind, action.key, action.count, outcome)
+        self.instance_mgr.resync_after_outage()
+
+    def _replay_held_action(self, action: HeldAction) -> str:
+        """Decide one held action's fate after recovery. Returns the
+        flight-recorded outcome string."""
+        if action.kind in ("evict", "drain_deregister"):
+            # Shard-owner verdicts, not election-gated ones: in sharded
+            # ingest the telemetry owner (master OR replica) runs the
+            # silence pipeline, so its held evictions replay here too —
+            # replay_held_eviction re-checks ownership and liveness
+            # against the recovered plane before acting.
+            return self.instance_mgr.replay_held_eviction(
+                action.key, action.reason or "post-outage replay")
+        if not self.is_master:
+            # Fencing backstop: by the time replay runs, anything queued
+            # under a mastership we no longer hold is dead.
+            return "discarded: no longer master"
+        # Publish/enact kinds (kvframe_publish, loadmetrics_upload,
+        # planner_publish, autoscaler_tick, loadframe_publish, flip):
+        # these re-derive from live state every sync tick — replaying the
+        # stale frame would publish the past over the present.
+        return "superseded: next sync tick republishes from live state"
 
     def _gc_stale_requests(self) -> None:
         """Deadline sweep: per-request deadlines (overload plane) are the

@@ -99,6 +99,13 @@ class FakeEngineConfig:
     # the owner (the bench's O(engines) connection mode); "master" keeps
     # the legacy elected-master heartbeat funnel.
     telemetry_mode: str = "owner"
+    # Coordination-plane static stability (mirror of
+    # AgentConfig.degraded_mode): "on" keeps heartbeats flowing to the
+    # last-known-good telemetry owner / elected master while the
+    # coordination plane is unreachable (owner resolution comes back
+    # empty); "off" restores the legacy collapse — no owner, no beats —
+    # which is the outage bench's control leg.
+    degraded_mode: str = "on"
 
 
 class FakeEngine:
@@ -175,8 +182,13 @@ class FakeEngine:
         # (mirrors the real agent).
         from ..multimaster import TelemetryOwnerResolver
 
-        self.telemetry_owner = TelemetryOwnerResolver(coord, self.name)
+        self.telemetry_owner = TelemetryOwnerResolver(
+            coord, self.name,
+            hold_last_owner=self.cfg.degraded_mode != "off")
         self._telemetry_mode = self.cfg.telemetry_mode
+        # Last master address that resolved ("master" funnel mode): the
+        # degraded-mode fallback target while the plane is unreachable.
+        self._last_master = ""
         self.mux_sends = 0
         self.direct_sends = 0
 
@@ -313,6 +325,13 @@ class FakeEngine:
             # the legacy elected-master funnel.
             if self._telemetry_mode == "master":
                 target = self.coord.get("XLLM:SERVICE:MASTER") or ""
+                if target:
+                    self._last_master = target
+                elif self.cfg.degraded_mode != "off":
+                    # Static stability: an unreachable plane resolves no
+                    # master — keep beating at the last one that did
+                    # (the owner path holds inside the resolver).
+                    target = self._last_master
             else:
                 target = self.telemetry_owner()
             if not target:
