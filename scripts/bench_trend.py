@@ -59,6 +59,10 @@ TRACKED: dict[str, list[tuple[str, bool]]] = {
         ("headline.ring_overhead_p50_pct", False),
         ("headline.sampled_overhead_p50_pct", False),
     ],
+    "leakcheck": [
+        ("headline.leak_overhead_pct", False),
+        ("headline.combined_overhead_pct", False),
+    ],
 }
 
 _NAME_RE = re.compile(r"^BENCH_(?:([a-z0-9]+)_)?r(\d+)\.json$")

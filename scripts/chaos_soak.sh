@@ -49,7 +49,9 @@
 # lock-order/hold race detector), XLLM_RCU_DEBUG=1 (the snapshot
 # deep-freeze race detector), XLLM_STATE_DEBUG=1 (the shared-state
 # ownership / attribute-race verifier — any write violating its declared
-# discipline fails the drill), and all three combined as a smoke. Set
+# discipline fails the drill), XLLM_LEAK_DEBUG=1 (the paired-effect
+# leak verifier — double-releases, strict-pair leaks and metric-series
+# resurrections fail the drill), and all four combined as a smoke. Set
 # XLLM_SOAK_SKIP_DEBUG_LEGS=1 to run the plain loop only.
 set -u
 
@@ -101,8 +103,8 @@ done
 total="$ITERS"
 if [ "${XLLM_SOAK_SKIP_DEBUG_LEGS:-}" != "1" ]; then
     for leg in "XLLM_LOCK_DEBUG=1" "XLLM_RCU_DEBUG=1" \
-               "XLLM_STATE_DEBUG=1" \
-               "XLLM_LOCK_DEBUG=1 XLLM_RCU_DEBUG=1 XLLM_STATE_DEBUG=1"; do
+               "XLLM_STATE_DEBUG=1" "XLLM_LEAK_DEBUG=1" \
+               "XLLM_LOCK_DEBUG=1 XLLM_RCU_DEBUG=1 XLLM_STATE_DEBUG=1 XLLM_LEAK_DEBUG=1"; do
         seed=$((RANDOM * 32768 + RANDOM))
         total=$((total + 1))
         echo "=== instrumented leg: $leg (seed=$seed, suite=${SUITES[*]}) ==="

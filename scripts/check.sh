@@ -6,18 +6,30 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# `check.sh --changed <git-ref>` scopes xlint's REPORT to files the
+# diff touches (analysis still runs tree-wide; registry files are never
+# filtered) — the fast pre-push loop. Everything else runs unchanged.
+CHANGED_ARGS=()
+if [ "${1:-}" = "--changed" ]; then
+    [ -n "${2:-}" ] || { echo "check.sh: --changed takes a git ref" >&2; exit 2; }
+    CHANGED_ARGS=(--changed "$2")
+    shift 2
+fi
+
 # One xlint invocation per profile, consumed as --format json: stable
 # exit codes (0 clean / 1 violations / 2 usage), machine-readable
 # violation list, file counts from the single shared parse.
 run_xlint() {
     local label="$1"; shift
     local out rc=0
-    out=$(python -m xllm_service_tpu.devtools.xlint --format json "$@") \
+    out=$(python -m xllm_service_tpu.devtools.xlint --format json \
+          ${CHANGED_ARGS[@]+"${CHANGED_ARGS[@]}"} "$@") \
         || rc=$?
     if [ "$rc" -eq 0 ]; then
         echo "$out" | python -c 'import json, sys
 d = json.load(sys.stdin)
-print("xlint: clean (%d files, %s profile)" % (d["files"], d["profile"]))'
+scope = ", changed vs %s" % d["changed"] if d.get("changed") else ""
+print("xlint: clean (%d files, %s profile%s)" % (d["files"], d["profile"], scope))'
         return 0
     fi
     echo "$out" | python -c 'import json, sys

@@ -39,6 +39,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 from xllm_service_tpu.coordination.memory import MemoryStore  # noqa: E402
+from xllm_service_tpu.devtools import lifecycle as _xlifecycle  # noqa: E402
 from xllm_service_tpu.devtools import locks as _xlocks  # noqa: E402
 from xllm_service_tpu.devtools import ownership as _xownership  # noqa: E402
 from xllm_service_tpu.devtools import rcu as _xrcu  # noqa: E402
@@ -84,6 +85,28 @@ def _state_ownership_guard():
     yield
     vs = _xownership.violations()
     assert not vs, ("state-ownership violations:\n"
+                    + "\n".join(str(v) for v in vs))
+
+
+@pytest.fixture(autouse=True)
+def _leak_guard():
+    """Under XLLM_LEAK_DEBUG=1 every test doubles as a resource-leak
+    detector: instrumented acquire/release pairs (devtools/lifecycle.py
+    EFFECT_PAIRS) keep per-pair balance counters with acquisition
+    stacks. A double-release or metric-series resurrection recorded
+    during the test fails it, and so does a nonzero teardown balance on
+    a `strict` pair (an admission slot or flight-recorder context
+    provider that leaked) — the runtime mirror of xlint's pair-release/
+    pair-once/pair-evict rules, following the lock/state/RCU guards
+    around this one."""
+    if not _xlifecycle.debug_enabled():
+        yield
+        return
+    _xlifecycle.reset_violations()
+    _xlifecycle.reset_balances()
+    yield
+    vs = _xlifecycle.violations() + _xlifecycle.strict_imbalances()
+    assert not vs, ("lifecycle pair violations:\n"
                     + "\n".join(str(v) for v in vs))
 
 

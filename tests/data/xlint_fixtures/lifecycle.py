@@ -1,0 +1,27 @@
+"""Fixture EFFECT_PAIRS registry stand-in for the pair rules.
+
+Never imported — xlint parses the registry out of the AST (detected by
+filename, like the other fixture registries). Endpoints live in
+``pair_sites.py`` / ``pair_regress.py`` / ``metrics.py``.
+"""
+
+EFFECT_PAIRS = {
+    # Clean entries: endpoints all defined in the fixture tree.
+    "slot": "SlotGate.claim -> SlotGate.unclaim @ finally;"
+            " transfer=Pipeline.hand_off; sink=Pipeline.drop_request;"
+            " strict",
+    "probe": "ProbeGate.admit -> ProbeGate.resolve @ owner",
+    "series": "FGauge.labels -> FGauge.remove @ evict;"
+              " helper=evict_series; idempotent",
+    # VIOLATION pair-release (x2): both endpoints stale (GhostGate
+    # is not defined anywhere in the tree).
+    "ghost": "GhostGate.grab -> GhostGate.ungrab @ gc",
+    # Hatched stale entry: the hatch silences the registry check.
+    "ghost2": "GhostGate.grab -> GhostGate.ungrab @ gc",  # xlint: allow-pair-release(migration window: endpoints land next PR)
+    # VIOLATION pair-release: malformed spec (missing '@ scope').
+    "broken": "SlotGate.claim -> SlotGate.unclaim",
+    # VIOLATION pair-release: endpoints defined but no acquire site.
+    "dead": "DeadGate.claim -> DeadGate.unclaim @ finally",
+    # VIOLATION pair-evict: evict-scope pair without a helper=.
+    "bare-series": "FGauge.labels -> FGauge.remove @ evict",
+}

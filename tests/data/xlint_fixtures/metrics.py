@@ -15,3 +15,18 @@ DUP_A = REGISTRY.counter("duplicated_name")
 DUP_B = REGISTRY.counter("duplicated_name")      # VIOLATION: duplicate name
 LABELED_TOTAL = REGISTRY.counter("labeled_total",
                                  labelnames=("instance", "phase"))
+
+
+class FGauge:
+    """Endpoint stand-in for the fixture 'series' evict pair."""
+
+    def labels(self, **kw):
+        return self
+
+    def remove(self, **kw):
+        pass
+
+
+def evict_series(metric, **labels):
+    """Blessed release site for the fixture 'series' evict pair."""
+    metric.remove(**labels)

@@ -1,0 +1,40 @@
+"""Fixture: the PR-12 admission-slot leak, resurrected. Never imported.
+
+``LeakyFrontend`` is the exact pre-fix shape: a helper acquires the
+slot, the caller hands the request off on the happy path, and nothing
+releases on the reject/exception paths. ``FixedFrontend`` is the
+post-fix control: ownership flag + try/finally, cleared on transfer.
+"""
+
+from .pair_sites import GATE, PIPE, do_work  # noqa: F401
+
+
+class LeakyFrontend:
+    """Pre-PR-12: rejected/raising requests leak their slot."""
+
+    def _check(self):
+        return GATE.claim()   # VIOLATION pair-release: no caller finally
+
+    def serve(self, req):
+        if not self._check():
+            return False
+        do_work()
+        PIPE.hand_off(req)
+        return True
+
+
+class FixedFrontend:
+    """Post-PR-12 control: flag-guarded finally, cleared on transfer."""
+
+    def serve(self, req):
+        if not GATE.claim():
+            return False
+        held = True
+        try:
+            do_work()
+            PIPE.hand_off(req)
+            held = False     # ownership transferred to the sink
+            return True
+        finally:
+            if held:
+                GATE.unclaim()

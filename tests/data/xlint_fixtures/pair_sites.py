@@ -1,0 +1,141 @@
+"""Fixture: effect-pair rule call sites. Never imported."""
+
+from .metrics import LABELED_TOTAL, evict_series  # noqa: F401
+
+
+class SlotGate:
+    """Acquire/release endpoints of the fixture 'slot' pair."""
+
+    def claim(self):
+        return True
+
+    def unclaim(self):
+        pass
+
+
+class ProbeGate:
+    """Owner-scope 'probe' pair: the owner class itself balances it."""
+
+    def admit(self):
+        self._inflight = True
+        return True
+
+    def resolve(self, ok):
+        self._inflight = False
+
+
+class DeadGate:
+    """Endpoints for the 'dead' pair — deliberately never acquired."""
+
+    def claim(self):
+        return True
+
+    def unclaim(self):
+        pass
+
+
+class Pipeline:
+    """Transfer/sink endpoints of the 'slot' pair."""
+
+    def hand_off(self, req):
+        req["held"] = True
+
+    def drop_request(self, req):
+        # Sink-owned release of a transferred slot (pair machinery:
+        # exempt from the call-site rules).
+        if req.pop("held", False):
+            GATE.unclaim()
+
+
+GATE = SlotGate()
+PROBE = ProbeGate()
+PIPE = Pipeline()
+
+
+def do_work():
+    pass
+
+
+# ---- pair-release shapes ---------------------------------------------------
+def clean_finally():
+    """Blessed shape: acquire discharged by this function's finally."""
+    held = GATE.claim()
+    try:
+        do_work()
+    finally:
+        if held:
+            GATE.unclaim()
+
+
+class Frontend:
+    """Acquire-in-a-helper shape: the helper's caller owns the finally."""
+
+    def _begin(self):
+        return GATE.claim()
+
+    def handle(self):
+        held = self._begin()
+        try:
+            do_work()
+        finally:
+            if held:
+                GATE.unclaim()
+
+
+def leaky_claim():
+    if GATE.claim():    # VIOLATION pair-release: no finally discharge
+        do_work()
+
+
+def hatched_claim():
+    GATE.claim()  # xlint: allow-pair-release(drill hook: the harness releases the slot)
+    do_work()
+
+
+def probe_round():
+    """Owner-scope pairs impose no call-site discipline."""
+    if PROBE.admit():
+        PROBE.resolve(True)
+
+
+# ---- pair-once shapes ------------------------------------------------------
+def finish_twice(req):
+    GATE.unclaim()
+    do_work()
+    GATE.unclaim()      # VIOLATION pair-once: released twice on one path
+
+
+def finish_after_transfer(req):
+    PIPE.hand_off(req)
+    GATE.unclaim()      # VIOLATION pair-once: release after transfer
+
+
+def finish_guarded(req):
+    GATE.unclaim()
+    if req.get("held"):
+        GATE.unclaim()  # clean: second release behind the ownership flag
+
+
+def finish_hatched(req):
+    PIPE.hand_off(req)
+    GATE.unclaim()  # xlint: allow-pair-once(abort path: the sink never ran)
+
+
+# ---- pair-evict shapes -----------------------------------------------------
+def evict_direct(name):
+    # VIOLATION pair-evict: hand-rolled eviction path.
+    LABELED_TOTAL.remove(instance=name, phase="prefill")
+
+
+def evict_blessed(name):
+    evict_series(LABELED_TOTAL, instance=name, phase="prefill")   # clean
+
+
+def evict_then_write(name):
+    evict_series(LABELED_TOTAL, instance=name, phase="prefill")
+    # VIOLATION pair-evict: write after evict (gauge resurrection).
+    LABELED_TOTAL.labels(instance=name, phase="prefill").inc()
+
+
+def evict_hatched(name):
+    LABELED_TOTAL.remove(instance=name, phase="prefill")  # xlint: allow-pair-evict(test-only shim owns this family)

@@ -330,6 +330,8 @@ class TestFlightRecorder:
         for i in range(10):
             rec.record("failover", request_id=f"r{i}")
         assert len(rec.recent(limit=50)) == 4    # bounded ring
+        rec.remove_context_provider("ctx")
+        rec.remove_context_provider("broken")
         rec.close()
 
     def test_recent_filters_by_kind(self):

@@ -1,0 +1,244 @@
+"""XLLM_LEAK_DEBUG runtime leak-verifier tests: per-pair balance
+counters on the instrumented acquire/release sites, double-release and
+strict-leak verdicts, the labeled-series tombstone half (the resurrected
+PR-12 gauge-resurrection bug, caught at runtime, with the fixed
+membership-gated heartbeat path as control), the escape hatch, and
+passthrough-when-disabled. The static half of this round's regression
+pair lives in tests/test_xlint.py / pair_regress.py."""
+
+import threading
+
+import pytest
+
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.flightrecorder import FlightRecorder
+from xllm_service_tpu.common.metrics import (
+    INSTANCE_QUEUE_DEPTH,
+    evict_series,
+)
+from xllm_service_tpu.common.types import LoadMetrics
+from xllm_service_tpu.devtools import lifecycle
+from xllm_service_tpu.overload.admission import AdmissionController
+from xllm_service_tpu.scheduler.instance_mgr import InstanceMgr
+
+from fakes import FakeChannel, make_meta
+
+BLOCK = 16
+
+
+@pytest.fixture()
+def leak_debug():
+    """Arm the verifier for the test body; restore the PRIOR state on
+    teardown (hardcoding False would disarm a suite-wide
+    XLLM_LEAK_DEBUG=1 run for every test collected after this file)."""
+    was = lifecycle.debug_enabled()
+    lifecycle.set_debug(True)
+    lifecycle.reset_violations()
+    lifecycle.reset_balances()
+    yield
+    lifecycle.reset_violations()
+    lifecycle.reset_balances()
+    lifecycle.set_debug(was)
+
+
+@pytest.fixture(autouse=True)
+def _reset_channels():
+    FakeChannel.reset()
+    yield
+    FakeChannel.reset()
+
+
+# ----------------------------------------------------------- escape hatch
+class TestEscape:
+    def test_escape_requires_reason(self):
+        with pytest.raises(ValueError):
+            lifecycle.escape("")
+        with pytest.raises(ValueError):
+            lifecycle.escape(None)
+
+    def test_escape_suppresses_bookkeeping(self, leak_debug):
+        with lifecycle.escape("test: harness owns this slot"):
+            lifecycle.note_acquire("admission-slot")
+            lifecycle.note_release("flight-context", key="ghost")
+        assert not lifecycle.balances()
+        assert not lifecycle.violations()
+
+
+# ------------------------------------------------------------ passthrough
+class TestPassthrough:
+    def test_noop_when_disabled(self):
+        if lifecycle.debug_enabled():
+            pytest.skip("XLLM_LEAK_DEBUG armed for this whole run")
+        lifecycle.note_acquire("admission-slot")
+        lifecycle.note_release("flight-context", key="ghost")
+        lifecycle.note_series_evicted("m", ("x",))
+        lifecycle.note_series_created("m", ("x",))
+        assert not lifecycle.balances()
+        assert not lifecycle.violations()
+
+
+# -------------------------------------------------------- balance verdicts
+class TestBalances:
+    def test_strict_imbalance_is_a_leak(self, leak_debug):
+        lifecycle.note_acquire("admission-slot")
+        vs = lifecycle.strict_imbalances()
+        assert len(vs) == 1 and vs[0].kind == "leak"
+        assert "unreleased acquisition" in vs[0].message
+        lifecycle.note_release("admission-slot")
+        assert not lifecycle.strict_imbalances()
+
+    def test_non_strict_imbalance_not_reported(self, leak_debug):
+        # retry-budget is a token bucket, not a strict pair.
+        lifecycle.note_acquire("retry-budget")
+        assert not lifecycle.strict_imbalances()
+
+    def test_double_release_caught(self, leak_debug):
+        lifecycle.note_release("admission-slot")
+        vs = lifecycle.violations()
+        assert len(vs) == 1 and vs[0].kind == "double-release"
+
+    def test_idempotent_pair_zero_balance_release_quiet(self, leak_debug):
+        # span-pending is pop-style: promote/drop of an unknown trace is
+        # a no-op, not a double-release.
+        lifecycle.note_release("span-pending", key="t1")
+        assert not lifecycle.violations()
+
+    def test_note_reset_drops_balances(self, leak_debug):
+        lifecycle.note_acquire("admission-slot")
+        lifecycle.note_acquire("admission-slot")
+        lifecycle.note_reset("admission-slot")
+        assert not lifecycle.strict_imbalances()
+
+
+# ------------------------------------------- instrumented real pair sites
+class TestAdmissionSlot:
+    def test_leaked_slot_caught_at_teardown(self, leak_debug):
+        ctl = AdmissionController()
+        ctl.configure(per_instance_limit=4)
+        ok, _, _ = ctl.try_admit("interactive", live=0, burn_hot=False)
+        assert ok
+        vs = lifecycle.strict_imbalances()
+        assert vs and vs[0].pair == "admission-slot"
+
+    def test_balanced_slot_quiet(self, leak_debug):
+        ctl = AdmissionController()
+        ctl.configure(per_instance_limit=4)
+        ok, _, _ = ctl.try_admit("interactive", live=0, burn_hot=False)
+        assert ok
+        ctl.release()
+        assert not lifecycle.strict_imbalances()
+        assert not lifecycle.violations()
+
+    def test_release_without_admit_is_double_release(self, leak_debug):
+        ctl = AdmissionController()
+        ctl.release()
+        vs = lifecycle.violations()
+        assert vs and vs[0].kind == "double-release" \
+            and vs[0].pair == "admission-slot"
+
+
+class TestFlightContext:
+    def test_leaked_provider_caught(self, leak_debug):
+        rec = FlightRecorder(capacity=8)
+        rec.add_context_provider("ctx", lambda: {})
+        vs = lifecycle.strict_imbalances()
+        assert vs and vs[0].pair == "flight-context"
+        rec.remove_context_provider("ctx")
+        assert not lifecycle.strict_imbalances()
+
+    def test_replacement_keeps_balance_at_one(self, leak_debug):
+        # Re-registering under the same name replaces the provider — the
+        # balance must stay 1 (release-then-acquire), not grow.
+        rec = FlightRecorder(capacity=8)
+        rec.add_context_provider("ctx", lambda: {})
+        rec.add_context_provider("ctx", lambda: {"v": 2})
+        assert lifecycle.balances()[("flight-context", "ctx")] == 1
+        rec.remove_context_provider("ctx")
+        assert not lifecycle.strict_imbalances()
+        assert not lifecycle.violations()
+
+
+# --------------------------------------------- PR-12 gauge resurrection
+class TestSeriesResurrection:
+    def test_stale_write_after_evict_caught(self, leak_debug):
+        """The resurrected PR-12 bug, runtime half: a racing writer
+        re-creates a labeled child after the owner's eviction."""
+        INSTANCE_QUEUE_DEPTH.labels(instance="zombie").set(3)
+        evict_series(INSTANCE_QUEUE_DEPTH, instance="zombie")
+        INSTANCE_QUEUE_DEPTH.labels(instance="zombie").set(1)   # stale
+        vs = lifecycle.violations()
+        assert vs and vs[0].kind == "resurrected-series", vs
+        assert "zombie" in vs[0].message
+        evict_series(INSTANCE_QUEUE_DEPTH, instance="zombie")
+
+    def test_revived_registration_quiet(self, leak_debug):
+        """Legitimate re-registration clears the tombstone first."""
+        INSTANCE_QUEUE_DEPTH.labels(instance="phoenix").set(3)
+        evict_series(INSTANCE_QUEUE_DEPTH, instance="phoenix")
+        lifecycle.note_series_revived("phoenix")
+        INSTANCE_QUEUE_DEPTH.labels(instance="phoenix").set(1)
+        assert not lifecycle.violations()
+        evict_series(INSTANCE_QUEUE_DEPTH, instance="phoenix")
+        lifecycle.reset_balances()
+
+    def test_fixed_heartbeat_path_control(self, leak_debug, store):
+        """The fixed path stays quiet end-to-end: a heartbeat landing
+        after deregistration is dropped by the membership gate instead
+        of resurrecting the evicted gauge series."""
+        from xllm_service_tpu.coordination.memory import InMemoryCoordination
+
+        coord = InMemoryCoordination(store)
+        mgr = InstanceMgr(coord, ServiceOptions(block_size=BLOCK),
+                          channel_factory=FakeChannel.factory,
+                          start_threads=False)
+        try:
+            meta = make_meta("i1")
+            assert mgr.register_instance(meta)
+            assert mgr.record_instance_heartbeat(
+                "i1", meta.incarnation_id,
+                load=LoadMetrics(waiting_requests_num=2))
+            mgr.deregister_instance("i1", reason="drill")
+            # The late beat: incarnation check fails first (instance
+            # gone), so no metric write, no resurrection.
+            assert not mgr.record_instance_heartbeat(
+                "i1", meta.incarnation_id,
+                load=LoadMetrics(waiting_requests_num=9))
+            assert not [v for v in lifecycle.violations()
+                        if v.kind == "resurrected-series"]
+        finally:
+            mgr.stop()
+            coord.close()
+            lifecycle.reset_violations()
+            lifecycle.reset_balances()
+
+
+# ------------------------------------------------------------ chaos drill
+@pytest.mark.chaos
+class TestLeakDrill:
+    def test_concurrent_admission_churn_is_balanced(self, leak_debug):
+        """N threads hammer admit/release; the verifier must end with
+        zero strict balance and no violations (the soak-leg shape
+        scripts/chaos_soak.sh runs with XLLM_LEAK_DEBUG=1)."""
+        ctl = AdmissionController()
+        ctl.configure(per_instance_limit=64)
+        errs: list = []
+
+        def churn():
+            try:
+                for _ in range(200):
+                    ok, _, _ = ctl.try_admit("interactive", live=0,
+                                             burn_hot=False)
+                    if ok:
+                        ctl.release()
+            except Exception as e:   # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=churn, name=f"churn-{i}")
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert not lifecycle.strict_imbalances()
+        assert not lifecycle.violations()

@@ -1006,13 +1006,19 @@ class TestCoordinationOutage:
                 lambda: m.scheduler.instance_mgr.get_instance_meta(
                     doomed.name) is None, timeout=5)
             assert mon.held.depth() == 0
-            replays = RECORDER.recent(limit=50, kind="held_action_replay")
-            assert any(r["detail"].get("key") == doomed.name
-                       and r["detail"].get("outcome") == "replayed: evicted"
-                       for r in replays)
+            # The replay records land asynchronously with the drain —
+            # poll for them (under the instrumented soak legs the
+            # recorder can lag the depth==0 observation).
+            def _replays():
+                return RECORDER.recent(limit=50, kind="held_action_replay")
+            assert wait_until(
+                lambda: any(r["detail"].get("key") == doomed.name
+                            and r["detail"].get("outcome")
+                            == "replayed: evicted"
+                            for r in _replays()), timeout=5)
             # …and the publish holds were superseded by live republish.
             assert any("superseded" in r["detail"].get("outcome", "")
-                       for r in replays)
+                       for r in _replays())
             assert RECORDER.recent(limit=50, kind="coordination_degraded")
             assert RECORDER.recent(limit=50, kind="coordination_recovered")
             assert _completion(m) == REPLY

@@ -783,6 +783,225 @@ def test_support_profile_keeps_behavioral_rules(tmp_path):
     assert "lock-discipline" not in rules
 
 
+# ------------------------------------------------------------- pair-release
+class TestPairRelease:
+    def test_leaky_acquire_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "pair-release", "pair_sites.py",
+                    "not discharged")
+
+    def test_pr12_leak_shape_resurrected(self, fixture_violations):
+        # The exact pre-PR-12 admission shape: helper acquires, caller
+        # never releases on the reject path.
+        assert hits(fixture_violations, "pair-release", "pair_regress.py",
+                    "PR-12 slot-leak shape")
+
+    def test_stale_endpoints_flagged(self, fixture_violations):
+        assert len(hits(fixture_violations, "pair-release", "lifecycle.py",
+                        "stale pair 'ghost'")) == 2
+
+    def test_hatched_stale_entry_quiet(self, fixture_violations):
+        assert not hits(fixture_violations, "pair-release", "lifecycle.py",
+                        "ghost2")
+
+    def test_malformed_spec_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "pair-release", "lifecycle.py",
+                    "missing '@ scope'")
+
+    def test_dead_pair_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "pair-release", "lifecycle.py",
+                    "dead pair 'dead'")
+
+    def test_clean_hatched_and_fixed_shapes_quiet(self, fixture_violations):
+        # clean_finally, the Frontend helper discharged by its caller's
+        # finally, hatched_claim and the FixedFrontend control must stay
+        # quiet: exactly one site violation per fixture file, plus the
+        # four registry-side ones asserted above.
+        assert len(hits(fixture_violations, "pair-release",
+                        "pair_sites.py")) == 1
+        assert len(hits(fixture_violations, "pair-release",
+                        "pair_regress.py")) == 1
+        assert len(hits(fixture_violations, "pair-release")) == 6
+
+
+# ---------------------------------------------------------------- pair-once
+class TestPairOnce:
+    def test_double_release_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "pair-once", "pair_sites.py",
+                    "released twice")
+
+    def test_release_after_transfer_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "pair-once", "pair_sites.py",
+                    "released after ownership transfer")
+
+    def test_guarded_and_hatched_releases_quiet(self, fixture_violations):
+        # finish_guarded (flag-guarded second release) and finish_hatched
+        # must not fire: exactly the two deliberate violations.
+        assert len(hits(fixture_violations, "pair-once")) == 2
+
+
+# --------------------------------------------------------------- pair-evict
+class TestPairEvict:
+    def test_direct_remove_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "pair-evict", "pair_sites.py",
+                    "direct LABELED_TOTAL.remove()")
+
+    def test_write_after_evict_flagged(self, fixture_violations):
+        # The PR-12 gauge-resurrection shape, caught statically.
+        assert hits(fixture_violations, "pair-evict", "pair_sites.py",
+                    "gauge-resurrection")
+
+    def test_helperless_evict_pair_flagged(self, fixture_violations):
+        assert hits(fixture_violations, "pair-evict", "lifecycle.py",
+                    "declares no helper=")
+
+    def test_blessed_and_hatched_evictions_quiet(self, fixture_violations):
+        # evict_blessed and evict_hatched stay quiet: exactly the two
+        # site violations plus the registry one.
+        assert len(hits(fixture_violations, "pair-evict")) == 3
+
+
+def test_xlint_pair_registry_is_live():
+    """The pair rules must actually be armed on the real tree: every
+    EFFECT_PAIRS entry parses, and a known-bad snippet linted next to
+    the REAL registry file fires all three rules (the PR-4 vacuous-rule
+    lesson, applied to the new rules on day one)."""
+    import tempfile
+
+    import xllm_service_tpu.devtools.lifecycle as lc_mod
+
+    assert lc_mod.EFFECT_PAIRS
+    assert set(lc_mod.pair_specs()) == set(lc_mod.EFFECT_PAIRS), \
+        "some EFFECT_PAIRS entries failed to parse"
+    reg = Path(lc_mod.__file__)
+    metrics = PACKAGE / "common" / "metrics.py"
+    # The probe impersonates the admission controller: an undischarged
+    # try_admit, a double release, and a direct labeled-series remove
+    # (INSTANCE_QUEUE_DEPTH is a real labeled instrument).
+    probe = (
+        "class AdmissionController:\n"
+        "    def try_admit(self):\n"
+        "        return True\n"
+        "    def release(self):\n"
+        "        pass\n"
+        "ADMISSION = AdmissionController()\n"
+        "def leaky():\n"
+        "    if ADMISSION.try_admit():\n"
+        "        pass\n"
+        "def twice():\n"
+        "    ADMISSION.release()\n"
+        "    ADMISSION.release()\n"
+        "def zap(name):\n"
+        "    INSTANCE_QUEUE_DEPTH.remove(instance=name)\n")
+    with tempfile.TemporaryDirectory() as td:
+        bad = Path(td) / "probe.py"
+        bad.write_text(probe)
+        vs = xlint.run([str(reg), str(metrics), str(bad)])
+        by_rule = {r: [v for v in vs if v.rule == r and "probe.py" in v.path]
+                   for r in ("pair-release", "pair-once", "pair-evict")}
+        assert any("not discharged" in v.message
+                   for v in by_rule["pair-release"]), vs
+        assert any("released twice" in v.message
+                   for v in by_rule["pair-once"]), vs
+        assert any("remove" in v.message
+                   for v in by_rule["pair-evict"]), vs
+
+
+# -------------------------------------------------------------- hatch audit
+def test_tree_hatches_all_carry_reasons():
+    """Every escape hatch in the real tree — comment suppressions and
+    runtime ownership.escape()/lifecycle.escape()/rcu.thaw() calls —
+    must carry a non-empty reason, and the audit itself must be live
+    (the tree does use both kinds)."""
+    stats: dict = {}
+    xlint.run([str(PACKAGE)], stats=stats)
+    hatches = stats["hatches"]
+    assert hatches
+    for h in hatches:
+        assert h["reason"], f"hatch without a reason: {h}"
+    kinds = {h["kind"].split(":")[0] for h in hatches}
+    assert kinds == {"comment", "runtime"}
+
+
+def test_cli_json_includes_hatches(tmp_path, capsys):
+    """Hatch reasons surface in --format json (the auditable inventory
+    scripts consume), for both runtime and comment hatches."""
+    import json
+
+    f = tmp_path / "h.py"
+    f.write_text(
+        "from xllm_service_tpu.devtools import lifecycle, ownership\n"
+        "def drill(obj):\n"
+        "    with lifecycle.escape('soak harness owns the slot'):\n"
+        "        pass\n"
+        "    with ownership.escape('test-only reset'):\n"
+        "        obj.x = 1\n")
+    rc = xlint.main(["--format", "json", str(f)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert {"path", "line", "kind", "reason"} <= set(doc["hatches"][0])
+    assert any(h["kind"] == "runtime:escape"
+               and h["reason"] == "soak harness owns the slot"
+               for h in doc["hatches"])
+
+    rc = xlint.main(["--format", "json",
+                     str(FIXTURES / "pair_sites.py")])
+    doc = json.loads(capsys.readouterr().out)
+    comment = [h for h in doc["hatches"]
+               if h["kind"] == "comment:pair-release"]
+    assert comment and comment[0]["reason"].startswith("drill hook")
+
+
+# ---------------------------------------------------------------- --changed
+def test_cli_changed_usage_and_bad_ref_exit_2(tmp_path, capsys, monkeypatch):
+    f = tmp_path / "x.py"
+    f.write_text("X = 1\n")
+    assert xlint.main(["--changed"]) == 2
+    monkeypatch.chdir(tmp_path)      # not a git checkout
+    assert xlint.main(["--changed", "HEAD", str(f)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_changed_filters_to_diff(tmp_path, capsys, monkeypatch):
+    """--changed <ref> lints the full tree but reports only violations
+    in files the diff touches — except registry files, which are never
+    filtered (a stale registry entry is everyone's failure)."""
+    import json
+    import subprocess
+
+    monkeypatch.chdir(tmp_path)
+    git = ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+    subprocess.run(["git", "init", "-q"], check=True)
+
+    def bad_module(cls, order):
+        return ("import threading, time\n"
+                f"class {cls}:\n"
+                "    def __init__(self):\n"
+                f"        self.lk = threading.Lock()  # lock-order: {order}\n"
+                "    def f(self):\n"
+                "        with self.lk:\n"
+                "            time.sleep(1)\n")
+
+    (tmp_path / "bad_old.py").write_text(bad_module("C1", 1))
+    # Registry files are exempt from the filter: a committed,
+    # unmodified lifecycle.py with a malformed entry must still report.
+    (tmp_path / "lifecycle.py").write_text(
+        "EFFECT_PAIRS = {\n"
+        "    \"x\": \"A.b -> C.d\",\n"
+        "}\n")
+    subprocess.run(git + ["add", "."], check=True)
+    subprocess.run(git + ["commit", "-q", "-m", "seed"], check=True)
+    (tmp_path / "bad_new.py").write_text(bad_module("C2", 2))
+
+    rc = xlint.main(["--format", "json", "--changed", "HEAD", "."])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    paths = {v["path"] for v in doc["violations"]}
+    assert doc["changed"] == "HEAD"
+    assert any("bad_new.py" in p for p in paths)
+    assert not any("bad_old.py" in p for p in paths)
+    assert any("lifecycle.py" in p for p in paths)
+
+
 def test_cli_clean_on_tree():
     assert xlint.main([str(PACKAGE), "-q"]) == 0
 

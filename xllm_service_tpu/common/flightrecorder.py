@@ -34,6 +34,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Optional
 
+from ..devtools import lifecycle as _lifecycle
 from ..devtools import ownership as _ownership
 from ..devtools.locks import make_lock
 from ..utils import get_logger
@@ -79,7 +80,13 @@ class FlightRecorder:
         # threads — the unguarded dict write here was the first real
         # finding of the state-write ownership rule.
         with self._lock:
+            if name in self._context:
+                # Replacement: the old registration's obligation passes
+                # to the new owner — release before re-acquiring so the
+                # keyed balance stays exactly one.
+                _lifecycle.note_release("flight-context", key=name)
             self._context[name] = fn
+            _lifecycle.note_acquire("flight-context", key=name)
 
     def remove_context_provider(self, name: str,
                                 fn: Optional[Callable[[], Any]] = None
@@ -92,6 +99,8 @@ class FlightRecorder:
         # access but compare equal on (func, self).
         with self._lock:
             if fn is None or self._context.get(name) == fn:
+                if name in self._context:
+                    _lifecycle.note_release("flight-context", key=name)
                 self._context.pop(name, None)
 
     # ------------------------------------------------------------ recording

@@ -24,6 +24,8 @@ import threading
 from bisect import bisect_right
 from typing import Any, Iterable, Optional
 
+from ..devtools import lifecycle as _lifecycle
+
 
 def _escape_label_value(v: str) -> str:
     return (str(v).replace("\\", "\\\\").replace('"', '\\"')
@@ -80,6 +82,7 @@ class Counter(_Metric):
                 child.labelnames = self.labelnames
                 child._labelvalues = key
                 self._children[key] = child
+                _lifecycle.note_series_created(self.name, key)
             return child
 
     def remove(self, **kw: Any) -> None:
@@ -132,6 +135,7 @@ class Gauge(_Metric):
                 child.labelnames = self.labelnames
                 child._labelvalues = key
                 self._children[key] = child
+                _lifecycle.note_series_created(self.name, key)
             return child
 
     def remove(self, **kw: Any) -> None:
@@ -194,6 +198,7 @@ class Histogram(_Metric):
                 child.labelnames = self.labelnames
                 child._labelvalues = key
                 self._children[key] = child
+                _lifecycle.note_series_created(self.name, key)
             return child
 
     def remove(self, **kw: Any) -> None:
@@ -245,6 +250,21 @@ class Histogram(_Metric):
         out.append(f"{self.name}_sum{self._label_suffix()} {total_sum}\n")
         out.append(f"{self.name}_count{self._label_suffix()} {total_n}\n")
         return "".join(out)
+
+
+def evict_series(metric: _Metric, **labels: Any) -> None:
+    """Drop one labeled child series when its owning entity goes away
+    (instance evicted, PD peer unlinked, master changed).
+
+    This is the single blessed release site for the ``metric-series``
+    effect pair (devtools/lifecycle.py): xlint's ``pair-evict`` rule
+    flags direct ``.remove()`` calls outside this module, and under
+    ``XLLM_LEAK_DEBUG=1`` the evicted key is tombstoned so a later
+    stale write re-creating the series (the PR-12 gauge-resurrection
+    bug) is reported."""
+    key = metric._child_key(labels)
+    metric.remove(**labels)
+    _lifecycle.note_series_evicted(metric.name, key)
 
 
 def relabel_prometheus_text(text: str, instance: str, role: str,

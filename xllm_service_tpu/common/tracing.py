@@ -39,6 +39,7 @@ from dataclasses import dataclass
 from hashlib import blake2b
 from typing import Any, Callable, Optional
 
+from ..devtools import lifecycle as _lifecycle
 from ..devtools.locks import make_lock
 from ..utils import get_logger
 
@@ -329,8 +330,10 @@ class SpanStore:
             spans = self._pending.get(span.trace_id)
             if spans is None:
                 spans = self._pending[span.trace_id] = []
+                _lifecycle.note_acquire("span-pending", key=span.trace_id)
                 while len(self._pending) > self._pending_traces_cap:
-                    self._pending.popitem(last=False)
+                    old_tid, _ = self._pending.popitem(last=False)
+                    _lifecycle.note_release("span-pending", key=old_tid)
             spans.append(span)
             if span.request_id:
                 self._req_index[span.request_id] = span.trace_id
@@ -342,13 +345,16 @@ class SpanStore:
         """Tail-based keep: move a pending trace into the ring."""
         with self._lock:
             spans = self._pending.pop(trace_id, None)
+            if spans is not None:
+                _lifecycle.note_release("span-pending", key=trace_id)
         for s in spans or ():
             self.add(s)
 
     def drop(self, trace_id: str) -> None:
         """Tail-based drop: the request ended cleanly; discard."""
         with self._lock:
-            self._pending.pop(trace_id, None)
+            if self._pending.pop(trace_id, None) is not None:
+                _lifecycle.note_release("span-pending", key=trace_id)
 
     def trace(self, trace_id: str) -> list[dict[str, Any]]:
         with self._lock:
@@ -393,6 +399,7 @@ class SpanStore:
             self._by_trace.clear()
             self._req_index.clear()
             self._pending.clear()
+        _lifecycle.note_reset("span-pending")
 
 
 def span_tree(spans: list[dict[str, Any]]) -> list[dict[str, Any]]:
@@ -501,6 +508,12 @@ class Tracer:
     span = start_span
 
     def _record(self, span: Span) -> None:
+        if not self.enabled:
+            # The span outlived a live-disable (admin toggle, or a
+            # process whose next master boots with tracing off while a
+            # predecessor's straggler spans wind down): drop it, or
+            # disabled-tracing runs observe phantom traces.
+            return
         if self.sample_rate >= 1.0 or span.trace_id in self._kept \
                 or self.is_sampled(span.trace_id):
             self.store.add(span)

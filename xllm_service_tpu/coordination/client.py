@@ -19,6 +19,7 @@ from typing import Optional
 from .base import CoordinationClient, KeyEvent, WatchCallback, WatchEventType
 from ..common.faults import FAULTS, FaultInjected
 from ..common.metrics import COORDINATION_RECONNECTS_TOTAL
+from ..devtools import lifecycle as _lifecycle
 from ..devtools.locks import make_lock
 from ..utils import get_logger, jittered_backoff
 
@@ -431,7 +432,10 @@ class TcpCoordinationClient(CoordinationClient):
                             # are no longer the owner — stop claiming it.
                             # (Owners detect demotion via verify_ownership.)
                             with self._ka_lock:
-                                self._keepalives.pop(key, None)
+                                if self._keepalives.pop(key, None) \
+                                        is not None:
+                                    _lifecycle.note_release(
+                                        "coord-lease", key=(id(self), key))
 
     # ---- CoordinationClient ------------------------------------------------
     def ping(self) -> bool:
@@ -453,6 +457,9 @@ class TcpCoordinationClient(CoordinationClient):
                          "ttl": ttl_s}).get("ok", False)
         if ok and ttl_s and keepalive:
             with self._ka_lock:
+                if self._k(key) not in self._keepalives:
+                    _lifecycle.note_acquire("coord-lease",
+                                            key=(id(self), self._k(key)))
                 self._keepalives[self._k(key)] = (ttl_s, value, False)
         return ok
 
@@ -461,6 +468,9 @@ class TcpCoordinationClient(CoordinationClient):
                          "ttl": ttl_s, "create_only": True}).get("ok", False)
         if ok and ttl_s and keepalive:
             with self._ka_lock:
+                if self._k(key) not in self._keepalives:
+                    _lifecycle.note_acquire("coord-lease",
+                                            key=(id(self), self._k(key)))
                 self._keepalives[self._k(key)] = (ttl_s, value, True)
         return ok
 
@@ -505,7 +515,9 @@ class TcpCoordinationClient(CoordinationClient):
 
     def release(self, key) -> None:
         with self._ka_lock:
-            self._keepalives.pop(self._k(key), None)
+            if self._keepalives.pop(self._k(key), None) is not None:
+                _lifecycle.note_release("coord-lease",
+                                        key=(id(self), self._k(key)))
 
     def add_watch(self, prefix, cb) -> int:
         wid = next(self._ids)
@@ -522,6 +534,10 @@ class TcpCoordinationClient(CoordinationClient):
         if self._closed.is_set():
             return
         self._closed.set()
+        with self._ka_lock:
+            for k in self._keepalives:
+                _lifecycle.note_release("coord-lease", key=(id(self), k))
+            self._keepalives.clear()
         self._watch_q.put(None)   # dispatcher sentinel
         try:
             self._sock.shutdown(socket.SHUT_RDWR)

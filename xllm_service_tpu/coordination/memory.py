@@ -22,6 +22,7 @@ from typing import Callable, Iterable, Mapping, Optional
 
 from .base import CoordinationClient, KeyEvent, WatchCallback, WatchEventType
 from ..common.faults import FAULTS
+from ..devtools import lifecycle as _lifecycle
 from ..devtools.locks import make_lock
 
 
@@ -267,6 +268,9 @@ class InMemoryCoordination(CoordinationClient):
         ok = self._store.put(self._k(key), value, ttl_s)
         if ok and ttl_s and keepalive:
             with self._ka_lock:
+                if self._k(key) not in self._keepalives:
+                    _lifecycle.note_acquire("coord-lease",
+                                            key=(id(self), self._k(key)))
                 self._keepalives[self._k(key)] = ttl_s
         return ok
 
@@ -274,6 +278,9 @@ class InMemoryCoordination(CoordinationClient):
         ok = self._store.put(self._k(key), value, ttl_s, create_only=True)
         if ok and ttl_s and keepalive:
             with self._ka_lock:
+                if self._k(key) not in self._keepalives:
+                    _lifecycle.note_acquire("coord-lease",
+                                            key=(id(self), self._k(key)))
                 self._keepalives[self._k(key)] = ttl_s
         return ok
 
@@ -304,7 +311,9 @@ class InMemoryCoordination(CoordinationClient):
 
     def release(self, key) -> None:
         with self._ka_lock:
-            self._keepalives.pop(self._k(key), None)
+            if self._keepalives.pop(self._k(key), None) is not None:
+                _lifecycle.note_release("coord-lease",
+                                        key=(id(self), self._k(key)))
 
     def add_watch(self, prefix, cb) -> int:
         ns_prefix = self._k(prefix)
@@ -324,6 +333,8 @@ class InMemoryCoordination(CoordinationClient):
     def close(self) -> None:
         self._closed.set()
         with self._ka_lock:
+            for k in self._keepalives:
+                _lifecycle.note_release("coord-lease", key=(id(self), k))
             self._keepalives.clear()
         for wid in list(self._watch_ids):
             self._store.remove_watch(wid)

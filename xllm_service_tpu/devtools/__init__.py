@@ -1,7 +1,10 @@
 """Developer tooling: concurrency-invariant linting + instrumented locks
-+ RCU publication discipline.
++ RCU publication discipline + state ownership + paired-effect
+lifecycles.
 
-Three halves, one contract:
+One contract, repeated: a declared registry in the source, a static
+xlint pass that cross-checks it bidirectionally, and an opt-in runtime
+verifier that checks the dynamic paths static analysis cannot see:
 
 - :mod:`.xlint` — an AST static-analysis pass enforcing the orchestration
   plane's concurrency and fault-plane invariants (lock discipline, lock
@@ -22,6 +25,16 @@ Three halves, one contract:
   the ``publish()``/``thaw()`` runtime: passthrough normally, deep-freeze
   under ``XLLM_RCU_DEBUG=1`` so the same suite doubles as a
   snapshot-race detector.
+- :mod:`.ownership` — the shared-state ownership model closing the
+  unregistered middle between locks and RCU: ``STATE_DISCIPLINES``
+  declares a discipline per mutable attribute (the authority for
+  xlint's state rules), and under ``XLLM_STATE_DEBUG=1`` an
+  instrumented ``__setattr__`` cross-checks every write at runtime.
+- :mod:`.lifecycle` — the paired-effect registry (``EFFECT_PAIRS``; the
+  authority for xlint's pair-release / pair-once / pair-evict rules)
+  plus the ``XLLM_LEAK_DEBUG=1`` balance verifier: per-pair counters
+  with acquisition stacks catch slot leaks, double-releases and
+  resurrected metric series the static rules cannot reach.
 
 The declared lock order lives in the source as ``# lock-order: N``
 annotations on each lock declaration; xlint verifies the static

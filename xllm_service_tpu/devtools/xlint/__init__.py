@@ -75,6 +75,25 @@ rule id                    invariant
                            do not read lock-guarded mutable attributes
                            without the lock — go through an RCU snapshot
                            or take it
+``pair-release``           every acquire site of a ``finally``-scope pair
+                           registered in ``devtools/lifecycle.py``'s
+                           ``EFFECT_PAIRS`` is discharged by a try/finally
+                           that reaches the declared release (in the
+                           acquiring function or every resolvable caller)
+                           or by the declared ownership transfer; stale /
+                           malformed / dead registry entries are
+                           violations too
+``pair-once``              no path releases a ``finally``-scope pair twice:
+                           two unconditional releases in one function, or
+                           an unconditional release lexically after the
+                           declared ownership transfer, are flagged —
+                           guard the release with the slot-ownership flag
+``pair-evict``             labeled metric series are evicted only through
+                           the blessed helper the ``evict``-scope pair
+                           declares (no direct ``INSTRUMENT.remove(...)``
+                           outside metrics.py), and no function writes to
+                           an instrument after evicting its series (the
+                           gauge-resurrection shape)
 =========================  ==================================================
 
 ``async with`` acquisitions of declared asyncio locks participate in the
@@ -96,18 +115,32 @@ Escape hatches are inline comments with a mandatory reason::
     # xlint: allow-state-decl(reason)
     # xlint: allow-state-write(reason)
     # xlint: allow-state-read(reason)
+    # xlint: allow-pair-release(reason)
+    # xlint: allow-pair-once(reason)
+    # xlint: allow-pair-evict(reason)
 
 The state rules also accept the runtime hatch — writes lexically inside
 ``with ownership.escape("reason"):`` are exempt (and an empty reason is
-itself a violation, mirroring ``rcu.thaw``).
+itself a violation, mirroring ``rcu.thaw`` and ``lifecycle.escape``).
 
 Run: ``python -m xllm_service_tpu.devtools.xlint xllm_service_tpu``
 (exit 0 = clean, 1 = violations, 2 = usage error). ``--format json``
 emits one machine-readable object (``{"profile", "roots", "files",
-"count", "violations": [{"rule", "path", "line", "message"}, ...]}``)
-with the same exit codes — ``scripts/check.sh`` consumes it. The whole
-tree is parsed ONCE per run: every rule shares the same per-file AST
-and cached node walks (``SourceFile.walk`` / ``Project.fn_walk``).
+"count", "violations": [{"rule", "path", "line", "message"}, ...],
+"hatches": [{"path", "line", "kind", "reason"}, ...]}`` — the hatches
+list surfaces every escape-hatch reason in the tree, comment hatches and
+``ownership.escape``/``rcu.thaw``/``lifecycle.escape`` runtime hatches
+alike, so reviews can audit them) with the same exit codes —
+``scripts/check.sh`` consumes it. The whole tree is parsed ONCE per run:
+every rule shares the same per-file AST and cached node walks
+(``SourceFile.walk`` / ``Project.fn_walk``).
+
+``--changed <git-ref>`` lints the full tree but REPORTS only violations
+in files changed vs the ref (``git diff --name-only <ref>``), plus any
+violation in a registry file — full-tree semantics are preserved (the
+registries are cross-checked against every call site, so killing the
+last call site of a fault point from an unchanged registry still
+reports), while the output stays scoped to your diff.
 
 Support code (tests/, benchmarks/) is linted with the RELAXED profile —
 ``python -m xllm_service_tpu.devtools.xlint --support tests benchmarks``
@@ -135,6 +168,7 @@ SUPPRESSIBLE = {
     "lock-annotation", "local-lock", "span-point", "hot-json",
     "rcu-frozen", "rcu-publish", "rcu-read", "async-blocking",
     "state-decl", "state-write", "state-read",
+    "pair-release", "pair-once", "pair-evict",
 }
 
 
@@ -157,6 +191,10 @@ class SourceFile:
     lines: list[str]
     # line number -> set of rule tokens allowed on that line.
     suppressions: dict[int, set[str]] = field(default_factory=dict)
+    # Registered comment hatches with their reasons, in line order:
+    # (line, token, reason). The JSON output surfaces these (plus the
+    # runtime escape/thaw hatches) so hatch reasons stay auditable.
+    hatches: "list[tuple[int, str, str]]" = field(default_factory=list)
     # Cached flat node list: the tree is parsed once per run and every
     # rule shares the same walk instead of re-walking per rule (the
     # single-parse/single-walk contract the CLI advertises).
@@ -183,13 +221,49 @@ class SourceFile:
         return None
 
 
-def _parse_suppressions(lines: list[str]) -> dict[int, set[str]]:
+def _parse_suppressions(lines: list[str]) -> tuple[
+        dict[int, set[str]], "list[tuple[int, str, str]]"]:
+    """Comment hatches → (line→tokens map, [(line, token, reason)]).
+    The reason is mandatory: an empty one does not register the
+    suppression (so the violation it meant to silence still fires)."""
     out: dict[int, set[str]] = {}
+    hatches: list[tuple[int, str, str]] = []
     for i, line in enumerate(lines, 1):
         for m in _SUPPRESS_RE.finditer(line):
             token, reason = m.group(1), m.group(2).strip()
             if token in SUPPRESSIBLE and reason:
                 out.setdefault(i, set()).add(token)
+                hatches.append((i, token, reason))
+    return out, hatches
+
+
+# Runtime escape hatches whose reason argument position we know:
+# ownership.escape(reason) / lifecycle.escape(reason) take it first,
+# rcu.thaw(obj, reason) second.
+_RUNTIME_HATCHES = {"escape": 0, "thaw": 1}
+
+
+def _runtime_hatches(f: "SourceFile") -> "list[tuple[int, str, str]]":
+    """``escape(...)``/``thaw(...)`` calls with their literal reasons —
+    the runtime half of the hatch audit. Non-literal reasons surface as
+    ``"<dynamic>"`` (still auditable, just not statically)."""
+    out: list[tuple[int, str, str]] = []
+    for node in f.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else \
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        if name not in _RUNTIME_HATCHES:
+            continue
+        idx = _RUNTIME_HATCHES[name]
+        if len(node.args) > idx:
+            a = node.args[idx]
+            reason = a.value if isinstance(a, ast.Constant) \
+                and isinstance(a.value, str) else "<dynamic>"
+        else:
+            reason = ""   # missing reason: the state/rcu rules flag it
+        out.append((node.lineno, name, reason))
     return out
 
 
@@ -225,8 +299,10 @@ def load_files(roots: list[str]) -> tuple[list[SourceFile], list[Violation]]:
                                         or 0, f"cannot parse: {e}"))
                 continue
             lines = src.splitlines()
+            suppressions, hatches = _parse_suppressions(lines)
             files.append(SourceFile(path=p, rel=rel, tree=tree, lines=lines,
-                                    suppressions=_parse_suppressions(lines)))
+                                    suppressions=suppressions,
+                                    hatches=hatches))
     return files, errors
 
 
@@ -244,6 +320,18 @@ def run(roots: list[str], profile: str = "strict",
     files, violations = load_files(roots)
     if stats is not None:
         stats["files"] = len(files)
+        hatches = []
+        for f in files:
+            for line, token, reason in f.hatches:
+                hatches.append({"path": f.rel, "line": line,
+                                "kind": f"comment:{token}",
+                                "reason": reason})
+            for line, name, reason in _runtime_hatches(f):
+                hatches.append({"path": f.rel, "line": line,
+                                "kind": f"runtime:{name}",
+                                "reason": reason})
+        stats["hatches"] = sorted(hatches,
+                                  key=lambda h: (h["path"], h["line"]))
     project = rules.Project(files)
     active = rules.ALL_RULES if profile == "strict" else rules.SUPPORT_RULES
     for rule_fn in active:
@@ -254,7 +342,30 @@ def run(roots: list[str], profile: str = "strict",
 #: Flags the CLI understands; anything else dash-prefixed is a usage
 #: error (stable exit code 2, so callers can tell "violations" from
 #: "you invoked me wrong").
-_KNOWN_FLAGS = {"-q", "--support", "--format"}
+_KNOWN_FLAGS = {"-q", "--support", "--format", "--changed"}
+
+#: Registry files: violations here are NEVER filtered by --changed —
+#: the registries are bidirectionally cross-checked against every call
+#: site, so an unchanged registry can go stale because of your diff.
+_REGISTRY_BASENAMES = {"faults.py", "tracing.py", "wire.py", "rcu.py",
+                       "ownership.py", "lifecycle.py", "metrics.py"}
+
+
+def _changed_files(ref: str) -> "set[str] | None":
+    """Basenamed-relative paths changed vs `ref` (tracked diff +
+    untracked), or None when git can't answer (bad ref / not a repo)."""
+    import subprocess
+    out: set[str] = set()
+    for cmd in (["git", "diff", "--name-only", ref],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 check=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        out.update(line.strip() for line in res.stdout.splitlines()
+                   if line.strip().endswith(".py"))
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -262,6 +373,7 @@ def main(argv: list[str] | None = None) -> int:
     quiet = "-q" in argv
     profile = "support" if "--support" in argv else "strict"
     fmt = "text"
+    changed_ref: "str | None" = None
     roots: list[str] = []
     i = 0
     while i < len(argv):
@@ -272,6 +384,13 @@ def main(argv: list[str] | None = None) -> int:
                       file=sys.stderr)
                 return 2
             fmt = argv[i + 1]
+            i += 2
+            continue
+        if a == "--changed":
+            if i + 1 >= len(argv) or argv[i + 1].startswith("-"):
+                print("xlint: --changed takes a git ref", file=sys.stderr)
+                return 2
+            changed_ref = argv[i + 1]
             i += 2
             continue
         if a.startswith("-") and a not in _KNOWN_FLAGS:
@@ -286,6 +405,23 @@ def main(argv: list[str] | None = None) -> int:
         roots = [str(pkg)]
     stats: dict = {}
     violations = run(roots, profile=profile, stats=stats)
+    if changed_ref is not None:
+        # Full-tree analysis (registry cross-checks and call-graph
+        # summaries need the whole tree), output scoped to the diff.
+        changed = _changed_files(changed_ref)
+        if changed is None:
+            print(f"xlint: --changed {changed_ref!r}: git diff failed "
+                  f"(bad ref or not a git checkout)", file=sys.stderr)
+            return 2
+        changed_norm = {c.replace("\\", "/") for c in changed}
+
+        def keep(v: Violation) -> bool:
+            p = v.path.replace("\\", "/")
+            if Path(p).name in _REGISTRY_BASENAMES:
+                return True
+            return any(c == p or c.endswith("/" + p) for c in changed_norm)
+
+        violations = [v for v in violations if keep(v)]
     if fmt == "json":
         import json as _json
 
@@ -293,14 +429,18 @@ def main(argv: list[str] | None = None) -> int:
             "profile": profile,
             "roots": roots,
             "files": stats.get("files", 0),
+            "changed": changed_ref,
             "count": len(violations),
             "violations": [{"rule": v.rule, "path": v.path,
                             "line": v.line, "message": v.message}
                            for v in violations],
+            "hatches": stats.get("hatches", []),
         }, indent=None))
         return 1 if violations else 0
     for v in violations:
         print(v)
     if not violations and not quiet:
-        print(f"xlint: clean ({len(roots)} root(s), {profile} profile)")
+        scope = f", changed vs {changed_ref}" if changed_ref else ""
+        print(f"xlint: clean ({len(roots)} root(s), {profile} "
+              f"profile{scope})")
     return 1 if violations else 0

@@ -2066,6 +2066,416 @@ def rule_state(project: Project) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------------- effect pairs
+def _pair_registry(project: Project):
+    """The ``devtools/lifecycle.py`` EFFECT_PAIRS registry (detected by
+    filename, like the other registries): returns ``(registry file,
+    {name: (PairSpec, line)}, [(error, line)])`` — or ``(None, {}, [])``
+    when the tree subset has no registry (fixture runs)."""
+    from ..lifecycle import parse_spec
+    for f in project.files:
+        if f.path.name != "lifecycle.py":
+            continue
+        items = _registry_items(f, "EFFECT_PAIRS")
+        if not items:
+            continue
+        specs: dict[str, tuple] = {}
+        errors: list[tuple[str, int]] = []
+        for name, (val, line) in items.items():
+            spec, errs = parse_spec(name, val)
+            if spec is not None:
+                specs[name] = (spec, line)
+            errors.extend((e, line) for e in errs)
+        return f, specs, errors
+    return None, {}, []
+
+
+def _ctor_class(node: ast.AST) -> Optional[str]:
+    """Class name when `node` is a ``ClassName(...)`` constructor call."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else \
+        fn.attr if isinstance(fn, ast.Attribute) else None
+    return name if name and name[:1].isupper() else None
+
+
+def _pair_aliases(project: Project) -> "dict[tuple, str]":
+    """Receiver-resolution index for pair call sites. GENERIC_NAMES
+    blocks method-name resolution for release-side names (``release``,
+    ``remove``, ``record`` …), so the pair rules resolve the RECEIVER:
+    module-level ``ADMISSION = AdmissionController(...)`` singletons map
+    ``("<module>", "ADMISSION")`` → class, and ``self._journal =
+    DeltaJournal(...)`` inits map ``(OwnerCls, "_journal")`` → class."""
+    aliases: dict[tuple, str] = {}
+    for f in project.files:
+        for node in f.tree.body:
+            cls = _ctor_class(node.value) \
+                if isinstance(node, ast.Assign) else None
+            if cls:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases[("<module>", t.id)] = cls
+        for cls_name, fn in _iter_functions(f):
+            if cls_name is None:
+                continue
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                cls = _ctor_class(sub.value)
+                if not cls:
+                    continue
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        aliases[(cls_name, t.attr)] = cls
+    return aliases
+
+
+def _pair_call_class(project: Project, aliases: "dict[tuple, str]",
+                     cls_name: Optional[str],
+                     call: ast.Call) -> Optional[str]:
+    """Best-effort class of the receiver of an ``x.meth(...)`` call."""
+    text = _expr_text(call.func.value)
+    if text == "self":
+        return cls_name
+    if text.startswith("self.") and text.count(".") == 1:
+        hit = aliases.get((cls_name, text[5:])) if cls_name else None
+        if hit:
+            return hit
+    last = text.rsplit(".", 1)[-1]
+    if ("<module>", last) in aliases:
+        return aliases[("<module>", last)]
+    if last in project.method_classes.get(call.func.attr, set()) or \
+            (last, call.func.attr) in project.methods:
+        return last          # classmethod/staticmethod-style receiver
+    meth = call.func.attr
+    if meth not in GENERIC_NAMES:
+        owners = project.method_classes.get(meth, set())
+        if len(owners) == 1:
+            return next(iter(owners))
+    return None
+
+
+def _pair_own_classes(spec) -> set:
+    own = {spec.acquire[0], spec.release[0]}
+    if spec.transfer:
+        own.add(spec.transfer[0])
+    if spec.sink:
+        own.add(spec.sink[0])
+    return own
+
+
+def rule_pair_release(project: Project) -> list[Violation]:
+    """Every acquire site of a ``finally``-scope pair must be discharged
+    by a try/finally that reaches the declared release — in the
+    acquiring function itself or (for an acquire wrapped in a helper) in
+    EVERY resolvable caller — or by the declared ownership transfer.
+    This is the exact shape of the PR-12 admission-slot leak. Also owns
+    the registry cross-checks: malformed specs, stale endpoints, dead
+    pairs."""
+    reg_f, specs, errors = _pair_registry(project)
+    if reg_f is None:
+        return []
+    out: list[Violation] = [
+        Violation("pair-release", reg_f.rel, line, msg)
+        for msg, line in errors
+        if not reg_f.allowed("pair-release", line)]
+    aliases = _pair_aliases(project)
+
+    # Bidirectional half 1: every declared endpoint must resolve to a
+    # method defined somewhere in the tree.
+    for name, (spec, line) in specs.items():
+        for role, ref in (("acquire", spec.acquire),
+                          ("release", spec.release),
+                          ("transfer", spec.transfer),
+                          ("sink", spec.sink)):
+            if ref is not None and ref not in project.methods \
+                    and not reg_f.allowed("pair-release", line):
+                out.append(Violation(
+                    "pair-release", reg_f.rel, line,
+                    f"stale pair '{name}': {role} target "
+                    f"{ref[0]}.{ref[1]} is not defined in the tree"))
+
+    fin_pairs = [(name, spec) for name, (spec, _l) in specs.items()
+                 if spec.scope == "finally"
+                 and spec.acquire in project.methods]
+    if not fin_pairs:
+        return out
+
+    fn_index: dict[tuple, tuple] = {}
+    for f in project.files:
+        for cls_name, fn in _iter_functions(f):
+            fn_index[(cls_name, fn.name)] = (fn, f)
+    contexts = project.call_contexts()
+
+    def releases_in_finally(fn, cls_name, spec) -> bool:
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Try) and node.finalbody):
+                continue
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == spec.release[1] \
+                            and _pair_call_class(project, aliases,
+                                                 cls_name, sub) \
+                            == spec.release[0]:
+                        return True
+        return False
+
+    def discharged(key: tuple, spec, seen: frozenset) -> bool:
+        """True when `key`'s function holds the finally-release itself
+        or every resolvable caller does (the acquire-in-a-helper shape:
+        the service's _admission_check acquires, its handler callers own
+        the slot's try/finally). Cycle edges resolve optimistically,
+        like the lock-summary fixpoint."""
+        if key in seen:
+            return True
+        entry = fn_index.get(key)
+        if entry is None:
+            return False
+        fn, _f = entry
+        if releases_in_finally(fn, key[0], spec):
+            return True
+        callers = {c for (c, _locks, _d) in contexts.get(key, ())}
+        callers.discard(key)
+        if not callers:
+            return False
+        seen = seen | {key}
+        return all(discharged(c, spec, seen) for c in callers)
+
+    live: set = set()
+    for f in project.files:
+        for cls_name, fn in _iter_functions(f):
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                for name, spec in fin_pairs:
+                    if node.func.attr != spec.acquire[1] \
+                            or _pair_call_class(project, aliases, cls_name,
+                                                node) != spec.acquire[0]:
+                        continue
+                    live.add(name)
+                    if cls_name in _pair_own_classes(spec):
+                        continue     # the pair's own machinery
+                    if f.allowed("pair-release", node.lineno):
+                        continue
+                    if discharged((cls_name, fn.name), spec, frozenset()):
+                        continue
+                    out.append(Violation(
+                        "pair-release", f.rel, node.lineno,
+                        f"acquire of pair '{name}' "
+                        f"({spec.acquire[0]}.{spec.acquire[1]}) is not "
+                        f"discharged by a try/finally "
+                        f"{spec.release[0]}.{spec.release[1]} here or in "
+                        f"its callers (the PR-12 slot-leak shape)"))
+
+    # Bidirectional half 2: a finally-pair no code acquires is dead —
+    # the registry entry outlived its last call site.
+    for name, (spec, line) in specs.items():
+        if spec.scope == "finally" and spec.acquire in project.methods \
+                and name not in live \
+                and not reg_f.allowed("pair-release", line):
+            out.append(Violation(
+                "pair-release", reg_f.rel, line,
+                f"dead pair '{name}': no acquire call site of "
+                f"{spec.acquire[0]}.{spec.acquire[1]} in the tree"))
+    return out
+
+
+def rule_pair_once(project: Project) -> list[Violation]:
+    """No path may release a ``finally``-scope pair twice: two
+    unconditional releases in one function, or an unconditional release
+    lexically after the declared ownership transfer (the transferred
+    slot is released by the sink — releasing it here too would
+    double-release). A release under a flag guard (``if slot["held"]``)
+    is the blessed shape."""
+    reg_f, specs, _errors = _pair_registry(project)
+    if reg_f is None:
+        return []
+    aliases = _pair_aliases(project)
+    fin_pairs = [(name, spec) for name, (spec, _l) in specs.items()
+                 if spec.scope == "finally"]
+    if not fin_pairs:
+        return []
+
+    out: list[Violation] = []
+    GUARDS = (ast.If, ast.IfExp, ast.While, ast.ExceptHandler,
+              ast.Assert, ast.BoolOp)
+
+    for f in project.files:
+        for cls_name, fn in _iter_functions(f):
+            for name, spec in fin_pairs:
+                if cls_name in _pair_own_classes(spec):
+                    continue
+                rels: list = []     # unguarded release calls, in order
+                xfers: list = []    # unguarded transfer calls, in order
+
+                def collect(node, guarded):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute):
+                        attr = node.func.attr
+                        if attr == spec.release[1] and not guarded \
+                                and _pair_call_class(
+                                    project, aliases, cls_name, node) \
+                                == spec.release[0]:
+                            rels.append(node)
+                        elif spec.transfer is not None \
+                                and attr == spec.transfer[1] \
+                                and not guarded \
+                                and _pair_call_class(
+                                    project, aliases, cls_name, node) \
+                                == spec.transfer[0]:
+                            xfers.append(node)
+                    for child in ast.iter_child_nodes(node):
+                        collect(child,
+                                guarded or isinstance(node, GUARDS))
+
+                collect(fn, False)
+                rels.sort(key=lambda n: n.lineno)
+                xfers.sort(key=lambda n: n.lineno)
+                for dup in rels[1:]:
+                    if not f.allowed("pair-once", dup.lineno):
+                        out.append(Violation(
+                            "pair-once", f.rel, dup.lineno,
+                            f"pair '{name}' released twice on the same "
+                            f"path (first release at line "
+                            f"{rels[0].lineno}); guard one release with "
+                            f"the slot-ownership flag"))
+                for rel in rels[:1]:
+                    first_xfer = next((x for x in xfers
+                                       if x.lineno < rel.lineno), None)
+                    if first_xfer is not None \
+                            and not f.allowed("pair-once", rel.lineno):
+                        out.append(Violation(
+                            "pair-once", f.rel, rel.lineno,
+                            f"pair '{name}' released after ownership "
+                            f"transfer ({spec.transfer[0]}."
+                            f"{spec.transfer[1]} at line "
+                            f"{first_xfer.lineno}) — the sink releases "
+                            f"the transferred slot; guard this release "
+                            f"with the slot-ownership flag"))
+    return out
+
+
+def rule_pair_evict(project: Project) -> list[Violation]:
+    """Labeled metric series are released ONLY through the blessed
+    eviction helper declared by the ``evict``-scope pair: a direct
+    ``INSTRUMENT.remove(...)`` outside metrics.py is a hand-rolled
+    eviction path, and a ``.labels(...)`` write lexically after an
+    eviction of the same instrument in the same function is the PR-12
+    gauge-resurrection shape."""
+    reg_f, specs, _errors = _pair_registry(project)
+    if reg_f is None:
+        return []
+    ev_pairs = [(name, spec, line) for name, (spec, line) in specs.items()
+                if spec.scope == "evict"]
+    if not ev_pairs:
+        return []
+
+    out: list[Violation] = []
+    decl_file = None
+    instruments: dict[str, int] = {}
+    top_defs: set[str] = set()
+    for f in project.files:
+        if f.path.name != "metrics.py":
+            continue
+        found: dict[str, int] = {}
+        defs: set[str] = set()
+        for node in f.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.add(node.name)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in ("counter", "gauge",
+                                                 "histogram"):
+                mname = _first_str_arg(node.value)
+                tgt = node.targets[0]
+                if mname and isinstance(tgt, ast.Name):
+                    found[tgt.id] = node.lineno
+        if found:
+            decl_file, instruments, top_defs = f, found, defs
+
+    helper_names: set[str] = set()
+    for name, spec, line in ev_pairs:
+        if spec.helper is None:
+            if not reg_f.allowed("pair-evict", line):
+                out.append(Violation(
+                    "pair-evict", reg_f.rel, line,
+                    f"evict pair '{name}' declares no helper= "
+                    f"(the blessed release site in metrics.py)"))
+            continue
+        helper_names.add(spec.helper)
+        if decl_file is not None and spec.helper not in top_defs \
+                and not reg_f.allowed("pair-evict", line):
+            out.append(Violation(
+                "pair-evict", reg_f.rel, line,
+                f"stale pair '{name}': helper {spec.helper}() is not "
+                f"defined in {decl_file.rel}"))
+    if decl_file is None or not instruments or not helper_names:
+        return out
+    blessed = sorted(helper_names)[0]
+
+    def recv_ident(call: ast.Call) -> Optional[str]:
+        recv = call.func.value
+        if isinstance(recv, ast.Name):
+            return recv.id
+        if isinstance(recv, ast.Attribute):
+            return recv.attr
+        return None
+
+    for f in project.files:
+        in_metrics = f.path.name == "metrics.py"
+        for _cls_name, fn in _iter_functions(f):
+            events: list[tuple[str, str, ast.Call]] = []
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute):
+                    ident = recv_ident(node)
+                    if ident not in instruments:
+                        continue
+                    if node.func.attr == "remove":
+                        events.append(("evict", ident, node))
+                        if not in_metrics \
+                                and not f.allowed("pair-evict",
+                                                  node.lineno):
+                            out.append(Violation(
+                                "pair-evict", f.rel, node.lineno,
+                                f"direct {ident}.remove(): evict labeled "
+                                f"series via the blessed {blessed}() "
+                                f"helper in metrics.py"))
+                    elif node.func.attr == "labels":
+                        events.append(("write", ident, node))
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in helper_names and node.args:
+                    a0 = node.args[0]
+                    ident = a0.id if isinstance(a0, ast.Name) else \
+                        a0.attr if isinstance(a0, ast.Attribute) else None
+                    if ident in instruments:
+                        events.append(("evict", ident, node))
+            events.sort(key=lambda e: e[2].lineno)
+            first_evict: dict[str, int] = {}
+            for kind, ident, node in events:
+                if kind == "evict":
+                    first_evict.setdefault(ident, node.lineno)
+                elif ident in first_evict \
+                        and node.lineno > first_evict[ident] \
+                        and not f.allowed("pair-evict", node.lineno):
+                    out.append(Violation(
+                        "pair-evict", f.rel, node.lineno,
+                        f"write to {ident} after its series were evicted "
+                        f"at line {first_evict[ident]} (the PR-12 "
+                        f"gauge-resurrection shape) — writes must only "
+                        f"be reachable while the owning entity is "
+                        f"registered"))
+    return out
+
+
 ALL_RULES = (
     rule_lock_discipline,
     rule_no_blocking_under_lock,
@@ -2078,6 +2488,9 @@ ALL_RULES = (
     rule_async_blocking,
     rule_rcu,
     rule_state,
+    rule_pair_release,
+    rule_pair_once,
+    rule_pair_evict,
 )
 
 #: Relaxed profile for support code (tests/, benchmarks/): every

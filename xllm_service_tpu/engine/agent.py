@@ -34,7 +34,12 @@ import jax
 
 from ..common import flightrecorder, tracing
 from ..common.flightrecorder import RECORDER
-from ..common.metrics import ENGINE_HEARTBEATS_TOTAL, ENGINE_PEER_LINKED
+from ..common.metrics import (
+    ENGINE_HEARTBEATS_TOTAL,
+    ENGINE_PEER_LINKED,
+    evict_series,
+)
+from ..devtools import lifecycle as _lifecycle
 from ..common.request import LogProb, RequestOutput, SamplingParams, Status, StatusCode
 from ..common.tracing import NOOP_SPAN, TRACER, TraceContext
 from ..common.types import (InstanceMetaInfo, InstanceType, TpuTopology,
@@ -931,7 +936,10 @@ class EngineAgent:
         if master == self._hb_master:
             return
         if self._hb_master:
-            ENGINE_HEARTBEATS_TOTAL.remove(master=self._hb_master)
+            evict_series(ENGINE_HEARTBEATS_TOTAL, master=self._hb_master)
+        # A flap back to a previously-evicted master legitimately
+        # re-creates its series (not the stale-writer resurrection bug).
+        _lifecycle.note_series_revived(master)
         self._hb_master = master
         self._hb_wire = dispatch_wire.WIRE_MSGPACK
 
@@ -1128,6 +1136,8 @@ class EngineAgent:
                     {"ok": False,
                      "error": f"kv layout mismatch on {f}"}, status=409)
         self.linked_peers[peer.name] = peer
+        # Unlink→relink of the same peer re-creates its series on purpose.
+        _lifecycle.note_series_revived(peer.name)
         ENGINE_PEER_LINKED.labels(peer=peer.name).set(1)
         return web.json_response({"ok": True})
 
@@ -1138,7 +1148,7 @@ class EngineAgent:
             # PD link torn down: evict the peer's labeled series, or a
             # long-lived engine's /metrics grows one dead series per
             # departed peer (ephemeral ports make the set unbounded).
-            ENGINE_PEER_LINKED.remove(peer=peer_name)
+            evict_series(ENGINE_PEER_LINKED, peer=peer_name)
         return web.json_response({"ok": True})
 
     async def _h_cancel(self, req: web.Request) -> web.Response:

@@ -51,6 +51,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..devtools import lifecycle as _lifecycle
 from ..devtools import ownership as _ownership
 from ..devtools import rcu
 from ..devtools.locks import make_lock
@@ -196,7 +197,9 @@ class TieredKVStore:
             with self._lock:
                 self._removed.append(hash_hex)
             return False
-        if not self._inflight.acquire(blocking=False):
+        if self._inflight.acquire(blocking=False):
+            _lifecycle.note_acquire("tier-inflight")
+        else:
             # Transfer pump saturated: dropping is the correct backpressure
             # (the alternative — unbounded queueing of device buffers —
             # pins HBM and eventually stalls the loop). The drop counter
@@ -213,6 +216,7 @@ class TieredKVStore:
                 # (same hash = same bytes — let the pending worker land).
                 self._superseded.discard(hash_hex)
                 self._inflight.release()
+                _lifecycle.note_release("tier-inflight")
                 return True     # already resident / in flight
             self._pending.add(hash_hex)
         if callable(blob):
@@ -225,6 +229,7 @@ class TieredKVStore:
                 self._pending.discard(hash_hex)
                 self._removed.append(hash_hex)
             self._inflight.release()
+            _lifecycle.note_release("tier-inflight")
             return False
         return True
 
@@ -241,6 +246,7 @@ class TieredKVStore:
                 self._removed.append(hash_hex)
         finally:
             self._inflight.release()
+            _lifecycle.note_release("tier-inflight")
 
     def _install_dram(self, hash_hex: str, arr: np.ndarray) -> None:
         """Land a fetched block in the arena, demoting the LRU DRAM block

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..common.faults import FAULTS
 from ..common.tracing import TRACER, TraceContext
+from ..devtools import lifecycle as _lifecycle
 from ..devtools.locks import make_lock
 from ..utils import get_logger
 
@@ -148,6 +149,8 @@ class StreamOfferTable:
             FAULTS.check("kv_transfer.offer", sid=service_request_id)
             self.gc()
             with self._lock:
+                if uid not in self._offers:
+                    _lifecycle.note_acquire("stream-offer", key=uid)
                 self._offers[uid] = (
                     data,
                     {"shape": list(shape), "dtype": dtype},
@@ -182,7 +185,8 @@ class StreamOfferTable:
 
     def release(self, uuid: int) -> None:
         with self._lock:
-            self._offers.pop(int(uuid), None)
+            if self._offers.pop(int(uuid), None) is not None:
+                _lifecycle.note_release("stream-offer", key=int(uuid))
 
     def gc(self) -> None:
         now = time.monotonic()
@@ -190,6 +194,7 @@ class StreamOfferTable:
             dead = [u for u, (_, _, dl) in self._offers.items() if dl < now]
             for u in dead:
                 self._offers.pop(u, None)
+                _lifecycle.note_release("stream-offer", key=u)
         if dead:
             logger.warning("dropped %d expired KV stream offers", len(dead))
 

@@ -42,6 +42,7 @@ from ..common.metrics import (
     HANDOFF_RECOVERIES_TOTAL,
 )
 from ..common.tracing import TRACER
+from ..devtools import lifecycle as _lifecycle
 from ..devtools.locks import make_lock
 from ..overload import RETRY_BUDGET
 from ..overload.deadline import ABS_DEADLINE_HEADER, PRIORITY_HEADER
@@ -59,13 +60,14 @@ class _JournalEntry:
     handlers polling length under the GIL); ``finished`` flips once,
     after the last frame."""
 
-    __slots__ = ("frames", "finished", "created", "touched")
+    __slots__ = ("frames", "finished", "created", "touched", "sid")
 
-    def __init__(self, now: float):
+    def __init__(self, now: float, sid: str = ""):
         self.frames: list[bytes] = []
         self.finished = False
         self.created = now
         self.touched = now
+        self.sid = sid
 
 
 class DeltaJournal:
@@ -110,7 +112,8 @@ class DeltaJournal:
             if entry is None:
                 if len(self._entries) >= self.max_requests:
                     return None
-                entry = self._entries[sid] = _JournalEntry(now)
+                entry = self._entries[sid] = _JournalEntry(now, sid)
+                _lifecycle.note_acquire("journal-session", key=sid)
             return entry
 
     def get(self, sid: str) -> Optional[_JournalEntry]:
@@ -131,12 +134,17 @@ class DeltaJournal:
     @staticmethod
     def finish(entry: Optional[_JournalEntry]) -> None:
         if entry is not None:
+            if not entry.finished:
+                _lifecycle.note_release("journal-session", key=entry.sid)
             entry.finished = True
 
     def _gc_locked(self, now: float) -> None:
         dead = [sid for sid, e in self._entries.items()
                 if now - e.touched > self.ttl_s]
         for sid in dead:
+            # Idempotent pair: a finished entry already released; this
+            # only balances entries the grace window abandoned.
+            _lifecycle.note_release("journal-session", key=sid)
             del self._entries[sid]
 
     def stats(self) -> dict:

@@ -33,6 +33,7 @@ from dataclasses import dataclass
 from typing import Any, Optional
 
 from ..common.slo import WindowCounts
+from ..devtools import lifecycle as _lifecycle
 from ..devtools import ownership as _ownership
 from ..devtools.locks import make_lock
 from .deadline import PRIORITY_BATCH
@@ -106,6 +107,7 @@ class AdmissionController:
             self._admitted_total = 0
             self._shed_total = {}
             self._shed_window = WindowCounts(_SHED_WINDOW_S)
+        _lifecycle.note_reset("admission-slot")
 
     @property
     def enabled(self) -> bool:
@@ -127,6 +129,7 @@ class AdmissionController:
             if admit:
                 self._pending += 1
                 self._admitted_total += 1
+                _lifecycle.note_acquire("admission-slot")
             else:
                 self._shed_total[priority] = \
                     self._shed_total.get(priority, 0) + 1
@@ -139,6 +142,7 @@ class AdmissionController:
         admitted must not be able to underflow the gate."""
         with self._lock:
             self._pending = max(0, self._pending - 1)
+            _lifecycle.note_release("admission-slot")
 
     # -------------------------------------------------------------- signals
     def shed_rate(self, now: Optional[float] = None) -> float:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 from typing import Any
 
+from ..devtools import lifecycle as _lifecycle
 from ..devtools import ownership as _ownership
 from ..devtools.locks import make_lock
 
@@ -61,6 +62,7 @@ class RetryBudget:
             self._tokens = self._cap
             self._spent_total = 0
             self._denied_total = 0
+        _lifecycle.note_reset("retry-budget")
 
     @property
     def enabled(self) -> bool:
@@ -72,6 +74,7 @@ class RetryBudget:
         with self._lock:
             if self._cap > 0:
                 self._tokens = min(self._cap, self._tokens + self._ratio)
+                _lifecycle.note_release("retry-budget")
 
     def try_spend(self, n: float = 1.0) -> bool:
         """Withdraw `n` tokens for a retry; False = budget exhausted,
@@ -82,6 +85,7 @@ class RetryBudget:
             if self._tokens >= n:
                 self._tokens -= n
                 self._spent_total += 1
+                _lifecycle.note_acquire("retry-budget")
                 return True
             self._denied_total += 1
             return False

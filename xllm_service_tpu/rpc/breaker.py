@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+from ..devtools import lifecycle as _lifecycle
 from ..devtools import ownership as _ownership
 from ..devtools.locks import make_lock
 
@@ -95,11 +96,13 @@ class CircuitBreaker:
                     return False
                 self._state = STATE_HALF_OPEN
                 self._probe_inflight = True
+                _lifecycle.note_acquire("breaker-probe", key=self.name)
                 return True
             # HALF_OPEN: one probe in flight at a time.
             if self._probe_inflight:
                 return False
             self._probe_inflight = True
+            _lifecycle.note_acquire("breaker-probe", key=self.name)
             return True
 
     def record(self, ok: bool, now: Optional[float] = None) -> None:
@@ -111,6 +114,8 @@ class CircuitBreaker:
         now = now if now is not None else time.monotonic()
         with self._lock:
             if self._state == STATE_HALF_OPEN:
+                if self._probe_inflight:
+                    _lifecycle.note_release("breaker-probe", key=self.name)
                 self._probe_inflight = False
                 if ok:
                     self._state = STATE_CLOSED

@@ -72,7 +72,9 @@ from ..common.metrics import (
     LOADINFO_AGE_SECONDS,
     RPC_RETRIES_TOTAL,
     TTFT_MS,
+    evict_series,
 )
+from ..devtools import lifecycle as _lifecycle
 from ..common.time_predictor import TimePredictor
 from ..common.types import (
     InstanceLoadInfo,
@@ -876,6 +878,11 @@ class InstanceMgr:
                 old.channel.close()
             self._instances[meta.name] = entry
             self._publish_snapshot()
+        # A legitimate re-registration (rolling restart, same name) may
+        # re-create series evicted with the previous incarnation — clear
+        # the leak verifier's tombstones so those are not misreported as
+        # the stale-writer resurrection bug.
+        _lifecycle.note_series_revived(meta.name)
         with self._metrics_lock:
             self._load_metrics.setdefault(meta.name, LoadMetrics())
             self._request_loads.setdefault(meta.name, _RequestLoad())
@@ -950,9 +957,10 @@ class InstanceMgr:
             # exporting stale labels. Inside _metrics_lock: the gauge
             # writers gate on _load_metrics membership under the same
             # lock, so a racing write can't resurrect a removed series.
-            INSTANCE_QUEUE_DEPTH.remove(instance=name)
+            evict_series(INSTANCE_QUEUE_DEPTH, instance=name)
             for phase in ("prefill", "decode"):
-                INSTANCE_INFLIGHT_REQUESTS.remove(instance=name, phase=phase)
+                evict_series(INSTANCE_INFLIGHT_REQUESTS, instance=name,
+                             phase=phase)
         # High-cardinality per-instance latency/retry series go too (a
         # histogram is 17 lines per child; fleet churn with ephemeral
         # ports would grow /metrics without bound). FAILOVER_* and
@@ -960,11 +968,11 @@ class InstanceMgr:
         # grow one small child per eviction event, not per instance
         # lifetime of traffic.
         policy = self._opts.load_balance_policy
-        TTFT_MS.remove(instance=name, policy=policy)
-        ITL_MS.remove(instance=name, policy=policy)
-        RPC_RETRIES_TOTAL.remove(instance=name)
-        CIRCUIT_BREAKER_OPEN.remove(instance=name)
-        LOADINFO_AGE_SECONDS.remove(instance=name)
+        evict_series(TTFT_MS, instance=name, policy=policy)
+        evict_series(ITL_MS, instance=name, policy=policy)
+        evict_series(RPC_RETRIES_TOTAL, instance=name)
+        evict_series(CIRCUIT_BREAKER_OPEN, instance=name)
+        evict_series(LOADINFO_AGE_SECONDS, instance=name)
         if reason not in ("replaced", "drained"):
             # Planned churn — a rolling-restart re-registration or a
             # completed graceful drain (autoscaler scale-in) — is not an

@@ -1344,6 +1344,19 @@ class Scheduler:
         self.kvcache_mgr.stop()
         self._output_executor.shutdown()
         self.schedule_executor.shutdown(wait=False)
+        # A stopping scheduler abandons its in-flight requests — but the
+        # admission gate is process-global, so their slots must be
+        # handed back or a killed master permanently shrinks the
+        # surviving masters' gate (found by the XLLM_LEAK_DEBUG drill).
+        # st.exited makes this exactly-once against racing late exits.
+        with self._req_lock:
+            for st in self._requests.values():
+                if not st.exited and st.request.admitted:
+                    st.exited = True
+                    st.finished = True
+                    st.request.admitted = False
+                    ADMISSION.release()
+            self._requests.clear()
         self._coord.release(SERVICE_KEY_PREFIX + self.self_addr)
         if self.is_master:
             self._coord.release(MASTER_KEY)
