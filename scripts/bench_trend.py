@@ -63,6 +63,9 @@ TRACKED: dict[str, list[tuple[str, bool]]] = {
         ("headline.leak_overhead_pct", False),
         ("headline.combined_overhead_pct", False),
     ],
+    "profile": [
+        ("headline.profile_overhead_pct", False),
+    ],
 }
 
 _NAME_RE = re.compile(r"^BENCH_(?:([a-z0-9]+)_)?r(\d+)\.json$")
@@ -109,6 +112,36 @@ def families(root: Path) -> dict[str, list[tuple[int, Path]]]:
     return out
 
 
+#: Composition-shift flags: a frame newly holding more than this share
+#: of profile samples (or whose share grew by more than this many
+#: points) between rounds is a flagged shift — the hot-frame evidence
+#: ROADMAP's native-extension item reads. Informational, not a failure:
+#: composition moves for good reasons too (a fix shrinks a tower).
+COMPOSITION_SHIFT_POINTS = 10.0
+
+
+def _composition_shifts(prev: dict, new: dict) -> list[str]:
+    """Diff ``composition.profile_top_frames`` (profile family docs):
+    per-frame self-sample share, new round vs previous."""
+    def shares(doc: dict) -> dict[str, float]:
+        frames = (doc.get("composition") or {}).get("profile_top_frames")
+        return {f["frame"]: float(f.get("pct", 0.0))
+                for f in frames or () if isinstance(f, dict)}
+
+    a, b = shares(prev), shares(new)
+    if not a or not b:
+        return []
+    out = []
+    for frame, pct in sorted(b.items(), key=lambda kv: -kv[1]):
+        delta = pct - a.get(frame, 0.0)
+        if delta > COMPOSITION_SHIFT_POINTS:
+            was = a.get(frame)
+            out.append(f"{frame}: {pct:.1f}% of samples "
+                       f"({'new' if was is None else f'was {was:.1f}%'}, "
+                       f"{delta:+.1f} points)")
+    return out
+
+
 def compare(root: Path, threshold_pct: float = 10.0,
             list_only: bool = False) -> int:
     regressions: list[str] = []
@@ -129,6 +162,8 @@ def compare(root: Path, threshold_pct: float = 10.0,
         tracked = dict(TRACKED.get(family, ()))
         for path, higher in _headline_paths(new):
             tracked.setdefault(path, higher)
+        for shift in _composition_shifts(prev, new):
+            print(f"bench_trend: {family}: COMPOSITION SHIFT — {shift}")
         for path, higher in sorted(tracked.items()):
             a, b = _lookup(prev, path), _lookup(new, path)
             if a is None or b is None:

@@ -6,7 +6,7 @@
 # all randomness from one seeded RNG), so any failing iteration can be
 # replayed exactly with:   XLLM_CHAOS_SEED=<seed> pytest -m chaos
 #
-# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs|--state|--autoscale|--overload|--outage] [extra pytest args...]
+# Usage: scripts/chaos_soak.sh [iterations] [--masters|--tier|--obs|--state|--autoscale|--overload|--outage|--profile] [extra pytest args...]
 #   --masters   soak the multi-master plane drills (tests/test_multimaster.py:
 #               owner/master kill mid-stream, split-brain demotion, write-lease
 #               proxying) instead of the single-master failover drills.
@@ -36,6 +36,15 @@
 #               requests whole, circuit-breaker open/probe/restore, the
 #               relayed client-disconnect cancellation drill, retry-
 #               budget exhaustion).
+#   --profile   soak the continuous-profiling drills
+#               (tests/test_profiling.py TestFleetProfile: the
+#               always-on sampler stays up through a fleet-scope
+#               /admin/profile merge with a killed agent, the relayed
+#               failed-over request's critical path sums to the
+#               measured TTFT, and SLO-breach bundles carry a profile
+#               window — with the sampler thread itself running under
+#               every instrumented leg below, including the combined
+#               LOCK+RCU+STATE+LEAK one).
 #   --outage    soak the coordination-plane static-stability drills
 #               (tests/test_multimaster.py TestCoordinationOutage +
 #               tests/test_chaos_failover.py TestCoordinationOutageFailover:
@@ -76,6 +85,9 @@ elif [ "${1:-}" = "--autoscale" ]; then
     shift
 elif [ "${1:-}" = "--overload" ]; then
     SUITES=("tests/test_overload.py")
+    shift
+elif [ "${1:-}" = "--profile" ]; then
+    SUITES=("tests/test_profiling.py")
     shift
 elif [ "${1:-}" = "--outage" ]; then
     SUITES=("tests/test_multimaster.py" "tests/test_chaos_failover.py")

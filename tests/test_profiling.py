@@ -1,0 +1,624 @@
+"""Tier-1 tests for the continuous-profiling plane (ISSUE 18):
+
+- sampling profiler units: role attribution off THREAD_ROLES, bounded
+  per-role stack tables (overflow bucket), window rotation, folded-stack
+  round-trip, depth bounding,
+- lifecycle: refcounted start/stop is leak-free under the runtime pair
+  verifier (strict `profiler-thread` pair), hz=0 spawns nothing,
+- anomaly path: flight-recorder bundles carry a non-empty profile window
+  while the sampler runs,
+- critical-path decomposition units (stage waits sum exactly to the
+  TTFT window; relay + failover cases) and the /admin/hotpath aggregate,
+- SLO trace exemplars: worst trace_id per window bucket,
+- CPU_ATTR -> hotpath_cpu_seconds_total counter export,
+- fleet drill: `/admin/profile?scope=fleet` merges per-role stacks,
+  survives a dead agent with a partial marker, and the critical path of
+  a relayed + failed-over request sums to the measured TTFT.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from xllm_service_tpu.common.faults import FAULTS
+from xllm_service_tpu.common.flightrecorder import RECORDER, FlightRecorder
+from xllm_service_tpu.common.hotpath import CpuAttribution
+from xllm_service_tpu.common.metrics import HOTPATH_CPU_SECONDS
+from xllm_service_tpu.common.slo import SloMonitor
+from xllm_service_tpu.common.tracing import TRACER
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.devtools import lifecycle as _lifecycle
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.profiling import (
+    CRITICAL_STAGES,
+    PROFILER,
+    SamplingProfiler,
+    aggregate_critical_paths,
+    critical_path,
+    parse_folded,
+    summarize_stacks,
+)
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import wait_until
+
+SEED = int(os.environ.get("XLLM_CHAOS_SEED", "0"))
+REPLY = "Every sample lands in exactly one stage bucket."
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    FAULTS.configure((), seed=SEED)
+    TRACER.configure(enabled=True, mirror=None, sample_rate=1.0)
+    TRACER.store.clear()
+    RECORDER.clear()
+    RECORDER.configure(capacity=64, directory="")
+    yield
+    FAULTS.clear()
+    TRACER.configure(enabled=True, mirror=None, sample_rate=1.0)
+    RECORDER.configure(capacity=64, directory="")
+
+
+def _opts(**kw):
+    from xllm_service_tpu.common.config import ServiceOptions
+
+    base = dict(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        lease_ttl_s=0.5, sync_interval_s=0.2,
+        reconcile_interval_s=0.05,
+        heartbeat_silence_to_suspect_s=0.3,
+        detect_disconnected_instance_interval_s=0.3,
+        health_probe_attempts=1, health_probe_timeout_s=0.2,
+        failover_backoff_base_s=0.05, failover_backoff_max_s=0.3,
+        rpc_backoff_base_s=0.02, rpc_backoff_max_s=0.1,
+        handoff_stall_timeout_s=1.5,
+        metrics_fleet_cache_ttl_s=0.0,
+        fleet_peer_timeout_s=2.0,
+        profile_hz=97.0, profile_window_s=60.0)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def _master(store, **kw) -> Master:
+    m = Master(_opts(**kw), coord=InMemoryCoordination(store))
+    m.start()
+    return m
+
+
+def _engine(store, **cfg_kw) -> FakeEngine:
+    cfg_kw.setdefault("delay_s", 0.02)
+    cfg = FakeEngineConfig(reply_text=REPLY, chunk_size=4,
+                           heartbeat_interval_s=0.1, lease_ttl_s=0.5,
+                           **cfg_kw)
+    return FakeEngine(InMemoryCoordination(store), cfg).start()
+
+
+def _base(m: Master) -> str:
+    return f"http://127.0.0.1:{m.http_port}"
+
+
+def _await_fleet(masters, engines) -> None:
+    addrs = {m.scheduler.self_addr for m in masters}
+    assert wait_until(
+        lambda: all(
+            all(m.scheduler.instance_mgr.get_instance_meta(e.name)
+                is not None for e in engines)
+            and set(m.scheduler.ownership.members()) == addrs
+            for m in masters), timeout=20)
+
+
+# ------------------------------------------------------------ sampler units
+class TestSampler:
+    def test_role_attribution_and_frame_labels(self):
+        """A thread named with a THREAD_ROLES prefix aggregates under
+        that role; the main thread under 'main'; an unregistered worker
+        under its name stem — with real file:qualname frame labels."""
+        p = SamplingProfiler()
+        p.configure(hz=500, window_s=60)
+        stop = threading.Event()
+
+        def _spin_marker():
+            while not stop.wait(0.001):
+                pass
+
+        threads = [threading.Thread(target=_spin_marker, daemon=True,
+                                    name=name)
+                   for name in ("engine-loop-0", "fleetworker-7_3")]
+        for t in threads:
+            t.start()
+        p.start()
+        try:
+            assert wait_until(lambda: p.snapshot()["samples"] > 20,
+                              timeout=10)
+            snap = p.snapshot()
+        finally:
+            p.stop()
+            stop.set()
+            for t in threads:
+                t.join(5)
+        roles = snap["roles"]
+        # engine-loop-* is the registered engine-pump role; the
+        # unregistered worker groups under its numbering-stripped stem.
+        assert "engine-pump" in roles
+        assert "fleetworker" in roles
+        assert "main" in roles
+        # Stacks carry file:qualname labels from the real frames (the
+        # leaf is the Event.wait; the marker function sits above it).
+        stacks = " ".join(s["stack"]
+                          for s in roles["engine-pump"]["top_stacks"])
+        assert "test_profiling.py" in stacks
+        assert "_spin_marker" in stacks
+        # The sampler never samples itself.
+        assert "profiler" not in roles
+
+    def test_bounded_stacks_overflow_bucket(self):
+        """Per-role distinct-stack tables cap at max_stacks; the excess
+        is charged to a visible overflow bucket, not dropped and not
+        unbounded."""
+        p = SamplingProfiler()
+        p.configure(hz=0, window_s=60, max_stacks=16)
+        for i in range(100):
+            p._merge([("role", (f"frame-{i}",))], now=time.monotonic())
+        snap = p.snapshot(top_n=200)
+        role = snap["roles"]["role"]
+        assert role["samples"] == 100
+        stacks = {s["stack"] for s in role["top_stacks"]}
+        assert len(stacks) == 17   # 16 distinct + the overflow bucket
+        overflow = next(s for s in role["top_stacks"]
+                        if s["stack"] == "(overflow)")
+        assert overflow["count"] == 84
+
+    def test_bounded_role_cardinality(self):
+        """Adversarial thread naming (one distinct role per sample) must
+        not grow the role table past MAX_ROLES + the spill bucket."""
+        from xllm_service_tpu.profiling.sampler import MAX_ROLES, _name_stem
+
+        p = SamplingProfiler()
+        p.configure(hz=0)
+        for i in range(500):
+            p._merge([(f"role-{i}", ("f",))], now=time.monotonic())
+        with p._lock:
+            assert len(p._agg) <= MAX_ROLES + 1
+            assert p._agg["(otherrole)"]
+        assert p.snapshot(top_n=1000)["samples"] == 500
+        # CPython default worker names collapse to the target function.
+        assert _name_stem("Thread-1078 (_generate)") == "_generate"
+        assert _name_stem("ThreadPoolExecutor-0_3") == "ThreadPoolExecutor"
+
+    def test_depth_bound_keeps_leaf_side(self):
+        p = SamplingProfiler()
+        p.configure(hz=500, window_s=60, max_depth=4)
+        stop = threading.Event()
+
+        def _recurse(n):
+            if n:
+                return _recurse(n - 1)
+            while not stop.wait(0.001):
+                pass
+
+        t = threading.Thread(target=lambda: _recurse(40), daemon=True,
+                             name="deepworker")
+        t.start()
+        p.start()
+        try:
+            assert wait_until(
+                lambda: "deepworker" in p.snapshot()["roles"], timeout=10)
+            snap = p.snapshot()
+        finally:
+            p.stop()
+            stop.set()
+            t.join(5)
+        for s in snap["roles"]["deepworker"]["top_stacks"]:
+            frames = s["stack"].split(";")
+            assert len(frames) <= 4
+            # Leaf side kept: the innermost frame is the wait, not the
+            # thread bootstrap.
+            assert "bootstrap" not in frames[-1]
+
+    def test_window_rotation_keeps_last_complete_window(self):
+        p = SamplingProfiler()
+        p.configure(hz=0, window_s=5, max_stacks=64)
+        t0 = time.monotonic()
+        with p._lock:
+            p._window_started = t0
+        p._merge([("r", ("a",))], now=t0 + 1)
+        p._merge([("r", ("b",))], now=t0 + 6)      # rotates
+        p._merge([("r", ("c",))], now=t0 + 7)
+        ctx_roles = p.snapshot(top_n=10)["roles"]["r"]
+        # Snapshot merges prev + live: all three stacks visible.
+        assert ctx_roles["samples"] == 3
+        with p._lock:
+            assert ("a",) in p._prev["r"] and ("b",) in p._prev["r"]
+            assert ("c",) in p._agg["r"]
+            assert p._prev_ticks == 2
+
+    def test_folded_roundtrip_and_summary(self):
+        counts = {("main", "a.py:f", "a.py:g"): 7,
+                  ("engine-pump", "b.py:h"): 3}
+        p = SamplingProfiler()
+        p.configure(hz=0)
+        for stack, n in counts.items():
+            p._merge([(stack[0], stack[1:])] * n, now=time.monotonic())
+        folded = p.folded()
+        assert "main;a.py:f;a.py:g 7" in folded
+        assert parse_folded(folded) == counts
+        summary = summarize_stacks(counts, top_n=5)
+        assert summary["samples"] == 10
+        assert summary["roles"]["main"]["samples"] == 7
+        assert summary["top_frames"][0]["frame"] == "a.py:g"
+        assert summary["top_frames"][0]["pct"] == 70.0
+
+    def test_refcounted_stop_is_leak_free(self):
+        """Strict `profiler-thread` pair under the runtime verifier:
+        start/start/stop/stop leaves zero balance, no violations, and no
+        sampler thread alive."""
+        was = _lifecycle.debug_enabled()
+        _lifecycle.set_debug(True)
+        _lifecycle.reset_violations()
+        _lifecycle.reset_balances()
+        try:
+            p = SamplingProfiler()
+            p.configure(hz=500)
+            p.start()
+            p.start()          # second owner: refcount, no second thread
+            assert p.running()
+            assert sum(1 for t in threading.enumerate()
+                       if t.name == "profiler-sampler") == 1
+            p.stop()
+            assert p.running()   # one owner left
+            p.stop()
+            assert not p.running()
+            assert wait_until(
+                lambda: not any(t.name == "profiler-sampler"
+                                for t in threading.enumerate()), timeout=5)
+            p.stop()             # idempotent: no outstanding start
+            vs = _lifecycle.violations() + _lifecycle.strict_imbalances()
+            assert not vs, "\n".join(str(v) for v in vs)
+        finally:
+            _lifecycle.set_debug(was)
+            _lifecycle.reset_balances()
+
+    def test_hz_zero_spawns_nothing(self):
+        p = SamplingProfiler()
+        p.configure(hz=0)
+        p.start()
+        assert not p.running()
+        assert p.snapshot()["enabled"] is False
+        assert p.anomaly_context() == {"enabled": False}
+        p.stop()
+
+    def test_anomaly_bundle_carries_profile_window(self):
+        """While the sampler runs, every flight-recorder bundle's context
+        includes a non-empty profile of the last window."""
+        rec = FlightRecorder(capacity=8)
+        p = SamplingProfiler()
+        p.configure(hz=500, window_s=60)
+        p.start()
+        try:
+            # The profiler registers its provider on the GLOBAL recorder;
+            # mirror it onto this test-local one.
+            rec.add_context_provider("profile", p.anomaly_context)
+            assert wait_until(lambda: p.snapshot()["samples"] > 0,
+                              timeout=10)
+            rec.record("slo_breach", request_id="r-1", trace_id="t-1",
+                       detail={"ttft_ms": 999})
+            bundle = rec.recent(1)[0]
+            prof = bundle["profile"]
+            assert prof["enabled"] is True
+            assert prof["ticks"] > 0
+            assert prof["role_samples"]
+            assert prof["top_frames"]
+            rec.remove_context_provider("profile", p.anomaly_context)
+        finally:
+            p.stop()
+
+
+# ------------------------------------------------------- critical-path units
+def _span(point, start, end, span_id, parent="", trace="t1", rid="r1",
+          **attrs):
+    return {"point": point, "trace_id": trace, "span_id": span_id,
+            "parent_span_id": parent, "request_id": rid,
+            "instance": "i1", "start_ms": float(start),
+            "end_ms": None if end is None else float(end),
+            "status": "OK", "attrs": attrs}
+
+
+class TestCriticalPath:
+    def test_stages_sum_exactly_to_ttft_window(self):
+        spans = [
+            _span("frontend.request", 0, 250, "root", ttft_ms=100.0),
+            _span("scheduler.schedule", 5, 20, "sched", parent="root"),
+            _span("engine.prefill", 30, 80, "pre", parent="sched"),
+        ]
+        cp = critical_path(spans)
+        assert cp is not None
+        s = cp["stages_ms"]
+        assert s["admission_wait"] == 5.0
+        assert s["schedule"] == 15.0
+        assert s["dispatch_wait"] == 10.0
+        assert s["prefill"] == 50.0
+        assert s["first_delta"] == 20.0
+        assert s["handoff"] == 0.0 and s["failover"] == 0.0
+        assert abs(sum(s.values()) - cp["ttft_ms"]) < 1e-9
+        assert cp["ttft_ms"] == 100.0
+        assert cp["relayed"] is False
+        assert abs(sum(cp["stage_share"].values()) - 1.0) < 0.01
+        assert set(s) == set(CRITICAL_STAGES)
+
+    def test_relayed_failover_decomposition(self):
+        spans = [
+            # Accepting frontend's relay root; owner hop starts at 10.
+            _span("frontend.request", 0, 260, "relay", relay=True),
+            _span("frontend.request", 10, 250, "owner", parent="relay",
+                  ttft_ms=200.0, failover_attempts=1),
+            _span("scheduler.schedule", 15, 25, "sched", parent="owner"),
+            _span("engine.prefill", 30, 60, "pre1", parent="sched"),
+            _span("scheduler.failover", 70, 80, "fo", parent="owner"),
+            _span("engine.prefill", 85, 150, "pre2", parent="fo"),
+        ]
+        cp = critical_path(spans)
+        assert cp is not None
+        assert cp["relayed"] is True
+        assert cp["failover_attempts"] == 1
+        # Window: relay accept (0) -> owner start (10) + ttft (200).
+        assert cp["ttft_ms"] == 210.0
+        s = cp["stages_ms"]
+        assert s["handoff"] == 10.0
+        assert s["admission_wait"] == 5.0   # 10 -> 15
+        assert s["schedule"] == 10.0
+        assert s["prefill"] == 30.0 + 65.0
+        assert s["failover"] == 10.0
+        assert abs(sum(s.values()) - cp["ttft_ms"]) < 1e-9
+
+    def test_open_span_and_no_ttft(self):
+        # Still-open prefill covers to the window end.
+        spans = [
+            _span("frontend.request", 0, None, "root", ttft_ms=50.0),
+            _span("engine.prefill", 10, None, "pre", parent="root"),
+        ]
+        cp = critical_path(spans)
+        assert cp["stages_ms"]["prefill"] == 40.0
+        # No TTFT observation anywhere -> no decomposition.
+        assert critical_path(
+            [_span("frontend.request", 0, 100, "root")]) is None
+        assert critical_path([]) is None
+
+    def test_aggregate(self):
+        spans = [
+            _span("frontend.request", 0, 250, "root", ttft_ms=100.0),
+            _span("scheduler.schedule", 5, 20, "sched", parent="root"),
+        ]
+        agg = aggregate_critical_paths(
+            [critical_path(spans), None, critical_path(spans)])
+        assert agg["requests"] == 2
+        assert agg["ttft_ms"]["mean"] == 100.0
+        assert agg["stages"]["schedule"]["mean_ms"] == 15.0
+        assert 0 < agg["stages"]["schedule"]["mean_share"] < 1
+
+
+# ----------------------------------------------------------- SLO exemplars
+class TestSloExemplars:
+    def test_worst_trace_per_window(self):
+        mon = SloMonitor()
+        mon.configure(ttft_ms=100, tpot_ms=50, budget=0.01,
+                      fast_s=60, slow_s=600)
+        now = time.time()
+        mon.record_ttft(80, now=now, trace_id="t-ok")
+        mon.record_ttft(500, now=now, trace_id="t-bad")
+        mon.record_ttft(300, now=now, trace_id="t-mid")
+        rep = mon.report(now=now)
+        ex = rep["objectives"]["ttft"]["fast"]["exemplar"]
+        assert ex["trace_id"] == "t-bad"
+        assert ex["value"] == 500
+        # Error-rate exemplar: only failures carry a trace.
+        mon.record_request(True, now=now, trace_id="t-fine")
+        mon.record_request(False, now=now, trace_id="t-err")
+        rep = mon.report(now=now)
+        assert rep["objectives"]["error_rate"]["fast"]["exemplar"][
+            "trace_id"] == "t-err"
+
+    def test_exemplar_ages_out_of_fast_window(self):
+        mon = SloMonitor()
+        mon.configure(ttft_ms=100, tpot_ms=50, budget=0.01,
+                      fast_s=10, slow_s=600)
+        now = time.time()
+        mon.record_ttft(900, now=now - 60, trace_id="t-old")
+        mon.record_ttft(200, now=now, trace_id="t-new")
+        rep = mon.report(now=now)
+        assert rep["objectives"]["ttft"]["fast"]["exemplar"][
+            "trace_id"] == "t-new"
+        # ...but the slow window still remembers the worst one.
+        assert rep["objectives"]["ttft"]["slow"]["exemplar"][
+            "trace_id"] == "t-old"
+        # Absent observations -> null exemplar, not an error.
+        mon2 = SloMonitor()
+        assert mon2.report()["objectives"]["ttft"]["fast"][
+            "exemplar"] is None
+
+
+# ------------------------------------------------------ CPU counter export
+class TestCpuCounterExport:
+    def test_export_counters_publishes_delta(self):
+        attr = CpuAttribution()
+        before = HOTPATH_CPU_SECONDS.labels(loop="ingest").value()
+        attr.add("ingest", 0.25)
+        attr.export_counters()
+        attr.export_counters()   # idempotent: no double-count
+        mid = HOTPATH_CPU_SECONDS.labels(loop="ingest").value()
+        assert abs(mid - before - 0.25) < 1e-9
+        attr.add("ingest", 0.5)
+        attr.export_counters()
+        after = HOTPATH_CPU_SECONDS.labels(loop="ingest").value()
+        assert abs(after - before - 0.75) < 1e-9
+
+
+# -------------------------------------------------------------- fleet drills
+class TestFleetProfile:
+    pytestmark = pytest.mark.chaos
+    def test_fleet_scope_merges_and_survives_dead_agent(self, store):
+        """`/admin/profile?scope=fleet`: per-role merged view with peer
+        markers; a killed agent degrades to a non-ok marker, never a
+        non-200."""
+        master = _master(store,
+                         heartbeat_silence_to_suspect_s=3.0,
+                         detect_disconnected_instance_interval_s=30.0,
+                         fleet_peer_timeout_s=1.0)
+        engines = [_engine(store), _engine(store)]
+        try:
+            _await_fleet([master], engines)
+            local = requests.get(_base(master) + "/admin/profile",
+                                 timeout=5).json()
+            assert local["enabled"] is True
+            assert wait_until(
+                lambda: requests.get(_base(master) + "/admin/profile",
+                                     timeout=5).json()["samples"] > 0,
+                timeout=15)
+            folded = requests.get(_base(master) + "/admin/profile",
+                                  params={"format": "folded"}, timeout=5)
+            assert folded.headers["Content-Type"].startswith("text/plain")
+            assert parse_folded(folded.text)
+
+            engines[0].kill()
+            time.sleep(0.2)
+            got = requests.get(_base(master) + "/admin/profile",
+                               params={"scope": "fleet"}, timeout=10)
+            assert got.status_code == 200, got.text
+            doc = got.json()
+            assert doc["scope"] == "fleet"
+            assert doc["samples"] > 0
+            assert "main" in doc["roles"]
+            statuses = {a: p["status"] for a, p in doc["peers"].items()}
+            assert statuses[engines[0].name] not in ("ok",), statuses
+            assert "ok" in statuses.values()   # a live peer answered
+            # Bad query param -> 400, not a crash.
+            bad = requests.get(_base(master) + "/admin/profile",
+                               params={"scope": "fleet", "top": "x"},
+                               timeout=5)
+            assert bad.status_code == 400
+        finally:
+            for e in engines:
+                e.stop()
+            master.stop()
+
+    def test_relayed_failover_critical_path_sums_to_ttft(self, store):
+        """Acceptance drill: a relayed request that fails over mid-stream
+        gets a fleet critical-path decomposition whose stage waits sum to
+        within 10% of the measured end-to-end TTFT."""
+        m1 = _master(store)
+        m2 = _master(store)
+        engines = [_engine(store), _engine(store)]
+        try:
+            _await_fleet([m1, m2], engines)
+            okey = next(
+                f"prof-affinity-{i}" for i in range(10000)
+                if m1.scheduler.ownership.owner_of(f"prof-affinity-{i}")
+                == m2.scheduler.self_addr)
+            FAULTS.configure([dict(point="engine.token", action="crash",
+                                   after=4, max_fires=1)], seed=SEED)
+            body = {"model": "fake-model", "prompt": "fleet",
+                    "stream": True, "max_tokens": 1000,
+                    "ownership_key": okey}
+            r = requests.post(_base(m1) + "/v1/completions", json=body,
+                              stream=True, timeout=90)
+            assert r.status_code == 200, r.text
+            text = ""
+            for line in r.iter_lines():
+                if not line.startswith(b"data: "):
+                    continue
+                data = line[len(b"data: "):]
+                if data == b"[DONE]":
+                    break
+                for c in json.loads(data).get("choices", ()):
+                    text += c.get("text", "")
+            assert text == REPLY
+
+            def fleet_cp():
+                rec = requests.get(_base(m1) + "/admin/trace/recent",
+                                   timeout=5).json()
+                sid = next((t["request_id"] for t in rec["traces"]
+                            if t["request_id"].startswith("completion-")),
+                           None)
+                if sid is None:
+                    return None
+                doc = requests.get(
+                    _base(m1) + "/admin/trace",
+                    params={"scope": "fleet", "request_id": sid},
+                    timeout=15).json()
+                pts = {s["point"] for s in doc.get("spans", ())}
+                if "scheduler.failover" not in pts:
+                    return None
+                return doc.get("critical_path") and doc
+
+            assert wait_until(lambda: fleet_cp() is not None, timeout=15), \
+                "no fleet critical path for the drill request"
+            doc = fleet_cp()
+            cp = doc["critical_path"]
+            assert cp["relayed"] is True
+            assert cp["failover_attempts"] >= 1
+            assert cp["stages_ms"]["handoff"] > 0
+            assert cp["stages_ms"]["prefill"] > 0
+            # Measured end-to-end TTFT, recomputed from the raw merged
+            # spans: accepting-frontend start -> owner's first token.
+            spans = doc["spans"]
+            ids = {s["span_id"] for s in spans}
+            fronts = [s for s in spans
+                      if s["point"] == "frontend.request"]
+            root = min((s for s in fronts
+                        if s.get("parent_span_id") not in ids),
+                       key=lambda s: s["start_ms"])
+            owner = next(s for s in fronts
+                         if (s.get("attrs") or {}).get("ttft_ms")
+                         is not None)
+            measured = (owner["start_ms"] + owner["attrs"]["ttft_ms"]
+                        - root["start_ms"])
+            total = sum(cp["stages_ms"].values())
+            assert abs(total - measured) <= 0.1 * measured, \
+                (total, measured, cp["stages_ms"])
+            # The per-trace view carries the same decomposition, and the
+            # hotpath aggregate has absorbed it.
+            hot = requests.get(_base(m2) + "/admin/hotpath",
+                               timeout=5).json()
+            assert hot["critical_path"]["requests"] >= 1
+            assert set(hot["critical_path"]["stages"]) == \
+                set(CRITICAL_STAGES)
+        finally:
+            for e in engines:
+                e.stop()
+            m1.stop()
+            m2.stop()
+
+    def test_breach_bundle_includes_profile_window(self, store):
+        """SLO-breach flight-recorder bundles captured on a live master
+        carry a non-empty profile window (the anomaly-path acceptance
+        criterion)."""
+        master = _master(store, slo_ttft_ms=0.001)
+        engine = _engine(store)
+        try:
+            _await_fleet([master], [engine])
+            assert wait_until(
+                lambda: PROFILER.snapshot()["samples"] > 0, timeout=15)
+            r = requests.post(_base(master) + "/v1/completions", json={
+                "model": "fake-model", "prompt": "fleet",
+                "max_tokens": 8}, timeout=30)
+            assert r.status_code == 200, r.text
+
+            def breach_bundle():
+                got = requests.get(
+                    _base(master) + "/admin/flightrecorder/recent",
+                    params={"kind": "slo_breach"}, timeout=5).json()
+                return next(iter(got.get("records", ())), None)
+
+            assert wait_until(lambda: breach_bundle() is not None,
+                              timeout=15), "no slo_breach bundle captured"
+            bundle = breach_bundle()
+            prof = bundle["profile"]
+            assert prof["enabled"] is True
+            assert prof["ticks"] > 0
+            assert prof["role_samples"]
+        finally:
+            engine.stop()
+            master.stop()

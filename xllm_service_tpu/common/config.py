@@ -128,6 +128,17 @@ class ServiceOptions:
     # post-mortem bundles (trace tree + hotpath stages + load snapshot)
     # captured on SLO breach / failover / error / KV-stream fallback.
     flightrecorder_capacity: int = 64
+    # Continuous-profiling plane (profiling/sampler.py): always-on
+    # wall-clock sampling at profile_hz (0 disables; ~19 Hz default — a
+    # non-round rate so the sampler never phase-locks with periodic
+    # loops; overhead gate <=1% via benchmarks/bench_profile_overhead).
+    # Folded stacks rotate on profile_window_s; per-role distinct-stack
+    # tables and stack depth are bounded (overflow is charged to a
+    # visible "(overflow)" bucket, never unbounded memory).
+    profile_hz: float = 19.0
+    profile_window_s: float = 30.0
+    profile_max_stacks: int = 256
+    profile_max_depth: int = 24
     # --- closed-loop fleet autoscaler (autoscaler/, docs/autoscaling.md) ---
     # Master-gated control loop turning SLO burn rates + planner pressure
     # into SCALE_OUT / SCALE_IN(drain) / FLIP actions through a pluggable

@@ -93,6 +93,7 @@ class CpuAttribution:
     def __init__(self):
         self._totals = {c: 0.0 for c in self.CATEGORIES}
         self._counts = {c: 0 for c in self.CATEGORIES}
+        self._exported = {c: 0.0 for c in self.CATEGORIES}
 
     def measure(self, category: str):
         return _CpuSpan(self, category)
@@ -107,10 +108,25 @@ class CpuAttribution:
                     "n": self._counts[c]}
                 for c in self.CATEGORIES}
 
+    def export_counters(self) -> None:
+        """Publish the delta since the last export to the Prometheus
+        ``hotpath_cpu_seconds_total{loop}`` counter — called from the
+        master's scrape-time gauge refresh, so /metrics and
+        /metrics/fleet carry the loop-level CPU series without a
+        background thread."""
+        from .metrics import HOTPATH_CPU_SECONDS
+
+        for c in self.CATEGORIES:
+            delta = self._totals[c] - self._exported[c]
+            if delta > 0:
+                HOTPATH_CPU_SECONDS.labels(loop=c).inc(delta)
+                self._exported[c] += delta
+
     def clear(self) -> None:
         for c in self.CATEGORIES:
             self._totals[c] = 0.0
             self._counts[c] = 0
+            self._exported[c] = 0.0
 
 
 class _CpuSpan:

@@ -452,6 +452,13 @@ PLANNER_SCALE_HINT = REGISTRY.gauge(
 # the age of the newest decision (a stuck control loop shows up here
 # before it shows up as an unserved burst). fleet_size and the decision
 # age are refreshed at tick time and at scrape time.
+HOTPATH_CPU_SECONDS = REGISTRY.counter(
+    "hotpath_cpu_seconds_total",
+    "Master hot-loop CPU seconds by coarse loop (ingest = heartbeat/"
+    "telemetry-frame ingest, route = schedule, stream = generation-delta "
+    "ingest) — the per-master scaling-evidence series; frame-level "
+    "breakdown lives at /admin/profile",
+    labelnames=("loop",))
 AUTOSCALER_ACTIONS_TOTAL = REGISTRY.counter(
     "autoscaler_actions_total",
     "Actions enacted by the autoscaler controller, by kind "

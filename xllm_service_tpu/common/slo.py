@@ -90,10 +90,41 @@ class _Objective:
         self.budget = max(1e-6, float(budget))
         self.fast = WindowCounts(fast_s)
         self.slow = WindowCounts(slow_s)
+        # Trace exemplars: the worst observation per second bucket
+        # ([sec, value, trace_id], pruned to the slow horizon) — a burn
+        # spike in /admin/slo links straight to a federated trace and
+        # its critical-path breakdown instead of a bare number.
+        self.exemplars: deque[list] = deque()
 
-    def record(self, bad: bool, now: Optional[float] = None) -> None:
+    def record(self, bad: bool, now: Optional[float] = None,
+               value: Optional[float] = None, trace_id: str = "") -> None:
         self.fast.record(bad, now)
         self.slow.record(bad, now)
+        if not trace_id or value is None:
+            return
+        sec = int(now if now is not None else time.time())
+        if self.exemplars and self.exemplars[-1][0] == sec:
+            b = self.exemplars[-1]
+            if value > b[1]:
+                b[1], b[2] = value, trace_id
+        else:
+            self.exemplars.append([sec, value, trace_id])
+            horizon = sec - self.slow.window_s
+            while self.exemplars and self.exemplars[0][0] < horizon:
+                self.exemplars.popleft()
+
+    def worst_exemplar(self, w: WindowCounts,
+                       now: Optional[float] = None) -> Optional[dict]:
+        now = now if now is not None else time.time()
+        horizon = now - w.window_s
+        best = None
+        for sec, value, tid in self.exemplars:
+            if sec >= horizon and (best is None or value > best[1]):
+                best = (sec, value, tid)
+        if best is None:
+            return None
+        return {"trace_id": best[2], "value": round(best[1], 3),
+                "age_s": round(now - best[0], 1)}
 
     def window_report(self, w: WindowCounts,
                       now: Optional[float] = None) -> dict[str, Any]:
@@ -102,7 +133,8 @@ class _Objective:
         frac = (bad / n) if n else 0.0
         return {"window_s": w.window_s, "n": n, "bad": bad,
                 "bad_fraction": round(frac, 6),
-                "burn_rate": round(frac / self.budget, 3)}
+                "burn_rate": round(frac / self.budget, 3),
+                "exemplar": self.worst_exemplar(w, now)}
 
     def report(self, alert: float,
                now: Optional[float] = None) -> dict[str, Any]:
@@ -154,17 +186,24 @@ class SloMonitor:
             self._configure_locked(ttft_ms, tpot_ms, budget, fast_s, slow_s)
 
     # ----------------------------------------------------------- recording
-    def record_ttft(self, ms: float, now: Optional[float] = None) -> None:
+    def record_ttft(self, ms: float, now: Optional[float] = None,
+                    trace_id: str = "") -> None:
         with self._lock:
-            self._objectives["ttft"].record(ms > self.ttft_target_ms, now)
+            self._objectives["ttft"].record(
+                ms > self.ttft_target_ms, now, value=ms, trace_id=trace_id)
 
-    def record_tpot(self, ms: float, now: Optional[float] = None) -> None:
+    def record_tpot(self, ms: float, now: Optional[float] = None,
+                    trace_id: str = "") -> None:
         with self._lock:
-            self._objectives["tpot"].record(ms > self.tpot_target_ms, now)
+            self._objectives["tpot"].record(
+                ms > self.tpot_target_ms, now, value=ms, trace_id=trace_id)
 
-    def record_request(self, ok: bool, now: Optional[float] = None) -> None:
+    def record_request(self, ok: bool, now: Optional[float] = None,
+                       trace_id: str = "") -> None:
         with self._lock:
-            self._objectives["error_rate"].record(not ok, now)
+            self._objectives["error_rate"].record(
+                not ok, now, value=None if ok else 1.0,
+                trace_id="" if ok else trace_id)
 
     def ttft_breached(self, ms: float) -> bool:
         """Per-request breach check (flight recorder / tail-sampling keep

@@ -393,6 +393,16 @@ class SpanStore:
         rows.sort(key=lambda r: r[key], reverse=True)
         return rows[:max(0, int(limit))]
 
+    def recent_trace_spans(self, limit: int = 50) -> list[list[dict]]:
+        """Span dicts of the most recently started traces (newest last) —
+        the /admin/hotpath critical-path aggregation input."""
+        with self._lock:
+            traces = [(min(s.start_ms for s in spans), list(spans))
+                      for spans in self._by_trace.values() if spans]
+        traces.sort(key=lambda t: t[0])
+        return [[s.to_dict() for s in spans]
+                for _, spans in traces[-max(1, int(limit)):]]
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
@@ -540,13 +550,19 @@ class Tracer:
         spans = self.store.trace(tid)
         if not spans:
             return 404, {"error": f"no spans recorded for trace {tid}"}
-        return 200, {"trace_id": tid,
-                     "request_id": request_id
-                     or next((s["request_id"] for s in spans
-                              if s["request_id"]), ""),
-                     "num_spans": len(spans),
-                     "spans": spans,
-                     "tree": span_tree(spans)}
+        from ..profiling import critical_path
+
+        payload = {"trace_id": tid,
+                   "request_id": request_id
+                   or next((s["request_id"] for s in spans
+                            if s["request_id"]), ""),
+                   "num_spans": len(spans),
+                   "spans": spans,
+                   "tree": span_tree(spans)}
+        cp = critical_path(spans)
+        if cp is not None:
+            payload["critical_path"] = cp
+        return 200, payload
 
     def query_recent(self, limit: int = 20,
                      sort: str = "recent") -> dict[str, Any]:

@@ -113,6 +113,13 @@ EFFECT_PAIRS: dict[str, str] = {
     # Offload-executor inflight slots (bounded transfer pump).
     "tier-inflight":
         "TieredKVStore.offload -> TieredKVStore._offload_worker @ owner",
+    # Continuous-profiling sampler thread: refcounted start/stop; the
+    # last stop must join the thread and drop the flight-recorder
+    # context provider (idempotent: start with profile_hz=0 spawns
+    # nothing, so its stop releases nothing).
+    "profiler-thread":
+        "SamplingProfiler.start -> SamplingProfiler.stop @ owner;"
+        " strict; idempotent",
 }
 
 _SCOPES = ("finally", "owner", "gc", "budget", "evict")

@@ -332,6 +332,29 @@ STATE_DISCIPLINES: dict[str, str] = {
     "InferenceEngine.recent_max_tbt_ms": "lock:_telemetry_lock",
     "InferenceEngine.preemption_count": "confined:engine-pump",
     "InferenceEngine.sarathi_rides": "confined:engine-pump",
+    # ---------------------------------------------------- SamplingProfiler
+    # Continuous profiler (profiling/sampler.py): refcounted lifecycle +
+    # window aggregates behind one leaf lock (order 824); the sampler
+    # thread merges each tick under it, /admin/profile reads under it.
+    "SamplingProfiler._refs": "lock:_lock",
+    "SamplingProfiler._thread": "lock:_lock",
+    "SamplingProfiler._stop_evt": "lock:_lock",
+    "SamplingProfiler._hz": "lock:_lock",
+    "SamplingProfiler._window_s": "lock:_lock",
+    "SamplingProfiler._max_stacks": "lock:_lock",
+    "SamplingProfiler._max_depth": "lock:_lock",
+    "SamplingProfiler._agg": "lock:_lock",
+    "SamplingProfiler._ticks": "lock:_lock",
+    "SamplingProfiler._window_started": "lock:_lock",
+    "SamplingProfiler._prev": "lock:_lock",
+    "SamplingProfiler._prev_ticks": "lock:_lock",
+    "SamplingProfiler._prev_window_s": "lock:_lock",
+    # Sampler-thread heartbeat: rebound only by the sampler loop itself.
+    "SamplingProfiler._last_tick_mono": "confined:profiler",
+    # Per-code-object label memo: only the sampler thread mutates it, and
+    # GIL-atomic dict get/set makes concurrent snapshot reads benign.
+    "SamplingProfiler._label_cache": "init-only",
+    "SamplingProfiler._roles": "init-only",
 }
 
 #: Fully-audited classes: xlint's ``state-decl`` rule requires EVERY
@@ -358,6 +381,7 @@ STATE_CLASSES: tuple = (
     "HeldActionLog",
     "RetryBudget",
     "CircuitBreaker",
+    "SamplingProfiler",
 )
 
 #: Thread roles for ``confined:<role>`` disciplines. ``threads`` are
@@ -402,6 +426,14 @@ THREAD_ROLES: dict[str, dict] = {
         "entries": (
             "InferenceEngine._loop",
             "InferenceEngine.step",
+        ),
+    },
+    "profiler": {
+        # Continuous-profiling sampler (profiling/sampler.py): one
+        # daemon thread per process, walking sys._current_frames().
+        "threads": ("profiler-sampler",),
+        "entries": (
+            "SamplingProfiler._loop",
         ),
     },
 }

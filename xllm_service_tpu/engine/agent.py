@@ -46,6 +46,8 @@ from ..common.types import (InstanceMetaInfo, InstanceType, TpuTopology,
                             now_ms)
 from ..devtools.locks import make_lock
 from ..coordination import CoordinationClient, connect
+from ..profiling import PROFILER
+from ..profiling import handle_admin_profile as _handle_admin_profile
 from ..rpc import MASTER_KEY, instance_key
 from ..rpc import wire as dispatch_wire
 from ..chat_template import MM_PLACEHOLDER, JinjaChatTemplate
@@ -550,6 +552,7 @@ class EngineAgent:
         self.kv_device_received = 0
         self.kv_host_received = 0
         self._alive = True
+        self._profiler_started = False
         self._started = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner: Optional[web.AppRunner] = None
@@ -679,6 +682,11 @@ class EngineAgent:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "EngineAgent":
+        # Continuous profiler (profiling/sampler.py): refcounted — an
+        # in-process agent sharing a master's process shares its sampler
+        # (and its configure()d rate) instead of spawning a second one.
+        PROFILER.start()
+        self._profiler_started = True
         for eng in self.engines:
             eng.start()
         t = threading.Thread(target=self._run_server, daemon=True,
@@ -727,6 +735,9 @@ class EngineAgent:
 
     def stop(self) -> None:
         self._alive = False
+        if self._profiler_started:
+            self._profiler_started = False
+            PROFILER.stop()
         RECORDER.remove_context_provider("engine", self._anomaly_context)
         self.coord.rm(instance_key(self.instance_type.value, self.name))
         self.streamer.stop()
@@ -757,6 +768,7 @@ class EngineAgent:
                            tracing.handle_admin_trace_recent)
         app.router.add_get("/admin/flightrecorder/recent",
                            flightrecorder.handle_flightrecorder_recent)
+        app.router.add_get("/admin/profile", _handle_admin_profile)
         app.router.add_post("/rpc/link", self._h_link)
         app.router.add_post("/rpc/unlink", self._h_unlink)
         app.router.add_post("/rpc/cancel", self._h_cancel)

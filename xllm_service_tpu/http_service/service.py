@@ -74,6 +74,8 @@ from ..overload import (
     parse_priority,
 )
 from ..overload.deadline import remaining_ms
+from ..profiling import (PROFILER, aggregate_critical_paths, critical_path,
+                         handle_admin_profile, parse_folded, summarize_stacks)
 from ..rpc import wire
 from ..scheduler.scheduler import Scheduler
 from ..utils import generate_service_request_id, get_logger, short_uuid
@@ -176,6 +178,16 @@ class XllmHttpService:
         RECORDER.configure(capacity=self.opts.flightrecorder_capacity,
                            directory=self.opts.flightrecorder_dir)
         RECORDER.add_context_provider("service", self._anomaly_context)
+        # Continuous profiler (profiling/sampler.py): always-on sampling
+        # at profile_hz (0 disables), refcounted — an in-process engine
+        # agent shares the same process-global sampler. The profiler
+        # registers its own flight-recorder context provider, so every
+        # anomaly bundle carries the last-window profile.
+        PROFILER.configure(hz=self.opts.profile_hz,
+                           window_s=self.opts.profile_window_s,
+                           max_stacks=self.opts.profile_max_stacks,
+                           max_depth=self.opts.profile_max_depth)
+        PROFILER.start()
         # Overload-hardening plane (overload/, docs/robustness.md):
         # admission gate, brownout state, global retry budget. Ticked by
         # the scheduler's sync loop; enforced on the request paths here.
@@ -248,6 +260,10 @@ class XllmHttpService:
         app.router.add_get("/admin/slo", self.handle_slo)
         app.router.add_get("/admin/flightrecorder/recent",
                            flightrecorder.handle_flightrecorder_recent)
+        # Continuous-profiling plane: this process's folded stacks, or
+        # `?scope=fleet` for the merged per-role view across every live
+        # engine agent and peer frontend.
+        app.router.add_get("/admin/profile", self.handle_admin_profile)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -279,6 +295,7 @@ class XllmHttpService:
         app.router.add_get("/admin/trace", tracing.handle_admin_trace)
         app.router.add_get("/admin/trace/recent",
                            tracing.handle_admin_trace_recent)
+        app.router.add_get("/admin/profile", handle_admin_profile)
         return app
 
     async def _on_startup(self, app: web.Application) -> None:
@@ -290,6 +307,7 @@ class XllmHttpService:
         if self._client is not None:
             await self._client.close()
         self.tracer.close()
+        PROFILER.stop()
         RECORDER.remove_context_provider("service", self._anomaly_context)
         RECORDER.close()
 
@@ -1049,6 +1067,9 @@ class XllmHttpService:
         tokens = RETRY_BUDGET.tokens()
         RETRY_BUDGET_TOKENS.set(tokens if tokens != float("inf") else -1.0)
         SLO_MONITOR.export_gauges()
+        # Hot-loop CPU attribution as counters: the per-master scaling
+        # series /metrics/fleet captures (ISSUE 18 satellite).
+        CPU_ATTR.export_counters()
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         self._refresh_local_gauges()
@@ -1131,7 +1152,7 @@ class XllmHttpService:
             return web.json_response(
                 {"error": "no spans recorded anywhere in the fleet",
                  "scope": "fleet", "peers": peers}, status=404)
-        return web.json_response({
+        payload = {
             "scope": "fleet",
             "trace_id": trace_id,
             "request_id": request_id or next(
@@ -1140,7 +1161,14 @@ class XllmHttpService:
             "peers": peers,
             "spans": spans,
             "tree": span_tree(spans),
-        })
+        }
+        # TTFT critical path over the MERGED tree: on a relayed request
+        # the root span lives on the accepting frontend and the prefill
+        # span on an engine — only the fleet view can decompose it.
+        cp = critical_path(spans)
+        if cp is not None:
+            payload["critical_path"] = cp
+        return web.json_response(payload)
 
     async def handle_admin_trace_recent(self,
                                         request: web.Request) -> web.Response:
@@ -1172,6 +1200,38 @@ class XllmHttpService:
                         reverse=True)[:max(0, limit)]
         return web.json_response({"scope": "fleet", "sort": sort,
                                   "peers": peers, "traces": merged})
+
+    async def handle_admin_profile(self,
+                                   request: web.Request) -> web.Response:
+        """Continuous-profiling view (profiling/sampler.py). Default
+        scope serves this process's folded stacks / top-N summary;
+        ``?scope=fleet`` fans out to every live engine agent and peer
+        frontend, merges the folded counts exactly (role prefixes keep
+        per-role attribution across processes) and marks each peer's
+        contribution — a dead peer degrades the view, never the
+        endpoint."""
+        if request.query.get("scope") != "fleet":
+            return await handle_admin_profile(request)
+        try:
+            top = int(request.query.get("top", 30))
+        except ValueError:
+            return _error_response(400, "top must be an integer")
+        counts = parse_folded(PROFILER.folded())
+        peers: dict[str, dict[str, str]] = {}
+        for addr, role, pstatus, payload in await self._fanout_get(
+                "/admin/profile", {"format": "folded"}, as_json=False):
+            if pstatus == "ok" and isinstance(payload, str):
+                for stack, n in parse_folded(payload).items():
+                    counts[stack] = counts.get(stack, 0) + n
+            peers[addr] = {"role": role, "status": pstatus}
+        if request.query.get("format") == "folded":
+            lines = [f"{';'.join(stack)} {n}"
+                     for stack, n in sorted(counts.items())]
+            return web.Response(text="\n".join(lines) + "\n",
+                                content_type="text/plain")
+        merged = summarize_stacks(counts, top_n=top)
+        merged.update({"scope": "fleet", "peers": peers})
+        return web.json_response(merged)
 
     async def handle_metrics_fleet(self,
                                    request: web.Request) -> web.Response:
@@ -1303,6 +1363,12 @@ class XllmHttpService:
             # route = schedule, stream = delta ingest): the bench's
             # ingest-share evidence for the sharded telemetry plane.
             "cpu": CPU_ATTR.summary(),
+            # Where recent requests' TTFT went, stage by stage: the
+            # critical-path aggregate over this process's span ring
+            # (per-request decomposition: /admin/trace?request_id=...).
+            "critical_path": aggregate_critical_paths(
+                critical_path(spans)
+                for spans in TRACER.store.recent_trace_spans(50)),
             "ownership": self.scheduler.ownership.stats(),
             # Telemetry-ingest shard map + frame-log progress + the
             # per-instance load-info snapshot ages (ISSUE 15 satellite:

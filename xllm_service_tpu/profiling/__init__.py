@@ -1,0 +1,23 @@
+"""Fleet continuous-profiling plane (docs/observability.md).
+
+Always-on wall-clock sampling profiler (``sampler.py``) with per-thread-
+role folded stacks served at ``GET /admin/profile`` on every process,
+plus the span-tree critical-path decomposition (``critical_path.py``)
+behind ``/admin/trace`` and ``/admin/hotpath``.
+"""
+
+from .critical_path import (CRITICAL_STAGES, aggregate_critical_paths,
+                            critical_path)
+from .sampler import (PROFILER, SamplingProfiler, handle_admin_profile,
+                      parse_folded, summarize_stacks)
+
+__all__ = [
+    "CRITICAL_STAGES",
+    "PROFILER",
+    "SamplingProfiler",
+    "aggregate_critical_paths",
+    "critical_path",
+    "handle_admin_profile",
+    "parse_folded",
+    "summarize_stacks",
+]

@@ -95,6 +95,9 @@ HOT_PATH_FUNCTIONS: dict[str, str] = {
         "chunked KV pull loop (decode-side executor thread, paced)",
     "EngineAgent._h_kv_stream_pull":
         "streaming-transfer pull endpoint (msgpack frames)",
+    "SamplingProfiler._sample_once":
+        "always-on ~19 Hz wall-clock stack sampler tick (overhead gate "
+        "<=1%: benchmarks/bench_profile_overhead.py)",
     # RCU snapshot readers (rcu-read single-load discipline applies: one
     # load of the publication attribute per call, or two loads may
     # observe different snapshots — the PR-6 COW-apply torn-read smell).

@@ -835,7 +835,9 @@ class Scheduler:
                 TTFT_MS.labels(instance=req.routing.prefill_name or "none",
                                policy=policy).observe(
                     now - req.created_time_ms)
-                SLO_MONITOR.record_ttft(now - req.created_time_ms)
+                SLO_MONITOR.record_ttft(
+                    now - req.created_time_ms,
+                    trace_id=req.span.trace_id if req.span else "")
             req.prefill_stage_finished = True
             req.metrics.prefill_finish_time_ms = now
             self.instance_mgr.update_request_metrics(
@@ -846,7 +848,9 @@ class Scheduler:
                     instance=(req.routing.decode_name
                               or req.routing.prefill_name or "none"),
                     policy=policy).observe(now - st.last_token_ms)
-                SLO_MONITOR.record_tpot(now - st.last_token_ms)
+                SLO_MONITOR.record_tpot(
+                    now - st.last_token_ms,
+                    trace_id=req.span.trace_id if req.span else "")
             self.instance_mgr.update_request_metrics(
                 req, RequestAction.DECODE_STEP, n_new=n_new)
         if n_new:
@@ -1008,13 +1012,13 @@ class Scheduler:
         """
         r = st.request
         m = r.metrics
-        SLO_MONITOR.record_request(ok=error is None)
+        trace_id = r.span.trace_id if r.span else \
+            (r.trace.trace_id if r.trace else "")
+        SLO_MONITOR.record_request(ok=error is None, trace_id=trace_id)
         ttft_ms = (m.prefill_finish_time_ms - r.created_time_ms) \
             if m.prefill_finish_time_ms else None
         slo_breach = ttft_ms is not None and SLO_MONITOR.ttft_breached(
             ttft_ms)
-        trace_id = r.span.trace_id if r.span else \
-            (r.trace.trace_id if r.trace else "")
         if error is None and st.failover_attempts == 0 and not slo_breach:
             TRACER.drop_trace(trace_id)
             return

@@ -46,6 +46,7 @@ import asyncio
 from ..common.faults import FAULTS
 from ..common.hashing import prefix_block_hash_hexes
 from ..common import tracing as _tracing
+from .. import profiling as _profiling
 from ..common.tracing import TRACER, TraceContext
 from ..common.types import (InstanceMetaInfo, InstanceType, KvCacheEvent,
                             TpuTopology, now_ms)
@@ -258,6 +259,7 @@ class FakeEngine:
         app.router.add_get("/admin/trace", _tracing.handle_admin_trace)
         app.router.add_get("/admin/trace/recent",
                            _tracing.handle_admin_trace_recent)
+        app.router.add_get("/admin/profile", _profiling.handle_admin_profile)
 
         async def _start():
             self._runner = web.AppRunner(app)
