@@ -66,6 +66,12 @@ TRACKED: dict[str, list[tuple[str, bool]]] = {
     "profile": [
         ("headline.profile_overhead_pct", False),
     ],
+    "fleet": [
+        ("headline.native_route_stream_speedup", True),
+        ("headline.route_stream_cpu_us_per_req", False),
+        ("headline.agg_rps_masters_4", True),
+        ("headline.masters_4_over_1_scaling", True),
+    ],
 }
 
 _NAME_RE = re.compile(r"^BENCH_(?:([a-z0-9]+)_)?r(\d+)\.json$")

@@ -41,6 +41,31 @@ print("xlint: %d violation(s)" % d["count"])' 2>/dev/null \
     return "$rc"
 }
 
+echo "== native hot-path core (csrc/ build + loader verdict) =="
+# Build is best-effort: the Makefile skips with a message when Python.h
+# is absent. The loader verdict is asserted either way — a box WITH the
+# toolchain must end up native-active (a silent fallback would make the
+# fleet-bench A/B meaningless), while a box without it must report a
+# clean pure-python fallback, never an import error.
+make -C csrc libhotcore.so
+python - <<'PYEOF'
+import json, sysconfig, pathlib
+from xllm_service_tpu.common import native
+st = native.status()
+print("native loader:", json.dumps(st))
+so = pathlib.Path("csrc/libhotcore.so")
+have_hdr = pathlib.Path(sysconfig.get_paths()["include"], "Python.h").exists()
+if so.exists():
+    assert st["loaded"], f"libhotcore.so built but loader inactive: {st}"
+    assert all(st["components"].values()), f"partial native: {st}"
+elif have_hdr:
+    raise SystemExit("check.sh: Python.h present but csrc build left no "
+                     ".so — build is broken, not merely unavailable")
+else:
+    assert not st["loaded"], f"no .so yet loader active? {st}"
+    print("native loader: pure-python fallback (no toolchain) — OK")
+PYEOF
+
 echo "== xlint (concurrency + RCU + state-ownership invariants) =="
 run_xlint strict xllm_service_tpu
 

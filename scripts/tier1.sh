@@ -92,16 +92,40 @@ run_chunk() {
     return 0
 }
 
+# Pure-python fallback drill: the wire/ownership/native differential
+# suites run a second time with XLLM_NATIVE=0 forced, proving the
+# mandatory fallbacks carry the same behavior a no-toolchain box gets.
+# Rides after chunk 3; its dots are not added to TOTAL_DOTS (they would
+# double-count tests the normal chunks already ran).
+PURE_FILES=(tests/test_native_hotcore.py tests/test_dispatch_wire.py
+            tests/test_multimaster.py)
+run_pure_drill() {
+    local log="/tmp/_t1_pure.log"
+    rm -f "$log"
+    echo "=== tier-1 pure-fallback drill (XLLM_NATIVE=0," \
+         "${#PURE_FILES[@]} files) ==="
+    set -o pipefail
+    timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu XLLM_NATIVE=0 \
+        python -m pytest "${PURE_FILES[@]}" -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly 2>&1 | tee "$log"
+    local rc=${PIPESTATUS[0]}
+    echo "pure drill: rc=$rc"
+    [ "$rc" -ne 0 ] && FAILED_CHUNKS+=("pure(rc=$rc)")
+    return 0
+}
+
 TOTAL_DOTS=0
 FAILED_CHUNKS=()
 case "$WHICH" in
     1) run_chunk 1 "${chunk1[@]}" ;;
     2) run_chunk 2 "${chunk2[@]}" ;;
-    3) run_chunk 3 "${chunk3[@]}" ;;
+    3) run_chunk 3 "${chunk3[@]}"; run_pure_drill ;;
     all)
         run_chunk 1 "${chunk1[@]}"
         run_chunk 2 "${chunk2[@]}"
         run_chunk 3 "${chunk3[@]}"
+        run_pure_drill
         ;;
     *) echo "usage: scripts/tier1.sh [1|2|3|all]" >&2; exit 2 ;;
 esac

@@ -1,0 +1,521 @@
+"""Differential property tests for the native hot-path core (ISSUE 19).
+
+libhotcore.so reimplements four frame families the profiler blamed for
+most of the master's route/stream CPU: msgpack LOADFRAME/telemetry
+encode+decode (rpc/wire.py), SSE delta-frame assembly
+(http_service/service.py), the blake2b-8 rendezvous walk
+(multimaster/ownership.py), and the byte tokenizer. The contract is
+byte-for-byte parity: native output must be indistinguishable from the
+pure-Python libraries it shadows, and anything it cannot serve
+bit-exactly must MISS so the call site's pure path runs.
+
+Two layers of drills:
+
+- RAW core parity (``CORE = native.load_core(force=True)``): randomized
+  inputs, native vs msgpack/json/hashlib reference, byte equality.
+  Skipped when the .so is absent (no C toolchain in the container).
+- CALL-SITE equivalence via the ``XLLM_NATIVE`` kill switch +
+  ``native.reload()``: the public wire/ownership/tokenizer functions
+  produce identical outputs with the switch on and off. These run
+  everywhere — with no .so both legs are the pure path and the drill
+  degrades to a determinism check, which is exactly the no-toolchain
+  acceptance mode.
+
+Randomness is seeded per test: a failure reproduces.
+"""
+
+import base64
+import json
+import math
+import os
+import random
+import string
+
+import msgpack
+import pytest
+
+from xllm_service_tpu.common import native
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.multimaster import ownership as own
+from xllm_service_tpu.multimaster.ownership import OwnershipRouter
+from xllm_service_tpu.rpc import wire
+from xllm_service_tpu.tokenizer.simple import SimpleTokenizer
+
+CORE = native.load_core(force=True)
+
+needs_so = pytest.mark.skipif(
+    CORE is None, reason="libhotcore.so not built (no C toolchain)")
+
+_COMPACT = (",", ":")
+
+
+# ------------------------------------------------------------- generators
+#
+# Weighted toward the wire's real shapes (str-keyed maps, int/str/float
+# leaves) but salted with every edge the C code special-cases: int64/u64
+# bounds, subnormal/huge floats, NaN/±Inf, control chars, non-ASCII,
+# surrogate-ADJACENT code points (U+D7FF / U+E000), astral planes,
+# empty containers, bytes (wire only — JSON rejects them either way).
+
+_EDGE_INTS = (0, -1, 1, 127, 128, -32, -33, 255, 256, 65535, 65536,
+              2**31 - 1, 2**31, 2**32 - 1, 2**32, 2**63 - 1, -2**63,
+              2**64 - 1)
+_EDGE_FLOATS = (0.0, -0.0, 1.5, -1.5, 1e308, 1e-310, 5e-324,
+                math.pi, 1 / 3, 123456789.123456789)
+_EDGE_STRS = ("", "é", "héllo wörld", "日本語テキスト", "🦖🚀",
+              "é́", "퟿",   # surrogate-adjacent
+              "line\nbreak\ttab\rret", "\x00\x01\x1f\x7f",
+              'quote" back\\slash', "a" * 300)
+
+
+def rand_str(rng: random.Random) -> str:
+    if rng.random() < 0.5:
+        return rng.choice(_EDGE_STRS)
+    n = rng.randrange(0, 40)
+    pool = (string.ascii_letters + string.digits + "éüß日本🎉\n\t\"\\"
+            + "퟿\x1f")
+    return "".join(rng.choice(pool) for _ in range(n))
+
+
+def rand_scalar(rng: random.Random, for_json: bool):
+    r = rng.random()
+    if r < 0.25:
+        return rng.choice(_EDGE_INTS) if rng.random() < 0.5 else \
+            rng.randrange(-10**12, 10**12)
+    if r < 0.45:
+        return rng.choice(_EDGE_FLOATS) if rng.random() < 0.5 else \
+            rng.random() * rng.choice((1.0, 1e6, 1e-6, 1e300))
+    if r < 0.75:
+        return rand_str(rng)
+    if r < 0.85:
+        return rng.choice((True, False, None))
+    if not for_json and rng.random() < 0.5:
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(20)))
+    return rng.randrange(100)
+
+
+def rand_obj(rng: random.Random, depth: int = 0, for_json: bool = False):
+    if depth >= 4 or rng.random() < 0.4 + depth * 0.2:
+        return rand_scalar(rng, for_json)
+    if rng.random() < 0.5:
+        return [rand_obj(rng, depth + 1, for_json)
+                for _ in range(rng.randrange(6))]
+    return {rand_str(rng): rand_obj(rng, depth + 1, for_json)
+            for _ in range(rng.randrange(6))}
+
+
+def rand_load_frame(rng: random.Random) -> dict:
+    """Realistic LOADFRAME body (encode_load_frame's shape)."""
+    instances = {}
+    for _ in range(rng.randrange(8)):
+        name = f"eng-{rng.randrange(1000)}:{rng.randrange(65536)}"
+        instances[name] = {
+            "l": {"waiting": rng.randrange(64), "running": rng.randrange(8),
+                  "kv_usage": rng.random()},
+            "y": {"ttft_ms": rng.random() * 500, "tpot_ms": rng.random() * 40},
+            "hb": rng.randrange(2**41), "up": rng.randrange(2**41),
+            "st": rng.choice(("READY", "DRAINING", "DEAD")),
+        }
+    gone = {f"eng-{rng.randrange(1000)}": rng.choice(("lease", "drain"))
+            for _ in range(rng.randrange(3))}
+    return {"i": instances, "g": gone, "s": rng.randrange(2**31),
+            "ms": rng.randrange(2**41)}
+
+
+def rand_telemetry_batch(rng: random.Random) -> list:
+    frames = []
+    for _ in range(1 + rng.randrange(5)):
+        if rng.random() < 0.5:
+            frames.append({"t": "hb", "d": rand_load_frame(rng)})
+        else:
+            frames.append({"t": "gens",
+                           "dest": f"10.0.0.{rng.randrange(256)}:9000",
+                           "d": {"gens": [rand_obj(rng, 2)
+                                          for _ in range(rng.randrange(4))]}})
+    return frames
+
+
+def rand_sse_delta(rng: random.Random) -> dict:
+    """OpenAI-style streaming delta, non-ASCII-heavy text."""
+    return {
+        "id": f"completion-{rng.randrange(10**9)}",
+        "object": "text_completion",
+        "created": rng.randrange(2**31),
+        "model": "fake-model",
+        "choices": [{"index": 0, "text": rand_str(rng),
+                     "finish_reason": rng.choice((None, "stop", "length"))}],
+        "usage": None if rng.random() < 0.5 else
+        {"prompt_tokens": rng.randrange(4096),
+         "completion_tokens": rng.randrange(4096)},
+    }
+
+
+def pure_sse_data(obj) -> bytes:
+    return (b"data: " + json.dumps(obj, ensure_ascii=False,
+                                   separators=_COMPACT).encode() + b"\n\n")
+
+
+def pure_sse_event(name: str, obj) -> bytes:
+    return (f"event: {name}\n".encode() + pure_sse_data(obj))
+
+
+# ---------------------------------------------------------- raw core parity
+@needs_so
+class TestCoreMsgpackParity:
+    def test_randomized_pack_unpack(self):
+        rng = random.Random(0x19A)
+        for _ in range(300):
+            obj = rand_obj(rng)
+            ref = msgpack.packb(obj, use_bin_type=True)
+            assert CORE.packb(obj) == ref
+            back = CORE.unpackb(ref)
+            # NaN != NaN: compare re-encodings, not objects.
+            assert msgpack.packb(back, use_bin_type=True) == ref
+            assert msgpack.packb(msgpack.unpackb(ref, raw=False),
+                                 use_bin_type=True) == ref
+
+    def test_randomized_load_frames(self):
+        rng = random.Random(0x19B)
+        for _ in range(100):
+            frame = rand_load_frame(rng)
+            ref = msgpack.packb(frame, use_bin_type=True)
+            assert CORE.packb(frame) == ref
+            assert CORE.unpackb(ref) == frame
+            b64 = base64.b64encode(ref).decode("ascii")
+            assert CORE.pack_b64(frame) == b64
+            assert CORE.unpack_b64(b64) == frame
+            assert CORE.unpack_b64(b64.encode("ascii")) == frame
+
+    def test_randomized_telemetry_batches(self):
+        rng = random.Random(0x19C)
+        for _ in range(60):
+            batch = {"frames": rand_telemetry_batch(rng)}
+            ref = msgpack.packb(batch, use_bin_type=True)
+            assert CORE.packb(batch) == ref
+            assert msgpack.packb(CORE.unpackb(ref),
+                                 use_bin_type=True) == ref
+
+    def test_int_boundaries_exact_format(self):
+        for v in _EDGE_INTS:
+            for sign in (v, -v if v <= 2**63 else v):
+                if -2**63 <= sign <= 2**64 - 1:
+                    assert CORE.packb(sign) == msgpack.packb(sign)
+
+    def test_unsupported_inputs_raise(self):
+        class Odd:
+            pass
+        for bad in (Odd(), {1: "non-str-key-is-fine-for-msgpack"},
+                    2**64, -2**63 - 1):
+            if isinstance(bad, dict):
+                # msgpack allows int keys; native must agree, not refuse.
+                assert CORE.packb(bad) == msgpack.packb(
+                    bad, use_bin_type=True)
+                continue
+            with pytest.raises(Exception):
+                CORE.packb(bad)
+
+    def test_decode_rejects_what_msgpack_rejects(self):
+        for raw in (b"", b"\xc1", b"\x81\xa1a",       # truncated / reserved
+                    msgpack.packb(1) + b"tail"):       # trailing bytes
+            with pytest.raises(Exception):
+                CORE.unpackb(raw)
+
+    def test_ext_types_refused_not_corrupted(self):
+        raw = msgpack.packb(msgpack.ExtType(4, b"x"))
+        with pytest.raises(Exception):
+            CORE.unpackb(raw)
+
+    def test_non_canonical_base64_refused(self):
+        frame = {"i": {}, "g": {}, "s": 1, "ms": 2}
+        good = CORE.pack_b64(frame)
+        # Whitespace / padding games decode fine in Python's lax
+        # b64decode; native refuses -> call sites fall back, results agree.
+        with pytest.raises(Exception):
+            CORE.unpack_b64(good + "\n")
+
+
+@needs_so
+class TestCoreSseParity:
+    def test_randomized_deltas(self):
+        rng = random.Random(0x19D)
+        for _ in range(300):
+            delta = rand_sse_delta(rng)
+            assert CORE.sse_data_frame(delta) == pure_sse_data(delta)
+
+    def test_randomized_json_objects(self):
+        rng = random.Random(0x19E)
+        for _ in range(300):
+            obj = rand_obj(rng, for_json=True)
+            assert CORE.sse_data_frame(obj) == pure_sse_data(obj)
+
+    def test_event_frames(self):
+        rng = random.Random(0x19F)
+        for _ in range(100):
+            obj = rand_obj(rng, 2, for_json=True)
+            name = rng.choice(("telemetry", "usage", "x-keepalive"))
+            assert CORE.sse_event_frame(name, obj) == pure_sse_event(
+                name, obj)
+
+    def test_float_repr_parity(self):
+        for v in _EDGE_FLOATS + (math.nan, math.inf, -math.inf):
+            got = CORE.sse_data_frame({"v": v})
+            want = pure_sse_data({"v": v})
+            assert got == want, repr(v)
+
+    def test_surrogate_adjacent_ok_lone_surrogate_refused(self):
+        ok = {"text": "퟿ and  bracket the surrogate block"}
+        assert CORE.sse_data_frame(ok) == pure_sse_data(ok)
+        with pytest.raises(Exception):
+            CORE.sse_data_frame({"text": "lone \ud800 surrogate"})
+        # The wrapper turns that refusal into MISS; the pure path then
+        # raises the canonical UnicodeEncodeError — native never emits
+        # bytes Python wouldn't.
+        if native.available("sse"):
+            assert native.sse_data_frame(
+                {"text": "\udc00"}) is native.MISS
+
+
+@needs_so
+class TestCoreRendezvousParity:
+    @staticmethod
+    def _pure(members, key):
+        best, best_score = "", -1
+        for m in members:
+            s = own._rendezvous_score(m, key)
+            if s > best_score:
+                best, best_score = m, s
+        return best
+
+    def test_randomized_draws(self):
+        rng = random.Random(0x1A0)
+        for _ in range(200):
+            members = tuple(sorted(
+                {f"10.{rng.randrange(256)}.{rng.randrange(256)}."
+                 f"{rng.randrange(256)}:{rng.randrange(65536)}"
+                 for _ in range(rng.randrange(1, 12))}))
+            key = rand_str(rng) + str(rng.randrange(10**9))
+            assert CORE.rendezvous(members, key) == self._pure(members, key)
+            assert CORE.rendezvous(list(members), key) == \
+                self._pure(members, key)
+
+    def test_empty_and_single(self):
+        assert CORE.rendezvous((), "k") == ""
+        assert CORE.rendezvous(("only:1",), "k") == "only:1"
+
+    def test_tie_breaks_first_strict_max(self):
+        # Duplicate members score identically; first wins in both paths.
+        members = ("a:1", "a:1", "b:2")
+        assert CORE.rendezvous(members, "k") == self._pure(members, "k")
+
+
+@needs_so
+class TestCoreTokenizerParity:
+    def test_randomized_text(self):
+        rng = random.Random(0x1A1)
+        for _ in range(300):
+            text = rand_str(rng)
+            assert CORE.tok_encode(text) == \
+                [b + 256 for b in text.encode("utf-8")]
+
+    def test_lone_surrogate_refused(self):
+        with pytest.raises(Exception):
+            CORE.tok_encode("bad \ud800")
+
+
+# ------------------------------------------- call-site switch equivalence
+@pytest.fixture
+def native_off():
+    """Force XLLM_NATIVE=0 + reload for one test; restore after."""
+    old = os.environ.get("XLLM_NATIVE")
+    os.environ["XLLM_NATIVE"] = "0"
+    native.reload()
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("XLLM_NATIVE", None)
+        else:
+            os.environ["XLLM_NATIVE"] = old
+        native.reload()
+
+
+def _both_paths(fn):
+    """Run ``fn()`` with the native loader in its default state and with
+    the kill switch forced off; return (default_leg, off_leg)."""
+    default_leg = fn()
+    old = os.environ.get("XLLM_NATIVE")
+    os.environ["XLLM_NATIVE"] = "0"
+    native.reload()
+    try:
+        off_leg = fn()
+    finally:
+        if old is None:
+            os.environ.pop("XLLM_NATIVE", None)
+        else:
+            os.environ["XLLM_NATIVE"] = old
+        native.reload()
+    return default_leg, off_leg
+
+
+class TestKillSwitch:
+    def test_switch_off_disables_everything(self, native_off):
+        st = native.status()
+        assert st["enabled"] is False
+        assert st["loaded"] is False
+        assert not any(st["components"].values())
+        assert native.packb({"a": 1}) is native.MISS
+        assert native.sse_data_frame({}) is native.MISS
+        assert native.rendezvous(("a",), "k") is native.MISS
+        assert native.tok_encode("x") is native.MISS
+
+    def test_status_shape(self):
+        st = native.status()
+        assert set(st) == {"enabled", "loaded", "so", "components"}
+        assert set(st["components"]) == set(native.COMPONENTS)
+
+
+class TestCallSiteEquivalence:
+    """Public wire/ownership/tokenizer outputs are identical with the
+    switch on and off (pure-vs-pure determinism when no .so exists)."""
+
+    def test_load_frame_wire(self):
+        rng = random.Random(0x1A2)
+        frames = [rand_load_frame(rng) for _ in range(20)]
+        on, off = _both_paths(lambda: [
+            wire.encode_load_frame(f["i"], f["g"], f["s"], f["ms"])
+            for f in frames])
+        assert on == off
+        decoded_on, decoded_off = _both_paths(
+            lambda: [wire.decode_load_frame(v) for v in on])
+        assert decoded_on == decoded_off == frames
+
+    def test_kv_frame_wire(self):
+        rng = random.Random(0x1A3)
+        upserts = {bytes(rng.randrange(256) for _ in range(16)):
+                   [[f"i{rng.randrange(9)}"], [], []]
+                   for _ in range(10)}
+        removals = list(upserts)[:3]
+        on, off = _both_paths(
+            lambda: wire.encode_kv_frame(upserts, removals, full=True))
+        assert on == off
+        d_on, d_off = _both_paths(lambda: wire.decode_kv_frame(on))
+        assert d_on == d_off == (upserts, removals, True)
+
+    def test_telemetry_wire(self):
+        rng = random.Random(0x1A4)
+        batch = rand_telemetry_batch(rng)
+        on, off = _both_paths(lambda: wire.encode_telemetry(batch))
+        assert on == off
+        assert wire.decode_body(on[1], on[0]) == {"frames": batch}
+
+    def test_dispatch_wire(self):
+        rng = random.Random(0x1A5)
+        payloads = [rand_load_frame(rng) for _ in range(10)]
+        on, off = _both_paths(
+            lambda: [wire.pack_dispatch(p) for p in payloads])
+        assert on == off
+        assert [wire.unpack_dispatch(b) for b in on] == payloads
+
+    def test_malformed_frames_raise_valueerror_both_paths(self):
+        for bad in ("%%%not-base64%%%",
+                    base64.b64encode(b"\xc1").decode(),
+                    base64.b64encode(msgpack.packb([1, 2])).decode()):
+            for leg in _both_paths(lambda b=bad: self._decode_err(b)):
+                assert leg == "ValueError"
+
+    @staticmethod
+    def _decode_err(value):
+        try:
+            wire.decode_load_frame(value)
+        except ValueError:
+            return "ValueError"
+        return "no-error"
+
+    def test_rendezvous_owner(self):
+        rng = random.Random(0x1A6)
+        draws = [(tuple(sorted({f"m{rng.randrange(30)}:1"
+                                for _ in range(rng.randrange(1, 8))})),
+                  f"completion-{rng.randrange(10**9)}")
+                 for _ in range(50)]
+        on, off = _both_paths(lambda: [
+            own.rendezvous_owner(m, k) for m, k in draws])
+        assert on == off
+
+    def test_tokenizer_encode(self):
+        tok = SimpleTokenizer()
+        texts = ["", "hello", "héllo wörld", "日本語 🦖", "\x00\x1f",
+                 "a" * 1000]
+        on, off = _both_paths(lambda: [tok.encode(t) for t in texts])
+        assert on == off
+        for t, ids in zip(texts, on):
+            assert tok.decode(ids) == t
+
+
+# ------------------------------------------------ instance_owner verdict memo
+class TestInstanceOwnerMemo:
+    def _router(self):
+        return OwnershipRouter(InMemoryCoordination({}), "10.0.0.1:1",
+                               start_watch=False)
+
+    def test_memo_hits_and_matches_uncached(self):
+        r = self._router()
+        with r._lock:
+            r._addrs |= {"10.0.0.2:1", "10.0.0.3:1"}
+            r._publish_locked()
+        names = [f"eng-{i}" for i in range(40)]
+        first = [r.instance_owner(n) for n in names]
+        # Uncached reference: the module-level walk over the same tuple.
+        want = [own.telemetry_owner(r.members(), n) for n in names]
+        assert first == want
+        # Second pass is pure memo hits — same verdicts, cache populated.
+        assert [r.instance_owner(n) for n in names] == first
+        assert len(r._own_cache[1]) == len(names)
+
+    def test_membership_change_invalidates(self):
+        r = self._router()
+        with r._lock:
+            r._addrs |= {"10.0.0.2:1", "10.0.0.3:1", "10.0.0.4:1"}
+            r._publish_locked()
+        names = [f"eng-{i}" for i in range(60)]
+        before = {n: r.instance_owner(n) for n in names}
+        epoch = r._own_cache[0]
+        with r._lock:
+            r._addrs.discard("10.0.0.3:1")
+            r._publish_locked()
+        # The published tuple is a fresh object: the identity check must
+        # rebuild the memo and re-walk against the survivors.
+        after = {n: r.instance_owner(n) for n in names}
+        assert r._own_cache[0] is not epoch
+        assert r._own_cache[0] is r.members()
+        for n in names:
+            assert after[n] == (own.telemetry_owner(r.members(), n)
+                                or r.self_addr)
+        assert any(before[n] != after[n] for n in names) or \
+            all(before[n] != "10.0.0.3:1" for n in names)
+        assert "10.0.0.3:1" not in after.values()
+
+    def test_exclude_bypasses_memo(self):
+        r = self._router()
+        with r._lock:
+            r._addrs |= {"10.0.0.2:1", "10.0.0.3:1"}
+            r._publish_locked()
+        n = "eng-x"
+        owner = r.instance_owner(n)
+        successor = r.instance_owner(n, exclude=(owner,))
+        assert successor != owner
+        # The bypass never polluted the memo with the successor.
+        assert r._own_cache[1].get(n) in (None, owner)
+        assert r.instance_owner(n) == owner
+
+    def test_runaway_namespace_resets_not_grows(self):
+        r = self._router()
+        r.OWN_CACHE_MAX = 32   # shrink the bound for the drill
+        for i in range(100):
+            r.instance_owner(f"chaos-{i}")
+        assert len(r._own_cache[1]) <= 33
+
+    def test_disabled_router_short_circuits(self):
+        r = OwnershipRouter(InMemoryCoordination({}), "10.0.0.1:1",
+                            enabled=False, start_watch=False)
+        assert r.instance_owner("eng-a") == "10.0.0.1:1"
+        assert r.owns_instance("eng-a")

@@ -301,6 +301,7 @@ def _stream(master, timeout=60):
         obj = json.loads(data)
         if "error" in obj:
             raise RuntimeError(f"stream error: {obj['error']}")
+        sid = obj.get("id") or sid
         for c in obj.get("choices", ()):
             text += c.get("text", "")
     return text, sid
@@ -388,17 +389,19 @@ class TestTracePropagation:
         engine = _engine(store)
         try:
             _await_fleet(master, [engine])
-            # Straggler spans from a prior test's (killed) masters may
-            # finish in the window before this master disabled the
-            # global tracer; from here on the disabled tracer drops all
-            # completions, so one more clear makes the check
-            # deterministic under load.
-            TRACER.store.clear()
-            text, _ = _stream(master)
+            # Straggler spans from a prior test's (killed) masters can
+            # land in the shared store at any point while this test
+            # runs, so asserting a globally empty store is flaky under
+            # load (seen after test_fleet_observability). Scope the
+            # check to THIS request instead: the disabled tracer drops
+            # its completions, so its id must never show up.
+            text, sid = _stream(master)
             assert text == REPLY
+            assert sid, "stream deltas carried no completion id"
+            assert _get_trace(master, request_id=sid).status_code == 404
             recent = requests.get(
                 _base(master) + "/admin/trace/recent", timeout=5).json()
-            assert recent["traces"] == []
+            assert sid not in {t["request_id"] for t in recent["traces"]}
         finally:
             engine.stop()
             master.stop()

@@ -459,6 +459,13 @@ HOTPATH_CPU_SECONDS = REGISTRY.counter(
     "ingest) — the per-master scaling-evidence series; frame-level "
     "breakdown lives at /admin/profile",
     labelnames=("loop",))
+NATIVE_PATH_ACTIVE = REGISTRY.gauge(
+    "native_path_active",
+    "1 when the libhotcore.so native fast path serves this component "
+    "(wire = LOADFRAME/telemetry msgpack, sse = delta-frame assembly, "
+    "rendezvous = ownership hashing, tokenizer = byte-id encode); 0 = "
+    "pure-Python fallback — a degraded process in a fleet scrape",
+    labelnames=("component",))
 AUTOSCALER_ACTIONS_TOTAL = REGISTRY.counter(
     "autoscaler_actions_total",
     "Actions enacted by the autoscaler controller, by kind "

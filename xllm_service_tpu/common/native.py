@@ -1,0 +1,290 @@
+"""Loader for the native hot-path core (csrc/hotcore.c → libhotcore.so).
+
+One loader, four components, one kill switch. The continuous profiler
+(BENCH_profile_r19.json) blamed four frame families for most of the
+master's route/stream CPU; hotcore.c reimplements exactly those, and
+this module is the only place that decides native-vs-Python:
+
+==============  =========================================================
+component       fast path (call sites keep a mandatory pure fallback)
+==============  =========================================================
+``wire``        msgpack pack/unpack + fused base64 form for LOADFRAME /
+                telemetry frames (rpc/wire.py)
+``sse``         SSE ``data: ...\\n\\n`` frame assembly + compact JSON
+                (http_service/service.py _respond emit loop)
+``rendezvous``  blake2b-8 highest-random-weight walk over the member set
+                (multimaster/ownership.py)
+``tokenizer``   SimpleTokenizer's utf8-byte+offset encode — the single
+                hottest route frame (tokenizer/simple.py)
+==============  =========================================================
+
+Contract (mirrors common/hashing.py's optional-extension pattern):
+
+- ``XLLM_NATIVE=0`` forces pure Python everywhere; absent .so or a
+  failed per-component parity self-test disables just that component.
+- Every wrapper returns :data:`MISS` when the native path cannot serve
+  the input **bit-exactly** (unsupported type, lone surrogate, ext
+  msgpack, non-canonical base64, ...). The call site then runs the
+  pure-Python code, which either handles the input or raises the
+  canonical library error. Native never produces bytes Python wouldn't.
+- The differential property tests (tests/test_native_hotcore.py) pin
+  byte-for-byte agreement; a tiny parity self-test re-runs at load so a
+  stale/mismatched .so degrades to Python instead of corrupting a wire.
+
+``native_path_active{component}`` gauges (common/metrics.py) and the
+flight-recorder context provider (wired by the HTTP service) expose
+which processes in a fleet run degraded.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+COMPONENTS = ("wire", "sse", "rendezvous", "tokenizer")
+
+#: Sentinel for "native could not serve this input — run the pure path".
+#: Distinct from None because decoders legitimately return None.
+MISS: Any = object()
+
+_SO_PATH = Path(__file__).resolve().parents[2] / "csrc" / "libhotcore.so"
+
+
+def _switch_on() -> bool:
+    return os.environ.get("XLLM_NATIVE", "") not in ("0", "false", "off")
+
+
+class _Core:
+    """Bound entry points of one loaded libhotcore.so."""
+
+    _PYOBJ_FNS = ("hc_json_bytes", "hc_sse_data_frame", "hc_packb",
+                  "hc_unpackb", "hc_pack_b64", "hc_unpack_b64",
+                  "hc_tok_encode")
+
+    def __init__(self, so_path: Path):
+        # PyDLL: the GIL stays held — every entry point uses CPython APIs.
+        lib = ctypes.PyDLL(str(so_path))
+        lib.hc_abi_version.argtypes = []
+        lib.hc_abi_version.restype = ctypes.c_int
+        if lib.hc_abi_version() != 1:
+            raise OSError(f"libhotcore ABI {lib.hc_abi_version()} != 1")
+        for name in self._PYOBJ_FNS:
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.py_object]
+            fn.restype = ctypes.py_object
+            setattr(self, name[3:], fn)
+        for name in ("hc_sse_event_frame", "hc_rendezvous"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.py_object, ctypes.py_object]
+            fn.restype = ctypes.py_object
+            setattr(self, name[3:], fn)
+
+
+def _self_test(core: _Core) -> dict[str, bool]:
+    """Per-component parity pins against known-good literals: a stale or
+    miscompiled .so must degrade to Python, never corrupt a wire."""
+    ok = {}
+    probe = {"s": "é\n", "i": [0, -33, 70000], "f": 1.5, "n": None}
+    try:
+        ok["wire"] = (
+            core.packb(probe) ==
+            b"\x84\xa1s\xa3\xc3\xa9\n\xa1i\x93\x00\xd0\xdf\xce\x00\x01"
+            b"\x11p\xa1f\xcb?\xf8\x00\x00\x00\x00\x00\x00\xa1n\xc0"
+            and core.unpackb(core.packb(probe)) == probe
+            and core.unpack_b64(core.pack_b64(probe)) == probe)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(any self-test failure means "disable this component", whatever the exception)
+        ok["wire"] = False
+    try:
+        ok["sse"] = (
+            core.sse_data_frame(probe) ==
+            b'data: {"s":"\xc3\xa9\\n","i":[0,-33,70000],"f":1.5,'
+            b'"n":null}\n\n'
+            and core.sse_event_frame("telemetry", {"a": 1}) ==
+            b'event: telemetry\ndata: {"a":1}\n\n')
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see above)
+        ok["sse"] = False
+    try:
+        # blake2b("a|k", digest_size=8) beats "b|k" for this key.
+        ok["rendezvous"] = (core.rendezvous(("a", "b"), "k") == "a"
+                            and core.rendezvous((), "k") == "")
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see above)
+        ok["rendezvous"] = False
+    try:
+        ok["tokenizer"] = core.tok_encode("hé") == [360, 451, 425]
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see above)
+        ok["tokenizer"] = False
+    return ok
+
+
+_CORE: Optional[_Core] = None
+_ACTIVE: dict[str, bool] = {c: False for c in COMPONENTS}
+
+
+def _load() -> None:
+    global _CORE, _ACTIVE
+    core = None
+    active = {c: False for c in COMPONENTS}
+    if _switch_on():
+        try:
+            core = _Core(_SO_PATH)
+        except OSError:
+            core = None   # absent/unloadable .so: documented degraded mode
+        if core is not None:
+            active = _self_test(core)
+            if not all(active.values()):
+                logger.warning(
+                    "libhotcore parity self-test failed for %s; those "
+                    "components stay on the pure-Python path",
+                    [c for c, v in active.items() if not v])
+            if not any(active.values()):
+                core = None
+    _CORE = core
+    _ACTIVE = active
+
+
+_load()
+
+
+def reload() -> dict:
+    """Re-evaluate XLLM_NATIVE + the .so (tests flip the switch
+    mid-process; check.sh asserts the loader's verdict)."""
+    _load()
+    return status()
+
+
+def load_core(force: bool = False) -> Optional[_Core]:
+    """The raw bound core, for the differential tests: ``force=True``
+    loads the .so even when ``XLLM_NATIVE=0`` so one process can compare
+    both paths. Returns None when the .so is absent/unloadable."""
+    if _CORE is not None and not force:
+        return _CORE
+    try:
+        return _Core(_SO_PATH)
+    except OSError:
+        return None
+
+
+def available(component: Optional[str] = None) -> bool:
+    if component is None:
+        return _CORE is not None
+    return _ACTIVE.get(component, False)
+
+
+def status() -> dict:
+    """Loader verdict (flight-recorder context + check.sh assertion)."""
+    return {"enabled": _switch_on(),
+            "loaded": _CORE is not None,
+            "so": str(_SO_PATH),
+            "components": dict(_ACTIVE)}
+
+
+def export_gauges() -> None:
+    """Refresh ``native_path_active{component}`` (scrape-time, like
+    CPU_ATTR.export_counters)."""
+    from .metrics import NATIVE_PATH_ACTIVE
+
+    for c in COMPONENTS:
+        NATIVE_PATH_ACTIVE.labels(component=c).set(
+            1.0 if _ACTIVE.get(c) else 0.0)
+
+
+# ----------------------------------------------------------------- wrappers
+# Shape note: every wrapper is `if not active: MISS; try native except
+# Exception: MISS` — the call site owns the pure path, so fallback code
+# lives exactly once, next to the logic it mirrors.
+
+def json_bytes(obj: Any) -> Any:
+    """Compact-JSON bytes (ensure_ascii=False) or MISS."""
+    if not _ACTIVE["sse"]:
+        return MISS
+    try:
+        return _CORE.json_bytes(obj)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(any native refusal degrades to the pure path; the fallback re-raises canonically for truly bad input)
+        return MISS
+
+
+def sse_data_frame(obj: Any) -> Any:
+    """b"data: <json>\\n\\n" or MISS."""
+    if not _ACTIVE["sse"]:
+        return MISS
+    try:
+        return _CORE.sse_data_frame(obj)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see json_bytes)
+        return MISS
+
+
+def sse_event_frame(name: str, obj: Any) -> Any:
+    """b"event: <name>\\ndata: <json>\\n\\n" or MISS."""
+    if not _ACTIVE["sse"]:
+        return MISS
+    try:
+        return _CORE.sse_event_frame(name, obj)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see json_bytes)
+        return MISS
+
+
+def packb(obj: Any) -> Any:
+    """msgpack bytes (parity: msgpack.packb(use_bin_type=True)) or MISS."""
+    if not _ACTIVE["wire"]:
+        return MISS
+    try:
+        return _CORE.packb(obj)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see json_bytes)
+        return MISS
+
+
+def unpackb(data: bytes) -> Any:
+    """Decoded object (parity: msgpack.unpackb(raw=False)) or MISS."""
+    if not _ACTIVE["wire"]:
+        return MISS
+    try:
+        return _CORE.unpackb(data)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see json_bytes)
+        return MISS
+
+
+def pack_b64(obj: Any) -> Any:
+    """ascii str base64(msgpack(obj)) — the LOADFRAME wire — or MISS."""
+    if not _ACTIVE["wire"]:
+        return MISS
+    try:
+        return _CORE.pack_b64(obj)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see json_bytes)
+        return MISS
+
+
+def unpack_b64(value: Any) -> Any:
+    """Decoded object from base64(msgpack) str/bytes, or MISS."""
+    if not _ACTIVE["wire"]:
+        return MISS
+    try:
+        return _CORE.unpack_b64(value)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see json_bytes)
+        return MISS
+
+
+def rendezvous(members: Any, key: str) -> Any:
+    """Highest-random-weight member ("" when empty) or MISS. ``members``
+    must be a tuple/list of str (the RCU-published member tuple is)."""
+    if not _ACTIVE["rendezvous"]:
+        return MISS
+    try:
+        return _CORE.rendezvous(members, key)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see json_bytes)
+        return MISS
+
+
+def tok_encode(text: str) -> Any:
+    """[b + 256 for b in text.encode("utf-8")] or MISS."""
+    if not _ACTIVE["tokenizer"]:
+        return MISS
+    try:
+        return _CORE.tok_encode(text)
+    except Exception:  # noqa: BLE001  # xlint: allow-broad-except(see json_bytes)
+        return MISS

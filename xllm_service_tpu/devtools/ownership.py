@@ -202,6 +202,14 @@ STATE_DISCIPLINES: dict[str, str] = {
     # increment is acceptable, taking a lock per accept is not.
     "OwnershipRouter.mined": "lock:_lock",
     "OwnershipRouter.mine_misses": "lock:_lock",
+    # Telemetry-shard verdict memo (ISSUE 19): nominally lock-guarded
+    # like the mining counters, but the beat-path write sites carry
+    # ownership.escape(reason) — the memo is keyed by IDENTITY of the
+    # RCU-published member tuple and every racer computes the same
+    # deterministic owner, so a lost fill is a re-computation, not a
+    # wrong answer; taking a lock per heartbeat is the cost the memo
+    # exists to remove.
+    "OwnershipRouter._own_cache": "lock:_lock",
     # ---------------------------------------------------------- SloMonitor
     "SloMonitor._objectives": "lock:_lock",
     "SloMonitor.ttft_target_ms": "lock:_lock",

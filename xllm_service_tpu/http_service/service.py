@@ -37,6 +37,7 @@ import aiohttp
 from aiohttp import web
 
 from ..common import flightrecorder
+from ..common import native as _native
 from ..common.flightrecorder import RECORDER
 from ..common.hotpath import CPU_ATTR, HOTPATH
 from ..common.metrics import (
@@ -178,6 +179,11 @@ class XllmHttpService:
         RECORDER.configure(capacity=self.opts.flightrecorder_capacity,
                            directory=self.opts.flightrecorder_dir)
         RECORDER.add_context_provider("service", self._anomaly_context)
+        # Native-core verdict in every anomaly bundle: a process quietly
+        # running degraded pure-Python (missing .so, failed parity
+        # self-test, XLLM_NATIVE=0) is exactly the asymmetry a fleet
+        # perf anomaly investigation needs to see first.
+        RECORDER.add_context_provider("native", _native.status)
         # Continuous profiler (profiling/sampler.py): always-on sampling
         # at profile_hz (0 disables), refcounted — an in-process engine
         # agent shares the same process-global sampler. The profiler
@@ -309,6 +315,7 @@ class XllmHttpService:
         self.tracer.close()
         PROFILER.stop()
         RECORDER.remove_context_provider("service", self._anomaly_context)
+        RECORDER.remove_context_provider("native", _native.status)
         RECORDER.close()
 
     def _anomaly_context(self) -> dict[str, Any]:
@@ -881,39 +888,56 @@ class XllmHttpService:
                         if detached:
                             continue   # re-check the grace window
                         raise
-                    while True:
-                        frame = b""
-                        if AioConnection.is_finish(tag):
-                            if emit_done:  # OpenAI framing; Anthropic streams
-                                frame = _DONE_FRAME
-                            done = True
-                        elif tag == "error":
-                            code, msg = item
-                            frame = _DATA_PREFIX + dumps(
-                                {"error": {"message": msg, "code": code}},
-                                separators=_COMPACT).encode() + _FRAME_SEP
-                            done = True
-                        elif tag == "event":
-                            name, obj = item
-                            frame = (f"event: {name}\n".encode()
-                                     + _DATA_PREFIX
-                                     + dumps(obj, ensure_ascii=False,
-                                             separators=_COMPACT).encode()
-                                     + _FRAME_SEP)
-                        else:
-                            frame = _DATA_PREFIX + dumps(
-                                item, ensure_ascii=False,
-                                separators=_COMPACT).encode() + _FRAME_SEP
-                        if frame:
-                            buf += frame
-                            if journal is not None:
-                                DeltaJournal.record(journal, frame)
-                        if done:
-                            break
-                        try:
-                            tag, item = conn.queue.get_nowait()
-                        except asyncio.QueueEmpty:
-                            break
+                    # The drain below is pure CPU (no awaits): frame
+                    # assembly + JSON serialization per delta — the
+                    # profiler's hottest output-lane work. Attributed to
+                    # the "stream" loop so the native-on/off A/B is
+                    # measured where the bytes are built; libhotcore
+                    # assembles data/event frames in one C call when it
+                    # can (error frames are rare and ensure_ascii, so
+                    # they stay on the Python encoder).
+                    with CPU_ATTR.measure("stream"):
+                        while True:
+                            frame = b""
+                            if AioConnection.is_finish(tag):
+                                if emit_done:  # OpenAI framing
+                                    frame = _DONE_FRAME
+                                done = True
+                            elif tag == "error":
+                                code, msg = item
+                                frame = _DATA_PREFIX + dumps(
+                                    {"error": {"message": msg,
+                                               "code": code}},
+                                    separators=_COMPACT).encode() \
+                                    + _FRAME_SEP
+                                done = True
+                            elif tag == "event":
+                                name, obj = item
+                                frame = _native.sse_event_frame(name, obj)
+                                if frame is _native.MISS:
+                                    frame = (f"event: {name}\n".encode()
+                                             + _DATA_PREFIX
+                                             + dumps(obj, ensure_ascii=False,
+                                                     separators=_COMPACT
+                                                     ).encode()
+                                             + _FRAME_SEP)
+                            else:
+                                frame = _native.sse_data_frame(item)
+                                if frame is _native.MISS:
+                                    frame = _DATA_PREFIX + dumps(
+                                        item, ensure_ascii=False,
+                                        separators=_COMPACT).encode() \
+                                        + _FRAME_SEP
+                            if frame:
+                                buf += frame
+                                if journal is not None:
+                                    DeltaJournal.record(journal, frame)
+                            if done:
+                                break
+                            try:
+                                tag, item = conn.queue.get_nowait()
+                            except asyncio.QueueEmpty:
+                                break
                     if buf:
                         if not detached:
                             try:
@@ -1070,6 +1094,9 @@ class XllmHttpService:
         # Hot-loop CPU attribution as counters: the per-master scaling
         # series /metrics/fleet captures (ISSUE 18 satellite).
         CPU_ATTR.export_counters()
+        # Which libhotcore components serve this process (1) vs run the
+        # pure-Python fallback (0) — fleet scrapes spot degraded peers.
+        _native.export_gauges()
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         self._refresh_local_gauges()

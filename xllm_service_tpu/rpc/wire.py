@@ -38,6 +38,8 @@ from typing import Any
 
 import msgpack
 
+from ..common import native as _native
+
 #: Wire format names (the values carried in InstanceMetaInfo.wire_formats).
 WIRE_MSGPACK = "msgpack"
 WIRE_JSON = "json"
@@ -77,6 +79,12 @@ HOT_PATH_FUNCTIONS: dict[str, str] = {
         "master→coordination KV-index sync (binary delta frames)",
     "OwnershipRouter.owner_of":
         "per-request ownership resolution (every accept + every relay)",
+    "OwnershipRouter.instance_owner":
+        "per-beat telemetry-shard verdict (memoized on the published "
+        "member tuple; native rendezvous walk on miss)",
+    "SimpleTokenizer.encode":
+        "per-request prompt tokenization inside the route loop (the "
+        "profiler's hottest route frame; native byte-id fast path)",
     "HandoffRelay._relay_stream":
         "owner-forward SSE relay (frames must pass through as raw bytes)",
     "XllmHttpService.handle_handoff":
@@ -130,11 +138,20 @@ HOT_PATH_FUNCTIONS: dict[str, str] = {
 
 def pack_dispatch(payload: dict[str, Any]) -> bytes:
     """Deterministic msgpack encoding of a dispatch payload (same dict →
-    same bytes; maps keep insertion order)."""
+    same bytes; maps keep insertion order). Native fast path when
+    libhotcore serves it — byte-identical by the differential tests, so
+    the retained-failover re-encode determinism holds across a mixed
+    native/pure fleet."""
+    enc = _native.packb(payload)
+    if enc is not _native.MISS:
+        return enc
     return msgpack.packb(payload, use_bin_type=True)
 
 
 def unpack_dispatch(data: bytes) -> Any:
+    obj = _native.unpackb(data)
+    if obj is not _native.MISS:
+        return obj
     return msgpack.unpackb(data, raw=False)
 
 
@@ -179,6 +196,9 @@ def encode_kv_frame(upserts: dict[bytes, Any], removals: "list[bytes]",
     frame = {"u": upserts, "r": list(removals)}
     if full:
         frame["full"] = True
+    enc = _native.pack_b64(frame)
+    if enc is not _native.MISS:
+        return enc
     return base64.b64encode(
         msgpack.packb(frame, use_bin_type=True)).decode("ascii")
 
@@ -187,7 +207,9 @@ def decode_kv_frame(value: str) -> "tuple[dict[bytes, Any], list[bytes], bool]":
     """Inverse of :func:`encode_kv_frame` → (upserts, removals, full).
     Raises ValueError on a malformed frame (callers skip it)."""
     try:
-        frame = msgpack.unpackb(base64.b64decode(value), raw=False)
+        frame = _native.unpack_b64(value)
+        if frame is _native.MISS:
+            frame = msgpack.unpackb(base64.b64decode(value), raw=False)
         if not isinstance(frame, dict):
             raise TypeError("frame is not a map")
         upserts = frame.get("u") or {}
@@ -219,9 +241,12 @@ def encode_load_frame(instances: dict, gone: "dict[str, str]", seq: int,
     mirrored graceful drain doesn't count as an eviction); ``now_ms``
     is the owner's clock at build time so mirrors can re-base
     heartbeat/telemetry ages without cross-host clock agreement."""
-    return base64.b64encode(msgpack.packb(
-        {"i": instances, "g": dict(gone), "s": seq, "ms": now_ms},
-        use_bin_type=True)).decode("ascii")
+    frame = {"i": instances, "g": dict(gone), "s": seq, "ms": now_ms}
+    enc = _native.pack_b64(frame)
+    if enc is not _native.MISS:
+        return enc
+    return base64.b64encode(
+        msgpack.packb(frame, use_bin_type=True)).decode("ascii")
 
 
 def decode_load_frame(value: str) -> dict:
@@ -229,7 +254,9 @@ def decode_load_frame(value: str) -> dict:
     "s": seq, "ms": build ms}. Raises ValueError on a malformed frame
     (callers skip it)."""
     try:
-        frame = msgpack.unpackb(base64.b64decode(value), raw=False)
+        frame = _native.unpack_b64(value)
+        if frame is _native.MISS:
+            frame = msgpack.unpackb(base64.b64decode(value), raw=False)
         if not isinstance(frame, dict) or not isinstance(
                 frame.get("i", {}), dict):
             raise TypeError("load frame is not a map")
@@ -260,6 +287,9 @@ def encode_telemetry(frames: "list[dict]") -> tuple[bytes, str]:
     endpoint is new, so there is no legacy-JSON peer to negotiate with
     (an old master answers 404 and the engine falls back to the legacy
     wires)."""
+    enc = _native.packb({"frames": frames})
+    if enc is not _native.MISS:
+        return enc, MSGPACK_CONTENT_TYPE
     return (msgpack.packb({"frames": frames}, use_bin_type=True),
             MSGPACK_CONTENT_TYPE)
 

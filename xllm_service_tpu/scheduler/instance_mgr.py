@@ -484,8 +484,9 @@ class InstanceMgr:
         """Does THIS master own heartbeat/load ingest and failure
         detection for the instance? Uniformly True outside sharded mode
         (legacy funnel: whoever receives a heartbeat ingests it, every
-        frontend runs its own detection). Lock-free: one rendezvous walk
-        over the published member tuple. Under XLLM_STATE_DEBUG the
+        frontend runs its own detection). Lock-free: one memo lookup on
+        the router's per-membership-epoch verdict cache (a rendezvous
+        walk only on the first ask per epoch). Under XLLM_STATE_DEBUG the
         answer is noted per-thread — the runtime half of the `owner:`
         state discipline on the sharded heartbeat fields."""
         ok = (not self.sharded()) or self._ownership.owns_instance(name)
@@ -1029,9 +1030,10 @@ class InstanceMgr:
                     owned_beat = False
                 self._update_load_info_locked(name)
         # Reuse the in-lock verdict: a second owns_telemetry() here would
-        # be another full rendezvous walk on the exact hot path this
-        # plane exists to thin (review catch). Bare beats (no metrics —
-        # the kv-relay path) are rare enough to pay the walk.
+        # repeat the shard lookup on the exact hot path this plane exists
+        # to thin (review catch). Bare beats (no metrics — the kv-relay
+        # path) re-ask, but the answer now comes from the router's
+        # per-membership-epoch verdict memo, not a fresh rendezvous walk.
         if owned_beat is None:
             owned_beat = self.owns_telemetry(name)
         HEARTBEATS_INGESTED_TOTAL.labels(
