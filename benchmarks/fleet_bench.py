@@ -226,16 +226,21 @@ def drive_window(spec: dict) -> dict:
     per_tenant: dict = {t["name"]: [] for t in TENANTS}
     errors = [0]
     lock = threading.Lock()
-    work = list(range(n))
+    # FIFO dispatch in due order: popping from the tail would have
+    # every worker sleep to the LAST due slot first and then serve the
+    # early slots arbitrarily late — due-slot latency would measure the
+    # dispatch order, not the service.
+    next_k = [0]
     pace_start = time.perf_counter() + 0.05
 
     def worker(wbase: str) -> None:
         session = requests.Session()
         while True:
             with lock:
-                if not work:
+                if next_k[0] >= n:
                     return
-                k = work.pop()
+                k = next_k[0]
+                next_k[0] += 1
             due = pace_start + offsets[k]
             now = time.perf_counter()
             if due > now:
@@ -315,16 +320,28 @@ class Fleet:
 
     def __init__(self, n_masters: int, n_engines: int,
                  native_on: bool, reply_chars: int = 32,
-                 chunk_size: int = 32):
+                 chunk_size: int = 32,
+                 master_extra: "list[str] | None" = None,
+                 engine_specs: "list[list[str]] | None" = None):
         self.n_masters = n_masters
         self.n_engines = n_engines
         self.native_on = native_on
         self.reply_chars = reply_chars
         self.chunk_size = chunk_size
+        # Topology A/B leg hooks: extra master flags (e.g.
+        # --topology-tradeoff) and per-engine extra flags (role + slice
+        # coordinates). engine_specs engines get explicit ports so their
+        # /admin/topology endpoints are scrapeable (engine_bases).
+        self.master_extra = list(master_extra or ())
+        self.engine_specs = engine_specs
+        self.engine_bases: "list[str]" = []
         self.procs: "list[subprocess.Popen]" = []
         self.names: "list[str]" = []
         self.bases: "list[str]" = []
         self.pinned = False
+        # Per-process affinity verdicts (machine-readable isolation
+        # evidence for the artifact): name -> {cpuset, pinned}.
+        self.pin_verdicts: "dict[str, dict]" = {}
 
     def _spawn(self, name: str, cmd: "list[str]", env: dict) -> None:
         logdir = Path(os.environ.get("XLLM_BENCH_LOGDIR", "/tmp"))
@@ -352,24 +369,38 @@ class Fleet:
                          "--http-port", str(http_ports[i]),
                          "--rpc-port", str(rpc_ports[i]),
                          "--load-balance-policy", "RR",
-                         "--telemetry-ingest-mode", "shard"], env)
+                         "--telemetry-ingest-mode", "shard"]
+                        + self.master_extra, env)
             if i == 0 and self.n_masters > 1:
                 time.sleep(0.5)   # deterministic election winner
-        for i in range(self.n_engines):
-            self._spawn(f"engine{i}",
-                        [sys.executable,
-                         str(REPO / "examples" / "run_fake_engine.py"),
-                         "--coordination-addr", f"127.0.0.1:{coord_port}",
-                         "--reply", "x" * self.reply_chars,
-                         "--chunk-size", str(self.chunk_size),
-                         "--delay", "0",
-                         "--telemetry-mode", "mux"], env)
+        n_engines = len(self.engine_specs) \
+            if self.engine_specs is not None else self.n_engines
+        for i in range(n_engines):
+            cmd = [sys.executable,
+                   str(REPO / "examples" / "run_fake_engine.py"),
+                   "--coordination-addr", f"127.0.0.1:{coord_port}",
+                   "--reply", "x" * self.reply_chars,
+                   "--chunk-size", str(self.chunk_size),
+                   "--delay", "0",
+                   "--telemetry-mode", "mux"]
+            if self.engine_specs is not None:
+                eport = free_port()
+                cmd += ["--host", "127.0.0.1", "--port", str(eport)]
+                cmd += self.engine_specs[i]
+                self.engine_bases.append(f"http://127.0.0.1:{eport}")
+            self._spawn(f"engine{i}", cmd, env)
         if plan:
             ok = True
             for name, p in zip(self.names, self.procs):
                 cpuset = plan.get(name) or plan["engines"]
-                ok = pin(p.pid, cpuset, name) and ok
+                pinned = pin(p.pid, cpuset, name)
+                self.pin_verdicts[name] = {"cpuset": sorted(cpuset),
+                                           "pinned": pinned}
+                ok = pinned and ok
             self.pinned = ok
+        else:
+            self.pin_verdicts = {n: {"cpuset": [], "pinned": False}
+                                 for n in self.names}
         self.bases = [f"http://127.0.0.1:{p}" for p in http_ports]
         return self
 
@@ -454,6 +485,24 @@ class Fleet:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def _cpu_isolation(mode: str, reason: str, fleet: "Fleet") -> dict:
+    """Machine-readable isolation record: how many cores the box gave
+    us, which measurement mode that forced, and the per-process affinity
+    verdict — so a trend diff can tell a code regression from a
+    projection artifact produced by a smaller box."""
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = 0
+    return {
+        "cores_available": cores,
+        "mode": mode,
+        "mode_reason": reason,
+        "all_pinned": fleet.pinned,
+        "per_process": fleet.pin_verdicts,
+    }
 
 
 # ------------------------------------------------------------------- one leg
@@ -565,6 +614,7 @@ def run_leg(n_masters: int, args, native_on: bool = True,
             "mode": mode,
             "mode_reason": plan_reason,
             "pinned": fleet.pinned,
+            "cpu_isolation": _cpu_isolation(mode, plan_reason, fleet),
             "agg_req_per_s": agg_rps,
             "served": served,
             "errors": sum(w["errors"] for w in windows),
@@ -581,6 +631,129 @@ def run_leg(n_masters: int, args, native_on: bool = True,
         return leg
     finally:
         fleet.stop()
+
+
+# --------------------------------------------------------- topology A/B legs
+#
+# ISSUE 20's proof: the same 2-slice fleet (1 PREFILL + 1 DECODE on
+# slice-a, 2 DECODE on slice-b) driven twice — topology-aware routing
+# (--topology-tradeoff > 0) vs flat (0) — with the DCN link throttled so
+# a cross-slice KV handoff costs real wall time. The fake engines model
+# the handoff (kv-handoff-bytes-per-token x prompt tokens over the
+# link's bytes/s) as a sleep before the first delta, so client TTFT
+# feels it exactly like a real pull-mode transfer. Evidence per leg:
+# client TTFT p50/p95, the master's pair-link census
+# (/admin/hotpath -> telemetry.topology.pair_links), and per-engine
+# modeled handoff p50/p95 by link class (/admin/topology).
+
+TOPO_ENGINE_SPECS = (
+    ["--type", "PREFILL", "--slice-id", "slice-a", "--topo-host", "host-a0"],
+    ["--type", "DECODE", "--slice-id", "slice-a", "--topo-host", "host-a1"],
+    ["--type", "DECODE", "--slice-id", "slice-b", "--topo-host", "host-b0"],
+    ["--type", "DECODE", "--slice-id", "slice-b", "--topo-host", "host-b1"],
+)
+
+
+def run_topo_leg(args, tradeoff: float, label: str) -> dict:
+    throttle = ["--kv-handoff-bytes-per-token", str(args.topo_kv_bytes),
+                "--ici-bytes-per-s", str(args.topo_ici_bytes_per_s),
+                "--dcn-bytes-per-s", str(args.topo_dcn_bytes_per_s)]
+    specs = [spec + throttle for spec in TOPO_ENGINE_SPECS]
+    plan, plan_reason = plan_cpu_sets(1)
+    mode = "pinned-concurrent" if plan else "phased-projection"
+    fleet = Fleet(1, len(specs), native_on=True,
+                  reply_chars=args.reply_chars,
+                  chunk_size=args.chunk_size,
+                  master_extra=["--topology-tradeoff", str(tradeoff)],
+                  engine_specs=specs).start(plan)
+    try:
+        fleet.wait_ready()
+        spec = {
+            "bases": fleet.bases,
+            "requests": args.topo_requests,
+            "concurrency": args.topo_concurrency,
+            "rps": args.topo_rps, "traffic": "steady",
+            "streams": args.streams,
+            "prompt_scale": args.topo_prompt_scale,
+            "seed": 0x21,
+            "warmup": True,
+        }
+        window = _spawn_driver(spec, plan["driver"] if plan else None)
+        # Pair-link census from the master (authoritative: every
+        # SCHEDULE's prefill->decode link class).
+        pair_links: dict = {}
+        try:
+            hot = requests.get(fleet.bases[0] + "/admin/hotpath",
+                               timeout=5).json()
+            pair_links = ((hot.get("telemetry") or {})
+                          .get("topology") or {}).get("pair_links") or {}
+        except (requests.RequestException, ValueError):
+            _warn("could not scrape /admin/hotpath pair_links")
+        # Modeled-handoff latencies from the engines, by link class.
+        by_link: "dict[str, list[float]]" = {}
+        for base in fleet.engine_bases:
+            try:
+                t = requests.get(base + "/admin/topology", timeout=5).json()
+            except (requests.RequestException, ValueError):
+                continue
+            for row in t.get("handoffs", ()):
+                by_link.setdefault(row["link"], []).append(row["ms"])
+        split = {link: n for link, n in pair_links.items()
+                 if link in ("local", "ici", "dcn")}
+        total_split = sum(split.values())
+        same = split.get("local", 0) + split.get("ici", 0)
+        handoffs = [ms for rows in by_link.values() for ms in rows]
+        return {
+            "label": label,
+            "topology_tradeoff": tradeoff,
+            "engines": [" ".join(s) for s in TOPO_ENGINE_SPECS],
+            "mode": mode,
+            "cpu_isolation": _cpu_isolation(mode, plan_reason, fleet),
+            "window": window,
+            "pair_links": pair_links,
+            "same_slice_pair_share": round(same / total_split, 4)
+            if total_split else 0.0,
+            "handoff_ms_by_link": {
+                link: {"n": len(v),
+                       "p50": round(percentile(v, 50), 2),
+                       "p95": round(percentile(v, 95), 2)}
+                for link, v in sorted(by_link.items())},
+            "handoff_ms": {"n": len(handoffs),
+                           "p50": round(percentile(handoffs, 50), 2),
+                           "p95": round(percentile(handoffs, 95), 2)},
+        }
+    finally:
+        fleet.stop()
+
+
+def run_topo(args) -> dict:
+    _info(f"topo leg: flat routing (tradeoff=0, DCN throttled to "
+          f"{args.topo_dcn_bytes_per_s:g} B/s)")
+    flat = run_topo_leg(args, 0.0, "flat")
+    _info("topo leg: topology-aware routing "
+          f"(tradeoff={args.topo_tradeoff:g})")
+    topo_leg = run_topo_leg(args, args.topo_tradeoff, "topo")
+    flat_p50 = flat["window"]["ttft_ms"]["p50"]
+    topo_p50 = topo_leg["window"]["ttft_ms"]["p50"]
+    headline = {
+        # Higher-is-better keys carry no unit suffix on purpose:
+        # bench_trend auto-tracks every headline leaf and infers the
+        # regression direction from the suffix.
+        "topo_ttft_p50_speedup": round(flat_p50 / max(0.01, topo_p50), 2),
+        "same_slice_pair_share": topo_leg["same_slice_pair_share"],
+        "topo_ttft_p50_ms": topo_p50,
+        "topo_handoff_p95_ms": topo_leg["handoff_ms"]["p95"],
+    }
+    return {
+        "bench": "topo",
+        "kv_handoff_bytes_per_token": args.topo_kv_bytes,
+        "ici_bytes_per_s": args.topo_ici_bytes_per_s,
+        "dcn_bytes_per_s": args.topo_dcn_bytes_per_s,
+        "requests_per_leg": args.topo_requests,
+        "offered_rps": args.topo_rps,
+        "legs": [flat, topo_leg],
+        "headline": headline,
+    }
 
 
 # ---------------------------------------------------------------------- main
@@ -683,6 +856,36 @@ def main() -> None:
     ap.add_argument("--burst-every", type=float, default=10.0)
     ap.add_argument("--burst-len", type=float, default=2.0)
     ap.add_argument("--burst-mult", type=float, default=4.0)
+    ap.add_argument("--topo", action="store_true",
+                    help="run the ICI-topology A/B instead of the fleet "
+                         "scaling suite: topology-aware vs flat routing "
+                         "over a 2-slice fleet with the DCN link "
+                         "throttled (artifact family BENCH_topo_*)")
+    ap.add_argument("--topo-tradeoff", type=float, default=0.25,
+                    help="--topology-tradeoff for the topo-aware leg")
+    ap.add_argument("--topo-requests", type=int, default=90,
+                    help="requests per topo A/B leg")
+    ap.add_argument("--topo-rps", type=float, default=3.0,
+                    help="steady open-loop rate for the topo legs (sub-"
+                         "capacity even on a 1-core box: the A/B "
+                         "isolates link cost, not queueing)")
+    ap.add_argument("--topo-concurrency", type=int, default=6,
+                    help="driver workers for the topo legs (enough to "
+                         "cover rps x worst DCN sleep without going "
+                         "closed-loop)")
+    ap.add_argument("--topo-prompt-scale", type=float, default=0.1,
+                    help="prompt scale for the topo legs: keeps the "
+                         "throttled-DCN handoff in the ~100ms-1s band "
+                         "(the batch tenant's full 24k-token payload "
+                         "would sleep >10s per cross-slice request)")
+    ap.add_argument("--topo-kv-bytes", type=int, default=1024,
+                    help="modeled KV payload per prompt token for the "
+                         "topo legs")
+    ap.add_argument("--topo-ici-bytes-per-s", type=float, default=2e8,
+                    help="modeled ICI bandwidth for the topo legs")
+    ap.add_argument("--topo-dcn-bytes-per-s", type=float, default=2e6,
+                    help="modeled (throttled) DCN bandwidth for the "
+                         "topo legs")
     ap.add_argument("--out", default=None,
                     help="write the artifact here (stdout otherwise)")
     args = ap.parse_args()
@@ -690,7 +893,7 @@ def main() -> None:
         # Driver-process mode: one measurement window, JSON on stdout.
         print(json.dumps(drive_window(json.loads(args.drive))))
         return
-    report = run(args)
+    report = run_topo(args) if args.topo else run(args)
     text = json.dumps(report, indent=2)
     if args.out:
         Path(args.out).write_text(text + "\n")

@@ -71,6 +71,27 @@ def main() -> None:
                         "unreachable (static stability); off = legacy "
                         "behavior (no resolvable target, no beats — the "
                         "outage bench's control leg)")
+    p.add_argument("--slice-id", default="fake-slice",
+                   help="TPU slice/pod coordinate; same-slice PD handoffs "
+                        "are ICI-classed, cross-slice DCN "
+                        "(docs/topology.md)")
+    p.add_argument("--topo-host", default="",
+                   help="physical host coordinate; non-empty marks this "
+                        "instance PLACED for topology-aware routing "
+                        "('' = legacy flat behavior)")
+    p.add_argument("--topo-chip", type=int, default=-1,
+                   help="chip index within --topo-host (-1 = unpinned)")
+    p.add_argument("--kv-handoff-bytes-per-token", type=int, default=0,
+                   help="modeled PD KV payload per prompt token: split-"
+                        "pair dispatches sleep the link-classed wire "
+                        "time before the first delta (0 = no modeled "
+                        "handoff — the topo bench's load-bearing knob)")
+    p.add_argument("--ici-bytes-per-s", type=float, default=0.0,
+                   help="modeled ICI bandwidth for the handoff sleep "
+                        "(0 = class default)")
+    p.add_argument("--dcn-bytes-per-s", type=float, default=0.0,
+                   help="modeled DCN bandwidth for the handoff sleep "
+                        "(0 = class default)")
     args = p.parse_args()
 
     rate = max(0.0, args.service_rate)
@@ -88,7 +109,13 @@ def main() -> None:
         heartbeat_interval_s=max(0.05, args.heartbeat_interval),
         lease_ttl_s=max(0.2, args.lease_ttl),
         telemetry_mode=args.telemetry_mode,
-        degraded_mode=args.degraded_mode)
+        degraded_mode=args.degraded_mode,
+        slice_id=args.slice_id,
+        topo_host=args.topo_host,
+        topo_chip=args.topo_chip,
+        kv_handoff_bytes_per_token=max(0, args.kv_handoff_bytes_per_token),
+        ici_bytes_per_s=max(0.0, args.ici_bytes_per_s),
+        dcn_bytes_per_s=max(0.0, args.dcn_bytes_per_s))
     ).start()
     print(f"fake engine {engine.name} ({args.type}) registered; Ctrl-C to stop",
           flush=True)

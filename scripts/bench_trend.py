@@ -72,6 +72,11 @@ TRACKED: dict[str, list[tuple[str, bool]]] = {
         ("headline.agg_rps_masters_4", True),
         ("headline.masters_4_over_1_scaling", True),
     ],
+    "topo": [
+        ("headline.topo_ttft_p50_speedup", True),
+        ("headline.same_slice_pair_share", True),
+        ("headline.topo_handoff_p95_ms", False),
+    ],
 }
 
 _NAME_RE = re.compile(r"^BENCH_(?:([a-z0-9]+)_)?r(\d+)\.json$")

@@ -75,6 +75,14 @@ run_xlint support --support tests benchmarks
 echo "== bench trend (headline-metric regression tripwire, >10% fails) =="
 python scripts/bench_trend.py
 
+echo "== topology plane under LOCK+RCU+STATE instrumentation =="
+# The placement plane touches every shared-state surface at once
+# (routing snapshot, metrics census, controller census, chaos drill) —
+# run its suite with all three runtime verifiers armed so a discipline
+# regression fails here, not in a soak.
+JAX_PLATFORMS=cpu XLLM_LOCK_DEBUG=1 XLLM_RCU_DEBUG=1 XLLM_STATE_DEBUG=1 \
+    python -m pytest tests/test_topology.py -q -p no:cacheprovider
+
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check =="
     ruff check xllm_service_tpu tests benchmarks scripts

@@ -84,6 +84,8 @@ def make_meta(name: str, itype: InstanceType = InstanceType.MIX,
         name=name, rpc_address=name, type=itype,
         incarnation_id=kw.pop("incarnation_id", uuid.uuid4().hex[:8]),
         topology=TpuTopology(slice_id=kw.pop("slice_id", "s0"),
+                             host=kw.pop("topo_host", ""),
+                             chip=kw.pop("topo_chip", -1),
                              mesh_shape=[1], axis_names=["data"]),
         **kw)
 

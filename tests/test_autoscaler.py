@@ -283,8 +283,10 @@ class RecordingActuator(FleetActuator):
         self.reaps: list[str] = []
         self._result = scale_out_result   # None = echo count
 
-    def scale_out(self, count, reason):
+    def scale_out(self, count, reason, slice_id=""):
         self.scale_outs.append((count, reason))
+        self.scale_out_slices = getattr(self, "scale_out_slices", [])
+        self.scale_out_slices.append(slice_id)
         return count if self._result is None else self._result
 
     def scale_in(self, instance, reason):
@@ -844,11 +846,16 @@ class FakeEngineActuator(FleetActuator):
         self._store = store
         self._cfg_kw = cfg_kw
         self.engines: dict[str, FakeEngine] = {}
+        self.scale_out_slices: list[str] = []
 
-    def scale_out(self, count, reason):
+    def scale_out(self, count, reason, slice_id=""):
+        self.scale_out_slices.append(slice_id)
         for _ in range(count):
+            kw = dict(self._cfg_kw)
+            if slice_id:
+                kw["slice_id"] = slice_id
             e = FakeEngine(InMemoryCoordination(self._store),
-                           FakeEngineConfig(**self._cfg_kw)).start()
+                           FakeEngineConfig(**kw)).start()
             self.engines[e.name] = e
         return count
 

@@ -119,7 +119,13 @@ def _await_fleet(masters, engines) -> None:
             for m in masters), timeout=20)
 
 
-def _stream(m: Master, okey=None, after_frames=0, hook=None, timeout=90):
+def _stream(m: Master, okey=None, after_frames=0, hook=None, timeout=90,
+            want_sid=False):
+    """Returns the streamed text; with ``want_sid`` a ``(text, sid)``
+    pair, where sid is the X-Request-Id header — the internal service
+    id the tracer records — so tests can scope trace assertions to THIS
+    request instead of the shared global store (straggler spans from a
+    prior test's killed masters make globally-empty checks flaky)."""
     body = {"model": "fake-model", "prompt": "fleet", "stream": True,
             "max_tokens": 1000}
     if okey is not None:
@@ -127,6 +133,7 @@ def _stream(m: Master, okey=None, after_frames=0, hook=None, timeout=90):
     r = requests.post(_base(m) + "/v1/completions", json=body,
                       stream=True, timeout=timeout)
     assert r.status_code == 200, r.text
+    sid = r.headers.get("X-Request-Id", "")
     text, n, fired = "", 0, False
     for line in r.iter_lines():
         if not line.startswith(b"data: "):
@@ -143,21 +150,18 @@ def _stream(m: Master, okey=None, after_frames=0, hook=None, timeout=90):
         if hook is not None and not fired and n >= after_frames:
             fired = True
             hook()
-    return text
+    return (text, sid) if want_sid else text
 
 
-def _completion(m: Master, max_tokens=50) -> str:
+def _completion(m: Master, max_tokens=50, want_sid=False):
     r = requests.post(_base(m) + "/v1/completions", json={
         "model": "fake-model", "prompt": "fleet",
         "max_tokens": max_tokens}, timeout=30)
     assert r.status_code == 200, r.text
-    return r.json()["choices"][0]["text"]
-
-
-def _latest_sid(m: Master) -> str:
-    rec = requests.get(_base(m) + "/admin/trace/recent", timeout=5).json()
-    return next(r["request_id"] for r in rec["traces"]
-                if r["request_id"].startswith("completion-"))
+    text = r.json()["choices"][0]["text"]
+    if want_sid:
+        return text, r.headers.get("X-Request-Id", "")
+    return text
 
 
 def _fleet_trace(m: Master, **params):
@@ -442,22 +446,27 @@ class TestTailSampling:
         engines = [_engine(store), _engine(store)]
         try:
             _await_fleet([master], engines)
-            assert _stream(master) == REPLY
+            # Per-request scoping (not a globally-empty store check —
+            # straggler spans from earlier tests' killed masters can
+            # land in the shared ring at any point): the clean request's
+            # OWN id must never be recorded at sample_rate=0.
+            clean, clean_sid = _stream(master, want_sid=True)
+            assert clean == REPLY and clean_sid
             time.sleep(0.3)
-            assert requests.get(_base(master) + "/admin/trace/recent",
-                                timeout=5).json()["traces"] == []
+            recent = requests.get(_base(master) + "/admin/trace/recent",
+                                  timeout=5).json()["traces"]
+            assert clean_sid not in {r["request_id"] for r in recent}
             FAULTS.configure([dict(point="engine.token", action="crash",
                                    after=4, max_fires=1)], seed=SEED)
-            assert _stream(master) == REPLY
+            text, sid = _stream(master, want_sid=True)
+            assert text == REPLY and sid
 
             def kept():
                 rows = requests.get(
                     _base(master) + "/admin/trace/recent",
                     timeout=5).json()["traces"]
-                return [r for r in rows
-                        if r["request_id"].startswith("completion-")]
-            assert wait_until(lambda: kept(), timeout=10)
-            sid = kept()[0]["request_id"]
+                return any(r["request_id"] == sid for r in rows)
+            assert wait_until(kept, timeout=10)
             got = requests.get(_base(master) + "/admin/trace",
                                params={"request_id": sid}, timeout=5).json()
             points = {s["point"] for s in got["spans"]}
@@ -488,12 +497,16 @@ class TestFleetTraceFederation:
                                  m2.scheduler.self_addr)
             FAULTS.configure([dict(point="engine.token", action="crash",
                                    after=4, max_fires=1)], seed=SEED)
-            assert _stream(m1, okey=okey) == REPLY
+            text, sid = _stream(m1, okey=okey, want_sid=True)
+            assert text == REPLY and sid
+            # Wait for THIS request's trace (not "any trace": a prior
+            # test's straggler span would satisfy that immediately).
             assert wait_until(
-                lambda: requests.get(
-                    _base(m1) + "/admin/trace/recent",
-                    timeout=5).json()["traces"], timeout=10)
-            sid = _latest_sid(m1)
+                lambda: any(
+                    r["request_id"] == sid
+                    for r in requests.get(
+                        _base(m1) + "/admin/trace/recent",
+                        timeout=5).json()["traces"]), timeout=10)
 
             def fleet_has_failover():
                 doc = _fleet_trace(m1, request_id=sid).json()
@@ -550,8 +563,8 @@ class TestFleetTraceFederation:
         coord = InMemoryCoordination(store)
         try:
             _await_fleet([master], [engine])
-            assert _completion(master) == REPLY
-            sid = _latest_sid(master)
+            text, sid = _completion(master, want_sid=True)
+            assert text == REPLY and sid
             local = requests.get(_base(master) + "/admin/trace",
                                  params={"request_id": sid},
                                  timeout=5).json()
@@ -597,8 +610,8 @@ class TestFleetTraceFederation:
         engines = [_engine(store), _engine(store)]
         try:
             _await_fleet([master], engines)
-            assert _completion(master) == REPLY
-            sid = _latest_sid(master)
+            text, sid = _completion(master, want_sid=True)
+            assert text == REPLY and sid
             victim = next(e for e in engines
                           if any(s["instance"] == e.name for s in
                                  requests.get(

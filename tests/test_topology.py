@@ -1,0 +1,599 @@
+"""ICI-topology placement plane (ISSUE 20).
+
+Five layers, cheapest first:
+
+1. the pure link-cost kernel (`common/topology.py`) as input->output
+   tables — placement semantics, link classes, budget fallbacks, the
+   KV-layout payload estimate, the armed bit;
+2. routing consumers in-process: RR's same-slice decode pool, CAR's
+   `topology_tradeoff` boundary, the SLO policy's cheapest-link-first
+   scan + modeled transfer time, the scheduled pair-link census —
+   each with a FLAT control proving dormancy (zero routing change);
+3. the autoscaler controller's lost-slice census: a replacement
+   scale-out targets the slice the failure emptied, and a flat fleet's
+   spawn commands carry no slice id;
+4. the slice-death chaos drill: a whole slice dies hard and the fleet
+   re-converges onto survivor same-slice pairs with ZERO survivor
+   SUSPECT transitions (no detector storm) and streams still serving.
+
+`scripts/check.sh` re-runs this file under combined LOCK+RCU+STATE
+instrumentation — the census/counter paths must hold their declared
+lock disciplines (devtools/ownership.py).
+"""
+
+import pytest
+import requests
+
+from xllm_service_tpu.common import topology as topo
+from xllm_service_tpu.common.config import ServiceOptions
+from xllm_service_tpu.common.request import Request
+from xllm_service_tpu.common.types import (
+    InstanceRuntimeState,
+    InstanceType,
+    LatencyMetrics,
+    LoadMetrics,
+    RequestAction,
+    Routing,
+    TpuTopology,
+)
+from xllm_service_tpu.autoscaler.actuator import FleetActuator
+from xllm_service_tpu.coordination.memory import InMemoryCoordination
+from xllm_service_tpu.master import Master
+from xllm_service_tpu.scheduler.global_kvcache_mgr import GlobalKVCacheMgr
+from xllm_service_tpu.scheduler.instance_mgr import InstanceMgr
+from xllm_service_tpu.scheduler.policies import create_policy
+from xllm_service_tpu.scheduler.policies.slo_aware import select_pair_on_slo
+from xllm_service_tpu.testing.fake_engine import FakeEngine, FakeEngineConfig
+
+from fakes import FakeChannel, make_meta, wait_until
+
+BLOCK = 16
+
+
+@pytest.fixture()
+def coord(store):
+    c = InMemoryCoordination(store)
+    yield c
+    c.close()
+
+
+@pytest.fixture(autouse=True)
+def _reset_channels():
+    FakeChannel.reset()
+    yield
+    FakeChannel.reset()
+
+
+def _opts(**kw) -> ServiceOptions:
+    base = dict(block_size=BLOCK, reconcile_interval_s=0.05)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def _coord_of(slice_id, host, chip=-1):
+    return topo.effective_coord(
+        TpuTopology(slice_id=slice_id, host=host, chip=chip), "n:1")
+
+
+# ---------------------------------------------------------------------------
+# 1) The pure kernel, as tables.
+# ---------------------------------------------------------------------------
+class TestKernel:
+    @pytest.mark.parametrize("a,b,expect", [
+        # Same host: the handoff never leaves the machine.
+        (("s0", "h0"), ("s0", "h0"), topo.LINK_LOCAL),
+        # Same host wins even across declared slices (host is physical).
+        (("s0", "h0"), ("s1", "h0"), topo.LINK_LOCAL),
+        # Same slice, different host: ICI.
+        (("s0", "h0"), ("s0", "h1"), topo.LINK_ICI),
+        # Different slices: DCN, the slow path.
+        (("s0", "h0"), ("s1", "h1"), topo.LINK_DCN),
+    ])
+    def test_link_class_table(self, a, b, expect):
+        assert topo.link_class(_coord_of(*a), _coord_of(*b)) == expect
+
+    def test_link_class_empty_slices_are_dcn(self):
+        # Degenerate coords (no slice, no host) must not accidentally
+        # classify as matching: "" == "" is not a locality claim.
+        assert topo.link_class(topo.Coord("", ""),
+                               topo.Coord("", "")) == topo.LINK_DCN
+
+    @pytest.mark.parametrize("name,slice_id,host,want", [
+        # Operator-placed: host set => placed, declared slice kept.
+        ("10.0.0.1:9000", "slice-a", "host-a0",
+         topo.Coord("slice-a", "host-a0", -1, True)),
+        # Host set, slice empty => per-host slice, still PLACED.
+        ("10.0.0.1:9000", "", "host-a0",
+         topo.Coord("host:host-a0", "host-a0", -1, True)),
+        # Unplaced (no host): synthetic per-host coordinate from the
+        # registry name; slice_id alone never places (agents have always
+        # defaulted slice_id, so keying off it would re-route every
+        # existing deployment).
+        ("10.0.0.1:9000", "slice-a", "",
+         topo.Coord("host:10.0.0.1", "10.0.0.1", -1, False)),
+    ])
+    def test_effective_coord_table(self, name, slice_id, host, want):
+        got = topo.effective_coord(
+            TpuTopology(slice_id=slice_id, host=host), name)
+        assert got == want
+
+    def test_effective_coord_none_topology(self):
+        got = topo.effective_coord(None, "box:8000")
+        assert got == topo.Coord("host:box", "box", -1, False)
+
+    def test_transfer_cost_zero_budget_uses_class_defaults(self):
+        # Budget 0 = account-only on the engine side; the kernel falls
+        # back to class defaults so the ordering local < ici < dcn
+        # survives on unthrottled fleets.
+        n = 10 ** 9
+        local = topo.transfer_cost(n, topo.LINK_LOCAL)
+        ici = topo.transfer_cost(n, topo.LINK_ICI)
+        dcn = topo.transfer_cost(n, topo.LINK_DCN)
+        assert 0 < local < ici < dcn
+        assert ici == pytest.approx(n / topo.DEFAULT_BYTES_PER_S["ici"])
+
+    def test_transfer_cost_budget_overrides(self):
+        assert topo.transfer_cost(1000, topo.LINK_ICI,
+                                  ici_bytes_per_s=500.0) \
+            == pytest.approx(2.0)
+        assert topo.transfer_cost(1000, topo.LINK_DCN,
+                                  dcn_bytes_per_s=250.0) \
+            == pytest.approx(4.0)
+        # local ignores both budgets: the accountant has no intra-host
+        # budget to borrow.
+        assert topo.transfer_cost(1000, topo.LINK_LOCAL,
+                                  ici_bytes_per_s=1.0,
+                                  dcn_bytes_per_s=1.0) \
+            == pytest.approx(1000 / topo.DEFAULT_BYTES_PER_S["local"])
+
+    @pytest.mark.parametrize("nbytes", [0, -5])
+    def test_transfer_cost_nonpositive_is_free(self, nbytes):
+        assert topo.transfer_cost(nbytes, topo.LINK_DCN) == 0.0
+
+    @pytest.mark.parametrize("dtype,itemsize", [
+        ("bfloat16", 2), ("float16", 2), ("float32", 4),
+        ("int8", 1), ("fp8_e4m3", 1), ("", 2),
+    ])
+    def test_kv_handoff_bytes_dtype_table(self, dtype, itemsize):
+        meta = make_meta("e1", num_layers=4, num_kv_heads=8, head_dim=128,
+                         kv_dtype=dtype)
+        # 2 (K+V) * layers * heads * head_dim * itemsize * tokens
+        assert topo.kv_handoff_bytes(meta, 10) \
+            == 2 * 4 * 8 * 128 * itemsize * 10
+
+    def test_kv_handoff_bytes_unadvertised_layout_is_zero(self):
+        # Fake engines advertise no KV layout: callers substitute their
+        # own modeled payload.
+        assert topo.kv_handoff_bytes(make_meta("e1"), 10) == 0
+        assert topo.kv_handoff_bytes(None, 10) == 0
+        assert topo.kv_handoff_bytes(
+            make_meta("e1", num_layers=4, num_kv_heads=8, head_dim=128), 0) \
+            == 0
+
+    def test_fleet_topo_active(self):
+        a0 = topo.Coord("slice-a", "h0", placed=True)
+        a1 = topo.Coord("slice-a", "h1", placed=True)
+        b0 = topo.Coord("slice-b", "h2", placed=True)
+        assert not topo.fleet_topo_active([])
+        assert not topo.fleet_topo_active([a0, a1])
+        assert topo.fleet_topo_active([a0, a1, b0])
+
+    def test_link_penalty_ordering(self):
+        assert topo.link_penalty(topo.LINK_LOCAL) == 0.0
+        assert topo.link_penalty(topo.LINK_LOCAL) \
+            < topo.link_penalty(topo.LINK_ICI) \
+            < topo.link_penalty(topo.LINK_DCN)
+        # Unknown classes cost like the slow path, never like a freebie.
+        assert topo.link_penalty("unknown") \
+            == topo.link_penalty(topo.LINK_DCN)
+
+
+# ---------------------------------------------------------------------------
+# 2) Routing consumers over a live InstanceMgr (fake channels).
+# ---------------------------------------------------------------------------
+def _placed_fleet(coord, opts=None):
+    """One prefill on slice-a, one same-slice decode, two cross-slice
+    decodes — the DCN decodes register FIRST so the legacy scan order
+    (registration order) would pick a cross-slice partner."""
+    mgr = InstanceMgr(coord, opts or _opts(), start_threads=False,
+                      channel_factory=FakeChannel.factory)
+    mgr.register_instance(
+        make_meta("pa", InstanceType.PREFILL,
+                  slice_id="slice-a", topo_host="host-a0"),
+        link_peers=False)
+    mgr.register_instance(
+        make_meta("dfar", InstanceType.DECODE,
+                  slice_id="slice-b", topo_host="host-b0"),
+        link_peers=False)
+    mgr.register_instance(
+        make_meta("dfar2", InstanceType.DECODE,
+                  slice_id="slice-b", topo_host="host-b1"),
+        link_peers=False)
+    mgr.register_instance(
+        make_meta("dnear", InstanceType.DECODE,
+                  slice_id="slice-a", topo_host="host-a1"),
+        link_peers=False)
+    return mgr
+
+
+def _flat_fleet(coord, opts=None):
+    """Same shape, no placement: every meta keeps the default empty
+    topo_host, so all coordinates are synthetic."""
+    mgr = InstanceMgr(coord, opts or _opts(), start_threads=False,
+                      channel_factory=FakeChannel.factory)
+    mgr.register_instance(make_meta("pa", InstanceType.PREFILL),
+                          link_peers=False)
+    for n in ("dfar", "dfar2", "dnear"):
+        mgr.register_instance(make_meta(n, InstanceType.DECODE),
+                              link_peers=False)
+    return mgr
+
+
+def _heartbeat_all(mgr, **per_name_loads):
+    for meta in mgr.list_instances():
+        mgr.record_instance_heartbeat(
+            meta.name, meta.incarnation_id,
+            per_name_loads.get(meta.name, LoadMetrics()), LatencyMetrics())
+
+
+class TestRoutingConsumers:
+    def test_rr_pairs_within_prefill_slice(self, coord):
+        mgr = _placed_fleet(coord)
+        decodes = {mgr.get_next_instance_pair().decode_name
+                   for _ in range(6)}
+        # RR carries no load signal, so locality simply wins: every pair
+        # stays on the prefill's slice.
+        assert decodes == {"dnear"}
+        mgr.stop()
+
+    def test_rr_falls_back_fleetwide_when_slice_has_no_decode(self, coord):
+        mgr = InstanceMgr(coord, _opts(), start_threads=False,
+                          channel_factory=FakeChannel.factory)
+        mgr.register_instance(
+            make_meta("pa", InstanceType.PREFILL,
+                      slice_id="slice-a", topo_host="host-a0"),
+            link_peers=False)
+        for i, n in enumerate(("d1", "d2")):
+            mgr.register_instance(
+                make_meta(n, InstanceType.DECODE,
+                          slice_id="slice-b", topo_host=f"host-b{i}"),
+                link_peers=False)
+        decodes = {mgr.get_next_instance_pair().decode_name
+                   for _ in range(4)}
+        assert decodes == {"d1", "d2"}   # no local decode: full RR pool
+        mgr.stop()
+
+    def test_rr_flat_fleet_unchanged(self, coord):
+        # Dormancy: an unplaced fleet keeps the legacy fleet-wide RR even
+        # with the tradeoff knob at its non-zero default.
+        mgr = _flat_fleet(coord)
+        decodes = [mgr.get_next_instance_pair().decode_name
+                   for _ in range(6)]
+        assert set(decodes) == {"dfar", "dfar2", "dnear"}
+        mgr.stop()
+
+    def test_rr_knob_zero_disarms_placed_fleet(self, coord):
+        mgr = _placed_fleet(coord, _opts(topology_tradeoff=0.0))
+        decodes = {mgr.get_next_instance_pair().decode_name
+                   for _ in range(6)}
+        assert decodes == {"dfar", "dfar2", "dnear"}
+        mgr.stop()
+
+    # -- CAR: the tradeoff knob is a score-unit boundary -------------------
+    def _car(self, coord, tradeoff, waiting_near=2):
+        opts = _opts(max_waiting_requests=10, topology_tradeoff=tradeoff)
+        mgr = _placed_fleet(coord, opts)
+        kv = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        # Fresh telemetry everywhere (the stale-discount set must stay
+        # empty); the same-slice decode carries the queue.
+        _heartbeat_all(mgr, dnear=LoadMetrics(
+            waiting_requests_num=waiting_near))
+        policy = create_policy("CAR", mgr, kv, opts)
+        r = policy.select_instances_pair(
+            Request(token_ids=list(range(BLOCK * 2))))
+        mgr.stop()
+        return r
+
+    def test_car_same_slice_wins_within_knob(self, coord):
+        # dnear is docked waiting/max_waiting = 0.2 score units; the DCN
+        # candidates are docked tradeoff * (penalty_dcn - penalty_ici)
+        # ~= 0.97 * t relative to it. t = 0.25 => 0.2425 > 0.2: locality
+        # absorbs the load skew.
+        r = self._car(coord, tradeoff=0.25)
+        assert r.prefill_name == "pa"
+        assert r.decode_name == "dnear"
+
+    def test_car_load_skew_beyond_knob_pays_dcn(self, coord):
+        # t = 0.15 => 0.1455 < 0.2: the load advantage exceeds the knob
+        # and the cross-slice candidate wins — the knob is a boundary,
+        # not a veto.
+        r = self._car(coord, tradeoff=0.15)
+        assert r.decode_name in ("dfar", "dfar2")
+
+    def test_car_knob_zero_is_legacy_scoring(self, coord):
+        r = self._car(coord, tradeoff=0.0)
+        assert r.decode_name in ("dfar", "dfar2")
+
+    def test_car_flat_fleet_ignores_knob(self, coord):
+        # Unplaced fleet: every candidate pays the same synthetic-DCN
+        # penalty, so the knob cannot change the argmax.
+        opts = _opts(max_waiting_requests=10, topology_tradeoff=0.25)
+        mgr = _flat_fleet(coord, opts)
+        kv = GlobalKVCacheMgr(coord, block_size=BLOCK)
+        _heartbeat_all(mgr, dnear=LoadMetrics(waiting_requests_num=2))
+        policy = create_policy("CAR", mgr, kv, opts)
+        r = policy.select_instances_pair(
+            Request(token_ids=list(range(BLOCK * 2))))
+        assert r.decode_name in ("dfar", "dfar2")   # least loaded, as ever
+        mgr.stop()
+
+    # -- SLO: cheapest-link-first scan + modeled transfer ------------------
+    def test_slo_scans_cheapest_link_first(self, coord):
+        # Registration order puts the DCN decodes first; without the
+        # topology sort the first candidate meeting the TPOT target is
+        # "dfar". With it, the ICI partner is scanned first.
+        opts = _opts(topology_kv_bytes_per_token=1024,
+                     topology_ici_bytes_per_s=1e6,
+                     topology_dcn_bytes_per_s=1e5)
+        mgr = _placed_fleet(coord, opts)
+        req = Request(token_ids=list(range(32)))
+        r = select_pair_on_slo(mgr, opts, req, flip_sink=lambda *a: None)
+        assert (r.prefill_name, r.decode_name) == ("pa", "dnear")
+        # Predicted TTFT carries the modeled wire time for the chosen
+        # pair: 32 tok * 1024 B / 1e6 B/s = 32.77 ms.
+        assert req.metrics.estimated_ttft_ms \
+            == pytest.approx(32.768, rel=0.01)
+        mgr.stop()
+
+    def test_slo_knob_zero_keeps_legacy_scan_order(self, coord):
+        opts = _opts(topology_tradeoff=0.0,
+                     topology_kv_bytes_per_token=1024)
+        mgr = _placed_fleet(coord, opts)
+        req = Request(token_ids=list(range(32)))
+        r = select_pair_on_slo(mgr, opts, req, flip_sink=lambda *a: None)
+        assert r.decode_name == "dfar"   # first registered, legacy order
+        # No transfer model joins the estimate when the knob is off (and
+        # the unfitted predictor contributes 0).
+        assert req.metrics.estimated_ttft_ms == 0.0
+        mgr.stop()
+
+    # -- pair-link census --------------------------------------------------
+    def test_scheduled_pair_link_census(self, coord):
+        mgr = _placed_fleet(coord)
+
+        def sched(p, d):
+            req = Request(token_ids=list(range(8)))
+            req.routing = Routing(prefill_name=p, decode_name=d)
+            mgr.update_request_metrics(req, RequestAction.SCHEDULE)
+
+        sched("pa", "dnear")    # same slice, different host -> ici
+        sched("pa", "dfar")     # cross slice -> dcn
+        sched("pa", "dfar")
+        sched("pa", "pa")       # collapsed pair -> mix
+        assert mgr.pair_link_counts() == {"ici": 1, "dcn": 2, "mix": 1}
+        assert mgr.stats()["topology"]["pair_links"] \
+            == {"ici": 1, "dcn": 2, "mix": 1}
+        mgr.stop()
+
+    def test_snapshot_exports_topology_view(self, coord):
+        mgr = _placed_fleet(coord)
+        snap = mgr.routing_snapshot()
+        assert snap.topo_active
+        assert snap.coords["pa"] \
+            == topo.Coord("slice-a", "host-a0", -1, True)
+        assert set(snap.decode_by_slice["slice-a"]) == {"dnear"}
+        assert set(snap.decode_by_slice["slice-b"]) == {"dfar", "dfar2"}
+        stats = mgr.stats()["topology"]
+        assert stats["active"]
+        assert stats["coords"]["dnear"]["slice_id"] == "slice-a"
+        mgr.stop()
+
+    def test_flat_snapshot_stays_dormant(self, coord):
+        mgr = InstanceMgr(coord, _opts(), start_threads=False,
+                          channel_factory=FakeChannel.factory)
+        # One-box flat fleet: names share the host part, so all synthetic
+        # coordinates collapse into one slice.
+        for i, t in enumerate((InstanceType.PREFILL, InstanceType.DECODE,
+                               InstanceType.DECODE)):
+            mgr.register_instance(
+                make_meta(f"127.0.0.1:{9000 + i}", t), link_peers=False)
+        snap = mgr.routing_snapshot()
+        assert not snap.topo_active
+        assert all(not c.placed for c in snap.coords.values())
+        mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# 3) Controller: replacement spawns target the slice that lost capacity.
+# ---------------------------------------------------------------------------
+class _SliceRecordingActuator(FleetActuator):
+    name = "slice-recording"
+
+    def __init__(self):
+        self.calls: list[tuple[int, str]] = []   # (count, slice_id)
+
+    def scale_out(self, count, reason, slice_id=""):
+        self.calls.append((count, slice_id))
+        return count
+
+    def scale_in(self, instance, reason):
+        return True
+
+
+def _controller_opts(**kw) -> ServiceOptions:
+    base = dict(autoscaler_enabled=True, autoscaler_breach_ticks=2,
+                autoscaler_min_instances=1, autoscaler_max_instances=8,
+                autoscaler_stale_hold_s=30.0)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+class TestReplacementTargetsLostSlice:
+    def _tick_fleet(self, coord, metas):
+        from xllm_service_tpu.autoscaler import AutoscalerController
+        from xllm_service_tpu.common.slo import SloMonitor
+
+        opts = _controller_opts()
+        mgr = InstanceMgr(coord, opts, start_threads=False,
+                          channel_factory=FakeChannel.factory)
+        for m in metas:
+            mgr.register_instance(m, link_peers=False)
+        act = _SliceRecordingActuator()
+        ctl = AutoscalerController(opts, mgr, act,
+                                   is_master_fn=lambda: True,
+                                   slo_monitor=SloMonitor())
+        return mgr, act, ctl
+
+    def test_replacement_lands_on_lost_slice(self, coord):
+        mgr, act, ctl = self._tick_fleet(coord, [
+            make_meta("pa", InstanceType.MIX,
+                      slice_id="slice-a", topo_host="host-a0"),
+            make_meta("da", InstanceType.MIX,
+                      slice_id="slice-a", topo_host="host-a1"),
+            make_meta("pb", InstanceType.MIX,
+                      slice_id="slice-b", topo_host="host-b0"),
+            make_meta("db", InstanceType.MIX,
+                      slice_id="slice-b", topo_host="host-b1"),
+        ])
+        _heartbeat_all(mgr)
+        rec = ctl.tick()    # census {a: 2, b: 2}; desired raised to 4
+        assert rec["actions"] == []
+        assert ctl.report()["slice_census"] == {"slice-a": 2, "slice-b": 2}
+
+        # slice-b dies between ticks (hard loss: both instances gone).
+        mgr.deregister_instance("pb")
+        mgr.deregister_instance("db")
+        _heartbeat_all(mgr)
+        rec = ctl.tick()    # live 2 < desired 4: hysteresis-free replace
+        kinds = [a["kind"] for a in rec["actions"]]
+        assert kinds == ["scale_out"]
+        assert rec["enacted"][0]["target_slice"] == "slice-b"
+        assert act.calls == [(2, "slice-b")]
+        assert "slice-b" not in ctl.report()["lost_slices"]  # consumed
+        mgr.stop()
+
+    def test_flat_fleet_spawns_carry_no_slice(self, coord):
+        # Control: the identical drill on an UNPLACED fleet must keep the
+        # spawn call byte-identical to the legacy path (slice_id "").
+        mgr, act, ctl = self._tick_fleet(coord, [
+            make_meta("e1"), make_meta("e2"),
+            make_meta("e3"), make_meta("e4"),
+        ])
+        _heartbeat_all(mgr)
+        ctl.tick()
+        assert ctl.report()["slice_census"] == {}   # never armed
+        mgr.deregister_instance("e3")
+        mgr.deregister_instance("e4")
+        _heartbeat_all(mgr)
+        rec = ctl.tick()
+        assert [a["kind"] for a in rec["actions"]] == ["scale_out"]
+        assert "target_slice" not in rec["enacted"][0]
+        assert act.calls == [(2, "")]
+        mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4) Slice-death chaos drill: converge without a SUSPECT storm.
+# ---------------------------------------------------------------------------
+def _drill_opts(**kw) -> ServiceOptions:
+    base = dict(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        lease_ttl_s=0.5, reconcile_interval_s=0.05,
+        heartbeat_silence_to_suspect_s=0.3,
+        detect_disconnected_instance_interval_s=0.3,
+        health_probe_attempts=1, health_probe_timeout_s=0.2,
+        sync_interval_s=0.2,
+        failover_backoff_base_s=0.05, failover_backoff_max_s=0.3,
+        rpc_backoff_base_s=0.02, rpc_backoff_max_s=0.1)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def _placed_engine(store, itype, slice_id, host) -> FakeEngine:
+    cfg = FakeEngineConfig(
+        instance_type=itype, reply_text="topology keeps the bytes close.",
+        chunk_size=4, delay_s=0.02, heartbeat_interval_s=0.1,
+        lease_ttl_s=0.5, slice_id=slice_id, topo_host=host)
+    return FakeEngine(InMemoryCoordination(store), cfg).start()
+
+
+def _stream(master) -> str:
+    import json
+
+    r = requests.post(
+        f"http://127.0.0.1:{master.http_port}/v1/completions",
+        json={"model": "fake-model", "prompt": "topo", "stream": True,
+              "max_tokens": 64}, stream=True, timeout=30)
+    assert r.status_code == 200, r.text
+    text = ""
+    for line in r.iter_lines():
+        if not line.startswith(b"data: "):
+            continue
+        data = line[len(b"data: "):]
+        if data == b"[DONE]":
+            break
+        obj = json.loads(data)
+        assert "error" not in obj, obj
+        for c in obj.get("choices", ()):
+            text += c.get("text", "")
+    return text
+
+
+@pytest.mark.chaos
+class TestSliceDeathDrill:
+    def test_whole_slice_dies_without_suspect_storm(self, store):
+        master = Master(_drill_opts(), coord=InMemoryCoordination(store))
+        master.start()
+        engines = {
+            "pa": _placed_engine(store, InstanceType.PREFILL,
+                                 "slice-a", "host-a0"),
+            "da": _placed_engine(store, InstanceType.DECODE,
+                                 "slice-a", "host-a1"),
+            "pb": _placed_engine(store, InstanceType.PREFILL,
+                                 "slice-b", "host-b0"),
+            "db": _placed_engine(store, InstanceType.DECODE,
+                                 "slice-b", "host-b1"),
+        }
+        mgr = master.scheduler.instance_mgr
+        try:
+            assert wait_until(
+                lambda: all(mgr.get_instance_meta(e.name) is not None
+                            for e in engines.values()), timeout=5)
+            assert mgr.routing_snapshot().topo_active
+            expected = _stream(master)
+            assert expected
+
+            survivors = (engines["pa"].name, engines["da"].name)
+            snap = mgr.routing_snapshot()
+            since_before = {n: snap.entries[n].state_since_ms
+                            for n in survivors}
+
+            # Hard death of ALL of slice-b: leases lapse, probes fail,
+            # no deregister.
+            engines["pb"].kill()
+            engines["db"].kill()
+            dead = (engines["pb"].name, engines["db"].name)
+            assert wait_until(
+                lambda: all(n not in mgr.routing_snapshot().entries
+                            for n in dead), timeout=10)
+
+            # Re-converged placement: every new pair rides the survivor
+            # slice's ICI (or collapses onto one instance), never DCN.
+            before = mgr.pair_link_counts()
+            for _ in range(3):
+                assert _stream(master) == expected
+            after = mgr.pair_link_counts()
+            assert after.get("dcn", 0) == before.get("dcn", 0)
+            assert sum(after.values()) >= sum(before.values()) + 3
+
+            # Zero survivor SUSPECT transitions: any state round-trip
+            # bumps state_since_ms.
+            snap = mgr.routing_snapshot()
+            for n in survivors:
+                assert snap.entries[n].state == InstanceRuntimeState.ACTIVE
+                assert snap.entries[n].state_since_ms == since_before[n]
+        finally:
+            for e in engines.values():
+                if e._alive:
+                    e.stop()
+            master.stop()

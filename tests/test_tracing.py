@@ -291,7 +291,11 @@ def _stream(master, timeout=60):
         "model": "fake-model", "prompt": "trace", "stream": True,
         "max_tokens": 1000}, stream=True, timeout=timeout)
     assert r.status_code == 200, r.text
-    text, sid = "", ""
+    # X-Request-Id is the INTERNAL service id (the tracer's key); the
+    # deltas only carry the OpenAI cmpl- id, which the trace plane never
+    # records — scoping by it would make the 404 checks vacuous.
+    sid = r.headers.get("X-Request-Id", "")
+    text = ""
     for line in r.iter_lines():
         if not line.startswith(b"data: "):
             continue
@@ -301,7 +305,6 @@ def _stream(master, timeout=60):
         obj = json.loads(data)
         if "error" in obj:
             raise RuntimeError(f"stream error: {obj['error']}")
-        sid = obj.get("id") or sid
         for c in obj.get("choices", ()):
             text += c.get("text", "")
     return text, sid

@@ -51,9 +51,14 @@ class FleetActuator:
 
     name = "none"
 
-    def scale_out(self, count: int, reason: str) -> int:
+    def scale_out(self, count: int, reason: str,
+                  slice_id: str = "") -> int:
         """Launch `count` instances; returns how many were actually
-        started (less than `count` = failure, retried with backoff)."""
+        started (less than `count` = failure, retried with backoff).
+        ``slice_id`` is the target slice for the new capacity ("" = any):
+        replacement spawns name the slice that lost instances so
+        placement re-converges where the failure happened
+        (docs/topology.md)."""
         return 0
 
     def scale_in(self, instance: str, reason: str) -> bool:
@@ -114,16 +119,18 @@ class HintActuator(FleetActuator):
                         ttl_s=self.ACTION_TTL_S)
         self._coord.set(AUTOSCALER_DECISION_KEY, body)
 
-    def scale_out(self, count: int, reason: str) -> int:
+    def scale_out(self, count: int, reason: str,
+                  slice_id: str = "") -> int:
         now = time.monotonic()
+        key = f"scale_out:{slice_id}"
         with self._lock:
-            last = self._last_publish.get("scale_out")
+            last = self._last_publish.get(key)
             if last is not None and last[1] == count \
                     and now - last[0] < self.REPUBLISH_S:
                 return count   # identical unsatisfied hint: don't spam
-            self._last_publish["scale_out"] = (now, count)
+            self._last_publish[key] = (now, count)
         self._publish({"action": "scale_out", "count": count,
-                       "reason": reason})
+                       "reason": reason, "slice_id": slice_id})
         return count
 
     def scale_in(self, instance: str, reason: str) -> bool:
@@ -169,16 +176,21 @@ class LocalProcessActuator(FleetActuator):
     #: runaway cap bounds the damage if it keeps happening).
     SPAWN_PENDING_TIMEOUT_S = 20.0
 
-    def _command(self, port: int) -> list[str]:
+    def _command(self, port: int, slice_id: str = "") -> list[str]:
         if self._spawn_cmd:
             tmpl = shlex.split(self._spawn_cmd)
             return [part.format(port=port,
-                                coordination_addr=self._opts.coordination_addr)
+                                coordination_addr=self._opts.coordination_addr,
+                                slice_id=slice_id)
                     for part in tmpl]
         repo = Path(__file__).resolve().parent.parent.parent
-        return [sys.executable, str(repo / "examples" / "run_fake_engine.py"),
-                "--coordination-addr", self._opts.coordination_addr,
-                "--host", self._host, "--port", str(port)]
+        cmd = [sys.executable,
+               str(repo / "examples" / "run_fake_engine.py"),
+               "--coordination-addr", self._opts.coordination_addr,
+               "--host", self._host, "--port", str(port)]
+        if slice_id:
+            cmd += ["--slice-id", slice_id]
+        return cmd
 
     def _reap_dead_locked(self) -> None:
         for name, p in list(self._procs.items()):
@@ -198,7 +210,8 @@ class LocalProcessActuator(FleetActuator):
                 and now - self._spawned_at.get(name, now)
                 < self.SPAWN_PENDING_TIMEOUT_S)
 
-    def scale_out(self, count: int, reason: str) -> int:
+    def scale_out(self, count: int, reason: str,
+                  slice_id: str = "") -> int:
         launched = 0
         for _ in range(max(0, count)):
             with self._lock:
@@ -211,7 +224,7 @@ class LocalProcessActuator(FleetActuator):
                     break
             port = pick_free_port(self._host)
             name = f"{self._host}:{port}"
-            cmd = self._command(port)
+            cmd = self._command(port, slice_id)
             try:
                 log = open(self._log_dir / f"autoscaled_{port}.log", "w")
                 p = subprocess.Popen(cmd, stdout=log,

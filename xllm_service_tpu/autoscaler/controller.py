@@ -40,6 +40,7 @@ from ..common.metrics import (
     AUTOSCALER_LAST_DECISION_AGE_SECONDS,
     FLEET_SIZE,
 )
+from ..common import topology as _topo
 from ..common.slo import SLO_MONITOR
 from ..common.tracing import TRACER
 from ..common.types import InstanceType, now_ms
@@ -336,6 +337,11 @@ class AutoscalerController:
     """The closed control loop. One instance per frontend; ticks ride the
     scheduler's sync cadence; only the elected master's ticks act."""
 
+    #: A recorded capacity loss on a slice targets replacement spawns
+    #: for at most this long — after that, placement falls back to
+    #: "any slice" (the loss is presumed absorbed or permanent).
+    LOST_SLICE_TTL_S = 120.0
+
     def __init__(self, options: ServiceOptions, instance_mgr,
                  actuator, planner=None,
                  is_master_fn: Optional[Callable[[], bool]] = None,
@@ -362,6 +368,13 @@ class AutoscalerController:
             maxlen=max(8, options.autoscaler_decision_log_capacity))
         self._flip_proposals: dict[str, InstanceType] = {}
         self._retiring: dict[str, float] = {}     # name -> retire ts (s)
+        # Topology plane (docs/topology.md): schedulable count per
+        # effective slice (previous tick) and slices that recently LOST
+        # capacity (slice_id -> loss ts). Replacement scale-outs target
+        # the most recent loss so new capacity lands on the slice the
+        # failure emptied. Both maps stay empty on flat fleets.
+        self._slice_census: dict[str, int] = {}
+        self._lost_slices: dict[str, float] = {}
         self._last_decision_ms = 0
         self._ticks = 0
 
@@ -486,6 +499,41 @@ class AutoscalerController:
         FLEET_SIZE.labels(role="encode").set(len(snap.encode))
         FLEET_SIZE.labels(role="draining").set(draining)
 
+        # Per-slice capacity census (docs/topology.md): a slice whose
+        # schedulable count DROPS is recorded as having lost capacity;
+        # replacement scale-outs target the most recent loss. Armed when
+        # the fleet spans >= 2 effective slices counting SUSPECT/dying
+        # entries (the schedulable-only topo_active bit flips false on
+        # the very tick an entire slice dies — the exact transition this
+        # census exists to record), or when the previous census did (the
+        # entries may already be evicted). A flat fleet never arms, never
+        # records a loss, and its spawn commands stay byte-identical to
+        # the legacy path. Only operator-PLACED coordinates count —
+        # synthetic per-host fallbacks would make any multi-host unplaced
+        # fleet look multi-slice and stamp synthetic slice ids into spawn
+        # commands. Intentional shrink (scale-in) also lowers `desired`,
+        # so the loss mark is only ever consulted when a genuine
+        # replacement fires.
+        coords = {n: c for n, c in getattr(snap, "coords", {}).items()
+                  if getattr(c, "placed", False)}
+        armed = _topo.fleet_topo_active(list(coords.values()))
+        census: dict[str, int] = {}
+        for n in live_names:
+            c = coords.get(n)
+            if c is not None:
+                census[c.slice_id] = census.get(c.slice_id, 0) + 1
+        with self._lock:
+            if armed or len(self._slice_census) >= 2:
+                for s, prev in self._slice_census.items():
+                    if census.get(s, 0) < prev:
+                        self._lost_slices[s] = now_s
+                self._slice_census = census
+            else:
+                self._slice_census = {}
+            for s, ts in list(self._lost_slices.items()):
+                if now_s - ts > self.LOST_SLICE_TTL_S:
+                    self._lost_slices.pop(s, None)
+
         ages = self._mgr.load_info_ages_s()
         max_age = -1.0 if any(a < 0 for a in ages.values()) \
             else max(ages.values(), default=0.0)
@@ -587,7 +635,20 @@ class AutoscalerController:
         if a.kind == ACTION_HOLD:
             return {"kind": a.kind, "ok": True}
         if a.kind == ACTION_SCALE_OUT:
-            launched = self._actuator.scale_out(a.count, a.reason)
+            # Target the slice that most recently lost capacity ("" on
+            # flat fleets / no recorded loss): the actuator lands the
+            # replacement where the failure happened, so the restored
+            # fleet re-converges to same-slice PD pairs instead of
+            # permanently paying DCN for a capacity hole.
+            with self._lock:
+                target_slice = max(self._lost_slices,
+                                   key=self._lost_slices.get, default="") \
+                    if self._lost_slices else ""
+            launched = self._actuator.scale_out(a.count, a.reason,
+                                                slice_id=target_slice)
+            if target_slice and launched >= a.count:
+                with self._lock:
+                    self._lost_slices.pop(target_slice, None)
             if launched < a.count:
                 with self._lock:
                     st = self._state
@@ -605,8 +666,11 @@ class AutoscalerController:
                 with self._lock:
                     self._state = dataclasses.replace(
                         self._state, retry_at_s=0.0, retry_count=0)
-            return {"kind": a.kind, "ok": launched >= a.count,
-                    "requested": a.count, "launched": launched}
+            out = {"kind": a.kind, "ok": launched >= a.count,
+                   "requested": a.count, "launched": launched}
+            if target_slice:
+                out["target_slice"] = target_slice
+            return out
         if a.kind == ACTION_SCALE_IN:
             self._mgr.request_drain(a.instance)
             AUTOSCALER_ACTIONS_TOTAL.labels(action=ACTION_DRAIN).inc()
@@ -659,6 +723,8 @@ class AutoscalerController:
             log = list(self._log)
             retiring = dict(self._retiring)
             ticks = self._ticks
+            lost_slices = sorted(self._lost_slices)
+            slice_census = dict(self._slice_census)
         return {
             "enabled": self._enabled,
             "master": bool(self._is_master_fn()),
@@ -667,6 +733,8 @@ class AutoscalerController:
             "last_decision_age_s": self.last_decision_age_s(),
             "state": dataclasses.asdict(st),
             "retiring": sorted(retiring),
+            "slice_census": slice_census,
+            "lost_slices": lost_slices,
             "config": dataclasses.asdict(self._cfg),
             "decisions": list(reversed(log)),
         }

@@ -59,6 +59,24 @@ class ServiceOptions:
     # SLO targets, live-reloadable (`global_gflags.cpp:122-132`).
     target_ttft_ms: float = 1000.0
     target_tpot_ms: float = 50.0
+    # --- ICI-topology-aware placement (common/topology.py, docs/topology.md) ---
+    # How much load-skew advantage (in CAR score units / normalized link
+    # penalty) a cross-slice DCN partner must show before it beats a
+    # same-slice ICI partner. 0 disables the plane entirely (flat
+    # placement); the plane is also dormant whenever the fleet's
+    # effective coordinates collapse into a single slice, so flat fleets
+    # see zero routing change at any knob value.
+    topology_tradeoff: float = 0.25
+    # Scheduler-side modeled link budgets (bytes/s) for transfer_cost —
+    # mirror the engine BandwidthAccountant budgets so the master's
+    # predicted handoff time matches what the engines actually pace.
+    # 0 = use the class-default bandwidths (account-only fleets).
+    topology_ici_bytes_per_s: float = 0.0
+    topology_dcn_bytes_per_s: float = 0.0
+    # Modeled KV bytes per prompt token for instances that don't
+    # advertise a KV layout (fake engines); real engines' advertised
+    # num_layers/num_kv_heads/head_dim/kv_dtype win when present.
+    topology_kv_bytes_per_token: int = 0
     # --- engine RPC channel (reference fixes 3 retries with no backoff,
     #     `instance_mgr.cpp:480-498`; here both are knobs and retries back
     #     off exponentially with jitter) ---

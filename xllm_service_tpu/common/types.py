@@ -102,6 +102,12 @@ class TpuTopology:
     slice_id      — which TPU slice/pod this instance's mesh lives on; KV
                     handoff between instances on the same slice can ride ICI,
                     cross-slice handoff rides DCN.
+    host          — physical host within the slice; two instances sharing a
+                    non-empty host are link-class "local". A non-empty host is
+                    also what marks the instance as *placed* for the topology
+                    plane (common/topology.py) — slice_id alone never does,
+                    so legacy default slice ids can't re-route a flat fleet.
+    chip          — chip index within the host (-1 = unpinned).
     mesh_shape    — e.g. [2, 4] for a 2x4 sub-mesh.
     axis_names    — named mesh axes, e.g. ["data", "model"].
     host_addrs    — per-host DCN endpoints (host:port) for KV transfer.
@@ -109,6 +115,8 @@ class TpuTopology:
     """
 
     slice_id: str = ""
+    host: str = ""
+    chip: int = -1
     mesh_shape: list[int] = field(default_factory=list)
     axis_names: list[str] = field(default_factory=list)
     host_addrs: list[str] = field(default_factory=list)
@@ -391,3 +399,8 @@ class InstanceLoadInfo:
     # frontends score routing off mirrored telemetry, so CAR/SLO scoring
     # discounts entries older than `loadinfo_stale_after_s`.
     updated_ms: int = 0
+    # Effective placement coordinate (common/topology.py effective_coord):
+    # synthetic per-host slice when the registration carried no host, so
+    # the planner/policies can always compare slices without re-deriving.
+    slice_id: str = ""
+    host: str = ""

@@ -128,6 +128,7 @@ STATE_DISCIPLINES: dict[str, str] = {
     "InstanceMgr._latency_metrics": "lock:_metrics_lock",
     "InstanceMgr._load_updated_ms": "lock:_metrics_lock",
     "InstanceMgr._request_loads": "lock:_metrics_lock",
+    "InstanceMgr._pair_links": "lock:_metrics_lock",
     "InstanceMgr._updated_load_names": "lock:_metrics_lock",
     "InstanceMgr._removed_load_names": "lock:_metrics_lock",
     "InstanceMgr._is_master": "confined:mastership",
@@ -236,6 +237,10 @@ STATE_DISCIPLINES: dict[str, str] = {
     "AutoscalerController._log": "lock:_lock",
     "AutoscalerController._last_decision_ms": "lock:_lock",
     "AutoscalerController._ticks": "lock:_lock",
+    # Topology plane (docs/topology.md): per-slice capacity census and
+    # the recently-lost-slice map that targets replacement spawns.
+    "AutoscalerController._slice_census": "lock:_lock",
+    "AutoscalerController._lost_slices": "lock:_lock",
     "AutoscalerController._opts": "init-only",
     "AutoscalerController._mgr": "init-only",
     "AutoscalerController._actuator": "init-only",

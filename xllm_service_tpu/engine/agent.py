@@ -33,6 +33,7 @@ from aiohttp import web
 import jax
 
 from ..common import flightrecorder, tracing
+from ..common import topology as topo
 from ..common.flightrecorder import RECORDER
 from ..common.metrics import (
     ENGINE_HEARTBEATS_TOTAL,
@@ -144,6 +145,13 @@ class AgentConfig:
     # beats.
     degraded_mode: str = "on"
     slice_id: str = "slice-0"
+    # Topology placement coordinate (common/topology.py). A non-empty
+    # topo_host marks this instance as PLACED: routing, planner flips,
+    # and autoscaler spawns then cost its PD links by class. Empty (the
+    # default) keeps the legacy per-host synthetic coordinate — flat
+    # fleets behave exactly as before.
+    topo_host: str = ""
+    topo_chip: int = -1
     # Model replicas behind this one registration (reference dp_size,
     # `xllm_rpc_service.proto:40-43`): each replica is an independent
     # continuous-batching engine; requests are dispatched prefix-affine
@@ -650,6 +658,8 @@ class EngineAgent:
             dp_size=len(self.engines),
             topology=TpuTopology(
                 slice_id=self.cfg.slice_id,
+                host=self.cfg.topo_host,
+                chip=self.cfg.topo_chip,
                 # Describes THIS engine's mesh (mesh-less = one device),
                 # not the host's device count — the device-KV-transfer
                 # gate compares these between peers.
@@ -1587,11 +1597,22 @@ class EngineAgent:
                             content_type="application/msgpack")
 
     def _link_class(self, peer_name: str) -> str:
-        """ICI-shaped (same slice) vs DCN-shaped (cross-slice) for
-        bandwidth budgeting."""
+        """ICI-shaped vs DCN-shaped for bandwidth budgeting, derived from
+        the topology coordinates via the shared link-cost kernel
+        (common/topology.py). The accountant has two budget classes, so
+        kernel "local" (same host — never leaves the machine) rides the
+        ICI bucket. Peers without placement coordinates keep the legacy
+        rule: same declared slice = ICI."""
         meta = self.linked_peers.get(peer_name)
-        if meta is not None and meta.topology.slice_id \
-                and meta.topology.slice_id == self.cfg.slice_id:
+        peer_topo = meta.topology if meta is not None else None
+        if self.cfg.topo_host and getattr(peer_topo, "host", ""):
+            mine = topo.Coord(self.cfg.slice_id, self.cfg.topo_host,
+                              self.cfg.topo_chip, placed=True)
+            link = topo.link_class(
+                mine, topo.effective_coord(peer_topo, peer_name))
+            return "ici" if link == topo.LINK_LOCAL else link
+        if peer_topo is not None and peer_topo.slice_id \
+                and peer_topo.slice_id == self.cfg.slice_id:
             return "ici"
         return "dcn"
 
@@ -1918,6 +1939,23 @@ def main() -> None:
                         "good master while the coordination plane is "
                         "unreachable (static stability); off = legacy "
                         "behavior (no resolvable target, no beats)")
+    p.add_argument("--slice-id", default="slice-0",
+                   help="TPU slice/pod this instance's mesh lives on; "
+                        "same-slice PD handoffs ride ICI, cross-slice "
+                        "rides DCN (docs/topology.md)")
+    p.add_argument("--topo-host", default="",
+                   help="physical host coordinate; non-empty marks this "
+                        "instance PLACED so routing/planner/autoscaler "
+                        "cost its links by class ('' = legacy per-host "
+                        "synthetic slice, flat behavior)")
+    p.add_argument("--topo-chip", type=int, default=-1,
+                   help="chip index within --topo-host (-1 = unpinned)")
+    p.add_argument("--ici-bytes-per-s", type=float, default=0.0,
+                   help="ICI-class KV pull bandwidth budget, bytes/s "
+                        "(0 = account-only, no pacing)")
+    p.add_argument("--dcn-bytes-per-s", type=float, default=0.0,
+                   help="DCN-class KV pull bandwidth budget, bytes/s "
+                        "(0 = account-only, no pacing)")
     args = p.parse_args()
 
     # Multi-host: join the process group (XLLM_MH_COORDINATOR /
@@ -2062,7 +2100,12 @@ def main() -> None:
                           generation_flush_ms=args.generation_flush_ms,
                           dp_size=args.dp_size,
                           telemetry_mode=args.telemetry_mode,
-                          degraded_mode=args.degraded_mode),
+                          degraded_mode=args.degraded_mode,
+                          slice_id=args.slice_id,
+                          topo_host=args.topo_host,
+                          topo_chip=args.topo_chip,
+                          ici_bytes_per_s=args.ici_bytes_per_s,
+                          dcn_bytes_per_s=args.dcn_bytes_per_s),
         params=params)
     agent.start()
     import signal as _signal

@@ -843,6 +843,11 @@ class XllmHttpService:
             resp.headers["Content-Type"] = "text/event-stream"
             resp.headers["Cache-Control"] = "no-cache"
             resp.headers["Connection"] = "keep-alive"
+            # The internal service id (the key /admin/trace and the
+            # flight recorder index by) — deltas only carry the
+            # OpenAI-style cmpl- id, so without this header a client
+            # cannot correlate its own request with the trace plane.
+            resp.headers["X-Request-Id"] = req.service_request_id
             await resp.prepare(http_req)
             # Coalesced emit: one blocking queue get, then drain whatever
             # else is already queued and flush ALL frames in one write()
@@ -985,7 +990,8 @@ class XllmHttpService:
                 if tag == "error":
                     code, msg = item
                     return _error_response(code, msg, "server_error")
-                return web.json_response(item)
+                return web.json_response(
+                    item, headers={"X-Request-Id": req.service_request_id})
         except asyncio.TimeoutError:
             if await self._deadline_cancel(req):
                 return _error_response(504, "deadline exceeded", "timeout")

@@ -156,9 +156,15 @@ class DeltaJournal:
 def _passthrough_headers(r) -> dict[str, str]:
     """Owner-response headers the relay must not swallow: Retry-After
     carries the admission gate's backoff hint on a shed 429 — without
-    it well-behaved clients retry immediately instead of backing off."""
-    ra = r.headers.get("Retry-After")
-    return {"Retry-After": ra} if ra else {}
+    it well-behaved clients retry immediately instead of backing off,
+    and X-Request-Id carries the internal service id the trace plane
+    keys by."""
+    out = {}
+    for h in ("Retry-After", "X-Request-Id"):
+        v = r.headers.get(h)
+        if v:
+            out[h] = v
+    return out
 
 
 class HandoffRelay:
@@ -277,6 +283,10 @@ class HandoffRelay:
         resp = web.StreamResponse()
         resp.headers["Content-Type"] = "text/event-stream"
         resp.headers["Cache-Control"] = "no-cache"
+        # Same contract as the owner-served path: the internal service id
+        # (what /admin/trace and the flight recorder key by) rides a
+        # response header — the deltas only carry the OpenAI cmpl- id.
+        resp.headers["X-Request-Id"] = sid
         prepared = False
         delivered = 0          # data frames already copied to the client
         failed: list[str] = []

@@ -76,6 +76,7 @@ from ..common.metrics import (
 )
 from ..devtools import lifecycle as _lifecycle
 from ..common.time_predictor import TimePredictor
+from ..common import topology as topo
 from ..common.types import (
     InstanceLoadInfo,
     InstanceMetaInfo,
@@ -167,7 +168,7 @@ class RoutingSnapshot:
 
     __slots__ = ("prefill", "decode", "encode", "schedulable", "entries",
                  "incarnations", "channels", "wire", "has_available",
-                 "built_ms")
+                 "built_ms", "coords", "decode_by_slice", "topo_active")
 
     def __init__(self, instances: dict[str, _Entry]):
         # Build timestamp: the fleet-observability gauge
@@ -208,6 +209,23 @@ class RoutingSnapshot:
         # DEFAULT/MIX serves both roles; otherwise both a PREFILL and a
         # DECODE must exist — a prefill-only fleet must NOT report ready.
         self.has_available = has_default or (has_prefill and has_decode)
+        # Topology plane (common/topology.py, docs/topology.md): every
+        # instance's effective coordinate (synthetic per-host slice when
+        # the registration carried no host), decode membership grouped by
+        # slice for locality-first pairing, and the plane's armed bit —
+        # ONLY when the schedulable PD fleet spans >= 2 distinct
+        # effective slices do consumers pay link costs; a flat fleet
+        # collapses into one synthetic slice and routing is bit-for-bit
+        # the legacy behavior.
+        self.coords = {n: topo.effective_coord(e.meta.topology, n)
+                       for n, e in instances.items()}
+        by_slice: dict[str, list[str]] = {}
+        for name in decode:
+            by_slice.setdefault(self.coords[name].slice_id, []).append(name)
+        self.decode_by_slice = {s: tuple(v) for s, v in by_slice.items()}
+        pd = set(prefill).union(decode)
+        self.topo_active = topo.fleet_topo_active(
+            [self.coords[n] for n in pd])
 
 
 @_ownership.verify_state
@@ -254,6 +272,12 @@ class InstanceMgr:
         self._metrics_lock = make_lock("instance_mgr.metrics", order=24)  # lock-order: 24
         self._load_metrics: dict[str, LoadMetrics] = {}
         self._latency_metrics: dict[str, LatencyMetrics] = {}
+        # Link-class census of scheduled PD pairs (topology plane
+        # evidence): link_class -> count, incremented per SCHEDULE.
+        # "mix" = the pair collapsed onto one instance (no handoff).
+        # Surfaced by stats() -> /admin/hotpath so the topo bench can
+        # read the same-slice pair share straight off the master.
+        self._pair_links: dict[str, int] = {}
         # Telemetry freshness per instance: when load/latency was last
         # refreshed (heartbeat ingest here on the master; LOADMETRICS
         # mirror on replicas). Feeds InstanceLoadInfo.updated_ms so
@@ -365,12 +389,15 @@ class InstanceMgr:
 
     def _make_load_info_locked(self, name: str, entry: _Entry,
                                snap: RoutingSnapshot) -> InstanceLoadInfo:
+        coord = snap.coords.get(name) \
+            or topo.effective_coord(entry.meta.topology, name)
         return InstanceLoadInfo(
             name=name, type=entry.meta.type,
             load=self._load_metrics.get(name, LoadMetrics()),
             latency=self._latency_metrics.get(name, LatencyMetrics()),
             schedulable=name in snap.schedulable,
-            updated_ms=self._load_updated_ms.get(name, 0))
+            updated_ms=self._load_updated_ms.get(name, 0),
+            slice_id=coord.slice_id, host=coord.host)
 
     def _update_load_info_locked(self, name: str) -> None:
         """Copy-on-write republish of one instance's load-info entry
@@ -1331,7 +1358,18 @@ class InstanceMgr:
         prefill = snap.prefill[next(self._rr_prefill) % len(snap.prefill)]
         if not snap.decode:
             return Routing(prefill_name=prefill)
-        decode = snap.decode[next(self._rr_decode) % len(snap.decode)]
+        pool = snap.decode
+        if snap.topo_active and self._opts.topology_tradeoff > 0:
+            # Topology plane armed: RR over the decodes sharing the
+            # chosen prefill's slice (ICI/local handoff) — the full
+            # fleet only when that slice has no decode. RR carries no
+            # load signal, so there is no skew to trade off against;
+            # locality simply wins. Flat fleets (one effective slice)
+            # never take this branch.
+            local = snap.decode_by_slice.get(snap.coords[prefill].slice_id)
+            if local:
+                pool = local
+        decode = pool[next(self._rr_decode) % len(pool)]
         if decode == prefill:
             # A MIX instance picked for both roles serves both stages.
             return Routing(prefill_name=prefill)
@@ -1440,6 +1478,19 @@ class InstanceMgr:
             if action == RequestAction.SCHEDULE:
                 pl.num_prefill_requests += 1
                 pl.num_prefill_tokens += ntok
+                # Pair-link census (lock: _metrics_lock): which link
+                # class this request's KV handoff will ride. Coordinates
+                # come from the current snapshot — racing a republish
+                # can misclassify ONE count, never corrupt state.
+                if not req.routing.decode_name \
+                        or req.routing.decode_name == pname:
+                    link = "mix"
+                else:
+                    snap = self._snapshot
+                    ca, cb = snap.coords.get(pname), snap.coords.get(dname)
+                    link = topo.link_class(ca, cb) \
+                        if ca is not None and cb is not None else "unknown"
+                self._pair_links[link] = self._pair_links.get(link, 0) + 1
             elif action == RequestAction.FINISH_PREFILL:
                 pl.num_prefill_requests = max(0, pl.num_prefill_requests - 1)
                 pl.num_prefill_tokens = max(0, pl.num_prefill_tokens - ntok)
@@ -1737,7 +1788,23 @@ class InstanceMgr:
             "frames_applied": self._frames_applied,
             "foreign_heartbeats": self._foreign_heartbeats,
             "load_info_ages_s": self.load_info_ages_s(),
+            # Topology plane: armed bit, per-instance effective
+            # coordinates, and the scheduled-pair link census (the topo
+            # bench's same-slice share evidence).
+            "topology": {
+                "active": snap.topo_active,
+                "tradeoff": self._opts.topology_tradeoff,
+                "coords": {n: {"slice_id": c.slice_id, "host": c.host,
+                               "chip": c.chip, "placed": c.placed}
+                           for n, c in snap.coords.items()},
+                "pair_links": self.pair_link_counts(),
+            },
         }
+
+    def pair_link_counts(self) -> dict[str, int]:
+        """Copy of the scheduled-pair link census (link class -> count)."""
+        with self._metrics_lock:
+            return dict(self._pair_links)
 
     def stop(self) -> None:
         self._stopped.set()

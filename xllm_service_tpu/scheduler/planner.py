@@ -116,15 +116,28 @@ class Planner:
         # instance restart) nor flip candidates (an idle-LOOKING stale
         # prefill may be carrying load its telemetry stopped reporting).
         stale = set(d.stale_load_entries)
-        slow_decode = any(
-            i.latency.recent_max_tbt > self._opts.target_tpot_ms
-            and i.name not in stale
-            for i in decodes)
-        idle_prefill = next(
-            (i.name for i in prefills if i.load.waiting_requests_num == 0
-             and i.load.running_requests_num == 0
-             and i.name not in stale), None)
-        if slow_decode and idle_prefill and len(prefills) > 1:
+        slow_decodes = [
+            i for i in decodes
+            if i.latency.recent_max_tbt > self._opts.target_tpot_ms
+            and i.name not in stale]
+        idle_prefills = [
+            i for i in prefills if i.load.waiting_requests_num == 0
+            and i.load.running_requests_num == 0
+            and i.name not in stale]
+        # Topology locality (docs/topology.md): flip WITHIN a slice
+        # before across one — a flipped prefill serves the slow decode's
+        # slice, so its future PD partners ride ICI, not DCN. Falls back
+        # to any idle prefill when no same-slice candidate exists; on
+        # flat fleets every instance shares one effective slice and the
+        # preference is a no-op (load-info slice_id is always populated
+        # with the effective coordinate).
+        idle_prefill = None
+        if idle_prefills:
+            slow_slices = {i.slice_id for i in slow_decodes}
+            idle_prefill = next(
+                (i.name for i in idle_prefills if i.slice_id in slow_slices),
+                idle_prefills[0].name)
+        if slow_decodes and idle_prefill and len(prefills) > 1:
             self.flip_sink(idle_prefill, InstanceType.DECODE)
             d.flips_requested.append([idle_prefill, "DECODE"])
             d.reasons.append("decode TPOT over target; flipping idle "

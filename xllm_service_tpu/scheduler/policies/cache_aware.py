@@ -15,6 +15,7 @@ RCU-published prefix index with the request's memoized block hashes
 from __future__ import annotations
 
 from .base import LoadBalancePolicy
+from ...common import topology as topo
 from ...common.request import Request
 from ...common.types import InstanceType, Routing
 
@@ -62,7 +63,25 @@ class CacheAwareRoutingPolicy(LoadBalancePolicy):
         best_p = max(prefills, key=score)
         if not decodes:
             return Routing(prefill_name=best_p.name)
-        best_d = max(decodes, key=score)
+        # Topology-aware decode tier (docs/topology.md): dock each decode
+        # candidate by `topology_tradeoff * link_penalty` for the link
+        # class of the prefill→decode KV handoff — a cross-slice DCN
+        # partner beats a same-slice ICI one only when its load/cache
+        # advantage exceeds the knob. Armed only when the candidates span
+        # >= 2 effective slices; flat fleets score exactly as before.
+        tradeoff = max(0.0, getattr(self._opts, "topology_tradeoff", 0.0))
+        dscore = score
+        if tradeoff > 0 and topo.fleet_topo_active(
+                [topo.Coord(i.slice_id, i.host)
+                 for i in prefills + decodes]):
+            cp = topo.Coord(best_p.slice_id, best_p.host)
+
+            def dscore(info) -> float:
+                link = topo.link_class(
+                    cp, topo.Coord(info.slice_id, info.host))
+                return score(info) - tradeoff * topo.link_penalty(link)
+
+        best_d = max(decodes, key=dscore)
         if best_d.name == best_p.name:
             # Collision: the top decode candidate is the instance already
             # chosen for prefill (only a MIX node can appear in both
@@ -77,5 +96,5 @@ class CacheAwareRoutingPolicy(LoadBalancePolicy):
                       and i.type == InstanceType.DECODE]
             if not others:
                 return Routing(prefill_name=best_p.name)
-            best_d = max(others, key=score)
+            best_d = max(others, key=dscore)
         return Routing(prefill_name=best_p.name, decode_name=best_d.name)

@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from .base import LoadBalancePolicy
+from ...common import topology as topo
 from ...common.request import Request
 from ...common.types import InstanceType, Routing
 
@@ -79,6 +80,28 @@ def select_pair_on_slo(mgr, opts, req: Request,
     if not decodes:
         return Routing(prefill_name=best_prefill_name)
 
+    # Topology plane (docs/topology.md): model the prefill→decode KV
+    # handoff per candidate — payload from the prefill's advertised KV
+    # layout (or the configured bytes-per-token stand-in), wire time by
+    # link class. Candidates scan cheapest-link-first (stable sort: the
+    # legacy order survives within a link class), and the modeled
+    # transfer time joins the predicted TTFT below. Dormant on flat
+    # fleets (single effective slice) — ordering and score unchanged.
+    tradeoff = max(0.0, getattr(opts, "topology_tradeoff", 0.0))
+    transfer_ms: dict[str, float] = {}
+    if tradeoff > 0 and getattr(snap, "topo_active", False):
+        cp = snap.coords[best_prefill_name]
+        nbytes = topo.kv_handoff_bytes(best_prefill.meta, prompt_len) \
+            or getattr(opts, "topology_kv_bytes_per_token", 0) * prompt_len
+        for name, _e in decodes:
+            link = topo.link_class(cp, snap.coords[name])
+            transfer_ms[name] = 1000.0 * topo.transfer_cost(
+                nbytes, link,
+                getattr(opts, "topology_ici_bytes_per_s", 0.0),
+                getattr(opts, "topology_dcn_bytes_per_s", 0.0))
+        if nbytes > 0:
+            decodes = sorted(decodes, key=lambda it: transfer_ms[it[0]])
+
     # 2) first decode meeting the TPOT target.
     chosen_decode: Optional[str] = None
     for name, entry in decodes:
@@ -132,6 +155,9 @@ def select_pair_on_slo(mgr, opts, req: Request,
 
     if chosen_decode == best_prefill_name:
         return Routing(prefill_name=best_prefill_name)
+    # Predicted TTFT now includes the modeled KV-handoff wire time for
+    # the pair actually chosen (0 for mix-collapse and flat fleets).
+    req.metrics.estimated_ttft_ms += transfer_ms.get(chosen_decode, 0.0)
     return Routing(prefill_name=best_prefill_name, decode_name=chosen_decode)
 
 

@@ -36,6 +36,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -45,6 +46,7 @@ import asyncio
 
 from ..common.faults import FAULTS
 from ..common.hashing import prefix_block_hash_hexes
+from ..common import topology as topo
 from ..common import tracing as _tracing
 from .. import profiling as _profiling
 from ..common.tracing import TRACER, TraceContext
@@ -107,6 +109,23 @@ class FakeEngineConfig:
     # empty); "off" restores the legacy collapse — no owner, no beats —
     # which is the outage bench's control leg.
     degraded_mode: str = "on"
+    # Topology placement coordinate (mirror of AgentConfig.slice_id/
+    # topo_host/topo_chip; common/topology.py). A non-empty topo_host
+    # marks this instance PLACED; empty keeps the legacy synthetic
+    # per-host coordinate, so existing tests/benches see zero change.
+    slice_id: str = "fake-slice"
+    topo_host: str = ""
+    topo_chip: int = -1
+    # Modeled PD KV-handoff: when > 0 and a dispatch routes decode to a
+    # DIFFERENT instance, the prefill side sleeps
+    # transfer_cost(bytes_per_token * prompt_tokens, link) before the
+    # first delta — the link class derived from the two instances'
+    # registered coordinates, the budgets below standing in for the real
+    # agent's BandwidthAccountant pacing. This is what makes the topo
+    # bench handoff-bandwidth-bound without real KV payloads.
+    kv_handoff_bytes_per_token: int = 0
+    ici_bytes_per_s: float = 0.0
+    dcn_bytes_per_s: float = 0.0
 
 
 class FakeEngine:
@@ -192,6 +211,15 @@ class FakeEngine:
         self._last_master = ""
         self.mux_sends = 0
         self.direct_sends = 0
+        # Modeled PD KV-handoff bookkeeping (topo bench evidence): per
+        # completed handoff (link, modeled_seconds). Appended from
+        # generation threads, read by /admin/topology — deque appends
+        # are atomic and the reader copies.
+        self.handoff_log: deque[tuple[str, float]] = deque(maxlen=4096)
+        # Peer-name -> effective Coord, resolved once from coordination
+        # (bench fleets are static; a missing peer is retried on the
+        # next handoff, not cached).
+        self._peer_coords: dict[str, topo.Coord] = {}
 
     # ------------------------------------------------------------ lifecycle
     def start(self, register: bool = True) -> "FakeEngine":
@@ -218,7 +246,10 @@ class FakeEngine:
             name=self.name, rpc_address=self.name, type=self.instance_type,
             draining=self.draining,
             dp_size=1,
-            topology=TpuTopology(slice_id="fake-slice", mesh_shape=[1],
+            topology=TpuTopology(slice_id=self.cfg.slice_id,
+                                 host=self.cfg.topo_host,
+                                 chip=self.cfg.topo_chip,
+                                 mesh_shape=[1],
                                  axis_names=["data"],
                                  host_addrs=[self.name]),
             incarnation_id=self.incarnation_id,
@@ -256,6 +287,7 @@ class FakeEngine:
         # Same per-process trace surface the real agent serves — useful
         # when the fake engine runs out-of-process
         # (examples/run_fake_engine.py).
+        app.router.add_get("/admin/topology", self._h_topology)
         app.router.add_get("/admin/trace", _tracing.handle_admin_trace)
         app.router.add_get("/admin/trace/recent",
                            _tracing.handle_admin_trace_recent)
@@ -659,6 +691,73 @@ class FakeEngine:
             with self._active_lock:
                 self._active_gens -= 1
 
+    # ------------------------------------------------- modeled KV handoff
+    def own_coord(self) -> topo.Coord:
+        return topo.effective_coord(
+            TpuTopology(slice_id=self.cfg.slice_id, host=self.cfg.topo_host,
+                        chip=self.cfg.topo_chip), self.name)
+
+    _PEER_TYPE_ORDER = (InstanceType.DECODE, InstanceType.MIX,
+                        InstanceType.DEFAULT, InstanceType.PREFILL,
+                        InstanceType.ENCODE)
+
+    def _resolve_coord(self, name: str) -> Optional[topo.Coord]:
+        """Effective coordinate of a peer, from its coordination
+        registration (cached — bench fleets are static; unresolvable
+        peers are retried on the next handoff, not negatively cached)."""
+        c = self._peer_coords.get(name)
+        if c is not None:
+            return c
+        for t in self._PEER_TYPE_ORDER:
+            try:
+                raw = self.coord.get(instance_key(t.value, name))
+            except Exception:  # noqa: BLE001  # xlint: allow-broad-except(plane outage = no coordinate; the handoff just goes unmodeled)
+                return None
+            if raw:
+                try:
+                    meta = InstanceMetaInfo.from_json(raw)
+                except (ValueError, TypeError):
+                    continue
+                c = topo.effective_coord(meta.topology, name)
+                self._peer_coords[name] = c
+                return c
+        return None
+
+    def _modeled_handoff(self, body: dict[str, Any],
+                         prompt_tokens: int) -> tuple[str, float]:
+        """(link, modeled seconds) for this dispatch's prefill→decode KV
+        handoff; ("", 0.0) when unmodeled (no bytes-per-token knob, no
+        split PD pair, or peer coordinate unresolvable)."""
+        bpt = self.cfg.kv_handoff_bytes_per_token
+        decode_name = (body.get("routing") or {}).get("decode_name") or ""
+        if bpt <= 0 or not decode_name or decode_name == self.name:
+            return "", 0.0
+        peer = self._resolve_coord(decode_name)
+        if peer is None:
+            return "", 0.0
+        link = topo.link_class(self.own_coord(), peer)
+        nbytes = bpt * max(1, prompt_tokens)
+        return link, topo.transfer_cost(nbytes, link,
+                                        self.cfg.ici_bytes_per_s,
+                                        self.cfg.dcn_bytes_per_s)
+
+    async def _h_topology(self, req: web.Request) -> web.Response:
+        """Topo bench evidence: own coordinate + the modeled-handoff log
+        (link class and modeled wire ms per completed handoff)."""
+        mine = self.own_coord()
+        rows = list(self.handoff_log)
+        counts: dict[str, int] = {}
+        for link, _s in rows:
+            counts[link] = counts.get(link, 0) + 1
+        return web.json_response({
+            "name": self.name,
+            "coord": {"slice_id": mine.slice_id, "host": mine.host,
+                      "chip": mine.chip, "placed": mine.placed},
+            "handoff_counts": counts,
+            "handoffs": [{"link": link, "ms": s * 1000.0}
+                         for link, s in rows],
+        })
+
     def _generate_stream(self, sid: str, source: str,
                          body: dict[str, Any]) -> None:
         session = self._push_session
@@ -697,7 +796,15 @@ class FakeEngine:
         with TRACER.span("engine.prefill", prompt_tokens=prompt_tokens,
                          resumed_tokens=len(resume), **span_kw):
             pass
-        with TRACER.span("kv_transfer.offer", simulated=True, **span_kw):
+        # Modeled PD KV handoff (topo bench): when the dispatch routed
+        # decode to a different instance, charge the link-classed wire
+        # time for the prompt's KV payload before the first delta —
+        # prefill→decode handoff gates TTFT exactly like the real
+        # stream pull does.
+        handoff_link, handoff_s = self._modeled_handoff(body, prompt_tokens)
+        with TRACER.span("kv_transfer.offer", simulated=True,
+                         link=handoff_link or "none",
+                         modeled_ms=handoff_s * 1000.0, **span_kw):
             pass
         # Deltas are BATCHED per push like the real agent's streamer
         # (GenerationStreamer flush window): the first delta flushes
@@ -742,6 +849,9 @@ class FakeEngine:
         deadline_ms = int(body.get("deadline_ms") or 0)
         if self.cfg.first_delta_delay_s:
             time.sleep(self.cfg.first_delta_delay_s)   # simulated prefill
+        if handoff_s > 0:
+            time.sleep(handoff_s)                      # modeled KV handoff
+            self.handoff_log.append((handoff_link, handoff_s))
         with TRACER.span("engine.decode", **span_kw) as dsp:
             for i in range(start, n):
                 chunk = chunks[i]
